@@ -1,0 +1,20 @@
+"""pipeedge_tpu: a TPU-native pipeline-parallel transformer inference framework.
+
+A ground-up JAX/XLA rebuild of the capabilities of usc-isi/PipeEdge
+(reference: /root/reference): pipeline-parallel inference over layer-range
+model shards (ViT / DeiT / BERT), microbatch streaming between stages,
+profile-driven heterogeneous scheduling (native C++ DP scheduler +
+reverse-auction schedulers), QuantPipe-style quantized inter-stage
+activations with adaptive bitwidth policies, and heartbeat monitoring.
+
+Architecture (TPU-first, not a port):
+- Model shards are *pure functions* over parameter pytrees with static
+  shapes, jit-compiled per (model, layer-range, microbatch) signature.
+- Stage-to-stage transport inside a slice is XLA collective-permute
+  (`jax.lax.ppermute`) under `shard_map` over a device mesh; a host-driven
+  driver with `jax.device_put` edges is the simple/debug path.
+- The quantized activation wire format is a fixed-shape packed uint32
+  buffer + scalar metadata (vs the reference's pickled dynamic tensors).
+"""
+
+__version__ = "0.1.0"

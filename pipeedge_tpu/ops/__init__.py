@@ -1,0 +1,3 @@
+"""XLA-friendly ops: quantization/bit-packing, clamping, attention."""
+
+from . import clamp, quant  # noqa: F401

@@ -1,0 +1,49 @@
+"""Optimal pre-quantization clipping (Banner et al., NeurIPS 2019).
+
+Capability parity with /root/reference/src/pipeedge/quantization/clamp_op.py:
+clamp activations to +/- alpha before uniform quantization, where alpha is the
+analytically-optimal clipping threshold for a Laplace-distributed tensor:
+alpha = W(3 * 4^b) * sqrt(var/2) (clamp_op.py:22-33), with a GeLU variant that
+treats the post-GeLU distribution as a half bell curve with doubled second
+moment: alpha = W(3 * 4^(b+1)) * sqrt(E[x^2]) (clamp_op.py:6-19).
+
+TPU-first design: the Lambert-W factor depends only on the *static* bitwidth,
+so it is precomputed on the host at trace time (scipy), leaving the on-device
+work as a fused moment-reduction + clip that XLA folds into the surrounding
+quantization kernel. (The reference calls scipy inside the hot path.)
+"""
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+from scipy.special import lambertw
+
+
+@lru_cache(maxsize=None)
+def clamp_factor_laplace(bit: int) -> float:
+    """W(3 * 4^bit), the optimal Laplace clipping multiplier (clamp_op.py:22-24)."""
+    return float(lambertw(3.0 * 4.0 ** bit).real)
+
+
+@lru_cache(maxsize=None)
+def clamp_factor_gelu(bit: int) -> float:
+    """W(3 * 4^(bit+1)) for half-bell post-GeLU tensors (clamp_op.py:6-8)."""
+    return float(lambertw(3.0 * 4.0 ** (bit + 1)).real)
+
+
+@partial(jax.jit, static_argnames=("bit",))
+def clamp_banner2019_laplace(x: jax.Array, bit: int) -> jax.Array:
+    """Clamp to the Laplace-optimal threshold (clamp_op.py:27-33)."""
+    var = jnp.var(x)
+    alpha = clamp_factor_laplace(bit) * jnp.sqrt(0.5 * var)
+    return jnp.clip(x, -alpha, alpha)
+
+
+@partial(jax.jit, static_argnames=("bit",))
+def clamp_banner2019_gelu(x: jax.Array, bit: int) -> jax.Array:
+    """Clamp a post-GeLU tensor (half bell curve, clamp_op.py:11-19)."""
+    second_moment = 2.0 * jnp.mean(jnp.square(x))
+    alpha = clamp_factor_gelu(bit) * jnp.sqrt(0.5 * second_moment)
+    return jnp.clip(x, -alpha, alpha)

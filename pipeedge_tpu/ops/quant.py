@@ -1,0 +1,189 @@
+"""Quantized activation encode/decode as jit-able XLA ops.
+
+Capability parity with the reference QuantPipe subsystem
+(/root/reference/src/pipeedge/quantization/basic_op.py:6-176), redesigned for
+TPU/XLA:
+
+- The reference quantizes on CPU with numpy (uint32 bit-packing via vectorized
+  shifts, basic_op.py:38-90) and ships a 5-element list of dynamically-shaped
+  torch tensors over TCP. Here, everything is a pure jittable function with
+  *static* shapes: the wire format is a `QuantizedTensor` pytree holding one
+  fixed-shape packed uint32 buffer plus per-item scale/shift scalars; the
+  bitwidth and logical shape are static (pytree aux data), so a pipeline edge
+  compiles to a fixed signature and the pack/unpack lowers to vectorized
+  integer shifts on the VPU, fusing with the producing/consuming matmuls.
+- `compression factor` = 32/bit, same discrete bitwidths {2,4,6,8,16,32}
+  (reference basic_op.py:109-111, runtime.py:142-153).
+
+Quantization math (parity with basic_op.py:114-143 'original' mode):
+  shift = min(x); scale = max(x - shift); q = round((x-shift)/scale * (2^b-1));
+  decode: q/(2^b-1) * scale + shift.
+Each item along the leading (microbatch) axis is quantized independently
+(`*_outerdim`, basic_op.py:166-176) via vmap.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Discrete bitwidths the runtime's adaptive policies select among
+# (reference runtime.py:142-153). 0 means "no quantization".
+SUPPORTED_BITS = (0, 1, 2, 3, 4, 5, 6, 8, 16, 32)
+
+
+def compression_factor(bit: int) -> float:
+    """Data-size improvement for a bitwidth > 0 (reference basic_op.py:109-111)."""
+    return 32.0 / bit
+
+
+def packed_words(n_values: int, bit: int) -> int:
+    """Number of uint32 words needed to pack `n_values` `bit`-wide ints.
+
+    Values per word = floor(32/bit) (reference basic_op.py:43 `enc_ratio`).
+    """
+    per_word = 32 // bit
+    return -(-n_values // per_word)  # ceil div
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class QuantizedTensor:
+    """Fixed-shape quantized activation payload (the inter-stage wire format).
+
+    Replaces the reference's `[comm_tensor, shape, scale_factor, shift,
+    quant_bit]` list (basic_op.py:143): `shape` and `bit` are static aux data
+    (known at trace time), so only `data`/`scale`/`shift` travel as arrays.
+
+    data:  uint32 [leading..., words] packed payload (float32 view when bit=0)
+    scale: float32 [leading...] per-item scale factors
+    shift: float32 [leading...] per-item shifts
+    shape: static logical shape of the decoded tensor
+    bit:   static bitwidth (0 = passthrough)
+    """
+    data: jax.Array
+    scale: jax.Array
+    shift: jax.Array
+    shape: Tuple[int, ...]
+    bit: int
+
+    def tree_flatten(self):
+        return (self.data, self.scale, self.shift), (self.shape, self.bit)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        data, scale, shift = children
+        shape, bit = aux
+        return cls(data=data, scale=scale, shift=shift, shape=shape, bit=bit)
+
+    @property
+    def nbytes_wire(self) -> int:
+        """Bytes on the wire (packed payload only)."""
+        return int(np.prod(self.data.shape)) * 4
+
+
+def _pack_bits(ints: jax.Array, bit: int) -> jax.Array:
+    """Pack a flat uint32 array of `bit`-wide values into uint32 words.
+
+    Vectorized shift-and-or, value i goes to word i//per_word at bit offset
+    (i % per_word)*bit — same layout as reference basic_op.py:38-55, but
+    expressed as a single reshaped shift/or that XLA maps onto the VPU.
+    """
+    per_word = 32 // bit
+    n = ints.shape[0]
+    n_pad = packed_words(n, bit) * per_word - n
+    padded = jnp.concatenate([ints, jnp.zeros((n_pad,), jnp.uint32)]) if n_pad else ints
+    grouped = padded.reshape(-1, per_word)
+    shifts = (jnp.arange(per_word, dtype=jnp.uint32) * bit)[None, :]
+    shifted = grouped << shifts
+    return jax.lax.reduce(shifted, np.uint32(0), jax.lax.bitwise_or, dimensions=[1])
+
+
+def _unpack_bits(words: jax.Array, bit: int, n_values: int) -> jax.Array:
+    """Inverse of `_pack_bits` (reference basic_op.py:58-90)."""
+    per_word = 32 // bit
+    shifts = (jnp.arange(per_word, dtype=jnp.uint32) * bit)[None, :]
+    mask = np.uint32((1 << bit) - 1) if bit < 32 else np.uint32(0xFFFFFFFF)
+    values = (words[:, None] >> shifts) & mask
+    return values.reshape(-1)[:n_values]
+
+
+def _quantize_item(x: jax.Array, bit: int, mode: str) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Quantize one tensor to (packed_words, scale, shift).
+
+    'original' mode: q = round(x01 * (2^b - 1)); 'modified': q = clip(floor(
+    x01 * 2^b), 0, 2^b - 1) (reference basic_op.py:17-29). Zero-range inputs
+    (scale == 0) are guarded to avoid the reference's NaN behavior.
+    """
+    flat = x.reshape(-1).astype(jnp.float32)
+    shift = jnp.min(flat)
+    scale = jnp.max(flat - shift)
+    safe_scale = jnp.where(scale > 0, scale, jnp.float32(1))
+    x01 = (flat - shift) / safe_scale
+    if mode == "original":
+        levels = float((1 << bit) - 1)
+        q = jnp.round(x01 * levels)
+    elif mode == "modified":
+        levels = float(1 << bit)
+        q = jnp.clip(jnp.floor(x01 * levels), 0.0, levels - 1.0)
+    else:
+        raise ValueError(f"mode must be 'original' or 'modified', got {mode!r}")
+    return _pack_bits(q.astype(jnp.uint32), bit), scale, shift
+
+
+def _dequantize_item(words: jax.Array, scale: jax.Array, shift: jax.Array,
+                     shape: Sequence[int], bit: int) -> jax.Array:
+    levels = float((1 << bit) - 1)
+    n = int(np.prod(shape))
+    q = _unpack_bits(words, bit, n).astype(jnp.float32)
+    return (q / levels * scale + shift).reshape(shape)
+
+
+@partial(jax.jit, static_argnames=("bit", "mode"))
+def tensor_encode(x: jax.Array, bit: int, mode: str = "original") -> QuantizedTensor:
+    """Encode a whole tensor with one scale/shift (reference basic_op.py:114-143)."""
+    shape = tuple(x.shape)
+    if bit == 0:
+        return QuantizedTensor(data=x, scale=jnp.float32(1), shift=jnp.float32(0),
+                               shape=shape, bit=0)
+    data, scale, shift = _quantize_item(x, bit, mode)
+    return QuantizedTensor(data=data, scale=scale, shift=shift, shape=shape, bit=bit)
+
+
+@jax.jit
+def tensor_decode(enc: QuantizedTensor) -> jax.Array:
+    """Decode `tensor_encode` output (reference basic_op.py:146-163)."""
+    if enc.bit == 0:
+        return enc.data
+    return _dequantize_item(enc.data, enc.scale, enc.shift, enc.shape, enc.bit)
+
+
+@partial(jax.jit, static_argnames=("bit", "mode"))
+def tensor_encode_outerdim(x: jax.Array, bit: int, mode: str = "original") -> QuantizedTensor:
+    """Quantize each item along the leading (microbatch) axis independently.
+
+    Parity with reference basic_op.py:166-170, but as a single vmapped kernel
+    instead of a Python loop + stack.
+    """
+    shape = tuple(x.shape)
+    if bit == 0:
+        b = shape[0]
+        return QuantizedTensor(data=x, scale=jnp.ones((b,), jnp.float32),
+                               shift=jnp.zeros((b,), jnp.float32), shape=shape, bit=0)
+    data, scale, shift = jax.vmap(lambda t: _quantize_item(t, bit, mode))(x)
+    return QuantizedTensor(data=data, scale=scale, shift=shift, shape=shape, bit=bit)
+
+
+@jax.jit
+def tensor_decode_outerdim(enc: QuantizedTensor) -> jax.Array:
+    """Decode `tensor_encode_outerdim` output (reference basic_op.py:173-176)."""
+    if enc.bit == 0:
+        return enc.data
+    item_shape = enc.shape[1:]
+    return jax.vmap(
+        lambda w, sc, sh: _dequantize_item(w, sc, sh, item_shape, enc.bit)
+    )(enc.data, enc.scale, enc.shift)

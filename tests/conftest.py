@@ -1,0 +1,14 @@
+"""Test configuration: run on a virtual 8-device CPU mesh.
+
+Multi-"chip" testing story per SURVEY.md §4: tests run on CPU with
+XLA_FLAGS=--xla_force_host_platform_device_count=8 so pipeline/mesh code is
+exercised across 8 fake devices without TPU hardware. Must be set before the
+first jax backend initialization, hence at conftest import time.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,131 @@
+"""Quantization round-trip tests.
+
+Mirrors the reference's test coverage (test/quant/test_quant.py:8-29:
+quantize → pack → unpack → dequantize restores values within tolerance for
+bitwidths {1,2,3,4,5,6,8,16,32} including shape [8,197,768]) and extends it
+with per-outer-item encoding, bit=0 passthrough, wire-size, and clamp tests.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pipeedge_tpu.ops import clamp, quant
+
+BITS = [1, 2, 3, 4, 5, 6, 8, 16, 32]
+SHAPES = [(8, 24, 48), (3, 5), (128,), (8, 197, 768)]
+
+
+def _max_roundtrip_err(x, bit):
+    """Worst-case error: half a quantization step, floored by f32 precision."""
+    rng = float(np.max(x) - np.min(x))
+    levels = (1 << bit) - 1
+    return max(rng / levels / 2, rng * 2.0 ** -20) + 1e-6
+
+
+@pytest.mark.parametrize("bit", BITS)
+@pytest.mark.parametrize("shape", SHAPES[:3])
+def test_roundtrip_whole_tensor(bit, shape):
+    rng = np.random.default_rng(42)
+    x = rng.uniform(-3, 7, size=shape).astype(np.float32)
+    enc = quant.tensor_encode(jnp.asarray(x), bit)
+    dec = np.asarray(quant.tensor_decode(enc))
+    assert dec.shape == x.shape
+    assert np.max(np.abs(dec - x)) <= _max_roundtrip_err(x, bit)
+
+
+@pytest.mark.parametrize("bit", [2, 4, 8, 16])
+def test_roundtrip_outerdim_large(bit):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 197, 768)).astype(np.float32)
+    enc = quant.tensor_encode_outerdim(jnp.asarray(x), bit)
+    dec = np.asarray(quant.tensor_decode_outerdim(enc))
+    # per-item scale/shift → per-item error bound
+    for i in range(x.shape[0]):
+        assert np.max(np.abs(dec[i] - x[i])) <= _max_roundtrip_err(x[i], bit)
+
+
+def test_outerdim_independent_scales():
+    # items with wildly different ranges must not pollute each other
+    x = np.stack([np.linspace(0, 1, 64, dtype=np.float32),
+                  np.linspace(-1000, 1000, 64, dtype=np.float32)])
+    enc = quant.tensor_encode_outerdim(jnp.asarray(x), 8)
+    assert enc.scale.shape == (2,)
+    dec = np.asarray(quant.tensor_decode_outerdim(enc))
+    assert np.max(np.abs(dec[0] - x[0])) < 1 / 255 + 1e-6
+    assert np.max(np.abs(dec[1] - x[1])) < 2000 / 255 + 1e-3
+
+
+def test_bit0_passthrough():
+    x = jnp.arange(12, dtype=jnp.float32).reshape(3, 4)
+    enc = quant.tensor_encode(x, 0)
+    assert enc.bit == 0
+    np.testing.assert_array_equal(np.asarray(quant.tensor_decode(enc)), np.asarray(x))
+    enc2 = quant.tensor_encode_outerdim(x, 0)
+    np.testing.assert_array_equal(
+        np.asarray(quant.tensor_decode_outerdim(enc2)), np.asarray(x))
+
+
+@pytest.mark.parametrize("bit", [2, 4, 8])
+def test_wire_size_matches_compression_factor(bit):
+    n = 1024
+    x = jnp.ones((n,), jnp.float32)
+    enc = quant.tensor_encode(x, bit)
+    per_word = 32 // bit
+    assert enc.data.shape == (n // per_word,)
+    assert enc.nbytes_wire == n * 4 / quant.compression_factor(bit)
+
+
+def test_modified_mode_roundtrip():
+    rng = np.random.default_rng(7)
+    x = rng.uniform(0, 1, size=(64,)).astype(np.float32)
+    enc = quant.tensor_encode(jnp.asarray(x), 8, mode="modified")
+    dec = np.asarray(quant.tensor_decode(enc))
+    # floor-based quantization: one full step worst case
+    assert np.max(np.abs(dec - x)) <= 1.5 / 255
+
+
+def test_constant_tensor_no_nan():
+    x = jnp.full((16,), 3.25)
+    dec = np.asarray(quant.tensor_decode(quant.tensor_encode(x, 4)))
+    assert np.all(np.isfinite(dec))
+    np.testing.assert_allclose(dec, 3.25, atol=1e-6)
+
+
+def test_encode_is_jittable_inside_larger_fn():
+    @jax.jit
+    def edge(x):
+        enc = quant.tensor_encode_outerdim(x, 8)
+        return quant.tensor_decode_outerdim(enc)
+
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(4, 32)).astype(np.float32))
+    out = edge(x)
+    assert np.max(np.abs(np.asarray(out) - np.asarray(x))) < 0.05
+
+
+# --- clamp ---
+
+def test_clamp_laplace_bounds_and_factor():
+    rng = np.random.default_rng(3)
+    x = rng.laplace(size=(4096,)).astype(np.float32)
+    out = np.asarray(clamp.clamp_banner2019_laplace(jnp.asarray(x), 4))
+    expected_alpha = clamp.clamp_factor_laplace(4) * np.sqrt(0.5 * np.var(x))
+    assert np.max(np.abs(out)) <= expected_alpha + 1e-5
+    inside = np.abs(x) < expected_alpha
+    np.testing.assert_allclose(out[inside], x[inside], rtol=1e-6)
+
+
+def test_clamp_factors_increase_with_bit():
+    lap = [clamp.clamp_factor_laplace(b) for b in (2, 4, 8, 16)]
+    assert all(a < b for a, b in zip(lap, lap[1:]))
+    # gelu factor at bit b equals laplace factor at bit b+1 (clamp_op.py:6-8, 22-24)
+    assert clamp.clamp_factor_gelu(4) == pytest.approx(clamp.clamp_factor_laplace(5))
+
+
+def test_clamp_gelu_halfbell():
+    rng = np.random.default_rng(5)
+    x = np.abs(rng.normal(size=(4096,))).astype(np.float32)  # post-GeLU-like
+    out = np.asarray(clamp.clamp_banner2019_gelu(jnp.asarray(x), 4))
+    second = 2 * np.mean(x ** 2)
+    expected_alpha = clamp.clamp_factor_gelu(4) * np.sqrt(0.5 * second)
+    assert np.max(out) <= expected_alpha + 1e-5
