@@ -20,7 +20,7 @@ def _max_roundtrip_err(x, bit):
     """Worst-case error: half a quantization step, floored by f32 precision."""
     rng = float(np.max(x) - np.min(x))
     levels = (1 << bit) - 1
-    return max(rng / levels / 2, rng * 2.0 ** -20) + 1e-6
+    return rng / levels / 2 + rng * 2.0 ** -20 + 1e-6
 
 
 @pytest.mark.parametrize("bit", BITS)
