@@ -1,0 +1,148 @@
+"""Model sharding core: layer-range shard configs and partition arithmetic.
+
+Capability parity with /root/reference/src/pipeedge/models/__init__.py (the
+`ModuleShard`/`ModuleShardConfig` abstractions), redesigned for JAX: a shard
+is not a module object but a *(static plan, parameter pytree, pure apply
+function)* triple. The same 1-based layer numbering applies: each transformer
+block counts as 4 schedulable sublayers (attention, attention-output+residual,
+MLP-up, MLP-down+residual — reference vit.py:41-70), so ViT-Base has 48
+"layers". Any contiguous `[layer_start, layer_end]` range is a valid shard,
+including mid-block cuts, whose inter-stage payload is then a 2-tensor tuple
+(reference transformers/__init__.py:5).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+
+SUBLAYERS_PER_BLOCK = 4
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardConfig:
+    """Static description of a layer-range shard (reference models/__init__.py:9-22).
+
+    Layers are 1-based and inclusive, counted in sublayers (4 per block).
+    `is_first` adds the embedding layer; `is_last` adds the final norm /
+    pooler / classifier head.
+    """
+    layer_start: int
+    layer_end: int
+    is_first: bool = False
+    is_last: bool = False
+
+    def __post_init__(self):
+        if not 1 <= self.layer_start <= self.layer_end:
+            raise ValueError(
+                f"invalid layer range [{self.layer_start}, {self.layer_end}]")
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockSlice:
+    """One transformer block's contribution to a shard: sublayers [sub_start, sub_end]."""
+    block_id: int   # 0-based transformer block index
+    sub_start: int  # 0..3
+    sub_end: int    # 0..3
+
+    @property
+    def is_full(self) -> bool:
+        return self.sub_start == 0 and self.sub_end == 3
+
+    def sublayers(self) -> range:
+        return range(self.sub_start, self.sub_end + 1)
+
+
+def block_slices(layer_start: int, layer_end: int) -> Tuple[BlockSlice, ...]:
+    """Decompose a 1-based sublayer range into per-block slices.
+
+    Same arithmetic as the reference shard builders (vit.py:99-113):
+    block = ceil(layer/4) - 1, sublayer = (layer-1) % 4.
+    """
+    slices = []
+    layer_curr = layer_start
+    while layer_curr <= layer_end:
+        block_id = math.ceil(layer_curr / SUBLAYERS_PER_BLOCK) - 1
+        sub_start = (layer_curr - 1) % SUBLAYERS_PER_BLOCK
+        if block_id == math.ceil(layer_end / SUBLAYERS_PER_BLOCK) - 1:
+            sub_end = (layer_end - 1) % SUBLAYERS_PER_BLOCK
+        else:
+            sub_end = SUBLAYERS_PER_BLOCK - 1
+        slices.append(BlockSlice(block_id, sub_start, sub_end))
+        layer_curr += sub_end - sub_start + 1
+    return tuple(slices)
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardPlan:
+    """Static execution plan for a shard: partial head block, scanned full
+    blocks, partial tail block.
+
+    The reference builds a Python list of per-block sub-shards and loops over
+    them (vit.py:99-113, 161-170); under jit we instead stack the full blocks'
+    parameters and `lax.scan` over them (one compiled block body regardless of
+    depth), with at most two partially-applied blocks at the shard edges.
+    """
+    head: Optional[BlockSlice]
+    full_ids: Tuple[int, ...]
+    tail: Optional[BlockSlice]
+
+    @property
+    def slices(self) -> Tuple[BlockSlice, ...]:
+        out = []
+        if self.head is not None:
+            out.append(self.head)
+        out.extend(BlockSlice(b, 0, 3) for b in self.full_ids)
+        if self.tail is not None:
+            out.append(self.tail)
+        return tuple(out)
+
+
+def plan_shard(shard_config: ShardConfig) -> ShardPlan:
+    """Compute the head/scan/tail plan for a layer range."""
+    slices = block_slices(shard_config.layer_start, shard_config.layer_end)
+    head = None
+    tail = None
+    if not slices[0].is_full:
+        head = slices[0]
+        slices = slices[1:]
+    if slices and not slices[-1].is_full:
+        tail = slices[-1]
+        slices = slices[:-1]
+    assert all(s.is_full for s in slices)
+    return ShardPlan(head=head, full_ids=tuple(s.block_id for s in slices), tail=tail)
+
+
+def edge_arity(layer_end: int) -> int:
+    """Number of tensors in the payload leaving a shard ending at `layer_end`.
+
+    A cut after sublayer 0 (attention) or 2 (MLP-up) leaves a (hidden,
+    residual) 2-tuple in flight; after sublayer 1 or 3 the residual has been
+    folded in and a single tensor flows (reference vit.py:56-70,
+    transformers/__init__.py:5).
+    """
+    sub = (layer_end - 1) % SUBLAYERS_PER_BLOCK
+    return 2 if sub in (0, 2) else 1
+
+
+def get_microbatch_size(shard_data, verify: bool = False) -> int:
+    """Microbatch size of a shard payload (reference models/__init__.py:39-49)."""
+    if not isinstance(shard_data, (tuple, list)):
+        shard_data = (shard_data,)
+    ubatch_size = 0 if len(shard_data) == 0 else len(shard_data[0])
+    if verify:
+        for tensor in shard_data:
+            assert len(tensor) == ubatch_size
+    return ubatch_size
+
+
+def num_params(params) -> int:
+    """Total parameter count of a pytree."""
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))
+
+
+def params_bytes(params) -> int:
+    """Total parameter bytes of a pytree."""
+    return sum(x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(params))

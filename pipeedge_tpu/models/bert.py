@@ -1,0 +1,185 @@
+"""BERT model family: pure-function shards with the 4-way sublayer split.
+
+Capability parity with /root/reference/src/pipeedge/models/transformers/bert.py.
+BERT is post-LN, so the sublayer split differs from ViT (`BertLayerShard.forward`,
+bert.py:41-52):
+  sub 0: self-attention (no pre-norm)     payload becomes (ctx, residual)
+  sub 1: output dense + residual, then LN payload becomes hidden
+  sub 2: MLP-up + GeLU                    payload becomes (mlp_h, residual)
+  sub 3: MLP-down + residual, then LN     payload becomes hidden
+First shard: word/position/token-type embeddings + LN (bert.py:76-80). Last
+shard: tanh pooler over the CLS token (bert.py:98-102), plus a classifier head
+for sequence classification (bert.py:186-208).
+
+Weight format: HF `BertModel` state-dict npz, the reference's native format
+(bert.py:153-161); classification checkpoints carry a `bert.` prefix that is
+stripped (bert.py:191-196).
+"""
+from __future__ import annotations
+
+from typing import Dict, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import ShardConfig
+from .layers import TransformerConfig, dense, gelu, layer_norm, self_attention
+from .shard import FamilySpec, build_shard_params
+
+SUBLAYER_PARAMS = {
+    0: ("q", "k", "v"),
+    1: ("attn_out", "attn_ln"),
+    2: ("mlp_up",),
+    3: ("mlp_down", "out_ln"),
+}
+
+
+def embed(p: Dict, input_ids: jax.Array, cfg: TransformerConfig) -> jax.Array:
+    """Sum of word/position/token-type embeddings + LayerNorm.
+
+    Token type ids default to zeros and positions to [0, S) — the reference
+    passes only input ids to `BertEmbeddings` (bert.py:145-146).
+    """
+    seq_len = input_ids.shape[1]
+    word = jnp.take(p["word"], input_ids, axis=0)
+    pos = p["pos"][:seq_len][None, :, :]
+    ttype = p["type"][0][None, None, :]
+    hidden = word + pos + ttype
+    return layer_norm(p["ln"], hidden, cfg.layer_norm_eps)
+
+
+def sublayer(p: Dict, sub: int, data, cfg: TransformerConfig):
+    """One of the 4 schedulable sublayers (reference bert.py:41-52)."""
+    if sub == 0:
+        ctx = self_attention({"q": p["q"], "k": p["k"], "v": p["v"]},
+                             data, cfg.num_attention_heads)
+        return (ctx, data)
+    if sub == 1:
+        ctx, skip = data
+        return layer_norm(p["attn_ln"], dense(p["attn_out"], ctx) + skip,
+                          cfg.layer_norm_eps)
+    if sub == 2:
+        return (gelu(dense(p["mlp_up"], data)), data)
+    if sub == 3:
+        mlp_h, skip = data
+        return layer_norm(p["out_ln"], dense(p["mlp_down"], mlp_h) + skip,
+                          cfg.layer_norm_eps)
+    raise ValueError(f"sublayer must be 0..3, got {sub}")
+
+
+def finalize(p: Dict, hidden: jax.Array, cfg: TransformerConfig) -> jax.Array:
+    """Tanh pooler on CLS (bert.py:98-102); classifier head when present."""
+    pooled = jnp.tanh(dense(p["pooler"], hidden[:, 0, :]))
+    if "head" in p:
+        return dense(p["head"], pooled)
+    return pooled
+
+
+FAMILY = FamilySpec(name="bert", embed=embed, sublayer=sublayer, finalize=finalize)
+
+
+def _a(x, dtype):
+    return jnp.asarray(np.asarray(x), dtype=dtype)
+
+
+def load_params(cfg: TransformerConfig, shard_config: ShardConfig,
+                weights: Mapping, dtype=jnp.float32) -> Dict:
+    """Build shard params from an HF-state-dict npz (bert.py:104-141).
+
+    Accepts both bare `BertModel` keys and `bert.`-prefixed classification
+    checkpoints (with `classifier.*`, bert.py:191-201).
+    """
+    if any(k.startswith("bert.") for k in weights.keys()):
+        sd = {k.removeprefix("bert."): weights[k] for k in weights.keys()
+              if k.startswith("bert.")}
+        classifier = {k: weights[k] for k in weights.keys()
+                      if k.startswith("classifier.")}
+    else:
+        sd = dict(weights.items()) if not isinstance(weights, dict) else weights
+        classifier = sd
+
+    def get_embed() -> Dict:
+        return {
+            "word": _a(sd["embeddings.word_embeddings.weight"], dtype),
+            "pos": _a(sd["embeddings.position_embeddings.weight"], dtype),
+            "type": _a(sd["embeddings.token_type_embeddings.weight"], dtype),
+            "ln": {"scale": _a(sd["embeddings.LayerNorm.weight"], dtype),
+                   "bias": _a(sd["embeddings.LayerNorm.bias"], dtype)},
+        }
+
+    def get_block(block_id: int, subs: tuple) -> Dict:
+        root = f"encoder.layer.{block_id}."
+        p: Dict = {}
+        if 0 in subs:
+            for name, key in (("q", "query"), ("k", "key"), ("v", "value")):
+                p[name] = {"w": _a(sd[root + f"attention.self.{key}.weight"], dtype).T,
+                           "b": _a(sd[root + f"attention.self.{key}.bias"], dtype)}
+        if 1 in subs:
+            p["attn_out"] = {"w": _a(sd[root + "attention.output.dense.weight"], dtype).T,
+                             "b": _a(sd[root + "attention.output.dense.bias"], dtype)}
+            p["attn_ln"] = {"scale": _a(sd[root + "attention.output.LayerNorm.weight"], dtype),
+                            "bias": _a(sd[root + "attention.output.LayerNorm.bias"], dtype)}
+        if 2 in subs:
+            p["mlp_up"] = {"w": _a(sd[root + "intermediate.dense.weight"], dtype).T,
+                           "b": _a(sd[root + "intermediate.dense.bias"], dtype)}
+        if 3 in subs:
+            p["mlp_down"] = {"w": _a(sd[root + "output.dense.weight"], dtype).T,
+                             "b": _a(sd[root + "output.dense.bias"], dtype)}
+            p["out_ln"] = {"scale": _a(sd[root + "output.LayerNorm.weight"], dtype),
+                           "bias": _a(sd[root + "output.LayerNorm.bias"], dtype)}
+        return p
+
+    def get_final() -> Dict:
+        p = {"pooler": {"w": _a(sd["pooler.dense.weight"], dtype).T,
+                        "b": _a(sd["pooler.dense.bias"], dtype)}}
+        if cfg.num_labels > 0 and "classifier.weight" in classifier:
+            p["head"] = {"w": _a(classifier["classifier.weight"], dtype).T,
+                         "b": _a(classifier["classifier.bias"], dtype)}
+        return p
+
+    return build_shard_params(shard_config, get_embed, get_block, get_final)
+
+
+def init_params(cfg: TransformerConfig, shard_config: ShardConfig,
+                seed: int = 0, dtype=jnp.float32) -> Dict:
+    """Random shard params with the same pytree structure as `load_params`."""
+    rng = np.random.default_rng(seed)
+    d, it = cfg.hidden_size, cfg.intermediate_size
+
+    def mat(*shape):
+        return jnp.asarray(rng.normal(0, 0.02, size=shape), dtype=dtype)
+
+    def vec(n):
+        return jnp.zeros((n,), dtype=dtype)
+
+    def ln():
+        return {"scale": jnp.ones((d,), dtype), "bias": vec(d)}
+
+    def get_embed() -> Dict:
+        return {"word": mat(cfg.vocab_size, d),
+                "pos": mat(cfg.max_position_embeddings, d),
+                "type": mat(cfg.type_vocab_size, d), "ln": ln()}
+
+    def get_block(block_id: int, subs: tuple) -> Dict:
+        p: Dict = {}
+        if 0 in subs:
+            for name in ("q", "k", "v"):
+                p[name] = {"w": mat(d, d), "b": vec(d)}
+        if 1 in subs:
+            p["attn_out"] = {"w": mat(d, d), "b": vec(d)}
+            p["attn_ln"] = ln()
+        if 2 in subs:
+            p["mlp_up"] = {"w": mat(d, it), "b": vec(it)}
+        if 3 in subs:
+            p["mlp_down"] = {"w": mat(it, d), "b": vec(d)}
+            p["out_ln"] = ln()
+        return p
+
+    def get_final() -> Dict:
+        p = {"pooler": {"w": mat(d, d), "b": vec(d)}}
+        if cfg.num_labels > 0:
+            p["head"] = {"w": mat(d, cfg.num_labels), "b": vec(cfg.num_labels)}
+        return p
+
+    return build_shard_params(shard_config, get_embed, get_block, get_final)

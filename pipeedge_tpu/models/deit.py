@@ -1,0 +1,159 @@
+"""DeiT model family: ViT sublayer math with distillation-token embeddings.
+
+Capability parity with /root/reference/src/pipeedge/models/transformers/deit.py.
+The encoder block is identical to ViT (the reference's `DeiTLayerShard` is a
+copy of `ViTLayerShard`, deit.py:27-69), so this module reuses `vit.sublayer`.
+Differences: embeddings prepend both a CLS and a distillation token
+(deit.py:119-126), and the native checkpoint format is the facebookresearch
+torch-hub state dict with *fused* qkv kernels that must be split
+(deit.py:130-156). The classifier head uses the CLS token only (deit.py:224-227).
+"""
+from __future__ import annotations
+
+from typing import Dict, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import ShardConfig
+from .layers import TransformerConfig, dense, layer_norm, patchify
+from .shard import FamilySpec, build_shard_params
+from .vit import SUBLAYER_PARAMS, sublayer  # block math shared with ViT
+
+__all__ = ["FAMILY", "SUBLAYER_PARAMS", "load_params", "init_params",
+           "hf_to_npz_weights"]
+
+
+def embed(p: Dict, pixel_values: jax.Array, cfg: TransformerConfig) -> jax.Array:
+    """Patch embedding + [CLS, DIST] tokens + position embeddings (deit.py:119-126)."""
+    x = jnp.transpose(pixel_values, (0, 2, 3, 1))
+    patches = patchify(x, cfg.patch_size)
+    hidden = dense(p["patch"], patches.astype(p["patch"]["w"].dtype))
+    b = hidden.shape[0]
+    cls = jnp.broadcast_to(p["cls"], (b, 1, cfg.hidden_size)).astype(hidden.dtype)
+    dist = jnp.broadcast_to(p["dist"], (b, 1, cfg.hidden_size)).astype(hidden.dtype)
+    hidden = jnp.concatenate([cls, dist, hidden], axis=1)
+    return hidden + p["pos"].astype(hidden.dtype)
+
+
+def finalize(p: Dict, hidden: jax.Array, cfg: TransformerConfig) -> jax.Array:
+    """Final layernorm; classifier on the CLS token (deit.py:157-166, 224-227)."""
+    hidden = layer_norm(p["ln"], hidden, cfg.layer_norm_eps)
+    if "head" in p:
+        return dense(p["head"], hidden[:, 0, :])
+    return hidden
+
+
+FAMILY = FamilySpec(name="deit", embed=embed, sublayer=sublayer, finalize=finalize)
+
+
+def _a(x, dtype):
+    return jnp.asarray(np.asarray(x), dtype=dtype)
+
+
+def load_params(cfg: TransformerConfig, shard_config: ShardConfig,
+                weights: Mapping, dtype=jnp.float32) -> Dict:
+    """Build shard params from a torch-hub DeiT state-dict npz (deit.py:118-156)."""
+    d = cfg.hidden_size
+
+    def get_embed() -> Dict:
+        kernel = np.asarray(weights["patch_embed.proj.weight"])  # [D, C, ph, pw]
+        return {
+            "cls": _a(weights["cls_token"], dtype),
+            "dist": _a(weights["dist_token"], dtype),
+            "pos": _a(weights["pos_embed"], dtype),
+            "patch": {"w": _a(kernel.transpose(2, 3, 1, 0).reshape(-1, d), dtype),
+                      "b": _a(weights["patch_embed.proj.bias"], dtype)},
+        }
+
+    def get_block(block_id: int, subs: tuple) -> Dict:
+        root = f"blocks.{block_id}."
+        p: Dict = {}
+        if 0 in subs:
+            p["ln_before"] = {"scale": _a(weights[root + "norm1.weight"], dtype),
+                              "bias": _a(weights[root + "norm1.bias"], dtype)}
+            # fused qkv [3D, D] torch-layout -> split + transpose to [in, out]
+            qkv_w = np.asarray(weights[root + "attn.qkv.weight"])
+            qkv_b = np.asarray(weights[root + "attn.qkv.bias"])
+            for i, name in enumerate(("q", "k", "v")):
+                p[name] = {"w": _a(qkv_w[i * d:(i + 1) * d, :].T, dtype),
+                           "b": _a(qkv_b[i * d:(i + 1) * d], dtype)}
+        if 1 in subs:
+            p["attn_out"] = {"w": _a(np.asarray(weights[root + "attn.proj.weight"]).T, dtype),
+                             "b": _a(weights[root + "attn.proj.bias"], dtype)}
+        if 2 in subs:
+            p["ln_after"] = {"scale": _a(weights[root + "norm2.weight"], dtype),
+                             "bias": _a(weights[root + "norm2.bias"], dtype)}
+            p["mlp_up"] = {"w": _a(np.asarray(weights[root + "mlp.fc1.weight"]).T, dtype),
+                           "b": _a(weights[root + "mlp.fc1.bias"], dtype)}
+        if 3 in subs:
+            p["mlp_down"] = {"w": _a(np.asarray(weights[root + "mlp.fc2.weight"]).T, dtype),
+                             "b": _a(weights[root + "mlp.fc2.bias"], dtype)}
+        return p
+
+    def get_final() -> Dict:
+        p = {"ln": {"scale": _a(weights["norm.weight"], dtype),
+                    "bias": _a(weights["norm.bias"], dtype)}}
+        if cfg.num_labels > 0 and "head.weight" in weights:
+            p["head"] = {"w": _a(np.asarray(weights["head.weight"]).T, dtype),
+                         "b": _a(weights["head.bias"], dtype)}
+        return p
+
+    return build_shard_params(shard_config, get_embed, get_block, get_final)
+
+
+def hf_to_npz_weights(state_dict: Mapping, cfg: TransformerConfig) -> Dict[str, np.ndarray]:
+    """Convert an HF DeiT state dict to the torch-hub key scheme the loader
+    (and the reference, deit.py:118-156) expects."""
+    sd = {k.removeprefix("deit."): np.asarray(v) for k, v in state_dict.items()}
+    out = {
+        "cls_token": sd["embeddings.cls_token"],
+        "dist_token": sd["embeddings.distillation_token"],
+        "pos_embed": sd["embeddings.position_embeddings"],
+        "patch_embed.proj.weight": sd["embeddings.patch_embeddings.projection.weight"],
+        "patch_embed.proj.bias": sd["embeddings.patch_embeddings.projection.bias"],
+        "norm.weight": sd["layernorm.weight"],
+        "norm.bias": sd["layernorm.bias"],
+    }
+    if "cls_classifier.weight" in sd:
+        out["head.weight"] = sd["cls_classifier.weight"]
+        out["head.bias"] = sd["cls_classifier.bias"]
+    for i in range(cfg.num_hidden_layers):
+        hf_root = f"encoder.layer.{i}."
+        attn_prefix = None
+        for cand in ("attention.attention.", "attention.self."):
+            if hf_root + cand + "query.weight" in sd:
+                attn_prefix = hf_root + cand
+                break
+        root = f"blocks.{i}."
+        out[root + "norm1.weight"] = sd[hf_root + "layernorm_before.weight"]
+        out[root + "norm1.bias"] = sd[hf_root + "layernorm_before.bias"]
+        out[root + "attn.qkv.weight"] = np.concatenate(
+            [sd[attn_prefix + n + ".weight"] for n in ("query", "key", "value")], axis=0)
+        out[root + "attn.qkv.bias"] = np.concatenate(
+            [sd[attn_prefix + n + ".bias"] for n in ("query", "key", "value")], axis=0)
+        out[root + "attn.proj.weight"] = sd[hf_root + "attention.output.dense.weight"]
+        out[root + "attn.proj.bias"] = sd[hf_root + "attention.output.dense.bias"]
+        out[root + "norm2.weight"] = sd[hf_root + "layernorm_after.weight"]
+        out[root + "norm2.bias"] = sd[hf_root + "layernorm_after.bias"]
+        out[root + "mlp.fc1.weight"] = sd[hf_root + "intermediate.dense.weight"]
+        out[root + "mlp.fc1.bias"] = sd[hf_root + "intermediate.dense.bias"]
+        out[root + "mlp.fc2.weight"] = sd[hf_root + "output.dense.weight"]
+        out[root + "mlp.fc2.bias"] = sd[hf_root + "output.dense.bias"]
+    return out
+
+
+def init_params(cfg: TransformerConfig, shard_config: ShardConfig,
+                seed: int = 0, dtype=jnp.float32) -> Dict:
+    """Random shard params with the same pytree structure as `load_params`."""
+    from .vit import init_params as vit_init
+    rng = np.random.default_rng(seed + 1)
+    params = vit_init(cfg, shard_config, seed=seed, dtype=dtype)
+    if shard_config.is_first:
+        d = cfg.hidden_size
+        params["embeddings"]["dist"] = jnp.asarray(
+            rng.normal(0, 0.02, size=(1, 1, d)), dtype=dtype)
+        params["embeddings"]["pos"] = jnp.asarray(
+            rng.normal(0, 0.02, size=(1, cfg.num_patches + 2, d)), dtype=dtype)
+    return params

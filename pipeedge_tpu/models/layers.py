@@ -1,0 +1,107 @@
+"""Pure-function transformer building blocks (MXU-friendly, dtype-flexible).
+
+These replace the reference's use of HuggingFace torch modules
+(ViTSelfAttention/ViTIntermediate/... — reference vit.py:12-14, bert.py:10-12)
+with jittable functions over parameter pytrees. Matmuls accumulate in float32
+via `preferred_element_type` so bfloat16 parameters/activations keep MXU
+throughput without losing accumulation precision.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    """Static model hyperparameters (stands in for HF `AutoConfig`, which the
+    reference fetches over the network — model_cfg.py:57-66; here configs are
+    local constants so the framework runs with zero egress)."""
+    model_type: str              # 'vit' | 'bert' | 'deit'
+    hidden_size: int
+    num_hidden_layers: int       # transformer blocks (sublayers = 4x this)
+    num_attention_heads: int
+    intermediate_size: int
+    layer_norm_eps: float = 1e-12
+    num_labels: int = 0
+    # vision
+    image_size: int = 224
+    patch_size: int = 16
+    num_channels: int = 3
+    # text
+    vocab_size: int = 0
+    max_position_embeddings: int = 0
+    type_vocab_size: int = 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+def layer_norm(p, x: jax.Array, eps: float) -> jax.Array:
+    """LayerNorm with scale/bias, computed in float32 for stability."""
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    normed = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (normed * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def dense(p, x: jax.Array) -> jax.Array:
+    """x @ w + b with kernels stored [in, out] (JAX convention; torch state
+    dicts store [out, in] and are transposed at load time)."""
+    y = jnp.dot(x, p["w"].astype(x.dtype), preferred_element_type=jnp.float32)
+    return (y + p["b"]).astype(x.dtype)
+
+
+def self_attention(p, x: jax.Array, num_heads: int,
+                   mask: Optional[jax.Array] = None) -> jax.Array:
+    """Multi-head self-attention context (pre-projection), batched over [B,S,D].
+
+    Matches HF `{ViT,Bert}SelfAttention` semantics: returns the concatenated
+    per-head context; the output projection lives in the next sublayer
+    (reference vit.py:58-63). Softmax in float32.
+    """
+    b, s, d = x.shape
+    hd = d // num_heads
+    q = dense(p["q"], x).reshape(b, s, num_heads, hd)
+    k = dense(p["k"], x).reshape(b, s, num_heads, hd)
+    v = dense(p["v"], x).reshape(b, s, num_heads, hd)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(hd))
+    if mask is not None:
+        # mask: [B, S] with 1 = attend, 0 = ignore
+        bias = jnp.where(mask[:, None, None, :] > 0, 0.0, -1e9).astype(jnp.float32)
+        scores = scores + bias
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v,
+                     preferred_element_type=jnp.float32).astype(x.dtype)
+    return ctx.reshape(b, s, d)
+
+
+def gelu(x: jax.Array) -> jax.Array:
+    """Exact (erf) GeLU, matching torch `nn.GELU()` default used by HF."""
+    return jax.nn.gelu(x, approximate=False)
+
+
+def patchify(x: jax.Array, patch: int) -> jax.Array:
+    """[B, H, W, C] -> [B, N, patch*patch*C] with (ph, pw, c) flattening order.
+
+    Expressing patch embedding as reshape + one big matmul (instead of a
+    strided conv) maps directly onto the MXU; the kernel layout matches, e.g.,
+    Google's ViT npz `embedding/kernel` [ph, pw, C, D] reshaped to
+    [ph*pw*C, D] (reference vit.py:124-128 does the conv-layout dance instead).
+    """
+    b, h, w, c = x.shape
+    nh, nw = h // patch, w // patch
+    x = x.reshape(b, nh, patch, nw, patch, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, nh * nw, patch * patch * c)

@@ -1,0 +1,132 @@
+"""Model registry and shard factories (parity with /root/reference/model_cfg.py).
+
+Same 9 supported models and layer counts (model_cfg.py:24-43); layer counts
+are in sublayers (4 per transformer block). Unlike the reference, model
+configs are local constants rather than `AutoConfig.from_pretrained` network
+fetches (model_cfg.py:57-66), so everything works with zero egress; the
+ViT-Huge num_labels=21843 override is baked in (model_cfg.py:62-66).
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import ShardConfig
+from .layers import TransformerConfig
+from .shard import make_shard_fn
+from . import bert as bert_mod
+from . import deit as deit_mod
+from . import vit as vit_mod
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelEntry:
+    name: str
+    layers: int                  # sublayer count = 4 * blocks
+    weights_file: str            # default npz filename (reference format)
+    family: object               # module: vit_mod | bert_mod | deit_mod
+    config: TransformerConfig
+
+
+def _vit(name, layers, weights, hidden, blocks, heads, inter, labels,
+         patch=16, img=224):
+    return ModelEntry(name, layers, weights, vit_mod, TransformerConfig(
+        model_type="vit", hidden_size=hidden, num_hidden_layers=blocks,
+        num_attention_heads=heads, intermediate_size=inter, num_labels=labels,
+        image_size=img, patch_size=patch))
+
+
+def _bert(name, layers, weights, hidden, blocks, heads, inter, labels):
+    return ModelEntry(name, layers, weights, bert_mod, TransformerConfig(
+        model_type="bert", hidden_size=hidden, num_hidden_layers=blocks,
+        num_attention_heads=heads, intermediate_size=inter, num_labels=labels,
+        vocab_size=30522, max_position_embeddings=512))
+
+
+def _deit(name, layers, weights, hidden, blocks, heads, inter):
+    return ModelEntry(name, layers, weights, deit_mod, TransformerConfig(
+        model_type="deit", hidden_size=hidden, num_hidden_layers=blocks,
+        num_attention_heads=heads, intermediate_size=inter, num_labels=1000))
+
+
+_MODELS: Dict[str, ModelEntry] = {e.name: e for e in [
+    _vit("google/vit-base-patch16-224", 48, "ViT-B_16-224.npz", 768, 12, 12, 3072, 1000),
+    _vit("google/vit-large-patch16-224", 96, "ViT-L_16-224.npz", 1024, 24, 16, 4096, 1000),
+    _vit("google/vit-huge-patch14-224-in21k", 128, "ViT-H_14.npz", 1280, 32, 16, 5120,
+         21843, patch=14),
+    _bert("bert-base-uncased", 48, "BERT-B.npz", 768, 12, 12, 3072, 0),
+    _bert("bert-large-uncased", 96, "BERT-L.npz", 1024, 24, 16, 4096, 0),
+    _bert("textattack/bert-base-uncased-CoLA", 48, "BERT-B-CoLA.npz", 768, 12, 12, 3072, 2),
+    _deit("facebook/deit-base-distilled-patch16-224", 48, "DeiT_B_distilled.npz",
+          768, 12, 12, 3072),
+    _deit("facebook/deit-small-distilled-patch16-224", 48, "DeiT_S_distilled.npz",
+          384, 12, 6, 1536),
+    _deit("facebook/deit-tiny-distilled-patch16-224", 48, "DeiT_T_distilled.npz",
+          192, 12, 3, 768),
+]}
+
+
+def get_model_names() -> List[str]:
+    """Available model names (model_cfg.py:45-47)."""
+    return list(_MODELS.keys())
+
+
+def get_model_entry(model_name: str) -> ModelEntry:
+    return _MODELS[model_name]
+
+
+def get_model_layers(model_name: str) -> int:
+    """Total sublayer count (model_cfg.py:53-55)."""
+    return _MODELS[model_name].layers
+
+
+def get_model_config(model_name: str) -> TransformerConfig:
+    """Static config (model_cfg.py:57-66, without the network fetch)."""
+    return _MODELS[model_name].config
+
+
+def get_model_default_weights_file(model_name: str) -> str:
+    """Default weights filename (model_cfg.py:68-70)."""
+    return _MODELS[model_name].weights_file
+
+
+def make_shard_config(model_name: str, layer_start: int, layer_end: int) -> ShardConfig:
+    """is_first/is_last derived from the global layer range (model_cfg.py:87-90)."""
+    return ShardConfig(layer_start=layer_start, layer_end=layer_end,
+                       is_first=layer_start == 1,
+                       is_last=layer_end == get_model_layers(model_name))
+
+
+def module_shard_factory(model_name: str, model_file: Optional[str],
+                         layer_start: int, layer_end: int, stage: int = 0,
+                         dtype=jnp.float32) -> Tuple[Callable, Dict, ShardConfig]:
+    """Build one pipeline stage: (jitted shard fn, params, shard config).
+
+    Parity with model_cfg.py:80-95. If the weights file is missing, falls back
+    to deterministic random initialization (same pytree structure) so the
+    framework runs end-to-end with zero egress; a warning is logged since
+    outputs then aren't pretrained.
+    """
+    entry = _MODELS[model_name]
+    if model_file is None:
+        model_file = entry.weights_file
+    shard_config = make_shard_config(model_name, layer_start, layer_end)
+    if model_file and os.path.exists(model_file):
+        with np.load(model_file) as weights:
+            params = entry.family.load_params(entry.config, shard_config, weights,
+                                              dtype=dtype)
+    else:
+        logger.warning("weights file %r not found for %s; using random init",
+                       model_file, model_name)
+        params = entry.family.init_params(entry.config, shard_config, dtype=dtype)
+    fn = make_shard_fn(entry.family.FAMILY, entry.config, shard_config)
+    logger.info("======= %s stage %d: layers [%d, %d] =======",
+                model_name, stage, layer_start, layer_end)
+    return fn, params, shard_config

@@ -1,0 +1,107 @@
+"""Generic shard execution engine shared by all model families.
+
+The reference runs a Python list of per-block torch sub-modules
+(vit.py:161-170, bert.py:142-151, deit.py:157-166). Here a shard executes as:
+
+    embeddings? -> partial head block -> lax.scan over stacked full blocks
+                -> partial tail block -> final norm/pooler/classifier?
+
+One compiled block body serves any pipeline depth (compile time independent of
+layer count), parameters for the scanned blocks live as one stacked pytree
+(leading axis = block), and partial blocks at the shard edges — which exist
+because PipeEdge partitions at sublayer granularity — are unrolled explicitly.
+
+A model family plugs in three pure functions via `FamilySpec`:
+  embed(embed_params, raw_input, cfg)        -> hidden [B, S, D]
+  sublayer(block_params, sub, payload, cfg)  -> payload (tensor or 2-tuple)
+  finalize(final_params, hidden, cfg)        -> model output
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+from . import BlockSlice, ShardConfig, plan_shard
+from .layers import TransformerConfig
+
+ShardData = Any  # jax.Array | tuple[jax.Array, jax.Array]
+
+
+@dataclasses.dataclass(frozen=True)
+class FamilySpec:
+    """Pure-function hooks defining a model family (vit/bert/deit)."""
+    name: str
+    embed: Callable[[Dict, Any, TransformerConfig], jax.Array]
+    sublayer: Callable[[Dict, int, ShardData, TransformerConfig], ShardData]
+    finalize: Callable[[Dict, jax.Array, TransformerConfig], jax.Array]
+
+
+def _apply_slice(family: FamilySpec, block_params: Dict, data: ShardData,
+                 blk: BlockSlice, cfg: TransformerConfig) -> ShardData:
+    for sub in blk.sublayers():
+        data = family.sublayer(block_params, sub, data, cfg)
+    return data
+
+
+def shard_apply(family: FamilySpec, cfg: TransformerConfig,
+                shard_config: ShardConfig, params: Dict,
+                data: ShardData) -> ShardData:
+    """Apply one layer-range shard. Pure; jit with cfg/shard_config static."""
+    plan = plan_shard(shard_config)
+    if shard_config.is_first:
+        data = family.embed(params["embeddings"], data, cfg)
+    if plan.head is not None:
+        data = _apply_slice(family, params["head"], data, plan.head, cfg)
+    if plan.full_ids:
+        full = BlockSlice(0, 0, 3)
+
+        def body(carry, block_params):
+            return _apply_slice(family, block_params, carry, full, cfg), None
+
+        data, _ = jax.lax.scan(body, data, params["blocks"])
+    if plan.tail is not None:
+        data = _apply_slice(family, params["tail"], data, plan.tail, cfg)
+    if shard_config.is_last:
+        data = family.finalize(params["final"], data, cfg)
+    return data
+
+
+def make_shard_fn(family: FamilySpec, cfg: TransformerConfig,
+                  shard_config: ShardConfig) -> Callable[[Dict, ShardData], ShardData]:
+    """Return a jit-compiled `fn(params, data)` for this shard signature."""
+    return jax.jit(partial(shard_apply, family, cfg, shard_config))
+
+
+def stack_blocks(block_param_list):
+    """Stack per-block parameter pytrees into one scanned pytree [L, ...]."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *block_param_list)
+
+
+def build_shard_params(shard_config: ShardConfig,
+                       get_embed: Callable[[], Dict],
+                       get_block: Callable[[int, tuple], Dict],
+                       get_final: Callable[[], Dict]) -> Dict:
+    """Assemble a shard's parameter pytree from per-component getters.
+
+    `get_block(block_id, sublayers)` returns only the parameters the listed
+    sublayers need — a shard never materializes weights outside its layer
+    range, mirroring the reference's lazy npz slicing (vit.py:93-118).
+    """
+    plan = plan_shard(shard_config)
+    params: Dict = {}
+    if shard_config.is_first:
+        params["embeddings"] = get_embed()
+    if plan.head is not None:
+        params["head"] = get_block(plan.head.block_id, tuple(plan.head.sublayers()))
+    if plan.full_ids:
+        params["blocks"] = stack_blocks(
+            [get_block(b, (0, 1, 2, 3)) for b in plan.full_ids])
+    if plan.tail is not None:
+        params["tail"] = get_block(plan.tail.block_id, tuple(plan.tail.sublayers()))
+    if shard_config.is_last:
+        params["final"] = get_final()
+    return params

@@ -1,0 +1,237 @@
+"""ViT model family: pure-function shards with the 4-way sublayer split.
+
+Capability parity with /root/reference/src/pipeedge/models/transformers/vit.py.
+Sublayer semantics match `ViTLayerShard.forward` (vit.py:55-70) exactly:
+  sub 0: ln_before -> self-attention         payload becomes (ctx, residual)
+  sub 1: output dense + residual add         payload becomes hidden
+  sub 2: ln_after -> MLP-up + GeLU           payload becomes (mlp_h, residual)
+  sub 3: MLP-down + residual add             payload becomes hidden
+First shard prepends patch+cls+position embeddings; last shard applies the
+final layernorm and (for classification) the head on the CLS token
+(vit.py:115-118, 221-226).
+
+Weight formats: Google's ViT `.npz` checkpoints (the reference's native
+format, key map at vit.py:121-159) and HF `ViTModel`/`ViTForImageClassification`
+state dicts (converted via `hf_to_npz_weights`). Kernels are stored [in, out].
+"""
+from __future__ import annotations
+
+from typing import Dict, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import ShardConfig
+from .layers import TransformerConfig, dense, gelu, layer_norm, patchify, self_attention
+from .shard import FamilySpec, build_shard_params
+
+# Parameters needed per sublayer (mirror of reference vit.py:41-53).
+SUBLAYER_PARAMS = {
+    0: ("ln_before", "q", "k", "v"),
+    1: ("attn_out",),
+    2: ("ln_after", "mlp_up"),
+    3: ("mlp_down",),
+}
+
+
+def embed(p: Dict, pixel_values: jax.Array, cfg: TransformerConfig) -> jax.Array:
+    """Patch embedding (as one matmul) + CLS token + position embeddings.
+
+    `pixel_values` is NCHW [B, C, H, W] for parity with the reference's HF
+    feature-extractor inputs; transposed to NHWC internally for TPU layout.
+    """
+    x = jnp.transpose(pixel_values, (0, 2, 3, 1))
+    patches = patchify(x, cfg.patch_size)
+    hidden = dense(p["patch"], patches.astype(p["patch"]["w"].dtype))
+    cls = jnp.broadcast_to(p["cls"], (hidden.shape[0], 1, cfg.hidden_size))
+    hidden = jnp.concatenate([cls.astype(hidden.dtype), hidden], axis=1)
+    return hidden + p["pos"].astype(hidden.dtype)
+
+
+def sublayer(p: Dict, sub: int, data, cfg: TransformerConfig):
+    """One of the 4 schedulable sublayers (reference vit.py:55-70)."""
+    if sub == 0:
+        normed = layer_norm(p["ln_before"], data, cfg.layer_norm_eps)
+        ctx = self_attention({"q": p["q"], "k": p["k"], "v": p["v"]},
+                             normed, cfg.num_attention_heads)
+        return (ctx, data)
+    if sub == 1:
+        ctx, skip = data
+        return dense(p["attn_out"], ctx) + skip
+    if sub == 2:
+        normed = layer_norm(p["ln_after"], data, cfg.layer_norm_eps)
+        return (gelu(dense(p["mlp_up"], normed)), data)
+    if sub == 3:
+        mlp_h, skip = data
+        return dense(p["mlp_down"], mlp_h) + skip
+    raise ValueError(f"sublayer must be 0..3, got {sub}")
+
+
+def finalize(p: Dict, hidden: jax.Array, cfg: TransformerConfig) -> jax.Array:
+    """Final layernorm; classifier head on CLS token when present."""
+    hidden = layer_norm(p["ln"], hidden, cfg.layer_norm_eps)
+    if "head" in p:
+        return dense(p["head"], hidden[:, 0, :])
+    return hidden
+
+
+FAMILY = FamilySpec(name="vit", embed=embed, sublayer=sublayer, finalize=finalize)
+
+
+# --- weight loading -------------------------------------------------------
+
+def _google_block_getter(weights: Mapping, cfg: TransformerConfig, dtype):
+    """Per-block params from Google ViT npz keys (reference vit.py:137-159)."""
+    d = cfg.hidden_size
+
+    def get_block(block_id: int, subs: tuple) -> Dict:
+        root = f"Transformer/encoderblock_{block_id}/"
+        attn = root + "MultiHeadDotProductAttention_1/"
+        p: Dict = {}
+        if 0 in subs:
+            p["ln_before"] = {"scale": _a(weights[root + "LayerNorm_0/scale"], dtype),
+                              "bias": _a(weights[root + "LayerNorm_0/bias"], dtype)}
+            for name, key in (("q", "query"), ("k", "key"), ("v", "value")):
+                p[name] = {"w": _a(weights[attn + key + "/kernel"], dtype).reshape(d, d),
+                           "b": _a(weights[attn + key + "/bias"], dtype).reshape(-1)}
+        if 1 in subs:
+            p["attn_out"] = {"w": _a(weights[attn + "out/kernel"], dtype).reshape(d, d),
+                             "b": _a(weights[attn + "out/bias"], dtype).reshape(-1)}
+        if 2 in subs:
+            p["ln_after"] = {"scale": _a(weights[root + "LayerNorm_2/scale"], dtype),
+                             "bias": _a(weights[root + "LayerNorm_2/bias"], dtype)}
+            p["mlp_up"] = {"w": _a(weights[root + "MlpBlock_3/Dense_0/kernel"], dtype),
+                           "b": _a(weights[root + "MlpBlock_3/Dense_0/bias"], dtype)}
+        if 3 in subs:
+            p["mlp_down"] = {"w": _a(weights[root + "MlpBlock_3/Dense_1/kernel"], dtype),
+                             "b": _a(weights[root + "MlpBlock_3/Dense_1/bias"], dtype)}
+        return p
+
+    return get_block
+
+
+def _a(x, dtype) -> jnp.ndarray:
+    return jnp.asarray(np.asarray(x), dtype=dtype)
+
+
+def load_params(cfg: TransformerConfig, shard_config: ShardConfig,
+                weights: Mapping, dtype=jnp.float32) -> Dict:
+    """Build shard params from a Google-format npz mapping (vit.py:121-159)."""
+
+    def get_embed() -> Dict:
+        kernel = np.asarray(weights["embedding/kernel"])  # [ph, pw, C, D]
+        return {
+            "cls": _a(weights["cls"], dtype),
+            "pos": _a(weights["Transformer/posembed_input/pos_embedding"], dtype),
+            "patch": {"w": _a(kernel.reshape(-1, kernel.shape[-1]), dtype),
+                      "b": _a(weights["embedding/bias"], dtype)},
+        }
+
+    def get_final() -> Dict:
+        p = {"ln": {"scale": _a(weights["Transformer/encoder_norm/scale"], dtype),
+                    "bias": _a(weights["Transformer/encoder_norm/bias"], dtype)}}
+        if cfg.num_labels > 0 and "head/kernel" in weights:
+            p["head"] = {"w": _a(weights["head/kernel"], dtype),
+                         "b": _a(weights["head/bias"], dtype)}
+        return p
+
+    return build_shard_params(shard_config, get_embed,
+                              _google_block_getter(weights, cfg, dtype), get_final)
+
+
+def hf_to_npz_weights(state_dict: Mapping, cfg: TransformerConfig) -> Dict[str, np.ndarray]:
+    """Convert an HF ViT state dict to the Google-npz key scheme.
+
+    Replaces the reference's `save_weights` download from
+    storage.googleapis.com (vit.py:172-186) with a local conversion so
+    checkpoints can come from any HF `ViTForImageClassification`/`ViTModel`.
+    """
+    sd = {k.removeprefix("vit."): np.asarray(v) for k, v in state_dict.items()}
+    d = cfg.hidden_size
+    nh = cfg.num_attention_heads
+    out = {
+        "cls": sd["embeddings.cls_token"],
+        "Transformer/posembed_input/pos_embedding": sd["embeddings.position_embeddings"],
+        # torch conv kernel [D, C, ph, pw] -> [ph, pw, C, D]
+        "embedding/kernel": sd["embeddings.patch_embeddings.projection.weight"].transpose(2, 3, 1, 0),
+        "embedding/bias": sd["embeddings.patch_embeddings.projection.bias"],
+        "Transformer/encoder_norm/scale": sd["layernorm.weight"],
+        "Transformer/encoder_norm/bias": sd["layernorm.bias"],
+    }
+    if "classifier.weight" in sd:
+        out["head/kernel"] = sd["classifier.weight"].T
+        out["head/bias"] = sd["classifier.bias"]
+    for i in range(cfg.num_hidden_layers):
+        hf_root = f"encoder.layer.{i}."
+        # HF renamed attention.attention -> attention.self in some versions
+        attn_prefix = None
+        for cand in ("attention.attention.", "attention.self."):
+            if hf_root + cand + "query.weight" in sd:
+                attn_prefix = hf_root + cand
+                break
+        root = f"Transformer/encoderblock_{i}/"
+        mha = root + "MultiHeadDotProductAttention_1/"
+        out[root + "LayerNorm_0/scale"] = sd[hf_root + "layernorm_before.weight"]
+        out[root + "LayerNorm_0/bias"] = sd[hf_root + "layernorm_before.bias"]
+        for name in ("query", "key", "value"):
+            # torch [out, in] -> flax [in, heads, head_dim]
+            out[mha + name + "/kernel"] = sd[attn_prefix + name + ".weight"].T.reshape(d, nh, d // nh)
+            out[mha + name + "/bias"] = sd[attn_prefix + name + ".bias"].reshape(nh, d // nh)
+        out[mha + "out/kernel"] = sd[hf_root + "attention.output.dense.weight"].T.reshape(nh, d // nh, d)
+        out[mha + "out/bias"] = sd[hf_root + "attention.output.dense.bias"]
+        out[root + "LayerNorm_2/scale"] = sd[hf_root + "layernorm_after.weight"]
+        out[root + "LayerNorm_2/bias"] = sd[hf_root + "layernorm_after.bias"]
+        out[root + "MlpBlock_3/Dense_0/kernel"] = sd[hf_root + "intermediate.dense.weight"].T
+        out[root + "MlpBlock_3/Dense_0/bias"] = sd[hf_root + "intermediate.dense.bias"]
+        out[root + "MlpBlock_3/Dense_1/kernel"] = sd[hf_root + "output.dense.weight"].T
+        out[root + "MlpBlock_3/Dense_1/bias"] = sd[hf_root + "output.dense.bias"]
+    return out
+
+
+# --- random init (benchmarks / tests without checkpoints) -----------------
+
+def init_params(cfg: TransformerConfig, shard_config: ShardConfig,
+                seed: int = 0, dtype=jnp.float32) -> Dict:
+    """Random shard params with the same pytree structure as `load_params`."""
+    rng = np.random.default_rng(seed)
+
+    def mat(*shape):
+        scale = 0.02
+        return jnp.asarray(rng.normal(0, scale, size=shape), dtype=dtype)
+
+    def vec(n):
+        return jnp.zeros((n,), dtype=dtype)
+
+    def ln():
+        return {"scale": jnp.ones((cfg.hidden_size,), dtype), "bias": vec(cfg.hidden_size)}
+
+    d, it = cfg.hidden_size, cfg.intermediate_size
+
+    def get_block(block_id: int, subs: tuple) -> Dict:
+        p: Dict = {}
+        if 0 in subs:
+            p["ln_before"] = ln()
+            for name in ("q", "k", "v"):
+                p[name] = {"w": mat(d, d), "b": vec(d)}
+        if 1 in subs:
+            p["attn_out"] = {"w": mat(d, d), "b": vec(d)}
+        if 2 in subs:
+            p["ln_after"] = ln()
+            p["mlp_up"] = {"w": mat(d, it), "b": vec(it)}
+        if 3 in subs:
+            p["mlp_down"] = {"w": mat(it, d), "b": vec(d)}
+        return p
+
+    def get_embed() -> Dict:
+        n_patch_in = cfg.patch_size * cfg.patch_size * cfg.num_channels
+        return {"cls": mat(1, 1, d), "pos": mat(1, cfg.num_patches + 1, d),
+                "patch": {"w": mat(n_patch_in, d), "b": vec(d)}}
+
+    def get_final() -> Dict:
+        p = {"ln": ln()}
+        if cfg.num_labels > 0:
+            p["head"] = {"w": mat(d, cfg.num_labels), "b": vec(cfg.num_labels)}
+        return p
+
+    return build_shard_params(shard_config, get_embed, get_block, get_final)

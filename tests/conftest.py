@@ -12,3 +12,9 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402  (must come after the env setup above)
+
+# XLA CPU's default matmul precision is reduced (bf16-like passes); golden
+# parity tests against torch float32 need full fp32 accumulation.
+jax.config.update("jax_default_matmul_precision", "highest")
