@@ -1,0 +1,1 @@
+"""Pipeline-parallel execution: host-driven and SPMD (shard_map + ppermute) drivers."""

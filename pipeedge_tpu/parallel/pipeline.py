@@ -1,0 +1,217 @@
+"""Host-driven pipeline driver: per-stage jitted programs, device_put edges.
+
+This is the TPU equivalent of the reference's P2P pipeline
+(/root/reference/src/pipeedge/comm/p2p/__init__.py:334-450): one "stage" per
+device, microbatches streamed through the stages, results collected in FIFO
+order. The reference needs four threads per rank (recv/work/send/command) and
+a hand-rolled wire protocol because stages are separate Python processes
+exchanging dynamically-shaped CPU tensors over TCP; under a single-controller
+JAX program none of that machinery exists:
+
+- A stage is a jit-compiled pure function resident on one device; its
+  input/output signatures (shape/dtype/arity) are static per (model,
+  partition, microbatch-size), so there is no framing protocol — the
+  "wire format" is the compiled program signature (SURVEY.md §5.8).
+- Dispatch is asynchronous: the host enqueues stage s for microbatch i and
+  the transfer to stage s+1 without blocking, so while stage s computes
+  microbatch i, stage s-1 computes microbatch i+1 — the same fill/drain
+  overlap the reference builds with threads and maxsize-1 queues
+  (p2p:88-93), but scheduled by the XLA runtime instead of Python locks.
+- Backpressure (the reference's ConditionQueue semantics) is a bounded
+  in-flight window: the host blocks on the oldest outstanding result once
+  `max_inflight` microbatches are unfinished.
+
+Quantized edges: each stage optionally decodes its input and encodes its
+output (QuantPipe, reference runtime.py:73-119) *inside* the stage's jit, so
+the pack/unpack fuses with the stage's first/last matmuls, and only the packed
+uint32 payload crosses devices. Per-bitwidth compiled variants are cached —
+bitwidth is compile-static (SURVEY.md §7 "hard parts").
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from ..ops import clamp as clamp_ops
+from ..ops import quant as quant_ops
+
+logger = logging.getLogger(__name__)
+
+# Payload tuples use this transform on quantized edges. The reference clamps
+# post-GeLU tensors with the gelu variant when the edge carries an MLP-up
+# output (runtime.py:73-90); the hidden-state tensor uses the laplace variant.
+
+
+def _encode_payload(payload, bit: int, clamp: bool):
+    """Quantize every tensor in a stage-output payload (1- or 2-tuple)."""
+    if bit == 0:
+        return payload
+    single = not isinstance(payload, tuple)
+    tensors = (payload,) if single else payload
+    out = []
+    for t in tensors:
+        if clamp:
+            t = clamp_ops.clamp_banner2019_laplace(t, bit)
+        out.append(quant_ops.tensor_encode_outerdim(t, bit))
+    return out[0] if single else tuple(out)
+
+
+def _decode_payload(payload):
+    """Dequantize a payload produced by `_encode_payload` (no-op otherwise)."""
+    if isinstance(payload, quant_ops.QuantizedTensor):
+        return quant_ops.tensor_decode_outerdim(payload)
+    if isinstance(payload, tuple) and any(
+            isinstance(t, quant_ops.QuantizedTensor) for t in payload):
+        return tuple(quant_ops.tensor_decode_outerdim(t) for t in payload)
+    return payload
+
+
+@dataclasses.dataclass
+class PipelineStage:
+    """One pipeline stage: a shard function bound to a device.
+
+    `quant_bit` applies to this stage's *output* edge (the reference registers
+    the encode hook on the producing module, runtime.py:464-482). It may be
+    changed between microbatches; each bitwidth compiles once and is cached.
+    """
+    shard_fn: Callable[[Dict, Any], Any]
+    params: Dict
+    device: jax.Device
+    quant_bit: int = 0
+    clamp: bool = True
+    name: str = ""
+
+    def __post_init__(self):
+        self.params = jax.device_put(self.params, self.device)
+        self._compiled: Dict[int, Callable] = {}
+
+    def _fn_for_bit(self, bit: int) -> Callable:
+        fn = self._compiled.get(bit)
+        if fn is None:
+            shard_fn, do_clamp = self.shard_fn, self.clamp
+
+            def step(params, payload):
+                data = _decode_payload(payload)
+                out = shard_fn(params, data)
+                return _encode_payload(out, bit, do_clamp)
+
+            fn = jax.jit(step)
+            self._compiled[bit] = fn
+        return fn
+
+    def __call__(self, payload):
+        payload = jax.device_put(payload, self.device)
+        return self._fn_for_bit(self.quant_bit)(self.params, payload)
+
+
+class HostPipeline:
+    """Drive microbatches through a chain of `PipelineStage`s.
+
+    FIFO ordering is guaranteed (single dispatch thread + in-order device
+    queues), which the reference could only promise for its P2P transport
+    (rpc:44, runtime.py:250-254).
+    """
+
+    def __init__(self, stages: Sequence[PipelineStage], max_inflight: int = 0,
+                 ubatch_callback: Optional[Callable[[int, Any], None]] = None):
+        if not stages:
+            raise ValueError("pipeline needs at least one stage")
+        self.stages = list(stages)
+        # Default window: 2 microbatches per stage (double buffering), the
+        # analog of the reference's buffers_in=2/buffers_out=2 (sched model).
+        self.max_inflight = max_inflight or 2 * len(self.stages)
+        self.ubatch_callback = ubatch_callback
+
+    def enqueue(self, ubatch):
+        """Dispatch one microbatch through all stages; returns the (device-
+        resident, not yet materialized) final payload."""
+        data = ubatch
+        for stage in self.stages:
+            data = stage(data)
+        return _undequantized_guard(data)
+
+    def run(self, ubatches: Sequence[Any]) -> Tuple[List[Any], Dict[str, float]]:
+        """Stream all microbatches; returns (results, stats).
+
+        Stats mirror the reference's end-of-run measurement: latency =
+        t(last result) - t(first enqueue); throughput = total items / latency
+        (reference runtime.py:493-505).
+        """
+        results: List[Any] = []
+        inflight: List[Any] = []
+        tik = time.monotonic()
+        for i, ubatch in enumerate(ubatches):
+            out = self.enqueue(ubatch)
+            inflight.append((i, out))
+            while len(inflight) >= self.max_inflight:
+                self._retire(inflight.pop(0), results)
+        while inflight:
+            self._retire(inflight.pop(0), results)
+        tok = time.monotonic()
+        items = sum(_leading_dim(u) for u in ubatches)
+        latency = tok - tik
+        stats = {"latency_sec": latency,
+                 "throughput_items_sec": items / latency if latency > 0 else 0.0,
+                 "microbatches": len(list(ubatches))}
+        return results, stats
+
+    def _retire(self, item, results):
+        i, out = item
+        out = jax.block_until_ready(out)
+        if self.ubatch_callback is not None:
+            self.ubatch_callback(i, out)
+        results.append(out)
+
+
+def _leading_dim(ubatch) -> int:
+    t = ubatch[0] if isinstance(ubatch, tuple) else ubatch
+    return int(t.shape[0])
+
+
+def _undequantized_guard(data):
+    """Final stage output must not leave the pipeline quantized."""
+    if isinstance(data, quant_ops.QuantizedTensor) or (
+            isinstance(data, tuple) and any(
+                isinstance(t, quant_ops.QuantizedTensor) for t in data)):
+        return _decode_payload(data)
+    return data
+
+
+def build_pipeline(model_name: str, partition: Sequence[Tuple[int, int]],
+                   model_file: Optional[str] = None,
+                   devices: Optional[Sequence[jax.Device]] = None,
+                   quant_bits: Optional[Sequence[int]] = None,
+                   dtype=None, max_inflight: int = 0) -> HostPipeline:
+    """Build a host-driven pipeline from a model partition.
+
+    `partition` is the reference's stage-layers list [[l0, r0], [l1, r1], ...]
+    (runtime.py:291-355); `quant_bits[i]` quantizes the edge leaving stage i
+    (reference `-q`, runtime.py:652-656). Stages are placed round-robin on
+    `devices` (default: all local devices).
+    """
+    import jax.numpy as jnp
+
+    from ..models import registry
+
+    if devices is None:
+        devices = jax.local_devices()
+    if dtype is None:
+        dtype = jnp.float32
+    if quant_bits is None:
+        quant_bits = [0] * len(partition)
+    stages = []
+    for i, (layer_start, layer_end) in enumerate(partition):
+        fn, params, _ = registry.module_shard_factory(
+            model_name, model_file, layer_start, layer_end, stage=i, dtype=dtype)
+        dev = devices[i % len(devices)]
+        bit = quant_bits[i] if i < len(quant_bits) else 0
+        # final stage's output edge is the result path: never quantized
+        if i == len(partition) - 1:
+            bit = 0
+        stages.append(PipelineStage(shard_fn=fn, params=params, device=dev,
+                                    quant_bit=bit, name=f"stage{i}"))
+    return HostPipeline(stages, max_inflight=max_inflight)

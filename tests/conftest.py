@@ -15,6 +15,10 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402  (must come after the env setup above)
 
+# The axon TPU plugin registers itself via sitecustomize and overrides
+# JAX_PLATFORMS; force the CPU backend explicitly so the 8 fake devices apply.
+jax.config.update("jax_platforms", "cpu")
+
 # XLA CPU's default matmul precision is reduced (bf16-like passes); golden
 # parity tests against torch float32 need full fp32 accumulation.
 jax.config.update("jax_default_matmul_precision", "highest")
