@@ -1,0 +1,262 @@
+"""SPMD pipeline: the whole stage graph as ONE jitted program over a mesh.
+
+The performance path (SURVEY.md §5.8, §7 step 3b). Where the host-driven
+driver dispatches per-stage programs with device_put edges, this compiles the
+*entire* pipeline — all stages, all microbatches — into a single XLA program
+under `shard_map` over a `jax.sharding.Mesh`:
+
+- mesh axes ('dp', 'stage'): 'stage' is the pipeline axis (the reference's
+  rank, comm/p2p), 'dp' optionally shards the microbatch dimension (data
+  parallelism within a stage — absent in the reference, SURVEY.md §2.4).
+- Each device holds only its own stage's transformer blocks (parameters are
+  stage-sharded; stages with fewer blocks are zero-padded and masked).
+- One `lax.scan` over T = n_microbatches + n_stages - 1 "ticks" runs the
+  fill/steady/drain schedule; the inter-stage edge is `lax.ppermute` over ICI
+  — the collective-permute equivalent of the reference's gloo send/recv
+  threads (p2p:155-258), with zero host involvement in steady state.
+- Quantized edges: the payload is encoded to packed uint32 before the
+  ppermute and decoded after, so only 32/bit of the activation bytes cross
+  the interconnect (QuantPipe on the wire, reference runtime.py:73-119).
+
+Constraints vs the host-driven path: partitions must be block-aligned (each
+stage = whole transformer blocks). Mid-block (sublayer) cuts stream a 2-tuple
+payload with shapes that differ per cut point, which would break the single
+SPMD program; the host-driven driver handles those (SURVEY.md §7 hard parts).
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models import ShardConfig, block_slices
+from ..models.layers import TransformerConfig
+from ..models.shard import FamilySpec, stack_blocks
+from ..ops import quant as quant_ops
+
+BlockRange = Tuple[int, int]
+
+
+def partition_to_blocks(partition: Sequence[Tuple[int, int]]) -> List[BlockRange]:
+    """Convert a sublayer partition to 0-based block ranges; reject mid-block cuts."""
+    out = []
+    for layer_start, layer_end in partition:
+        slices = block_slices(layer_start, layer_end)
+        if not all(s.is_full for s in slices):
+            raise ValueError(
+                f"SPMD pipeline requires block-aligned partitions; "
+                f"[{layer_start}, {layer_end}] cuts mid-block (use the "
+                f"host-driven pipeline for sublayer cuts)")
+        out.append((slices[0].block_id, slices[-1].block_id))
+    return out
+
+
+def _pad_stack(stage_blocks: List[Any], max_b: int):
+    """Stack per-stage block pytrees [n_i, ...] into [n_stages, max_b, ...]."""
+    def pad(leaf):
+        pad_width = [(0, max_b - leaf.shape[0])] + [(0, 0)] * (leaf.ndim - 1)
+        return jnp.pad(leaf, pad_width)
+
+    padded = [jax.tree_util.tree_map(pad, b) for b in stage_blocks]
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *padded)
+
+
+@dataclasses.dataclass
+class SpmdPipeline:
+    """Compiled SPMD pipeline over a ('dp', 'stage') mesh.
+
+    Build with `build_spmd_pipeline`. Call `run(inputs)` with a stacked
+    microbatch array [M, B, ...raw input dims...]; returns [M, B, ...out...].
+    """
+    family: FamilySpec
+    cfg: TransformerConfig
+    mesh: Mesh
+    n_stages: int
+    max_blocks: int
+    params: Dict            # {'embed', 'final', 'blocks', 'n_blocks'}
+    quant_bit: int = 0
+    _compiled: Dict[Tuple, Any] = dataclasses.field(default_factory=dict)
+
+    def run(self, inputs: jax.Array) -> jax.Array:
+        key = (inputs.shape, str(inputs.dtype), self.quant_bit)
+        fn = self._compiled.get(key)
+        if fn is None:
+            fn = self._build(inputs)
+            self._compiled[key] = fn
+        dp_spec = "dp" if self.mesh.shape.get("dp", 1) > 1 else None
+        inputs = jax.device_put(inputs, NamedSharding(self.mesh, P(None, dp_spec)))
+        return fn(self.params, inputs)
+
+    # -- program construction ------------------------------------------
+
+    def _build(self, inputs: jax.Array):
+        family, cfg = self.family, self.cfg
+        n_stages, max_b = self.n_stages, self.max_blocks
+        quant_bit = self.quant_bit
+        mesh = self.mesh
+        n_ubatch = inputs.shape[0]
+        n_ticks = n_ubatch + n_stages - 1
+        dp = mesh.shape.get("dp", 1)
+
+        # trace shapes: embedded hidden + final output
+        embed_shape = jax.eval_shape(
+            partial(family.embed, cfg=cfg), self.params["embed"], inputs[0])
+        b_local = embed_shape.shape[0] // dp
+        hidden_local = jax.ShapeDtypeStruct(
+            (b_local,) + embed_shape.shape[1:], embed_shape.dtype)
+        out_shape = jax.eval_shape(
+            partial(family.finalize, cfg=cfg), self.params["final"],
+            jnp.zeros(hidden_local.shape, hidden_local.dtype))
+
+        def block_apply(bp, x):
+            for sub in range(4):
+                x = family.sublayer(bp, sub, x, cfg)
+            return x
+
+        def run_blocks(blocks, n_valid, x):
+            def step(carry, xs):
+                bp, j = xs
+                out = jax.lax.cond(j < n_valid, lambda c: block_apply(bp, c),
+                                   lambda c: c, carry)
+                return out, None
+
+            x, _ = jax.lax.scan(step, x, (blocks, jnp.arange(max_b)))
+            return x
+
+        fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+        def encode(h):
+            if quant_bit == 0:
+                return h
+            return quant_ops.tensor_encode_outerdim(h, quant_bit)
+
+        def decode(e):
+            if quant_bit == 0:
+                return e
+            return quant_ops.tensor_decode_outerdim(e)
+
+        def permute_payload(payload):
+            if n_stages == 1:
+                return payload
+            return jax.tree_util.tree_map(
+                lambda t: jax.lax.ppermute(t, "stage", fwd_perm), payload)
+
+        def spmd_body(params, stacked_inputs):
+            # local views: blocks [1, max_b, ...] (stage-sharded), inputs
+            # [M, B/dp, ...] (dp-sharded), embed/final replicated
+            blocks = jax.tree_util.tree_map(lambda x: x[0], params["blocks"])
+            n_valid = params["n_blocks"][0]
+            stage = jax.lax.axis_index("stage")
+            is_first = stage == 0
+            is_last = stage == n_stages - 1
+
+            # Embeddings for all microbatches, computed once per device.
+            # Patch/word embedding is <2% of total FLOPs; doing it everywhere
+            # avoids a second program region gated on stage index.
+            embedded = jax.vmap(
+                lambda u: family.embed(params["embed"], u, cfg))(stacked_inputs)
+
+            zero_h = jnp.zeros(hidden_local.shape, hidden_local.dtype)
+            outputs0 = jnp.zeros((n_ubatch,) + out_shape.shape, out_shape.dtype)
+
+            def tick(carry, t):
+                prev_enc, outputs = carry
+                recv = decode(permute_payload(prev_enc))
+                in_idx = jnp.clip(t, 0, n_ubatch - 1)
+                x = jnp.where(is_first, embedded[in_idx], recv)
+                h = run_blocks(blocks, n_valid, x)
+                logits = family.finalize(params["final"], h, cfg)
+                out_idx = t - (n_stages - 1)
+                updated = jax.lax.dynamic_update_slice(
+                    outputs, logits[None].astype(outputs.dtype),
+                    (jnp.clip(out_idx, 0, n_ubatch - 1),)
+                    + (0,) * len(out_shape.shape))
+                valid = jnp.logical_and(out_idx >= 0, is_last)
+                outputs = jnp.where(valid, updated, outputs)
+                return (encode(h), outputs), None
+
+            (_, outputs), _ = jax.lax.scan(
+                tick, (encode(zero_h), outputs0), jnp.arange(n_ticks))
+            # only the last stage wrote real outputs; fan them back out
+            return jax.lax.psum(outputs, "stage")
+
+        dp_spec = "dp" if dp > 1 else None
+        in_specs = (
+            {
+                "embed": P(),
+                "final": P(),
+                "blocks": jax.tree_util.tree_map(
+                    lambda _: P("stage"), self.params["blocks"]),
+                "n_blocks": P("stage"),
+            },
+            P(None, dp_spec),
+        )
+        out_spec = P(None, dp_spec)
+        fn = jax.jit(jax.shard_map(spmd_body, mesh=mesh, in_specs=in_specs,
+                                   out_specs=out_spec, check_vma=False))
+        return fn
+
+
+def build_spmd_pipeline(family: FamilySpec, cfg: TransformerConfig,
+                        partition: Sequence[Tuple[int, int]],
+                        stage_params: Sequence[Dict], mesh: Mesh,
+                        quant_bit: int = 0) -> SpmdPipeline:
+    """Assemble an `SpmdPipeline` from per-stage shard parameter pytrees.
+
+    `stage_params[i]` is the pytree built by a family loader for stage i's
+    `ShardConfig` (block-aligned). Stage 0 must carry 'embeddings', the last
+    stage 'final'; per-stage 'blocks' stacks are zero-padded to the deepest
+    stage and masked at run time.
+    """
+    n_stages = len(partition)
+    if mesh.shape["stage"] != n_stages:
+        raise ValueError(f"mesh 'stage' axis {mesh.shape['stage']} != "
+                         f"{n_stages} pipeline stages")
+    partition_to_blocks(partition)  # validates block alignment
+
+    blocks_list = []
+    n_blocks = []
+    for i, p in enumerate(stage_params):
+        if "blocks" not in p:
+            raise ValueError(f"stage {i} has no full blocks; SPMD pipeline "
+                             f"requires block-aligned partitions")
+        blocks_list.append(p["blocks"])
+        n_blocks.append(jax.tree_util.tree_leaves(p["blocks"])[0].shape[0])
+    max_b = max(n_blocks)
+
+    params = {
+        "embed": stage_params[0]["embeddings"],
+        "final": stage_params[-1]["final"],
+        "blocks": _pad_stack(blocks_list, max_b),
+        "n_blocks": jnp.asarray(n_blocks, jnp.int32),
+    }
+    # place parameters: blocks stage-sharded, embed/final replicated
+    params = {
+        "embed": jax.device_put(params["embed"],
+                                NamedSharding(mesh, P())),
+        "final": jax.device_put(params["final"], NamedSharding(mesh, P())),
+        "blocks": jax.device_put(params["blocks"],
+                                 NamedSharding(mesh, P("stage"))),
+        "n_blocks": jax.device_put(params["n_blocks"],
+                                   NamedSharding(mesh, P("stage"))),
+    }
+    return SpmdPipeline(family=family, cfg=cfg, mesh=mesh, n_stages=n_stages,
+                        max_blocks=max_b, params=params)
+
+
+def make_pipeline_mesh(n_stages: int, dp: int = 1,
+                       devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a ('dp', 'stage') mesh: stage axis contiguous so ppermute edges
+    ride neighboring ICI links."""
+    if devices is None:
+        devices = jax.devices()
+    need = n_stages * dp
+    if len(devices) < need:
+        raise ValueError(f"need {need} devices, have {len(devices)}")
+    arr = np.asarray(devices[:need]).reshape(dp, n_stages)
+    return Mesh(arr, ("dp", "stage"))
