@@ -1,0 +1,67 @@
+"""Headline benchmark: ViT-Large images/sec on the available TPU chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: the reference's best in-repo single-device ViT-Large number —
+0.22 img/s on RCC-VE-C2000 at batch=8 (BASELINE.md, README_Scheduler.md:213-239).
+
+Method: microbatches are streamed through the model inside ONE jitted
+`lax.scan` program (the single-stage degenerate of the SPMD pipeline), inputs
+device-resident, and a scalar reduction of the logits is read back to fence
+execution — `block_until_ready` alone does not fence on the tunneled axon
+platform.
+"""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BASELINE_IMG_PER_SEC = 0.22  # ViT-Large b=8 on RCC-VE-C2000 (BASELINE.md)
+
+
+def main():
+    from pipeedge_tpu.models import registry
+    from pipeedge_tpu.models.shard import make_shard_fn
+
+    name = "google/vit-large-patch16-224"
+    entry = registry.get_model_entry(name)
+    cfg = entry.config
+    shard_cfg = registry.make_shard_config(name, 1, registry.get_model_layers(name))
+    params = entry.family.init_params(cfg, shard_cfg, dtype=jnp.bfloat16)
+    fn = make_shard_fn(entry.family.FAMILY, cfg, shard_cfg)
+
+    batch = 8   # reference profiles use batch=8 (README_Scheduler.md:148-151)
+    n_ubatch = 32
+    rng = np.random.default_rng(0)
+    xs = jax.device_put(jnp.asarray(
+        rng.normal(size=(n_ubatch, batch, 3, 224, 224)), dtype=jnp.bfloat16))
+    params = jax.device_put(params)
+
+    @jax.jit
+    def run_all(p, xs):
+        def step(carry, x):
+            logits = fn(p, x)
+            return carry + jnp.sum(logits.astype(jnp.float32)), None
+
+        total, _ = jax.lax.scan(step, jnp.float32(0), xs)
+        return total
+
+    float(run_all(params, xs))  # compile + warmup (readback fences)
+    best = float("inf")
+    for _ in range(3):
+        tik = time.monotonic()
+        float(run_all(params, xs))
+        best = min(best, time.monotonic() - tik)
+    img_per_sec = n_ubatch * batch / best
+
+    print(json.dumps({
+        "metric": "vit_large_images_per_sec_b8",
+        "value": round(img_per_sec, 3),
+        "unit": "images/sec",
+        "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()
