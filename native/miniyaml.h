@@ -1,0 +1,339 @@
+// miniyaml: a small, dependency-free YAML-subset reader/emitter.
+//
+// The image has no yaml-cpp, so the scheduler parses its three input files
+// (models.yml / device_types.yml / devices.yml — formats documented in the
+// reference's README_Scheduler.md:44-264 and emitted by PyYAML safe_dump)
+// with this purpose-built parser. Supported subset, which covers everything
+// PyYAML's default_flow_style=None emitter produces for those schemas:
+//   - block mappings (nested by indentation), plain/quoted scalar keys
+//   - block sequences ("- item", including "- key: value" map items)
+//   - flow sequences "[a, b, c]", INCLUDING multi-line wrapped ones
+//   - plain, single- and double-quoted scalars; comments; empty values (null)
+// Not supported (not needed): anchors/aliases, tags, flow mappings,
+// multi-line literal scalars.
+#pragma once
+
+#include <cctype>
+#include <cstdlib>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace miniyaml {
+
+class Node;
+using NodePtr = std::shared_ptr<Node>;
+
+class Node {
+ public:
+  enum class Kind { Null, Scalar, Seq, Map };
+
+  Kind kind = Kind::Null;
+  std::string scalar;
+  std::vector<NodePtr> seq;
+  std::vector<std::pair<std::string, NodePtr>> map;  // preserves file order
+
+  bool is_null() const { return kind == Kind::Null; }
+  bool is_scalar() const { return kind == Kind::Scalar; }
+  bool is_seq() const { return kind == Kind::Seq; }
+  bool is_map() const { return kind == Kind::Map; }
+
+  const NodePtr find(const std::string &key) const {
+    for (const auto &kv : map)
+      if (kv.first == key) return kv.second;
+    return nullptr;
+  }
+  bool has(const std::string &key) const { return find(key) != nullptr; }
+  const Node &at(const std::string &key) const {
+    auto n = find(key);
+    if (!n) throw std::runtime_error("miniyaml: missing key: " + key);
+    return *n;
+  }
+
+  long long as_int() const {
+    if (!is_scalar()) throw std::runtime_error("miniyaml: not a scalar int");
+    return std::strtoll(scalar.c_str(), nullptr, 10);
+  }
+  double as_double() const {
+    if (!is_scalar()) throw std::runtime_error("miniyaml: not a scalar number");
+    return std::strtod(scalar.c_str(), nullptr);
+  }
+  const std::string &as_string() const { return scalar; }
+
+  std::vector<double> as_double_list() const {
+    std::vector<double> out;
+    for (const auto &n : seq) out.push_back(n->as_double());
+    return out;
+  }
+  std::vector<long long> as_int_list() const {
+    std::vector<long long> out;
+    for (const auto &n : seq) out.push_back(n->as_int());
+    return out;
+  }
+  std::vector<std::string> as_string_list() const {
+    std::vector<std::string> out;
+    for (const auto &n : seq) out.push_back(n->scalar);
+    return out;
+  }
+};
+
+namespace detail {
+
+struct Line {
+  int indent;
+  std::string text;  // content with indent stripped, comments removed
+};
+
+inline std::string strip(const std::string &s) {
+  size_t a = s.find_first_not_of(" \t\r\n");
+  if (a == std::string::npos) return "";
+  size_t b = s.find_last_not_of(" \t\r\n");
+  return s.substr(a, b - a + 1);
+}
+
+inline std::string unquote(const std::string &s) {
+  if (s.size() >= 2 && ((s.front() == '"' && s.back() == '"') ||
+                        (s.front() == '\'' && s.back() == '\'')))
+    return s.substr(1, s.size() - 2);
+  return s;
+}
+
+// remove a trailing comment that is not inside quotes/brackets
+inline std::string drop_comment(const std::string &s) {
+  int depth = 0;
+  char quote = 0;
+  for (size_t i = 0; i < s.size(); ++i) {
+    char c = s[i];
+    if (quote) {
+      if (c == quote) quote = 0;
+    } else if (c == '"' || c == '\'') {
+      quote = c;
+    } else if (c == '[') {
+      ++depth;
+    } else if (c == ']') {
+      --depth;
+    } else if (c == '#' && depth == 0 && (i == 0 || s[i - 1] == ' ')) {
+      return s.substr(0, i);
+    }
+  }
+  return s;
+}
+
+inline int bracket_balance(const std::string &s) {
+  int depth = 0;
+  char quote = 0;
+  for (char c : s) {
+    if (quote) {
+      if (c == quote) quote = 0;
+    } else if (c == '"' || c == '\'') {
+      quote = c;
+    } else if (c == '[') {
+      ++depth;
+    } else if (c == ']') {
+      --depth;
+    }
+  }
+  return depth;
+}
+
+// Split the physical document into logical lines, merging wrapped flow
+// sequences (PyYAML wraps long [a, b, ...] lists across lines).
+inline std::vector<Line> logical_lines(const std::string &doc) {
+  std::vector<Line> lines;
+  size_t pos = 0;
+  std::string pending;
+  int pending_indent = 0;
+  int balance = 0;
+  while (pos <= doc.size()) {
+    size_t eol = doc.find('\n', pos);
+    std::string raw = doc.substr(pos, eol == std::string::npos ? std::string::npos
+                                                               : eol - pos);
+    pos = eol == std::string::npos ? doc.size() + 1 : eol + 1;
+    std::string content = drop_comment(raw);
+    if (balance > 0) {
+      pending += " " + strip(content);
+      balance += bracket_balance(content);
+      if (balance <= 0) {
+        lines.push_back({pending_indent, strip(pending)});
+        pending.clear();
+        balance = 0;
+      }
+      continue;
+    }
+    std::string stripped = strip(content);
+    if (stripped.empty() || stripped == "---") continue;
+    int indent = 0;
+    while (indent < (int)content.size() && content[indent] == ' ') ++indent;
+    int bal = bracket_balance(content);
+    if (bal > 0) {
+      pending = stripped;
+      pending_indent = indent;
+      balance = bal;
+    } else {
+      lines.push_back({indent, stripped});
+    }
+  }
+  return lines;
+}
+
+inline NodePtr make_scalar(const std::string &s) {
+  auto n = std::make_shared<Node>();
+  std::string v = strip(s);
+  if (v.empty() || v == "~" || v == "null") {
+    n->kind = Node::Kind::Null;
+  } else {
+    n->kind = Node::Kind::Scalar;
+    n->scalar = unquote(v);
+  }
+  return n;
+}
+
+inline NodePtr parse_flow_seq(const std::string &s) {
+  auto n = std::make_shared<Node>();
+  n->kind = Node::Kind::Seq;
+  std::string body = strip(s);
+  body = body.substr(1, body.size() - 2);  // strip [ ]
+  std::string cur;
+  int depth = 0;
+  char quote = 0;
+  for (char c : body) {
+    if (quote) {
+      cur += c;
+      if (c == quote) quote = 0;
+    } else if (c == '"' || c == '\'') {
+      quote = c;
+      cur += c;
+    } else if (c == '[') {
+      ++depth;
+      cur += c;
+    } else if (c == ']') {
+      --depth;
+      cur += c;
+    } else if (c == ',' && depth == 0) {
+      if (!strip(cur).empty()) n->seq.push_back(make_scalar(cur));
+      cur.clear();
+    } else {
+      cur += c;
+    }
+  }
+  if (!strip(cur).empty()) n->seq.push_back(make_scalar(cur));
+  return n;
+}
+
+inline NodePtr parse_value_inline(const std::string &v) {
+  std::string s = strip(v);
+  if (!s.empty() && s.front() == '[') return parse_flow_seq(s);
+  return make_scalar(s);
+}
+
+// find "key:" split point outside quotes/brackets
+inline size_t key_split(const std::string &s) {
+  char quote = 0;
+  for (size_t i = 0; i < s.size(); ++i) {
+    char c = s[i];
+    if (quote) {
+      if (c == quote) quote = 0;
+    } else if (c == '"' || c == '\'') {
+      quote = c;
+    } else if (c == ':' && (i + 1 == s.size() || s[i + 1] == ' ')) {
+      return i;
+    }
+  }
+  return std::string::npos;
+}
+
+NodePtr parse_block(const std::vector<Line> &lines, size_t &idx, int indent);
+
+// parse one "- ..." sequence item body (may be scalar, inline map entry, or
+// nested block)
+inline NodePtr parse_seq_item(const std::vector<Line> &lines, size_t &idx,
+                              int item_indent, const std::string &rest) {
+  std::string body = strip(rest);
+  if (body.empty()) {  // nested block on following lines
+    return parse_block(lines, idx, item_indent + 1);
+  }
+  size_t split = key_split(body);
+  if (split == std::string::npos || body.front() == '[') {
+    return parse_value_inline(body);
+  }
+  // "- key: value" starts a map; more keys may follow on deeper lines
+  auto n = std::make_shared<Node>();
+  n->kind = Node::Kind::Map;
+  std::string key = unquote(strip(body.substr(0, split)));
+  std::string val = body.substr(split + 1);
+  if (strip(val).empty()) {
+    n->map.emplace_back(key, parse_block(lines, idx, item_indent + 1));
+  } else {
+    n->map.emplace_back(key, parse_value_inline(val));
+  }
+  while (idx < lines.size() && lines[idx].indent > item_indent) {
+    const Line &ln = lines[idx];
+    size_t ksp = key_split(ln.text);
+    if (ksp == std::string::npos) break;
+    ++idx;
+    std::string k2 = unquote(strip(ln.text.substr(0, ksp)));
+    std::string v2 = ln.text.substr(ksp + 1);
+    if (strip(v2).empty()) {
+      n->map.emplace_back(k2, parse_block(lines, idx, ln.indent + 1));
+    } else {
+      n->map.emplace_back(k2, parse_value_inline(v2));
+    }
+  }
+  return n;
+}
+
+inline NodePtr parse_block(const std::vector<Line> &lines, size_t &idx,
+                           int min_indent) {
+  if (idx >= lines.size() || lines[idx].indent < min_indent) {
+    return std::make_shared<Node>();  // null
+  }
+  int indent = lines[idx].indent;
+  if (lines[idx].text.rfind("- ", 0) == 0 || lines[idx].text == "-") {
+    auto n = std::make_shared<Node>();
+    n->kind = Node::Kind::Seq;
+    while (idx < lines.size() && lines[idx].indent == indent &&
+           (lines[idx].text.rfind("- ", 0) == 0 || lines[idx].text == "-")) {
+      std::string rest = lines[idx].text == "-" ? "" : lines[idx].text.substr(2);
+      ++idx;
+      n->seq.push_back(parse_seq_item(lines, idx, indent, rest));
+    }
+    return n;
+  }
+  auto n = std::make_shared<Node>();
+  n->kind = Node::Kind::Map;
+  while (idx < lines.size() && lines[idx].indent == indent) {
+    const Line &ln = lines[idx];
+    if (ln.text.rfind("- ", 0) == 0 || ln.text == "-") break;
+    size_t split = key_split(ln.text);
+    if (split == std::string::npos)
+      throw std::runtime_error("miniyaml: expected 'key:' at: " + ln.text);
+    std::string key = unquote(strip(ln.text.substr(0, split)));
+    std::string val = ln.text.substr(split + 1);
+    ++idx;
+    if (strip(val).empty()) {
+      // YAML allows a block sequence value to sit at the SAME indent as its
+      // key (PyYAML emits this); otherwise the value block must be deeper.
+      if (idx < lines.size() && lines[idx].indent == indent &&
+          (lines[idx].text.rfind("- ", 0) == 0 || lines[idx].text == "-")) {
+        n->map.emplace_back(key, parse_block(lines, idx, indent));
+      } else {
+        n->map.emplace_back(key, parse_block(lines, idx, indent + 1));
+      }
+    } else {
+      n->map.emplace_back(key, parse_value_inline(val));
+    }
+  }
+  return n;
+}
+
+}  // namespace detail
+
+inline NodePtr parse(const std::string &doc) {
+  auto lines = detail::logical_lines(doc);
+  size_t idx = 0;
+  return detail::parse_block(lines, idx, 0);
+}
+
+}  // namespace miniyaml

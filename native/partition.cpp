@@ -1,0 +1,166 @@
+#include "partition.h"
+
+#include <algorithm>
+#include <limits>
+
+namespace tpusched {
+namespace {
+
+constexpr double kInfeasible = std::numeric_limits<double>::infinity();
+
+// Packed DP index helpers over (layer 0..L, used-multiset S, next-kind u).
+// S encodes per-kind used counts in mixed radix: digit u has radix
+// count[u] + 1 and place value radix_place[u] (reference schedule.cpp:111-132).
+struct Radix {
+  std::vector<std::size_t> place;  // place value per kind
+  std::size_t total = 1;           // product of all radices
+
+  explicit Radix(const std::vector<std::size_t> &counts) {
+    place.reserve(counts.size());
+    for (std::size_t c : counts) {
+      place.push_back(total);
+      total *= c + 1;
+    }
+  }
+  std::size_t digit(std::size_t S, std::size_t u,
+                    const std::vector<std::size_t> &counts) const {
+    return S / place[u] % (counts[u] + 1);
+  }
+};
+
+}  // namespace
+
+std::vector<StageAssignment> plan_partition(const PartitionProblem &prob) {
+  const std::size_t L = prob.layers.size();
+  const std::size_t K = prob.kinds.size();
+  if (L == 0 || K == 0) return {};
+
+  // O(1) range queries via prefix sums (the reference recomputes per-range
+  // sums inside the DP loop, schedule.cpp:20-26, 54-60).
+  std::vector<std::vector<double>> cum_time(K, std::vector<double>(L + 1, 0.0));
+  for (std::size_t u = 0; u < K; ++u)
+    for (std::size_t i = 0; i < L; ++i)
+      cum_time[u][i + 1] = cum_time[u][i] + prob.kinds[u].layer_time_s[i];
+  std::vector<double> cum_mem(L + 1, 0.0);
+  for (std::size_t i = 0; i < L; ++i)
+    cum_mem[i + 1] = cum_mem[i] + prob.layers[i].mem_mb;
+
+  auto edge_bytes = [&](std::size_t layer_r) -> double {
+    // bytes leaving layer_r (1-based), reference schedule.cpp:32-37
+    return static_cast<double>(prob.layers[layer_r - 1].params_out) *
+           prob.dtype_bytes * prob.ubatch_size;
+  };
+  auto input_bytes = [&](std::size_t layer_l) -> double {
+    return layer_l == 1 ? static_cast<double>(prob.params_in) *
+                              prob.dtype_bytes * prob.ubatch_size
+                        : edge_bytes(layer_l - 1);
+  };
+  auto comm_time_s = [&](std::size_t layer_r, std::size_t u,
+                         std::size_t v) -> double {
+    // effective link bw = min of both endpoints (reference schedule.cpp:43)
+    double mbps = std::min(prob.kinds[u].bw_mbps, prob.kinds[v].bw_mbps);
+    return edge_bytes(layer_r) / (mbps * 1024.0 * 1024.0 / 8.0);
+  };
+  auto stage_fits = [&](std::size_t u, std::size_t l, std::size_t r) -> bool {
+    // weights + recv/send/queue buffers + processing buffers must fit
+    // (reference schedule.cpp:49-85)
+    double need = (cum_mem[r] - cum_mem[l - 1]) * 1024.0 * 1024.0;
+    double in_b = input_bytes(l);
+    double out_b = edge_bytes(r);
+    if (l > 1) need += in_b * prob.buffers_in;
+    need += out_b * prob.buffers_out;
+    need += in_b + out_b;
+    return prob.kinds[u].mem_mb * 1024.0 * 1024.0 > need;
+  };
+
+  const Radix radix(prob.kind_count);
+  const std::size_t M = radix.total;
+  auto idx = [&](std::size_t i, std::size_t S, std::size_t u) {
+    return (i * M + S) * K + u;
+  };
+
+  std::vector<double> best((L + 1) * M * K, kInfeasible);
+  struct Parent {
+    std::int64_t layer = -1;
+    std::int64_t kind = -1;
+  };
+  std::vector<Parent> parent((L + 1) * M * K);
+  for (std::size_t u = 0; u < K; ++u) best[idx(0, 0, u)] = 0.0;
+
+  double answer = kInfeasible;
+  std::size_t ans_i = 0, ans_S = 0, ans_u = 0;
+
+  // S only ever grows (S + place[u] > S), so ascending S order is a valid
+  // topological order for the relaxation.
+  for (std::size_t i = 0; i < L; ++i) {
+    for (std::size_t S = 0; S < M; ++S) {
+      for (std::size_t u = 0; u < K; ++u) {
+        double cur = best[idx(i, S, u)];
+        if (cur == kInfeasible) continue;
+        if (radix.digit(S, u, prob.kind_count) == prob.kind_count[u])
+          continue;  // all devices of kind u already used
+        for (std::size_t j = i + 1; j <= L; ++j) {
+          if (!stage_fits(u, i + 1, j)) continue;
+          double comp = cum_time[u][j] - cum_time[u][i];
+          if (j == L) {
+            double cost = std::max(cur, comp);
+            if (cost < answer) {
+              answer = cost;
+              ans_i = i;
+              ans_S = S;
+              ans_u = u;
+            }
+            continue;
+          }
+          std::size_t S2 = S + radix.place[u];
+          for (std::size_t v = 0; v < K; ++v) {
+            if (radix.digit(S2, v, prob.kind_count) == prob.kind_count[v])
+              continue;
+            double cost = std::max(cur, std::max(comp, comm_time_s(j, u, v)));
+            if (cost < best[idx(j, S2, v)]) {
+              best[idx(j, S2, v)] = cost;
+              parent[idx(j, S2, v)] = {static_cast<std::int64_t>(i),
+                                       static_cast<std::int64_t>(u)};
+            }
+          }
+        }
+      }
+    }
+  }
+
+  if (answer == kInfeasible) return {};
+
+  // Backtrack the parent chain from the answer state.
+  std::vector<StageAssignment> stages;
+  std::size_t i = ans_i, S = ans_S, u = ans_u;
+  stages.push_back({u, i + 1, L});
+  while (i > 0) {
+    Parent p = parent[idx(i, S, u)];
+    stages.push_back({static_cast<std::size_t>(p.kind),
+                      static_cast<std::size_t>(p.layer) + 1, i});
+    S -= radix.place[p.kind];
+    i = static_cast<std::size_t>(p.layer);
+    u = static_cast<std::size_t>(p.kind);
+  }
+  std::sort(stages.begin(), stages.end(),
+            [](const StageAssignment &a, const StageAssignment &b) {
+              return a.layer_r < b.layer_l;
+            });
+  return stages;
+}
+
+std::vector<HostStage> assign_hosts(
+    const std::vector<StageAssignment> &stages,
+    const std::vector<DeviceKind> &kinds,
+    const std::map<std::string, std::vector<std::string>> &kind_hosts) {
+  std::map<std::string, std::size_t> next_host;
+  std::vector<HostStage> out;
+  for (const auto &s : stages) {
+    const std::string &kind_name = kinds[s.kind_idx].name;
+    std::size_t h = next_host[kind_name]++;
+    out.push_back({kind_hosts.at(kind_name)[h], s.layer_l, s.layer_r});
+  }
+  return out;
+}
+
+}  // namespace tpusched

@@ -1,0 +1,65 @@
+// Throughput-optimal pipeline partitioning over heterogeneous device types.
+//
+// TPU-native reimplementation of the reference's scheduler core
+// (/root/reference/src-native/schedule.cpp:92-267, the Hu et al. DSD'22
+// dynamic program), same algorithm and I/O contract, rebuilt with precomputed
+// prefix sums so range compute-time and memory queries are O(1) instead of
+// O(L) in the hot loop.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <map>
+#include <string>
+#include <vector>
+
+namespace tpusched {
+
+struct LayerProfile {
+  std::uint64_t params_out;  // per-microbatch output element count
+  double mem_mb;             // weight memory for this layer
+};
+
+struct DeviceKind {
+  std::string name;
+  double mem_mb;                    // usable device memory
+  double bw_mbps;                   // link bandwidth (Mbit/s)
+  std::vector<double> layer_time_s; // per-layer compute time profile
+};
+
+struct PartitionProblem {
+  std::vector<LayerProfile> layers;
+  std::uint64_t params_in = 0;       // first layer's input element count
+  std::size_t dtype_bytes = 4;
+  std::size_t ubatch_size = 8;
+  std::size_t buffers_in = 2;        // in-flight + queue recv buffers
+  std::size_t buffers_out = 2;       // in-flight + queue send buffers
+  std::vector<DeviceKind> kinds;
+  std::vector<std::size_t> kind_count;  // devices available per kind
+};
+
+struct StageAssignment {
+  std::size_t kind_idx;   // index into PartitionProblem::kinds
+  std::size_t layer_l;    // 1-based inclusive
+  std::size_t layer_r;
+};
+
+// Minimize the pipeline bottleneck = max over stages of
+// max(stage compute time, outbound edge comm time), subject to each stage
+// fitting its device's memory (weights + in/out data buffers). Returns the
+// stage list in layer order; empty if no feasible schedule exists.
+std::vector<StageAssignment> plan_partition(const PartitionProblem &prob);
+
+// Assign concrete hosts to a kind-level schedule, consuming each kind's host
+// list in order (reference schedule.cpp:248-267).
+struct HostStage {
+  std::string host;
+  std::size_t layer_l;
+  std::size_t layer_r;
+};
+std::vector<HostStage> assign_hosts(
+    const std::vector<StageAssignment> &stages,
+    const std::vector<DeviceKind> &kinds,
+    const std::map<std::string, std::vector<std::string>> &kind_hosts);
+
+}  // namespace tpusched

@@ -1,0 +1,230 @@
+// sched-pipeline: CLI for the heterogeneous pipeline-partition scheduler.
+//
+// Drop-in contract parity with the reference binary
+// (/root/reference/src-native/sched-pipeline.cpp:132-249): same flags,
+// defaults, YAML input schemas (README_Scheduler.md:44-264) and YAML schedule
+// output ("- host: [l, r]" per stage). TPU extension: bfloat16/float16
+// dtypes are accepted (the reference only supports torch.float32,
+// sched-pipeline.cpp:227-232); profiles describing TPU chips as device types
+// work unchanged.
+#include <getopt.h>
+
+#include <cstdio>
+#include <fstream>
+#include <iostream>
+#include <map>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "miniyaml.h"
+#include "partition.h"
+
+using tpusched::DeviceKind;
+using tpusched::HostStage;
+using tpusched::LayerProfile;
+using tpusched::PartitionProblem;
+
+namespace {
+
+constexpr const char *kDtypeDefault = "torch.float32";
+constexpr std::size_t kBatchDefault = 8;
+constexpr std::size_t kBuffersDefault = 2;  // in-flight + queue
+constexpr const char *kModelDefault = "google/vit-base-patch16-224";
+
+[[noreturn]] void usage(int code) {
+  auto &os = code ? std::cerr : std::cout;
+  os << "Usage: sched-pipeline [OPTION]...\n\n"
+     << "Run the pipeline partition scheduling algorithm.\n\n"
+     << "Options:\n"
+     << "  -h, --help                 Print this message and exit\n"
+     << "  -d, --dtype=NAME           Data type (default=" << kDtypeDefault << ")\n"
+     << "  -b, --batch-size=N         Batch size (default=" << kBatchDefault << ")\n"
+     << "  -i, --buffers-in=N         Inbound data buffers (default=" << kBuffersDefault << ")\n"
+     << "  -o, --buffers-out=N        Outbound data buffers (default=" << kBuffersDefault << ")\n"
+     << "  -m, --model-name=NAME      Model name (default=" << kModelDefault << ")\n"
+     << "  -M, --models-file=PATH     Models YAML file (default=models.yml)\n"
+     << "  -T, --dev-types-file=PATH  Device types YAML file (default=device_types.yml)\n"
+     << "  -D, --dev-file=PATH        Devices YAML file (default=devices.yml)\n";
+  std::exit(code);
+}
+
+miniyaml::NodePtr load_yaml_file(const std::string &path) {
+  std::ifstream f(path);
+  if (!f) {
+    std::cerr << "Cannot open file: " << path << std::endl;
+    std::exit(1);
+  }
+  std::stringstream ss;
+  ss << f.rdbuf();
+  return miniyaml::parse(ss.str());
+}
+
+std::size_t dtype_bytes(const std::string &dtype) {
+  // torch-style and bare names; bf16/f16 are the TPU-native additions
+  static const std::map<std::string, std::size_t> sizes = {
+      {"torch.float32", 4}, {"float32", 4},
+      {"torch.bfloat16", 2}, {"bfloat16", 2},
+      {"torch.float16", 2}, {"float16", 2},
+  };
+  auto it = sizes.find(dtype);
+  if (it == sizes.end()) {
+    std::cerr << "Unsupported dtype: " << dtype << std::endl;
+    usage(1);
+  }
+  return it->second;
+}
+
+void load_model(PartitionProblem &prob, const miniyaml::Node &models,
+                const std::string &model_name) {
+  auto node = models.find(model_name);
+  if (!node) {
+    std::cerr << "Model not found: " << model_name << std::endl;
+    std::exit(1);
+  }
+  std::size_t layers = (std::size_t)node->at("layers").as_int();
+  auto params_out = node->at("parameters_out").as_int_list();
+  auto mem_mb = node->at("mem_MB").as_double_list();
+  if (params_out.size() < layers) {
+    std::cerr << "Warning: Model parameters_out length " << params_out.size()
+              << " < " << layers << ": block will be repeated" << std::endl;
+  } else if (params_out.size() > layers) {
+    std::cerr << "Model parameters_out length " << params_out.size() << " > "
+              << layers << std::endl;
+    std::exit(1);
+  }
+  if (mem_mb.size() != layers) {
+    std::cerr << "Model mem_MB length " << mem_mb.size() << " != " << layers
+              << std::endl;
+    std::exit(1);
+  }
+  prob.params_in = (std::uint64_t)node->at("parameters_in").as_int();
+  for (std::size_t i = 0; i < layers; ++i) {
+    prob.layers.push_back(
+        {(std::uint64_t)params_out[i % params_out.size()], mem_mb[i]});
+  }
+}
+
+void load_device_types(PartitionProblem &prob, const miniyaml::Node &types,
+                       const std::string &model_name, const std::string &dtype,
+                       std::size_t batch_size) {
+  if (!types.is_map()) {
+    std::cerr << "No device types found" << std::endl;
+    std::exit(1);
+  }
+  for (const auto &[name, dev] : types.map) {
+    const miniyaml::NodePtr profiles = dev->find("model_profiles");
+    const miniyaml::NodePtr model_prof =
+        profiles ? profiles->find(model_name) : nullptr;
+    if (!model_prof || !model_prof->is_seq()) {
+      std::cerr << "Warning: Device type " << name
+                << " doesn't support model: type will be skipped" << std::endl;
+      continue;
+    }
+    const miniyaml::Node *match = nullptr;
+    for (const auto &prof : model_prof->seq) {
+      if (prof->at("dtype").as_string() == dtype &&
+          (std::size_t)prof->at("batch_size").as_int() == batch_size) {
+        match = prof.get();
+      }
+    }
+    if (!match) {
+      std::cerr << "Warning: Device type " << name
+                << " doesn't have matching profile: type will be skipped"
+                << std::endl;
+      continue;
+    }
+    prob.kinds.push_back({name, dev->at("mem_MB").as_double(),
+                          dev->at("bw_Mbps").as_double(),
+                          match->at("time_s").as_double_list()});
+  }
+}
+
+std::map<std::string, std::vector<std::string>> load_devices(
+    const miniyaml::Node &devices) {
+  if (!devices.is_map()) {
+    std::cerr << "No devices found" << std::endl;
+    std::exit(1);
+  }
+  std::map<std::string, std::vector<std::string>> out;
+  for (const auto &[name, hosts] : devices.map) {
+    out[name] = hosts->as_string_list();
+  }
+  return out;
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  std::string dtype = kDtypeDefault;
+  std::string model_name = kModelDefault;
+  std::string models_file = "models.yml";
+  std::string types_file = "device_types.yml";
+  std::string devices_file = "devices.yml";
+  PartitionProblem prob;
+  prob.ubatch_size = kBatchDefault;
+  prob.buffers_in = kBuffersDefault;
+  prob.buffers_out = kBuffersDefault;
+
+  static const char short_opts[] = "hd:b:i:o:m:M:T:D:";
+  static const struct option long_opts[] = {
+      {"help", no_argument, nullptr, 'h'},
+      {"dtype", required_argument, nullptr, 'd'},
+      {"batch-size", required_argument, nullptr, 'b'},
+      {"buffers-in", required_argument, nullptr, 'i'},
+      {"buffers-out", required_argument, nullptr, 'o'},
+      {"model-name", required_argument, nullptr, 'm'},
+      {"models-file", required_argument, nullptr, 'M'},
+      {"dev-types-file", required_argument, nullptr, 'T'},
+      {"dev-file", required_argument, nullptr, 'D'},
+      {nullptr, 0, nullptr, 0}};
+  int c;
+  while ((c = getopt_long(argc, argv, short_opts, long_opts, nullptr)) != -1) {
+    switch (c) {
+      case 'h': usage(0);
+      case 'd': dtype = optarg; break;
+      case 'b':
+        if (std::sscanf(optarg, "%zu", &prob.ubatch_size) != 1) usage(1);
+        break;
+      case 'i':
+        if (std::sscanf(optarg, "%zu", &prob.buffers_in) != 1) usage(1);
+        break;
+      case 'o':
+        if (std::sscanf(optarg, "%zu", &prob.buffers_out) != 1) usage(1);
+        break;
+      case 'm': model_name = optarg; break;
+      case 'M': models_file = optarg; break;
+      case 'T': types_file = optarg; break;
+      case 'D': devices_file = optarg; break;
+      default: usage(1);
+    }
+  }
+  prob.dtype_bytes = dtype_bytes(dtype);
+
+  load_model(prob, *load_yaml_file(models_file), model_name);
+  load_device_types(prob, *load_yaml_file(types_file), model_name, dtype,
+                    prob.ubatch_size);
+  for (const auto &kind : prob.kinds) {
+    if (kind.layer_time_s.size() != prob.layers.size()) {
+      std::cerr << "Device: " << kind.name << ": model layer size ("
+                << kind.layer_time_s.size() << ") != device time size ("
+                << prob.layers.size() << ")" << std::endl;
+      std::exit(1);
+    }
+  }
+  auto kind_hosts = load_devices(*load_yaml_file(devices_file));
+  for (auto &kind : prob.kinds) {
+    auto it = kind_hosts.find(kind.name);
+    prob.kind_count.push_back(it == kind_hosts.end() ? 0 : it->second.size());
+  }
+
+  auto stages = tpusched::plan_partition(prob);
+  auto host_stages = tpusched::assign_hosts(stages, prob.kinds, kind_hosts);
+
+  // YAML schedule: list of {host: [layer_l, layer_r]}
+  for (const auto &s : host_stages) {
+    std::cout << "- " << s.host << ": [" << s.layer_l << ", " << s.layer_r
+              << "]" << std::endl;
+  }
+  return 0;
+}

@@ -1,0 +1,78 @@
+"""Subprocess wrapper for the native `sched-pipeline` scheduler binary.
+
+Parity with /root/reference/src/pipeedge/sched/scheduler.py:24-73: builds the
+CLI arguments, searches `app_paths` then the in-repo build dir then PATH, and
+parses the YAML schedule from stdout into [{host: [layer_l, layer_r]}, ...].
+"""
+import logging
+import os
+import subprocess
+from typing import Dict, List, Optional
+
+import yaml
+
+logger = logging.getLogger(__name__)
+
+# in-repo build location (native/CMakeLists.txt)
+_REPO_BUILD_PATHS = [
+    os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), 'native', 'build', 'sched-pipeline'),
+]
+
+
+def _log_cpe(exc: subprocess.CalledProcessError) -> None:
+    logger.error("Scheduler subprocess failed, return code: %d", exc.returncode)
+    stdout = exc.stdout.decode().strip()
+    if stdout:
+        logger.info("stdout:\n%s", stdout)
+    stderr = exc.stderr.decode().strip()
+    if stderr:
+        logger.error("stderr:\n%s", stderr)
+
+
+def sched_pipeline(model_name: str, buffers_in: int, buffers_out: int,
+                   batch_size: int, dtype: str = 'torch.float32',
+                   models_file: Optional[str] = None,
+                   dev_types_file: Optional[str] = None,
+                   dev_file: Optional[str] = None,
+                   app_paths: Optional[List[str]] = None) \
+        -> List[Dict[str, List[int]]]:
+    """Run the native scheduler; returns the stage list in layer order."""
+    if app_paths is None:
+        app_paths = []
+    args = ['-i', str(buffers_in), '-o', str(buffers_out),
+            '-b', str(batch_size), '-d', dtype, '-m', model_name]
+    if models_file:
+        args += ['-M', models_file]
+    if dev_types_file:
+        args += ['-T', dev_types_file]
+    if dev_file:
+        args += ['-D', dev_file]
+
+    candidates = list(app_paths) + _REPO_BUILD_PATHS + ['sched-pipeline']
+    proc = None
+    last_missing = None
+    for app_path in candidates:
+        try:
+            proc = subprocess.run([app_path] + args, capture_output=True,
+                                  check=True)
+            break
+        except FileNotFoundError:
+            last_missing = app_path
+        except subprocess.CalledProcessError as exc:
+            _log_cpe(exc)
+            raise
+    if proc is None:
+        logger.error("Could not locate sched-pipeline (last tried %r) - "
+                     "build it with: cmake -B native/build native && "
+                     "ninja -C native/build", last_missing)
+        raise FileNotFoundError('sched-pipeline')
+
+    stderr = proc.stderr.decode().strip()
+    if stderr:
+        logger.warning(stderr)
+    sched = yaml.safe_load(proc.stdout.decode())
+    if sched is None:
+        sched = []
+    assert isinstance(sched, list)
+    return sched

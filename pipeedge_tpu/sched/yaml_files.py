@@ -1,0 +1,47 @@
+"""Load/save helpers for the scheduler's YAML files.
+
+Parity with /root/reference/src/pipeedge/sched/yaml_files.py:15-49. Missing
+files load as empty maps.
+"""
+import os
+
+import yaml
+
+
+def _yaml_load_map(file) -> dict:
+    if os.path.exists(file):
+        with open(file, 'r', encoding='utf-8') as yfile:
+            return yaml.safe_load(yfile) or {}
+    return {}
+
+
+def yaml_models_load(file) -> dict:
+    """models.yml: model name -> yaml_model."""
+    return _yaml_load_map(file)
+
+
+def yaml_device_types_load(file) -> dict:
+    """device_types.yml: device type name -> yaml_device_type."""
+    return _yaml_load_map(file)
+
+
+def yaml_devices_load(file) -> dict:
+    """devices.yml: device type name -> list of hosts."""
+    return _yaml_load_map(file)
+
+
+def yaml_device_neighbors_load(file) -> dict:
+    """device_neighbors.yml: neighbor host -> yaml_device_neighbors_type."""
+    return _yaml_load_map(file)
+
+
+def yaml_device_neighbors_world_load(file) -> dict:
+    """device_neighbors_world.yml: host -> {neighbor host -> link props}."""
+    return _yaml_load_map(file)
+
+
+def yaml_save(yml, file) -> None:
+    """Save with PyYAML's compact flow style for leaf lists (matches the
+    reference's emitted formats)."""
+    with open(file, 'w', encoding='utf-8') as yfile:
+        yaml.safe_dump(yml, yfile, default_flow_style=None, encoding='utf-8')
