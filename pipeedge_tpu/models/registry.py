@@ -70,6 +70,10 @@ _MODELS: Dict[str, ModelEntry] = {e.name: e for e in [
           384, 12, 6, 1536),
     _deit("facebook/deit-tiny-distilled-patch16-224", 48, "DeiT_T_distilled.npz",
           192, 12, 3, 768),
+    # tiny synthetic models for fast tests / CI (not in the reference's list)
+    _vit("pipeedge/test-tiny-vit", 8, "test-tiny-vit.npz", 32, 2, 4, 64, 5,
+         patch=4, img=16),
+    _bert("pipeedge/test-tiny-bert", 8, "test-tiny-bert.npz", 32, 2, 4, 64, 2),
 ]}
 
 

@@ -1,0 +1,154 @@
+"""Offline per-layer profiler: jit timing + compiled memory analysis.
+
+Capability parity with /root/reference/profiler.py, redesigned for TPU/XLA:
+
+- The reference times `module(*inputs)` wall-clock on CPU (profiler.py:73-79)
+  and measures memory as the RSS delta around shard construction in a fresh
+  subprocess (profiler.py:39-53, 93-118). Here each layer is a jit-compiled
+  pure function: time comes from executing `iterations` steps inside ONE
+  compiled `lax.scan` (per-iteration inputs are perturbed by the loop index
+  so XLA cannot hoist the loop-invariant computation; a scalar readback
+  fences the device), and memory comes from the compiled executable's
+  `memory_analysis()` plus exact parameter-buffer bytes — no subprocesses
+  or RSS heuristics needed since compilation is hermetic.
+- Output schema is identical (profiler.py:234-240): {model_name, dtype,
+  batch_size, layers, profile_data: [{layer, time, memory, shape_in,
+  shape_out}]}, so the downstream converters and the native scheduler run
+  unchanged. Layer l's outputs chain into layer l+1's inputs
+  (profile_layers_individually, profiler.py:133-145).
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .models import registry
+
+logger = logging.getLogger(__name__)
+
+
+def _payload_shapes(payload) -> List[List[int]]:
+    """Per-item shapes (batch dim stripped), as the reference records them."""
+    tensors = payload if isinstance(payload, tuple) else (payload,)
+    return [list(t.shape[1:]) for t in tensors]
+
+
+def _perturb(payload, i):
+    """Make iteration i's input depend on the loop index (defeats hoisting)."""
+    scale = 1.0 + i.astype(jnp.float32) * 1e-6
+    if isinstance(payload, tuple):
+        return tuple(t * scale.astype(t.dtype) if jnp.issubdtype(t.dtype, jnp.floating)
+                     else t for t in payload)
+    if jnp.issubdtype(payload.dtype, jnp.floating):
+        return payload * scale.astype(payload.dtype)
+    return payload  # integer inputs (BERT ids) can't be perturbed; layer 1
+                    # embeddings are not loop-invariant w.r.t. the carry sum
+
+
+def _scalar_probe(payload) -> jax.Array:
+    tensors = payload if isinstance(payload, tuple) else (payload,)
+    return sum(jnp.sum(t.astype(jnp.float32)) for t in tensors)
+
+
+def time_shard_fn(fn, params, payload, iterations: int, warmup: bool = True) -> float:
+    """Average seconds per execution of `fn(params, payload)`.
+
+    All `iterations` run inside one compiled scan; a scalar readback fences
+    (block_until_ready does not fence on tunneled TPU platforms).
+    """
+    @jax.jit
+    def run(params, payload):
+        def step(carry, i):
+            out = fn(params, _perturb(payload, i))
+            return carry + _scalar_probe(out), None
+
+        total, _ = jax.lax.scan(step, jnp.float32(0), jnp.arange(iterations))
+        return total
+
+    if warmup:
+        float(run(params, payload))  # compile + warm
+    best = float("inf")
+    for _ in range(3):
+        tik = time.monotonic()
+        float(run(params, payload))
+        best = min(best, time.monotonic() - tik)
+    return best / iterations
+
+
+def shard_memory_bytes(fn, params, payload) -> int:
+    """Memory footprint: exact parameter bytes + compiled temp buffers."""
+    from .models import params_bytes
+    total = params_bytes(params)
+    try:
+        compiled = jax.jit(fn).lower(params, payload).compile()
+        analysis = compiled.memory_analysis()
+        if analysis is not None:
+            total += int(getattr(analysis, "temp_size_in_bytes", 0))
+    except Exception as exc:  # memory_analysis availability varies by backend
+        logger.debug("memory_analysis unavailable: %s", exc)
+    return total
+
+
+def default_inputs(model_name: str, batch_size: int,
+                   dtype=jnp.float32) -> jax.Array:
+    """Random model inputs matching the reference's defaults
+    (profiler.py:204-220: random images; tokenized input ids for BERT)."""
+    cfg = registry.get_model_config(model_name)
+    rng = np.random.default_rng(0)
+    if cfg.model_type == "bert":
+        ids = rng.integers(0, cfg.vocab_size, size=(batch_size, 512))
+        return jnp.asarray(ids, dtype=jnp.int32)
+    return jnp.asarray(rng.normal(size=(
+        batch_size, cfg.num_channels, cfg.image_size, cfg.image_size)),
+        dtype=dtype)
+
+
+def profile_layers_individually(model_name: str, model_file: Optional[str],
+                                inputs, layer_start: int, layer_end: int,
+                                warmup: bool, iterations: int,
+                                dtype=jnp.float32) -> List[Dict[str, Any]]:
+    """Profile each layer separately, chaining outputs into the next layer's
+    inputs (reference profiler.py:133-145)."""
+    results = []
+    payload = inputs
+    for layer in range(layer_start, layer_end + 1):
+        fn, params, _ = registry.module_shard_factory(
+            model_name, model_file, layer, layer, dtype=dtype)
+        shape_in = _payload_shapes(payload)
+        t = time_shard_fn(fn, params, payload, iterations, warmup=warmup)
+        mem = shard_memory_bytes(fn, params, payload)
+        out = fn(params, payload)
+        results.append({
+            "layer": layer,
+            "time": float(t),
+            "memory": float(mem) / 1024 / 1024,  # MB, like the reference
+            "shape_in": shape_in,
+            "shape_out": _payload_shapes(out),
+        })
+        logger.info("layer %d: %.6f s, %.2f MB", layer, t, results[-1]["memory"])
+        payload = out
+    return results
+
+
+def validate_profile_results(profile_results: dict, model_name: str,
+                             dtype_name: str, batch_size: int,
+                             model_layers: int, layer_start: int,
+                             layer_end: int) -> None:
+    """Consistency checks against existing results (profiler.py:163-173)."""
+    assert profile_results["model_name"] == model_name, \
+        "model name mismatch with existing results"
+    assert profile_results["dtype"] == dtype_name, \
+        "dtype mismatch with existing results"
+    assert profile_results["batch_size"] == batch_size, \
+        "batch size mismatch with existing results"
+    assert profile_results["layers"] == model_layers, \
+        "layer count mismatch with existing results"
+    for layer in range(layer_start, layer_end + 1):
+        for pd in profile_results["profile_data"]:
+            assert layer != pd["layer"], \
+                "layer to be profiled already in existing results"

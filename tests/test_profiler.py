@@ -1,0 +1,107 @@
+"""Profiler -> converters -> scheduler end-to-end loop.
+
+This is the reference's offline workflow (README_Profiler.md): profile per
+layer, convert to models.yml + device_types.yml, feed the native scheduler.
+"""
+import os
+import shutil
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import pytest
+import yaml
+
+from pipeedge_tpu import profiler as prof
+from pipeedge_tpu.models import registry
+from pipeedge_tpu.sched.scheduler import _REPO_BUILD_PATHS, sched_pipeline
+
+MODEL = "pipeedge/test-tiny-vit"
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def profile_results():
+    inputs = prof.default_inputs(MODEL, 2)
+    results = prof.profile_layers_individually(
+        MODEL, None, inputs, 1, registry.get_model_layers(MODEL),
+        warmup=True, iterations=2)
+    return {
+        "model_name": MODEL,
+        "dtype": "float32",
+        "batch_size": 2,
+        "layers": registry.get_model_layers(MODEL),
+        "profile_data": results,
+    }
+
+
+def test_profile_schema_and_chaining(profile_results):
+    data = profile_results["profile_data"]
+    assert [d["layer"] for d in data] == list(range(1, 9))
+    for d in data:
+        assert d["time"] > 0
+        assert d["memory"] > 0
+        assert isinstance(d["shape_in"], list)
+    # chaining: layer n's shape_out == layer n+1's shape_in
+    for a, b in zip(data, data[1:]):
+        assert a["shape_out"] == b["shape_in"]
+    # tuple payloads after attention/MLP-up sublayers (2 shapes)
+    assert len(data[0]["shape_out"]) == 2   # after sublayer 0
+    assert len(data[1]["shape_out"]) == 1   # residual folded
+    # first input: image dims; last output: logits
+    assert data[0]["shape_in"] == [[3, 16, 16]]
+    assert data[-1]["shape_out"] == [[5]]
+
+
+def test_validate_profile_results(profile_results):
+    prof.validate_profile_results(profile_results, MODEL, "float32", 2, 8, 9, 9)
+    with pytest.raises(AssertionError):
+        prof.validate_profile_results(profile_results, MODEL, "float32", 2, 8, 1, 1)
+    with pytest.raises(AssertionError):
+        prof.validate_profile_results(profile_results, "other", "float32", 2, 8, 9, 9)
+
+
+@pytest.mark.skipif(
+    not (os.path.exists(_REPO_BUILD_PATHS[0]) or shutil.which("sched-pipeline")),
+    reason="sched-pipeline binary not built")
+def test_convert_and_schedule_end_to_end(profile_results, tmp_path):
+    results_yml = tmp_path / "profiler_results.yml"
+    with open(results_yml, "w", encoding="utf-8") as f:
+        yaml.safe_dump(profile_results, f, default_flow_style=None)
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    models_yml = tmp_path / "models.yml"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "profiler_results_to_models.py"),
+         "-i", str(results_yml), "-o", str(models_yml)],
+        capture_output=True, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stdout.decode() + r.stderr.decode()
+
+    types_yml = tmp_path / "device_types.yml"
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "profiler_results_to_device_types.py"), "tpu-v5e",
+         "-i", str(results_yml), "-o", str(types_yml),
+         "-dtm", "14000", "-dtb", "10000"],
+        capture_output=True, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stdout.decode() + r.stderr.decode()
+
+    models = yaml.safe_load(open(models_yml))
+    assert models[MODEL]["layers"] == 8
+    assert models[MODEL]["parameters_in"] == 3 * 16 * 16
+    assert models[MODEL]["parameters_out"][-1] == 5
+    types = yaml.safe_load(open(types_yml))
+    assert len(types["tpu-v5e"]["model_profiles"][MODEL][0]["time_s"]) == 8
+
+    devices_yml = tmp_path / "devices.yml"
+    with open(devices_yml, "w") as f:
+        yaml.safe_dump({"tpu-v5e": ["chip0", "chip1"]}, f)
+    schedule = sched_pipeline(MODEL, 2, 2, 2, dtype="float32",
+                              models_file=str(models_yml),
+                              dev_types_file=str(types_yml),
+                              dev_file=str(devices_yml))
+    covered = []
+    for stage in schedule:
+        (_, (l, r)), = stage.items()
+        covered.extend(range(l, r + 1))
+    assert covered == list(range(1, 9))
