@@ -1,0 +1,156 @@
+"""Global monitoring utilities (facade over `pipeedge_tpu.monitoring`).
+
+Parity with /root/reference/monitoring.py: a module-global `MonitorContext`
+behind an RWLock, per-thread iteration contexts keyed by thread ident (so
+concurrent threads can measure the same key), per-key CSV files named
+`<key>.csv` with mode from env `CSV_FILE_MODE`, instant metrics logged every
+iteration and window metrics at each window boundary.
+"""
+from contextlib import contextmanager
+import logging
+import os
+import threading
+from typing import Union
+
+from pipeedge_tpu.monitoring import MonitorContext, MonitorIterationContext
+from pipeedge_tpu.utils.threads import RWLock
+
+ENV_CSV_FILE_MODE: str = "CSV_FILE_MODE"
+_CSV_FILE_MODE = 'w'  # NOTE: will overwrite existing files!
+
+PRINT_FIELDS_INSTANT = True
+PRINT_FIELDS_WINDOW = True
+PRINT_FIELDS_GLOBAL = True
+
+logger = logging.getLogger(__name__)
+
+_monitor_ctx = None  # pylint: disable=invalid-name
+_monitor_ctx_lock = RWLock()
+
+# key: thread ident, value: dict (key: key, value: MonitorIterationContext)
+_thr_ctx = {}
+# per-key locks, only for reporting iterations
+_locks = {}
+# user-friendly field names
+_work_types = {}
+_acc_types = {}
+
+
+def _log_scope(key, scope):
+    ctx = _monitor_ctx
+    get = lambda metric: getattr(ctx, f"get_{scope}_{metric}")(key=key)  # noqa: E731
+    name = scope.capitalize()
+    logger.info("%s: %s Time:     %s sec", key, name, get("time_s"))
+    logger.info("%s: %s Rate:     %s microbatches/sec", key, name, get("heartrate"))
+    logger.info("%s: %s Work:     %s %s", key, name, get("work"), _work_types[key])
+    logger.info("%s: %s Perf:     %s %s/sec", key, name, get("perf"), _work_types[key])
+    logger.info("%s: %s Energy:   %s Joules", key, name, get("energy_j"))
+    logger.info("%s: %s Power:    %s Watts", key, name, get("power_w"))
+    logger.info("%s: %s Acc:      %s %s", key, name, get("accuracy"), _acc_types[key])
+    logger.info("%s: %s Acc Rate: %s %s/sec", key, name, get("accuracy_rate"),
+                _acc_types[key])
+
+
+def init(key: str, window_size: int, work_type: str = 'items',
+         acc_type: str = 'acc') -> None:
+    """Create the global monitoring context."""
+    global _monitor_ctx  # pylint: disable=global-statement
+    log_name = key + '.csv'
+    log_mode = os.getenv(ENV_CSV_FILE_MODE, _CSV_FILE_MODE)
+    with _monitor_ctx_lock.lock_write():
+        _monitor_ctx = MonitorContext(key=key, window_size=window_size,
+                                      log_name=log_name, log_mode=log_mode)
+        logger.info("Monitoring energy source: %s", _monitor_ctx.energy_source)
+        _monitor_ctx.open()
+        _locks[key] = threading.Lock()
+        _work_types[key] = work_type
+        _acc_types[key] = acc_type
+
+
+def finish() -> None:
+    """Log global stats and destroy the monitoring context."""
+    global _monitor_ctx  # pylint: disable=global-statement
+    with _monitor_ctx_lock.lock_write():
+        if _monitor_ctx is None:
+            return
+        if PRINT_FIELDS_GLOBAL:
+            for key in _monitor_ctx.keys():
+                _log_scope(key, "global")
+        _monitor_ctx.close()
+        _monitor_ctx = None
+        _thr_ctx.clear()
+        _locks.clear()
+        _work_types.clear()
+        _acc_types.clear()
+
+
+def add_key(key: str, work_type: str = 'items', acc_type: str = 'acc') -> None:
+    """Add a new monitored key."""
+    with _monitor_ctx_lock.lock_write():
+        if _monitor_ctx is None:
+            return
+        _monitor_ctx.add_heartbeat(key=key, log_name=key + '.csv')
+        _locks[key] = threading.Lock()
+        _work_types[key] = work_type
+        _acc_types[key] = acc_type
+
+
+@contextmanager
+def get_locked_context(key: str):
+    """Yields the `MonitorContext` with a lock on `key` (use to synchronize
+    retrieving metrics)."""
+    with _monitor_ctx_lock.lock_read():
+        with _locks[key]:
+            yield _monitor_ctx
+
+
+def _iter_ctx_push(key):
+    ident = threading.get_ident()
+    keymap = _thr_ctx.setdefault(ident, {})
+    if key in keymap:
+        raise KeyError(f"Thread iteration context already exists for key: {key}")
+    keymap[key] = MonitorIterationContext()
+    return keymap[key]
+
+
+def _iter_ctx_pop(key):
+    ident = threading.get_ident()
+    iter_ctx = _thr_ctx[ident].pop(key)
+    if len(_thr_ctx[ident]) == 0:
+        del _thr_ctx[ident]
+    return iter_ctx
+
+
+def iteration_start(key: str) -> None:
+    """Start an iteration."""
+    with _monitor_ctx_lock.lock_read():
+        if _monitor_ctx is None:
+            return
+        with _locks[key]:
+            _monitor_ctx.iteration_start(iter_ctx=_iter_ctx_push(key))
+
+
+def iteration(key: str, work: int = 1, accuracy: Union[int, float] = 0,
+              safe: bool = True) -> None:
+    """Complete an iteration; logs instant metrics each beat and window
+    metrics each window period."""
+    with _monitor_ctx_lock.lock_read():
+        if _monitor_ctx is None:
+            return
+        with _locks[key]:
+            try:
+                iter_ctx = _iter_ctx_pop(key)
+            except KeyError:
+                if safe:
+                    raise KeyError(
+                        f"No thread iteration context for key: {key}") from None
+                iter_ctx = None
+            _monitor_ctx.iteration(key=key, work=work, accuracy=accuracy,
+                                   iter_ctx=iter_ctx)
+            tag = _monitor_ctx.get_tag(key=key)
+            if tag > 0:
+                if PRINT_FIELDS_INSTANT:
+                    _log_scope(key, "instant")
+                if PRINT_FIELDS_WINDOW and \
+                        (tag + 1) % _monitor_ctx.get_window_size(key=key) == 0:
+                    _log_scope(key, "window")

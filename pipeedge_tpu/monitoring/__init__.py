@@ -1,0 +1,294 @@
+"""Heartbeat-based monitoring: keyed work/energy/accuracy windows + CSV logs.
+
+Capability parity with /root/reference/src/pipeedge/monitoring/__init__.py
+(MonitorContext, 61-364), with the two native dependencies replaced:
+
+- `apphb.Heartbeat` -> an in-module ring-buffer heartbeat (per-beat
+  duration/work/energy/accuracy; instant = last beat, window = last
+  `window_size` beats, global = everything).
+- `energymon` -> a pluggable `EnergySource`. TPU power telemetry is not
+  exposed through JAX, so the default source is None and all energy/power
+  metrics read 0 — the same graceful fallback the reference applies when the
+  energymon library is missing (monitoring.py:104-121). A custom source (for
+  hosts with RAPL sysfs, for instance) can be passed in.
+
+Semantics preserved: the (instant | window | global) x (time | heartrate |
+work | perf | energy | power | accuracy | accuracy-rate) getter matrix
+(monitoring/__init__.py:228-330), per-beat CSV rows with rates normalized to
+/s and W (216-224), reusable-context-manager behavior, and a pickling block.
+"""
+from __future__ import annotations
+
+import csv
+import dataclasses
+import time
+import warnings
+from collections import deque
+from typing import Any, Optional, Union
+
+_NS_PER_S = 1_000_000_000
+
+
+class EnergySource:
+    """Interface for an energy meter; `get_uj()` returns cumulative microjoules."""
+
+    def init(self) -> None:  # pragma: no cover - interface
+        pass
+
+    def finish(self) -> None:  # pragma: no cover - interface
+        pass
+
+    def get_uj(self) -> int:  # pragma: no cover - interface
+        return 0
+
+    def get_source(self) -> str:  # pragma: no cover - interface
+        return "None"
+
+
+@dataclasses.dataclass
+class MonitorIterationContext:
+    """In-flight iteration state — clients should not modify."""
+    t_ns_last: Optional[int] = None
+    e_uj_last: Optional[int] = None
+
+
+@dataclasses.dataclass
+class _Beat:
+    duration_ns: int
+    work: Union[int, float]
+    energy_uj: int
+    accuracy: Union[int, float]
+
+
+class _Heartbeat:
+    """Ring-buffer heartbeat with instant/window/global aggregation."""
+
+    def __init__(self, window_size: int):
+        assert window_size > 0
+        self.window_size = window_size
+        self._window = deque(maxlen=window_size)
+        self._totals = _Beat(0, 0, 0, 0)
+        self.count = 0
+
+    def beat(self, duration_ns, work, energy_uj, accuracy):
+        b = _Beat(duration_ns, work, energy_uj, accuracy)
+        self._window.append(b)
+        self._totals.duration_ns += duration_ns
+        self._totals.work += work
+        self._totals.energy_uj += energy_uj
+        self._totals.accuracy += accuracy
+        self.count += 1
+
+    def _scope(self, scope: str):
+        if scope == "instant":
+            if not self._window:
+                return _Beat(0, 0, 0, 0), 0
+            return self._window[-1], 1
+        if scope == "window":
+            agg = _Beat(0, 0, 0, 0)
+            for b in self._window:
+                agg.duration_ns += b.duration_ns
+                agg.work += b.work
+                agg.energy_uj += b.energy_uj
+                agg.accuracy += b.accuracy
+            return agg, len(self._window)
+        return self._totals, self.count
+
+    def time_ns(self, scope): return self._scope(scope)[0].duration_ns
+
+    def heartrate(self, scope):
+        agg, n = self._scope(scope)
+        return n * _NS_PER_S / agg.duration_ns if agg.duration_ns else 0.0
+
+    def work(self, scope): return self._scope(scope)[0].work
+
+    def perf(self, scope):
+        agg, _ = self._scope(scope)
+        return agg.work * _NS_PER_S / agg.duration_ns if agg.duration_ns else 0.0
+
+    def energy_uj(self, scope): return self._scope(scope)[0].energy_uj
+
+    def power_w(self, scope):
+        agg, _ = self._scope(scope)
+        # uJ/ns == 1000 W
+        return agg.energy_uj * 1000 / agg.duration_ns if agg.duration_ns else 0.0
+
+    def accuracy(self, scope): return self._scope(scope)[0].accuracy
+
+    def accuracy_rate(self, scope):
+        agg, _ = self._scope(scope)
+        return agg.accuracy * _NS_PER_S / agg.duration_ns if agg.duration_ns else 0.0
+
+
+_CSV_HEADER = ["Tag", "Time (ns)", "Heart Rate (/s)", "Work",
+               "Performance (/s)", "Energy (uJ)", "Power (W)", "Accuracy",
+               "Accuracy Rate (/s)"]
+
+
+def _format_record(record):
+    """High-precision floats, never exponential (reference monitoring/__init__.py:39-41)."""
+    return [f"{r:.15f}" if isinstance(r, float) else r for r in record]
+
+
+@dataclasses.dataclass
+class _KeyedState:
+    hbt: _Heartbeat
+    log_name: Optional[str] = None
+    log_mode: str = "x"
+    iter_ctx: MonitorIterationContext = dataclasses.field(
+        default_factory=MonitorIterationContext)
+    tag: int = 0
+
+
+class MonitorContext:
+    """Top-level monitoring interface (reusable context manager, not reentrant).
+
+    Parameters mirror the reference (monitoring/__init__.py:98-114), with
+    `energy_source` (an `EnergySource` or None) replacing the energymon
+    library name/getter pair.
+    """
+
+    def __init__(self, key: Any = None, window_size: int = 1,
+                 log_name: Optional[str] = None, log_mode: str = "x",
+                 energy_source: Optional[EnergySource] = None):
+        self._initialized = False
+        self._key = key
+        self._states = {key: _KeyedState(_Heartbeat(window_size), log_name, log_mode)}
+        self._em = energy_source
+
+    def keys(self) -> tuple:
+        return tuple(self._states.keys())
+
+    def add_heartbeat(self, key: Any = None, window_size: Optional[int] = None,
+                      log_name: Optional[str] = None,
+                      log_mode: Optional[str] = None) -> None:
+        """Add a heartbeat for a new key (monitoring/__init__.py:120-148)."""
+        if key in self._states:
+            raise ValueError(f"key already in use: {key}")
+        if window_size is None:
+            window_size = self.get_window_size(key=self._key)
+        if log_mode is None:
+            log_mode = self._states[self._key].log_mode
+        self._states[key] = _KeyedState(_Heartbeat(window_size), log_name, log_mode)
+        if self._initialized:
+            self._log_header(self._states[key])
+
+    def _log_header(self, state: _KeyedState) -> None:
+        if state.log_name is not None:
+            with open(state.log_name, mode=state.log_mode, encoding="utf8") as f:
+                csv.writer(f, delimiter=",",
+                           quoting=csv.QUOTE_MINIMAL).writerow(_CSV_HEADER)
+
+    def open(self) -> None:
+        if self._initialized:
+            raise RuntimeError("Monitor is already open")
+        if self._em is not None:
+            self._em.init()
+        self._initialized = True
+        for state in self._states.values():
+            self._log_header(state)
+
+    def close(self) -> None:
+        self._initialized = False
+        if self._em is not None:
+            self._em.finish()
+
+    def _check_init(self):
+        if not self._initialized:
+            raise RuntimeError("Monitor is not open")
+
+    def iteration_start(self, key: Any = None,
+                        iter_ctx: Optional[MonitorIterationContext] = None) -> None:
+        """Begin a measurement (monitoring/__init__.py:170-187)."""
+        self._check_init()
+        if iter_ctx is None:
+            iter_ctx = self._states[key].iter_ctx
+        iter_ctx.t_ns_last = time.monotonic_ns()
+        iter_ctx.e_uj_last = 0 if self._em is None else self._em.get_uj()
+
+    def iteration(self, key: Any = None, work: int = 1,
+                  accuracy: Union[int, float] = 1,
+                  iter_ctx: Optional[MonitorIterationContext] = None) -> None:
+        """Complete a measurement and emit a heartbeat + CSV row
+        (monitoring/__init__.py:189-226)."""
+        self._check_init()
+        t_ns = time.monotonic_ns()
+        e_uj = 0 if self._em is None else self._em.get_uj()
+        state = self._states[key]
+        if iter_ctx is None:
+            iter_ctx = state.iter_ctx
+        # calling without a prior start makes this call the start
+        if iter_ctx.t_ns_last is not None:
+            state.hbt.beat(t_ns - iter_ctx.t_ns_last, work,
+                           e_uj - iter_ctx.e_uj_last, accuracy)
+            state.tag += 1
+            if state.log_name is not None:
+                hbt = state.hbt
+                rec = [state.tag - 1, hbt.time_ns("instant"),
+                       hbt.heartrate("instant"), hbt.work("instant"),
+                       hbt.perf("instant"), hbt.energy_uj("instant"),
+                       hbt.power_w("instant"), hbt.accuracy("instant"),
+                       hbt.accuracy_rate("instant")]
+                with open(state.log_name, mode="a", encoding="utf8") as f:
+                    csv.writer(f, delimiter=",", quoting=csv.QUOTE_MINIMAL
+                               ).writerow(_format_record(rec))
+        iter_ctx.t_ns_last = t_ns
+        iter_ctx.e_uj_last = e_uj
+
+    # getter matrix: (instant | window | global) x 8 metrics
+    def get_instant_time_s(self, key=None): return self._states[key].hbt.time_ns("instant") / _NS_PER_S
+    def get_instant_heartrate(self, key=None): return self._states[key].hbt.heartrate("instant")
+    def get_instant_work(self, key=None): return self._states[key].hbt.work("instant")
+    def get_instant_perf(self, key=None): return self._states[key].hbt.perf("instant")
+    def get_instant_energy_j(self, key=None): return self._states[key].hbt.energy_uj("instant") / 1e6
+    def get_instant_power_w(self, key=None): return self._states[key].hbt.power_w("instant")
+    def get_instant_accuracy(self, key=None): return self._states[key].hbt.accuracy("instant")
+    def get_instant_accuracy_rate(self, key=None): return self._states[key].hbt.accuracy_rate("instant")
+
+    def get_window_time_s(self, key=None): return self._states[key].hbt.time_ns("window") / _NS_PER_S
+    def get_window_heartrate(self, key=None): return self._states[key].hbt.heartrate("window")
+    def get_window_work(self, key=None): return self._states[key].hbt.work("window")
+    def get_window_perf(self, key=None): return self._states[key].hbt.perf("window")
+    def get_window_energy_j(self, key=None): return self._states[key].hbt.energy_uj("window") / 1e6
+    def get_window_power_w(self, key=None): return self._states[key].hbt.power_w("window")
+    def get_window_accuracy(self, key=None): return self._states[key].hbt.accuracy("window")
+    def get_window_accuracy_rate(self, key=None): return self._states[key].hbt.accuracy_rate("window")
+
+    def get_global_time_s(self, key=None): return self._states[key].hbt.time_ns("global") / _NS_PER_S
+    def get_global_heartrate(self, key=None): return self._states[key].hbt.heartrate("global")
+    def get_global_work(self, key=None): return self._states[key].hbt.work("global")
+    def get_global_perf(self, key=None): return self._states[key].hbt.perf("global")
+    def get_global_energy_j(self, key=None): return self._states[key].hbt.energy_uj("global") / 1e6
+    def get_global_power_w(self, key=None): return self._states[key].hbt.power_w("global")
+    def get_global_accuracy(self, key=None): return self._states[key].hbt.accuracy("global")
+    def get_global_accuracy_rate(self, key=None): return self._states[key].hbt.accuracy_rate("global")
+
+    def get_tag(self, key: Any = None) -> int:
+        """The next tag (== completed heartbeat count)."""
+        return self._states[key].tag
+
+    def get_window_size(self, key: Any = None) -> int:
+        return self._states[key].hbt.window_size
+
+    @property
+    def initialized(self) -> bool:
+        return self._initialized
+
+    @property
+    def energy_source(self) -> str:
+        return "None" if self._em is None else self._em.get_source()
+
+    def __enter__(self):
+        self.open()
+        return self
+
+    def __exit__(self, *args):
+        self.close()
+
+    def __del__(self):
+        if self._initialized:
+            warnings.warn("unclosed monitor", category=ResourceWarning, source=self)
+            self.close()
+
+    def __getstate__(self):
+        raise TypeError(f"Cannot pickle {self.__class__.__name__!r} object")

@@ -1,0 +1,1 @@
+"""Utility modules: thread primitives, controllers, quantization policies, data."""

@@ -1,0 +1,91 @@
+"""Thread utilities: readers-writer lock and waitable counter.
+
+Capability parity with /root/reference/utils/threads.py (RWLock at 5-57,
+ThreadSafeCounter at 60-91). The TPU runtime is single-controller and far
+less thread-heavy than the reference's 4-threads-per-rank design, but the
+monitoring facade and host-driven pipeline still use these.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+
+class RWLock:
+    """A readers-writer lock: many concurrent readers, exclusive writers."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writer = False
+
+    def acquire_read(self) -> None:
+        with self._cond:
+            while self._writer:
+                self._cond.wait()
+            self._readers += 1
+
+    def release_read(self) -> None:
+        with self._cond:
+            self._readers -= 1
+            if self._readers == 0:
+                self._cond.notify_all()
+
+    def acquire_write(self) -> None:
+        with self._cond:
+            while self._writer or self._readers > 0:
+                self._cond.wait()
+            self._writer = True
+
+    def release_write(self) -> None:
+        with self._cond:
+            self._writer = False
+            self._cond.notify_all()
+
+    @contextmanager
+    def lock_read(self):
+        """Context manager for read access."""
+        self.acquire_read()
+        try:
+            yield self
+        finally:
+            self.release_read()
+
+    @contextmanager
+    def lock_write(self):
+        """Context manager for exclusive write access."""
+        self.acquire_write()
+        try:
+            yield self
+        finally:
+            self.release_write()
+
+
+class ThreadSafeCounter:
+    """A counter whose waiters can block until a threshold is reached
+    (reference utils/threads.py:60-91; used to count pipeline results)."""
+
+    def __init__(self, value: int = 0):
+        self._value = value
+        self._cond = threading.Condition()
+
+    @property
+    def value(self) -> int:
+        with self._cond:
+            return self._value
+
+    def add(self, quantity: int = 1) -> None:
+        with self._cond:
+            self._value += quantity
+            self._cond.notify_all()
+
+    def set(self, value: int) -> None:
+        with self._cond:
+            self._value = value
+            self._cond.notify_all()
+
+    def wait_gte(self, threshold: int, timeout: float = None) -> bool:
+        """Block until value >= threshold; returns False on timeout."""
+        with self._cond:
+            return self._cond.wait_for(lambda: self._value >= threshold,
+                                       timeout=timeout)

@@ -1,0 +1,129 @@
+"""Monitoring context + facade tests (the reference ships none — SURVEY.md §4)."""
+import csv
+import threading
+import time
+
+import pytest
+
+import monitoring as monitoring_facade
+from pipeedge_tpu.monitoring import MonitorContext
+from pipeedge_tpu.utils.threads import RWLock, ThreadSafeCounter
+
+
+def test_monitor_lifecycle_and_metrics(tmp_path):
+    log = tmp_path / "shard.csv"
+    with MonitorContext(key="shard", window_size=3, log_name=str(log)) as ctx:
+        for i in range(7):
+            ctx.iteration_start(key="shard")
+            time.sleep(0.002)
+            ctx.iteration(key="shard", work=8, accuracy=i)
+        assert ctx.get_tag(key="shard") == 7
+        assert ctx.get_global_work(key="shard") == 56
+        assert ctx.get_window_work(key="shard") == 24          # last 3 beats
+        assert ctx.get_instant_work(key="shard") == 8
+        assert ctx.get_global_time_s(key="shard") >= 0.014
+        assert ctx.get_instant_heartrate(key="shard") > 0
+        assert ctx.get_global_perf(key="shard") > 0
+        # no energy source -> zero energy/power, not an error
+        assert ctx.get_global_energy_j(key="shard") == 0
+        assert ctx.get_window_power_w(key="shard") == 0
+        assert ctx.energy_source == "None"
+    # CSV: header + 7 rows
+    rows = list(csv.reader(open(log)))
+    assert rows[0][0] == "Tag"
+    assert len(rows) == 8
+
+
+def test_monitor_multiple_keys(tmp_path):
+    ctx = MonitorContext(key="a", window_size=2, log_name=None)
+    ctx.add_heartbeat(key="b", log_name=str(tmp_path / "b.csv"))
+    with ctx:
+        ctx.iteration_start(key="b")
+        ctx.iteration(key="b", work=3)
+        assert ctx.get_global_work(key="b") == 3
+        assert ctx.get_global_work(key="a") == 0
+    with pytest.raises(ValueError):
+        ctx.add_heartbeat(key="b")
+
+
+def test_monitor_not_open_raises():
+    ctx = MonitorContext(key="x")
+    with pytest.raises(RuntimeError):
+        ctx.iteration_start(key="x")
+
+
+def test_monitor_pickle_blocked():
+    import pickle
+    with pytest.raises(TypeError):
+        pickle.dumps(MonitorContext(key="x"))
+
+
+def test_facade_lifecycle(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    monitoring_facade.init("shard", 2, work_type="items", acc_type="layers")
+    monitoring_facade.add_key("send", work_type="Mbits")
+    monitoring_facade.iteration_start("shard")
+    monitoring_facade.iteration("shard", work=4, accuracy=12)
+    monitoring_facade.iteration_start("send")
+    monitoring_facade.iteration("send", work=1.5)
+    with monitoring_facade.get_locked_context("send") as mctx:
+        assert mctx.get_tag(key="send") == 1
+        assert mctx.get_window_work(key="send") == 1.5
+    monitoring_facade.finish()
+    assert (tmp_path / "shard.csv").exists()
+    assert (tmp_path / "send.csv").exists()
+    # after finish: no-ops, no errors
+    monitoring_facade.iteration_start("shard")
+    monitoring_facade.iteration("shard")
+
+
+def test_facade_unbalanced_iteration_raises(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    monitoring_facade.init("k", 2)
+    try:
+        with pytest.raises(KeyError):
+            monitoring_facade.iteration("k", work=1)  # no start
+        monitoring_facade.iteration("k", work=1, safe=False)  # tolerated
+    finally:
+        monitoring_facade.finish()
+
+
+def test_facade_threads_same_key(tmp_path, monkeypatch):
+    """Concurrent threads measuring the same key (reference monitoring.py:1-8)."""
+    monkeypatch.chdir(tmp_path)
+    monitoring_facade.init("k", 4)
+    errors = []
+
+    def worker():
+        try:
+            for _ in range(5):
+                monitoring_facade.iteration_start("k")
+                monitoring_facade.iteration("k", work=1)
+        except Exception as exc:  # pragma: no cover
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    with monitoring_facade.get_locked_context("k") as mctx:
+        assert mctx.get_tag(key="k") == 20
+        assert mctx.get_global_work(key="k") == 20
+    monitoring_facade.finish()
+    assert not errors
+
+
+def test_rwlock_and_counter():
+    lock = RWLock()
+    with lock.lock_read():
+        with lock.lock_read():
+            pass  # concurrent readers fine
+    with lock.lock_write():
+        pass
+    counter = ThreadSafeCounter()
+    t = threading.Thread(target=lambda: (time.sleep(0.01), counter.add(5)))
+    t.start()
+    assert counter.wait_gte(5, timeout=2)
+    t.join()
+    assert counter.value == 5

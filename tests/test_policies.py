@@ -1,0 +1,68 @@
+"""Adaptive-bitwidth policy + controller tests."""
+import pytest
+
+from pipeedge_tpu.utils.controller import AdaptiveIntegralXupController, KalmanFilter
+from pipeedge_tpu.utils.quant import (
+    BITWIDTHS, AdaptiveBitwidthPerformanceController, constrain_max_bitwidth)
+
+
+def test_bitwidths_unique_discrete_compressions():
+    # 32,16,10,8,6,5,4,3,2: largest bit per distinct floor(32/bit)
+    assert BITWIDTHS == [32, 16, 10, 8, 6, 5, 4, 3, 2]
+
+
+def test_kalman_converges():
+    kf = KalmanFilter()
+    est = 0.0
+    for _ in range(100):
+        est = kf(10.0)
+    assert est == pytest.approx(10.0, rel=0.01)
+
+
+def test_controller_tracks_reference():
+    # plant: y = base * u with base workload 2.0; target y = 10 -> u = 5
+    ctl = AdaptiveIntegralXupController(reference=10.0, u_0=1.0, u_max=16.0)
+    u = 1.0
+    for _ in range(50):
+        y = 2.0 * u
+        u = ctl(y)
+    assert 2.0 * u == pytest.approx(10.0, rel=0.05)
+
+
+def test_controller_pole_validation():
+    ctl = AdaptiveIntegralXupController(1.0, 1.0)
+    with pytest.raises(ValueError):
+        ctl.pole = 1.0
+    with pytest.raises(ValueError):
+        ctl.pole = -0.1
+    ctl.pole = 0.5
+
+
+def test_controller_antiwindup_clamp():
+    ctl = AdaptiveIntegralXupController(reference=1e9, u_0=1.0, u_max=4.0)
+    for _ in range(10):
+        u = ctl(1.0)
+    assert u == 4.0  # clamped at u_max
+
+
+def test_constrain_max_bitwidth():
+    # scale = speed*t/size; need effective compression 1/floor(32/b) <= scale
+    assert constrain_max_bitwidth(1.0, 1.0, 1.0, 32) == 32     # no constraint
+    assert constrain_max_bitwidth(0.5, 1.0, 1.0, 32) == 16     # 2x needed
+    assert constrain_max_bitwidth(0.25, 1.0, 1.0, 32) == 8     # 4x needed
+    assert constrain_max_bitwidth(0.24, 1.0, 1.0, 32) == 6     # >4x -> floor(32/6)=5
+    assert constrain_max_bitwidth(0.01, 1.0, 1.0, 32) == 0     # unsatisfiable
+    assert constrain_max_bitwidth(1.0, 0.0, 1.0, 32) == 32     # no data
+
+
+def test_bitwidth_perf_controller_splits_window():
+    ctl = AdaptiveBitwidthPerformanceController(
+        perf_constraint=100.0, bitwidths=BITWIDTHS, bitwidth_start=32)
+    bw1, bw2, iters1 = ctl(50.0, 10)
+    assert bw1 in BITWIDTHS and bw2 in BITWIDTHS
+    assert bw1 >= bw2           # bw1 is the slower (higher-precision) one
+    assert 0 <= iters1 <= 10
+    # sustained underperformance drives toward smaller bitwidths
+    for _ in range(30):
+        bw1, bw2, iters1 = ctl(50.0, 10)
+    assert bw2 <= 4
