@@ -1,0 +1,131 @@
+"""Dataset utilities (parity with /root/reference/utils/data.py).
+
+Numpy-first (the pipeline consumes jnp arrays); torch / HF-datasets /
+torchvision are optional and gracefully gated — with zero egress the default
+path is synthetic data, matching the reference's rollover-single-image mode
+(runtime.py:394-401).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class RolloverTensorDataset:
+    """Repeat small tensors to a requested length (reference data.py:7-20)."""
+
+    def __init__(self, max_size: int, *tensors):
+        assert len(tensors) > 0
+        self._tensors = tensors
+        self._max_size = max_size
+
+    def __len__(self) -> int:
+        return self._max_size
+
+    def __getitem__(self, idx) -> Tuple:
+        if not 0 <= idx < self._max_size:
+            raise IndexError(idx)
+        return tuple(t[idx % len(t)] for t in self._tensors)
+
+
+class SubsetDataset:
+    """Index-selected view of a dataset (reference's load_dataset_subset)."""
+
+    def __init__(self, dataset, indices: Sequence[int]):
+        self._dataset = dataset
+        self._indices = list(indices)
+
+    def __len__(self) -> int:
+        return len(self._indices)
+
+    def __getitem__(self, idx):
+        return self._dataset[self._indices[idx]]
+
+
+def load_dataset_subset(dataset, indices: Optional[Sequence[int]] = None,
+                        max_size: Optional[int] = None,
+                        shuffle: bool = False):
+    """Select a subset by indices or size, optionally shuffled."""
+    if indices is None:
+        indices = list(range(len(dataset)))
+    if shuffle:
+        indices = list(indices)
+        np.random.default_rng(0).shuffle(indices)
+    if max_size is not None:
+        indices = list(indices)[:max_size]
+    return SubsetDataset(dataset, indices)
+
+
+def synthetic_image_dataset(size: int, shape=(3, 224, 224),
+                            n_labels: int = 1000) -> RolloverTensorDataset:
+    """Random-image dataset; the zero-egress stand-in for the reference's
+    downloaded sample image (runtime.py:397-401)."""
+    rng = np.random.default_rng(0)
+    images = rng.normal(size=(min(size, 64),) + shape).astype(np.float32)
+    labels = rng.integers(0, n_labels, size=(min(size, 64),))
+    return RolloverTensorDataset(size, images, labels)
+
+
+def synthetic_token_dataset(size: int, seq_len: int = 512,
+                            vocab_size: int = 30522,
+                            n_labels: int = 2) -> RolloverTensorDataset:
+    """Random token-id dataset (BERT input stand-in, tools/bert_save_input.py)."""
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, vocab_size, size=(min(size, 64), seq_len)).astype(np.int32)
+    labels = rng.integers(0, n_labels, size=(min(size, 64),))
+    return RolloverTensorDataset(size, ids, labels)
+
+
+def load_dataset_glue(tokenizer, task: str, split: str, ubatch_size: int):
+    """GLUE dataset with per-microbatch padding (reference data.py:54-78).
+    Requires the HF `datasets` package and a local cache (zero egress)."""
+    from datasets import load_dataset  # gated import
+    dataset = load_dataset('glue', task, split=split)
+
+    def _tokenize(examples):
+        enc = tokenizer(examples['sentence'], padding=True, truncation=True,
+                        return_tensors='np')
+        return {'input_ids': enc['input_ids'], 'label': examples['label']}
+
+    dataset = dataset.map(_tokenize, batched=True, batch_size=ubatch_size)
+    items = [(np.asarray(d['input_ids'], dtype=np.int32), int(d['label']))
+             for d in dataset]
+    ids = [i for i, _ in items]
+    labels = np.asarray([l for _, l in items])
+    return list(zip(ids, labels))
+
+
+def load_dataset_imagenet(feature_extractor, root: str, split: str = 'val'):
+    """ImageNet via torchvision ImageFolder + HF feature extractor
+    (reference data.py:81-89). Requires a local dataset directory."""
+    from torchvision.datasets import ImageFolder  # gated import
+
+    class _FeatureDataset:
+        def __init__(self, folder):
+            self._folder = folder
+
+        def __len__(self):
+            return len(self._folder)
+
+        def __getitem__(self, idx):
+            img, label = self._folder[idx]
+            pixels = feature_extractor(images=[img], return_tensors='np'
+                                       )['pixel_values'][0]
+            return pixels, label
+
+    import os
+    return _FeatureDataset(ImageFolder(os.path.join(root, split)))
+
+
+def batch_dataset(dataset, ubatch_size: int):
+    """Yield (inputs [u, ...], labels [u]) microbatches, FIFO order."""
+    n = len(dataset)
+    for start in range(0, n - ubatch_size + 1, ubatch_size):
+        items = [dataset[i] for i in range(start, start + ubatch_size)]
+        inputs = np.stack([x for x, _ in items])
+        labels = np.asarray([y for _, y in items])
+        yield inputs, labels

@@ -1,0 +1,481 @@
+"""Pipeline-parallel inference runtime CLI.
+
+Parity with /root/reference/runtime.py (the main application, 605-730),
+re-architected for a single-controller JAX/TPU world:
+
+- The reference launches one OS process per rank (`runtime.py RANK WORLDSIZE`)
+  and wires them with gloo TCP or TensorPipe RPC. Here ONE controller process
+  drives all chips: `rank` must be 0 and `worldsize` becomes the number of
+  pipeline stages (devices). There is no network bring-up, no wire protocol,
+  and no command plane — the schedule broadcast (CMD_SCHED) and stop
+  (CMD_STOP) of the reference (runtime.py:404-452) are plain function calls.
+- `--comm spmd` compiles the whole pipeline into one XLA program with
+  ppermute edges (block-aligned partitions); `--comm host` drives per-stage
+  jit programs with device_put edges and supports arbitrary sublayer cuts
+  and runtime-adaptive quantization. `p2p`/`rpc` are accepted as aliases
+  for host mode (their capability equivalent).
+- Schedule resolution precedence is identical (runtime.py:291-355): manual
+  `-pt` partition > single-stage degenerate > native sched-pipeline.
+- Monitoring keys, window adaptation via env ADAPTIVE_QUANT /
+  SEND_CONSTRAINT / WINDOW_SIZE, result accuracy vs labels or softmax
+  confidence (runtime.py:236-257) are preserved.
+"""
+import argparse
+import logging
+import os
+import queue
+import sys
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+import monitoring
+from pipeedge_tpu.models import get_microbatch_size, registry
+from pipeedge_tpu.parallel import pipeline as host_pipeline
+from pipeedge_tpu.parallel import spmd
+from pipeedge_tpu.sched.scheduler import sched_pipeline
+from pipeedge_tpu.utils import data as data_utils
+from pipeedge_tpu.utils import quant as quantutil
+from pipeedge_tpu.utils.threads import ThreadSafeCounter
+
+logger = logging.getLogger(__name__)
+
+# Env knobs (reference runtime.py:40-52)
+ENV_WINDOW_SIZE = "WINDOW_SIZE"
+ENV_SEND_CONSTRAINT = "SEND_CONSTRAINT"
+ENV_ADAPTIVE_QUANT = "ADAPTIVE_QUANT"
+ADAPTIVE_QUANT_HEURISTIC = "HEURISTIC"
+ADAPTIVE_QUANT_HEURISTIC2 = "HEURISTIC2"
+ADAPTIVE_QUANT_CONTROLLER = "CONTROLLER"
+
+MONITORING_KEY_MODEL = 'shard'
+MONITORING_KEY_OUTPUT = 'output'
+MONITORING_KEY_QUANT_ENCODE = 'quant_encode'
+MONITORING_KEY_QUANT_DECODE = 'quant_decode'
+MONITORING_KEY_SEND = 'send'
+MONITORING_KEY_RECV = 'recv'
+
+results_counter = ThreadSafeCounter()
+label_queue = queue.Queue()
+
+
+def get_window_size() -> int:
+    """Window period for monitoring/adaptation (reference runtime.py:40-44)."""
+    return int(os.getenv(ENV_WINDOW_SIZE, "10"))
+
+
+def handle_results(tensors) -> None:
+    """Process result tensors (reference runtime.py:236-257): accuracy from
+    labels when available (FIFO order guaranteed here), else softmax
+    confidence."""
+    outputs = np.asarray(tensors)
+    n_items = get_microbatch_size(outputs, verify=True)
+    if label_queue.empty():
+        exp = np.exp(outputs - outputs.max(axis=-1, keepdims=True))
+        probs = exp / exp.sum(axis=-1, keepdims=True)
+        acc = float(probs.max(axis=-1).sum())
+    else:
+        ubatch_labels = label_queue.get()
+        assert len(outputs) == len(ubatch_labels)
+        pred = outputs.argmax(axis=-1)
+        acc = int((pred == np.asarray(ubatch_labels)).sum())
+    monitoring.iteration(MONITORING_KEY_OUTPUT, work=n_items, accuracy=acc,
+                         safe=False)
+    logger.debug("outputs is %s", outputs)
+    results_counter.add(n_items)
+
+
+def parse_yaml_sched(sched: List[dict], hosts: Optional[List[str]]) -> \
+        Tuple[List[Tuple[int, int]], List[int]]:
+    """Parse the scheduler's YAML into stage_layers + stage_ranks
+    (reference runtime.py:260-288). Ranks here are device indices."""
+    assert isinstance(sched, list)
+    if len(sched) == 0:
+        raise RuntimeError("No viable schedule found")
+    stage_layers = []
+    stage_ranks = []
+    for stage in sched:
+        assert len(stage) == 1
+        for host, layers in stage.items():
+            assert len(layers) == 2
+            stage_layers.append((int(layers[0]), int(layers[1])))
+            if hosts:
+                try:
+                    stage_ranks.append(hosts.index(host))
+                except ValueError:
+                    logger.error("Scheduling: host not in hosts list: %s", host)
+                    raise
+            else:
+                try:
+                    stage_ranks.append(int(host))
+                except ValueError:
+                    logger.error("Scheduling: 'hosts' not specified, failed "
+                                 "to parse as device index: %s", host)
+                    raise
+    return stage_layers, stage_ranks
+
+
+def get_pipeline_sched(world_size: int, hosts: Optional[List[str]],
+                       partition: Optional[List[Tuple[int, int]]],
+                       quant: Optional[List[int]],
+                       rank_order: Optional[List[int]], model_name: str,
+                       microbatch_size: int, s_models_file: Optional[str],
+                       s_dev_types_file: Optional[str],
+                       s_dev_file: Optional[str]) -> \
+        Tuple[List[Tuple[int, int]], List[int], List[int]]:
+    """Schedule resolution: manual partition > single-stage degenerate >
+    native scheduler (reference runtime.py:291-355)."""
+    if partition:
+        logger.info("Scheduling: using user-defined partitioning")
+        stage_layers = partition
+        stage_quant = quant if quant else [0] * len(stage_layers)
+        stage_ranks = rank_order if rank_order else list(range(len(stage_layers)))
+    elif quant:
+        raise RuntimeError("Must specify partition with quantization")
+    elif rank_order:
+        raise RuntimeError("Must specify partition with rank stage ordering")
+    elif world_size <= 1:
+        logger.info("Scheduling: single-node execution (degenerate case)")
+        stage_layers = [(1, registry.get_model_layers(model_name))]
+        stage_quant = [0]
+        stage_ranks = [0]
+    else:
+        logger.info("Scheduling: using scheduler algorithm")
+        if hosts and len(hosts) != world_size:
+            raise RuntimeError("Specified hosts count != world size")
+        sched = sched_pipeline(model_name, 2, 2, microbatch_size,
+                               models_file=s_models_file,
+                               dev_types_file=s_dev_types_file,
+                               dev_file=s_dev_file)
+        stage_layers, stage_ranks = parse_yaml_sched(sched, hosts)
+        stage_quant = [0] * len(stage_layers)
+    logger.info("Scheduling: stage-to-layer mapping: %s", stage_layers)
+    logger.info("Scheduling: stage output quantization: %s", stage_quant)
+    logger.info("Scheduling: stage-to-device mapping: %s", stage_ranks)
+    return stage_layers, stage_quant, stage_ranks
+
+
+def load_dataset(dataset_cfg: dict, model_name: str, batch_size: int,
+                 ubatch_size: int):
+    """Load inputs based on model (reference runtime.py:358-401); synthetic
+    data replaces network-fetched samples under zero egress."""
+    cfg = registry.get_model_config(model_name)
+    name = dataset_cfg['name']
+    root = dataset_cfg['root']
+    split = dataset_cfg['split']
+    indices = dataset_cfg['indices']
+    shuffle = dataset_cfg['shuffle']
+    if name == 'CoLA':
+        try:
+            from transformers import AutoTokenizer
+            tokenizer = AutoTokenizer.from_pretrained(model_name)
+            dataset = data_utils.load_dataset_glue(tokenizer, 'cola', split,
+                                                   ubatch_size)
+            dataset = data_utils.load_dataset_subset(
+                dataset, indices=indices, max_size=batch_size, shuffle=shuffle)
+        except Exception as exc:
+            logger.warning("CoLA unavailable offline (%s); using synthetic "
+                           "token data", exc)
+            dataset = data_utils.synthetic_token_dataset(
+                batch_size, seq_len=64, vocab_size=cfg.vocab_size or 30522,
+                n_labels=max(cfg.num_labels, 2))
+    elif name == 'ImageNet':
+        try:
+            from transformers import AutoImageProcessor
+            extractor = AutoImageProcessor.from_pretrained(model_name)
+            dataset = data_utils.load_dataset_imagenet(extractor, root or
+                                                       'ImageNet', split=split)
+            dataset = data_utils.load_dataset_subset(
+                dataset, indices=indices, max_size=batch_size, shuffle=shuffle)
+        except Exception as exc:
+            logger.warning("ImageNet unavailable (%s); using synthetic images",
+                           exc)
+            dataset = data_utils.synthetic_image_dataset(
+                batch_size, shape=(cfg.num_channels, cfg.image_size,
+                                   cfg.image_size),
+                n_labels=max(cfg.num_labels, 2))
+    elif cfg.model_type == 'bert':
+        dataset = data_utils.synthetic_token_dataset(
+            batch_size, seq_len=64, vocab_size=cfg.vocab_size or 30522,
+            n_labels=max(cfg.num_labels, 2))
+    else:
+        dataset = data_utils.synthetic_image_dataset(
+            batch_size, shape=(cfg.num_channels, cfg.image_size, cfg.image_size),
+            n_labels=max(cfg.num_labels, 2))
+    return dataset
+
+
+def _make_adaptive_callback(stages, window_size: int):
+    """Window-period bitwidth adaptation (reference runtime.py:121-216).
+
+    Runs host-side between microbatches, reading the 'send' monitor window
+    and mutating each non-final stage's quant_bit; the host pipeline swaps in
+    the pre-compiled program for the chosen bitwidth.
+    """
+    policy = os.getenv(ENV_ADAPTIVE_QUANT)
+    if not policy:
+        return None
+    rate_constraint = float(os.getenv(ENV_SEND_CONSTRAINT, "0"))
+    controllers = {}
+    ctl_state = {}
+
+    def callback(i: int, out) -> None:
+        tag = i + 1
+        if tag % window_size != 0:
+            # controller policy counts down its bitwidth1 window split
+            if policy == ADAPTIVE_QUANT_CONTROLLER:
+                for stage in stages[:-1]:
+                    st = ctl_state.get(id(stage))
+                    if st:
+                        bw1, bw2, it1 = st
+                        stage.quant_bit = (bw1 if it1 > 0 else bw2) % max(
+                            quantutil.BITWIDTHS)
+                        ctl_state[id(stage)] = (bw1, bw2, max(0, it1 - 1))
+            return
+        with monitoring.get_locked_context(MONITORING_KEY_SEND) as mctx:
+            if mctx is None:
+                return
+            window_perf = mctx.get_window_perf(key=MONITORING_KEY_SEND)
+            window_work = mctx.get_window_work(key=MONITORING_KEY_SEND)
+            heartrate = mctx.get_window_heartrate(key=MONITORING_KEY_SEND)
+        ubatch_size = get_microbatch_size(np.asarray(out))
+        for stage in stages[:-1]:
+            if policy == ADAPTIVE_QUANT_HEURISTIC:
+                # discrete compress-ratio ladder (runtime.py:121-154)
+                if rate_constraint > 0:
+                    target_time = ubatch_size * window_size / rate_constraint
+                else:
+                    target_time = float('inf')
+                target_datasize = target_time * max(window_perf, 1e-12)
+                qbit = stage.quant_bit
+                eff = window_work * (32 / qbit if qbit > 0 else 1)
+                ratio = int(eff / target_datasize) + 1 if target_datasize > 0 else 1
+                for bound, bit in ((1, 0), (2, 16), (4, 8), (5, 6), (8, 4)):
+                    if ratio <= bound:
+                        stage.quant_bit = bit
+                        break
+                else:
+                    stage.quant_bit = 2
+            elif policy == ADAPTIVE_QUANT_HEURISTIC2:
+                # analytic largest-feasible bitwidth (runtime.py:156-174)
+                if rate_constraint <= 0:
+                    continue
+                ubatch_time = ubatch_size / rate_constraint
+                src_bit = 32
+                qbit = quantutil.constrain_max_bitwidth(
+                    ubatch_time, max(window_work, 1e-12) / window_size,
+                    max(window_perf, 1e-12), src_bit)
+                stage.quant_bit = max(2, qbit) % src_bit
+            elif policy == ADAPTIVE_QUANT_CONTROLLER:
+                # Kalman/integral controller window split (runtime.py:177-216)
+                if id(stage) not in controllers:
+                    bw_start = stage.quant_bit or max(quantutil.BITWIDTHS)
+                    controllers[id(stage)] = \
+                        quantutil.AdaptiveBitwidthPerformanceController(
+                            rate_constraint, quantutil.BITWIDTHS, bw_start)
+                ctl = controllers[id(stage)]
+                ctl.reference = rate_constraint
+                send_rate = heartrate * ubatch_size
+                bw1, bw2, it1 = ctl(send_rate, window_size)
+                ctl_state[id(stage)] = (bw1, bw2, it1)
+                stage.quant_bit = (bw1 if it1 > 0 else bw2) % max(
+                    quantutil.BITWIDTHS)
+            logger.info("Adaptive quantization (%s): bitwidth=%d", policy,
+                        stage.quant_bit)
+
+    return callback
+
+
+def run_pipeline_host(args, stage_layers, stage_quant, stage_ranks,
+                      ubatches, labels) -> None:
+    """Host-driven pipeline (arbitrary cut points, adaptive quantization)."""
+    import jax
+    import jax.numpy as jnp
+
+    devices = jax.devices()
+    dtype = jnp.bfloat16 if args.dtype == 'bfloat16' else jnp.float32
+    pipe = host_pipeline.build_pipeline(
+        args.model_name, stage_layers, model_file=args.model_file,
+        devices=[devices[r % len(devices)] for r in stage_ranks],
+        quant_bits=stage_quant, dtype=dtype)
+    window_size = get_window_size()
+    adaptive = _make_adaptive_callback(pipe.stages, window_size)
+
+    for lb in labels:
+        label_queue.put(lb)
+
+    def on_result(i, out):
+        # send monitor: wire bytes of the quantized edge payloads (Mbits),
+        # the reference's p2p_post_hook_monitor semantics (runtime.py:219-230)
+        mbits = sum(np.asarray(t).nbytes for t in
+                    (out if isinstance(out, tuple) else (out,))) * 8 / 1e6
+        monitoring.iteration(MONITORING_KEY_SEND, work=mbits, safe=False)
+        handle_results(out)
+        if adaptive is not None:
+            adaptive(i, out)
+
+    pipe.ubatch_callback = on_result
+    tik = time.monotonic()
+    _, stats = pipe.run([jnp.asarray(u, dtype=dtype if u.dtype.kind == 'f'
+                                     else None) for u in ubatches])
+    tok = time.monotonic()
+    _report(tik, tok, ubatches)
+
+
+def run_pipeline_spmd(args, stage_layers, stage_quant, ubatches, labels) -> None:
+    """SPMD pipeline: one XLA program, ppermute edges (block-aligned)."""
+    import jax
+    import jax.numpy as jnp
+
+    entry = registry.get_model_entry(args.model_name)
+    dtype = jnp.bfloat16 if args.dtype == 'bfloat16' else jnp.float32
+    total = registry.get_model_layers(args.model_name)
+    stage_params = []
+    for i, (l, r) in enumerate(stage_layers):
+        _, params, _ = registry.module_shard_factory(
+            args.model_name, args.model_file, l, r, stage=i, dtype=dtype)
+        stage_params.append(params)
+    mesh = spmd.make_pipeline_mesh(len(stage_layers))
+    quant_bit = stage_quant[0] if stage_quant else 0
+    pipe = spmd.build_spmd_pipeline(entry.family.FAMILY, entry.config,
+                                    stage_layers, stage_params, mesh,
+                                    quant_bit=quant_bit)
+    for lb in labels:
+        label_queue.put(lb)
+    inputs = jnp.asarray(np.stack(ubatches),
+                         dtype=dtype if ubatches[0].dtype.kind == 'f' else None)
+    pipe.run(inputs)  # compile + warmup
+    tik = time.monotonic()
+    outputs = np.asarray(pipe.run(inputs))
+    tok = time.monotonic()
+    for out in outputs:
+        handle_results(out)
+    _report(tik, tok, ubatches)
+
+
+def _report(tik, tok, ubatches):
+    batch_size = sum(len(u) for u in ubatches)
+    latency = tok - tik
+    throughput = batch_size / latency if latency > 0 else 0
+    logger.info("Latency: %f seconds", latency)
+    logger.info("Throughput: %f items/sec", throughput)
+    print(f"latency_sec={latency:.6f} throughput_items_sec={throughput:.3f}")
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Pipeline-parallel inference runtime (TPU-native)",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("rank", type=int, help="must be 0 (single controller)")
+    parser.add_argument("worldsize", type=int,
+                        help="number of pipeline stages (devices)")
+    parser.add_argument("-c", "--comm", type=str, default="host",
+                        choices=["host", "spmd", "p2p", "rpc"],
+                        help="pipeline driver; p2p/rpc are host aliases")
+    parser.add_argument("-m", "--model-name", type=str,
+                        default="google/vit-base-patch16-224",
+                        choices=registry.get_model_names())
+    parser.add_argument("-M", "--model-file", type=str,
+                        help="model weights file (.npz)")
+    parser.add_argument("-b", "--batch-size", default=64, type=int)
+    parser.add_argument("-u", "--ubatch-size", default=8, type=int)
+    parser.add_argument("-t", "--dtype", default="float32",
+                        choices=["float32", "bfloat16"])
+    # scheduling (reference runtime.py:657-687)
+    parser.add_argument("-pt", "--partition", type=str,
+                        help="comma-delimited layer pairs, e.g. '1,24,25,48'")
+    parser.add_argument("-q", "--quant", type=str,
+                        help="comma-delimited per-stage output quant bitwidths")
+    parser.add_argument("-r", "--rank-order", type=str, default=None,
+                        help="comma-delimited stage-to-device mapping")
+    parser.add_argument("-D", "--data-rank", type=int, default=0,
+                        help="accepted for compatibility; single-controller "
+                             "runtime always drives from the host")
+    parser.add_argument("-sm", "--sched-models-file", default=None, type=str)
+    parser.add_argument("-sdt", "--sched-dev-types-file", default=None, type=str)
+    parser.add_argument("-sd", "--sched-dev-file", default=None, type=str)
+    parser.add_argument("-H", "--hosts", type=str,
+                        help="comma-delimited hosts/chips for schedule mapping")
+    # dataset (reference runtime.py:688-705)
+    parser.add_argument("--dataset-name", type=str, default="synthetic",
+                        choices=["synthetic", "ImageNet", "CoLA"])
+    parser.add_argument("--dataset-root", type=str)
+    parser.add_argument("--dataset-split", default='val', type=str)
+    parser.add_argument("--dataset-indices-tsv", type=str,
+                        help="TSV file with dataset indices to use")
+    parser.add_argument("--dataset-shuffle", action="store_true")
+    args = parser.parse_args()
+
+    if args.rank != 0:
+        logger.warning("Single-controller runtime: only rank 0 runs; "
+                       "rank %d exits immediately (all devices are driven "
+                       "from rank 0)", args.rank)
+        return
+
+    partition = None
+    if args.partition:
+        nums = [int(x) for x in args.partition.split(',')]
+        assert len(nums) % 2 == 0
+        partition = list(zip(nums[::2], nums[1::2]))
+    quant = [int(x) for x in args.quant.split(',')] if args.quant else None
+    rank_order = [int(x) for x in args.rank_order.split(',')] \
+        if args.rank_order else None
+    hosts = args.hosts.split(',') if args.hosts else None
+    indices = None
+    if args.dataset_indices_tsv:
+        with open(args.dataset_indices_tsv) as f:
+            indices = [int(line.split('\t')[0]) for line in f if line.strip()]
+
+    stage_layers, stage_quant, stage_ranks = get_pipeline_sched(
+        args.worldsize, hosts, partition, quant, rank_order, args.model_name,
+        args.ubatch_size, args.sched_models_file, args.sched_dev_types_file,
+        args.sched_dev_file)
+
+    dataset = load_dataset(
+        {'name': args.dataset_name, 'root': args.dataset_root,
+         'split': args.dataset_split, 'indices': indices,
+         'shuffle': args.dataset_shuffle},
+        args.model_name, args.batch_size, args.ubatch_size)
+    ubatches, labels = [], []
+    for inputs, lbls in data_utils.batch_dataset(dataset, args.ubatch_size):
+        ubatches.append(inputs)
+        labels.append(lbls)
+
+    window_size = get_window_size()
+    monitoring.init(MONITORING_KEY_MODEL, window_size, work_type='items',
+                    acc_type='layers')
+    monitoring.add_key(MONITORING_KEY_OUTPUT, work_type='classifications',
+                       acc_type='correct')
+    monitoring.add_key(MONITORING_KEY_SEND, work_type='Mbits')
+    monitoring.add_key(MONITORING_KEY_RECV, work_type='Mbits')
+    monitoring.add_key(MONITORING_KEY_QUANT_ENCODE, acc_type='bits')
+    monitoring.add_key(MONITORING_KEY_QUANT_DECODE, acc_type='bits')
+
+    try:
+        comm = args.comm
+        if comm in ("p2p", "rpc"):
+            comm = "host"
+        if comm == "spmd":
+            try:
+                spmd.partition_to_blocks(stage_layers)
+            except ValueError as exc:
+                logger.warning("%s; falling back to host driver", exc)
+                comm = "host"
+        if comm == "spmd":
+            run_pipeline_spmd(args, stage_layers, stage_quant, ubatches, labels)
+        else:
+            run_pipeline_host(args, stage_layers, stage_quant, stage_ranks,
+                              ubatches, labels)
+        assert results_counter.wait_gte(
+            sum(len(u) for u in ubatches), timeout=300)
+    finally:
+        monitoring.finish()
+
+
+if __name__ == "__main__":
+    logging.basicConfig(
+        level=logging.INFO,
+        handlers=[logging.StreamHandler(sys.stdout),
+                  logging.FileHandler("runtime.log", mode='a')])
+    main()

@@ -1,0 +1,120 @@
+"""Runtime CLI integration tests (subprocess, 8 fake devices).
+
+Covers the reference's primary usage patterns (README.md:75-79): single-node
+degenerate, manual multi-stage partition, quantized edges, SPMD driver, and
+scheduler-driven auto-partitioning.
+"""
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+from pipeedge_tpu.sched.scheduler import _REPO_BUILD_PATHS
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MODEL = "pipeedge/test-tiny-vit"
+
+
+def _run(tmp_path, *extra, env_extra=None, timeout=300):
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=REPO)
+    if env_extra:
+        env.update(env_extra)
+    cmd = [sys.executable, os.path.join(REPO, "runtime.py")] + list(extra)
+    return subprocess.run(cmd, capture_output=True, env=env, cwd=str(tmp_path),
+                          timeout=timeout, text=True)
+
+
+def _throughput(proc) -> float:
+    for line in proc.stdout.splitlines():
+        if line.startswith("latency_sec="):
+            return float(line.split("throughput_items_sec=")[1])
+    raise AssertionError(f"no stats line in output:\n{proc.stdout}\n{proc.stderr}")
+
+
+def test_single_stage_degenerate(tmp_path):
+    proc = _run(tmp_path, "0", "1", "-m", MODEL, "-b", "4", "-u", "2")
+    assert proc.returncode == 0, proc.stderr
+    assert _throughput(proc) > 0
+    # monitoring CSVs created per key
+    assert (tmp_path / "shard.csv").exists()
+    assert (tmp_path / "output.csv").exists()
+
+
+def test_two_stage_host_with_quant(tmp_path):
+    proc = _run(tmp_path, "0", "2", "-m", MODEL, "-pt", "1,4,5,8",
+                "-q", "8,0", "-b", "4", "-u", "2")
+    assert proc.returncode == 0, proc.stderr
+    assert _throughput(proc) > 0
+
+
+def test_midblock_partition_host(tmp_path):
+    """Sublayer (mid-block) cuts: 2-tensor payload across the edge."""
+    proc = _run(tmp_path, "0", "3", "-m", MODEL, "-pt", "1,1,2,5,6,8",
+                "-b", "4", "-u", "2")
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_spmd_driver(tmp_path):
+    proc = _run(tmp_path, "0", "2", "-m", MODEL, "-pt", "1,4,5,8",
+                "-c", "spmd", "-b", "4", "-u", "2")
+    assert proc.returncode == 0, proc.stderr
+    assert _throughput(proc) > 0
+
+
+def test_spmd_falls_back_on_midblock_cut(tmp_path):
+    proc = _run(tmp_path, "0", "2", "-m", MODEL, "-pt", "1,5,6,8",
+                "-c", "spmd", "-b", "4", "-u", "2")
+    assert proc.returncode == 0, proc.stderr
+    assert "falling back to host driver" in proc.stderr + proc.stdout
+
+
+def test_nonzero_rank_exits(tmp_path):
+    proc = _run(tmp_path, "1", "2", "-m", MODEL)
+    assert proc.returncode == 0
+
+
+@pytest.mark.skipif(
+    not (os.path.exists(_REPO_BUILD_PATHS[0]) or shutil.which("sched-pipeline")),
+    reason="sched-pipeline binary not built")
+def test_scheduler_driven_partition(tmp_path):
+    # synthetic profile files for the tiny model over 2 identical chips
+    n = 8
+    models = {MODEL: {"layers": n, "parameters_in": 768,
+                      "parameters_out": [1000] * n, "mem_MB": [1.0] * n}}
+    types = {"chip": {"mem_MB": 1024, "bw_Mbps": 10000, "model_profiles": {
+        MODEL: [{"dtype": "torch.float32", "batch_size": 2,
+                 "time_s": [0.01] * n}]}}}
+    devs = {"chip": ["0", "1"]}
+    for fname, data in (("models.yml", models), ("device_types.yml", types),
+                        ("devices.yml", devs)):
+        with open(tmp_path / fname, "w") as f:
+            yaml.safe_dump(data, f, default_flow_style=None)
+    proc = _run(tmp_path, "0", "2", "-m", MODEL, "-u", "2", "-b", "4",
+                "-sm", "models.yml", "-sdt", "device_types.yml",
+                "-sd", "devices.yml")
+    assert proc.returncode == 0, proc.stderr
+    assert _throughput(proc) > 0
+
+
+def test_adaptive_quant_heuristic(tmp_path):
+    proc = _run(tmp_path, "0", "2", "-m", MODEL, "-pt", "1,4,5,8",
+                "-q", "8,0", "-b", "12", "-u", "2",
+                env_extra={"ADAPTIVE_QUANT": "HEURISTIC",
+                           "SEND_CONSTRAINT": "100", "WINDOW_SIZE": "3"})
+    assert proc.returncode == 0, proc.stderr
+    assert "Adaptive quantization" in proc.stderr + proc.stdout
+
+
+def test_adaptive_quant_controller(tmp_path):
+    proc = _run(tmp_path, "0", "2", "-m", MODEL, "-pt", "1,4,5,8",
+                "-q", "8,0", "-b", "12", "-u", "2",
+                env_extra={"ADAPTIVE_QUANT": "CONTROLLER",
+                           "SEND_CONSTRAINT": "50", "WINDOW_SIZE": "3"})
+    assert proc.returncode == 0, proc.stderr
+    assert "Adaptive quantization" in proc.stderr + proc.stdout
