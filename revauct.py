@@ -1,0 +1,189 @@
+"""Pipeline Reverse Auction Scheduler CLI.
+
+Parity with /root/reference/revauct.py: every device bids its feasible shards
+(from its profiles) and neighbor bandwidths; the auctioneer filters/orders
+bids and runs a latency-, throughput-, or host-count-optimizing scheduler,
+printing the 1-indexed schedule YAML.
+
+Single-controller adaptation: the reference fans the bid request out over
+torch RPC to one process per device (revauct.py:168-180). Here all device
+configs (device_types.yml + devices.yml + device_neighbors_world.yml) are
+local, so bids are gathered with a thread pool — same fan-out/fan-in shape,
+no network bring-up. Chips/hosts in the YAML play the role of ranks.
+"""
+import argparse
+import logging
+import random
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional, Tuple
+
+import yaml
+
+from pipeedge_tpu.models import registry
+from pipeedge_tpu.sched import revauct, yaml_files
+
+logger = logging.getLogger(__name__)
+
+
+def _find_profiles(yml_models, yml_dev_types, dev_type, model: str,
+                   ubatch_size: int, dtype: str) -> Tuple:
+    """Locate (model, device type, matching model profile) in the YAML config
+    (reference revauct.py:40-64)."""
+    yml_model = yml_models.get(model)
+    yml_dev_type = yml_dev_types.get(dev_type)
+    yml_dtm_profile = None
+    if yml_dev_type is not None:
+        for prof in (yml_dev_type.get('model_profiles') or {}).get(model, []):
+            if prof['dtype'] == dtype and prof['batch_size'] == ubatch_size:
+                yml_dtm_profile = prof
+                break
+    return yml_model, yml_dev_type, yml_dtm_profile
+
+
+def bid_latency_for_host(host: str, dev_type: str, cfg: dict, model: str,
+                         ubatch_size: int, dtype: str = 'torch.float32'):
+    """One device's auction response: (host, (shards, costs, neighbors)) —
+    the payload shape of the reference's RPC handler (revauct.py:68-87)."""
+    t_start = time.time()
+    yml_model, yml_dev_type, yml_dtm_profile = _find_profiles(
+        cfg['yml_models'], cfg['yml_dev_types'], dev_type, model, ubatch_size,
+        dtype)
+    shards, costs = [], []
+    if yml_model is not None and yml_dev_type is not None and \
+            yml_dtm_profile is not None:
+        for shard, cost in revauct.bid_latency(yml_model, yml_dev_type,
+                                               yml_dtm_profile, ubatch_size,
+                                               dtype=dtype):
+            shards.append(shard)
+            costs.append(cost)
+    neighbors = cfg['yml_dev_neighbors_world'].get(host, {})
+    logger.debug("Reverse auction bid time (ms): %f",
+                 1000 * (time.time() - t_start))
+    return host, (shards, costs, neighbors)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(
+        description="Pipeline Reverse Auction Scheduler",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("rank", type=int, help="must be 0 (single controller)")
+    parser.add_argument("worldsize", type=int,
+                        help="number of devices to auction over (<= hosts in "
+                             "the neighbors world file)")
+    devcfg = parser.add_argument_group('Device configuration')
+    devcfg.add_argument("-sm", "--sched-models-file", default='models.yml')
+    devcfg.add_argument("-sdt", "--sched-dev-types-file",
+                        default='device_types.yml')
+    devcfg.add_argument("-sd", "--sched-dev-file", default='devices.yml',
+                        help="device types to hosts mapping YAML file")
+    devcfg.add_argument("-sdnw", "--sched-dev-neighbors-world",
+                        default='device_neighbors_world.yml')
+    devcfg.add_argument("-D", "--data-host", type=str, default=None,
+                        help="host where inputs are loaded and outputs "
+                             "processed; default: first host")
+    modcfg = parser.add_argument_group('Model configuration')
+    modcfg.add_argument("-m", "--model-name", type=str,
+                        default="google/vit-base-patch16-224",
+                        choices=registry.get_model_names())
+    modcfg.add_argument("-u", "--ubatch-size", default=8, type=int)
+    schcfg = parser.add_argument_group('Additional scheduler options')
+    schcfg.add_argument("--filter-bids-chunk", type=int, default=1)
+    schcfg.add_argument("--filter-bids-largest", action='store_true')
+    schcfg.add_argument("-sch", "--scheduler", default="latency_ordered",
+                        choices=["latency_ordered", "throughput_ordered",
+                                 "greedy_host_count"])
+    schcfg.add_argument("-d", "--dev-count", type=int, default=None)
+    schcfg.add_argument("--no-strict-order", action='store_true')
+    schcfg.add_argument("--strict-first", action='store_true')
+    schcfg.add_argument("--strict-last", action='store_true')
+    schcfg.add_argument("--seed", type=int, default=None,
+                        help="seed the device-order shuffle")
+    args = parser.parse_args()
+
+    if args.rank != 0:
+        logger.info("Single-controller auction: rank %d idle", args.rank)
+        return
+
+    cfg = {
+        'yml_models': yaml_files.yaml_models_load(args.sched_models_file),
+        'yml_dev_types': yaml_files.yaml_device_types_load(
+            args.sched_dev_types_file),
+        'yml_dev_neighbors_world': yaml_files.yaml_device_neighbors_world_load(
+            args.sched_dev_neighbors_world),
+    }
+    host_types = {}
+    for dev_type, hosts in yaml_files.yaml_devices_load(
+            args.sched_dev_file).items():
+        for host in hosts:
+            host_types[host] = dev_type
+
+    hosts = list(cfg['yml_dev_neighbors_world'].keys())[:args.worldsize]
+    yml_model = cfg['yml_models'][args.model_name]
+    dtype = 'torch.float32'
+
+    # fan out bid requests (thread pool replaces the reference's RPC fan-out)
+    t_start = time.time()
+    with ThreadPoolExecutor() as pool:
+        futs = [pool.submit(bid_latency_for_host, host,
+                            host_types.get(host, ''), cfg, args.model_name,
+                            args.ubatch_size, dtype) for host in hosts]
+        bids_in_order = [f.result() for f in futs]
+    logger.debug("Reverse auction total time (ms): %f",
+                 1000 * (time.time() - t_start))
+    bid_data_by_host = {
+        host: ({tuple(s): c for s, c in zip(payload[0], payload[1])}, payload[2])
+        for host, payload in bids_in_order}
+
+    if args.filter_bids_chunk > 1:
+        bid_data_by_host = {
+            h: (revauct.filter_bids_chunk(yml_model, b[0],
+                                          chunk=args.filter_bids_chunk), b[1])
+            for h, b in bid_data_by_host.items()}
+    if args.filter_bids_largest:
+        bid_data_by_host = {h: (revauct.filter_bids_largest(b[0]), b[1])
+                            for h, b in bid_data_by_host.items()}
+
+    data_host = args.data_host if args.data_host else hosts[0]
+    dev_order = list(bid_data_by_host.keys())
+    rng = random.Random(args.seed)
+    rng.shuffle(dev_order)
+    dev_order = dev_order[:args.dev_count]
+    for idx, dev in enumerate(dev_order):
+        if dev == data_host:
+            dev_order[0], dev_order[idx] = dev_order[idx], dev_order[0]
+    logger.info("Device order: %s", dev_order)
+
+    strict_order = not args.no_strict_order
+    schedule = []
+    t_start = time.time()
+    if args.scheduler == 'latency_ordered':
+        schedule, pred = revauct.sched_optimal_latency_dev_order(
+            yml_model, args.ubatch_size, dtype, bid_data_by_host, data_host,
+            data_host, dev_order, strict_order=strict_order,
+            strict_first=args.strict_first, strict_last=args.strict_last)
+        logger.info("Latency prediction (sec): %s", pred)
+    elif args.scheduler == 'throughput_ordered':
+        schedule, pred = revauct.sched_optimal_throughput_dev_order(
+            yml_model, args.ubatch_size, dtype, bid_data_by_host, data_host,
+            data_host, dev_order, strict_order=strict_order,
+            strict_first=args.strict_first, strict_last=args.strict_last)
+        logger.info("Throughput prediction (items/sec): %s", pred)
+    else:
+        schedule = revauct.sched_greedy_host_count(
+            yml_model, args.ubatch_size, dtype, bid_data_by_host, data_host,
+            data_host)
+    logger.info("Scheduler function runtime (sec): %s", time.time() - t_start)
+    logger.info("Schedule stages: %d", len(schedule))
+
+    # shift to the runtime's 1-based layer numbering (reference revauct.py:233-235)
+    sched_compat = [{host: [l + 1 for l in layers]
+                     for host, layers in part.items()} for part in schedule]
+    logger.info("Schedule:")
+    print(yaml.safe_dump(sched_compat, default_flow_style=None,
+                         sort_keys=False))
+
+
+if __name__ == "__main__":
+    logging.basicConfig(format='%(message)s', level=logging.INFO)
+    main()

@@ -1,0 +1,173 @@
+"""Reverse-auction scheduler tests (library + CLI).
+
+The reference ships these schedulers untested (SURVEY.md §4); here the DAG
+schedulers are validated against hand-computed optima on small instances.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+from pipeedge_tpu.sched import revauct, yaml_types
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DTYPE = 'torch.float32'
+UB = 1
+
+
+def _model(n, params_out=100, mem_mb=1.0):
+    return {'layers': n, 'parameters_in': 100,
+            'parameters_out': [params_out] * n, 'mem_MB': [mem_mb] * n}
+
+
+def _dev_type(mem_mb, bw, time_s):
+    return yaml_types.yaml_device_type(
+        mem_mb, bw, {'m': [yaml_types.yaml_model_profile(DTYPE, UB, time_s)]})
+
+
+def _bids_for(model, dev_types, neighbors):
+    """host -> (shard bid dict, neighbor links)."""
+    out = {}
+    for host, tname in dev_types.items():
+        prof = tname['model_profiles']['m'][0]
+        shard_bids = dict(revauct.bid_latency(model, tname, prof, UB, DTYPE))
+        out[host] = (shard_bids, neighbors.get(host, {}))
+    return out
+
+
+def test_bid_latency_memory_filter():
+    model = _model(4, mem_mb=100.0)
+    small = _dev_type(250, 1000, [0.1] * 4)   # fits at most 2 layers
+    bids = revauct.bid_latency(model, small, small['model_profiles']['m'][0], UB)
+    assert bids
+    assert all(r - l <= 1 for (l, r), _ in bids)
+    big = _dev_type(100000, 1000, [0.1] * 4)
+    bids = revauct.bid_latency(model, big, big['model_profiles']['m'][0], UB)
+    assert ((0, 3), pytest.approx(0.4)) in [(s, c) for s, c in bids]
+
+
+def test_filter_bids_chunk_and_largest():
+    model = _model(8)
+    bids = {(0, 3): 1.0, (0, 7): 2.0, (1, 3): 0.5, (4, 7): 1.0, (4, 5): 0.6,
+            (0, 5): 1.5}
+    chunked = revauct.filter_bids_chunk(model, bids, chunk=4)
+    assert set(chunked) == {(0, 3), (0, 7), (4, 7)}
+    largest = revauct.filter_bids_largest(bids)
+    assert set(largest) == {(0, 7), (1, 3), (4, 7)}
+
+
+def test_greedy_host_count():
+    model = _model(4, mem_mb=100.0)
+    types = {'a': _dev_type(250, 1000, [0.1] * 4),
+             'b': _dev_type(250, 1000, [0.2] * 4),
+             'c': _dev_type(250, 1000, [0.3] * 4)}
+    neighbors = {h: {o: {'bw_Mbps': 1000} for o in types if o != h}
+                 for h in types}
+    bids = _bids_for(model, types, neighbors)
+    sched = revauct.sched_greedy_host_count(model, UB, DTYPE, bids, 'a', 'a')
+    covered = []
+    for stage in sched:
+        for _, layers in stage.items():
+            if layers:
+                covered.extend(range(layers[0], layers[1] + 1))
+    assert covered == list(range(4))
+    # data host 'a' holds the first shard
+    assert list(sched[0].keys()) == ['a']
+
+
+def test_optimal_latency_picks_fast_path():
+    """Two orderings of the same 2-layer model: the optimum must assign the
+    whole model to the fast device when memory allows."""
+    model = _model(2, mem_mb=1.0)
+    types = {'fast': _dev_type(1024, 1000, [0.01, 0.01]),
+             'slow': _dev_type(1024, 1000, [10.0, 10.0])}
+    neighbors = {'fast': {'slow': {'bw_Mbps': 1000}},
+                 'slow': {'fast': {'bw_Mbps': 1000}}}
+    bids = _bids_for(model, types, neighbors)
+    sched, cost = revauct.sched_optimal_latency_dev_order(
+        model, UB, DTYPE, bids, 'fast', 'fast', ['fast', 'slow'],
+        strict_order=True, strict_first=False, strict_last=False)
+    assert sched == [{'fast': [0, 1]}]
+    assert cost == pytest.approx(0.02)
+
+
+def test_optimal_latency_splits_when_memory_forces():
+    model = _model(4, mem_mb=100.0)
+    types = {'h0': _dev_type(250, 1000, [0.1] * 4),
+             'h1': _dev_type(250, 1000, [0.1] * 4),
+             'h2': _dev_type(250, 1000, [0.1] * 4)}
+    neighbors = {h: {o: {'bw_Mbps': 1000} for o in types if o != h}
+                 for h in types}
+    bids = _bids_for(model, types, neighbors)
+    sched, cost = revauct.sched_optimal_latency_dev_order(
+        model, UB, DTYPE, bids, 'h0', 'h0', ['h0', 'h1', 'h2'],
+        strict_order=False, strict_first=False, strict_last=False)
+    covered = []
+    for stage in sched:
+        for _, layers in stage.items():
+            if layers:
+                covered.extend(range(layers[0], layers[1] + 1))
+    assert covered == list(range(4))
+    assert cost < float('inf')
+
+
+def test_optimal_throughput_minimizes_bottleneck():
+    """Throughput objective must prefer even stage split over uneven."""
+    model = _model(4, mem_mb=100.0)
+    types = {'h0': _dev_type(350, 1000, [0.1] * 4),   # fits up to 3 layers
+             'h1': _dev_type(350, 1000, [0.1] * 4)}
+    neighbors = {h: {o: {'bw_Mbps': 100000} for o in types if o != h}
+                 for h in types}
+    bids = _bids_for(model, types, neighbors)
+    sched, tput = revauct.sched_optimal_throughput_dev_order(
+        model, UB, DTYPE, bids, 'h0', 'h0', ['h0', 'h1'],
+        strict_order=True, strict_first=False, strict_last=False)
+    stages = [layers for stage in sched for _, layers in stage.items() if layers]
+    sizes = sorted(r - l + 1 for l, r in stages)
+    assert sizes == [2, 2]          # even split: bottleneck 0.2s
+    assert tput == pytest.approx(1 / 0.2, rel=1e-6)
+
+
+def test_no_path_returns_empty():
+    model = _model(2, mem_mb=10000.0)  # doesn't fit anywhere
+    types = {'h0': _dev_type(100, 1000, [0.1, 0.1])}
+    bids = _bids_for(model, types, {'h0': {}})
+    sched, cost = revauct.sched_optimal_latency_dev_order(
+        model, UB, DTYPE, bids, 'h0', 'h0', ['h0'])
+    assert sched == []
+    assert cost == float('inf')
+
+
+def test_revauct_cli(tmp_path):
+    n = 8
+    models = {"pipeedge/test-tiny-vit": {
+        "layers": n, "parameters_in": 768, "parameters_out": [1000] * n,
+        "mem_MB": [50.0] * n}}
+    types = {"chip": {"mem_MB": 300, "bw_Mbps": 10000, "model_profiles": {
+        "pipeedge/test-tiny-vit": [{"dtype": DTYPE, "batch_size": 2,
+                                    "time_s": [0.01] * n}]}}}
+    devs = {"chip": ["c0", "c1", "c2"]}
+    neighbors = {h: {o: {"bw_Mbps": 10000} for o in ["c0", "c1", "c2"] if o != h}
+                 for h in ["c0", "c1", "c2"]}
+    for fname, data in (("models.yml", models), ("device_types.yml", types),
+                        ("devices.yml", devs),
+                        ("device_neighbors_world.yml", neighbors)):
+        with open(tmp_path / fname, "w") as f:
+            yaml.safe_dump(data, f, default_flow_style=None)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "revauct.py"), "0", "3",
+         "-m", "pipeedge/test-tiny-vit", "-u", "2", "--seed", "0",
+         "-sch", "throughput_ordered", "--no-strict-order"],
+        capture_output=True, env=env, cwd=str(tmp_path), text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    sched = yaml.safe_load(proc.stdout)
+    covered = []
+    for stage in sched:
+        for _, layers in stage.items():
+            if layers:
+                covered.extend(range(layers[0], layers[1] + 1))
+    assert covered == list(range(1, n + 1))  # 1-based in CLI output
