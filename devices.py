@@ -1,0 +1,33 @@
+"""Common device configuration (parity with /root/reference/devices.py).
+
+The reference keeps a global torch device and hook functions that shuttle
+activations device<->CPU around every shard, because its transport is
+CPU-side TCP (devices.py:8-24). Under JAX the transport IS on-device
+(ppermute/device_put), so no shuttling hooks exist; this module only resolves
+device specs ('tpu', 'cpu', 'tpu:1') to `jax.Device` handles.
+"""
+from typing import List, Optional
+
+import jax
+
+DEVICE: Optional[jax.Device] = None
+
+
+def get_devices(spec: Optional[str] = None) -> List[jax.Device]:
+    """Resolve a device spec to the jax devices to run on.
+
+    None -> all default-backend devices; 'cpu'/'tpu' -> that platform's
+    devices; 'tpu:1' -> single device by ordinal.
+    """
+    if spec is None:
+        return jax.devices()
+    if ':' in spec:
+        platform, ordinal = spec.split(':', 1)
+        return [jax.devices(platform)[int(ordinal)]]
+    return jax.devices(spec)
+
+
+def set_device(spec: Optional[str]) -> None:
+    """Set the module-global default device (reference devices.py:6)."""
+    global DEVICE  # pylint: disable=global-statement
+    DEVICE = None if spec is None else get_devices(spec)[0]

@@ -1,0 +1,38 @@
+"""Build bert_input.npz: evaluation sentences + labels for the BERT models.
+
+Parity with /root/reference/tools/bert_save_input.py:8-18: 512 GLUE CoLA
+training sentences with index 0 replaced by a width-forcing 512-token string.
+Falls back to synthetic sentences when the datasets cache is unavailable
+(zero egress).
+"""
+import logging
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def build(n: int = 512):
+    try:
+        import datasets
+        ds_train = datasets.load_dataset('glue', name='cola', split='train')
+        bert_input = ds_train[:n]['sentence']
+        bert_label = ds_train[:n]['label']
+    except Exception as exc:
+        logger.warning("GLUE CoLA unavailable (%s); generating synthetic "
+                       "sentences", exc)
+        rng = np.random.default_rng(0)
+        words = ["the", "model", "runs", "fast", "on", "tpu", "chips",
+                 "with", "pipeline", "stages"]
+        bert_input = [" ".join(rng.choice(words, size=rng.integers(4, 16)))
+                      for _ in range(n)]
+        bert_label = rng.integers(0, 2, size=n).tolist()
+    # index 0 forces the tokenizer to produce width-512 input_ids
+    bert_input[0] = 'hello ' * 512
+    bert_label[0] = 0
+    np.savez('bert_input.npz', input=bert_input, label=bert_label)
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    build()

@@ -1,0 +1,45 @@
+"""Generate tc-qdisc bandwidth-throttling ansible playbooks for experiments.
+
+Parity with /root/reference/tools/create_bandwidth_playbook.py:7-36: for each
+requested bandwidth, emit add/delete playbooks per host group that shape the
+NIC with a token bucket filter. Used to emulate constrained DCN links when
+benchmarking adaptive quantization.
+"""
+import argparse
+
+
+def tc_command(bandwidth, action, ifname="eth0"):
+    command = (f"sudo tc qdisc {action} dev {ifname} root tbf "
+               f"rate {bandwidth}mbit burst 32kbit latency 20ms")
+    print(command)
+    return command
+
+
+def write_playbook(path, host_group, command):
+    with open(path, "w") as script:
+        script.write(f"- hosts: {host_group}\n")
+        script.write("  tasks:\n")
+        script.write("    - name: add bandwidth limitation\n")
+        script.write(f"      shell: {command}\n\n")
+
+
+def create_scripts(bandwidths, host_groups, ifname):
+    for bw in bandwidths:
+        for group in host_groups:
+            write_playbook(f"bw_{bw}mbps_20ms_add_{group}.yml", group,
+                           tc_command(bw, "add", ifname))
+            write_playbook(f"bw_{bw}mbps_20ms_delete_{group}.yml", group,
+                           tc_command(bw, "delete", ifname))
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="Create bandwidth-throttling ansible playbooks")
+    parser.add_argument("-bd", "--bandwidth", type=str, required=True,
+                        help="comma-delimited bandwidths in Mbps")
+    parser.add_argument("-g", "--groups", type=str, default="m,n",
+                        help="comma-delimited ansible host groups")
+    parser.add_argument("-i", "--ifname", type=str, default="eth0")
+    args = parser.parse_args()
+    create_scripts(args.bandwidth.split(','), args.groups.split(','),
+                   args.ifname)

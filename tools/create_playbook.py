@@ -1,0 +1,64 @@
+"""Generate an ansible playbook that launches the runtime across a fleet.
+
+Parity with /root/reference/tools/create_playbook.py:23-39. In the
+single-controller TPU world one host drives a whole slice, so the playbook
+has one task per *controller host* (each owning its slice) instead of one
+task per rank; the generated commands invoke this repo's runtime.py.
+"""
+import argparse
+
+
+def create_python_command(file_name, rank, world_size, partition, model_name,
+                          batch_size, ubatch_size, comm):
+    command = (f"python3 {file_name} {rank} {world_size} -m {model_name} "
+               f"-pt {partition} -b {batch_size} -u {ubatch_size} -c {comm}")
+    print(command)
+    return command
+
+
+def create_shell_command(script, node_name, command, write_async=True,
+                         task_name="runtime"):
+    script.write(f"- hosts: {node_name}\n")
+    script.write("  tasks:\n")
+    script.write(f"    - name: {task_name}\n")
+    script.write(f"      shell: {command}\n")
+    if write_async:
+        script.write("      async: 10000\n")
+        script.write("      poll: 0\n")
+    script.write("\n")
+
+
+def create_script(script_name, node_list, file_name, world_size, partition,
+                  model_name, batch_size, ubatch_size, comm):
+    with open(script_name, "w") as script:
+        for idx, node in enumerate(node_list):
+            command = create_python_command(file_name, 0, world_size, partition,
+                                            model_name, batch_size, ubatch_size,
+                                            comm)
+            # last task runs synchronously so ansible waits for completion
+            create_shell_command(script, node, command,
+                                 write_async=idx != len(node_list) - 1)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="Create ansible playbook yml script")
+    parser.add_argument("-wz", "--world-size", type=int, required=True,
+                        help="number of pipeline stages per controller")
+    parser.add_argument("-f", "--file-name", type=str, default="runtime.py")
+    parser.add_argument("-m", "--model-name", type=str,
+                        default="google/vit-base-patch16-224")
+    parser.add_argument("-pt", "--partition", type=str, default="1,48")
+    parser.add_argument("-b", "--batch-size", default=64, type=int)
+    parser.add_argument("-u", "--ubatch-size", default=8, type=int)
+    parser.add_argument("-c", "--comm", default="spmd",
+                        choices=["spmd", "host"])
+    parser.add_argument("-nz", "--nodes", type=str, required=True,
+                        help="comma-delimited controller host names")
+    parser.add_argument("-sn", "--script-name", default="playbook.yml")
+    args = parser.parse_args()
+
+    nodes = args.nodes.split(',')
+    create_script(args.script_name, nodes, args.file_name, args.world_size,
+                  args.partition, args.model_name, args.batch_size,
+                  args.ubatch_size, args.comm)

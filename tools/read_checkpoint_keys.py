@@ -1,0 +1,27 @@
+"""Dump checkpoint keys and shapes (reference tools/read_pth_files.py).
+
+Supports the framework's npz weights files and torch .pth checkpoints.
+"""
+import argparse
+
+import numpy as np
+
+
+def main():
+    parser = argparse.ArgumentParser(description="List checkpoint keys/shapes")
+    parser.add_argument("file", type=str, help=".npz or .pth checkpoint")
+    args = parser.parse_args()
+    if args.file.endswith(".pth") or args.file.endswith(".pt"):
+        import torch
+        net = torch.load(args.file, map_location="cpu")
+        state = net.get("model", net) if isinstance(net, dict) else net
+        for key, value in state.items():
+            print(key, tuple(value.size()), sep="   ")
+    else:
+        with np.load(args.file) as weights:
+            for key in weights.files:
+                print(key, weights[key].shape, sep="   ")
+
+
+if __name__ == "__main__":
+    main()
