@@ -1,0 +1,150 @@
+"""Sequence/context parallelism: ring attention and Ulysses-style all-to-all.
+
+NEW capability beyond the reference: PipeEdge only ever splits the layer axis
+and tops out at 512 tokens (SURVEY.md §5.7 — no ring/blockwise/Ulysses
+anywhere). For long contexts the sequence axis must shard across chips; this
+module provides both standard formulations, built on XLA collectives over a
+`shard_map` mesh axis so the communication rides ICI:
+
+- `ring_attention`: each chip holds a query/key/value sequence chunk; K/V
+  chunks rotate around the ring via `lax.ppermute` while a streaming
+  (log-sum-exp) softmax accumulates partial attention — memory per chip is
+  O(S/n * S/n) for scores, O(S/n) for state, so sequence length scales
+  linearly with chip count. Compute of block t overlaps the transfer of
+  block t+1 (XLA schedules the ppermute asynchronously).
+- `ulysses_attention`: all-to-all swaps sequence sharding for head sharding,
+  runs exact local attention per head group, and swaps back. Cheaper when
+  heads >= chips; two all-to-alls instead of n-1 permutes.
+
+Both are exact (match full attention to float tolerance) and support causal
+masking with global position offsets.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _block_attention(q, k, v, m_prev, l_prev, acc_prev, q_offset, k_offset,
+                     causal: bool, scale: float):
+    """One streaming-softmax block update.
+
+    q: [B, Sq, H, D]; k/v: [B, Sk, H, D]; running (max, sum, acc) over the
+    key axis. Scores/stats in float32 regardless of input dtype.
+    """
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        q_pos = q_offset + jnp.arange(sq)
+        k_pos = k_offset + jnp.arange(sk)
+        mask = q_pos[:, None] >= k_pos[None, :]
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    m_blk = jnp.max(scores, axis=-1)                       # [B, H, Sq]
+    m_new = jnp.maximum(m_prev, m_blk)
+    # guard fully-masked rows (m == -inf) against NaN from exp(-inf - -inf)
+    safe_m = jnp.where(jnp.isinf(m_new), 0.0, m_new)
+    p = jnp.exp(jnp.where(jnp.isinf(scores), -jnp.inf, scores) -
+                safe_m[..., None])
+    corr = jnp.exp(jnp.where(jnp.isinf(m_prev), -jnp.inf, m_prev) - safe_m)
+    l_new = l_prev * corr + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32)
+    acc_new = acc_prev * corr.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, acc_new
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
+                   causal: bool = False) -> jax.Array:
+    """Exact attention over a ring-sharded sequence axis.
+
+    Call inside `shard_map` with q/k/v local chunks [B, S/n, H, D] sharded on
+    the sequence axis `axis_name`. Returns the local output chunk.
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    chunk = k.shape[1]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    m0 = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    acc0 = jnp.zeros((b, sq, h, d), jnp.float32)
+    q_offset = idx * sq
+
+    def step(t, carry):
+        m, l, acc, k_cur, v_cur = carry
+        # K/V block t originated on ring neighbor (idx - t) mod n
+        k_offset = ((idx - t) % n) * chunk
+        m, l, acc = _block_attention(q, k_cur, v_cur, m, l, acc, q_offset,
+                                     k_offset, causal, scale)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return m, l, acc, k_nxt, v_nxt
+
+    m, l, acc, _, _ = jax.lax.fori_loop(0, n, step, (m0, l0, acc0, k, v))
+    l = jnp.where(l == 0, 1.0, l)  # fully-masked rows output zeros
+    out = acc / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str, causal: bool = False) -> jax.Array:
+    """Exact attention via all-to-all head<->sequence resharding.
+
+    Inside `shard_map`: inputs are sequence-sharded [B, S/n, H, D]; an
+    all-to-all regroups to head-sharded [B, S, H/n, D], local full attention
+    runs per head group, and the inverse all-to-all restores sequence
+    sharding. Requires H % n == 0.
+    """
+    n = jax.lax.axis_size(axis_name)
+    b, s_local, h, d = q.shape
+    assert h % n == 0, "ulysses requires head count divisible by axis size"
+    scale = 1.0 / (d ** 0.5)
+
+    def to_heads(x):  # [B, S/n, H, D] -> [B, S, H/n, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def to_seq(x):    # [B, S, H/n, D] -> [B, S/n, H, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", qh, kh,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        s_total = s_local * n
+        pos = jnp.arange(s_total)
+        mask = pos[:, None] >= pos[None, :]
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(vh.dtype)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, vh,
+                     preferred_element_type=jnp.float32).astype(q.dtype)
+    return to_seq(ctx)
+
+
+def make_sequence_parallel_attention(mesh: Mesh, axis_name: str = "sp",
+                                     kind: str = "ring",
+                                     causal: bool = False):
+    """Build a jitted `fn(q, k, v) -> out` over globally-shaped [B, S, H, D]
+    arrays with the sequence axis sharded over `axis_name`."""
+    inner = ring_attention if kind == "ring" else ulysses_attention
+    spec = P(None, axis_name)
+
+    @jax.jit
+    def fn(q, k, v):
+        return jax.shard_map(
+            partial(inner, axis_name=axis_name, causal=causal),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)(q, k, v)
+
+    def place(x):
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return lambda q, k, v: fn(place(q), place(k), place(v))

@@ -1,0 +1,106 @@
+"""Tensor parallelism: Megatron-style within-stage sharding of a block.
+
+NEW capability beyond the reference (SURVEY.md §2.4: PipeEdge has no TP).
+A transformer block's attention heads and MLP hidden dimension shard over a
+mesh axis: q/k/v and MLP-up kernels column-split (no communication), the
+attention-output and MLP-down kernels row-split, followed by one `psum` each
+— the canonical 2-allreduce-per-block layout that keeps every matmul dense
+on the local MXU.
+
+Composes with the pipeline: a ('tp',)-sharded block runs inside one pipeline
+stage, so a ('dp', 'stage', 'tp') mesh gives dp x pp x tp.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.layers import TransformerConfig, gelu, layer_norm
+
+
+def shard_vit_block_params(params: Dict, mesh: Mesh, axis: str = "tp") -> Dict:
+    """Place one ViT/DeiT block's params with Megatron TP sharding.
+
+    Column-parallel (out-dim sharded): q/k/v, mlp_up. Row-parallel (in-dim
+    sharded): attn_out, mlp_down. LayerNorms replicated.
+    """
+    def put(x, spec):
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    out = {}
+    for name in ("q", "k", "v"):
+        out[name] = {"w": put(params[name]["w"], P(None, axis)),
+                     "b": put(params[name]["b"], P(axis))}
+    out["attn_out"] = {"w": put(params["attn_out"]["w"], P(axis, None)),
+                       "b": put(params["attn_out"]["b"], P())}
+    out["mlp_up"] = {"w": put(params["mlp_up"]["w"], P(None, axis)),
+                     "b": put(params["mlp_up"]["b"], P(axis))}
+    out["mlp_down"] = {"w": put(params["mlp_down"]["w"], P(axis, None)),
+                       "b": put(params["mlp_down"]["b"], P())}
+    for ln in ("ln_before", "ln_after"):
+        out[ln] = {k: put(v, P()) for k, v in params[ln].items()}
+    return out
+
+
+def _tp_block_local(p: Dict, x: jax.Array, cfg: TransformerConfig,
+                    axis: str) -> jax.Array:
+    """Per-device block body under shard_map: local head/hidden slices +
+    two psums. `x` is replicated across the tp axis."""
+    n = jax.lax.axis_size(axis)
+    heads_local = cfg.num_attention_heads // n
+    b, s, d = x.shape
+    hd = cfg.head_dim
+
+    normed = layer_norm(p["ln_before"], x, cfg.layer_norm_eps)
+
+    def proj(name):
+        w = p[name]["w"]  # [D, D/n] local column slice
+        y = jnp.dot(normed, w.astype(x.dtype),
+                    preferred_element_type=jnp.float32) + p[name]["b"]
+        return y.astype(x.dtype).reshape(b, s, heads_local, hd)
+
+    q, k, v = proj("q"), proj("k"), proj("v")
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) / jnp.sqrt(
+                            jnp.float32(hd))
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v,
+                     preferred_element_type=jnp.float32).astype(x.dtype)
+    ctx = ctx.reshape(b, s, heads_local * hd)
+    # row-parallel output projection: partial products summed across devices
+    attn = jnp.dot(ctx, p["attn_out"]["w"].astype(x.dtype),
+                   preferred_element_type=jnp.float32)
+    attn = jax.lax.psum(attn, axis) + p["attn_out"]["b"]
+    x = attn.astype(x.dtype) + x
+
+    normed = layer_norm(p["ln_after"], x, cfg.layer_norm_eps)
+    up = jnp.dot(normed, p["mlp_up"]["w"].astype(x.dtype),
+                 preferred_element_type=jnp.float32) + p["mlp_up"]["b"]
+    hidden = gelu(up.astype(x.dtype))
+    down = jnp.dot(hidden, p["mlp_down"]["w"].astype(x.dtype),
+                   preferred_element_type=jnp.float32)
+    down = jax.lax.psum(down, axis) + p["mlp_down"]["b"]
+    return down.astype(x.dtype) + x
+
+
+def make_tp_block_fn(cfg: TransformerConfig, mesh: Mesh, axis: str = "tp"):
+    """Jitted `fn(sharded_params, x) -> x` running one full transformer block
+    with tensor parallelism over `axis`. `x` is replicated."""
+    param_specs = {
+        "q": {"w": P(None, axis), "b": P(axis)},
+        "k": {"w": P(None, axis), "b": P(axis)},
+        "v": {"w": P(None, axis), "b": P(axis)},
+        "attn_out": {"w": P(axis, None), "b": P()},
+        "mlp_up": {"w": P(None, axis), "b": P(axis)},
+        "mlp_down": {"w": P(axis, None), "b": P()},
+        "ln_before": {"scale": P(), "bias": P()},
+        "ln_after": {"scale": P(), "bias": P()},
+    }
+    body = jax.shard_map(partial(_tp_block_local, cfg=cfg, axis=axis),
+                         mesh=mesh, in_specs=(param_specs, P()),
+                         out_specs=P(), check_vma=False)
+    return jax.jit(body)
