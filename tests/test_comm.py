@@ -1,0 +1,63 @@
+"""Comm context lifecycle tests.
+
+Mirrors the reference's only comm tests — world_size=1 context init/shutdown
+and context-manager reuse (test/comm/p2p/test_context.py:23-40,
+test/comm/rpc/test_context.py:13-29) — plus command-plane delivery, which the
+reference never tests.
+"""
+import threading
+import time
+
+from pipeedge_tpu.comm import (CMD_SCHED, CMD_STOP, CommandPlane, DistContext,
+                               MultiHostContext, SliceContext)
+
+
+def test_dist_context_lifecycle():
+    ctx = DistContext(world_size=1, rank=0)
+    assert not ctx.initialized
+    ctx.init()
+    assert ctx.initialized
+    ctx.shutdown()
+    assert not ctx.initialized
+    # reusable as context manager (reference test_context.py:34-40)
+    with ctx:
+        assert ctx.initialized
+    with ctx:
+        assert ctx.initialized
+    assert not ctx.initialized
+
+
+def test_slice_context_devices_and_commands():
+    got = []
+    event = threading.Event()
+
+    def handler(cmd, payload):
+        got.append((cmd, payload))
+        event.set()
+
+    with SliceContext(cmd_handler=handler) as ctx:
+        assert ctx.world_size >= 1
+        assert len(ctx.devices) == ctx.world_size
+        ctx.cmd_broadcast(CMD_SCHED, ((1, 24), (25, 48)))
+        assert event.wait(timeout=5)
+    assert got == [(CMD_SCHED, ((1, 24), (25, 48)))]
+
+
+def test_multihost_single_process_noop():
+    with MultiHostContext("127.0.0.1:0", num_processes=1, process_id=0) as ctx:
+        assert ctx.initialized
+        assert ctx.world_size == 1
+
+
+def test_command_plane_ordering_and_stop():
+    got = []
+    plane = CommandPlane(lambda cmd, p: got.append(cmd))
+    plane.start()
+    for cmd in (CMD_SCHED, CMD_SCHED, CMD_STOP):
+        plane.publish(cmd)
+    deadline = time.monotonic() + 5
+    while len(got) < 3 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    plane.stop()
+    assert got == [CMD_SCHED, CMD_SCHED, CMD_STOP]
+    plane.stop()  # idempotent
