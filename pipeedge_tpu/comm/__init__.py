@@ -94,13 +94,17 @@ class SliceContext(DistContext):
     """
 
     def __init__(self, cmd_handler: Optional[DistCmdHandler] = None):
-        import jax
-        devices = jax.local_devices()
-        super().__init__(world_size=len(devices), rank=0)
-        self.devices = devices
+        super().__init__(world_size=0, rank=0)
+        self.devices: list = []
         self.command_plane = CommandPlane(cmd_handler)
 
     def init(self) -> None:
+        # Snapshot devices here, not in __init__: touching the backend at
+        # construction time would initialize it before a MultiHostContext
+        # (or dryrun_multichip's platform override) gets a chance to run.
+        import jax
+        self.devices = jax.local_devices()
+        self._world_size = len(self.devices)
         super().init()
         self.command_plane.start()
 
@@ -151,37 +155,80 @@ class CommandPlane:
     on a background thread, preserving the asynchronous delivery semantics
     the runtime relies on (schedule can arrive while the pipeline runs)."""
 
+    _SHUTDOWN = object()  # queue sentinel: everything before it is delivered
+
     def __init__(self, handler: Optional[DistCmdHandler] = None):
         self._handler = handler
+        # Each start()/stop() session gets its own queue: stop() swaps in a
+        # fresh one under the lock, so the outgoing dispatch thread drains
+        # exactly its own session's commands (no replay, no cross-session
+        # consumer races), while later publishes land in the new queue and
+        # are held for the next start().
         self._queue: "queue.Queue" = queue.Queue()
         self._thread: Optional[threading.Thread] = None
-        self._stop = threading.Event()
+        # Set only by an in-handler stop(): the dispatch thread that is
+        # still draining its session's queue and couldn't be joined there.
+        self._draining: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
 
     def start(self) -> None:
-        if self._thread is not None:
-            return
-        self._stop.clear()
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="CommandPlane")
-        self._thread.start()
+        """Start the dispatch thread. If the previous session was stopped
+        from inside its own handler, wait for that dispatcher to finish
+        first so two sessions never dispatch concurrently (not possible
+        when start() itself runs on the draining thread — that lone case
+        accepts overlap)."""
+        with self._lock:
+            draining = self._draining
+        if draining is not None:
+            if draining is not threading.current_thread():
+                draining.join()
+            with self._lock:
+                if self._draining is draining:
+                    self._draining = None
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._thread = threading.Thread(
+                target=self._run, args=(self._queue,), daemon=True,
+                name="CommandPlane")
+            self._thread.start()
 
     def stop(self) -> None:
-        if self._thread is None:
-            return
-        self._stop.set()
-        self._queue.put(None)  # wake the thread
-        self._thread.join()
-        self._thread = None
+        """Stop the dispatch thread after it drains already-published
+        commands (a CMD_STOP published just before shutdown must still be
+        delivered). Commands published after stop()'s cutoff are held for
+        the next start(); a restarted plane never replays the stopped
+        session's leftovers. Safe to call concurrently from several threads
+        and from inside a command handler (e.g. a handler reacting to
+        CMD_STOP by shutting the context down) — in that case the dispatch
+        thread finishes its queue and exits on its own instead of joining
+        itself."""
+        with self._lock:
+            thread = self._thread
+            if thread is None:
+                return
+            self._thread = None
+            self._queue.put(self._SHUTDOWN)  # FIFO: after all prior publishes
+            self._queue = queue.Queue()
+        if thread is not threading.current_thread():
+            thread.join()
+        else:
+            with self._lock:
+                self._draining = thread
 
     def publish(self, cmd: int, payload: Tuple[Any, ...] = ()) -> None:
-        self._queue.put((cmd, payload))
+        with self._lock:
+            self._queue.put((cmd, payload))
 
-    def _run(self) -> None:
-        while not self._stop.is_set():
-            item = self._queue.get()
-            if item is None:
-                continue
+    def _run(self, q: "queue.Queue") -> None:
+        while True:
+            item = q.get()
+            if item is self._SHUTDOWN:
+                return
             cmd, payload = item
             logger.debug("command plane: cmd=%d", cmd)
             if self._handler is not None:
-                self._handler(cmd, payload)
+                try:
+                    self._handler(cmd, payload)
+                except Exception:  # keep dispatching, like the reference
+                    logger.exception("command handler failed (cmd=%d)", cmd)

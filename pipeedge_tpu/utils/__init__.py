@@ -1,1 +1,34 @@
 """Utility modules: thread primitives, controllers, quantization policies, data."""
+
+
+def force_host_cpu_devices(n: int) -> None:
+    """Point jax at >= n virtual CPU devices (for multi-"chip" testing
+    without TPU hardware, SURVEY.md §4).
+
+    Must run before the first backend initialization in the process:
+    --xla_force_host_platform_device_count is parse-once. Setting the
+    JAX_PLATFORMS env var is NOT enough — the TPU plugin overrides it —
+    so the platform is forced via jax.config, which wins. Safe to call
+    multiple times; a too-small inherited device count is rewritten.
+    """
+    import os
+    import re
+
+    import jax
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    match = re.search(r"--xla_force_host_platform_device_count=(\d+)",
+                      flags)
+    if match is None:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+    elif int(match.group(1)) < n:
+        os.environ["XLA_FLAGS"] = (
+            flags[:match.start()]
+            + f"--xla_force_host_platform_device_count={n}"
+            + flags[match.end():])
+    if jax.config.jax_platforms != "cpu":
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:  # backend already initialized; use what we have
+            pass

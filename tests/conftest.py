@@ -5,19 +5,14 @@ XLA_FLAGS=--xla_force_host_platform_device_count=8 so pipeline/mesh code is
 exercised across 8 fake devices without TPU hardware. Must be set before the
 first jax backend initialization, hence at conftest import time.
 """
-import os
+import jax
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
-
-import jax  # noqa: E402  (must come after the env setup above)
+from pipeedge_tpu.utils import force_host_cpu_devices
 
 # The axon TPU plugin registers itself via sitecustomize and overrides
-# JAX_PLATFORMS; force the CPU backend explicitly so the 8 fake devices apply.
-jax.config.update("jax_platforms", "cpu")
+# JAX_PLATFORMS; the helper forces the CPU backend explicitly so the 8 fake
+# devices apply. Must run before the first backend initialization.
+force_host_cpu_devices(8)
 
 # XLA CPU's default matmul precision is reduced (bf16-like passes); golden
 # parity tests against torch float32 need full fp32 accumulation.

@@ -61,3 +61,100 @@ def test_command_plane_ordering_and_stop():
     plane.stop()
     assert got == [CMD_SCHED, CMD_SCHED, CMD_STOP]
     plane.stop()  # idempotent
+
+
+def test_command_plane_stop_drains_pending():
+    # A command published right before stop() must still be delivered.
+    got = []
+    plane = CommandPlane(lambda cmd, p: got.append(cmd))
+    plane.start()
+    plane.publish(CMD_SCHED)
+    plane.publish(CMD_STOP)
+    plane.stop()
+    assert got == [CMD_SCHED, CMD_STOP]
+
+
+def test_command_plane_handler_exception_keeps_dispatching():
+    got = []
+
+    def handler(cmd, payload):
+        got.append(cmd)
+        if cmd == CMD_SCHED:
+            raise KeyError("malformed schedule payload")
+
+    plane = CommandPlane(handler)
+    plane.start()
+    plane.publish(CMD_SCHED)  # raises inside handler
+    plane.publish(CMD_STOP)  # must still be delivered
+    plane.stop()
+    assert got == [CMD_SCHED, CMD_STOP]
+
+
+def test_command_plane_stop_from_handler():
+    # A handler may react to CMD_STOP by stopping the plane (the reference's
+    # CMD_STOP semantics, runtime.py:408-410); the dispatch thread must not
+    # try to join itself, and queued commands before the cutoff still arrive.
+    got = []
+    plane = CommandPlane(None)
+
+    def handler(cmd, payload):
+        got.append(cmd)
+        if cmd == CMD_STOP:
+            plane.stop()
+
+    plane._handler = handler
+    # publish BEFORE start so both commands deterministically precede the
+    # handler's stop() cutoff (held commands are delivered at start)
+    plane.publish(CMD_STOP)
+    plane.publish(CMD_SCHED)
+    plane.start()
+    deadline = time.monotonic() + 5
+    while len(got) < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert got == [CMD_STOP, CMD_SCHED]
+    # plane is stopped and restartable
+    plane.start()
+    plane.publish(CMD_SCHED)
+    plane.stop()
+    assert got == [CMD_STOP, CMD_SCHED, CMD_SCHED]
+
+
+def test_command_plane_concurrent_stop():
+    plane = CommandPlane(lambda cmd, p: None)
+    plane.start()
+    errors = []
+
+    def stopper():
+        try:
+            plane.stop()
+        except Exception as exc:  # pragma: no cover - failure path
+            errors.append(exc)
+
+    threads = [threading.Thread(target=stopper) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+
+
+def test_command_plane_publish_while_stopped_held_for_next_start():
+    got = []
+    plane = CommandPlane(lambda cmd, p: got.append(cmd))
+    plane.publish(CMD_SCHED)  # plane never started yet
+    plane.start()
+    plane.stop()  # drains: delivers the held command
+    assert got == [CMD_SCHED]
+
+
+def test_command_plane_restart_does_not_replay():
+    got = []
+    plane = CommandPlane(lambda cmd, p: got.append(cmd))
+    plane.start()
+    plane.publish(CMD_SCHED)
+    plane.stop()
+    # restart: nothing stale may fire into the new session
+    plane.start()
+    plane.publish(CMD_STOP)
+    plane.stop()
+    assert got == [CMD_SCHED, CMD_STOP]
