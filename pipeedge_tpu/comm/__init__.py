@@ -177,21 +177,26 @@ class CommandPlane:
         first so two sessions never dispatch concurrently (not possible
         when start() itself runs on the draining thread — that lone case
         accepts overlap)."""
-        with self._lock:
-            draining = self._draining
-        if draining is not None:
-            if draining is not threading.current_thread():
-                draining.join()
+        while True:
+            with self._lock:
+                # check _thread and _draining in the SAME critical section:
+                # an in-handler stop() publishes both under the lock, so we
+                # can never observe "no thread, nothing draining" while an
+                # old dispatcher is still working through its queue
+                if self._thread is not None:
+                    return
+                draining = self._draining
+                if draining is None or draining is threading.current_thread():
+                    self._draining = None
+                    self._thread = threading.Thread(
+                        target=self._run, args=(self._queue,), daemon=True,
+                        name="CommandPlane")
+                    self._thread.start()
+                    return
+            draining.join()
             with self._lock:
                 if self._draining is draining:
                     self._draining = None
-        with self._lock:
-            if self._thread is not None:
-                return
-            self._thread = threading.Thread(
-                target=self._run, args=(self._queue,), daemon=True,
-                name="CommandPlane")
-            self._thread.start()
 
     def stop(self) -> None:
         """Stop the dispatch thread after it drains already-published
@@ -210,11 +215,13 @@ class CommandPlane:
             self._thread = None
             self._queue.put(self._SHUTDOWN)  # FIFO: after all prior publishes
             self._queue = queue.Queue()
+            if thread is threading.current_thread():
+                # Mark in the same critical section that retired the thread,
+                # so a concurrent start() can never observe (_thread=None,
+                # _draining=None) while this dispatcher is still draining.
+                self._draining = thread
         if thread is not threading.current_thread():
             thread.join()
-        else:
-            with self._lock:
-                self._draining = thread
 
     def publish(self, cmd: int, payload: Tuple[Any, ...] = ()) -> None:
         with self._lock:

@@ -14,10 +14,66 @@ import yaml
 logger = logging.getLogger(__name__)
 
 # in-repo build location (native/CMakeLists.txt)
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), 'native')
 _REPO_BUILD_PATHS = [
-    os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__)))), 'native', 'build', 'sched-pipeline'),
+    os.path.join(_NATIVE_DIR, 'build', 'sched-pipeline'),
 ]
+
+
+_BUILD_FAILED = False
+
+
+def build_native(force: bool = False) -> Optional[str]:
+    """Build the in-repo `sched-pipeline` binary if absent; returns its path.
+
+    The reference ships the binary inside the wheel via py-build-cmake
+    (pyproject.toml:36-52); for a source checkout we compile on first use so
+    the build tree never needs to be committed. Returns None if no native
+    toolchain is available; a failed build is cached so repeated scheduling
+    calls don't re-run cmake.
+    """
+    global _BUILD_FAILED
+    binary = _REPO_BUILD_PATHS[0]
+    if os.path.exists(binary) and not force:
+        return binary
+    if _BUILD_FAILED and not force:
+        return None
+    build_dir = os.path.join(_NATIVE_DIR, 'build')
+    os.makedirs(build_dir, exist_ok=True)
+    try:
+        # serialize concurrent builders (e.g. parallel test workers) on an
+        # advisory file lock; the loser re-checks for the winner's binary
+        import fcntl
+        lock_f = open(os.path.join(build_dir, '.build-lock'), 'w')
+    except (OSError, ImportError):
+        lock_f = None
+    try:
+        if lock_f is not None:
+            fcntl.flock(lock_f, fcntl.LOCK_EX)
+            if os.path.exists(binary) and not force:
+                return binary
+        subprocess.run(['cmake', '-B', build_dir, '-G', 'Ninja', _NATIVE_DIR],
+                       capture_output=True, check=True)
+        subprocess.run(['ninja', '-C', build_dir], capture_output=True,
+                       check=True)
+    except FileNotFoundError as exc:
+        logger.warning("native toolchain unavailable (%s); cannot build "
+                       "sched-pipeline", exc)
+        _BUILD_FAILED = True
+        return None
+    except subprocess.CalledProcessError as exc:
+        _log_cpe(exc)
+        _BUILD_FAILED = True
+        return None
+    finally:
+        if lock_f is not None:
+            lock_f.close()
+    if os.path.exists(binary):
+        _BUILD_FAILED = False
+        return binary
+    _BUILD_FAILED = True
+    return None
 
 
 def _log_cpe(exc: subprocess.CalledProcessError) -> None:
@@ -52,21 +108,39 @@ def sched_pipeline(model_name: str, buffers_in: int, buffers_out: int,
     candidates = list(app_paths) + _REPO_BUILD_PATHS + ['sched-pipeline']
     proc = None
     last_missing = None
-    for app_path in candidates:
+
+    def _try(app_path):
+        nonlocal proc, last_missing
         try:
             proc = subprocess.run([app_path] + args, capture_output=True,
                                   check=True)
-            break
+            return True
         except FileNotFoundError:
             last_missing = app_path
+            return False
         except subprocess.CalledProcessError as exc:
             _log_cpe(exc)
             raise
-    if proc is None:
-        logger.error("Could not locate sched-pipeline (last tried %r) - "
-                     "build it with: cmake -B native/build native && "
-                     "ninja -C native/build", last_missing)
-        raise FileNotFoundError('sched-pipeline')
+
+    for app_path in candidates:
+        if _try(app_path):
+            break
+    else:
+        # every candidate missing: compile the in-repo binary on demand
+        # (only now, so explicit app_paths / PATH installs take precedence
+        # and we never run cmake when a binary already exists)
+        built = build_native()
+        if built is None or not _try(built):
+            if _BUILD_FAILED:
+                logger.error("Could not locate sched-pipeline and the "
+                             "auto-build failed (see log above) - fix the "
+                             "native toolchain or install a prebuilt "
+                             "sched-pipeline on PATH")
+            else:
+                logger.error("Could not locate sched-pipeline (last tried "
+                             "%r) - build it with: cmake -B native/build "
+                             "native && ninja -C native/build", last_missing)
+            raise FileNotFoundError('sched-pipeline')
 
     stderr = proc.stderr.decode().strip()
     if stderr:

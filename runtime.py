@@ -95,14 +95,16 @@ def parse_yaml_sched(sched: List[dict], hosts: Optional[List[str]]) -> \
         raise RuntimeError("No viable schedule found")
     stage_layers = []
     stage_ranks = []
+    # numeric host names round-trip through YAML as ints
+    hosts_s = [str(h) for h in hosts] if hosts else None
     for stage in sched:
         assert len(stage) == 1
         for host, layers in stage.items():
             assert len(layers) == 2
             stage_layers.append((int(layers[0]), int(layers[1])))
-            if hosts:
+            if hosts_s:
                 try:
-                    stage_ranks.append(hosts.index(host))
+                    stage_ranks.append(hosts_s.index(str(host)))
                 except ValueError:
                     logger.error("Scheduling: host not in hosts list: %s", host)
                     raise

@@ -15,12 +15,26 @@ import yaml
 
 from pipeedge_tpu import sched
 from pipeedge_tpu.sched import yaml_files, yaml_types
-from pipeedge_tpu.sched.scheduler import _REPO_BUILD_PATHS, sched_pipeline
+from pipeedge_tpu.sched.scheduler import (_REPO_BUILD_PATHS, build_native,
+                                          sched_pipeline)
 
 BIN = _REPO_BUILD_PATHS[0]
 pytestmark = pytest.mark.skipif(
-    not (os.path.exists(BIN) or shutil.which('sched-pipeline')),
-    reason="sched-pipeline binary not built")
+    not (os.path.exists(BIN) or shutil.which('sched-pipeline')
+         or shutil.which('cmake')),
+    reason="sched-pipeline binary not built and no native toolchain")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _ensure_binary():
+    """Build on demand at fixture time (not import time, so unrelated pytest
+    collection never triggers a native compile)."""
+    global BIN
+    if not os.path.exists(BIN):
+        built = shutil.which('sched-pipeline') or build_native()
+        if built is None:
+            pytest.skip("sched-pipeline auto-build failed")
+        BIN = built
 
 BATCH = 8
 DTYPE = 'torch.float32'
