@@ -215,13 +215,17 @@ class CommandPlane:
             self._thread = None
             self._queue.put(self._SHUTDOWN)  # FIFO: after all prior publishes
             self._queue = queue.Queue()
-            if thread is threading.current_thread():
-                # Mark in the same critical section that retired the thread,
-                # so a concurrent start() can never observe (_thread=None,
-                # _draining=None) while this dispatcher is still draining.
-                self._draining = thread
+            # Mark in the same critical section that retired the thread, so a
+            # concurrent start() can never observe (_thread=None,
+            # _draining=None) while this dispatcher is still draining — for
+            # BOTH the in-handler stop (joined by the next start()) and an
+            # external stop (joined right below).
+            self._draining = thread
         if thread is not threading.current_thread():
             thread.join()
+            with self._lock:
+                if self._draining is thread:
+                    self._draining = None
 
     def publish(self, cmd: int, payload: Tuple[Any, ...] = ()) -> None:
         with self._lock:

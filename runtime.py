@@ -25,12 +25,14 @@ import logging
 import os
 import queue
 import sys
+import threading
 import time
 from typing import List, Optional, Tuple
 
 import numpy as np
 
 import monitoring
+from pipeedge_tpu.comm import CMD_SCHED, CMD_STOP
 from pipeedge_tpu.models import get_microbatch_size, registry
 from pipeedge_tpu.parallel import pipeline as host_pipeline
 from pipeedge_tpu.parallel import spmd
@@ -58,6 +60,21 @@ MONITORING_KEY_RECV = 'recv'
 
 results_counter = ThreadSafeCounter()
 label_queue = queue.Queue()
+# multi-process (dcn) command state (reference runtime.py:400-415)
+stop_event = threading.Event()
+sched_q = queue.Queue()
+
+
+def handle_cmd(cmd: int, tensors: Tuple) -> None:
+    """Process a command (reference runtime.py:404-415)."""
+    if cmd == CMD_STOP:
+        logger.info("handle_cmd: stop")
+        stop_event.set()
+    elif cmd == CMD_SCHED:
+        logger.info("handle_cmd: sched")
+        sched_q.put(tensors)
+    else:
+        logger.warning("handle_cmd: Unknown command: %s", cmd)
 
 
 def get_window_size() -> int:
@@ -356,6 +373,195 @@ def run_pipeline_spmd(args, stage_layers, stage_quant, ubatches, labels) -> None
     _report(tik, tok, ubatches)
 
 
+def _wire_encode(out, bit: int) -> List[np.ndarray]:
+    """Stage output -> wire tensor list. bit>0 packs each payload tensor into
+    [packed_uint32, scale, shift, shape] quadruples (the reference's 5-tuple
+    wire format, basic_op.py:114-143; bit is schedule metadata both ends
+    know, so it doesn't travel)."""
+    import jax.numpy as jnp
+
+    from pipeedge_tpu.ops import quant as quant_ops
+    tensors = out if isinstance(out, tuple) else (out,)
+    if bit == 0:
+        return [np.asarray(t) for t in tensors]
+    wire = []
+    for t in tensors:
+        enc = quant_ops.tensor_encode_outerdim(jnp.asarray(t), bit)
+        wire += [np.asarray(enc.data), np.asarray(enc.scale),
+                 np.asarray(enc.shift), np.asarray(enc.shape, np.int64)]
+    return wire
+
+
+def _wire_decode(tensors: List[np.ndarray], bit: int, dtype):
+    """Inverse of `_wire_encode`; returns the stage payload (tensor/tuple)."""
+    import jax.numpy as jnp
+
+    from pipeedge_tpu.ops import quant as quant_ops
+    if bit == 0:
+        out = tuple(jnp.asarray(t) for t in tensors)
+    else:
+        assert len(tensors) % 4 == 0
+        out = []
+        for i in range(0, len(tensors), 4):
+            data, scale, shift, shape = tensors[i:i + 4]
+            enc = quant_ops.QuantizedTensor(
+                data=jnp.asarray(data), scale=jnp.asarray(scale),
+                shift=jnp.asarray(shift), shape=tuple(int(s) for s in shape),
+                bit=bit)
+            out.append(quant_ops.tensor_decode_outerdim(enc).astype(dtype))
+        out = tuple(out)
+    return out[0] if len(out) == 1 else out
+
+
+def run_pipeline_dcn(args, stage_layers, stage_quant, stage_ranks,
+                     ubatches, labels) -> None:
+    """Multi-process pipeline over the DCN transport: this process is ONE
+    rank (reference `runtime.py RANK WORLDSIZE` semantics, run_pipeline_p2p
+    418-511). Rank `--data-rank` resolves/broadcasts the schedule, streams
+    microbatches to the first stage, and collects results from the last."""
+    import jax
+    import jax.numpy as jnp
+
+    from pipeedge_tpu.comm import dcn
+
+    rank, world_size = args.rank, args.worldsize
+    data_rank = args.data_rank
+    addrs = _parse_dcn_addrs(args, world_size)
+    dtype = jnp.bfloat16 if args.dtype == 'bfloat16' else jnp.float32
+
+    with dcn.DistDcnContext(world_size, rank, addrs,
+                            cmd_handler=handle_cmd) as ctx:
+        if rank == data_rank:
+            # schedule was resolved by the caller; broadcast it (CMD_SCHED,
+            # reference runtime.py:441-445)
+            ctx.cmd_broadcast(CMD_SCHED, [
+                np.asarray(stage_layers, np.int32),
+                np.asarray(stage_quant, np.int32),
+                np.asarray(stage_ranks, np.int32)])
+        else:
+            # workers block until the schedule arrives (runtime.py:447-448)
+            tensors = sched_q.get(timeout=args.sched_timeout)
+            stage_layers = [tuple(map(int, lr)) for lr in tensors[0]]
+            stage_quant = [int(q) for q in tensors[1]]
+            stage_ranks = [int(r) for r in tensors[2]]
+
+        try:
+            my_stages = [i for i, r in enumerate(stage_ranks) if r == rank]
+            stage = None
+            if my_stages:
+                assert len(my_stages) == 1, \
+                    "one stage per rank (reference p2p semantics)"
+                i = my_stages[0]
+                l, r = stage_layers[i]
+                fn, params, _ = registry.module_shard_factory(
+                    args.model_name, args.model_file, l, r, stage=i,
+                    dtype=dtype)
+                in_bit = stage_quant[i - 1] if i > 0 else 0
+                out_bit = stage_quant[i] if i < len(stage_layers) - 1 else 0
+                is_first, is_last = i == 0, i == len(stage_layers) - 1
+
+                def work_cb(tensors):
+                    if is_first:
+                        payload = jnp.asarray(tensors[0], dtype=dtype
+                                              if tensors[0].dtype.kind == 'f'
+                                              else None)
+                    else:
+                        payload = _wire_decode(tensors, in_bit, dtype)
+                    monitoring.iteration_start(MONITORING_KEY_MODEL)
+                    out = fn(params, payload)
+                    out = jax.block_until_ready(out)
+                    n_items = get_microbatch_size(np.asarray(
+                        out[0] if isinstance(out, tuple) else out))
+                    monitoring.iteration(MONITORING_KEY_MODEL, work=n_items,
+                                         accuracy=r - l + 1)
+                    return _wire_encode(out, out_bit)
+
+                # head stage is fed over the wire from the data rank
+                # (self-connection over loopback when colocated); the last
+                # stage's results ride a separate wire channel so a
+                # single-stage colocated schedule can't mix its own input
+                # feed with its results
+                rank_src = stage_ranks[i - 1] if not is_first else data_rank
+                rank_dst = stage_ranks[i + 1] if not is_last else data_rank
+                stage = dcn.DcnPipelineStage(
+                    ctx, rank_src, rank_dst, work_cb,
+                    send_channel=dcn.CHANNEL_RESULTS if is_last
+                    else dcn.CHANNEL_DATA)
+                stage.start()
+            else:
+                logger.info("rank %d not in schedule; idling", rank)
+
+            if rank == data_rank:
+                for lb in labels:
+                    label_queue.put(lb)
+                first_rank = stage_ranks[0]
+                last_rank = stage_ranks[-1]
+                last_bit = 0  # final stage output is never quantized
+
+                def results_loop():
+                    for _ in range(len(ubatches)):
+                        if stop_event.is_set():
+                            return
+                        try:
+                            tensors = ctx.recv_tensors(
+                                last_rank, timeout=args.sched_timeout,
+                                channel=dcn.CHANNEL_RESULTS)
+                        except queue.Empty:
+                            return
+                        out = _wire_decode(tensors, last_bit, dtype)
+                        mbits = sum(np.asarray(t).nbytes for t in tensors) \
+                            * 8 / 1e6
+                        monitoring.iteration(MONITORING_KEY_RECV, work=mbits,
+                                             safe=False)
+                        handle_results(np.asarray(out))
+
+                results_thread = threading.Thread(target=results_loop,
+                                                  daemon=True)
+                results_thread.start()
+                try:
+                    tik = time.monotonic()
+                    for u in ubatches:
+                        ctx.send_tensors(first_rank, [np.asarray(u)])
+                    batch_total = sum(len(u) for u in ubatches)
+                    complete = results_counter.wait_gte(
+                        batch_total, timeout=args.sched_timeout)
+                    tok = time.monotonic()
+                finally:
+                    # CMD_STOP must go out even on failure, or the workers
+                    # hang until their own timeouts
+                    ctx.cmd_broadcast(CMD_STOP)
+                    stop_event.set()
+                results_thread.join(timeout=10)
+                if not complete:
+                    raise RuntimeError(
+                        f"pipeline delivered {results_counter.value}/"
+                        f"{batch_total} results within {args.sched_timeout}s")
+                _report(tik, tok, ubatches)
+            else:
+                if not stop_event.wait(timeout=args.sched_timeout):
+                    raise RuntimeError(
+                        f"rank {rank}: no CMD_STOP within "
+                        f"{args.sched_timeout}s; aborting")
+        finally:
+            if stage is not None:
+                stage.stop()
+
+
+def _parse_dcn_addrs(args, world_size: int) -> List[Tuple[str, int]]:
+    """--dcn-addrs 'h:p,h:p,...' (one per rank) or localhost defaults at
+    --port+rank (the reference's MASTER_ADDR/PORT analogue, runtime.py:599)."""
+    if args.dcn_addrs:
+        parts = args.dcn_addrs.split(',')
+        if len(parts) != world_size:
+            raise RuntimeError("--dcn-addrs must list one host:port per rank")
+        out = []
+        for p in parts:
+            host, port = p.rsplit(':', 1)
+            out.append((host, int(port)))
+        return out
+    return [("127.0.0.1", args.port + i) for i in range(world_size)]
+
+
 def _report(tik, tok, ubatches):
     batch_size = sum(len(u) for u in ubatches)
     latency = tok - tik
@@ -373,8 +579,10 @@ def main():
     parser.add_argument("worldsize", type=int,
                         help="number of pipeline stages (devices)")
     parser.add_argument("-c", "--comm", type=str, default="host",
-                        choices=["host", "spmd", "p2p", "rpc"],
-                        help="pipeline driver; p2p/rpc are host aliases")
+                        choices=["host", "spmd", "dcn", "p2p", "rpc"],
+                        help="pipeline driver; dcn = multi-process TCP "
+                             "transport (one rank per process, reference "
+                             "p2p semantics); p2p/rpc are host aliases")
     parser.add_argument("-m", "--model-name", type=str,
                         default="google/vit-base-patch16-224",
                         choices=registry.get_model_names())
@@ -392,8 +600,20 @@ def main():
     parser.add_argument("-r", "--rank-order", type=str, default=None,
                         help="comma-delimited stage-to-device mapping")
     parser.add_argument("-D", "--data-rank", type=int, default=0,
-                        help="accepted for compatibility; single-controller "
-                             "runtime always drives from the host")
+                        help="rank that drives data/results (dcn mode); "
+                             "single-controller drivers always use the host")
+    parser.add_argument("--dcn-addrs", type=str, default=None,
+                        help="comma-delimited host:port listener address per "
+                             "rank (dcn mode); default 127.0.0.1:PORT+rank")
+    parser.add_argument("-P", "--port", type=int, default=29600,
+                        help="base listener port for dcn mode defaults")
+    parser.add_argument("--sched-timeout", type=float, default=300,
+                        help="seconds a worker waits for the schedule / "
+                             "results / stop (dcn mode)")
+    parser.add_argument("--platform", type=str, default="auto",
+                        choices=["auto", "cpu"],
+                        help="force the JAX CPU backend (testing multi-"
+                             "process dcn pipelines without TPU chips)")
     parser.add_argument("-sm", "--sched-models-file", default=None, type=str)
     parser.add_argument("-sdt", "--sched-dev-types-file", default=None, type=str)
     parser.add_argument("-sd", "--sched-dev-file", default=None, type=str)
@@ -409,10 +629,15 @@ def main():
     parser.add_argument("--dataset-shuffle", action="store_true")
     args = parser.parse_args()
 
-    if args.rank != 0:
+    if args.platform == "cpu":
+        from pipeedge_tpu.utils import force_host_cpu_devices
+        force_host_cpu_devices(max(1, args.worldsize))
+
+    if args.rank != 0 and args.comm != "dcn":
         logger.warning("Single-controller runtime: only rank 0 runs; "
                        "rank %d exits immediately (all devices are driven "
-                       "from rank 0)", args.rank)
+                       "from rank 0). Use --comm dcn for one-process-per-"
+                       "rank operation.", args.rank)
         return
 
     partition = None
@@ -429,20 +654,26 @@ def main():
         with open(args.dataset_indices_tsv) as f:
             indices = [int(line.split('\t')[0]) for line in f if line.strip()]
 
-    stage_layers, stage_quant, stage_ranks = get_pipeline_sched(
-        args.worldsize, hosts, partition, quant, rank_order, args.model_name,
-        args.ubatch_size, args.sched_models_file, args.sched_dev_types_file,
-        args.sched_dev_file)
+    is_dcn_worker = args.comm == "dcn" and args.rank != args.data_rank
+    if is_dcn_worker:
+        # schedule arrives via CMD_SCHED; only the data rank loads data
+        stage_layers, stage_quant, stage_ranks = [], [], []
+        ubatches, labels = [], []
+    else:
+        stage_layers, stage_quant, stage_ranks = get_pipeline_sched(
+            args.worldsize, hosts, partition, quant, rank_order,
+            args.model_name, args.ubatch_size, args.sched_models_file,
+            args.sched_dev_types_file, args.sched_dev_file)
 
-    dataset = load_dataset(
-        {'name': args.dataset_name, 'root': args.dataset_root,
-         'split': args.dataset_split, 'indices': indices,
-         'shuffle': args.dataset_shuffle},
-        args.model_name, args.batch_size, args.ubatch_size)
-    ubatches, labels = [], []
-    for inputs, lbls in data_utils.batch_dataset(dataset, args.ubatch_size):
-        ubatches.append(inputs)
-        labels.append(lbls)
+        dataset = load_dataset(
+            {'name': args.dataset_name, 'root': args.dataset_root,
+             'split': args.dataset_split, 'indices': indices,
+             'shuffle': args.dataset_shuffle},
+            args.model_name, args.batch_size, args.ubatch_size)
+        ubatches, labels = [], []
+        for inputs, lbls in data_utils.batch_dataset(dataset, args.ubatch_size):
+            ubatches.append(inputs)
+            labels.append(lbls)
 
     window_size = get_window_size()
     monitoring.init(MONITORING_KEY_MODEL, window_size, work_type='items',
@@ -464,13 +695,18 @@ def main():
             except ValueError as exc:
                 logger.warning("%s; falling back to host driver", exc)
                 comm = "host"
-        if comm == "spmd":
+        if comm == "dcn":
+            # waits for its own results/stop internally (multi-process)
+            run_pipeline_dcn(args, stage_layers, stage_quant, stage_ranks,
+                             ubatches, labels)
+        elif comm == "spmd":
             run_pipeline_spmd(args, stage_layers, stage_quant, ubatches, labels)
         else:
             run_pipeline_host(args, stage_layers, stage_quant, stage_ranks,
                               ubatches, labels)
-        assert results_counter.wait_gte(
-            sum(len(u) for u in ubatches), timeout=300)
+        if comm != "dcn":
+            assert results_counter.wait_gte(
+                sum(len(u) for u in ubatches), timeout=300)
     finally:
         monitoring.finish()
 

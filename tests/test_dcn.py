@@ -1,0 +1,238 @@
+"""DCN transport tests: wire framing, context P2P, command channel, and a
+3-stage cross-"host" pipeline on localhost (reference never tests its wire
+protocol or multi-rank paths at all, SURVEY.md §4)."""
+import queue
+import socket
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+import pytest
+
+from pipeedge_tpu.comm import CMD_SCHED, CMD_STOP
+from pipeedge_tpu.comm import dcn
+
+
+def _free_ports(n):
+    socks = [socket.create_server(("127.0.0.1", 0)) for _ in range(n)]
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _make_contexts(n, handlers=None):
+    ports = _free_ports(n)
+    addrs = [("127.0.0.1", p) for p in ports]
+    ctxs = [dcn.DistDcnContext(n, r, addrs,
+                               cmd_handler=(handlers or {}).get(r))
+            for r in range(n)]
+    for c in ctxs:
+        c.init()
+    return ctxs
+
+
+# -- framing -----------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", [
+    np.float16, np.float32, np.float64, np.uint8, np.int8, np.int16,
+    np.int32, np.int64, np.bool_, np.complex64, np.complex128,
+    ml_dtypes.bfloat16])
+def test_frame_roundtrip_dtypes(dtype):
+    a, b = socket.socketpair()
+    arr = np.arange(24).reshape(2, 3, 4).astype(dtype)
+    dcn._send_frame(a, dcn._MSG_TENSORS, 7, [arr], channel=1)
+    msg_type, aux, channel, out = dcn._recv_frame(b)
+    assert msg_type == dcn._MSG_TENSORS and aux == 7 and channel == 1
+    np.testing.assert_array_equal(np.asarray(out[0], np.float64),
+                                  np.asarray(arr, np.float64))
+    assert out[0].dtype == arr.dtype
+    a.close(), b.close()
+
+
+def test_frame_roundtrip_edge_shapes():
+    a, b = socket.socketpair()
+    tensors = [np.float32(3.5).reshape(()),                # 0-d
+               np.zeros((0, 5), np.int32),                 # zero-size
+               np.arange(20, dtype=np.float32)[::2]]       # non-contiguous
+    dcn._send_frame(a, dcn._MSG_TENSORS, 0, tensors)
+    _, _, _, out = dcn._recv_frame(b)
+    assert out[0].shape == () and float(out[0]) == 3.5
+    assert out[1].shape == (0, 5)
+    np.testing.assert_array_equal(out[2], np.arange(0, 20, 2, dtype=np.float32))
+    a.close(), b.close()
+
+
+def test_frame_rejects_unknown_dtype():
+    a, b = socket.socketpair()
+    with pytest.raises(TypeError):
+        dcn._send_frame(a, dcn._MSG_TENSORS, 0,
+                        [np.array(["x"], dtype=object)])
+    a.close(), b.close()
+
+
+# -- context P2P -------------------------------------------------------
+
+def test_context_send_recv_bidirectional():
+    ctxs = _make_contexts(2)
+    try:
+        x = np.random.default_rng(0).normal(size=(4, 8)).astype(np.float32)
+        y = np.arange(6, dtype=np.int64)
+        ctxs[0].send_tensors(1, [x, y])
+        got = ctxs[1].recv_tensors(0, timeout=10)
+        np.testing.assert_array_equal(got[0], x)
+        np.testing.assert_array_equal(got[1], y)
+        # reverse direction on the 1->0 link
+        ctxs[1].send_tensors(0, [y])
+        np.testing.assert_array_equal(ctxs[0].recv_tensors(1, timeout=10)[0], y)
+    finally:
+        for c in ctxs:
+            c.shutdown()
+
+
+def test_self_loop_channels_demultiplex():
+    """A rank sending to itself on two channels reads them back separately
+    (the colocated data-rank + single-stage schedule case)."""
+    ctxs = _make_contexts(1)
+    try:
+        a = np.arange(4, dtype=np.float32)
+        b = np.arange(8, dtype=np.float32)
+        ctxs[0].send_tensors(0, [a], channel=dcn.CHANNEL_DATA)
+        ctxs[0].send_tensors(0, [b], channel=dcn.CHANNEL_RESULTS)
+        got_b = ctxs[0].recv_tensors(0, timeout=10,
+                                     channel=dcn.CHANNEL_RESULTS)
+        got_a = ctxs[0].recv_tensors(0, timeout=10, channel=dcn.CHANNEL_DATA)
+        np.testing.assert_array_equal(got_a[0], a)
+        np.testing.assert_array_equal(got_b[0], b)
+    finally:
+        ctxs[0].shutdown()
+
+
+def test_context_lifecycle_reusable():
+    """Context-manager init/shutdown/reenter (reference test_context.py)."""
+    ports = _free_ports(1)
+    ctx = dcn.DistDcnContext(1, 0, [("127.0.0.1", ports[0])])
+    for _ in range(2):
+        with ctx:
+            assert ctx.initialized
+        assert not ctx.initialized
+
+
+def test_cmd_broadcast_reaches_all_peers():
+    received = {r: queue.Queue() for r in range(3)}
+    handlers = {r: (lambda cmd, tensors, _r=r: received[_r].put((cmd, tensors)))
+                for r in range(3)}
+    ctxs = _make_contexts(3, handlers)
+    try:
+        sched = np.asarray([[1, 24], [25, 48]], np.int32)
+        ctxs[0].cmd_broadcast(CMD_SCHED, [sched])
+        for r in (1, 2):
+            cmd, tensors = received[r].get(timeout=10)
+            assert cmd == CMD_SCHED
+            np.testing.assert_array_equal(tensors[0], sched)
+        ctxs[0].cmd_broadcast(CMD_STOP)
+        for r in (1, 2):
+            cmd, tensors = received[r].get(timeout=10)
+            assert cmd == CMD_STOP and tensors == ()
+        assert received[0].empty()  # no self-delivery, like the reference
+    finally:
+        for c in ctxs:
+            c.shutdown()
+
+
+# -- pipeline stages ---------------------------------------------------
+
+def test_three_stage_pipeline_matches_single_shard():
+    """Stream microbatches through 3 DCN stages (3 contexts in-process ==
+    3 hosts on localhost) and compare with the monolithic forward."""
+    pytest.importorskip("torch")
+    import torch
+    from transformers import ViTConfig, ViTForImageClassification
+
+    from pipeedge_tpu.models import ShardConfig
+    from pipeedge_tpu.models import vit as vit_mod
+    from pipeedge_tpu.models.layers import TransformerConfig
+    from pipeedge_tpu.models.shard import make_shard_fn
+
+    tiny = dict(hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+                intermediate_size=64)
+    torch.manual_seed(0)
+    model = ViTForImageClassification(
+        ViTConfig(**tiny, image_size=16, patch_size=4, num_labels=5)).eval()
+    cfg = TransformerConfig(model_type="vit", **tiny, num_labels=5,
+                            image_size=16, patch_size=4)
+    weights = vit_mod.hf_to_npz_weights(model.state_dict(), cfg)
+    total = 4 * cfg.num_hidden_layers
+
+    full_sc = ShardConfig(1, total, is_first=True, is_last=True)
+    params_full = vit_mod.load_params(cfg, full_sc, weights)
+    full_fn = jax.jit(make_shard_fn(vit_mod.FAMILY, cfg, full_sc))
+
+    partition = [(1, 3), (4, 5), (6, 8)]  # includes mid-block cuts
+    stage_fns = []
+    for (l, r) in partition:
+        sc = ShardConfig(l, r, is_first=l == 1, is_last=r == total)
+        sp = vit_mod.load_params(cfg, sc, weights)
+        fn = jax.jit(make_shard_fn(vit_mod.FAMILY, cfg, sc))
+        stage_fns.append((fn, sp))
+
+    def work(stage_idx):
+        fn, sp = stage_fns[stage_idx]
+        def cb(tensors):
+            data = jnp.asarray(tensors[0]) if len(tensors) == 1 \
+                else tuple(jnp.asarray(t) for t in tensors)
+            out = fn(sp, data)
+            out = out if isinstance(out, tuple) else (out,)
+            return [np.asarray(t) for t in out]
+        return cb
+
+    results = queue.Queue()
+    ctxs = _make_contexts(3)
+    stages = [
+        dcn.DcnPipelineStage(ctxs[0], None, 1, work(0)),
+        dcn.DcnPipelineStage(ctxs[1], 0, 2, work(1)),
+        dcn.DcnPipelineStage(ctxs[2], 1, None, work(2),
+                             results_cb=results.put),
+    ]
+    rng = np.random.default_rng(0)
+    ubatches = [rng.normal(size=(2, 3, 16, 16)).astype(np.float32)
+                for _ in range(4)]
+    try:
+        for s in stages:
+            s.start()
+        for u in ubatches:
+            stages[0].enqueue_tensors([u])
+        outs = [results.get(timeout=60) for _ in ubatches]
+    finally:
+        for s in stages:
+            s.stop()
+        for c in ctxs:
+            c.shutdown()
+    for u, out in zip(ubatches, outs):  # FIFO order is guaranteed
+        expect = np.asarray(full_fn(params_full, jnp.asarray(u)))
+        np.testing.assert_allclose(out[0], expect, rtol=2e-4, atol=2e-4)
+
+
+def test_stage_stop_while_blocked():
+    """stop() releases a work thread blocked on a full hand-off queue."""
+    ctxs = _make_contexts(1)
+    blocker = threading.Event()
+
+    def slow(tensors):
+        blocker.wait(5)
+        return tensors
+
+    stage = dcn.DcnPipelineStage(ctxs[0], None, None, slow,
+                                 results_cb=lambda x: time.sleep(1))
+    try:
+        stage.start()
+        for _ in range(2):
+            stage.enqueue_tensors([np.zeros(2, np.float32)])
+        blocker.set()
+        stage.stop()
+        assert all(not t.is_alive() for t in stage._threads)
+    finally:
+        ctxs[0].shutdown()

@@ -1,0 +1,40 @@
+"""Multi-process runtime integration over the DCN transport: the reference's
+`runtime.py RANK WORLDSIZE` deployment shape (one OS process per rank,
+schedule broadcast via CMD_SCHED, results + CMD_STOP), on localhost."""
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.create_server(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_dcn_runtime_quantized_edge(tmp_path):
+    port = _free_port()
+    common = [sys.executable, os.path.join(REPO, "runtime.py")]
+    opts = ["-c", "dcn", "--platform", "cpu",
+            "-m", "pipeedge/test-tiny-vit", "-b", "16", "-u", "4",
+            "-pt", "1,4,5,8", "-q", "8,0", "-r", "0,1",
+            "-P", str(port), "--sched-timeout", "120"]
+    env = dict(os.environ, PYTHONPATH=REPO)
+    worker = subprocess.Popen(common + ["1", "2"] + opts, cwd=tmp_path,
+                              env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+    try:
+        data = subprocess.run(common + ["0", "2"] + opts, cwd=tmp_path,
+                              env=env, capture_output=True, text=True,
+                              timeout=240)
+        wout, _ = worker.communicate(timeout=60)
+    finally:
+        worker.kill()
+    assert data.returncode == 0, data.stdout + data.stderr
+    assert "latency_sec=" in data.stdout
+    assert worker.returncode == 0, wout
+    assert "======= pipeedge/test-tiny-vit stage 1: layers [5, 8]" in wout
