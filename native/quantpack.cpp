@@ -1,0 +1,88 @@
+// Native host-side quantized-activation wire codec.
+//
+// Packs k-bit quantization codes into uint32 words with the same layout and
+// math as the XLA ops in pipeedge_tpu/ops/quant.py ('original' mode; value i
+// -> word i/per_word at bit offset (i%per_word)*bit, per_word = 32/bit), so
+// payloads produced on either side interoperate on the DCN wire. Mirrors the
+// role of the reference's numpy packing (reference quantization/
+// basic_op.py:38-90) but as C++ for the host runtime path: DCN edges encode
+// on the host after device readback, and a native codec keeps that off the
+// accelerator and out of the interpreter.
+//
+// Rounding: XLA's round() is round-half-to-even; std::nearbyint under the
+// default FE_TONEAREST mode matches it exactly.
+
+#include <cfenv>
+#include <cmath>
+#include <cstdint>
+
+extern "C" {
+
+int qp_abi_version() { return 1; }
+
+// ceil(n / (32/bit)) words per item
+int64_t qp_packed_words(int64_t n, int bit) {
+  int64_t per_word = 32 / bit;
+  return (n + per_word - 1) / per_word;
+}
+
+// x: [batch, n] row-major float32. Outputs: packed [batch, words] uint32,
+// scale/shift [batch] float32. bit in {1..32}.
+void qp_encode_f32(const float* x, int64_t batch, int64_t n, int bit,
+                   uint32_t* packed, float* scale, float* shift) {
+  const int64_t per_word = 32 / bit;
+  const int64_t words = qp_packed_words(n, bit);
+  const float levels =
+      (bit >= 32) ? 4294967295.0f : static_cast<float>((1u << bit) - 1u);
+  std::fesetround(FE_TONEAREST);
+  for (int64_t b = 0; b < batch; ++b) {
+    const float* xb = x + b * n;
+    if (n == 0) {
+      scale[b] = 0.0f;
+      shift[b] = 0.0f;
+      continue;
+    }
+    float mn = xb[0], mx_rel = 0.0f;
+    for (int64_t i = 1; i < n; ++i) mn = xb[i] < mn ? xb[i] : mn;
+    for (int64_t i = 0; i < n; ++i) {
+      float rel = xb[i] - mn;
+      mx_rel = rel > mx_rel ? rel : mx_rel;
+    }
+    const float sc = mx_rel;
+    const float safe = sc > 0.0f ? sc : 1.0f;
+    shift[b] = mn;
+    scale[b] = sc;
+    uint32_t* pb = packed + b * words;
+    for (int64_t w = 0; w < words; ++w) pb[w] = 0u;
+    for (int64_t i = 0; i < n; ++i) {
+      const float x01 = (xb[i] - mn) / safe;
+      const uint32_t q =
+          static_cast<uint32_t>(static_cast<int64_t>(
+              std::nearbyint(static_cast<double>(x01 * levels))));
+      pb[i / per_word] |=
+          (bit >= 32 ? q : (q & ((1u << bit) - 1u)))
+          << ((i % per_word) * bit);
+    }
+  }
+}
+
+// packed: [batch, words]; out: [batch, n] float32.
+void qp_decode_f32(const uint32_t* packed, int64_t batch, int64_t n, int bit,
+                   const float* scale, const float* shift, float* out) {
+  const int64_t per_word = 32 / bit;
+  const int64_t words = qp_packed_words(n, bit);
+  const float levels =
+      (bit >= 32) ? 4294967295.0f : static_cast<float>((1u << bit) - 1u);
+  const uint32_t mask = (bit >= 32) ? 0xFFFFFFFFu : ((1u << bit) - 1u);
+  for (int64_t b = 0; b < batch; ++b) {
+    const uint32_t* pb = packed + b * words;
+    float* ob = out + b * n;
+    const float sc = scale[b], sh = shift[b];
+    for (int64_t i = 0; i < n; ++i) {
+      const uint32_t q = (pb[i / per_word] >> ((i % per_word) * bit)) & mask;
+      ob[i] = static_cast<float>(q) / levels * sc + sh;
+    }
+  }
+}
+
+}  // extern "C"

@@ -89,6 +89,21 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
+def _sendmsg_all(sock: socket.socket, parts: List) -> None:
+    """Scatter-gather send of every buffer in `parts` (no flattening copy)."""
+    bufs = [memoryview(p) for p in parts if len(p)]
+    while bufs:
+        try:
+            sent = sock.sendmsg(bufs)
+        except InterruptedError:
+            continue
+        while bufs and sent >= len(bufs[0]):
+            sent -= len(bufs[0])
+            bufs.pop(0)
+        if sent:
+            bufs[0] = bufs[0][sent:]
+
+
 def _send_frame(sock: socket.socket, msg_type: int, aux: int,
                 tensors: Sequence[np.ndarray], channel: int = 0) -> None:
     parts = [_HEADER.pack(msg_type, aux, channel, len(tensors))]
@@ -99,8 +114,9 @@ def _send_frame(sock: socket.socket, msg_type: int, aux: int,
         parts.append(_TENSOR_HEADER.pack(_dtype_code(t.dtype), t.ndim))
         for d in t.shape:
             parts.append(_DIM.pack(d))
-        parts.append(t.tobytes())
-    sock.sendall(b''.join(parts))
+        # raw bytes view of the payload: zero-copy into sendmsg
+        parts.append(t.reshape(-1).view(np.uint8))
+    _sendmsg_all(sock, parts)
 
 
 def _recv_frame(sock: socket.socket) -> Tuple[int, int, int, List[np.ndarray]]:
@@ -156,6 +172,12 @@ class DistDcnContext(DistContext):
     # -- lifecycle -----------------------------------------------------
 
     def init(self) -> None:
+        # fresh session state so the context is genuinely reusable
+        # (base-class contract, comm/__init__.py): the previous session's
+        # threads are all joined by shutdown() and hold the old event
+        self._stop = threading.Event()
+        self._reader_threads = []
+        self._recv_queues = {}
         host, port = self._rank_addrs[self._rank]
         self._listener = socket.create_server((host, port), backlog=8,
                                               reuse_port=False)
@@ -251,16 +273,19 @@ class DistDcnContext(DistContext):
 
     # -- outgoing ------------------------------------------------------
 
-    def _ensure_conn(self, dst: int) -> socket.socket:
+    def _ensure_conn(self, dst: int,
+                     timeout: Optional[float] = None) -> socket.socket:
         """Dial `dst` lazily; caller must hold _conn_locks[dst]. Retries
-        refused connections until CONNECT_TIMEOUT so simultaneously-launched
-        ranks can dial peers whose listeners aren't up yet (the role of the
-        reference's process-group rendezvous, p2p:62)."""
+        refused connections until the deadline (CONNECT_TIMEOUT default) so
+        simultaneously-launched ranks can dial peers whose listeners aren't
+        up yet (the role of the reference's process-group rendezvous,
+        p2p:62)."""
         conn = self._conns.get(dst)
         if conn is not None:
             return conn
         host, port = self._rank_addrs[dst]
-        deadline = time.monotonic() + self.CONNECT_TIMEOUT
+        deadline = time.monotonic() + (self.CONNECT_TIMEOUT
+                                       if timeout is None else timeout)
         while True:
             try:
                 conn = socket.create_connection((host, port), timeout=5)
@@ -291,13 +316,21 @@ class DistDcnContext(DistContext):
 
     def cmd_broadcast(self, cmd: int,
                       tensors: Sequence[np.ndarray] = ()) -> None:
-        """Send a command frame to every other rank (p2p:72-85)."""
+        """Send a command frame to every other rank (p2p:72-85). Best-effort:
+        an unreachable peer is logged and skipped, never letting one dead
+        rank block the command (CMD_STOP especially) from the rest."""
         for dst in range(self._world_size):
             if dst == self._rank:
                 continue
-            with self._conn_locks[dst]:
-                conn = self._ensure_conn(dst)
-                _send_frame(conn, _MSG_CMD, cmd, tensors)
+            try:
+                with self._conn_locks[dst]:
+                    # short dial deadline: a peer that was never reachable
+                    # shouldn't stall the whole broadcast for CONNECT_TIMEOUT
+                    conn = self._ensure_conn(dst, timeout=5.0)
+                    _send_frame(conn, _MSG_CMD, cmd, tensors)
+            except OSError as exc:
+                logger.warning("cmd_broadcast: rank %d unreachable (%s); "
+                               "skipping", dst, exc)
 
 
 class DcnPipelineStage:
@@ -334,6 +367,11 @@ class DcnPipelineStage:
         if self._rank_src is None and self._rank_dst is None \
                 and self._work_cb is None:
             return  # not in the schedule: idle (reference runtime.py:456-460)
+        # fresh session state: a stopped stage can be restarted (stop()
+        # joined all threads, which hold the old event/queues)
+        self._stop = threading.Event()
+        self._queue_work = queue.Queue(maxsize=1)
+        self._queue_out = queue.Queue(maxsize=1)
         for target, name in ((self._recv_loop, "recv"),
                              (self._work_loop, "work"),
                              (self._send_loop, "send")):

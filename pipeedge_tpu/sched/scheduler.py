@@ -24,17 +24,21 @@ _REPO_BUILD_PATHS = [
 _BUILD_FAILED = False
 
 
-def build_native(force: bool = False) -> Optional[str]:
-    """Build the in-repo `sched-pipeline` binary if absent; returns its path.
+def build_native(force: bool = False,
+                 artifact: Optional[str] = None) -> Optional[str]:
+    """Build the in-repo native tree if `artifact` is absent; returns its
+    path. `artifact` defaults to the `sched-pipeline` binary; other targets
+    (e.g. libquantpack.so) pass their own path so a build tree that predates
+    them still gets rebuilt.
 
-    The reference ships the binary inside the wheel via py-build-cmake
+    The reference ships its binary inside the wheel via py-build-cmake
     (pyproject.toml:36-52); for a source checkout we compile on first use so
     the build tree never needs to be committed. Returns None if no native
-    toolchain is available; a failed build is cached so repeated scheduling
-    calls don't re-run cmake.
+    toolchain is available; a failed build is cached so repeated calls don't
+    re-run cmake.
     """
     global _BUILD_FAILED
-    binary = _REPO_BUILD_PATHS[0]
+    binary = artifact or _REPO_BUILD_PATHS[0]
     if os.path.exists(binary) and not force:
         return binary
     if _BUILD_FAILED and not force:

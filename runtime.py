@@ -373,22 +373,39 @@ def run_pipeline_spmd(args, stage_layers, stage_quant, ubatches, labels) -> None
     _report(tik, tok, ubatches)
 
 
+def _native_wire_codec(bit: int):
+    """The native host-side codec when usable for this bitwidth (bit-identical
+    wire format, native_quant.py), else None. PIPEEDGE_NATIVE_QUANT=0
+    disables it."""
+    if bit == 0 or bit > 16 or os.getenv("PIPEEDGE_NATIVE_QUANT", "1") != "1":
+        return None
+    from pipeedge_tpu.ops import native_quant
+    return native_quant if native_quant.available() else None
+
+
 def _wire_encode(out, bit: int) -> List[np.ndarray]:
     """Stage output -> wire tensor list. bit>0 packs each payload tensor into
     [packed_uint32, scale, shift, shape] quadruples (the reference's 5-tuple
     wire format, basic_op.py:114-143; bit is schedule metadata both ends
-    know, so it doesn't travel)."""
+    know, so it doesn't travel). Packing runs in the native codec when built
+    (host-side, off the accelerator), else via the XLA ops."""
     import jax.numpy as jnp
 
     from pipeedge_tpu.ops import quant as quant_ops
     tensors = out if isinstance(out, tuple) else (out,)
     if bit == 0:
         return [np.asarray(t) for t in tensors]
+    native = _native_wire_codec(bit)
     wire = []
     for t in tensors:
-        enc = quant_ops.tensor_encode_outerdim(jnp.asarray(t), bit)
-        wire += [np.asarray(enc.data), np.asarray(enc.scale),
-                 np.asarray(enc.shift), np.asarray(enc.shape, np.int64)]
+        if native is not None:
+            arr = np.asarray(t, np.float32)
+            packed, scale, shift = native.encode_outerdim(arr, bit)
+            wire += [packed, scale, shift, np.asarray(arr.shape, np.int64)]
+        else:
+            enc = quant_ops.tensor_encode_outerdim(jnp.asarray(t), bit)
+            wire += [np.asarray(enc.data), np.asarray(enc.scale),
+                     np.asarray(enc.shift), np.asarray(enc.shape, np.int64)]
     return wire
 
 
@@ -401,14 +418,20 @@ def _wire_decode(tensors: List[np.ndarray], bit: int, dtype):
         out = tuple(jnp.asarray(t) for t in tensors)
     else:
         assert len(tensors) % 4 == 0
+        native = _native_wire_codec(bit)
         out = []
         for i in range(0, len(tensors), 4):
             data, scale, shift, shape = tensors[i:i + 4]
-            enc = quant_ops.QuantizedTensor(
-                data=jnp.asarray(data), scale=jnp.asarray(scale),
-                shift=jnp.asarray(shift), shape=tuple(int(s) for s in shape),
-                bit=bit)
-            out.append(quant_ops.tensor_decode_outerdim(enc).astype(dtype))
+            if native is not None:
+                dec = native.decode_outerdim(data, scale, shift,
+                                             tuple(int(s) for s in shape), bit)
+                out.append(jnp.asarray(dec, dtype=dtype))
+            else:
+                enc = quant_ops.QuantizedTensor(
+                    data=jnp.asarray(data), scale=jnp.asarray(scale),
+                    shift=jnp.asarray(shift),
+                    shape=tuple(int(s) for s in shape), bit=bit)
+                out.append(quant_ops.tensor_decode_outerdim(enc).astype(dtype))
         out = tuple(out)
     return out[0] if len(out) == 1 else out
 

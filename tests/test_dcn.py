@@ -112,12 +112,18 @@ def test_self_loop_channels_demultiplex():
 
 
 def test_context_lifecycle_reusable():
-    """Context-manager init/shutdown/reenter (reference test_context.py)."""
+    """Context-manager init/shutdown/reenter (reference test_context.py) —
+    and the reentered session must actually move data, not just report
+    initialized."""
     ports = _free_ports(1)
     ctx = dcn.DistDcnContext(1, 0, [("127.0.0.1", ports[0])])
-    for _ in range(2):
+    for session in range(2):
         with ctx:
             assert ctx.initialized
+            x = np.full((3,), session, np.int32)
+            ctx.send_tensors(0, [x])
+            np.testing.assert_array_equal(
+                ctx.recv_tensors(0, timeout=10)[0], x)
         assert not ctx.initialized
 
 

@@ -9,20 +9,21 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _free_port():
-    s = socket.create_server(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+def _free_ports(n):
+    socks = [socket.create_server(("127.0.0.1", 0)) for _ in range(n)]
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
 
 
 def test_two_process_dcn_runtime_quantized_edge(tmp_path):
-    port = _free_port()
+    addrs = ",".join(f"127.0.0.1:{p}" for p in _free_ports(2))
     common = [sys.executable, os.path.join(REPO, "runtime.py")]
     opts = ["-c", "dcn", "--platform", "cpu",
             "-m", "pipeedge/test-tiny-vit", "-b", "16", "-u", "4",
             "-pt", "1,4,5,8", "-q", "8,0", "-r", "0,1",
-            "-P", str(port), "--sched-timeout", "120"]
+            "--dcn-addrs", addrs, "--sched-timeout", "120"]
     env = dict(os.environ, PYTHONPATH=REPO)
     worker = subprocess.Popen(common + ["1", "2"] + opts, cwd=tmp_path,
                               env=env, stdout=subprocess.PIPE,

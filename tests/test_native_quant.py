@@ -1,0 +1,90 @@
+"""Native quant codec vs the XLA ops.
+
+Interop contract (native_quant.py docstring): wire payloads (packed words,
+scale, shift) are BIT-IDENTICAL to the XLA encoder for the wire bitwidths
+(<= 16); decodes agree to f32 rounding — the quantization error itself
+(scale / 2^bit) is orders of magnitude larger than 1-ulp differences from
+XLA's fused multiply-adds."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pipeedge_tpu.ops import native_quant
+from pipeedge_tpu.ops import quant as quant_ops
+
+pytestmark = pytest.mark.skipif(not native_quant.available(),
+                                reason="native quant codec not built")
+
+BITS = [2, 3, 4, 6, 8, 16]
+
+
+def _assert_decodes_agree(a, b, scale):
+    # f32-rounding agreement: tiny relative to the quantization step
+    np.testing.assert_allclose(a, b, rtol=2e-6,
+                               atol=2e-6 * float(np.max(scale)) + 1e-12)
+
+
+@pytest.mark.parametrize("bit", BITS)
+@pytest.mark.parametrize("shape", [(4, 33), (2, 7, 5), (8, 13, 48)])
+def test_native_encode_matches_xla_bitwise(bit, shape):
+    x = np.random.default_rng(bit).normal(size=shape).astype(np.float32)
+    ref = quant_ops.tensor_encode_outerdim(jnp.asarray(x), bit)
+    packed, scale, shift = native_quant.encode_outerdim(x, bit)
+    np.testing.assert_array_equal(packed, np.asarray(ref.data))
+    np.testing.assert_array_equal(scale, np.asarray(ref.scale))
+    np.testing.assert_array_equal(shift, np.asarray(ref.shift))
+
+
+@pytest.mark.parametrize("bit", BITS)
+def test_cross_decode(bit):
+    """XLA-encoded payloads decode natively and vice versa."""
+    shape = (4, 6, 9)
+    x = np.random.default_rng(7).normal(size=shape).astype(np.float32)
+    enc = quant_ops.tensor_encode_outerdim(jnp.asarray(x), bit)
+    native_dec = native_quant.decode_outerdim(
+        np.asarray(enc.data), np.asarray(enc.scale), np.asarray(enc.shift),
+        shape, bit)
+    xla_dec = np.asarray(quant_ops.tensor_decode_outerdim(enc))
+    _assert_decodes_agree(native_dec, xla_dec, enc.scale)
+
+    packed, scale, shift = native_quant.encode_outerdim(x, bit)
+    enc2 = quant_ops.QuantizedTensor(
+        data=jnp.asarray(packed), scale=jnp.asarray(scale),
+        shift=jnp.asarray(shift), shape=shape, bit=bit)
+    _assert_decodes_agree(np.asarray(quant_ops.tensor_decode_outerdim(enc2)),
+                          native_quant.decode_outerdim(packed, scale, shift,
+                                                       shape, bit), scale)
+
+
+def test_roundtrip_error_bound():
+    x = np.random.default_rng(0).normal(size=(4, 257)).astype(np.float32)
+    for bit in BITS:
+        packed, scale, shift = native_quant.encode_outerdim(x, bit)
+        dec = native_quant.decode_outerdim(packed, scale, shift, x.shape, bit)
+        rng = float(scale.max())
+        # uniform quantization: error <= scale / (2^bit - 1) / 2 + fp slack
+        bound = rng / ((1 << bit) - 1) / 2 + 1e-5 * rng
+        assert np.abs(dec - x).max() <= bound
+
+
+def test_constant_input_zero_range():
+    x = np.full((3, 17), 2.5, np.float32)
+    packed, scale, shift = native_quant.encode_outerdim(x, 4)
+    assert (scale == 0).all() and (shift == 2.5).all()
+    dec = native_quant.decode_outerdim(packed, scale, shift, x.shape, 4)
+    np.testing.assert_array_equal(dec, x)
+
+
+def test_zero_size_inner_dim():
+    x = np.zeros((4, 0, 5), np.float32)
+    packed, scale, shift = native_quant.encode_outerdim(x, 8)
+    assert packed.shape == (4, 0) and (scale == 0).all() and (shift == 0).all()
+    dec = native_quant.decode_outerdim(packed, scale, shift, x.shape, 8)
+    assert dec.shape == x.shape
+
+
+def test_rejects_out_of_range_bitwidths():
+    x = np.zeros((2, 8), np.float32)
+    for bad in (0, 17, 32):
+        with pytest.raises(ValueError):
+            native_quant.encode_outerdim(x, bad)
