@@ -38,6 +38,7 @@ import jax
 
 from ..ops import clamp as clamp_ops
 from ..ops import quant as quant_ops
+from ..utils import tracing
 
 logger = logging.getLogger(__name__)
 
@@ -130,8 +131,11 @@ class HostPipeline:
         """Dispatch one microbatch through all stages; returns the (device-
         resident, not yet materialized) final payload."""
         data = ubatch
-        for stage in self.stages:
-            data = stage(data)
+        for i, stage in enumerate(self.stages):
+            # named profiler region: stage dispatch shows up on the trace
+            # timeline (see utils/tracing.py; no-op cost when not tracing)
+            with tracing.annotate(stage.name or f"stage{i}"):
+                data = stage(data)
         return _undequantized_guard(data)
 
     def run(self, ubatches: Sequence[Any]) -> Tuple[List[Any], Dict[str, float]]:

@@ -1,0 +1,58 @@
+"""Runtime tracing: JAX profiler (XPlane/TensorBoard/Perfetto) integration.
+
+The reference has NO trace-viewer integration anywhere (SURVEY.md §5.1 —
+only wall-clock offline profiling and heartbeat CSVs). On TPU the profiler
+is how you actually see MXU utilization, HBM traffic, and collective overlap,
+so the runtime exposes it first-class:
+
+- `trace(out_dir)`: context manager capturing a profiler session; view with
+  TensorBoard's profile plugin or Perfetto (xplane → trace.json.gz is
+  emitted automatically).
+- `annotate(name)`: named host-side region that shows up on the trace
+  timeline (wraps `jax.profiler.TraceAnnotation`), used by the pipeline
+  drivers to label per-microbatch/per-stage work.
+
+Both degrade to no-ops if the profiler backend is unavailable (e.g. a
+second concurrent session), mirroring the monitoring subsystem's graceful
+energy-meter fallback (reference monitoring.py:104-121).
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+from typing import Iterator, Optional
+
+logger = logging.getLogger(__name__)
+
+
+@contextlib.contextmanager
+def trace(out_dir: Optional[str]) -> Iterator[None]:
+    """Capture a JAX profiler trace into `out_dir` (no-op when None)."""
+    if not out_dir:
+        yield
+        return
+    import jax
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+        jax.profiler.start_trace(out_dir)
+    except Exception as exc:  # bad path / profiler busy: degrade gracefully
+        logger.warning("trace capture unavailable (%s); continuing without",
+                       exc)
+        yield
+        return
+    try:
+        yield
+    finally:
+        try:
+            jax.profiler.stop_trace()
+            logger.info("trace written to %s (view: tensorboard --logdir %s)",
+                        out_dir, out_dir)
+        except Exception as exc:
+            logger.warning("trace stop failed: %s", exc)
+
+
+def annotate(name: str):
+    """Named region on the profiler timeline (host + linked device ops)."""
+    import jax
+    return jax.profiler.TraceAnnotation(name)

@@ -637,6 +637,9 @@ def main():
                         choices=["auto", "cpu"],
                         help="force the JAX CPU backend (testing multi-"
                              "process dcn pipelines without TPU chips)")
+    parser.add_argument("--trace", type=str, default=None, metavar="DIR",
+                        help="capture a JAX profiler trace of the run into "
+                             "DIR (view with tensorboard/perfetto)")
     parser.add_argument("-sm", "--sched-models-file", default=None, type=str)
     parser.add_argument("-sdt", "--sched-dev-types-file", default=None, type=str)
     parser.add_argument("-sd", "--sched-dev-file", default=None, type=str)
@@ -718,15 +721,23 @@ def main():
             except ValueError as exc:
                 logger.warning("%s; falling back to host driver", exc)
                 comm = "host"
-        if comm == "dcn":
-            # waits for its own results/stop internally (multi-process)
-            run_pipeline_dcn(args, stage_layers, stage_quant, stage_ranks,
-                             ubatches, labels)
-        elif comm == "spmd":
-            run_pipeline_spmd(args, stage_layers, stage_quant, ubatches, labels)
-        else:
-            run_pipeline_host(args, stage_layers, stage_quant, stage_ranks,
-                              ubatches, labels)
+        from pipeedge_tpu.utils import tracing
+        trace_dir = args.trace
+        if trace_dir and comm == "dcn":
+            # per-rank session dirs: same-host ranks would otherwise clobber
+            # each other's hostname-keyed profile files
+            trace_dir = os.path.join(trace_dir, f"rank{args.rank}")
+        with tracing.trace(trace_dir):
+            if comm == "dcn":
+                # waits for its own results/stop internally (multi-process)
+                run_pipeline_dcn(args, stage_layers, stage_quant, stage_ranks,
+                                 ubatches, labels)
+            elif comm == "spmd":
+                run_pipeline_spmd(args, stage_layers, stage_quant, ubatches,
+                                  labels)
+            else:
+                run_pipeline_host(args, stage_layers, stage_quant, stage_ranks,
+                                  ubatches, labels)
         if comm != "dcn":
             assert results_counter.wait_gte(
                 sum(len(u) for u in ubatches), timeout=300)
