@@ -13,6 +13,7 @@ import logging
 import os
 from typing import Callable, Dict, List, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -110,11 +111,15 @@ def make_shard_config(model_name: str, layer_start: int, layer_end: int) -> Shar
 
 def module_shard_factory(model_name: str, model_file: Optional[str],
                          layer_start: int, layer_end: int, stage: int = 0,
-                         dtype=jnp.float32) -> Tuple[Callable, Dict, ShardConfig]:
+                         dtype=jnp.float32,
+                         params: Optional[Dict] = None) \
+        -> Tuple[Callable, Dict, ShardConfig]:
     """Build one pipeline stage: (jitted shard fn, params, shard config).
 
-    Parity with model_cfg.py:80-95. If the weights file is missing, falls back
-    to deterministic random initialization (same pytree structure) so the
+    Parity with model_cfg.py:80-95. `params` supplies a pre-restored
+    parameter pytree (e.g. an Orbax stage checkpoint) and skips weight-file
+    loading. Otherwise, if the weights file is missing, falls back to
+    deterministic random initialization (same pytree structure) so the
     framework runs end-to-end with zero egress; a warning is logged since
     outputs then aren't pretrained.
     """
@@ -122,7 +127,12 @@ def module_shard_factory(model_name: str, model_file: Optional[str],
     if model_file is None:
         model_file = entry.weights_file
     shard_config = make_shard_config(model_name, layer_start, layer_end)
-    if model_file and os.path.exists(model_file):
+    if params is not None:
+        params = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(x, dtype=dtype
+                                  if jnp.issubdtype(x.dtype, jnp.floating)
+                                  else None), params)
+    elif model_file and os.path.exists(model_file):
         with np.load(model_file) as weights:
             params = entry.family.load_params(entry.config, shard_config, weights,
                                               dtype=dtype)

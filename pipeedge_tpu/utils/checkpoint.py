@@ -1,0 +1,143 @@
+"""Sharded checkpoint save/load via Orbax (+ per-stage slicing).
+
+The reference's "checkpoint" story is npz weight archives where each stage
+lazily loads only its own layers' keys (SURVEY.md §5.4; reference
+vit.py:93-118). This module keeps that capability contract and adds the
+TPU-native format on top:
+
+- `save_params` / `load_params`: one parameter pytree <-> an Orbax
+  checkpoint directory (async-capable, content-addressed, the standard JAX
+  checkpoint format). `load_params` accepts a `shardings` pytree
+  (NamedSharding leaves) for sharded direct-to-device restore — each host
+  reads only the slices it owns, the Orbax equivalent of the reference's
+  lazy npz key loading.
+- `save_stage_checkpoints` / `load_stage_checkpoint`: materialize one
+  checkpoint per pipeline stage from a reference-format npz archive, so a
+  DCN rank restores exactly its stage shard from disk without ever touching
+  other stages' weights (parity with module_shard_factory's npz slicing,
+  registry.py:111-136).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+_MANIFEST = "manifest.json"
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+    return ocp.StandardCheckpointer()
+
+
+def save_params(path: str, params: Dict) -> None:
+    """Write a parameter pytree as an Orbax checkpoint at `path`."""
+    ckptr = _checkpointer()
+    ckptr.save(os.path.abspath(path), params, force=True)
+    ckptr.wait_until_finished()
+
+
+def load_params(path: str, shardings: Optional[Any] = None) -> Dict:
+    """Restore a pytree saved by `save_params`.
+
+    With `shardings` (a pytree of jax.sharding.Sharding congruent with the
+    saved tree, or a single Sharding applied to every leaf), leaves restore
+    directly into the requested placement.
+    """
+    ckptr = _checkpointer()
+    path = os.path.abspath(path)
+    if shardings is None:
+        # Don't trust saved sharding metadata: a checkpoint written on one
+        # topology (e.g. a TPU host) must restore on another (e.g. a CPU
+        # test process). Default every leaf onto the current backend.
+        shardings = jax.sharding.SingleDeviceSharding(jax.local_devices()[0])
+    meta = ckptr.metadata(path)
+    item_meta = getattr(meta, "item_metadata", meta)
+    single = isinstance(shardings, jax.sharding.Sharding)
+    if single:
+        target = jax.tree_util.tree_map(
+            lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype,
+                                           sharding=shardings), item_meta)
+    else:
+        target = jax.tree_util.tree_map(
+            lambda m, sh: jax.ShapeDtypeStruct(m.shape, m.dtype, sharding=sh),
+            item_meta, shardings)
+    return ckptr.restore(path, target)
+
+
+def stage_dir(root: str, stage: int) -> str:
+    return os.path.join(os.path.abspath(root), f"stage_{stage:03d}")
+
+
+def save_stage_checkpoints(model_name: str, npz_path: str, out_root: str,
+                           partition: Sequence[Tuple[int, int]],
+                           dtype=None) -> List[str]:
+    """Slice a reference-format npz into one Orbax checkpoint per stage.
+
+    Returns the per-stage checkpoint directories. Stage i's checkpoint holds
+    exactly the parameters `module_shard_factory` would build for
+    partition[i] — nothing else is read into memory at restore time.
+    """
+    import jax.numpy as jnp
+
+    from ..models import registry
+
+    if dtype is None:
+        dtype = jnp.float32
+    entry = registry.get_model_entry(model_name)
+    dirs = []
+    with np.load(npz_path) as weights:
+        for i, (l, r) in enumerate(partition):
+            sc = registry.make_shard_config(model_name, l, r)
+            params = entry.family.load_params(entry.config, sc, weights,
+                                              dtype=dtype)
+            d = stage_dir(out_root, i)
+            save_params(d, params)
+            dirs.append(d)
+    os.makedirs(os.path.abspath(out_root), exist_ok=True)
+    with open(os.path.join(os.path.abspath(out_root), _MANIFEST), "w",
+              encoding="utf8") as f:
+        json.dump({"model_name": model_name,
+                   "partition": [list(p) for p in partition]}, f)
+    return dirs
+
+
+def read_manifest(out_root: str) -> Optional[Dict]:
+    """The {model_name, partition} manifest written next to the stage dirs
+    (None for pre-manifest checkpoints)."""
+    path = os.path.join(os.path.abspath(out_root), _MANIFEST)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf8") as f:
+        return json.load(f)
+
+
+def check_stage_compatible(out_root: str, model_name: str, stage: int,
+                           layer_range: Tuple[int, int]) -> None:
+    """Raise if the checkpoint's conversion partition disagrees with the
+    runtime schedule — stage-index restore with a shifted partition would
+    otherwise load the wrong layers' weights silently."""
+    manifest = read_manifest(out_root)
+    if manifest is None:
+        return
+    if manifest["model_name"] != model_name:
+        raise ValueError(
+            f"stage checkpoint {out_root} is for model "
+            f"{manifest['model_name']!r}, not {model_name!r}")
+    saved = [tuple(p) for p in manifest["partition"]]
+    if stage >= len(saved) or saved[stage] != tuple(layer_range):
+        raise ValueError(
+            f"stage {stage} layer range {tuple(layer_range)} does not match "
+            f"checkpoint partition {saved} (re-run tools/"
+            f"convert_checkpoint.py with the runtime partition)")
+
+
+def load_stage_checkpoint(out_root: str, stage: int,
+                          shardings: Optional[Any] = None) -> Dict:
+    """Restore one stage's parameter pytree written by
+    `save_stage_checkpoints`."""
+    return load_params(stage_dir(out_root, stage), shardings=shardings)

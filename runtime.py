@@ -476,9 +476,19 @@ def run_pipeline_dcn(args, stage_layers, stage_quant, stage_ranks,
                     "one stage per rank (reference p2p semantics)"
                 i = my_stages[0]
                 l, r = stage_layers[i]
+                restored = None
+                if args.stage_ckpt:
+                    # per-stage Orbax restore: this rank reads exactly its
+                    # own shard from disk (utils/checkpoint.py); validated
+                    # against the runtime schedule via the manifest
+                    from pipeedge_tpu.utils import checkpoint as ckpt_utils
+                    ckpt_utils.check_stage_compatible(
+                        args.stage_ckpt, args.model_name, i, (l, r))
+                    restored = ckpt_utils.load_stage_checkpoint(
+                        args.stage_ckpt, i)
                 fn, params, _ = registry.module_shard_factory(
                     args.model_name, args.model_file, l, r, stage=i,
-                    dtype=dtype)
+                    dtype=dtype, params=restored)
                 in_bit = stage_quant[i - 1] if i > 0 else 0
                 out_bit = stage_quant[i] if i < len(stage_layers) - 1 else 0
                 is_first, is_last = i == 0, i == len(stage_layers) - 1
@@ -611,6 +621,10 @@ def main():
                         choices=registry.get_model_names())
     parser.add_argument("-M", "--model-file", type=str,
                         help="model weights file (.npz)")
+    parser.add_argument("--stage-ckpt", type=str, default=None, metavar="DIR",
+                        help="per-stage Orbax checkpoint root (from "
+                             "tools/convert_checkpoint.py); each dcn rank "
+                             "restores only its own stage shard")
     parser.add_argument("-b", "--batch-size", default=64, type=int)
     parser.add_argument("-u", "--ubatch-size", default=8, type=int)
     parser.add_argument("-t", "--dtype", default="float32",
@@ -658,6 +672,10 @@ def main():
     if args.platform == "cpu":
         from pipeedge_tpu.utils import force_host_cpu_devices
         force_host_cpu_devices(max(1, args.worldsize))
+
+    if args.stage_ckpt and args.comm != "dcn":
+        parser.error("--stage-ckpt is a dcn-mode option (per-rank restore); "
+                     "single-controller drivers load via -M/--model-file")
 
     if args.rank != 0 and args.comm != "dcn":
         logger.warning("Single-controller runtime: only rank 0 runs; "

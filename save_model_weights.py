@@ -76,12 +76,16 @@ if __name__ == "__main__":
                         help="Model name (default: all models)")
     parser.add_argument("--random", action='store_true',
                         help="generate randomly-initialized weights (offline)")
+    parser.add_argument("-o", "--output-dir", default=".",
+                        help="directory to write the npz files into")
     args = parser.parse_args()
 
+    os.makedirs(args.output_dir, exist_ok=True)
     model_names = registry.get_model_names() if args.model_name is None \
         else args.model_name
     for name in model_names:
-        model_file = registry.get_model_default_weights_file(name)
+        model_file = os.path.join(
+            args.output_dir, registry.get_model_default_weights_file(name))
         if os.path.exists(model_file):
             logger.info('%s: weights file already exists: %s', name, model_file)
             continue
