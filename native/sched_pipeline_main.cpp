@@ -60,6 +60,15 @@ miniyaml::NodePtr load_yaml_file(const std::string &path) {
   return miniyaml::parse(ss.str());
 }
 
+// "torch.float32" and "float32" name the same dtype: reference-format YAML
+// uses torch-style names, the TPU profiler writes bare jnp names — profiles
+// must match either way.
+std::string normalize_dtype(const std::string &dtype) {
+  const std::string prefix = "torch.";
+  if (dtype.rfind(prefix, 0) == 0) return dtype.substr(prefix.size());
+  return dtype;
+}
+
 std::size_t dtype_bytes(const std::string &dtype) {
   // torch-style and bare names; bf16/f16 are the TPU-native additions
   static const std::map<std::string, std::size_t> sizes = {
@@ -123,7 +132,8 @@ void load_device_types(PartitionProblem &prob, const miniyaml::Node &types,
     }
     const miniyaml::Node *match = nullptr;
     for (const auto &prof : model_prof->seq) {
-      if (prof->at("dtype").as_string() == dtype &&
+      if (normalize_dtype(prof->at("dtype").as_string()) ==
+              normalize_dtype(dtype) &&
           (std::size_t)prof->at("batch_size").as_int() == batch_size) {
         match = prof.get();
       }

@@ -19,6 +19,13 @@ _DTYPE_BYTES = {
 }
 
 
+def normalize_dtype(dtype: str) -> str:
+    """'torch.float32' and 'float32' name the same dtype: reference-format
+    YAML uses torch-style names, the TPU profiler writes bare jnp names.
+    Mirrors normalize_dtype in native/sched_pipeline_main.cpp."""
+    return dtype[len('torch.'):] if dtype.startswith('torch.') else dtype
+
+
 def _dtype_bytes(dtype: str) -> int:
     """Bytes for a single value of `dtype`."""
     return _DTYPE_BYTES[dtype]

@@ -48,6 +48,9 @@ def main():
     parser.add_argument("--no-warmup", action="store_false", dest="warmup")
     parser.add_argument("-i", "--iterations", default=16, type=int,
                         help="iterations to average runtime over")
+    parser.add_argument("--trace", type=str, default=None, metavar="DIR",
+                        help="capture a JAX profiler trace of the measured "
+                             "forwards into DIR")
     args = parser.parse_args()
 
     dtype = _DTYPES[args.dtype]
@@ -82,9 +85,11 @@ def main():
             "profile_data": [],
         }
 
-    results = prof.profile_layers_individually(
-        args.model_name, args.model_file, inputs, args.layer_start, layer_end,
-        args.warmup, args.iterations, dtype=dtype)
+    from pipeedge_tpu.utils import tracing
+    with tracing.trace(args.trace):
+        results = prof.profile_layers_individually(
+            args.model_name, args.model_file, inputs, args.layer_start,
+            layer_end, args.warmup, args.iterations, dtype=dtype)
 
     profile_results["profile_data"].extend(results)
     profile_results["profile_data"].sort(key=lambda pd: pd["layer"])

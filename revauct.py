@@ -20,6 +20,7 @@ from typing import Optional, Tuple
 
 import yaml
 
+from pipeedge_tpu import sched
 from pipeedge_tpu.models import registry
 from pipeedge_tpu.sched import revauct, yaml_files
 
@@ -35,7 +36,9 @@ def _find_profiles(yml_models, yml_dev_types, dev_type, model: str,
     yml_dtm_profile = None
     if yml_dev_type is not None:
         for prof in (yml_dev_type.get('model_profiles') or {}).get(model, []):
-            if prof['dtype'] == dtype and prof['batch_size'] == ubatch_size:
+            if sched.normalize_dtype(prof['dtype']) == \
+                    sched.normalize_dtype(dtype) and \
+                    prof['batch_size'] == ubatch_size:
                 yml_dtm_profile = prof
                 break
     return yml_model, yml_dev_type, yml_dtm_profile

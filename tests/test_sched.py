@@ -235,6 +235,26 @@ def test_bfloat16_dtype_supported(tmp_path):
     assert schedule == [{'h0': [1, n]}]
 
 
+def test_dtype_name_normalization(tmp_path):
+    """Profiles with bare jnp names match torch-style requests and vice
+    versa (the TPU profiler writes 'float32', reference files
+    'torch.float32')."""
+    n = 4
+    models = {'m': _mk_model(n, [1000] * n, [1.0] * n)}
+    for prof_dtype, req_dtype in (('float32', 'torch.float32'),
+                                  ('torch.float32', 'float32')):
+        device_types = {'dev': yaml_types.yaml_device_type(
+            1024, 1000,
+            {'m': [yaml_types.yaml_model_profile(prof_dtype, BATCH,
+                                                 [0.1] * n)]})}
+        devices = {'dev': ['h0']}
+        mf, tf, df = _write_files(tmp_path, models, device_types, devices)
+        schedule = sched_pipeline('m', 2, 2, BATCH, dtype=req_dtype,
+                                  models_file=mf, dev_types_file=tf,
+                                  dev_file=df)
+        assert schedule == [{'h0': [1, n]}], (prof_dtype, req_dtype)
+
+
 def test_unknown_model_errors(tmp_path):
     models = {'m': _mk_model(2, [10, 10], [1.0, 1.0])}
     device_types = {'dev': _mk_type(1024, 1000, [0.1, 0.1])}
