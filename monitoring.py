@@ -57,9 +57,11 @@ def init(key: str, window_size: int, work_type: str = 'items',
     global _monitor_ctx  # pylint: disable=global-statement
     log_name = key + '.csv'
     log_mode = os.getenv(ENV_CSV_FILE_MODE, _CSV_FILE_MODE)
+    from pipeedge_tpu.monitoring.energy import default_energy_source
     with _monitor_ctx_lock.lock_write():
         _monitor_ctx = MonitorContext(key=key, window_size=window_size,
-                                      log_name=log_name, log_mode=log_mode)
+                                      log_name=log_name, log_mode=log_mode,
+                                      energy_source=default_energy_source())
         logger.info("Monitoring energy source: %s", _monitor_ctx.energy_source)
         _monitor_ctx.open()
         _locks[key] = threading.Lock()

@@ -136,6 +136,7 @@ void load_device_types(PartitionProblem &prob, const miniyaml::Node &types,
               normalize_dtype(dtype) &&
           (std::size_t)prof->at("batch_size").as_int() == batch_size) {
         match = prof.get();
+        break;  // first match, like the reverse auction's matcher
       }
     }
     if (!match) {

@@ -9,6 +9,7 @@ import sys
 
 import yaml
 
+from pipeedge_tpu import sched
 from pipeedge_tpu.models import registry
 from pipeedge_tpu.sched import yaml_files, yaml_types
 
@@ -27,8 +28,10 @@ def is_dev_type_compatible(device_types, dev_type_name, mem, bwdth) -> bool:
 
 
 def is_model_profile_match(model_profile, dtype, batch_size) -> bool:
-    """dtype+batch_size is the unique profile key."""
-    return model_profile["dtype"] == dtype and \
+    """dtype+batch_size is the unique profile key ('float32' and
+    'torch.float32' are the same key — both schedulers normalize)."""
+    return sched.normalize_dtype(model_profile["dtype"]) == \
+        sched.normalize_dtype(dtype) and \
         model_profile["batch_size"] == batch_size
 
 

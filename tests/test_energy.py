@@ -1,0 +1,59 @@
+"""RAPL energy source: fake powercap sysfs tree, wraparound, fallback."""
+import os
+
+import pytest
+
+from pipeedge_tpu.monitoring import MonitorContext
+from pipeedge_tpu.monitoring.energy import (RaplEnergySource,
+                                            default_energy_source)
+
+
+def _mk_domain(root, n, energy_uj, max_range=1_000_000):
+    d = root / f"intel-rapl:{n}"
+    d.mkdir(parents=True)
+    (d / "energy_uj").write_text(str(energy_uj))
+    (d / "max_energy_range_uj").write_text(str(max_range))
+    return d
+
+
+def test_reads_and_sums_domains(tmp_path):
+    _mk_domain(tmp_path, 0, 100)
+    _mk_domain(tmp_path, 1, 50)
+    sub = tmp_path / "intel-rapl:0:0"  # subdomain must be ignored
+    sub.mkdir()
+    (sub / "energy_uj").write_text("999999")
+    src = RaplEnergySource(str(tmp_path))
+    src.init()
+    assert src.get_uj() == 150
+    assert src.get_source() == "RAPL(2 domains)"
+
+
+def test_wraparound(tmp_path):
+    d = _mk_domain(tmp_path, 0, 900, max_range=1000)
+    src = RaplEnergySource(str(tmp_path))
+    src.init()
+    assert src.get_uj() == 900
+    (d / "energy_uj").write_text("100")  # counter wrapped past 1000
+    assert src.get_uj() == 1100  # 100 + one full range
+    (d / "energy_uj").write_text("400")
+    assert src.get_uj() == 1400
+
+
+def test_default_source_fallback(tmp_path):
+    assert default_energy_source(str(tmp_path / "missing")) is None
+    _mk_domain(tmp_path, 0, 7)
+    src = default_energy_source(str(tmp_path))
+    assert src is not None
+    src.init()
+    assert src.get_uj() == 7
+
+
+def test_monitor_context_uses_energy(tmp_path):
+    d = _mk_domain(tmp_path, 0, 1000)
+    src = RaplEnergySource(str(tmp_path))
+    with MonitorContext(key="k", window_size=4, energy_source=src) as ctx:
+        ctx.iteration_start(key="k")
+        (d / "energy_uj").write_text("250000")  # +0.249 J
+        ctx.iteration(key="k", work=1)
+        assert ctx.get_instant_energy_j(key="k") == pytest.approx(0.249)
+        assert ctx.energy_source == "RAPL(1 domains)"

@@ -1,17 +1,22 @@
 """Generate an ansible playbook that launches the runtime across a fleet.
 
-Parity with /root/reference/tools/create_playbook.py:23-39. In the
-single-controller TPU world one host drives a whole slice, so the playbook
-has one task per *controller host* (each owning its slice) instead of one
-task per rank; the generated commands invoke this repo's runtime.py.
+Parity with /root/reference/tools/create_playbook.py:23-39. Two fleet
+shapes:
+
+- `-c spmd|host`: single-controller — one task per controller host, each
+  driving its whole slice (rank 0).
+- `-c dcn`: one rank per host (the reference's deployment shape), with
+  `--dcn-addrs` derived from the node list + `--port`.
 """
 import argparse
 
 
 def create_python_command(file_name, rank, world_size, partition, model_name,
-                          batch_size, ubatch_size, comm):
+                          batch_size, ubatch_size, comm, dcn_addrs=None):
     command = (f"python3 {file_name} {rank} {world_size} -m {model_name} "
                f"-pt {partition} -b {batch_size} -u {ubatch_size} -c {comm}")
+    if dcn_addrs:
+        command += f" --dcn-addrs {dcn_addrs}"
     print(command)
     return command
 
@@ -29,8 +34,24 @@ def create_shell_command(script, node_name, command, write_async=True,
 
 
 def create_script(script_name, node_list, file_name, world_size, partition,
-                  model_name, batch_size, ubatch_size, comm):
+                  model_name, batch_size, ubatch_size, comm, port=29600):
     with open(script_name, "w") as script:
+        if comm == "dcn":
+            # one rank per host (reference create_playbook.py:23-39); the
+            # data rank (0) runs last/synchronously so ansible waits on it
+            if world_size != len(node_list):
+                raise ValueError(
+                    f"dcn mode runs one rank per host: --world-size "
+                    f"{world_size} != {len(node_list)} nodes")
+            addrs = ",".join(f"{node}:{port}" for node in node_list)
+            for idx in range(len(node_list) - 1, -1, -1):
+                command = create_python_command(
+                    file_name, idx, len(node_list), partition, model_name,
+                    batch_size, ubatch_size, comm, dcn_addrs=addrs)
+                create_shell_command(script, node_list[idx], command,
+                                     write_async=idx != 0,
+                                     task_name=f"runtime rank {idx}")
+            return
         for idx, node in enumerate(node_list):
             command = create_python_command(file_name, 0, world_size, partition,
                                             model_name, batch_size, ubatch_size,
@@ -52,13 +73,16 @@ if __name__ == "__main__":
     parser.add_argument("-b", "--batch-size", default=64, type=int)
     parser.add_argument("-u", "--ubatch-size", default=8, type=int)
     parser.add_argument("-c", "--comm", default="spmd",
-                        choices=["spmd", "host"])
+                        choices=["spmd", "host", "dcn"])
+    parser.add_argument("-P", "--port", type=int, default=29600,
+                        help="per-rank listener port (dcn mode)")
     parser.add_argument("-nz", "--nodes", type=str, required=True,
-                        help="comma-delimited controller host names")
+                        help="comma-delimited controller host names "
+                             "(dcn: one rank per host, rank 0 = data rank)")
     parser.add_argument("-sn", "--script-name", default="playbook.yml")
     args = parser.parse_args()
 
     nodes = args.nodes.split(',')
     create_script(args.script_name, nodes, args.file_name, args.world_size,
                   args.partition, args.model_name, args.batch_size,
-                  args.ubatch_size, args.comm)
+                  args.ubatch_size, args.comm, port=args.port)
