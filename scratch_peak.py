@@ -1,0 +1,18 @@
+import time
+import jax, jax.numpy as jnp, numpy as np
+
+for n, dt in [(4096, jnp.bfloat16), (8192, jnp.bfloat16), (8192, jnp.float32)]:
+    a = jnp.asarray(np.random.default_rng(0).normal(size=(n, n)), dt)
+    b = jnp.asarray(np.random.default_rng(1).normal(size=(n, n)), dt)
+    @jax.jit
+    def mm(a, b):
+        c = a
+        for _ in range(8):
+            c = jnp.dot(c, b, preferred_element_type=jnp.float32).astype(dt)
+        return jnp.sum(c.astype(jnp.float32))
+    float(mm(a, b))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.monotonic(); float(mm(a, b)); best = min(best, time.monotonic()-t0)
+    tf = 8 * 2 * n**3 / best / 1e12
+    print(f"{n} {dt.__name__}: {tf:.1f} TFLOP/s", flush=True)

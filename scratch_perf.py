@@ -1,0 +1,37 @@
+import time, json
+import jax, jax.numpy as jnp, numpy as np
+from pipeedge_tpu.models import registry
+from pipeedge_tpu.models.shard import make_shard_fn
+
+name = "google/vit-large-patch16-224"
+entry = registry.get_model_entry(name)
+cfg = entry.config
+sc = registry.make_shard_config(name, 1, registry.get_model_layers(name))
+
+def bench(batch, n_ubatch, dtype):
+    params = entry.family.init_params(cfg, sc, dtype=dtype)
+    fn = make_shard_fn(entry.family.FAMILY, cfg, sc)
+    rng = np.random.default_rng(0)
+    xs = jax.device_put(jnp.asarray(rng.normal(size=(n_ubatch, batch, 3, 224, 224)), dtype=dtype))
+    params = jax.device_put(params)
+    @jax.jit
+    def run_all(p, xs):
+        def step(c, x):
+            return c + jnp.sum(fn(p, x).astype(jnp.float32)), None
+        t, _ = jax.lax.scan(step, jnp.float32(0), xs)
+        return t
+    float(run_all(params, xs))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.monotonic(); float(run_all(params, xs)); best = min(best, time.monotonic()-t0)
+    return n_ubatch*batch/best
+
+for batch, n_ub, dt, label in [
+    (8, 32, jnp.bfloat16, "b8 bf16 (bench)"),
+    (16, 16, jnp.bfloat16, "b16 bf16"),
+    (32, 8, jnp.bfloat16, "b32 bf16"),
+    (64, 4, jnp.bfloat16, "b64 bf16"),
+    (128, 2, jnp.bfloat16, "b128 bf16"),
+    (8, 32, jnp.float32, "b8 f32"),
+]:
+    print(label, round(bench(batch, n_ub, dt), 1), "img/s", flush=True)
