@@ -142,10 +142,8 @@ std::vector<StageAssignment> plan_partition(const PartitionProblem &prob) {
     i = static_cast<std::size_t>(p.layer);
     u = static_cast<std::size_t>(p.kind);
   }
-  std::sort(stages.begin(), stages.end(),
-            [](const StageAssignment &a, const StageAssignment &b) {
-              return a.layer_r < b.layer_l;
-            });
+  // Backtracking emits stages last-to-first; reversing restores layer order.
+  std::reverse(stages.begin(), stages.end());
   return stages;
 }
 

@@ -89,12 +89,17 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
+# Linux caps sendmsg at UIO_MAXIOV (1024) iovecs; frames with many tensors
+# (2 + ndim buffers each) must be sent in chunks or sendmsg fails EMSGSIZE.
+_MAX_IOVECS = 1000
+
+
 def _sendmsg_all(sock: socket.socket, parts: List) -> None:
     """Scatter-gather send of every buffer in `parts` (no flattening copy)."""
     bufs = [memoryview(p) for p in parts if len(p)]
     while bufs:
         try:
-            sent = sock.sendmsg(bufs)
+            sent = sock.sendmsg(bufs[:_MAX_IOVECS])
         except InterruptedError:
             continue
         while bufs and sent >= len(bufs[0]):
@@ -314,23 +319,38 @@ class DistDcnContext(DistContext):
         queue.Empty on timeout."""
         return self._queue_for(src, channel).get(timeout=timeout)
 
-    def cmd_broadcast(self, cmd: int,
-                      tensors: Sequence[np.ndarray] = ()) -> None:
-        """Send a command frame to every other rank (p2p:72-85). Best-effort:
-        an unreachable peer is logged and skipped, never letting one dead
-        rank block the command (CMD_STOP especially) from the rest."""
+    def cmd_broadcast(self, cmd: int, tensors: Sequence[np.ndarray] = (),
+                      best_effort: Optional[bool] = None) -> None:
+        """Send a command frame to every other rank (p2p:72-85).
+
+        Delivery policy: commands the fleet can survive missing (CMD_STOP —
+        receivers also have their own timeouts) are best-effort with a short
+        dial deadline, so one dead rank never stalls the broadcast. Every
+        other command (CMD_SCHED especially) retries dialing each peer until
+        the full CONNECT_TIMEOUT: a worker whose listener comes up seconds
+        after the data rank broadcasts must still receive the schedule — the
+        delivery guarantee the reference gets for free from its
+        init_process_group rendezvous (p2p:62)."""
+        if best_effort is None:
+            best_effort = cmd == CMD_STOP
+        dial_timeout = 5.0 if best_effort else None  # None = CONNECT_TIMEOUT
+        failures = []
         for dst in range(self._world_size):
             if dst == self._rank:
                 continue
             try:
                 with self._conn_locks[dst]:
-                    # short dial deadline: a peer that was never reachable
-                    # shouldn't stall the whole broadcast for CONNECT_TIMEOUT
-                    conn = self._ensure_conn(dst, timeout=5.0)
+                    conn = self._ensure_conn(dst, timeout=dial_timeout)
                     _send_frame(conn, _MSG_CMD, cmd, tensors)
             except OSError as exc:
+                # keep delivering to the remaining reachable peers either way
+                failures.append((dst, exc))
                 logger.warning("cmd_broadcast: rank %d unreachable (%s); "
                                "skipping", dst, exc)
+        if failures and not best_effort:
+            raise ConnectionError(
+                f"cmd_broadcast(cmd={cmd}): undeliverable to rank(s) "
+                + ", ".join(f"{d} ({e})" for d, e in failures))
 
 
 class DcnPipelineStage:

@@ -72,9 +72,16 @@ class RaplEnergySource(EnergySource):
                 with open(path, encoding="ascii") as f:
                     now = int(f.read().strip())
             except (OSError, ValueError):
+                # Transient read failure: report the last known value so the
+                # cumulative total never goes backwards (a dropped domain
+                # would make this iteration's delta hugely negative).
+                total += self._last[i] + self._wrap_uj[i]
                 continue
-            if now < self._last[i] and self._ranges[i] > 0:
-                self._wrap_uj[i] += self._ranges[i]
+            if now < self._last[i]:
+                # Counter wrapped. When the range is unreadable (rng==0),
+                # the best wrap estimate is the last observed value.
+                self._wrap_uj[i] += self._ranges[i] if self._ranges[i] > 0 \
+                    else self._last[i]
             self._last[i] = now
             total += now + self._wrap_uj[i]
         return total

@@ -145,6 +145,7 @@ class HostPipeline:
         t(last result) - t(first enqueue); throughput = total items / latency
         (reference runtime.py:493-505).
         """
+        ubatches = list(ubatches)  # single pass: generators welcome
         results: List[Any] = []
         inflight: List[Any] = []
         tik = time.monotonic()
@@ -160,7 +161,7 @@ class HostPipeline:
         latency = tok - tik
         stats = {"latency_sec": latency,
                  "throughput_items_sec": items / latency if latency > 0 else 0.0,
-                 "microbatches": len(list(ubatches))}
+                 "microbatches": len(ubatches)}
         return results, stats
 
     def _retire(self, item, results):

@@ -463,7 +463,13 @@ def run_pipeline_dcn(args, stage_layers, stage_quant, stage_ranks,
                 np.asarray(stage_ranks, np.int32)])
         else:
             # workers block until the schedule arrives (runtime.py:447-448)
-            tensors = sched_q.get(timeout=args.sched_timeout)
+            try:
+                tensors = sched_q.get(timeout=args.sched_timeout)
+            except queue.Empty:
+                raise RuntimeError(
+                    f"rank {rank}: no CMD_SCHED within {args.sched_timeout}s;"
+                    " is the data rank up and are --dcn-addrs consistent "
+                    "across ranks?") from None
             stage_layers = [tuple(map(int, lr)) for lr in tensors[0]]
             stage_quant = [int(q) for q in tensors[1]]
             stage_ranks = [int(r) for r in tensors[2]]
