@@ -132,6 +132,19 @@ def iteration_start(key: str) -> None:
             _monitor_ctx.iteration_start(iter_ctx=_iter_ctx_push(key))
 
 
+def iteration_abort(key: str) -> None:
+    """Discard a started iteration without emitting a heartbeat (e.g. a
+    transfer that failed mid-way); no-op if none was started."""
+    with _monitor_ctx_lock.lock_read():
+        if _monitor_ctx is None:
+            return
+        with _locks[key]:
+            try:
+                _iter_ctx_pop(key)
+            except KeyError:
+                pass
+
+
 def iteration(key: str, work: int = 1, accuracy: Union[int, float] = 0,
               safe: bool = True) -> None:
     """Complete an iteration; logs instant metrics each beat and window

@@ -66,8 +66,13 @@ _HEADER = struct.Struct('!BiBH')
 _TENSOR_HEADER = struct.Struct('!BB')  # dtype code, ndim
 _DIM = struct.Struct('!q')
 
-CHANNEL_DATA = 0     # inter-stage activations / head-stage feed
+CHANNEL_DATA = 0     # inter-stage activations
 CHANNEL_RESULTS = 1  # last stage -> data rank
+CHANNEL_FEED = 2     # data rank -> head stage (raw inputs). A separate
+# channel so feed traffic is distinguishable from pipeline-edge traffic:
+# the reference injects inputs *locally* (enqueue_tensor, p2p:442-450), so
+# its per-rank 'send' telemetry never contains feed bytes — keeping the
+# adaptive-quant policies' sensor clean. Monitoring hooks can filter on it.
 
 
 def _dtype_code(dtype: np.dtype) -> int:
@@ -124,8 +129,11 @@ def _send_frame(sock: socket.socket, msg_type: int, aux: int,
     _sendmsg_all(sock, parts)
 
 
-def _recv_frame(sock: socket.socket) -> Tuple[int, int, int, List[np.ndarray]]:
-    msg_type, aux, channel, n = _HEADER.unpack(_recv_exact(sock, _HEADER.size))
+def _recv_header(sock: socket.socket) -> Tuple[int, int, int, int]:
+    return _HEADER.unpack(_recv_exact(sock, _HEADER.size))
+
+
+def _recv_body(sock: socket.socket, n: int) -> List[np.ndarray]:
     tensors = []
     for _ in range(n):
         code, ndim = _TENSOR_HEADER.unpack(
@@ -138,7 +146,12 @@ def _recv_frame(sock: socket.socket) -> Tuple[int, int, int, List[np.ndarray]]:
         nbytes = dtype.itemsize * int(np.prod(shape, dtype=np.int64))
         payload = _recv_exact(sock, nbytes)
         tensors.append(np.frombuffer(payload, dtype=dtype).reshape(shape))
-    return msg_type, aux, channel, tensors
+    return tensors
+
+
+def _recv_frame(sock: socket.socket) -> Tuple[int, int, int, List[np.ndarray]]:
+    msg_type, aux, channel, n = _recv_header(sock)
+    return msg_type, aux, channel, _recv_body(sock, n)
 
 
 class DistDcnContext(DistContext):
@@ -173,6 +186,38 @@ class DistDcnContext(DistContext):
         self._recv_queues: Dict[Tuple[int, int], "queue.Queue"] = {}
         self._recv_lock = threading.Lock()
         self._stop = threading.Event()
+        # send/recv measurement hooks (reference p2p:132-152): pre fires just
+        # before the payload moves, post just after, so (post - pre) is the
+        # actual wire transfer time — excluding idle waits for data to exist.
+        self._send_pre_hook: Optional[Callable[[int, int], None]] = None
+        self._send_post_hook: Optional[
+            Callable[[int, int, Sequence[np.ndarray]], None]] = None
+        self._recv_pre_hook: Optional[Callable[[int, int], None]] = None
+        self._recv_post_hook: Optional[
+            Callable[[int, int, Sequence[np.ndarray]], None]] = None
+
+    def register_send_hooks(self, pre: Optional[Callable] = None,
+                            post: Optional[Callable] = None) -> None:
+        """Measure data sends: `pre(dst, channel)` before the frame hits the
+        socket, `post(dst, channel, tensors)` after the write completes
+        (reference register_send_pre/post_hook, p2p:132-142). Command and
+        HELLO frames are not measured."""
+        self._send_pre_hook = pre
+        self._send_post_hook = post
+
+    def register_recv_hooks(self, pre: Optional[Callable] = None,
+                            post: Optional[Callable] = None) -> None:
+        """Measure data receipt: `pre(src, channel)` after a frame header
+        arrives (payload incoming), `post(src, channel, tensors)` once the
+        payload is fully read — so the interval is transfer time, not idle
+        time (reference recv hooks run around the tensor payload reads,
+        p2p:236-244).
+
+        After `pre` fires, `post` is ALWAYS called — with `tensors=None` if
+        the transfer aborted mid-payload (peer death) — so hooks that pair
+        start/stop measurements never leak a started measurement."""
+        self._recv_pre_hook = pre
+        self._recv_post_hook = post
 
     # -- lifecycle -----------------------------------------------------
 
@@ -251,7 +296,22 @@ class DistDcnContext(DistContext):
                 logger.error("peer spoke before HELLO; dropping connection")
                 return
             while not self._stop.is_set():
-                msg_type, aux, channel, tensors = _recv_frame(conn)
+                msg_type, aux, channel, n_tensors = _recv_header(conn)
+                hooked = (msg_type == _MSG_TENSORS
+                          and self._recv_pre_hook is not None)
+                if hooked:
+                    self._recv_pre_hook(src, channel)
+                try:
+                    tensors = _recv_body(conn, n_tensors)
+                except Exception:
+                    # abort notification: a paired measurement started by the
+                    # pre hook must be discarded, or this (recyclable) thread
+                    # ident leaks a dangling iteration context
+                    if hooked and self._recv_post_hook is not None:
+                        self._recv_post_hook(src, channel, None)
+                    raise
+                if msg_type == _MSG_TENSORS and self._recv_post_hook is not None:
+                    self._recv_post_hook(src, channel, tensors)
                 if msg_type == _MSG_TENSORS:
                     # blocks when the consumer is behind: TCP backpressure
                     # propagates the stall to the sender (reference
@@ -311,7 +371,17 @@ class DistDcnContext(DistContext):
         """Send a tensor list to `dst` (reference _send_tensor, p2p:96-108)."""
         with self._conn_locks[dst]:
             conn = self._ensure_conn(dst)
-            _send_frame(conn, _MSG_TENSORS, self._rank, tensors, channel)
+            if self._send_pre_hook is not None:
+                self._send_pre_hook(dst, channel)
+            try:
+                _send_frame(conn, _MSG_TENSORS, self._rank, tensors, channel)
+            except Exception:
+                if self._send_pre_hook is not None \
+                        and self._send_post_hook is not None:
+                    self._send_post_hook(dst, channel, None)  # abort
+                raise
+            if self._send_post_hook is not None:
+                self._send_post_hook(dst, channel, tensors)
 
     def recv_tensors(self, src: int, timeout: Optional[float] = None,
                      channel: int = CHANNEL_DATA) -> List[np.ndarray]:
@@ -333,14 +403,20 @@ class DistDcnContext(DistContext):
         init_process_group rendezvous (p2p:62)."""
         if best_effort is None:
             best_effort = cmd == CMD_STOP
-        dial_timeout = 5.0 if best_effort else None  # None = CONNECT_TIMEOUT
+        # One deadline shared across the whole broadcast: several dead peers
+        # cost at most ~CONNECT_TIMEOUT total, not CONNECT_TIMEOUT each
+        # (already-connected and live peers dial in milliseconds regardless
+        # of their position in the loop).
+        deadline = time.monotonic() + (5.0 if best_effort
+                                       else self.CONNECT_TIMEOUT)
         failures = []
         for dst in range(self._world_size):
             if dst == self._rank:
                 continue
             try:
                 with self._conn_locks[dst]:
-                    conn = self._ensure_conn(dst, timeout=dial_timeout)
+                    remaining = max(1.0, deadline - time.monotonic())
+                    conn = self._ensure_conn(dst, timeout=remaining)
                     _send_frame(conn, _MSG_CMD, cmd, tensors)
             except OSError as exc:
                 # keep delivering to the remaining reachable peers either way

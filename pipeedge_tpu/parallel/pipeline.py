@@ -118,7 +118,9 @@ class HostPipeline:
     """
 
     def __init__(self, stages: Sequence[PipelineStage], max_inflight: int = 0,
-                 ubatch_callback: Optional[Callable[[int, Any], None]] = None):
+                 ubatch_callback: Optional[Callable[[int, Any], None]] = None,
+                 edge_bytes_callback: Optional[
+                     Callable[[int, List[int]], None]] = None):
         if not stages:
             raise ValueError("pipeline needs at least one stage")
         self.stages = list(stages)
@@ -126,16 +128,25 @@ class HostPipeline:
         # analog of the reference's buffers_in=2/buffers_out=2 (sched model).
         self.max_inflight = max_inflight or 2 * len(self.stages)
         self.ubatch_callback = ubatch_callback
+        # called at each microbatch's retirement with the per-edge wire byte
+        # counts [stage0->1, stage1->2, ...] of that microbatch — the
+        # single-controller analogue of the reference's per-rank send
+        # monitoring hooks (p2p:132-152, runtime.py:219-230)
+        self.edge_bytes_callback = edge_bytes_callback
 
-    def enqueue(self, ubatch):
+    def enqueue(self, ubatch, edge_bytes: Optional[List[int]] = None):
         """Dispatch one microbatch through all stages; returns the (device-
-        resident, not yet materialized) final payload."""
+        resident, not yet materialized) final payload. When `edge_bytes` is a
+        list, it receives the wire byte count of each inter-stage edge."""
         data = ubatch
+        last = len(self.stages) - 1
         for i, stage in enumerate(self.stages):
             # named profiler region: stage dispatch shows up on the trace
             # timeline (see utils/tracing.py; no-op cost when not tracing)
             with tracing.annotate(stage.name or f"stage{i}"):
                 data = stage(data)
+            if edge_bytes is not None and i < last:
+                edge_bytes.append(payload_wire_bytes(data))
         return _undequantized_guard(data)
 
     def run(self, ubatches: Sequence[Any]) -> Tuple[List[Any], Dict[str, float]]:
@@ -148,10 +159,12 @@ class HostPipeline:
         ubatches = list(ubatches)  # single pass: generators welcome
         results: List[Any] = []
         inflight: List[Any] = []
+        track_edges = self.edge_bytes_callback is not None
         tik = time.monotonic()
         for i, ubatch in enumerate(ubatches):
-            out = self.enqueue(ubatch)
-            inflight.append((i, out))
+            edge_bytes: Optional[List[int]] = [] if track_edges else None
+            out = self.enqueue(ubatch, edge_bytes)
+            inflight.append((i, out, edge_bytes))
             while len(inflight) >= self.max_inflight:
                 self._retire(inflight.pop(0), results)
         while inflight:
@@ -165,8 +178,10 @@ class HostPipeline:
         return results, stats
 
     def _retire(self, item, results):
-        i, out = item
+        i, out, edge_bytes = item
         out = jax.block_until_ready(out)
+        if self.edge_bytes_callback is not None:
+            self.edge_bytes_callback(i, edge_bytes)
         if self.ubatch_callback is not None:
             self.ubatch_callback(i, out)
         results.append(out)
@@ -175,6 +190,23 @@ class HostPipeline:
 def _leading_dim(ubatch) -> int:
     t = ubatch[0] if isinstance(ubatch, tuple) else ubatch
     return int(t.shape[0])
+
+
+def payload_wire_bytes(payload) -> int:
+    """Bytes a stage-output payload puts on the inter-stage edge.
+
+    For quantized payloads this counts the packed words plus scale/shift
+    metadata (everything that actually travels, `QuantizedTensor.nbytes_wire`
+    + per-item scalars); raw payloads count their array bytes. Shapes are
+    known without materializing, so this never fences the device."""
+    tensors = payload if isinstance(payload, tuple) else (payload,)
+    total = 0
+    for t in tensors:
+        if isinstance(t, quant_ops.QuantizedTensor):
+            total += t.nbytes_wire + t.scale.nbytes + t.shift.nbytes
+        else:
+            total += t.nbytes
+    return total
 
 
 def _undequantized_guard(data):

@@ -225,16 +225,23 @@ def load_dataset(dataset_cfg: dict, model_name: str, batch_size: int,
     return dataset
 
 
-def _make_adaptive_callback(stages, window_size: int):
+def _make_adaptive_callback(edge_stages, window_size: int, edge_keys=None):
     """Window-period bitwidth adaptation (reference runtime.py:121-216).
 
-    Runs host-side between microbatches, reading the 'send' monitor window
-    and mutating each non-final stage's quant_bit; the host pipeline swaps in
-    the pre-compiled program for the chosen bitwidth.
+    `edge_stages` are the stages whose *output* edge is adaptive (i.e. all but
+    the final stage); each must expose a mutable `quant_bit`. `edge_keys[i]`
+    names the monitoring key carrying stage i's edge telemetry (wire Mbits
+    per microbatch) — per-edge windows, so each stage adapts on its OWN
+    edge's measured traffic, exactly as each reference rank reads its own
+    local 'send' window (reference runtime.py:123-127). Default: every stage
+    reads MONITORING_KEY_SEND (the per-process key — correct for a DCN rank,
+    which owns exactly one edge).
     """
     policy = os.getenv(ENV_ADAPTIVE_QUANT)
     if not policy:
         return None
+    if edge_keys is None:
+        edge_keys = [MONITORING_KEY_SEND] * len(edge_stages)
     rate_constraint = float(os.getenv(ENV_SEND_CONSTRAINT, "0"))
     controllers = {}
     ctl_state = {}
@@ -244,7 +251,7 @@ def _make_adaptive_callback(stages, window_size: int):
         if tag % window_size != 0:
             # controller policy counts down its bitwidth1 window split
             if policy == ADAPTIVE_QUANT_CONTROLLER:
-                for stage in stages[:-1]:
+                for stage in edge_stages:
                     st = ctl_state.get(id(stage))
                     if st:
                         bw1, bw2, it1 = st
@@ -252,14 +259,16 @@ def _make_adaptive_callback(stages, window_size: int):
                             quantutil.BITWIDTHS)
                         ctl_state[id(stage)] = (bw1, bw2, max(0, it1 - 1))
             return
-        with monitoring.get_locked_context(MONITORING_KEY_SEND) as mctx:
-            if mctx is None:
-                return
-            window_perf = mctx.get_window_perf(key=MONITORING_KEY_SEND)
-            window_work = mctx.get_window_work(key=MONITORING_KEY_SEND)
-            heartrate = mctx.get_window_heartrate(key=MONITORING_KEY_SEND)
-        ubatch_size = get_microbatch_size(np.asarray(out))
-        for stage in stages[:-1]:
+        out_arr = np.asarray(out[0] if isinstance(out, tuple) else out)
+        ubatch_size = get_microbatch_size(out_arr)
+        for stage_idx, stage in enumerate(edge_stages):
+            key = edge_keys[stage_idx]
+            with monitoring.get_locked_context(key) as mctx:
+                if mctx is None:
+                    return
+                window_perf = mctx.get_window_perf(key=key)
+                window_work = mctx.get_window_work(key=key)
+                heartrate = mctx.get_window_heartrate(key=key)
             if policy == ADAPTIVE_QUANT_HEURISTIC:
                 # discrete compress-ratio ladder (runtime.py:121-154)
                 if rate_constraint > 0:
@@ -306,6 +315,57 @@ def _make_adaptive_callback(stages, window_size: int):
     return callback
 
 
+class _EdgeQuantState:
+    """Mutable output-edge bitwidth for a DCN rank — the role of the
+    reference's non-persistent `quant_bit` module buffer that adaptive hooks
+    mutate (reference runtime.py:464-467, 143-153)."""
+
+    def __init__(self, quant_bit: int):
+        self.quant_bit = quant_bit
+
+
+def _register_dcn_monitor_hooks(ctx) -> None:
+    """Wire send/recv transport hooks to the monitoring keys, measuring the
+    actual bytes and transfer time of every pipeline-edge frame on this rank
+    (reference p2p:132-152 + runtime.py:219-230).
+
+    Feed-channel frames (raw inputs from the data rank to the head stage)
+    are excluded: the reference injects inputs locally (enqueue_tensor), so
+    its 'send' telemetry — the adaptive policies' sensor — never contains
+    feed bytes. A colocated data rank + stage would otherwise pollute the
+    stage's edge window with uncompressed feed traffic."""
+    from pipeedge_tpu.comm import dcn
+
+    def send_pre(dst, channel):
+        if channel != dcn.CHANNEL_FEED:
+            monitoring.iteration_start(MONITORING_KEY_SEND)
+
+    def send_post(dst, channel, tensors):
+        if channel == dcn.CHANNEL_FEED:
+            return
+        if tensors is None:  # transfer aborted mid-frame
+            monitoring.iteration_abort(MONITORING_KEY_SEND)
+            return
+        mbits = sum(int(t.nbytes) for t in tensors) * 8 / 1e6
+        monitoring.iteration(MONITORING_KEY_SEND, work=mbits)
+
+    def recv_pre(src, channel):
+        if channel != dcn.CHANNEL_FEED:
+            monitoring.iteration_start(MONITORING_KEY_RECV)
+
+    def recv_post(src, channel, tensors):
+        if channel == dcn.CHANNEL_FEED:
+            return
+        if tensors is None:
+            monitoring.iteration_abort(MONITORING_KEY_RECV)
+            return
+        mbits = sum(int(t.nbytes) for t in tensors) * 8 / 1e6
+        monitoring.iteration(MONITORING_KEY_RECV, work=mbits)
+
+    ctx.register_send_hooks(send_pre, send_post)
+    ctx.register_recv_hooks(recv_pre, recv_post)
+
+
 def run_pipeline_host(args, stage_layers, stage_quant, stage_ranks,
                       ubatches, labels) -> None:
     """Host-driven pipeline (arbitrary cut points, adaptive quantization)."""
@@ -319,21 +379,35 @@ def run_pipeline_host(args, stage_layers, stage_quant, stage_ranks,
         devices=[devices[r % len(devices)] for r in stage_ranks],
         quant_bits=stage_quant, dtype=dtype)
     window_size = get_window_size()
-    adaptive = _make_adaptive_callback(pipe.stages, window_size)
+    # Per-edge telemetry: one monitoring key per inter-stage edge, fed with
+    # that edge's actual wire bytes each microbatch (the per-rank 'send' key
+    # of the reference, p2p:132-152 + runtime.py:219-230 — qualified by
+    # stage index since one controller process owns every edge). The plain
+    # 'send' key aggregates all edges per microbatch.
+    edge_keys = [f"{MONITORING_KEY_SEND}{i}"
+                 for i in range(len(pipe.stages) - 1)]
+    for key in edge_keys:
+        monitoring.add_key(key, work_type='Mbits')
+    adaptive = _make_adaptive_callback(pipe.stages[:-1], window_size,
+                                       edge_keys=edge_keys)
 
     for lb in labels:
         label_queue.put(lb)
 
+    def on_edge_bytes(i, edge_bytes):
+        total_mbits = 0.0
+        for key, nbytes in zip(edge_keys, edge_bytes):
+            mbits = nbytes * 8 / 1e6
+            total_mbits += mbits
+            monitoring.iteration(key, work=mbits, safe=False)
+        monitoring.iteration(MONITORING_KEY_SEND, work=total_mbits, safe=False)
+
     def on_result(i, out):
-        # send monitor: wire bytes of the quantized edge payloads (Mbits),
-        # the reference's p2p_post_hook_monitor semantics (runtime.py:219-230)
-        mbits = sum(np.asarray(t).nbytes for t in
-                    (out if isinstance(out, tuple) else (out,))) * 8 / 1e6
-        monitoring.iteration(MONITORING_KEY_SEND, work=mbits, safe=False)
         handle_results(out)
         if adaptive is not None:
             adaptive(i, out)
 
+    pipe.edge_bytes_callback = on_edge_bytes
     pipe.ubatch_callback = on_result
     tik = time.monotonic()
     _, stats = pipe.run([jnp.asarray(u, dtype=dtype if u.dtype.kind == 'f'
@@ -384,19 +458,22 @@ def _native_wire_codec(bit: int):
 
 
 def _wire_encode(out, bit: int) -> List[np.ndarray]:
-    """Stage output -> wire tensor list. bit>0 packs each payload tensor into
-    [packed_uint32, scale, shift, shape] quadruples (the reference's 5-tuple
-    wire format, basic_op.py:114-143; bit is schedule metadata both ends
-    know, so it doesn't travel). Packing runs in the native codec when built
-    (host-side, off the accelerator), else via the XLA ops."""
+    """Stage output -> wire tensor list: a scalar int32 bitwidth header, then
+    per payload tensor either the raw array (bit=0) or a [packed_uint32,
+    scale, shift, shape] quadruple. The bitwidth travels ON the wire — the
+    reference ships it as the 5th element of every encoded tensor
+    (basic_op.py:143) — so the consumer can decode even when the producer's
+    adaptive policy changes the bitwidth mid-run. Packing runs in the native
+    codec when built (host-side, off the accelerator), else via the XLA
+    ops."""
     import jax.numpy as jnp
 
     from pipeedge_tpu.ops import quant as quant_ops
     tensors = out if isinstance(out, tuple) else (out,)
+    wire = [np.asarray(bit, np.int32)]
     if bit == 0:
-        return [np.asarray(t) for t in tensors]
+        return wire + [np.asarray(t) for t in tensors]
     native = _native_wire_codec(bit)
-    wire = []
     for t in tensors:
         if native is not None:
             arr = np.asarray(t, np.float32)
@@ -409,11 +486,14 @@ def _wire_encode(out, bit: int) -> List[np.ndarray]:
     return wire
 
 
-def _wire_decode(tensors: List[np.ndarray], bit: int, dtype):
-    """Inverse of `_wire_encode`; returns the stage payload (tensor/tuple)."""
+def _wire_decode(tensors: List[np.ndarray], dtype):
+    """Inverse of `_wire_encode` (bitwidth read from the wire header);
+    returns the stage payload (tensor/tuple)."""
     import jax.numpy as jnp
 
     from pipeedge_tpu.ops import quant as quant_ops
+    bit = int(tensors[0])
+    tensors = tensors[1:]
     if bit == 0:
         out = tuple(jnp.asarray(t) for t in tensors)
     else:
@@ -454,6 +534,7 @@ def run_pipeline_dcn(args, stage_layers, stage_quant, stage_ranks,
 
     with dcn.DistDcnContext(world_size, rank, addrs,
                             cmd_handler=handle_cmd) as ctx:
+        _register_dcn_monitor_hooks(ctx)
         if rank == data_rank:
             # schedule was resolved by the caller; broadcast it (CMD_SCHED,
             # reference runtime.py:441-445)
@@ -495,9 +576,17 @@ def run_pipeline_dcn(args, stage_layers, stage_quant, stage_ranks,
                 fn, params, _ = registry.module_shard_factory(
                     args.model_name, args.model_file, l, r, stage=i,
                     dtype=dtype, params=restored)
-                in_bit = stage_quant[i - 1] if i > 0 else 0
                 out_bit = stage_quant[i] if i < len(stage_layers) - 1 else 0
                 is_first, is_last = i == 0, i == len(stage_layers) - 1
+                # adaptive policy (env ADAPTIVE_QUANT): this rank adapts its
+                # own output edge on its own measured 'send' window, exactly
+                # the reference's per-rank hook (runtime.py:121-216). The
+                # bitwidth travels on the wire, so the consumer needs no
+                # coordination.
+                edge = None if is_last else _EdgeQuantState(out_bit)
+                adaptive = None if edge is None else _make_adaptive_callback(
+                    [edge], get_window_size())
+                ubatch_idx = [0]
 
                 def work_cb(tensors):
                     if is_first:
@@ -505,7 +594,7 @@ def run_pipeline_dcn(args, stage_layers, stage_quant, stage_ranks,
                                               if tensors[0].dtype.kind == 'f'
                                               else None)
                     else:
-                        payload = _wire_decode(tensors, in_bit, dtype)
+                        payload = _wire_decode(tensors, dtype)
                     monitoring.iteration_start(MONITORING_KEY_MODEL)
                     out = fn(params, payload)
                     out = jax.block_until_ready(out)
@@ -513,17 +602,26 @@ def run_pipeline_dcn(args, stage_layers, stage_quant, stage_ranks,
                         out[0] if isinstance(out, tuple) else out))
                     monitoring.iteration(MONITORING_KEY_MODEL, work=n_items,
                                          accuracy=r - l + 1)
-                    return _wire_encode(out, out_bit)
+                    wire = _wire_encode(
+                        out, edge.quant_bit if edge is not None else 0)
+                    if adaptive is not None:
+                        adaptive(ubatch_idx[0],
+                                 out[0] if isinstance(out, tuple) else out)
+                        ubatch_idx[0] += 1
+                    return wire
 
                 # head stage is fed over the wire from the data rank
-                # (self-connection over loopback when colocated); the last
-                # stage's results ride a separate wire channel so a
-                # single-stage colocated schedule can't mix its own input
-                # feed with its results
+                # (self-connection over loopback when colocated) on the FEED
+                # channel; the last stage's results ride the RESULTS channel.
+                # Distinct channels keep a colocated schedule's feed, edge,
+                # and result streams demultiplexed — and keep feed bytes out
+                # of the adaptive policies' edge telemetry.
                 rank_src = stage_ranks[i - 1] if not is_first else data_rank
                 rank_dst = stage_ranks[i + 1] if not is_last else data_rank
                 stage = dcn.DcnPipelineStage(
                     ctx, rank_src, rank_dst, work_cb,
+                    recv_channel=dcn.CHANNEL_FEED if is_first
+                    else dcn.CHANNEL_DATA,
                     send_channel=dcn.CHANNEL_RESULTS if is_last
                     else dcn.CHANNEL_DATA)
                 stage.start()
@@ -535,9 +633,11 @@ def run_pipeline_dcn(args, stage_layers, stage_quant, stage_ranks,
                     label_queue.put(lb)
                 first_rank = stage_ranks[0]
                 last_rank = stage_ranks[-1]
-                last_bit = 0  # final stage output is never quantized
 
                 def results_loop():
+                    # wire Mbits/time are measured by the transport recv
+                    # hooks (_register_dcn_monitor_hooks) on the reader
+                    # thread; this loop only consumes decoded results
                     for _ in range(len(ubatches)):
                         if stop_event.is_set():
                             return
@@ -547,11 +647,7 @@ def run_pipeline_dcn(args, stage_layers, stage_quant, stage_ranks,
                                 channel=dcn.CHANNEL_RESULTS)
                         except queue.Empty:
                             return
-                        out = _wire_decode(tensors, last_bit, dtype)
-                        mbits = sum(np.asarray(t).nbytes for t in tensors) \
-                            * 8 / 1e6
-                        monitoring.iteration(MONITORING_KEY_RECV, work=mbits,
-                                             safe=False)
+                        out = _wire_decode(tensors, dtype)
                         handle_results(np.asarray(out))
 
                 results_thread = threading.Thread(target=results_loop,
@@ -560,7 +656,8 @@ def run_pipeline_dcn(args, stage_layers, stage_quant, stage_ranks,
                 try:
                     tik = time.monotonic()
                     for u in ubatches:
-                        ctx.send_tensors(first_rank, [np.asarray(u)])
+                        ctx.send_tensors(first_rank, [np.asarray(u)],
+                                         channel=dcn.CHANNEL_FEED)
                     batch_total = sum(len(u) for u in ubatches)
                     complete = results_counter.wait_gte(
                         batch_total, timeout=args.sched_timeout)

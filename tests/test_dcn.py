@@ -149,6 +149,36 @@ def test_cmd_broadcast_reaches_all_peers():
             c.shutdown()
 
 
+def test_send_recv_hooks_measure_wire_bytes():
+    """Transport hooks see every data frame's actual bytes (reference
+    p2p:132-152); command frames are not measured."""
+    ctxs = _make_contexts(2)
+    events = {"send_pre": [], "send": [], "recv_pre": [], "recv": []}
+    ctxs[0].register_send_hooks(
+        lambda dst, ch: events["send_pre"].append((dst, ch)),
+        lambda dst, ch, ts: events["send"].append(
+            (dst, ch, sum(t.nbytes for t in ts))))
+    ctxs[1].register_recv_hooks(
+        lambda src, ch: events["recv_pre"].append((src, ch)),
+        lambda src, ch, ts: events["recv"].append(
+            (src, ch, sum(t.nbytes for t in ts))))
+    try:
+        x = np.zeros((4, 8), np.float32)
+        y = np.arange(6, dtype=np.int64)
+        ctxs[0].send_tensors(1, [x, y], channel=dcn.CHANNEL_DATA)
+        ctxs[1].recv_tensors(0, timeout=10)
+        ctxs[0].cmd_broadcast(CMD_STOP)
+        time.sleep(0.3)  # let rank 1's reader drain the command frame
+        nbytes = x.nbytes + y.nbytes
+        assert events["send_pre"] == [(1, dcn.CHANNEL_DATA)]
+        assert events["send"] == [(1, dcn.CHANNEL_DATA, nbytes)]
+        assert events["recv_pre"] == [(0, dcn.CHANNEL_DATA)]
+        assert events["recv"] == [(0, dcn.CHANNEL_DATA, nbytes)]
+    finally:
+        for c in ctxs:
+            c.shutdown()
+
+
 # -- pipeline stages ---------------------------------------------------
 
 def test_three_stage_pipeline_matches_single_shard():

@@ -39,3 +39,40 @@ def test_two_process_dcn_runtime_quantized_edge(tmp_path):
     assert "latency_sec=" in data.stdout
     assert worker.returncode == 0, wout
     assert "======= pipeedge/test-tiny-vit stage 1: layers [5, 8]" in wout
+
+
+def test_two_process_dcn_adaptive_quant(tmp_path):
+    """Adaptive quantization over DCN: rank 0 (stage 0) measures its own
+    send window via the transport hooks and adapts its output-edge bitwidth;
+    the bitwidth rides the wire header so rank 1 decodes without
+    coordination (reference per-rank policy, runtime.py:121-216)."""
+    addrs = ",".join(f"127.0.0.1:{p}" for p in _free_ports(2))
+    common = [sys.executable, os.path.join(REPO, "runtime.py")]
+    opts = ["-c", "dcn", "--platform", "cpu",
+            "-m", "pipeedge/test-tiny-vit", "-b", "24", "-u", "4",
+            "-pt", "1,4,5,8", "-q", "8,0", "-r", "0,1",
+            "--dcn-addrs", addrs, "--sched-timeout", "120"]
+    rank_dirs = []
+    for r in range(2):
+        d = tmp_path / f"rank{r}"
+        d.mkdir()
+        rank_dirs.append(d)
+    env = dict(os.environ, PYTHONPATH=REPO, ADAPTIVE_QUANT="HEURISTIC",
+               SEND_CONSTRAINT="100", WINDOW_SIZE="3")
+    worker = subprocess.Popen(common + ["1", "2"] + opts, cwd=rank_dirs[1],
+                              env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+    try:
+        data = subprocess.run(common + ["0", "2"] + opts, cwd=rank_dirs[0],
+                              env=env, capture_output=True, text=True,
+                              timeout=240)
+        wout, _ = worker.communicate(timeout=60)
+    finally:
+        worker.kill()
+    assert data.returncode == 0, data.stdout + data.stderr
+    assert worker.returncode == 0, wout
+    # the data rank hosts stage 0, whose policy adapts its output edge
+    assert "Adaptive quantization" in data.stdout + data.stderr
+    # transport hooks produced per-rank wire telemetry CSVs
+    assert (rank_dirs[0] / "send.csv").exists()
+    assert (rank_dirs[1] / "recv.csv").exists()

@@ -102,6 +102,28 @@ def test_scheduler_driven_partition(tmp_path):
     assert _throughput(proc) > 0
 
 
+def test_per_edge_send_telemetry_csvs(tmp_path):
+    """Each inter-stage edge gets its own send telemetry key/CSV with real
+    wire bytes per microbatch (VERDICT r1 #1): the 8-bit quantized edge 0
+    reports far fewer Mbits than the raw mid-block edge 1."""
+    import csv as csvmod
+    proc = _run(tmp_path, "0", "3", "-m", MODEL, "-pt", "1,4,5,6,7,8",
+                "-q", "8,0,0", "-b", "8", "-u", "2")
+    assert proc.returncode == 0, proc.stderr
+    rows = {}
+    for key in ("send0", "send1", "send"):
+        f = tmp_path / f"{key}.csv"
+        assert f.exists(), f"missing {key}.csv"
+        with open(f) as fh:
+            rows[key] = list(csvmod.DictReader(fh))
+    # 4 microbatches -> >=3 beats per edge (the first call starts the clock)
+    assert len(rows["send0"]) >= 3
+    assert len(rows["send0"]) == len(rows["send1"])
+    w0 = float(rows["send0"][-1]["Work"])
+    w1 = float(rows["send1"][-1]["Work"])
+    assert 0 < w0 < w1 / 3
+
+
 def test_adaptive_quant_heuristic(tmp_path):
     proc = _run(tmp_path, "0", "2", "-m", MODEL, "-pt", "1,4,5,8",
                 "-q", "8,0", "-b", "12", "-u", "2",

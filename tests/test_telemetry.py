@@ -1,0 +1,113 @@
+"""Per-edge send/recv telemetry feeding the adaptive quantization policies.
+
+The reference measures each rank's real wire traffic with send/recv hooks
+(reference comm/p2p/__init__.py:132-152, runtime.py:219-230) and each rank's
+adaptive policy steers on its OWN send window (runtime.py:121-216). These
+tests pin the single-controller equivalents: host-pipeline edges report their
+actual wire bytes (packed size when quantized), the adaptive callback
+consumes per-edge monitoring windows (a throttled edge's stage drops its
+bitwidth while an uncongested edge's stage does not), and the DCN wire
+format carries the bitwidth on the wire so a producer can change it mid-run.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import monitoring
+import runtime
+from pipeedge_tpu.monitoring import MonitorIterationContext
+from pipeedge_tpu.ops import quant as quant_ops
+from pipeedge_tpu.parallel import pipeline as host_pipeline
+
+
+def test_payload_wire_bytes_quantized_vs_raw():
+    x = jnp.zeros((2, 8, 16), jnp.float32)
+    raw = host_pipeline.payload_wire_bytes(x)
+    assert raw == 2 * 8 * 16 * 4
+    enc = quant_ops.tensor_encode_outerdim(x, 8)
+    q = host_pipeline.payload_wire_bytes(enc)
+    assert q == enc.nbytes_wire + enc.scale.nbytes + enc.shift.nbytes
+    assert q < raw / 3  # 8-bit packing: ~4x smaller (plus scalar metadata)
+    assert host_pipeline.payload_wire_bytes((x, x)) == 2 * raw
+
+
+def test_host_pipeline_reports_per_edge_wire_bytes():
+    dev = jax.devices()[0]
+    stages = [host_pipeline.PipelineStage(shard_fn=lambda p, x: x + 1,
+                                          params={}, device=dev, quant_bit=b)
+              for b in (8, 0, 0)]
+    seen = []
+    pipe = host_pipeline.HostPipeline(
+        stages, edge_bytes_callback=lambda i, eb: seen.append((i, list(eb))))
+    ubatches = [jnp.zeros((2, 4, 8), jnp.float32) for _ in range(3)]
+    pipe.run(ubatches)
+    assert [i for i, _ in seen] == [0, 1, 2]
+    for _, eb in seen:
+        assert len(eb) == 2           # one count per inter-stage edge
+        assert eb[0] < eb[1]          # 8-bit edge0 beats the raw f32 edge1
+        assert eb[1] == 2 * 4 * 8 * 4
+
+
+def _feed_window(key, work_mbits, duration_s, n):
+    """Inject n beats of (work, duration) into a monitoring key — simulating
+    an edge whose transfers take `duration_s` each (e.g. a throttled link)."""
+    with monitoring.get_locked_context(key) as mctx:
+        for _ in range(n):
+            ic = MonitorIterationContext(
+                t_ns_last=time.monotonic_ns() - int(duration_s * 1e9),
+                e_uj_last=0)
+            mctx.iteration(key=key, work=work_mbits, iter_ctx=ic)
+
+
+def test_adaptive_drops_only_the_throttled_edge(monkeypatch, tmp_path):
+    monkeypatch.chdir(tmp_path)  # monitoring writes per-key CSVs in cwd
+    monkeypatch.setenv("ADAPTIVE_QUANT", "HEURISTIC2")
+    monkeypatch.setenv("SEND_CONSTRAINT", "10")  # items/sec
+    window = 4
+    monitoring.init("shard", window)
+    try:
+        monitoring.add_key("send0", work_type="Mbits")
+        monitoring.add_key("send1", work_type="Mbits")
+        s0 = runtime._EdgeQuantState(8)
+        s1 = runtime._EdgeQuantState(8)
+        cb = runtime._make_adaptive_callback([s0, s1], window,
+                                             edge_keys=["send0", "send1"])
+        assert cb is not None
+        # ubatch_size=2 at 10 items/s -> 0.2 s transfer budget per microbatch.
+        # Edge 0 moves 1 Mbit in 0.05 s (fine); edge 1 takes 1.5 s (7.5x
+        # over budget -> needs >=7.5x compression -> 4-bit, floor(32/4)=8).
+        _feed_window("send0", 1.0, 0.05, window)
+        _feed_window("send1", 1.0, 1.5, window)
+        cb(window - 1, np.zeros((2, 5), np.float32))
+        assert s0.quant_bit == 0       # uncongested edge: no quantization
+        assert s1.quant_bit == 4       # throttled edge drops to 4-bit
+    finally:
+        monitoring.finish()
+
+
+def test_dcn_wire_format_carries_bitwidth():
+    """The consumer decodes with the bitwidth from the wire header — no
+    shared schedule metadata needed (reference ships quant_bit as the 5th
+    element of every encoded tensor, basic_op.py:143)."""
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 4, 8)),
+                    jnp.float32)
+    wire = runtime._wire_encode(x, 8)
+    assert int(wire[0]) == 8
+    dec = runtime._wire_decode(wire, jnp.float32)
+    np.testing.assert_allclose(np.asarray(dec), np.asarray(x), atol=0.1)
+
+    wire0 = runtime._wire_encode(x, 0)  # passthrough still carries header
+    assert int(wire0[0]) == 0
+    np.testing.assert_array_equal(
+        np.asarray(runtime._wire_decode(wire0, jnp.float32)), np.asarray(x))
+
+    for bit in (2, 4, 16):  # producer may pick any supported width mid-run
+        dec = runtime._wire_decode(runtime._wire_encode(x, bit), jnp.float32)
+        assert np.asarray(dec).shape == x.shape
+
+    # 2-tuple payloads (mid-block cuts) encode per tensor
+    pair = (x, x + 1)
+    dec = runtime._wire_decode(runtime._wire_encode(pair, 8), jnp.float32)
+    assert isinstance(dec, tuple) and len(dec) == 2
