@@ -353,7 +353,10 @@ class DistDcnContext(DistContext):
                                        if timeout is None else timeout)
         while True:
             try:
-                conn = socket.create_connection((host, port), timeout=5)
+                # per-attempt timeout clamped to the remaining budget, so a
+                # SYN-blackholed peer can't overrun the caller's deadline
+                attempt = min(5.0, max(0.1, deadline - time.monotonic()))
+                conn = socket.create_connection((host, port), timeout=attempt)
                 break
             except OSError:
                 if self._stop.is_set() or time.monotonic() >= deadline:

@@ -19,7 +19,7 @@ import numpy as np
 
 from . import ShardConfig
 from .layers import TransformerConfig
-from .shard import make_shard_fn
+from .shard import make_shard_fn, unstack_blocks
 from . import bert as bert_mod
 from . import deit as deit_mod
 from . import vit as vit_mod
@@ -109,10 +109,20 @@ def make_shard_config(model_name: str, layer_start: int, layer_end: int) -> Shar
                        is_last=layer_end == get_model_layers(model_name))
 
 
+def should_unroll_blocks(n_blocks: int) -> bool:
+    """Execution-layout policy: unroll full blocks when the depth is within
+    PIPEEDGE_UNROLL_BLOCKS (default 48, covering every registered model —
+    unrolled runs ~6% faster and compiles faster on TPU; see
+    shard.shard_apply). 0 disables unrolling (always scan)."""
+    limit = int(os.getenv("PIPEEDGE_UNROLL_BLOCKS", "48"))
+    return 0 < n_blocks <= limit
+
+
 def module_shard_factory(model_name: str, model_file: Optional[str],
                          layer_start: int, layer_end: int, stage: int = 0,
                          dtype=jnp.float32,
-                         params: Optional[Dict] = None) \
+                         params: Optional[Dict] = None,
+                         unroll: Optional[bool] = None) \
         -> Tuple[Callable, Dict, ShardConfig]:
     """Build one pipeline stage: (jitted shard fn, params, shard config).
 
@@ -122,6 +132,10 @@ def module_shard_factory(model_name: str, model_file: Optional[str],
     deterministic random initialization (same pytree structure) so the
     framework runs end-to-end with zero egress; a warning is logged since
     outputs then aren't pretrained.
+
+    `unroll` selects the full-block execution layout (None = policy
+    `should_unroll_blocks`); pass False where the stacked layout is
+    required, e.g. params feeding the SPMD driver's stage stacking.
     """
     entry = _MODELS[model_name]
     if model_file is None:
@@ -140,6 +154,11 @@ def module_shard_factory(model_name: str, model_file: Optional[str],
         logger.warning("weights file %r not found for %s; using random init",
                        model_file, model_name)
         params = entry.family.init_params(entry.config, shard_config, dtype=dtype)
+    blocks = params.get("blocks")
+    if blocks is not None and not isinstance(blocks, (tuple, list)):
+        n_blocks = jax.tree_util.tree_leaves(blocks)[0].shape[0]
+        if unroll if unroll is not None else should_unroll_blocks(n_blocks):
+            params = unstack_blocks(params)
     fn = make_shard_fn(entry.family.FAMILY, entry.config, shard_config)
     logger.info("======= %s stage %d: layers [%d, %d] =======",
                 model_name, stage, layer_start, layer_end)

@@ -336,34 +336,24 @@ def _register_dcn_monitor_hooks(ctx) -> None:
     stage's edge window with uncompressed feed traffic."""
     from pipeedge_tpu.comm import dcn
 
-    def send_pre(dst, channel):
-        if channel != dcn.CHANNEL_FEED:
-            monitoring.iteration_start(MONITORING_KEY_SEND)
+    def make_hooks(key):
+        def pre(peer, channel):
+            if channel != dcn.CHANNEL_FEED:
+                monitoring.iteration_start(key)
 
-    def send_post(dst, channel, tensors):
-        if channel == dcn.CHANNEL_FEED:
-            return
-        if tensors is None:  # transfer aborted mid-frame
-            monitoring.iteration_abort(MONITORING_KEY_SEND)
-            return
-        mbits = sum(int(t.nbytes) for t in tensors) * 8 / 1e6
-        monitoring.iteration(MONITORING_KEY_SEND, work=mbits)
+        def post(peer, channel, tensors):
+            if channel == dcn.CHANNEL_FEED:
+                return
+            if tensors is None:  # transfer aborted mid-frame
+                monitoring.iteration_abort(key)
+                return
+            mbits = sum(int(t.nbytes) for t in tensors) * 8 / 1e6
+            monitoring.iteration(key, work=mbits)
 
-    def recv_pre(src, channel):
-        if channel != dcn.CHANNEL_FEED:
-            monitoring.iteration_start(MONITORING_KEY_RECV)
+        return pre, post
 
-    def recv_post(src, channel, tensors):
-        if channel == dcn.CHANNEL_FEED:
-            return
-        if tensors is None:
-            monitoring.iteration_abort(MONITORING_KEY_RECV)
-            return
-        mbits = sum(int(t.nbytes) for t in tensors) * 8 / 1e6
-        monitoring.iteration(MONITORING_KEY_RECV, work=mbits)
-
-    ctx.register_send_hooks(send_pre, send_post)
-    ctx.register_recv_hooks(recv_pre, recv_post)
+    ctx.register_send_hooks(*make_hooks(MONITORING_KEY_SEND))
+    ctx.register_recv_hooks(*make_hooks(MONITORING_KEY_RECV))
 
 
 def run_pipeline_host(args, stage_layers, stage_quant, stage_ranks,
@@ -426,8 +416,11 @@ def run_pipeline_spmd(args, stage_layers, stage_quant, ubatches, labels) -> None
     total = registry.get_model_layers(args.model_name)
     stage_params = []
     for i, (l, r) in enumerate(stage_layers):
+        # stacked block layout required: the SPMD driver pads and re-stacks
+        # per-stage blocks across the 'stage' mesh axis
         _, params, _ = registry.module_shard_factory(
-            args.model_name, args.model_file, l, r, stage=i, dtype=dtype)
+            args.model_name, args.model_file, l, r, stage=i, dtype=dtype,
+            unroll=False)
         stage_params.append(params)
     mesh = spmd.make_pipeline_mesh(len(stage_layers))
     quant_bit = stage_quant[0] if stage_quant else 0

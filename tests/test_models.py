@@ -118,6 +118,29 @@ def test_deit_parity_and_composition(deit_setup, partition):
     np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-5)
 
 
+def test_unrolled_blocks_match_scanned(vit_setup):
+    """The unrolled execution layout (shard.unstack_blocks — the faster TPU
+    path) computes bit-identical results to the scanned stacked layout."""
+    from pipeedge_tpu.models.shard import unstack_blocks
+
+    cfg, weights, x, expected = vit_setup
+    total = 4 * cfg.num_hidden_layers
+    sc = ShardConfig(1, total, is_first=True, is_last=True)
+    params = vit_mod.load_params(cfg, sc, weights)
+    fn = make_shard_fn(vit_mod.FAMILY, cfg, sc)
+    scanned = np.asarray(fn(params, jnp.asarray(x)))
+    unrolled_params = unstack_blocks(params)
+    assert isinstance(unrolled_params["blocks"], tuple)
+    unrolled = np.asarray(fn(unrolled_params, jnp.asarray(x)))
+    np.testing.assert_array_equal(scanned, unrolled)
+    np.testing.assert_allclose(unrolled, expected, rtol=2e-4, atol=2e-5)
+    # idempotent / no-op cases
+    assert unstack_blocks(unrolled_params) is not None
+    head_only = ShardConfig(1, 2, is_first=True, is_last=False)
+    hp = vit_mod.load_params(cfg, head_only, weights)
+    assert unstack_blocks(hp) is hp  # no full blocks: returned unchanged
+
+
 def test_bert_model_no_head_returns_pooler(bert_setup):
     from transformers import BertModel
     cfg, weights, ids, _ = bert_setup
