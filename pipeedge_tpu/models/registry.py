@@ -157,7 +157,9 @@ def module_shard_factory(model_name: str, model_file: Optional[str],
     blocks = params.get("blocks")
     if blocks is not None and not isinstance(blocks, (tuple, list)):
         n_blocks = jax.tree_util.tree_leaves(blocks)[0].shape[0]
-        if unroll if unroll is not None else should_unroll_blocks(n_blocks):
+        do_unroll = unroll if unroll is not None \
+            else should_unroll_blocks(n_blocks)
+        if do_unroll:
             params = unstack_blocks(params)
     fn = make_shard_fn(entry.family.FAMILY, entry.config, shard_config)
     logger.info("======= %s stage %d: layers [%d, %d] =======",

@@ -26,6 +26,7 @@ SPMD program; the host-driven driver handles those (SURVEY.md §7 hard parts).
 from __future__ import annotations
 
 import dataclasses
+import logging
 from functools import partial
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -38,6 +39,8 @@ from ..models import ShardConfig, block_slices
 from ..models.layers import TransformerConfig
 from ..models.shard import FamilySpec, stack_blocks
 from ..ops import quant as quant_ops
+
+logger = logging.getLogger(__name__)
 
 BlockRange = Tuple[int, int]
 
@@ -66,12 +69,51 @@ def _pad_stack(stage_blocks: List[Any], max_b: int):
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *padded)
 
 
+def _raw_words(n_values: int, itemsize: int) -> int:
+    """uint32 words to carry `n_values` raw elements of `itemsize` bytes."""
+    return -(-n_values * itemsize // 4)
+
+
+def _bitcast_to_words(h: jax.Array) -> jax.Array:
+    """[B, ...] -> [B, words] uint32 view of the raw payload (bit=0 edges in
+    a mixed-bitwidth wire format)."""
+    b = h.shape[0]
+    flat = h.reshape(b, -1)
+    if h.dtype == jnp.float32:
+        return jax.lax.bitcast_convert_type(flat, jnp.uint32)
+    if h.dtype == jnp.bfloat16:
+        u16 = jax.lax.bitcast_convert_type(flat, jnp.uint16)
+        return jax.lax.bitcast_convert_type(u16.reshape(b, -1, 2), jnp.uint32)
+    raise TypeError(f"unsupported raw edge dtype {h.dtype}")
+
+
+def _bitcast_from_words(words: jax.Array, shape, dtype) -> jax.Array:
+    """Inverse of `_bitcast_to_words` for the leading [B, words] block."""
+    b = shape[0]
+    n = int(np.prod(shape[1:]))
+    if dtype == jnp.float32:
+        flat = jax.lax.bitcast_convert_type(words[:, :n], jnp.float32)
+    elif dtype == jnp.bfloat16:
+        u16 = jax.lax.bitcast_convert_type(words[:, :n // 2], jnp.uint16)
+        flat = jax.lax.bitcast_convert_type(u16, jnp.bfloat16).reshape(b, -1)
+    else:
+        raise TypeError(f"unsupported raw edge dtype {dtype}")
+    return flat.reshape(shape)
+
+
 @dataclasses.dataclass
 class SpmdPipeline:
     """Compiled SPMD pipeline over a ('dp', 'stage') mesh.
 
     Build with `build_spmd_pipeline`. Call `run(inputs)` with a stacked
     microbatch array [M, B, ...raw input dims...]; returns [M, B, ...out...].
+
+    `stage_bits[i]` quantizes the edge leaving stage i (reference `-q`
+    per-stage semantics, runtime.py:652-656). Uniform bits compile to the
+    direct QuantizedTensor edge; mixed bits compile to a `lax.switch` over
+    per-bitwidth encoders writing one uniform padded uint32 wire buffer —
+    shapes must be identical across devices in an SPMD program, so the
+    buffer is sized for the widest edge and each stage's branch zero-pads.
     """
     family: FamilySpec
     cfg: TransformerConfig
@@ -79,11 +121,17 @@ class SpmdPipeline:
     n_stages: int
     max_blocks: int
     params: Dict            # {'embed', 'final', 'blocks', 'n_blocks'}
-    quant_bit: int = 0
+    stage_bits: Tuple[int, ...] = (0,)
     _compiled: Dict[Tuple, Any] = dataclasses.field(default_factory=dict)
 
+    @property
+    def quant_bit(self) -> int:
+        """Uniform edge bitwidth (0 when edges are mixed) — legacy accessor."""
+        bits = set(self.stage_bits[:-1] or (0,))
+        return next(iter(bits)) if len(bits) == 1 else 0
+
     def run(self, inputs: jax.Array) -> jax.Array:
-        key = (inputs.shape, str(inputs.dtype), self.quant_bit)
+        key = (inputs.shape, str(inputs.dtype), self.stage_bits)
         fn = self._compiled.get(key)
         if fn is None:
             fn = self._build(inputs)
@@ -97,7 +145,6 @@ class SpmdPipeline:
     def _build(self, inputs: jax.Array):
         family, cfg = self.family, self.cfg
         n_stages, max_b = self.n_stages, self.max_blocks
-        quant_bit = self.quant_bit
         mesh = self.mesh
         n_ubatch = inputs.shape[0]
         n_ticks = n_ubatch + n_stages - 1
@@ -130,15 +177,86 @@ class SpmdPipeline:
 
         fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
 
-        def encode(h):
-            if quant_bit == 0:
-                return h
-            return quant_ops.tensor_encode_outerdim(h, quant_bit)
+        # -- edge codec: uniform bitwidth (direct) or mixed (lax.switch over
+        #    a uniform padded uint32 wire buffer; SPMD shapes must match
+        #    across devices, so the buffer is sized for the widest edge) ----
+        edge_bits = tuple(self.stage_bits[i] for i in range(n_stages - 1))
+        uniform = len(set(edge_bits)) <= 1
+        if uniform:
+            quant_bit = edge_bits[0] if edge_bits else 0
 
-        def decode(e):
-            if quant_bit == 0:
-                return e
-            return quant_ops.tensor_decode_outerdim(e)
+            def encode(h, stage):
+                if quant_bit == 0:
+                    return h
+                return quant_ops.tensor_encode_outerdim(h, quant_bit)
+
+            def decode(e, stage):
+                if quant_bit == 0:
+                    return e
+                return quant_ops.tensor_decode_outerdim(e)
+
+            def zero_carry():
+                return encode(jnp.zeros(hidden_local.shape,
+                                        hidden_local.dtype), 0)
+        else:
+            n_vals = int(np.prod(hidden_local.shape[1:]))
+            itemsize = jnp.dtype(hidden_local.dtype).itemsize
+            distinct = sorted(set(edge_bits))
+            words_for = {
+                wb: (quant_ops.packed_words(n_vals, wb) if wb > 0
+                     else _raw_words(n_vals, itemsize)) for wb in distinct}
+            max_words = max(words_for.values())
+
+            def make_enc(wb):
+                def enc(h):
+                    if wb == 0:
+                        data = _bitcast_to_words(h)
+                        scale = jnp.ones((b_local,), jnp.float32)
+                        shift = jnp.zeros((b_local,), jnp.float32)
+                    else:
+                        q = quant_ops.tensor_encode_outerdim(h, wb)
+                        data, scale, shift = q.data, q.scale, q.shift
+                    pad = max_words - data.shape[1]
+                    if pad:
+                        data = jnp.pad(data, ((0, 0), (0, pad)))
+                    return data, scale, shift
+                return enc
+
+            def make_dec(wb):
+                def dec(payload):
+                    data, scale, shift = payload
+                    if wb == 0:
+                        return _bitcast_from_words(
+                            data, hidden_local.shape, hidden_local.dtype)
+                    q = quant_ops.QuantizedTensor(
+                        data=data[:, :words_for[wb]], scale=scale, shift=shift,
+                        shape=hidden_local.shape, bit=wb)
+                    return quant_ops.tensor_decode_outerdim(q).astype(
+                        hidden_local.dtype)
+                return dec
+
+            enc_branches = [make_enc(wb) for wb in distinct]
+            dec_branches = [make_dec(wb) for wb in distinct]
+            # stage i's OUT edge uses edge_bits[i]; its IN edge uses
+            # edge_bits[i-1] (clamped: stage 0's in-edge / the last stage's
+            # out-edge values are never consumed)
+            out_branch = jnp.asarray(
+                [distinct.index(edge_bits[min(i, n_stages - 2)])
+                 for i in range(n_stages)], jnp.int32)
+            in_branch = jnp.asarray(
+                [distinct.index(edge_bits[max(i - 1, 0)])
+                 for i in range(n_stages)], jnp.int32)
+
+            def encode(h, stage):
+                return jax.lax.switch(out_branch[stage], enc_branches, h)
+
+            def decode(payload, stage):
+                return jax.lax.switch(in_branch[stage], dec_branches, payload)
+
+            def zero_carry():
+                return (jnp.zeros((b_local, max_words), jnp.uint32),
+                        jnp.zeros((b_local,), jnp.float32),
+                        jnp.zeros((b_local,), jnp.float32))
 
         def permute_payload(payload):
             if n_stages == 1:
@@ -155,33 +273,44 @@ class SpmdPipeline:
             is_first = stage == 0
             is_last = stage == n_stages - 1
 
-            # Embeddings for all microbatches, computed once per device.
-            # Patch/word embedding is <2% of total FLOPs; doing it everywhere
-            # avoids a second program region gated on stage index.
-            embedded = jax.vmap(
-                lambda u: family.embed(params["embed"], u, cfg))(stacked_inputs)
+            # Embeddings for all microbatches — computed only on the first
+            # stage (runtime branch on the device-local stage index); other
+            # stages carry zeros of the same shape.
+            def do_embed(si):
+                return jax.vmap(
+                    lambda u: family.embed(params["embed"], u, cfg))(si)
 
-            zero_h = jnp.zeros(hidden_local.shape, hidden_local.dtype)
+            embedded = jax.lax.cond(
+                is_first, do_embed,
+                lambda si: jnp.zeros((n_ubatch,) + hidden_local.shape,
+                                     embed_shape.dtype), stacked_inputs)
+
             outputs0 = jnp.zeros((n_ubatch,) + out_shape.shape, out_shape.dtype)
 
             def tick(carry, t):
                 prev_enc, outputs = carry
-                recv = decode(permute_payload(prev_enc))
+                recv = decode(permute_payload(prev_enc), stage)
                 in_idx = jnp.clip(t, 0, n_ubatch - 1)
                 x = jnp.where(is_first, embedded[in_idx], recv)
                 h = run_blocks(blocks, n_valid, x)
-                logits = family.finalize(params["final"], h, cfg)
                 out_idx = t - (n_stages - 1)
+                # classifier head/pooler only on the last stage — for
+                # ViT-Huge's 21843-way head that is a real matmul per tick
+                logits = jax.lax.cond(
+                    is_last,
+                    lambda hh: family.finalize(params["final"], hh, cfg)
+                    .astype(out_shape.dtype),
+                    lambda hh: jnp.zeros(out_shape.shape, out_shape.dtype), h)
                 updated = jax.lax.dynamic_update_slice(
                     outputs, logits[None].astype(outputs.dtype),
                     (jnp.clip(out_idx, 0, n_ubatch - 1),)
                     + (0,) * len(out_shape.shape))
                 valid = jnp.logical_and(out_idx >= 0, is_last)
                 outputs = jnp.where(valid, updated, outputs)
-                return (encode(h), outputs), None
+                return (encode(h, stage), outputs), None
 
             (_, outputs), _ = jax.lax.scan(
-                tick, (encode(zero_h), outputs0), jnp.arange(n_ticks))
+                tick, (zero_carry(), outputs0), jnp.arange(n_ticks))
             # only the last stage wrote real outputs; fan them back out
             return jax.lax.psum(outputs, "stage")
 
@@ -205,15 +334,27 @@ class SpmdPipeline:
 def build_spmd_pipeline(family: FamilySpec, cfg: TransformerConfig,
                         partition: Sequence[Tuple[int, int]],
                         stage_params: Sequence[Dict], mesh: Mesh,
-                        quant_bit: int = 0) -> SpmdPipeline:
+                        quant_bit=0) -> SpmdPipeline:
     """Assemble an `SpmdPipeline` from per-stage shard parameter pytrees.
 
     `stage_params[i]` is the pytree built by a family loader for stage i's
     `ShardConfig` (block-aligned). Stage 0 must carry 'embeddings', the last
     stage 'final'; per-stage 'blocks' stacks are zero-padded to the deepest
     stage and masked at run time.
+
+    `quant_bit`: an int applied to every inter-stage edge, or a per-stage
+    sequence where entry i quantizes the edge leaving stage i (reference
+    `-q` list semantics, runtime.py:652-656; the final entry is the result
+    edge and is forced to 0).
     """
     n_stages = len(partition)
+    if isinstance(quant_bit, (list, tuple)):
+        if len(quant_bit) != n_stages:
+            raise ValueError(f"quant_bit list length {len(quant_bit)} != "
+                             f"{n_stages} stages")
+        stage_bits = tuple(int(b) for b in quant_bit[:-1]) + (0,)
+    else:
+        stage_bits = (int(quant_bit),) * max(n_stages - 1, 0) + (0,)
     if mesh.shape["stage"] != n_stages:
         raise ValueError(f"mesh 'stage' axis {mesh.shape['stage']} != "
                          f"{n_stages} pipeline stages")
@@ -225,9 +366,22 @@ def build_spmd_pipeline(family: FamilySpec, cfg: TransformerConfig,
         if "blocks" not in p:
             raise ValueError(f"stage {i} has no full blocks; SPMD pipeline "
                              f"requires block-aligned partitions")
+        if isinstance(p["blocks"], (tuple, list)):
+            raise ValueError(
+                f"stage {i} params use the unrolled (tuple) block layout; "
+                "the SPMD pipeline stacks blocks across the stage axis — "
+                "build stage params with module_shard_factory(..., "
+                "unroll=False) or family loaders directly")
         blocks_list.append(p["blocks"])
         n_blocks.append(jax.tree_util.tree_leaves(p["blocks"])[0].shape[0])
     max_b = max(n_blocks)
+    nonzero = [b for b in stage_bits[:-1] if b > 0]
+    if nonzero and any(b == 0 for b in stage_bits[:-1]):
+        logger.warning(
+            "SPMD per-stage quant bits %s mix raw (0) and quantized edges: "
+            "the uniform SPMD wire buffer is padded to the raw edge's size, "
+            "so quantized edges save no interconnect bandwidth in this "
+            "configuration (quantization error still applies)", stage_bits)
 
     params = {
         "embed": stage_params[0]["embeddings"],
@@ -246,15 +400,35 @@ def build_spmd_pipeline(family: FamilySpec, cfg: TransformerConfig,
                                    NamedSharding(mesh, P("stage"))),
     }
     return SpmdPipeline(family=family, cfg=cfg, mesh=mesh, n_stages=n_stages,
-                        max_blocks=max_b, params=params)
+                        max_blocks=max_b, params=params,
+                        stage_bits=stage_bits)
 
 
 def make_pipeline_mesh(n_stages: int, dp: int = 1,
-                       devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+                       devices: Optional[Sequence[jax.Device]] = None,
+                       stage_ranks: Optional[Sequence[int]] = None) -> Mesh:
     """Build a ('dp', 'stage') mesh: stage axis contiguous so ppermute edges
-    ride neighboring ICI links."""
+    ride neighboring ICI links.
+
+    `stage_ranks[i]` places stage i on `devices[stage_ranks[i]]` (reference
+    `-r` rank-order semantics, runtime.py:657-687); requires dp=1 and
+    distinct ranks.
+    """
     if devices is None:
         devices = jax.devices()
+    if stage_ranks is not None:
+        if dp != 1:
+            raise ValueError("stage_ranks requires dp=1")
+        if len(stage_ranks) != n_stages:
+            raise ValueError(f"stage_ranks length {len(stage_ranks)} != "
+                             f"{n_stages} stages")
+        if len(set(stage_ranks)) != n_stages:
+            raise ValueError(f"stage_ranks must be distinct: {stage_ranks}")
+        if max(stage_ranks) >= len(devices):
+            raise ValueError(f"stage rank {max(stage_ranks)} out of range "
+                             f"({len(devices)} devices)")
+        arr = np.asarray([devices[r] for r in stage_ranks]).reshape(1, n_stages)
+        return Mesh(arr, ("dp", "stage"))
     need = n_stages * dp
     if len(devices) < need:
         raise ValueError(f"need {need} devices, have {len(devices)}")

@@ -406,7 +406,8 @@ def run_pipeline_host(args, stage_layers, stage_quant, stage_ranks,
     _report(tik, tok, ubatches)
 
 
-def run_pipeline_spmd(args, stage_layers, stage_quant, ubatches, labels) -> None:
+def run_pipeline_spmd(args, stage_layers, stage_quant, stage_ranks,
+                      ubatches, labels) -> None:
     """SPMD pipeline: one XLA program, ppermute edges (block-aligned)."""
     import jax
     import jax.numpy as jnp
@@ -422,11 +423,22 @@ def run_pipeline_spmd(args, stage_layers, stage_quant, ubatches, labels) -> None
             args.model_name, args.model_file, l, r, stage=i, dtype=dtype,
             unroll=False)
         stage_params.append(params)
-    mesh = spmd.make_pipeline_mesh(len(stage_layers))
-    quant_bit = stage_quant[0] if stage_quant else 0
+    n_stages = len(stage_layers)
+    ranks = None
+    if stage_ranks and list(stage_ranks) != list(range(n_stages)):
+        devices = jax.devices()
+        mapped = [r % len(devices) for r in stage_ranks]
+        if len(set(mapped)) == n_stages:
+            ranks = mapped
+        else:
+            logger.warning("stage_ranks %s not distinct on %d devices; "
+                           "using default stage order", stage_ranks,
+                           len(devices))
+    mesh = spmd.make_pipeline_mesh(n_stages, stage_ranks=ranks)
     pipe = spmd.build_spmd_pipeline(entry.family.FAMILY, entry.config,
                                     stage_layers, stage_params, mesh,
-                                    quant_bit=quant_bit)
+                                    quant_bit=list(stage_quant) if stage_quant
+                                    else 0)
     for lb in labels:
         label_queue.put(lb)
     inputs = jnp.asarray(np.stack(ubatches),
@@ -847,8 +859,8 @@ def main():
                 run_pipeline_dcn(args, stage_layers, stage_quant, stage_ranks,
                                  ubatches, labels)
             elif comm == "spmd":
-                run_pipeline_spmd(args, stage_layers, stage_quant, ubatches,
-                                  labels)
+                run_pipeline_spmd(args, stage_layers, stage_quant,
+                                  stage_ranks, ubatches, labels)
             else:
                 run_pipeline_host(args, stage_layers, stage_quant, stage_ranks,
                                   ubatches, labels)

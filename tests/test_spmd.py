@@ -99,11 +99,64 @@ def test_spmd_quantized_edges(tiny_vit4):
     rng = np.random.default_rng(2)
     inputs = jnp.asarray(rng.normal(size=(3, 2, 3, 16, 16)).astype(np.float32))
     exact = np.asarray(pipe.run(inputs))
-    pipe.quant_bit = 8
+    pipe.stage_bits = (8, 0)
+    assert pipe.quant_bit == 8
     q8 = np.asarray(pipe.run(inputs))
     err = np.max(np.abs(q8 - exact))
     assert err < np.max(np.abs(exact)) * 0.5
     assert not np.allclose(q8, exact)  # quantization actually happened
+
+
+def test_spmd_per_stage_quant_bits(tiny_vit4):
+    """Mixed per-stage edge bitwidths (reference -q list semantics): the
+    lax.switch wire codec must agree with the exact pipeline within the
+    coarsest edge's quantization error, and differ from it (quantization
+    really ran). Includes a raw (bit=0) edge mixed with quantized ones."""
+    cfg, weights = tiny_vit4
+    partition = [(1, 4), (5, 8), (9, 12), (13, 16)]
+    mesh = spmd.make_pipeline_mesh(4)
+    sp = _stage_params(vit_mod, cfg, partition, weights)
+    exact_pipe = spmd.build_spmd_pipeline(vit_mod.FAMILY, cfg, partition, sp,
+                                          mesh)
+    rng = np.random.default_rng(5)
+    inputs = jnp.asarray(rng.normal(size=(5, 2, 3, 16, 16)).astype(np.float32))
+    exact = np.asarray(exact_pipe.run(inputs))
+
+    pipe = spmd.build_spmd_pipeline(vit_mod.FAMILY, cfg, partition, sp, mesh,
+                                    quant_bit=[8, 4, 0, 0])
+    assert pipe.stage_bits == (8, 4, 0, 0)
+    mixed = np.asarray(pipe.run(inputs))
+    assert mixed.shape == exact.shape
+    assert not np.allclose(mixed, exact)       # 4-bit edge really quantized
+    # 16-level edge dominates the error; outputs stay in the same regime
+    assert np.max(np.abs(mixed - exact)) < np.max(np.abs(exact))
+
+    # high-precision mixed edges track the exact result closely
+    pipe16 = spmd.build_spmd_pipeline(vit_mod.FAMILY, cfg, partition, sp,
+                                      mesh, quant_bit=[16, 0, 16, 0])
+    m16 = np.asarray(pipe16.run(inputs))
+    np.testing.assert_allclose(m16, exact, rtol=0.05, atol=0.05)
+
+
+def test_spmd_stage_ranks_mesh(tiny_vit4):
+    """-r rank order: stages placed on the listed devices, same results."""
+    cfg, weights = tiny_vit4
+    partition = [(1, 8), (9, 16)]
+    ranks = [3, 1]
+    mesh = spmd.make_pipeline_mesh(2, stage_ranks=ranks)
+    devs = list(mesh.devices.flat)
+    assert devs == [jax.devices()[3], jax.devices()[1]]
+    sp = _stage_params(vit_mod, cfg, partition, weights)
+    pipe = spmd.build_spmd_pipeline(vit_mod.FAMILY, cfg, partition, sp, mesh)
+    rng = np.random.default_rng(6)
+    inputs = jnp.asarray(rng.normal(size=(4, 2, 3, 16, 16)).astype(np.float32))
+    got = np.asarray(pipe.run(inputs))
+    expected = _expected(vit_mod, cfg, weights, inputs)
+    np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-5)
+    with pytest.raises(ValueError):
+        spmd.make_pipeline_mesh(2, stage_ranks=[1, 1])
+    with pytest.raises(ValueError):
+        spmd.make_pipeline_mesh(2, dp=2, stage_ranks=[0, 1])
 
 
 def test_spmd_bert(tiny_vit4):
