@@ -68,6 +68,21 @@ _DIM = struct.Struct('!q')
 
 CHANNEL_DATA = 0     # inter-stage activations
 CHANNEL_RESULTS = 1  # last stage -> data rank
+# Round-parity offset for multi-round (re-schedule) runs: round r uses
+# channel + CHANNEL_ROUND_PARITY*(r%2), so a frame the data rank streams for
+# round r+1 can never be pulled by a stage from round r that is still
+# tearing down (its recv loop polls only the old-parity channel; per-channel
+# queues keep the traffic apart). Parity-2 suffices because a worker fully
+# stops round r's stage before it begins round r+1.
+CHANNEL_ROUND_PARITY = 8
+
+
+def base_channel(channel: int) -> int:
+    """Strip the round-parity offset: the logical stream kind
+    (DATA/RESULTS/FEED) of a possibly parity-shifted channel byte."""
+    return channel % CHANNEL_ROUND_PARITY
+
+
 CHANNEL_FEED = 2     # data rank -> head stage (raw inputs). A separate
 # channel so feed traffic is distinguishable from pipeline-edge traffic:
 # the reference injects inputs *locally* (enqueue_tensor, p2p:442-450), so
@@ -186,6 +201,13 @@ class DistDcnContext(DistContext):
         self._recv_queues: Dict[Tuple[int, int], "queue.Queue"] = {}
         self._recv_lock = threading.Lock()
         self._stop = threading.Event()
+        # peer-death detection (beyond the reference, whose RPC backpressure
+        # "breaks down if the previous stage fails to send data afterward",
+        # rpc/__init__.py:83-86): ranks whose connection dropped outside a
+        # clean shutdown, and an optional notification callback
+        self._dead: set = set()
+        self._dead_lock = threading.Lock()
+        self._peer_death_handler: Optional[Callable[[int], None]] = None
         # send/recv measurement hooks (reference p2p:132-152): pre fires just
         # before the payload moves, post just after, so (post - pre) is the
         # actual wire transfer time — excluding idle waits for data to exist.
@@ -219,6 +241,27 @@ class DistDcnContext(DistContext):
         self._recv_pre_hook = pre
         self._recv_post_hook = post
 
+    def register_peer_death_handler(self, handler: Callable[[int], None]) \
+            -> None:
+        """`handler(rank)` fires (once per rank, from the observing thread)
+        when a connection to/from `rank` drops while the context is live —
+        i.e. not during `shutdown()`. A dropped connection during a clean
+        stop is NOT a death; callers that race stop against detection should
+        gate on their own stop flag inside the handler."""
+        self._peer_death_handler = handler
+
+    def _mark_dead(self, rank: int) -> None:
+        if rank < 0 or self._stop.is_set():
+            return
+        with self._dead_lock:
+            if rank in self._dead:
+                return
+            self._dead.add(rank)
+        logger.warning("rank %d: peer rank %d connection lost (peer death?)",
+                       self._rank, rank)
+        if self._peer_death_handler is not None:
+            self._peer_death_handler(rank)
+
     # -- lifecycle -----------------------------------------------------
 
     def init(self) -> None:
@@ -228,6 +271,7 @@ class DistDcnContext(DistContext):
         self._stop = threading.Event()
         self._reader_threads = []
         self._recv_queues = {}
+        self._dead = set()
         host, port = self._rank_addrs[self._rank]
         self._listener = socket.create_server((host, port), backlog=8,
                                               reuse_port=False)
@@ -333,6 +377,7 @@ class DistDcnContext(DistContext):
         except (ConnectionError, OSError) as exc:
             if not self._stop.is_set():
                 logger.warning("connection from rank %d dropped: %s", src, exc)
+                self._mark_dead(src)
         finally:
             conn.close()
 
@@ -372,25 +417,53 @@ class DistDcnContext(DistContext):
     def send_tensors(self, dst: int, tensors: Sequence[np.ndarray],
                      channel: int = CHANNEL_DATA) -> None:
         """Send a tensor list to `dst` (reference _send_tensor, p2p:96-108)."""
-        with self._conn_locks[dst]:
-            conn = self._ensure_conn(dst)
-            if self._send_pre_hook is not None:
-                self._send_pre_hook(dst, channel)
-            try:
-                _send_frame(conn, _MSG_TENSORS, self._rank, tensors, channel)
-            except Exception:
-                if self._send_pre_hook is not None \
-                        and self._send_post_hook is not None:
-                    self._send_post_hook(dst, channel, None)  # abort
-                raise
-            if self._send_post_hook is not None:
-                self._send_post_hook(dst, channel, tensors)
+        try:
+            with self._conn_locks[dst]:
+                conn = self._ensure_conn(dst)
+                if self._send_pre_hook is not None:
+                    self._send_pre_hook(dst, channel)
+                try:
+                    _send_frame(conn, _MSG_TENSORS, self._rank, tensors,
+                                channel)
+                except Exception as exc:
+                    if self._send_pre_hook is not None \
+                            and self._send_post_hook is not None:
+                        self._send_post_hook(dst, channel, None)  # abort
+                    if isinstance(exc, OSError):
+                        # broken pipe / reset: the peer is gone; drop the
+                        # conn so state stays clean
+                        with self._conns_lock:
+                            if self._conns.get(dst) is conn:
+                                del self._conns[dst]
+                    raise
+                if self._send_post_hook is not None:
+                    self._send_post_hook(dst, channel, tensors)
+        except OSError:
+            # notify AFTER releasing the conn lock: the death handler may
+            # broadcast commands, which needs these locks (deadlock otherwise)
+            self._mark_dead(dst)
+            raise
 
     def recv_tensors(self, src: int, timeout: Optional[float] = None,
                      channel: int = CHANNEL_DATA) -> List[np.ndarray]:
         """Receive the next tensor list from `src` (p2p:111-121). Raises
-        queue.Empty on timeout."""
-        return self._queue_for(src, channel).get(timeout=timeout)
+        queue.Empty on timeout, ConnectionError if `src`'s connection died
+        and no frames remain (already-delivered frames drain first)."""
+        q = self._queue_for(src, channel)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            try:
+                return q.get(timeout=0.2 if deadline is None
+                             else max(0.0, min(0.2,
+                                               deadline - time.monotonic())))
+            except queue.Empty:
+                with self._dead_lock:
+                    dead = src in self._dead
+                if dead and q.empty():
+                    raise ConnectionError(
+                        f"rank {src} died (connection lost)") from None
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise
 
     def cmd_broadcast(self, cmd: int, tensors: Sequence[np.ndarray] = (),
                       best_effort: Optional[bool] = None) -> None:
@@ -518,6 +591,11 @@ class DcnPipelineStage:
                                                  channel=self._recv_channel)
             except queue.Empty:
                 continue
+            except ConnectionError:
+                # upstream died: the context's peer-death handler owns the
+                # fleet-wide reaction (CMD_STOP broadcast); this thread just
+                # stops pulling
+                return
             self._queue_work.put(tensors)
 
     def _work_loop(self) -> None:
@@ -533,7 +611,10 @@ class DcnPipelineStage:
             if item is self._SENTINEL or self._stop.is_set():
                 return
             if self._rank_dst is not None:
-                self._ctx.send_tensors(self._rank_dst, item,
-                                       channel=self._send_channel)
+                try:
+                    self._ctx.send_tensors(self._rank_dst, item,
+                                           channel=self._send_channel)
+                except OSError:
+                    return  # downstream died: peer-death handler notified
             elif self._results_cb is not None:
                 self._results_cb(item)

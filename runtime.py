@@ -63,12 +63,26 @@ label_queue = queue.Queue()
 # multi-process (dcn) command state (reference runtime.py:400-415)
 stop_event = threading.Event()
 sched_q = queue.Queue()
+# why the fleet stopped: a CMD_STOP carrying a rank id means that rank died
+# mid-run (peer-death protocol, beyond the reference's acknowledged
+# non-fault-tolerance at rpc/__init__.py:83-86); None = clean stop
+stop_info: List[Optional[int]] = [None]
+# cumulative CMD_STOP count: round r of a multi-schedule run ends at the
+# (r+1)-th stop, so a stop that lands while a worker is still tearing down
+# the previous round is counted, not lost (stop_event alone would race)
+stop_counter = ThreadSafeCounter()
+# set once the fleet is tearing down cleanly (empty CMD_SCHED sent/received):
+# from then on, dropped connections are expected, not peer deaths
+fleet_shutdown = threading.Event()
 
 
 def handle_cmd(cmd: int, tensors: Tuple) -> None:
     """Process a command (reference runtime.py:404-415)."""
     if cmd == CMD_STOP:
         logger.info("handle_cmd: stop")
+        if tensors:
+            stop_info[0] = int(np.asarray(tensors[0]))
+        stop_counter.add(1)
         stop_event.set()
     elif cmd == CMD_SCHED:
         logger.info("handle_cmd: sched")
@@ -338,11 +352,11 @@ def _register_dcn_monitor_hooks(ctx) -> None:
 
     def make_hooks(key):
         def pre(peer, channel):
-            if channel != dcn.CHANNEL_FEED:
+            if dcn.base_channel(channel) != dcn.CHANNEL_FEED:
                 monitoring.iteration_start(key)
 
         def post(peer, channel, tensors):
-            if channel == dcn.CHANNEL_FEED:
+            if dcn.base_channel(channel) == dcn.CHANNEL_FEED:
                 return
             if tensors is None:  # transfer aborted mid-frame
                 monitoring.iteration_abort(key)
@@ -521,13 +535,19 @@ def _wire_decode(tensors: List[np.ndarray], dtype):
     return out[0] if len(out) == 1 else out
 
 
-def run_pipeline_dcn(args, stage_layers, stage_quant, stage_ranks,
-                     ubatches, labels) -> None:
+def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
     """Multi-process pipeline over the DCN transport: this process is ONE
     rank (reference `runtime.py RANK WORLDSIZE` semantics, run_pipeline_p2p
     418-511). Rank `--data-rank` resolves/broadcasts the schedule, streams
-    microbatches to the first stage, and collects results from the last."""
-    import jax
+    microbatches to the first stage, and collects results from the last.
+
+    `schedules` is a list of (stage_layers, stage_quant, stage_ranks)
+    rounds: after each round completes (CMD_STOP), the data rank broadcasts
+    the next round's CMD_SCHED and the live fleet rebuilds its stages — the
+    re-scheduling path the reference designed (CMD_SCHED lands on sched_q,
+    runtime.py:404-415) but never shipped (its runtime consumes exactly one
+    schedule at startup). An EMPTY CMD_SCHED means "no more rounds": workers
+    exit their schedule loop."""
     import jax.numpy as jnp
 
     from pipeedge_tpu.comm import dcn
@@ -540,152 +560,264 @@ def run_pipeline_dcn(args, stage_layers, stage_quant, stage_ranks,
     with dcn.DistDcnContext(world_size, rank, addrs,
                             cmd_handler=handle_cmd) as ctx:
         _register_dcn_monitor_hooks(ctx)
-        if rank == data_rank:
-            # schedule was resolved by the caller; broadcast it (CMD_SCHED,
-            # reference runtime.py:441-445)
-            ctx.cmd_broadcast(CMD_SCHED, [
-                np.asarray(stage_layers, np.int32),
-                np.asarray(stage_quant, np.int32),
-                np.asarray(stage_ranks, np.int32)])
-        else:
-            # workers block until the schedule arrives (runtime.py:447-448)
+
+        def on_peer_death(dead: int) -> None:
+            if stop_info[0] is not None:
+                return  # the fleet is already aborting for a known death
+            # Grace window: connections also drop during the clean fleet
+            # teardown (empty CMD_SCHED), which may still be in flight on
+            # another socket — wait briefly for it before declaring a
+            # failure. Mid-run or between rounds, connections never drop
+            # cleanly, so anything else is a death.
+            if fleet_shutdown.wait(timeout=2.0):
+                return
+            logger.error("rank %d: peer rank %d died; stopping the pipeline",
+                         rank, dead)
+            stop_info[0] = dead
+            # broadcast BEFORE waking local waiters: the data rank's finally
+            # block broadcasts a plain CMD_STOP once stop_event fires, and
+            # the death-carrying stop must reach peers first
             try:
-                tensors = sched_q.get(timeout=args.sched_timeout)
-            except queue.Empty:
-                raise RuntimeError(
-                    f"rank {rank}: no CMD_SCHED within {args.sched_timeout}s;"
-                    " is the data rank up and are --dcn-addrs consistent "
-                    "across ranks?") from None
-            stage_layers = [tuple(map(int, lr)) for lr in tensors[0]]
-            stage_quant = [int(q) for q in tensors[1]]
-            stage_ranks = [int(r) for r in tensors[2]]
+                ctx.cmd_broadcast(CMD_STOP, [np.asarray(dead, np.int32)],
+                                  best_effort=True)
+            except OSError:  # pragma: no cover - best_effort already guards
+                pass
+            stop_event.set()
 
-        try:
-            my_stages = [i for i, r in enumerate(stage_ranks) if r == rank]
-            stage = None
-            if my_stages:
-                assert len(my_stages) == 1, \
-                    "one stage per rank (reference p2p semantics)"
-                i = my_stages[0]
-                l, r = stage_layers[i]
-                restored = None
-                if args.stage_ckpt:
-                    # per-stage Orbax restore: this rank reads exactly its
-                    # own shard from disk (utils/checkpoint.py); validated
-                    # against the runtime schedule via the manifest
-                    from pipeedge_tpu.utils import checkpoint as ckpt_utils
-                    ckpt_utils.check_stage_compatible(
-                        args.stage_ckpt, args.model_name, i, (l, r))
-                    restored = ckpt_utils.load_stage_checkpoint(
-                        args.stage_ckpt, i)
-                fn, params, _ = registry.module_shard_factory(
-                    args.model_name, args.model_file, l, r, stage=i,
-                    dtype=dtype, params=restored)
-                out_bit = stage_quant[i] if i < len(stage_layers) - 1 else 0
-                is_first, is_last = i == 0, i == len(stage_layers) - 1
-                # adaptive policy (env ADAPTIVE_QUANT): this rank adapts its
-                # own output edge on its own measured 'send' window, exactly
-                # the reference's per-rank hook (runtime.py:121-216). The
-                # bitwidth travels on the wire, so the consumer needs no
-                # coordination.
-                edge = None if is_last else _EdgeQuantState(out_bit)
-                adaptive = None if edge is None else _make_adaptive_callback(
-                    [edge], get_window_size())
-                ubatch_idx = [0]
+        ctx.register_peer_death_handler(on_peer_death)
+        results_target = [0]
+        if rank == data_rank:
+            for rnd, (stage_layers, stage_quant, stage_ranks) in \
+                    enumerate(schedules):
+                if rnd:
+                    logger.info("re-schedule: broadcasting round %d "
+                                "(partition %s)", rnd, stage_layers)
+                _dcn_round(args, ctx, rnd, stage_layers, stage_quant,
+                           stage_ranks, ubatches, labels, dtype,
+                           results_target)
+            # no more rounds: an empty schedule releases the workers.
+            # fleet_shutdown first, so peers closing in response are not
+            # taken for deaths.
+            fleet_shutdown.set()
+            ctx.cmd_broadcast(CMD_SCHED, [])
+        else:
+            rnd = 0
+            while True:
+                # workers block until the schedule arrives (runtime.py:447-8),
+                # polling so a peer death declared meanwhile aborts promptly
+                deadline = time.monotonic() + args.sched_timeout
+                while True:
+                    try:
+                        tensors = sched_q.get(timeout=0.5)
+                        break
+                    except queue.Empty:
+                        if stop_info[0] is not None:
+                            raise RuntimeError(
+                                f"rank {rank}: pipeline aborted: rank "
+                                f"{stop_info[0]} died") from None
+                        if time.monotonic() >= deadline:
+                            raise RuntimeError(
+                                f"rank {rank}: no CMD_SCHED within "
+                                f"{args.sched_timeout}s; is the data rank up "
+                                "and are --dcn-addrs consistent across "
+                                "ranks?") from None
+                if len(tensors) == 0:
+                    logger.info("rank %d: empty CMD_SCHED; shutting down",
+                                rank)
+                    fleet_shutdown.set()
+                    break
+                stage_layers = [tuple(map(int, lr)) for lr in tensors[0]]
+                stage_quant = [int(q) for q in tensors[1]]
+                stage_ranks = [int(r) for r in tensors[2]]
+                _dcn_round(args, ctx, rnd, stage_layers, stage_quant,
+                           stage_ranks, [], [], dtype, results_target)
+                rnd += 1
 
-                def work_cb(tensors):
-                    if is_first:
-                        payload = jnp.asarray(tensors[0], dtype=dtype
-                                              if tensors[0].dtype.kind == 'f'
-                                              else None)
-                    else:
-                        payload = _wire_decode(tensors, dtype)
-                    monitoring.iteration_start(MONITORING_KEY_MODEL)
-                    out = fn(params, payload)
-                    out = jax.block_until_ready(out)
-                    n_items = get_microbatch_size(np.asarray(
-                        out[0] if isinstance(out, tuple) else out))
-                    monitoring.iteration(MONITORING_KEY_MODEL, work=n_items,
-                                         accuracy=r - l + 1)
-                    wire = _wire_encode(
-                        out, edge.quant_bit if edge is not None else 0)
-                    if adaptive is not None:
-                        adaptive(ubatch_idx[0],
-                                 out[0] if isinstance(out, tuple) else out)
-                        ubatch_idx[0] += 1
-                    return wire
 
-                # head stage is fed over the wire from the data rank
-                # (self-connection over loopback when colocated) on the FEED
-                # channel; the last stage's results ride the RESULTS channel.
-                # Distinct channels keep a colocated schedule's feed, edge,
-                # and result streams demultiplexed — and keep feed bytes out
-                # of the adaptive policies' edge telemetry.
-                rank_src = stage_ranks[i - 1] if not is_first else data_rank
-                rank_dst = stage_ranks[i + 1] if not is_last else data_rank
-                stage = dcn.DcnPipelineStage(
-                    ctx, rank_src, rank_dst, work_cb,
-                    recv_channel=dcn.CHANNEL_FEED if is_first
-                    else dcn.CHANNEL_DATA,
-                    send_channel=dcn.CHANNEL_RESULTS if is_last
-                    else dcn.CHANNEL_DATA)
-                stage.start()
-            else:
-                logger.info("rank %d not in schedule; idling", rank)
+def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
+               ubatches, labels, dtype, results_target) -> None:
+    """One schedule round on a live DCN fleet: (data rank) broadcast the
+    schedule, build this rank's stage if it is in the schedule, stream the
+    batch, stop; (worker) build, run until this round's CMD_STOP."""
+    import jax
+    import jax.numpy as jnp
 
-            if rank == data_rank:
-                for lb in labels:
-                    label_queue.put(lb)
-                first_rank = stage_ranks[0]
-                last_rank = stage_ranks[-1]
+    from pipeedge_tpu.comm import dcn
 
-                def results_loop():
-                    # wire Mbits/time are measured by the transport recv
-                    # hooks (_register_dcn_monitor_hooks) on the reader
-                    # thread; this loop only consumes decoded results
-                    for _ in range(len(ubatches)):
-                        if stop_event.is_set():
-                            return
-                        try:
-                            tensors = ctx.recv_tensors(
-                                last_rank, timeout=args.sched_timeout,
-                                channel=dcn.CHANNEL_RESULTS)
-                        except queue.Empty:
-                            return
-                        out = _wire_decode(tensors, dtype)
-                        handle_results(np.asarray(out))
+    rank, data_rank = args.rank, args.data_rank
+    # cross-round frame isolation (see dcn.CHANNEL_ROUND_PARITY)
+    parity = dcn.CHANNEL_ROUND_PARITY * (rnd % 2)
+    # fresh round state BEFORE the schedule goes out: once peers have the
+    # schedule they may finish the round (CMD_STOP) at any time
+    stop_event.clear()
+    stop_info[0] = None
+    if rank == data_rank:
+        # schedule resolved by the caller; broadcast it (CMD_SCHED,
+        # reference runtime.py:441-445)
+        ctx.cmd_broadcast(CMD_SCHED, [
+            np.asarray(stage_layers, np.int32),
+            np.asarray(stage_quant, np.int32),
+            np.asarray(stage_ranks, np.int32)])
 
-                results_thread = threading.Thread(target=results_loop,
-                                                  daemon=True)
-                results_thread.start()
+    try:
+        my_stages = [i for i, r in enumerate(stage_ranks) if r == rank]
+        stage = None
+        if my_stages:
+            assert len(my_stages) == 1, \
+                "one stage per rank (reference p2p semantics)"
+            i = my_stages[0]
+            l, r = stage_layers[i]
+            restored = None
+            if args.stage_ckpt:
+                # per-stage Orbax restore: this rank reads exactly its
+                # own shard from disk (utils/checkpoint.py); validated
+                # against the runtime schedule via the manifest
+                from pipeedge_tpu.utils import checkpoint as ckpt_utils
+                ckpt_utils.check_stage_compatible(
+                    args.stage_ckpt, args.model_name, i, (l, r))
+                restored = ckpt_utils.load_stage_checkpoint(
+                    args.stage_ckpt, i)
+            fn, params, _ = registry.module_shard_factory(
+                args.model_name, args.model_file, l, r, stage=i,
+                dtype=dtype, params=restored)
+            out_bit = stage_quant[i] if i < len(stage_layers) - 1 else 0
+            is_first, is_last = i == 0, i == len(stage_layers) - 1
+            # adaptive policy (env ADAPTIVE_QUANT): this rank adapts its
+            # own output edge on its own measured 'send' window, exactly
+            # the reference's per-rank hook (runtime.py:121-216). The
+            # bitwidth travels on the wire, so the consumer needs no
+            # coordination.
+            edge = None if is_last else _EdgeQuantState(out_bit)
+            adaptive = None if edge is None else _make_adaptive_callback(
+                [edge], get_window_size())
+            ubatch_idx = [0]
+
+            def work_cb(tensors):
+                if is_first:
+                    payload = jnp.asarray(tensors[0], dtype=dtype
+                                          if tensors[0].dtype.kind == 'f'
+                                          else None)
+                else:
+                    payload = _wire_decode(tensors, dtype)
+                monitoring.iteration_start(MONITORING_KEY_MODEL)
+                out = fn(params, payload)
+                out = jax.block_until_ready(out)
+                n_items = get_microbatch_size(np.asarray(
+                    out[0] if isinstance(out, tuple) else out))
+                monitoring.iteration(MONITORING_KEY_MODEL, work=n_items,
+                                     accuracy=r - l + 1)
+                wire = _wire_encode(
+                    out, edge.quant_bit if edge is not None else 0)
+                if adaptive is not None:
+                    adaptive(ubatch_idx[0],
+                             out[0] if isinstance(out, tuple) else out)
+                    ubatch_idx[0] += 1
+                return wire
+
+            # head stage is fed over the wire from the data rank
+            # (self-connection over loopback when colocated) on the FEED
+            # channel; the last stage's results ride the RESULTS channel.
+            # Distinct channels keep a colocated schedule's feed, edge,
+            # and result streams demultiplexed — and keep feed bytes out
+            # of the adaptive policies' edge telemetry.
+            rank_src = stage_ranks[i - 1] if not is_first else data_rank
+            rank_dst = stage_ranks[i + 1] if not is_last else data_rank
+            stage = dcn.DcnPipelineStage(
+                ctx, rank_src, rank_dst, work_cb,
+                recv_channel=(dcn.CHANNEL_FEED if is_first
+                              else dcn.CHANNEL_DATA) + parity,
+                send_channel=(dcn.CHANNEL_RESULTS if is_last
+                              else dcn.CHANNEL_DATA) + parity)
+            stage.start()
+        else:
+            logger.info("rank %d not in schedule; idling", rank)
+
+        if rank == data_rank:
+            for lb in labels:
+                label_queue.put(lb)
+            first_rank = stage_ranks[0]
+            last_rank = stage_ranks[-1]
+
+            def results_loop():
+                # wire Mbits/time are measured by the transport recv
+                # hooks (_register_dcn_monitor_hooks) on the reader
+                # thread; this loop only consumes decoded results
+                for _ in range(len(ubatches)):
+                    if stop_event.is_set():
+                        return
+                    try:
+                        tensors = ctx.recv_tensors(
+                            last_rank, timeout=args.sched_timeout,
+                            channel=dcn.CHANNEL_RESULTS + parity)
+                    except (queue.Empty, ConnectionError):
+                        # timeout, or the last stage died: the peer-death
+                        # handler aborts the run; just stop consuming
+                        return
+                    out = _wire_decode(tensors, dtype)
+                    handle_results(np.asarray(out))
+
+            results_thread = threading.Thread(target=results_loop,
+                                              daemon=True)
+            results_thread.start()
+            try:
+                tik = time.monotonic()
+                batch_total = sum(len(u) for u in ubatches)
+                # results_counter is cumulative across rounds
+                results_target[0] += batch_total
+                target = results_target[0]
                 try:
-                    tik = time.monotonic()
                     for u in ubatches:
                         ctx.send_tensors(first_rank, [np.asarray(u)],
-                                         channel=dcn.CHANNEL_FEED)
-                    batch_total = sum(len(u) for u in ubatches)
-                    complete = results_counter.wait_gte(
-                        batch_total, timeout=args.sched_timeout)
-                    tok = time.monotonic()
-                finally:
-                    # CMD_STOP must go out even on failure, or the workers
-                    # hang until their own timeouts
-                    ctx.cmd_broadcast(CMD_STOP)
-                    stop_event.set()
-                results_thread.join(timeout=10)
-                if not complete:
+                                         channel=dcn.CHANNEL_FEED + parity)
+                except OSError as exc:
                     raise RuntimeError(
-                        f"pipeline delivered {results_counter.value}/"
-                        f"{batch_total} results within {args.sched_timeout}s")
-                _report(tik, tok, ubatches)
-            else:
-                if not stop_event.wait(timeout=args.sched_timeout):
+                        f"feeding stage rank {first_rank} failed "
+                        f"({exc}); peer died?") from exc
+                # poll so a peer-death stop aborts the wait immediately
+                # instead of riding out the full --sched-timeout
+                deadline = time.monotonic() + args.sched_timeout
+                complete = False
+                while not complete and time.monotonic() < deadline \
+                        and not stop_event.is_set():
+                    complete = results_counter.wait_gte(target, timeout=0.5)
+                # last results can land concurrently with an abort
+                complete = complete or results_counter.wait_gte(target,
+                                                                timeout=0)
+                tok = time.monotonic()
+            finally:
+                # CMD_STOP must go out even on failure, or the workers
+                # hang until their own timeouts
+                ctx.cmd_broadcast(CMD_STOP)
+                stop_event.set()
+            results_thread.join(timeout=10)
+            if not complete:
+                # results_counter is cumulative; report this round's share
+                delivered = results_counter.value - (target - batch_total)
+                if stop_info[0] is not None:
                     raise RuntimeError(
-                        f"rank {rank}: no CMD_STOP within "
-                        f"{args.sched_timeout}s; aborting")
-        finally:
-            if stage is not None:
-                stage.stop()
+                        f"pipeline aborted: rank {stop_info[0]} died "
+                        f"mid-run ({delivered}/{batch_total} "
+                        "results delivered)")
+                raise RuntimeError(
+                    f"pipeline delivered {delivered}/"
+                    f"{batch_total} results within {args.sched_timeout}s")
+            _report(tik, tok, ubatches)
+        else:
+            # wait on the stop COUNT, not the event: round rnd ends at the
+            # (rnd+1)-th CMD_STOP, which may already have landed while this
+            # worker was still tearing down the previous round
+            if not stop_counter.wait_gte(rnd + 1,
+                                         timeout=args.sched_timeout):
+                raise RuntimeError(
+                    f"rank {rank}: no CMD_STOP within "
+                    f"{args.sched_timeout}s; aborting")
+            if stop_info[0] is not None:
+                raise RuntimeError(
+                    f"rank {rank}: pipeline aborted: rank "
+                    f"{stop_info[0]} died mid-run")
+    finally:
+        if stage is not None:
+            stage.stop()
 
 
 def _parse_dcn_addrs(args, world_size: int) -> List[Tuple[str, int]]:
@@ -739,9 +871,12 @@ def main():
                         choices=["float32", "bfloat16"])
     # scheduling (reference runtime.py:657-687)
     parser.add_argument("-pt", "--partition", type=str,
-                        help="comma-delimited layer pairs, e.g. '1,24,25,48'")
+                        help="comma-delimited layer pairs, e.g. '1,24,25,48';"
+                             " ';'-separated values define live re-schedule "
+                             "rounds (dcn only)")
     parser.add_argument("-q", "--quant", type=str,
-                        help="comma-delimited per-stage output quant bitwidths")
+                        help="comma-delimited per-stage output quant bitwidths"
+                             " (';'-separated per re-schedule round)")
     parser.add_argument("-r", "--rank-order", type=str, default=None,
                         help="comma-delimited stage-to-device mapping")
     parser.add_argument("-D", "--data-rank", type=int, default=0,
@@ -792,30 +927,55 @@ def main():
                        "rank operation.", args.rank)
         return
 
-    partition = None
-    if args.partition:
-        nums = [int(x) for x in args.partition.split(',')]
-        assert len(nums) % 2 == 0
-        partition = list(zip(nums[::2], nums[1::2]))
-    quant = [int(x) for x in args.quant.split(',')] if args.quant else None
-    rank_order = [int(x) for x in args.rank_order.split(',')] \
-        if args.rank_order else None
     hosts = args.hosts.split(',') if args.hosts else None
     indices = None
     if args.dataset_indices_tsv:
         with open(args.dataset_indices_tsv) as f:
             indices = [int(line.split('\t')[0]) for line in f if line.strip()]
 
+    # ';'-separated -pt/-q/-r values define multiple schedule ROUNDS: the
+    # dcn fleet re-schedules live at each run boundary (CMD_SCHED). A single
+    # value applies to every round.
+    pt_rounds = args.partition.split(';') if args.partition else [None]
+    q_rounds = args.quant.split(';') if args.quant else [None]
+    r_rounds = args.rank_order.split(';') if args.rank_order else [None]
+    n_rounds = max(len(pt_rounds), len(q_rounds), len(r_rounds))
+    if n_rounds > 1 and args.comm != "dcn":
+        parser.error("';'-separated re-schedule rounds require --comm dcn")
+    for opt, specs in (("-pt", pt_rounds), ("-q", q_rounds),
+                       ("-r", r_rounds)):
+        if 1 < len(specs) != n_rounds:
+            parser.error(f"{opt}: {len(specs)} ';'-rounds given but "
+                         f"{n_rounds} rounds defined; give 1 or {n_rounds}")
+
+    def _round_spec(specs, i):
+        return specs[i] if len(specs) > 1 else specs[0]
+
     is_dcn_worker = args.comm == "dcn" and args.rank != args.data_rank
     if is_dcn_worker:
         # schedule arrives via CMD_SCHED; only the data rank loads data
+        schedules = []
         stage_layers, stage_quant, stage_ranks = [], [], []
         ubatches, labels = [], []
     else:
-        stage_layers, stage_quant, stage_ranks = get_pipeline_sched(
-            args.worldsize, hosts, partition, quant, rank_order,
-            args.model_name, args.ubatch_size, args.sched_models_file,
-            args.sched_dev_types_file, args.sched_dev_file)
+        schedules = []
+        for i in range(n_rounds):
+            partition = None
+            pt_spec = _round_spec(pt_rounds, i)
+            if pt_spec:
+                nums = [int(x) for x in pt_spec.split(',')]
+                assert len(nums) % 2 == 0
+                partition = list(zip(nums[::2], nums[1::2]))
+            q_spec = _round_spec(q_rounds, i)
+            quant = [int(x) for x in q_spec.split(',')] if q_spec else None
+            r_spec = _round_spec(r_rounds, i)
+            rank_order = [int(x) for x in r_spec.split(',')] \
+                if r_spec else None
+            schedules.append(get_pipeline_sched(
+                args.worldsize, hosts, partition, quant, rank_order,
+                args.model_name, args.ubatch_size, args.sched_models_file,
+                args.sched_dev_types_file, args.sched_dev_file))
+        stage_layers, stage_quant, stage_ranks = schedules[0]
 
         dataset = load_dataset(
             {'name': args.dataset_name, 'root': args.dataset_root,
@@ -856,8 +1016,7 @@ def main():
         with tracing.trace(trace_dir):
             if comm == "dcn":
                 # waits for its own results/stop internally (multi-process)
-                run_pipeline_dcn(args, stage_layers, stage_quant, stage_ranks,
-                                 ubatches, labels)
+                run_pipeline_dcn(args, schedules, ubatches, labels)
             elif comm == "spmd":
                 run_pipeline_spmd(args, stage_layers, stage_quant,
                                   stage_ranks, ubatches, labels)

@@ -5,6 +5,8 @@ import os
 import socket
 import subprocess
 import sys
+import threading
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -15,6 +17,36 @@ def _free_ports(n):
     for s in socks:
         s.close()
     return ports
+
+
+class _OutReader:
+    """Drain a subprocess's stdout on a thread so the test can poll for a
+    marker without blocking on readline."""
+
+    def __init__(self, proc):
+        self.proc = proc
+        self.lines = []
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        for line in self.proc.stdout:
+            self.lines.append(line)
+
+    def wait_for(self, needle, timeout):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if any(needle in line for line in self.lines):
+                return True
+            if self.proc.poll() is not None:
+                self._thread.join(timeout=5)
+                return any(needle in line for line in self.lines)
+            time.sleep(0.05)
+        return False
+
+    def text(self):
+        self._thread.join(timeout=5)
+        return "".join(self.lines)
 
 
 def test_two_process_dcn_runtime_quantized_edge(tmp_path):
@@ -76,3 +108,125 @@ def test_two_process_dcn_adaptive_quant(tmp_path):
     # transport hooks produced per-rank wire telemetry CSVs
     assert (rank_dirs[0] / "send.csv").exists()
     assert (rank_dirs[1] / "recv.csv").exists()
+
+
+def test_peer_death_aborts_fleet(tmp_path):
+    """Fault tolerance beyond the reference (whose RPC backpressure 'breaks
+    down if the previous stage fails to send data afterward',
+    rpc/__init__.py:83-86): kill a middle stage mid-run and assert the whole
+    fleet stops deterministically — well before --sched-timeout — with every
+    surviving rank raising a message naming the dead rank."""
+    addrs = ",".join(f"127.0.0.1:{p}" for p in _free_ports(3))
+    common = [sys.executable, os.path.join(REPO, "runtime.py")]
+    opts = ["-c", "dcn", "--platform", "cpu",
+            "-m", "pipeedge/test-tiny-vit", "-b", "1024", "-u", "4",
+            "-pt", "1,2,3,5,6,8", "-q", "0,0,0", "-r", "0,1,2",
+            "--dcn-addrs", addrs, "--sched-timeout", "600"]
+    env = dict(os.environ, PYTHONPATH=REPO)
+
+    def launch(rank):
+        return subprocess.Popen(common + [str(rank), "3"] + opts,
+                                cwd=tmp_path, env=env,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+
+    victim, survivor = launch(1), launch(2)
+    victim_out, survivor_out = _OutReader(victim), _OutReader(survivor)
+    data = launch(0)
+    data_out = _OutReader(data)
+    try:
+        # the victim has its schedule and model built; data is about to flow
+        assert victim_out.wait_for("stage 1: layers", 180), victim_out.text()
+        victim.kill()
+        # detection + CMD_STOP fan-out, NOT the 600s timeout
+        data.wait(timeout=120)
+        survivor.wait(timeout=60)
+    finally:
+        for proc in (victim, survivor, data):
+            proc.kill()
+    assert data.returncode not in (None, 0), data_out.text()
+    assert "died" in data_out.text(), data_out.text()
+    assert survivor.returncode not in (None, 0), survivor_out.text()
+    assert "died" in survivor_out.text(), survivor_out.text()
+
+
+def test_four_process_idle_rank_adaptive_quant(tmp_path):
+    """4 ranks, 3-stage schedule: rank 3 is NOT in the schedule and must idle
+    until CMD_STOP (reference model_cfg.py:154-159, runtime.py:456-460), while
+    the scheduled ranks run a mixed-bitwidth quantized pipeline with the
+    adaptive policy live on every edge's owner."""
+    addrs = ",".join(f"127.0.0.1:{p}" for p in _free_ports(4))
+    common = [sys.executable, os.path.join(REPO, "runtime.py")]
+    opts = ["-c", "dcn", "--platform", "cpu",
+            "-m", "pipeedge/test-tiny-vit", "-b", "32", "-u", "4",
+            "-pt", "1,2,3,5,6,8", "-q", "8,4,0", "-r", "0,1,2",
+            "--dcn-addrs", addrs, "--sched-timeout", "180"]
+    rank_dirs = []
+    for r in range(4):
+        d = tmp_path / f"rank{r}"
+        d.mkdir()
+        rank_dirs.append(d)
+    env = dict(os.environ, PYTHONPATH=REPO, ADAPTIVE_QUANT="HEURISTIC",
+               SEND_CONSTRAINT="100", WINDOW_SIZE="3")
+    workers = [subprocess.Popen(common + [str(r), "4"] + opts,
+                                cwd=rank_dirs[r], env=env,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+               for r in (1, 2, 3)]
+    try:
+        data = subprocess.run(common + ["0", "4"] + opts, cwd=rank_dirs[0],
+                              env=env, capture_output=True, text=True,
+                              timeout=300)
+        wouts = [w.communicate(timeout=60)[0] for w in workers]
+    finally:
+        for w in workers:
+            w.kill()
+    assert data.returncode == 0, data.stdout + data.stderr
+    assert "latency_sec=" in data.stdout
+    for r, wout in zip((1, 2, 3), wouts):
+        assert workers[r - 1].returncode == 0, wout
+    assert "stage 1: layers [3, 5]" in wouts[0]
+    assert "stage 2: layers [6, 8]" in wouts[1]
+    assert "not in schedule; idling" in wouts[2]
+    # stage 0 (data rank) and stage 1 both own quantized output edges whose
+    # bitwidth the policy adapts on their measured send window
+    assert "Adaptive quantization" in data.stdout + data.stderr
+    assert "Adaptive quantization" in wouts[0]
+    # per-rank wire telemetry from the transport hooks
+    assert (rank_dirs[0] / "send.csv").exists()
+    assert (rank_dirs[1] / "send.csv").exists()
+    assert (rank_dirs[2] / "recv.csv").exists()
+
+
+def test_live_reschedule_two_rounds(tmp_path):
+    """Live re-scheduling over one DCN fleet: the reference DESIGNED this
+    (CMD_SCHED lands on a queue any time, runtime.py:404-415) but its runtime
+    consumes exactly one schedule. Here the data rank broadcasts a second,
+    DIFFERENT partition at the run boundary and the same worker processes
+    rebuild their stages and run again — ending on an empty CMD_SCHED."""
+    addrs = ",".join(f"127.0.0.1:{p}" for p in _free_ports(2))
+    common = [sys.executable, os.path.join(REPO, "runtime.py")]
+    opts = ["-c", "dcn", "--platform", "cpu",
+            "-m", "pipeedge/test-tiny-vit", "-b", "16", "-u", "4",
+            "-pt", "1,4,5,8;1,2,3,8", "-q", "8,0;4,0", "-r", "0,1",
+            "--dcn-addrs", addrs, "--sched-timeout", "180"]
+    env = dict(os.environ, PYTHONPATH=REPO)
+    worker = subprocess.Popen(common + ["1", "2"] + opts, cwd=tmp_path,
+                              env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+    try:
+        data = subprocess.run(common + ["0", "2"] + opts, cwd=tmp_path,
+                              env=env, capture_output=True, text=True,
+                              timeout=300)
+        wout, _ = worker.communicate(timeout=60)
+    finally:
+        worker.kill()
+    assert data.returncode == 0, data.stdout + data.stderr
+    # one latency report per round
+    assert data.stdout.count("latency_sec=") == 2, data.stdout
+    assert "re-schedule: broadcasting round 1" in data.stdout + data.stderr
+    assert worker.returncode == 0, wout
+    # the worker rebuilt its stage with the round-2 partition
+    assert "stage 1: layers [5, 8]" in wout
+    assert "stage 1: layers [3, 8]" in wout
+    assert "empty CMD_SCHED; shutting down" in wout
