@@ -42,6 +42,10 @@ logger = logging.getLogger(__name__)
 # Command identifiers (reference runtime.py:36-37)
 CMD_STOP = 0
 CMD_SCHED = 1
+# reverse-auction bid request (the reference fans this out as an RPC call,
+# revauct.py:168-174; over DCN it is a command frame answered on the
+# transport's BIDS channel)
+CMD_BID = 2
 
 DistCmdHandler = Callable[[int, Tuple[Any, ...]], None]
 

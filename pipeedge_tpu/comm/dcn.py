@@ -68,6 +68,7 @@ _DIM = struct.Struct('!q')
 
 CHANNEL_DATA = 0     # inter-stage activations
 CHANNEL_RESULTS = 1  # last stage -> data rank
+CHANNEL_BIDS = 3     # reverse-auction bid replies -> auctioneer
 # Round-parity offset for multi-round (re-schedule) runs: round r uses
 # channel + CHANNEL_ROUND_PARITY*(r%2), so a frame the data rank streams for
 # round r+1 can never be pulled by a stage from round r that is still
@@ -88,6 +89,23 @@ CHANNEL_FEED = 2     # data rank -> head stage (raw inputs). A separate
 # the reference injects inputs *locally* (enqueue_tensor, p2p:442-450), so
 # its per-rank 'send' telemetry never contains feed bytes — keeping the
 # adaptive-quant policies' sensor clean. Monitoring hooks can filter on it.
+
+
+def parse_rank_addrs(dcn_addrs: Optional[str], world_size: int,
+                     base_port: int) -> List[Tuple[str, int]]:
+    """Parse `--dcn-addrs 'h:p,h:p,...'` (one per rank) or default to
+    localhost at base_port+rank (the reference's MASTER_ADDR/PORT analogue,
+    runtime.py:599). Shared by every DCN CLI."""
+    if dcn_addrs:
+        parts = dcn_addrs.split(',')
+        if len(parts) != world_size:
+            raise RuntimeError("--dcn-addrs must list one host:port per rank")
+        out = []
+        for p in parts:
+            host, port = p.rsplit(':', 1)
+            out.append((host, int(port)))
+        return out
+    return [("127.0.0.1", base_port + i) for i in range(world_size)]
 
 
 def _dtype_code(dtype: np.dtype) -> int:
@@ -192,10 +210,16 @@ class DistDcnContext(DistContext):
         self._listener: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
         self._reader_threads: List[threading.Thread] = []
-        self._conns: Dict[int, socket.socket] = {}       # outgoing, by dst
+        self._conns: Dict[int, socket.socket] = {}       # outgoing data, by dst
+        # outgoing COMMAND connections, separate from the data sockets: a
+        # data send blocked on backpressure holds its conn lock for as long
+        # as the receiver stalls, and an abort command (CMD_STOP after a
+        # peer death) must never queue behind it
+        self._cmd_conns: Dict[int, socket.socket] = {}
         # per-destination locks (created upfront: world size is known), so a
         # slow dial to one peer never stalls traffic to the others
         self._conn_locks = [threading.Lock() for _ in range(world_size)]
+        self._cmd_conn_locks = [threading.Lock() for _ in range(world_size)]
         self._conns_lock = threading.Lock()              # dict/list mutation
         self._accepted: List[socket.socket] = []         # incoming
         self._recv_queues: Dict[Tuple[int, int], "queue.Queue"] = {}
@@ -287,8 +311,10 @@ class DistDcnContext(DistContext):
         if self._accept_thread is not None:
             self._accept_thread.join()
         with self._conns_lock:
-            conns = list(self._conns.values()) + self._accepted
+            conns = (list(self._conns.values())
+                     + list(self._cmd_conns.values()) + self._accepted)
             self._conns.clear()
+            self._cmd_conns.clear()
             self._accepted.clear()
         for c in conns:
             try:
@@ -383,14 +409,18 @@ class DistDcnContext(DistContext):
 
     # -- outgoing ------------------------------------------------------
 
-    def _ensure_conn(self, dst: int,
-                     timeout: Optional[float] = None) -> socket.socket:
-        """Dial `dst` lazily; caller must hold _conn_locks[dst]. Retries
-        refused connections until the deadline (CONNECT_TIMEOUT default) so
+    def _ensure_conn(self, dst: int, timeout: Optional[float] = None,
+                     conns: Optional[Dict[int, socket.socket]] = None) \
+            -> socket.socket:
+        """Dial `dst` lazily into `conns` (default: the data-conn map);
+        caller must hold the matching per-dst lock. Retries refused
+        connections until the deadline (CONNECT_TIMEOUT default) so
         simultaneously-launched ranks can dial peers whose listeners aren't
         up yet (the role of the reference's process-group rendezvous,
         p2p:62)."""
-        conn = self._conns.get(dst)
+        if conns is None:
+            conns = self._conns
+        conn = conns.get(dst)
         if conn is not None:
             return conn
         host, port = self._rank_addrs[dst]
@@ -411,7 +441,7 @@ class DistDcnContext(DistContext):
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         _send_frame(conn, _MSG_HELLO, self._rank, ())
         with self._conns_lock:
-            self._conns[dst] = conn
+            conns[dst] = conn
         return conn
 
     def send_tensors(self, dst: int, tensors: Sequence[np.ndarray],
@@ -490,12 +520,18 @@ class DistDcnContext(DistContext):
             if dst == self._rank:
                 continue
             try:
-                with self._conn_locks[dst]:
+                # dedicated command connections: never blocked behind a
+                # backpressured data send to the same peer
+                with self._cmd_conn_locks[dst]:
                     remaining = max(1.0, deadline - time.monotonic())
-                    conn = self._ensure_conn(dst, timeout=remaining)
+                    conn = self._ensure_conn(dst, timeout=remaining,
+                                             conns=self._cmd_conns)
                     _send_frame(conn, _MSG_CMD, cmd, tensors)
             except OSError as exc:
-                # keep delivering to the remaining reachable peers either way
+                # keep delivering to the remaining reachable peers either
+                # way; drop the broken conn so a later broadcast redials
+                with self._conns_lock:
+                    self._cmd_conns.pop(dst, None)
                 failures.append((dst, exc))
                 logger.warning("cmd_broadcast: rank %d unreachable (%s); "
                                "skipping", dst, exc)

@@ -1,6 +1,23 @@
 """Utility modules: thread primitives, controllers, quantization policies, data."""
 
 
+def apply_env_platform() -> None:
+    """Honor an explicit JAX_PLATFORMS env var via jax.config.
+
+    The TPU plugin overrides the env var during backend discovery, so
+    `JAX_PLATFORMS=cpu some_cli.py` silently grabs the (single-tenant,
+    tunneled) TPU chip unless the platform is forced through jax.config
+    before the first device query. CLIs that tests run as subprocesses call
+    this first thing.
+    """
+    import os
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
+
+
 def force_host_cpu_devices(n: int) -> None:
     """Point jax at >= n virtual CPU devices (for multi-"chip" testing
     without TPU hardware, SURVEY.md §4).

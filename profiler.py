@@ -99,5 +99,7 @@ def main():
 
 
 if __name__ == "__main__":
+    from pipeedge_tpu.utils import apply_env_platform
+    apply_env_platform()  # honor an explicit JAX_PLATFORMS (e.g. cpu in CI)
     logging.basicConfig(level=logging.INFO)
     main()

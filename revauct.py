@@ -5,19 +5,32 @@ Parity with /root/reference/revauct.py: every device bids its feasible shards
 bids and runs a latency-, throughput-, or host-count-optimizing scheduler,
 printing the 1-indexed schedule YAML.
 
-Single-controller adaptation: the reference fans the bid request out over
-torch RPC to one process per device (revauct.py:168-180). Here all device
-configs (device_types.yml + devices.yml + device_neighbors_world.yml) are
-local, so bids are gathered with a thread pool — same fan-out/fan-in shape,
-no network bring-up. Chips/hosts in the YAML play the role of ranks.
+Two fan-out modes:
+
+- ``--comm local`` (single controller): all device configs
+  (device_types.yml + devices.yml + device_neighbors_world.yml) are local,
+  so bids are gathered with a thread pool — same fan-out/fan-in shape as the
+  reference's RPC, no network bring-up. Chips/hosts in the YAML play the
+  role of ranks.
+- ``--comm dcn`` (distributed): one process per rank, exactly the
+  reference's deployment (revauct.py:168-180) — the auctioneer (rank 0)
+  broadcasts a CMD_BID over the DCN command plane; every rank computes its
+  bid from its OWN local profile files (`--dev-type`/`--host` identify the
+  bidder, reference _DEVICE_CFG at revauct.py:147-152) and replies on the
+  transport's BIDS channel. The auctioneer never needs the other ranks'
+  device_types files.
 """
 import argparse
+import json
 import logging
+import queue
 import random
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional, Tuple
 
+import numpy as np
 import yaml
 
 from pipeedge_tpu import sched
@@ -25,6 +38,9 @@ from pipeedge_tpu.models import registry
 from pipeedge_tpu.sched import revauct, yaml_files
 
 logger = logging.getLogger(__name__)
+
+# the auction's profile dtype key (reference revauct.py fixes this too)
+DTYPE = 'torch.float32'
 
 
 def _find_profiles(yml_models, yml_dev_types, dev_type, model: str,
@@ -60,31 +76,223 @@ def bid_latency_for_host(host: str, dev_type: str, cfg: dict, model: str,
                                                dtype=dtype):
             shards.append(shard)
             costs.append(cost)
+    else:
+        # an empty bid silently shrinks the auctioned fleet — make the
+        # misconfiguration (unknown dev type / missing profile) visible
+        logger.warning(
+            "host %s bids NOTHING: model=%s dev_type=%s ubatch=%d dtype=%s "
+            "has no matching profile in the local device files",
+            host, model, dev_type, ubatch_size, dtype)
     neighbors = cfg['yml_dev_neighbors_world'].get(host, {})
     logger.debug("Reverse auction bid time (ms): %f",
                  1000 * (time.time() - t_start))
     return host, (shards, costs, neighbors)
 
 
+def _load_cfg(args) -> dict:
+    """This rank's local profile files (reference _DEVICE_CFG population,
+    revauct.py:147-152)."""
+    return {
+        'yml_models': yaml_files.yaml_models_load(args.sched_models_file),
+        'yml_dev_types': yaml_files.yaml_device_types_load(
+            args.sched_dev_types_file),
+        'yml_dev_neighbors_world': yaml_files.yaml_device_neighbors_world_load(
+            args.sched_dev_neighbors_world),
+    }
+
+
+def _schedule_and_print(args, yml_model, bids_in_order) -> None:
+    """Auctioneer tail: filter/order the collected bids, run the selected
+    scheduler, print the 1-indexed schedule YAML (reference
+    revauct.py:182-239)."""
+    bid_data_by_host = {
+        host: ({tuple(s): c for s, c in zip(payload[0], payload[1])},
+               payload[2])
+        for host, payload in bids_in_order}
+
+    if args.filter_bids_chunk > 1:
+        bid_data_by_host = {
+            h: (revauct.filter_bids_chunk(yml_model, b[0],
+                                          chunk=args.filter_bids_chunk), b[1])
+            for h, b in bid_data_by_host.items()}
+    if args.filter_bids_largest:
+        bid_data_by_host = {h: (revauct.filter_bids_largest(b[0]), b[1])
+                            for h, b in bid_data_by_host.items()}
+
+    data_host = args.data_host if args.data_host else \
+        next(iter(bid_data_by_host))
+    dev_order = list(bid_data_by_host.keys())
+    rng = random.Random(args.seed)
+    rng.shuffle(dev_order)
+    dev_order = dev_order[:args.dev_count]
+    for idx, dev in enumerate(dev_order):
+        if dev == data_host:
+            dev_order[0], dev_order[idx] = dev_order[idx], dev_order[0]
+    logger.info("Device order: %s", dev_order)
+
+    strict_order = not args.no_strict_order
+    schedule = []
+    t_start = time.time()
+    if args.scheduler == 'latency_ordered':
+        schedule, pred = revauct.sched_optimal_latency_dev_order(
+            yml_model, args.ubatch_size, DTYPE, bid_data_by_host, data_host,
+            data_host, dev_order, strict_order=strict_order,
+            strict_first=args.strict_first, strict_last=args.strict_last)
+        logger.info("Latency prediction (sec): %s", pred)
+    elif args.scheduler == 'throughput_ordered':
+        schedule, pred = revauct.sched_optimal_throughput_dev_order(
+            yml_model, args.ubatch_size, DTYPE, bid_data_by_host, data_host,
+            data_host, dev_order, strict_order=strict_order,
+            strict_first=args.strict_first, strict_last=args.strict_last)
+        logger.info("Throughput prediction (items/sec): %s", pred)
+    else:
+        schedule = revauct.sched_greedy_host_count(
+            yml_model, args.ubatch_size, DTYPE, bid_data_by_host, data_host,
+            data_host)
+    logger.info("Scheduler function runtime (sec): %s", time.time() - t_start)
+    logger.info("Schedule stages: %d", len(schedule))
+
+    # shift to the runtime's 1-based layer numbering (reference
+    # revauct.py:233-235)
+    sched_compat = [{host: [l + 1 for l in layers]
+                     for host, layers in part.items()} for part in schedule]
+    logger.info("Schedule:")
+    print(yaml.safe_dump(sched_compat, default_flow_style=None,
+                         sort_keys=False))
+
+
+def main_local(args) -> None:
+    """Single-controller auction: all device configs are local; bids fan out
+    to a thread pool (the reference's RPC fan-out shape, revauct.py:168-180,
+    without network bring-up)."""
+    if args.rank != 0:
+        logger.info("Single-controller auction: rank %d idle", args.rank)
+        return
+    cfg = _load_cfg(args)
+    host_types = {}
+    for dev_type, hosts in yaml_files.yaml_devices_load(
+            args.sched_dev_file).items():
+        for host in hosts:
+            host_types[host] = dev_type
+
+    hosts = list(cfg['yml_dev_neighbors_world'].keys())[:args.worldsize]
+    yml_model = cfg['yml_models'][args.model_name]
+
+    t_start = time.time()
+    with ThreadPoolExecutor() as pool:
+        futs = [pool.submit(bid_latency_for_host, host,
+                            host_types.get(host, ''), cfg, args.model_name,
+                            args.ubatch_size, DTYPE) for host in hosts]
+        bids_in_order = [f.result() for f in futs]
+    logger.debug("Reverse auction total time (ms): %f",
+                 1000 * (time.time() - t_start))
+    if args.data_host is None:
+        args.data_host = hosts[0]
+    _schedule_and_print(args, yml_model, bids_in_order)
+
+
+def main_dcn(args) -> None:
+    """Distributed auction over the DCN command plane: rank-local bids, the
+    reference's deployment shape (one process per device,
+    revauct.py:168-180). Rank 0 is the auctioneer AND a bidder."""
+    from pipeedge_tpu.comm import CMD_BID, CMD_STOP, dcn
+
+    cfg = _load_cfg(args)
+    bid_req_q: "queue.Queue" = queue.Queue()
+    stop_ev = threading.Event()
+
+    def handler(cmd, tensors):
+        if cmd == CMD_BID:
+            bid_req_q.put(tensors)
+        elif cmd == CMD_STOP:
+            stop_ev.set()
+
+    addrs = dcn.parse_rank_addrs(args.dcn_addrs, args.worldsize, args.port)
+    with dcn.DistDcnContext(args.worldsize, args.rank, addrs,
+                            cmd_handler=handler) as ctx:
+        if args.rank == 0:
+            # broadcast the auction request (reference rpc_async fan-out,
+            # revauct.py:171-174); rank 0 bids locally
+            ctx.cmd_broadcast(CMD_BID, [
+                np.frombuffer(args.model_name.encode(), np.uint8),
+                np.asarray(args.ubatch_size, np.int32),
+                np.frombuffer(DTYPE.encode(), np.uint8)])
+            bids_in_order = [bid_latency_for_host(
+                args.host, args.dev_type, cfg, args.model_name,
+                args.ubatch_size, DTYPE)]
+            for rank in range(1, args.worldsize):
+                blob = ctx.recv_tensors(rank, timeout=args.auction_timeout,
+                                        channel=dcn.CHANNEL_BIDS)
+                bid = json.loads(bytes(blob[0]).decode())
+                bids_in_order.append(
+                    (bid['host'],
+                     (bid['shards'], bid['costs'], bid['neighbors'])))
+            ctx.cmd_broadcast(CMD_STOP)
+            if args.data_host is None:
+                args.data_host = args.host
+            yml_model = cfg['yml_models'][args.model_name]
+            _schedule_and_print(args, yml_model, bids_in_order)
+        else:
+            # bidder: wait for the request, answer from the LOCAL profiles
+            # only (this process never sees the other ranks' device files)
+            try:
+                tensors = bid_req_q.get(timeout=args.auction_timeout)
+            except queue.Empty:
+                raise RuntimeError(
+                    f"rank {args.rank}: no CMD_BID within "
+                    f"{args.auction_timeout}s; is the auctioneer up?") \
+                    from None
+            model = bytes(tensors[0]).decode()
+            ubatch_size = int(tensors[1])
+            dtype = bytes(tensors[2]).decode()
+            host, payload = bid_latency_for_host(
+                args.host, args.dev_type, cfg, model, ubatch_size, dtype)
+            blob = json.dumps({'host': host, 'shards': payload[0],
+                               'costs': payload[1],
+                               'neighbors': payload[2]}).encode()
+            ctx.send_tensors(0, [np.frombuffer(blob, np.uint8)],
+                             channel=dcn.CHANNEL_BIDS)
+            stop_ev.wait(timeout=args.auction_timeout)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(
         description="Pipeline Reverse Auction Scheduler",
         formatter_class=argparse.ArgumentDefaultsHelpFormatter)
-    parser.add_argument("rank", type=int, help="must be 0 (single controller)")
+    parser.add_argument("rank", type=int,
+                        help="this node's rank (0 = auctioneer)")
     parser.add_argument("worldsize", type=int,
-                        help="number of devices to auction over (<= hosts in "
-                             "the neighbors world file)")
+                        help="number of devices to auction over")
+    netcfg = parser.add_argument_group('Network configuration (--comm dcn)')
+    netcfg.add_argument("-c", "--comm", default="local",
+                        choices=["local", "dcn"],
+                        help="bid fan-out: local thread pool (single "
+                             "controller) or distributed rank-local bids "
+                             "over the DCN command plane")
+    netcfg.add_argument("--dcn-addrs", type=str, default=None,
+                        help="comma-separated host:port per rank")
+    netcfg.add_argument("--port", type=int, default=29500,
+                        help="base port when --dcn-addrs is unset "
+                             "(rank i listens on port+i)")
+    netcfg.add_argument("--auction-timeout", type=float, default=120.0)
     devcfg = parser.add_argument_group('Device configuration')
     devcfg.add_argument("-sm", "--sched-models-file", default='models.yml')
     devcfg.add_argument("-sdt", "--sched-dev-types-file",
                         default='device_types.yml')
     devcfg.add_argument("-sd", "--sched-dev-file", default='devices.yml',
-                        help="device types to hosts mapping YAML file")
+                        help="device types to hosts mapping YAML file "
+                             "(--comm local only)")
     devcfg.add_argument("-sdnw", "--sched-dev-neighbors-world",
                         default='device_neighbors_world.yml')
+    devcfg.add_argument("--host", type=str, default=None,
+                        help="this bidder's hostname (--comm dcn; reference "
+                             "revauct.py --host); default rank<N>")
+    devcfg.add_argument("--dev-type", type=str, default=None,
+                        help="this bidder's device type name in its local "
+                             "device_types file (--comm dcn)")
     devcfg.add_argument("-D", "--data-host", type=str, default=None,
                         help="host where inputs are loaded and outputs "
-                             "processed; default: first host")
+                             "processed; default: first host / auctioneer")
     modcfg = parser.add_argument_group('Model configuration')
     modcfg.add_argument("-m", "--model-name", type=str,
                         default="google/vit-base-patch16-224",
@@ -103,88 +311,16 @@ def main() -> None:
     schcfg.add_argument("--seed", type=int, default=None,
                         help="seed the device-order shuffle")
     args = parser.parse_args()
+    if args.host is None:
+        args.host = f"rank{args.rank}"
+    if args.comm == "dcn" and not args.dev_type:
+        parser.error("--comm dcn requires --dev-type (this bidder's entry "
+                     "in its local device_types file)")
 
-    if args.rank != 0:
-        logger.info("Single-controller auction: rank %d idle", args.rank)
-        return
-
-    cfg = {
-        'yml_models': yaml_files.yaml_models_load(args.sched_models_file),
-        'yml_dev_types': yaml_files.yaml_device_types_load(
-            args.sched_dev_types_file),
-        'yml_dev_neighbors_world': yaml_files.yaml_device_neighbors_world_load(
-            args.sched_dev_neighbors_world),
-    }
-    host_types = {}
-    for dev_type, hosts in yaml_files.yaml_devices_load(
-            args.sched_dev_file).items():
-        for host in hosts:
-            host_types[host] = dev_type
-
-    hosts = list(cfg['yml_dev_neighbors_world'].keys())[:args.worldsize]
-    yml_model = cfg['yml_models'][args.model_name]
-    dtype = 'torch.float32'
-
-    # fan out bid requests (thread pool replaces the reference's RPC fan-out)
-    t_start = time.time()
-    with ThreadPoolExecutor() as pool:
-        futs = [pool.submit(bid_latency_for_host, host,
-                            host_types.get(host, ''), cfg, args.model_name,
-                            args.ubatch_size, dtype) for host in hosts]
-        bids_in_order = [f.result() for f in futs]
-    logger.debug("Reverse auction total time (ms): %f",
-                 1000 * (time.time() - t_start))
-    bid_data_by_host = {
-        host: ({tuple(s): c for s, c in zip(payload[0], payload[1])}, payload[2])
-        for host, payload in bids_in_order}
-
-    if args.filter_bids_chunk > 1:
-        bid_data_by_host = {
-            h: (revauct.filter_bids_chunk(yml_model, b[0],
-                                          chunk=args.filter_bids_chunk), b[1])
-            for h, b in bid_data_by_host.items()}
-    if args.filter_bids_largest:
-        bid_data_by_host = {h: (revauct.filter_bids_largest(b[0]), b[1])
-                            for h, b in bid_data_by_host.items()}
-
-    data_host = args.data_host if args.data_host else hosts[0]
-    dev_order = list(bid_data_by_host.keys())
-    rng = random.Random(args.seed)
-    rng.shuffle(dev_order)
-    dev_order = dev_order[:args.dev_count]
-    for idx, dev in enumerate(dev_order):
-        if dev == data_host:
-            dev_order[0], dev_order[idx] = dev_order[idx], dev_order[0]
-    logger.info("Device order: %s", dev_order)
-
-    strict_order = not args.no_strict_order
-    schedule = []
-    t_start = time.time()
-    if args.scheduler == 'latency_ordered':
-        schedule, pred = revauct.sched_optimal_latency_dev_order(
-            yml_model, args.ubatch_size, dtype, bid_data_by_host, data_host,
-            data_host, dev_order, strict_order=strict_order,
-            strict_first=args.strict_first, strict_last=args.strict_last)
-        logger.info("Latency prediction (sec): %s", pred)
-    elif args.scheduler == 'throughput_ordered':
-        schedule, pred = revauct.sched_optimal_throughput_dev_order(
-            yml_model, args.ubatch_size, dtype, bid_data_by_host, data_host,
-            data_host, dev_order, strict_order=strict_order,
-            strict_first=args.strict_first, strict_last=args.strict_last)
-        logger.info("Throughput prediction (items/sec): %s", pred)
+    if args.comm == "dcn":
+        main_dcn(args)
     else:
-        schedule = revauct.sched_greedy_host_count(
-            yml_model, args.ubatch_size, dtype, bid_data_by_host, data_host,
-            data_host)
-    logger.info("Scheduler function runtime (sec): %s", time.time() - t_start)
-    logger.info("Schedule stages: %d", len(schedule))
-
-    # shift to the runtime's 1-based layer numbering (reference revauct.py:233-235)
-    sched_compat = [{host: [l + 1 for l in layers]
-                     for host, layers in part.items()} for part in schedule]
-    logger.info("Schedule:")
-    print(yaml.safe_dump(sched_compat, default_flow_style=None,
-                         sort_keys=False))
+        main_local(args)
 
 
 if __name__ == "__main__":

@@ -554,7 +554,7 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
 
     rank, world_size = args.rank, args.worldsize
     data_rank = args.data_rank
-    addrs = _parse_dcn_addrs(args, world_size)
+    addrs = dcn.parse_rank_addrs(args.dcn_addrs, world_size, args.port)
     dtype = jnp.bfloat16 if args.dtype == 'bfloat16' else jnp.float32
 
     with dcn.DistDcnContext(world_size, rank, addrs,
@@ -647,10 +647,14 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
     rank, data_rank = args.rank, args.data_rank
     # cross-round frame isolation (see dcn.CHANNEL_ROUND_PARITY)
     parity = dcn.CHANNEL_ROUND_PARITY * (rnd % 2)
+    # a peer death is terminal for the whole run — stop_info is never reset,
+    # so a death notification landing between rounds cannot be erased
+    if stop_info[0] is not None:
+        raise RuntimeError(f"rank {rank}: pipeline aborted: rank "
+                           f"{stop_info[0]} died")
     # fresh round state BEFORE the schedule goes out: once peers have the
     # schedule they may finish the round (CMD_STOP) at any time
     stop_event.clear()
-    stop_info[0] = None
     if rank == data_rank:
         # schedule resolved by the caller; broadcast it (CMD_SCHED,
         # reference runtime.py:441-445)
@@ -759,20 +763,30 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
             results_thread = threading.Thread(target=results_loop,
                                               daemon=True)
             results_thread.start()
+            def feed_loop():
+                # feeding runs on its own thread: a send backpressured by a
+                # stalled pipeline can block in the kernel indefinitely, and
+                # the main thread must stay free to abort (peer death) and
+                # broadcast CMD_STOP. On send failure the transport's
+                # peer-death handler aborts the run; just stop feeding.
+                try:
+                    for u in ubatches:
+                        if stop_event.is_set():
+                            return
+                        ctx.send_tensors(first_rank, [np.asarray(u)],
+                                         channel=dcn.CHANNEL_FEED + parity)
+                except OSError as exc:
+                    logger.error("feeding stage rank %d failed (%s)",
+                                 first_rank, exc)
+
             try:
                 tik = time.monotonic()
                 batch_total = sum(len(u) for u in ubatches)
                 # results_counter is cumulative across rounds
                 results_target[0] += batch_total
                 target = results_target[0]
-                try:
-                    for u in ubatches:
-                        ctx.send_tensors(first_rank, [np.asarray(u)],
-                                         channel=dcn.CHANNEL_FEED + parity)
-                except OSError as exc:
-                    raise RuntimeError(
-                        f"feeding stage rank {first_rank} failed "
-                        f"({exc}); peer died?") from exc
+                feed_thread = threading.Thread(target=feed_loop, daemon=True)
+                feed_thread.start()
                 # poll so a peer-death stop aborts the wait immediately
                 # instead of riding out the full --sched-timeout
                 deadline = time.monotonic() + args.sched_timeout
@@ -790,6 +804,7 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                 ctx.cmd_broadcast(CMD_STOP)
                 stop_event.set()
             results_thread.join(timeout=10)
+            feed_thread.join(timeout=10)
             if not complete:
                 # results_counter is cumulative; report this round's share
                 delivered = results_counter.value - (target - batch_total)
@@ -805,34 +820,25 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
         else:
             # wait on the stop COUNT, not the event: round rnd ends at the
             # (rnd+1)-th CMD_STOP, which may already have landed while this
-            # worker was still tearing down the previous round
-            if not stop_counter.wait_gte(rnd + 1,
-                                         timeout=args.sched_timeout):
-                raise RuntimeError(
-                    f"rank {rank}: no CMD_STOP within "
-                    f"{args.sched_timeout}s; aborting")
+            # worker was still tearing down the previous round. Poll so a
+            # LOCALLY detected death (own send failed; own broadcast skips
+            # self, so stop_counter never moves) also aborts promptly.
+            deadline = time.monotonic() + args.sched_timeout
+            stopped = False
+            while not stopped and stop_info[0] is None \
+                    and time.monotonic() < deadline:
+                stopped = stop_counter.wait_gte(rnd + 1, timeout=0.5)
             if stop_info[0] is not None:
                 raise RuntimeError(
                     f"rank {rank}: pipeline aborted: rank "
                     f"{stop_info[0]} died mid-run")
+            if not stopped:
+                raise RuntimeError(
+                    f"rank {rank}: no CMD_STOP within "
+                    f"{args.sched_timeout}s; aborting")
     finally:
         if stage is not None:
             stage.stop()
-
-
-def _parse_dcn_addrs(args, world_size: int) -> List[Tuple[str, int]]:
-    """--dcn-addrs 'h:p,h:p,...' (one per rank) or localhost defaults at
-    --port+rank (the reference's MASTER_ADDR/PORT analogue, runtime.py:599)."""
-    if args.dcn_addrs:
-        parts = args.dcn_addrs.split(',')
-        if len(parts) != world_size:
-            raise RuntimeError("--dcn-addrs must list one host:port per rank")
-        out = []
-        for p in parts:
-            host, port = p.rsplit(':', 1)
-            out.append((host, int(port)))
-        return out
-    return [("127.0.0.1", args.port + i) for i in range(world_size)]
 
 
 def _report(tik, tok, ubatches):

@@ -70,6 +70,8 @@ def save_weights(model_name: str, model_file: str, random_init: bool = False) ->
 
 
 if __name__ == "__main__":
+    from pipeedge_tpu.utils import apply_env_platform
+    apply_env_platform()  # tests run this CLI with JAX_PLATFORMS=cpu
     parser = argparse.ArgumentParser(description="Save model weights files")
     parser.add_argument("-m", "--model-name", action='append',
                         choices=registry.get_model_names(),

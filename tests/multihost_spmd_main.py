@@ -1,0 +1,70 @@
+"""Multi-host SPMD smoke worker: one process per "host", composed into a
+single global device mesh via `MultiHostContext` (jax.distributed).
+
+Run by tests/test_multihost.py as `python multihost_spmd_main.py RANK WORLD
+COORD_ADDR`: each process contributes 4 virtual CPU devices, the global mesh
+spans 2x4=8 devices, and the full SPMD pipeline step (pp x dp, quantized
+ppermute edges) compiles and executes across the process boundary — the
+mechanism that spans TPU slices over DCN (SURVEY.md §5.8).
+"""
+import os
+import sys
+
+rank = int(sys.argv[1])
+world = int(sys.argv[2])
+coord = sys.argv[3]
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from pipeedge_tpu.comm import MultiHostContext  # noqa: E402
+from pipeedge_tpu.models import ShardConfig  # noqa: E402
+from pipeedge_tpu.models import vit as vit_mod  # noqa: E402
+from pipeedge_tpu.models.layers import TransformerConfig  # noqa: E402
+from pipeedge_tpu.parallel import spmd  # noqa: E402
+
+
+def main() -> None:
+    with MultiHostContext(coord, world, rank):
+        n_local = len(jax.local_devices())
+        n_global = len(jax.devices())
+        assert n_local == 4, n_local
+        assert n_global == 4 * world, n_global
+
+        dp, n_stages = 2, n_global // 2
+        cfg = TransformerConfig(model_type="vit", hidden_size=32,
+                                num_hidden_layers=n_stages,
+                                num_attention_heads=4, intermediate_size=64,
+                                num_labels=5, image_size=16, patch_size=4)
+        total = 4 * cfg.num_hidden_layers
+        partition = [(4 * i + 1, 4 * (i + 1)) for i in range(n_stages)]
+        stage_params = []
+        for l, r in partition:
+            sc = ShardConfig(l, r, is_first=l == 1, is_last=r == total)
+            # same seed everywhere: every process must contribute identical
+            # replicated values to the global arrays
+            stage_params.append(vit_mod.init_params(cfg, sc, seed=0))
+        mesh = spmd.make_pipeline_mesh(n_stages, dp=dp)
+        pipe = spmd.build_spmd_pipeline(vit_mod.FAMILY, cfg, partition,
+                                        stage_params, mesh,
+                                        quant_bit=[8] * (n_stages - 1) + [0])
+        batch = 2 * dp
+        inputs = jnp.asarray(
+            np.random.default_rng(0).normal(
+                size=(n_stages + 1, batch, 3, 16, 16)), dtype=jnp.float32)
+        out = pipe.run(inputs)
+        jax.block_until_ready(out)
+        assert out.shape == (n_stages + 1, batch, 5), out.shape
+        print(f"MULTIHOST-OK rank={rank} local={n_local} global={n_global} "
+              f"out={out.shape}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

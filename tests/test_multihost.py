@@ -1,0 +1,35 @@
+"""Multi-host SPMD smoke: the spmd driver composes with process-spanning
+meshes via `MultiHostContext` (jax.distributed.initialize) — the mechanism
+that joins TPU slices over DCN into one global device mesh (SURVEY.md §5.8,
+the role of the reference's init_process_group bring-up, p2p:62)."""
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_two_process_spmd_pipeline():
+    with socket.create_server(("127.0.0.1", 0)) as s:
+        coord = f"127.0.0.1:{s.getsockname()[1]}"
+    script = os.path.join(REPO, "tests", "multihost_spmd_main.py")
+    # a clean environment: the parent test process forced its own platform
+    # config, but each child must bring up its own 4-device CPU backend
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["PYTHONPATH"] = REPO
+    procs = [subprocess.Popen([sys.executable, script, str(r), "2", coord],
+                              env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for r in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r}:\n{out}"
+        assert f"MULTIHOST-OK rank={r} local=4 global=8" in out, out

@@ -171,3 +171,93 @@ def test_revauct_cli(tmp_path):
             if layers:
                 covered.extend(range(layers[0], layers[1] + 1))
     assert covered == list(range(1, n + 1))  # 1-based in CLI output
+
+
+def test_revauct_distributed_dcn_matches_centralized(tmp_path):
+    """Distributed auction over the DCN command plane (reference deployment,
+    revauct.py:168-180): one process per rank, each bidding ONLY from its own
+    local device_types file. The resulting schedule must equal the
+    centralized (--comm local) run over the same fleet and seed."""
+    import socket as socket_mod
+    n = 8
+    hosts = ["c0", "c1", "c2"]
+    times = {"c0": 0.01, "c1": 0.02, "c2": 0.04}  # heterogeneous fleet
+    models = {"pipeedge/test-tiny-vit": {
+        "layers": n, "parameters_in": 768, "parameters_out": [1000] * n,
+        "mem_MB": [50.0] * n}}
+    neighbors = {h: {o: {"bw_Mbps": 10000} for o in hosts if o != h}
+                 for h in hosts}
+
+    def dev_type(host):
+        return {f"type-{host}": {
+            "mem_MB": 300, "bw_Mbps": 10000, "model_profiles": {
+                "pipeedge/test-tiny-vit": [{
+                    "dtype": DTYPE, "batch_size": 2,
+                    "time_s": [times[host]] * n}]}}}
+
+    # centralized fixture: every type in one file + devices.yml
+    central = tmp_path / "central"
+    central.mkdir()
+    all_types = {}
+    for h in hosts:
+        all_types.update(dev_type(h))
+    devs = {f"type-{h}": [h] for h in hosts}
+    for fname, data in (("models.yml", models), ("device_types.yml", all_types),
+                        ("devices.yml", devs),
+                        ("device_neighbors_world.yml", neighbors)):
+        with open(central / fname, "w") as f:
+            yaml.safe_dump(data, f, default_flow_style=None)
+    # per-rank fixtures: each rank dir has ONLY its own device type
+    for r, h in enumerate(hosts):
+        d = tmp_path / f"rank{r}"
+        d.mkdir()
+        for fname, data in (("models.yml", models),
+                            ("device_types.yml", dev_type(h)),
+                            ("device_neighbors_world.yml", neighbors)):
+            with open(d / fname, "w") as f:
+                yaml.safe_dump(data, f, default_flow_style=None)
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    base = [sys.executable, os.path.join(REPO, "revauct.py")]
+    sched_args = ["-m", "pipeedge/test-tiny-vit", "-u", "2", "--seed", "7",
+                  "-sch", "throughput_ordered", "--no-strict-order"]
+
+    central_proc = subprocess.run(
+        base + ["0", "3"] + sched_args, capture_output=True, env=env,
+        cwd=str(central), text=True, timeout=120)
+    assert central_proc.returncode == 0, central_proc.stderr
+
+    socks = [socket_mod.create_server(("127.0.0.1", 0)) for _ in range(3)]
+    addrs = ",".join(f"127.0.0.1:{s.getsockname()[1]}" for s in socks)
+    for s in socks:
+        s.close()
+    dcn_args = sched_args + ["-c", "dcn", "--dcn-addrs", addrs]
+    bidders = [subprocess.Popen(
+        base + [str(r), "3"] + dcn_args +
+        ["--host", hosts[r], "--dev-type", f"type-{hosts[r]}"],
+        cwd=str(tmp_path / f"rank{r}"), env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True) for r in (1, 2)]
+    try:
+        auctioneer = subprocess.run(
+            base + ["0", "3"] + dcn_args +
+            ["--host", hosts[0], "--dev-type", "type-c0"],
+            capture_output=True, env=env, cwd=str(tmp_path / "rank0"),
+            text=True, timeout=120)
+        bouts = [b.communicate(timeout=30)[0] for b in bidders]
+    finally:
+        for b in bidders:
+            b.kill()
+    assert auctioneer.returncode == 0, auctioneer.stdout + auctioneer.stderr
+    for b, bout in zip(bidders, bouts):
+        assert b.returncode == 0, bout
+
+    central_sched = yaml.safe_load(central_proc.stdout)
+    dcn_sched = yaml.safe_load(auctioneer.stdout)
+    assert dcn_sched == central_sched
+    # sanity: the schedule covers all layers and uses the fast host
+    covered = []
+    for stage in dcn_sched:
+        for _, layers in stage.items():
+            if layers:
+                covered.extend(range(layers[0], layers[1] + 1))
+    assert covered == list(range(1, n + 1))
