@@ -28,7 +28,7 @@ import random
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Optional, Tuple
+from typing import Tuple
 
 import numpy as np
 import yaml
@@ -217,17 +217,22 @@ def main_dcn(args) -> None:
                 np.frombuffer(args.model_name.encode(), np.uint8),
                 np.asarray(args.ubatch_size, np.int32),
                 np.frombuffer(DTYPE.encode(), np.uint8)])
-            bids_in_order = [bid_latency_for_host(
-                args.host, args.dev_type, cfg, args.model_name,
-                args.ubatch_size, DTYPE)]
-            for rank in range(1, args.worldsize):
-                blob = ctx.recv_tensors(rank, timeout=args.auction_timeout,
-                                        channel=dcn.CHANNEL_BIDS)
-                bid = json.loads(bytes(blob[0]).decode())
-                bids_in_order.append(
-                    (bid['host'],
-                     (bid['shards'], bid['costs'], bid['neighbors'])))
-            ctx.cmd_broadcast(CMD_STOP)
+            try:
+                bids_in_order = [bid_latency_for_host(
+                    args.host, args.dev_type, cfg, args.model_name,
+                    args.ubatch_size, DTYPE)]
+                for rank in range(1, args.worldsize):
+                    blob = ctx.recv_tensors(rank,
+                                            timeout=args.auction_timeout,
+                                            channel=dcn.CHANNEL_BIDS)
+                    bid = json.loads(bytes(blob[0]).decode())
+                    bids_in_order.append(
+                        (bid['host'],
+                         (bid['shards'], bid['costs'], bid['neighbors'])))
+            finally:
+                # even on a failed collection (a bidder died), release the
+                # others — they would otherwise block the full timeout
+                ctx.cmd_broadcast(CMD_STOP)
             if args.data_host is None:
                 args.data_host = args.host
             yml_model = cfg['yml_models'][args.model_name]

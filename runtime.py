@@ -791,8 +791,11 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                 # instead of riding out the full --sched-timeout
                 deadline = time.monotonic() + args.sched_timeout
                 complete = False
+                # stop_info guards the window where a death notification
+                # lands just before this round cleared stop_event
                 while not complete and time.monotonic() < deadline \
-                        and not stop_event.is_set():
+                        and not stop_event.is_set() \
+                        and stop_info[0] is None:
                     complete = results_counter.wait_gte(target, timeout=0.5)
                 # last results can land concurrently with an abort
                 complete = complete or results_counter.wait_gte(target,

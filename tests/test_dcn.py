@@ -272,3 +272,47 @@ def test_stage_stop_while_blocked():
         assert all(not t.is_alive() for t in stage._threads)
     finally:
         ctxs[0].shutdown()
+
+
+def test_cmd_broadcast_bypasses_backpressured_data_send():
+    """Commands ride dedicated per-peer connections: an abort broadcast must
+    complete even while a data send to the same peer is blocked on
+    backpressure (the receiver's single-slot queue is full and the kernel
+    socket buffers are saturated). Regression for a deadlock class where
+    commands queued behind a blocked data send on a shared socket."""
+    got = queue.Queue()
+    ctxs = _make_contexts(2, handlers={1: lambda c, t: got.put(c)})
+    a, b = ctxs
+    try:
+        # saturate a->b: b never drains its recv queue, so after the 1-slot
+        # queue + kernel buffers fill, the sender blocks inside sendmsg
+        big = np.zeros(4 * 1024 * 1024, np.uint8)
+        sent = [0]
+
+        def flood():
+            try:
+                while True:
+                    a.send_tensors(1, [big])
+                    sent[0] += 1
+            except OSError:
+                pass  # context shutdown closes the socket
+
+        t = threading.Thread(target=flood, daemon=True)
+        t.start()
+        # wait until the flood stalls (no progress for a full second)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            before = sent[0]
+            time.sleep(1.0)
+            if sent[0] == before and before > 0:
+                break
+        assert sent[0] > 0, "flood never sent a frame"
+        tik = time.monotonic()
+        a.cmd_broadcast(CMD_STOP)
+        elapsed = time.monotonic() - tik
+        assert got.get(timeout=10) == CMD_STOP
+        assert elapsed < 5, f"cmd_broadcast took {elapsed:.1f}s behind a " \
+                            "blocked data send"
+    finally:
+        for c in ctxs:
+            c.shutdown()
