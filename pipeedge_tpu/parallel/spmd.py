@@ -292,6 +292,13 @@ class SpmdPipeline:
                 recv = decode(permute_payload(prev_enc), stage)
                 in_idx = jnp.clip(t, 0, n_ubatch - 1)
                 x = jnp.where(is_first, embedded[in_idx], recv)
+                # Every stage runs its blocks every tick, including fill
+                # ticks (garbage in-flight) and drain ticks (stage 0 on a
+                # clamped stale input). This is deliberate: ticks are
+                # lockstep across the stage axis and some stage does valid
+                # work in every tick, so gating invalid stages (lax.cond)
+                # cannot shorten any tick — it would only spend the saved
+                # FLOPs on idle waiting at the same wall-clock.
                 h = run_blocks(blocks, n_valid, x)
                 out_idx = t - (n_stages - 1)
                 # classifier head/pooler only on the last stage — for

@@ -634,6 +634,65 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
                 rnd += 1
 
 
+def _make_tp_stage(args, l, r, stage, dtype, restored):
+    """Build a stage whose blocks are Megatron-TP-sharded over this rank's
+    local devices (--stage-tp N): hierarchical parallelism the reference
+    cannot express — pipeline over DCN across hosts, tensor parallelism over
+    ICI within each host (SURVEY.md §2.4 'composes with the pipeline').
+
+    Returns `(fn, params)` with the work_cb calling convention
+    `fn(params, payload)`; the TP block params live pre-sharded in the
+    closure, so `params` is empty."""
+    import jax
+    from jax.sharding import Mesh
+
+    from pipeedge_tpu.parallel import tensor as tp
+
+    n_tp = args.stage_tp
+    local = jax.local_devices()
+    if len(local) < n_tp:
+        raise RuntimeError(f"--stage-tp {n_tp}: only {len(local)} local "
+                           "devices on this rank")
+    entry = registry.get_model_entry(args.model_name)
+    cfg = entry.config
+    if cfg.model_type not in ("vit", "deit"):
+        raise RuntimeError("--stage-tp supports ViT/DeiT stages (BERT's "
+                           "post-LN block layout has no TP mapping yet)")
+    if cfg.num_attention_heads % n_tp or cfg.intermediate_size % n_tp:
+        raise RuntimeError(
+            f"--stage-tp {n_tp} must divide attention heads "
+            f"({cfg.num_attention_heads}) and intermediate size "
+            f"({cfg.intermediate_size})")
+    if (l - 1) % 4 or r % 4:
+        raise RuntimeError(f"--stage-tp requires block-aligned stages; "
+                           f"[{l}, {r}] cuts mid-block")
+    _, params, shard_cfg = registry.module_shard_factory(
+        args.model_name, args.model_file, l, r, stage=stage, dtype=dtype,
+        params=restored, unroll=True)
+    mesh = Mesh(np.asarray(local[:n_tp]), ("tp",))
+    block_fn = tp.make_tp_block_fn(cfg, mesh)
+    sharded_blocks = tuple(tp.shard_vit_block_params(bp, mesh)
+                           for bp in params["blocks"])
+    family = entry.family
+    embed_fn = jax.jit(lambda p, x: family.embed(p, x, cfg))
+    final_fn = jax.jit(lambda p, x: family.finalize(p, x, cfg))
+    embed_p = params.get("embeddings")
+    final_p = params.get("final")
+    logger.info("stage %d: %d block(s) TP-sharded over %d local devices",
+                stage, len(sharded_blocks), n_tp)
+
+    def stage_fn(_params, x):
+        if shard_cfg.is_first:
+            x = embed_fn(embed_p, x)
+        for bp in sharded_blocks:
+            x = block_fn(bp, x)
+        if shard_cfg.is_last:
+            x = final_fn(final_p, x)
+        return x
+
+    return stage_fn, {}
+
+
 def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                ubatches, labels, dtype, results_target) -> None:
     """One schedule round on a live DCN fleet: (data rank) broadcast the
@@ -681,9 +740,12 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                     args.stage_ckpt, args.model_name, i, (l, r))
                 restored = ckpt_utils.load_stage_checkpoint(
                     args.stage_ckpt, i)
-            fn, params, _ = registry.module_shard_factory(
-                args.model_name, args.model_file, l, r, stage=i,
-                dtype=dtype, params=restored)
+            if args.stage_tp > 1:
+                fn, params = _make_tp_stage(args, l, r, i, dtype, restored)
+            else:
+                fn, params, _ = registry.module_shard_factory(
+                    args.model_name, args.model_file, l, r, stage=i,
+                    dtype=dtype, params=restored)
             out_bit = stage_quant[i] if i < len(stage_layers) - 1 else 0
             is_first, is_last = i == 0, i == len(stage_layers) - 1
             # adaptive policy (env ADAPTIVE_QUANT): this rank adapts its
@@ -896,6 +958,11 @@ def main():
                              "rank (dcn mode); default 127.0.0.1:PORT+rank")
     parser.add_argument("-P", "--port", type=int, default=29600,
                         help="base listener port for dcn mode defaults")
+    parser.add_argument("--stage-tp", type=int, default=1,
+                        help="shard each dcn stage's blocks Megatron-style "
+                             "over N local devices (block-aligned ViT/DeiT "
+                             "stages): pipeline across hosts over DCN, "
+                             "tensor parallelism within each host")
     parser.add_argument("--sched-timeout", type=float, default=300,
                         help="seconds a worker waits for the schedule / "
                              "results / stop (dcn mode)")
@@ -951,6 +1018,10 @@ def main():
     n_rounds = max(len(pt_rounds), len(q_rounds), len(r_rounds))
     if n_rounds > 1 and args.comm != "dcn":
         parser.error("';'-separated re-schedule rounds require --comm dcn")
+    if args.stage_tp > 1 and args.comm != "dcn":
+        parser.error("--stage-tp requires --comm dcn (per-rank local TP; "
+                     "use the spmd driver's mesh axes for single-controller "
+                     "tp)")
     for opt, specs in (("-pt", pt_rounds), ("-q", q_rounds),
                        ("-r", r_rounds)):
         if 1 < len(specs) != n_rounds:

@@ -230,3 +230,61 @@ def test_live_reschedule_two_rounds(tmp_path):
     assert "stage 1: layers [5, 8]" in wout
     assert "stage 1: layers [3, 8]" in wout
     assert "empty CMD_SCHED; shutting down" in wout
+
+
+def test_dcn_stage_tp_hierarchical(tmp_path):
+    """Hierarchical parallelism the reference cannot express: pipeline
+    stages span hosts over DCN (TCP) while each rank Megatron-TP-shards its
+    stage's blocks over its local devices (--stage-tp). Numerical equality
+    of the TP block against the plain block is covered by
+    tests/test_tensor_parallel.py; this exercises the full runtime path."""
+    addrs = ",".join(f"127.0.0.1:{p}" for p in _free_ports(2))
+    common = [sys.executable, os.path.join(REPO, "runtime.py")]
+    opts = ["-c", "dcn", "--platform", "cpu", "--stage-tp", "2",
+            "-m", "pipeedge/test-tiny-vit", "-b", "16", "-u", "4",
+            "-pt", "1,4,5,8", "-q", "8,0", "-r", "0,1",
+            "--dcn-addrs", addrs, "--sched-timeout", "180"]
+    env = dict(os.environ, PYTHONPATH=REPO,
+               XLA_FLAGS="--xla_force_host_platform_device_count=2")
+    worker = subprocess.Popen(common + ["1", "2"] + opts, cwd=tmp_path,
+                              env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+    try:
+        data = subprocess.run(common + ["0", "2"] + opts, cwd=tmp_path,
+                              env=env, capture_output=True, text=True,
+                              timeout=300)
+        wout, _ = worker.communicate(timeout=60)
+    finally:
+        worker.kill()
+    assert data.returncode == 0, data.stdout + data.stderr
+    assert "latency_sec=" in data.stdout
+    assert "TP-sharded over 2 local devices" in data.stdout + data.stderr
+    assert worker.returncode == 0, wout
+    assert "TP-sharded over 2 local devices" in wout
+
+
+def test_tp_stage_matches_plain_stage():
+    """_make_tp_stage output == plain module_shard_factory stage output for
+    both shard ends (embed+block and block+finalize)."""
+    import argparse
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    import runtime as rt
+    from pipeedge_tpu.models import registry
+
+    args = argparse.Namespace(stage_tp=2,
+                              model_name="pipeedge/test-tiny-vit",
+                              model_file=None)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 3, 16, 16)),
+                    dtype=jnp.float32)
+    payload = x
+    for l, r, stage in ((1, 4, 0), (5, 8, 1)):
+        fn_ref, p_ref, _ = registry.module_shard_factory(
+            args.model_name, None, l, r, stage=stage, dtype=jnp.float32)
+        fn_tp, p_tp = rt._make_tp_stage(args, l, r, stage, jnp.float32, None)
+        ref = np.asarray(fn_ref(p_ref, payload))
+        got = np.asarray(fn_tp(p_tp, payload))
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+        payload = fn_ref(p_ref, payload)

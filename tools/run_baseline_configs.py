@@ -56,14 +56,25 @@ CONFIGS = {
 
 def run_config(n: int, platform: str, dtype: str) -> dict:
     spec = CONFIGS[n]
+    missing = [a for a in spec["args"]
+               if a.endswith(".yml") and not os.path.exists(a)]
+    if missing:
+        return {"config": n, "desc": spec["desc"], "rc": "missing-profiles",
+                "missing": missing,
+                "hint": "generate the TPU profile fixtures first "
+                        "(profiles/README.md)"}
     cmd = [sys.executable, os.path.join(REPO, "runtime.py")] + spec["args"] \
         + ["-t", dtype]
     if platform:
         cmd += ["--platform", platform]
     env = dict(os.environ, PYTHONPATH=REPO, **spec.get("env", {}))
     tik = time.monotonic()
-    proc = subprocess.run(cmd, capture_output=True, text=True, env=env,
-                          timeout=1800)
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                              timeout=1800)
+    except subprocess.TimeoutExpired:
+        return {"config": n, "desc": spec["desc"], "rc": "timeout",
+                "wall_s": round(time.monotonic() - tik, 1)}
     wall = time.monotonic() - tik
     result = {"config": n, "desc": spec["desc"], "rc": proc.returncode,
               "wall_s": round(wall, 1)}
