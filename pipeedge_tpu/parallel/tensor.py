@@ -22,28 +22,24 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models.layers import TransformerConfig, gelu, layer_norm
 
 
+def _shard_by_specs(params: Dict, specs: Dict, mesh: Mesh,
+                    axis: str) -> Dict:
+    """Place a block's params per the SAME spec table shard_map uses as
+    in_specs — one source of truth, so the placement can never drift from
+    the compiled expectation (drift would silently reshard every call)."""
+    specs = _rename_axis(specs, axis)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params,
+        specs)
+
+
 def shard_vit_block_params(params: Dict, mesh: Mesh, axis: str = "tp") -> Dict:
     """Place one ViT/DeiT block's params with Megatron TP sharding.
 
     Column-parallel (out-dim sharded): q/k/v, mlp_up. Row-parallel (in-dim
     sharded): attn_out, mlp_down. LayerNorms replicated.
     """
-    def put(x, spec):
-        return jax.device_put(x, NamedSharding(mesh, spec))
-
-    out = {}
-    for name in ("q", "k", "v"):
-        out[name] = {"w": put(params[name]["w"], P(None, axis)),
-                     "b": put(params[name]["b"], P(axis))}
-    out["attn_out"] = {"w": put(params["attn_out"]["w"], P(axis, None)),
-                       "b": put(params["attn_out"]["b"], P())}
-    out["mlp_up"] = {"w": put(params["mlp_up"]["w"], P(None, axis)),
-                     "b": put(params["mlp_up"]["b"], P(axis))}
-    out["mlp_down"] = {"w": put(params["mlp_down"]["w"], P(axis, None)),
-                       "b": put(params["mlp_down"]["b"], P())}
-    for ln in ("ln_before", "ln_after"):
-        out[ln] = {k: put(v, P()) for k, v in params[ln].items()}
-    return out
+    return _shard_by_specs(params, _VIT_PARAM_SPECS, mesh, axis)
 
 
 def _tp_block_local(p: Dict, x: jax.Array, cfg: TransformerConfig,
@@ -87,20 +83,102 @@ def _tp_block_local(p: Dict, x: jax.Array, cfg: TransformerConfig,
     return down.astype(x.dtype) + x
 
 
+def shard_bert_block_params(params: Dict, mesh: Mesh, axis: str = "tp") \
+        -> Dict:
+    """Place one BERT (post-LN) block's params with Megatron TP sharding:
+    same column/row layout as ViT, LayerNorms (attn_ln/out_ln) replicated."""
+    return _shard_by_specs(params, _BERT_PARAM_SPECS, mesh, axis)
+
+
+def shard_block_params(cfg: TransformerConfig, params: Dict, mesh: Mesh,
+                       axis: str = "tp") -> Dict:
+    """Family dispatch: Megatron placement for one block's params."""
+    if cfg.model_type == "bert":
+        return shard_bert_block_params(params, mesh, axis)
+    return shard_vit_block_params(params, mesh, axis)
+
+
+def _tp_bert_block_local(p: Dict, x: jax.Array, cfg: TransformerConfig,
+                         axis: str) -> jax.Array:
+    """Per-device BERT block body (post-LN residuals, bert.py sublayer
+    semantics 0-3): attention on raw x, LayerNorm AFTER each residual."""
+    n = jax.lax.axis_size(axis)
+    heads_local = cfg.num_attention_heads // n
+    b, s, _ = x.shape
+    hd = cfg.head_dim
+
+    def proj(name):
+        w = p[name]["w"]  # [D, D/n] local column slice
+        y = jnp.dot(x, w.astype(x.dtype),
+                    preferred_element_type=jnp.float32) + p[name]["b"]
+        return y.astype(x.dtype).reshape(b, s, heads_local, hd)
+
+    q, k, v = proj("q"), proj("k"), proj("v")
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) / jnp.sqrt(
+                            jnp.float32(hd))
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v,
+                     preferred_element_type=jnp.float32).astype(x.dtype)
+    ctx = ctx.reshape(b, s, heads_local * hd)
+    attn = jnp.dot(ctx, p["attn_out"]["w"].astype(x.dtype),
+                   preferred_element_type=jnp.float32)
+    attn = jax.lax.psum(attn, axis) + p["attn_out"]["b"]
+    x = layer_norm(p["attn_ln"], attn.astype(x.dtype) + x,
+                   cfg.layer_norm_eps)
+
+    up = jnp.dot(x, p["mlp_up"]["w"].astype(x.dtype),
+                 preferred_element_type=jnp.float32) + p["mlp_up"]["b"]
+    hidden = gelu(up.astype(x.dtype))
+    down = jnp.dot(hidden, p["mlp_down"]["w"].astype(x.dtype),
+                   preferred_element_type=jnp.float32)
+    down = jax.lax.psum(down, axis) + p["mlp_down"]["b"]
+    return layer_norm(p["out_ln"], down.astype(x.dtype) + x,
+                      cfg.layer_norm_eps)
+
+
+_VIT_PARAM_SPECS = {
+    "q": {"w": P(None, "tp"), "b": P("tp")},
+    "k": {"w": P(None, "tp"), "b": P("tp")},
+    "v": {"w": P(None, "tp"), "b": P("tp")},
+    "attn_out": {"w": P("tp", None), "b": P()},
+    "mlp_up": {"w": P(None, "tp"), "b": P("tp")},
+    "mlp_down": {"w": P("tp", None), "b": P()},
+    "ln_before": {"scale": P(), "bias": P()},
+    "ln_after": {"scale": P(), "bias": P()},
+}
+
+_BERT_PARAM_SPECS = {
+    "q": {"w": P(None, "tp"), "b": P("tp")},
+    "k": {"w": P(None, "tp"), "b": P("tp")},
+    "v": {"w": P(None, "tp"), "b": P("tp")},
+    "attn_out": {"w": P("tp", None), "b": P()},
+    "mlp_up": {"w": P(None, "tp"), "b": P("tp")},
+    "mlp_down": {"w": P("tp", None), "b": P()},
+    "attn_ln": {"scale": P(), "bias": P()},
+    "out_ln": {"scale": P(), "bias": P()},
+}
+
+
+def _rename_axis(specs, axis):
+    if axis == "tp":
+        return specs
+    return jax.tree_util.tree_map(
+        lambda s: P(*(axis if a == "tp" else a for a in s)), specs,
+        is_leaf=lambda s: isinstance(s, P))
+
+
 def make_tp_block_fn(cfg: TransformerConfig, mesh: Mesh, axis: str = "tp"):
     """Jitted `fn(sharded_params, x) -> x` running one full transformer block
-    with tensor parallelism over `axis`. `x` is replicated."""
-    param_specs = {
-        "q": {"w": P(None, axis), "b": P(axis)},
-        "k": {"w": P(None, axis), "b": P(axis)},
-        "v": {"w": P(None, axis), "b": P(axis)},
-        "attn_out": {"w": P(axis, None), "b": P()},
-        "mlp_up": {"w": P(None, axis), "b": P(axis)},
-        "mlp_down": {"w": P(axis, None), "b": P()},
-        "ln_before": {"scale": P(), "bias": P()},
-        "ln_after": {"scale": P(), "bias": P()},
-    }
-    body = jax.shard_map(partial(_tp_block_local, cfg=cfg, axis=axis),
+    with tensor parallelism over `axis`. `x` is replicated. Dispatches on
+    the family: ViT/DeiT pre-LN blocks or BERT post-LN blocks."""
+    if cfg.model_type == "bert":
+        param_specs = _rename_axis(_BERT_PARAM_SPECS, axis)
+        local = _tp_bert_block_local
+    else:
+        param_specs = _rename_axis(_VIT_PARAM_SPECS, axis)
+        local = _tp_block_local
+    body = jax.shard_map(partial(local, cfg=cfg, axis=axis),
                          mesh=mesh, in_specs=(param_specs, P()),
                          out_specs=P(), check_vma=False)
     return jax.jit(body)

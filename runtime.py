@@ -655,9 +655,6 @@ def _make_tp_stage(args, l, r, stage, dtype, restored):
                            "devices on this rank")
     entry = registry.get_model_entry(args.model_name)
     cfg = entry.config
-    if cfg.model_type not in ("vit", "deit"):
-        raise RuntimeError("--stage-tp supports ViT/DeiT stages (BERT's "
-                           "post-LN block layout has no TP mapping yet)")
     if cfg.num_attention_heads % n_tp or cfg.intermediate_size % n_tp:
         raise RuntimeError(
             f"--stage-tp {n_tp} must divide attention heads "
@@ -671,8 +668,15 @@ def _make_tp_stage(args, l, r, stage, dtype, restored):
         params=restored, unroll=True)
     mesh = Mesh(np.asarray(local[:n_tp]), ("tp",))
     block_fn = tp.make_tp_block_fn(cfg, mesh)
-    sharded_blocks = tuple(tp.shard_vit_block_params(bp, mesh)
-                           for bp in params["blocks"])
+    # shard block-by-block, dropping each unsharded block as it is placed,
+    # so peak memory is the stage + one block rather than two full stages
+    blocks = list(params["blocks"])
+    params["blocks"] = None
+    sharded_blocks = []
+    for i, bp in enumerate(blocks):
+        sharded_blocks.append(tp.shard_block_params(cfg, bp, mesh))
+        blocks[i] = None
+    sharded_blocks = tuple(sharded_blocks)
     family = entry.family
     embed_fn = jax.jit(lambda p, x: family.embed(p, x, cfg))
     final_fn = jax.jit(lambda p, x: family.finalize(p, x, cfg))
@@ -960,9 +964,9 @@ def main():
                         help="base listener port for dcn mode defaults")
     parser.add_argument("--stage-tp", type=int, default=1,
                         help="shard each dcn stage's blocks Megatron-style "
-                             "over N local devices (block-aligned ViT/DeiT "
-                             "stages): pipeline across hosts over DCN, "
-                             "tensor parallelism within each host")
+                             "over N local devices (block-aligned stages): "
+                             "pipeline across hosts over DCN, tensor "
+                             "parallelism within each host")
     parser.add_argument("--sched-timeout", type=float, default=300,
                         help="seconds a worker waits for the schedule / "
                              "results / stop (dcn mode)")
@@ -1022,6 +1026,25 @@ def main():
         parser.error("--stage-tp requires --comm dcn (per-rank local TP; "
                      "use the spmd driver's mesh axes for single-controller "
                      "tp)")
+    if args.stage_tp > 1:
+        # fail at parse time, not mid-round after the schedule broadcast
+        # (a late failure on one rank strands the rest of the fleet until
+        # the peer-death abort)
+        cfg = registry.get_model_config(args.model_name)
+        if cfg.num_attention_heads % args.stage_tp \
+                or cfg.intermediate_size % args.stage_tp:
+            parser.error(
+                f"--stage-tp {args.stage_tp} must divide attention heads "
+                f"({cfg.num_attention_heads}) and intermediate size "
+                f"({cfg.intermediate_size}) of {args.model_name}")
+        for spec in pt_rounds:
+            if not spec:
+                continue
+            nums = [int(x) for x in spec.split(',')]
+            for l, r in zip(nums[::2], nums[1::2]):
+                if (l - 1) % 4 or r % 4:
+                    parser.error(f"--stage-tp requires block-aligned "
+                                 f"stages; [{l}, {r}] cuts mid-block")
     for opt, specs in (("-pt", pt_rounds), ("-q", q_rounds),
                        ("-r", r_rounds)):
         if 1 < len(specs) != n_rounds:

@@ -274,17 +274,20 @@ def test_tp_stage_matches_plain_stage():
     import runtime as rt
     from pipeedge_tpu.models import registry
 
-    args = argparse.Namespace(stage_tp=2,
-                              model_name="pipeedge/test-tiny-vit",
-                              model_file=None)
-    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 3, 16, 16)),
-                    dtype=jnp.float32)
-    payload = x
-    for l, r, stage in ((1, 4, 0), (5, 8, 1)):
-        fn_ref, p_ref, _ = registry.module_shard_factory(
-            args.model_name, None, l, r, stage=stage, dtype=jnp.float32)
-        fn_tp, p_tp = rt._make_tp_stage(args, l, r, stage, jnp.float32, None)
-        ref = np.asarray(fn_ref(p_ref, payload))
-        got = np.asarray(fn_tp(p_tp, payload))
-        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
-        payload = fn_ref(p_ref, payload)
+    rng = np.random.default_rng(0)
+    for model, payload in (
+            ("pipeedge/test-tiny-vit",
+             jnp.asarray(rng.normal(size=(2, 3, 16, 16)), jnp.float32)),
+            ("pipeedge/test-tiny-bert",
+             jnp.asarray(rng.integers(0, 30, size=(2, 9)), jnp.int32))):
+        args = argparse.Namespace(stage_tp=2, model_name=model,
+                                  model_file=None)
+        for l, r, stage in ((1, 4, 0), (5, 8, 1)):
+            fn_ref, p_ref, _ = registry.module_shard_factory(
+                model, None, l, r, stage=stage, dtype=jnp.float32)
+            fn_tp, p_tp = rt._make_tp_stage(args, l, r, stage, jnp.float32,
+                                            None)
+            ref = np.asarray(fn_ref(p_ref, payload))
+            got = np.asarray(fn_tp(p_tp, payload))
+            np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+            payload = fn_ref(p_ref, payload)

@@ -49,3 +49,31 @@ def test_tp_params_are_actually_sharded():
     assert all(shape == (64, 16) for shape in shard_shapes)
     shard_shapes = [s.data.shape for s in sharded["mlp_down"]["w"].addressable_shards]
     assert all(shape == (32, 64) for shape in shard_shapes)
+
+
+BERT_CFG = TransformerConfig(model_type="bert", hidden_size=64,
+                             num_hidden_layers=1, num_attention_heads=8,
+                             intermediate_size=128, num_labels=0,
+                             vocab_size=50, max_position_embeddings=64,
+                             type_vocab_size=2)
+
+
+@pytest.mark.parametrize("n_tp", [2, 4])
+def test_tp_bert_block_matches_unsharded(n_tp):
+    """BERT's post-LN block under Megatron TP: same column/row layout,
+    LayerNorm after each residual (bert.py sublayers 0-3)."""
+    from pipeedge_tpu.models import bert as bert_mod
+    from pipeedge_tpu.parallel.tensor import shard_block_params
+
+    params = bert_mod.init_params(BERT_CFG, ShardConfig(1, 4), seed=5)
+    bp = jax.tree_util.tree_map(lambda x: x[0], params["blocks"])
+    x = np.random.default_rng(1).normal(size=(2, 9, 64)).astype(np.float32)
+    data = jnp.asarray(x)
+    for sub in range(4):
+        data = bert_mod.sublayer(bp, sub, data, BERT_CFG)
+    expected = np.asarray(data)
+    mesh = Mesh(np.asarray(jax.devices()[:n_tp]), ("tp",))
+    sharded = shard_block_params(BERT_CFG, bp, mesh)
+    fn = make_tp_block_fn(BERT_CFG, mesh)
+    got = np.asarray(fn(sharded, jnp.asarray(x)))
+    np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-5)
