@@ -101,6 +101,19 @@ def _bitcast_from_words(words: jax.Array, shape, dtype) -> jax.Array:
     return flat.reshape(shape)
 
 
+def _stacked_block_specs(cfg, blocks_tree, tp: int):
+    """Partition specs for the stacked block params [n_stages, max_b, ...]:
+    stage-sharded on the leading axis, and — when the mesh has a 'tp' axis —
+    Megatron column/row sharded on the kernel dims per the SAME family spec
+    tables the TP block bodies compile against (parallel/tensor.py)."""
+    if tp <= 1:
+        return jax.tree_util.tree_map(lambda _: P("stage"), blocks_tree)
+    from .tensor import family_tp_plan
+    table, _ = family_tp_plan(cfg)
+    return jax.tree_util.tree_map(
+        lambda _, s: P(*(("stage", None) + tuple(s))), blocks_tree, table)
+
+
 @dataclasses.dataclass
 class SpmdPipeline:
     """Compiled SPMD pipeline over a ('dp', 'stage') mesh.
@@ -160,10 +173,21 @@ class SpmdPipeline:
             partial(family.finalize, cfg=cfg), self.params["final"],
             jnp.zeros(hidden_local.shape, hidden_local.dtype))
 
-        def block_apply(bp, x):
-            for sub in range(4):
-                x = family.sublayer(bp, sub, x, cfg)
-            return x
+        tp = mesh.shape.get("tp", 1)
+        if tp > 1:
+            # Megatron block body: kernels arrive as local column/row slices
+            # (see the placement specs in build_spmd_pipeline), two psums
+            # over 'tp' per block — pp x dp x tp in ONE compiled program
+            from .tensor import family_tp_plan
+            _, tp_local = family_tp_plan(cfg)
+
+            def block_apply(bp, x):
+                return tp_local(bp, x, cfg, "tp")
+        else:
+            def block_apply(bp, x):
+                for sub in range(4):
+                    x = family.sublayer(bp, sub, x, cfg)
+                return x
 
         def run_blocks(blocks, n_valid, x):
             def step(carry, xs):
@@ -326,8 +350,8 @@ class SpmdPipeline:
             {
                 "embed": P(),
                 "final": P(),
-                "blocks": jax.tree_util.tree_map(
-                    lambda _: P("stage"), self.params["blocks"]),
+                "blocks": _stacked_block_specs(cfg, self.params["blocks"],
+                                               tp),
                 "n_blocks": P("stage"),
             },
             P(None, dp_spec),
@@ -390,19 +414,29 @@ def build_spmd_pipeline(family: FamilySpec, cfg: TransformerConfig,
             "so quantized edges save no interconnect bandwidth in this "
             "configuration (quantization error still applies)", stage_bits)
 
+    tp = mesh.shape.get("tp", 1)
+    if tp > 1:
+        if cfg.num_attention_heads % tp or cfg.intermediate_size % tp:
+            raise ValueError(
+                f"mesh tp={tp} must divide attention heads "
+                f"({cfg.num_attention_heads}) and intermediate size "
+                f"({cfg.intermediate_size})")
     params = {
         "embed": stage_params[0]["embeddings"],
         "final": stage_params[-1]["final"],
         "blocks": _pad_stack(blocks_list, max_b),
         "n_blocks": jnp.asarray(n_blocks, jnp.int32),
     }
-    # place parameters: blocks stage-sharded, embed/final replicated
+    # place parameters: blocks stage-sharded (and Megatron tp-sharded when
+    # the mesh has a tp axis), embed/final replicated
+    block_specs = _stacked_block_specs(cfg, params["blocks"], tp)
     params = {
         "embed": jax.device_put(params["embed"],
                                 NamedSharding(mesh, P())),
         "final": jax.device_put(params["final"], NamedSharding(mesh, P())),
-        "blocks": jax.device_put(params["blocks"],
-                                 NamedSharding(mesh, P("stage"))),
+        "blocks": jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            params["blocks"], block_specs),
         "n_blocks": jax.device_put(params["n_blocks"],
                                    NamedSharding(mesh, P("stage"))),
     }
@@ -411,21 +445,22 @@ def build_spmd_pipeline(family: FamilySpec, cfg: TransformerConfig,
                         stage_bits=stage_bits)
 
 
-def make_pipeline_mesh(n_stages: int, dp: int = 1,
+def make_pipeline_mesh(n_stages: int, dp: int = 1, tp: int = 1,
                        devices: Optional[Sequence[jax.Device]] = None,
                        stage_ranks: Optional[Sequence[int]] = None) -> Mesh:
-    """Build a ('dp', 'stage') mesh: stage axis contiguous so ppermute edges
-    ride neighboring ICI links.
+    """Build a ('dp', 'stage'[, 'tp']) mesh: tp innermost (fastest axis, so
+    the two per-block psums ride adjacent ICI links), stage next (ppermute
+    edges ride neighboring links).
 
     `stage_ranks[i]` places stage i on `devices[stage_ranks[i]]` (reference
-    `-r` rank-order semantics, runtime.py:657-687); requires dp=1 and
+    `-r` rank-order semantics, runtime.py:657-687); requires dp=1, tp=1 and
     distinct ranks.
     """
     if devices is None:
         devices = jax.devices()
     if stage_ranks is not None:
-        if dp != 1:
-            raise ValueError("stage_ranks requires dp=1")
+        if dp != 1 or tp != 1:
+            raise ValueError("stage_ranks requires dp=1 and tp=1")
         if len(stage_ranks) != n_stages:
             raise ValueError(f"stage_ranks length {len(stage_ranks)} != "
                              f"{n_stages} stages")
@@ -436,8 +471,11 @@ def make_pipeline_mesh(n_stages: int, dp: int = 1,
                              f"({len(devices)} devices)")
         arr = np.asarray([devices[r] for r in stage_ranks]).reshape(1, n_stages)
         return Mesh(arr, ("dp", "stage"))
-    need = n_stages * dp
+    need = n_stages * dp * tp
     if len(devices) < need:
         raise ValueError(f"need {need} devices, have {len(devices)}")
+    if tp > 1:
+        arr = np.asarray(devices[:need]).reshape(dp, n_stages, tp)
+        return Mesh(arr, ("dp", "stage", "tp"))
     arr = np.asarray(devices[:need]).reshape(dp, n_stages)
     return Mesh(arr, ("dp", "stage"))

@@ -90,12 +90,21 @@ def shard_bert_block_params(params: Dict, mesh: Mesh, axis: str = "tp") \
     return _shard_by_specs(params, _BERT_PARAM_SPECS, mesh, axis)
 
 
+def family_tp_plan(cfg: TransformerConfig):
+    """THE family dispatch point for tensor parallelism: returns
+    (param spec table, per-device block body). Every TP consumer — the
+    placement helpers here and the SPMD pipeline's stacked specs/block
+    body — goes through this, so adding a family is one edit."""
+    if cfg.model_type == "bert":
+        return _BERT_PARAM_SPECS, _tp_bert_block_local
+    return _VIT_PARAM_SPECS, _tp_block_local
+
+
 def shard_block_params(cfg: TransformerConfig, params: Dict, mesh: Mesh,
                        axis: str = "tp") -> Dict:
-    """Family dispatch: Megatron placement for one block's params."""
-    if cfg.model_type == "bert":
-        return shard_bert_block_params(params, mesh, axis)
-    return shard_vit_block_params(params, mesh, axis)
+    """Megatron placement for one block's params (family-dispatched)."""
+    specs, _ = family_tp_plan(cfg)
+    return _shard_by_specs(params, specs, mesh, axis)
 
 
 def _tp_bert_block_local(p: Dict, x: jax.Array, cfg: TransformerConfig,
@@ -172,12 +181,8 @@ def make_tp_block_fn(cfg: TransformerConfig, mesh: Mesh, axis: str = "tp"):
     """Jitted `fn(sharded_params, x) -> x` running one full transformer block
     with tensor parallelism over `axis`. `x` is replicated. Dispatches on
     the family: ViT/DeiT pre-LN blocks or BERT post-LN blocks."""
-    if cfg.model_type == "bert":
-        param_specs = _rename_axis(_BERT_PARAM_SPECS, axis)
-        local = _tp_bert_block_local
-    else:
-        param_specs = _rename_axis(_VIT_PARAM_SPECS, axis)
-        local = _tp_block_local
+    specs, local = family_tp_plan(cfg)
+    param_specs = _rename_axis(specs, axis)
     body = jax.shard_map(partial(local, cfg=cfg, axis=axis),
                          mesh=mesh, in_specs=(param_specs, P()),
                          out_specs=P(), check_vma=False)

@@ -429,6 +429,12 @@ def run_pipeline_spmd(args, stage_layers, stage_quant, stage_ranks,
     entry = registry.get_model_entry(args.model_name)
     dtype = jnp.bfloat16 if args.dtype == 'bfloat16' else jnp.float32
     total = registry.get_model_layers(args.model_name)
+    # conflict check on the RAW argument, before any (potentially multi-GB)
+    # stage weights load
+    if stage_ranks and list(stage_ranks) != list(range(len(stage_layers))) \
+            and (args.spmd_dp > 1 or args.spmd_tp > 1):
+        raise RuntimeError("-r stage ranks cannot combine with "
+                           "--spmd-dp/--spmd-tp mesh axes")
     stage_params = []
     for i, (l, r) in enumerate(stage_layers):
         # stacked block layout required: the SPMD driver pads and re-stacks
@@ -448,7 +454,8 @@ def run_pipeline_spmd(args, stage_layers, stage_quant, stage_ranks,
             logger.warning("stage_ranks %s not distinct on %d devices; "
                            "using default stage order", stage_ranks,
                            len(devices))
-    mesh = spmd.make_pipeline_mesh(n_stages, stage_ranks=ranks)
+    mesh = spmd.make_pipeline_mesh(n_stages, dp=args.spmd_dp,
+                                   tp=args.spmd_tp, stage_ranks=ranks)
     pipe = spmd.build_spmd_pipeline(entry.family.FAMILY, entry.config,
                                     stage_layers, stage_params, mesh,
                                     quant_bit=list(stage_quant) if stage_quant
@@ -962,6 +969,13 @@ def main():
                              "rank (dcn mode); default 127.0.0.1:PORT+rank")
     parser.add_argument("-P", "--port", type=int, default=29600,
                         help="base listener port for dcn mode defaults")
+    parser.add_argument("--spmd-dp", type=int, default=1,
+                        help="data-parallel mesh axis for the spmd driver "
+                             "(worldsize devices = stages x dp x tp)")
+    parser.add_argument("--spmd-tp", type=int, default=1,
+                        help="Megatron tensor-parallel mesh axis for the "
+                             "spmd driver: blocks stage-sharded AND "
+                             "tp-sharded in one XLA program")
     parser.add_argument("--stage-tp", type=int, default=1,
                         help="shard each dcn stage's blocks Megatron-style "
                              "over N local devices (block-aligned stages): "

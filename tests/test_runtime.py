@@ -140,3 +140,20 @@ def test_adaptive_quant_controller(tmp_path):
                            "SEND_CONSTRAINT": "50", "WINDOW_SIZE": "3"})
     assert proc.returncode == 0, proc.stderr
     assert "Adaptive quantization" in proc.stderr + proc.stdout
+
+
+def test_runtime_spmd_dp_tp_mesh(tmp_path):
+    """CLI spmd driver over a stages x dp x tp mesh (one XLA program)."""
+    import subprocess
+    import sys
+    env = dict(os.environ, PYTHONPATH=REPO,
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "runtime.py"), "0", "8",
+         "--platform", "cpu", "-c", "spmd", "-m", "pipeedge/test-tiny-vit",
+         "-b", "16", "-u", "4", "-pt", "1,4,5,8", "-q", "8,0",
+         "--spmd-dp", "2", "--spmd-tp", "2"],
+        capture_output=True, env=env, cwd=str(tmp_path), text=True,
+        timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "latency_sec=" in proc.stdout

@@ -178,3 +178,48 @@ def test_spmd_bert(tiny_vit4):
     got = np.asarray(pipe.run(ids))
     expected = _expected(bert_mod, cfg, weights, ids)
     np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-5)
+
+
+def test_spmd_dp_stage_tp_mesh(tiny_vit4):
+    """pp x dp x tp in ONE compiled program: blocks stage-sharded AND
+    Megatron tp-sharded (two psums per block over 'tp'), batch dp-sharded,
+    quantized ppermute stage edges — against the single-shard oracle."""
+    cfg, weights = tiny_vit4
+    partition = [(1, 8), (9, 16)]
+    mesh = spmd.make_pipeline_mesh(2, dp=2, tp=2)
+    assert mesh.shape == {"dp": 2, "stage": 2, "tp": 2}
+    pipe = spmd.build_spmd_pipeline(
+        vit_mod.FAMILY, cfg, partition,
+        _stage_params(vit_mod, cfg, partition, weights), mesh, quant_bit=8)
+    rng = np.random.default_rng(6)
+    inputs = jnp.asarray(rng.normal(size=(4, 4, 3, 16, 16)).astype(np.float32))
+    got = np.asarray(pipe.run(inputs))
+    expected = _expected(vit_mod, cfg, weights, inputs)
+    # 8-bit edge quantization dominates the tolerance
+    np.testing.assert_allclose(got, expected, rtol=0.1, atol=0.05)
+    pipe_raw = spmd.build_spmd_pipeline(
+        vit_mod.FAMILY, cfg, partition,
+        _stage_params(vit_mod, cfg, partition, weights), mesh)
+    got_raw = np.asarray(pipe_raw.run(inputs))
+    np.testing.assert_allclose(got_raw, expected, rtol=2e-4, atol=2e-5)
+
+
+def test_spmd_bert_tp(tiny_vit4):
+    from transformers import BertConfig, BertForSequenceClassification
+    hf_cfg = BertConfig(**TINY4, vocab_size=100, max_position_embeddings=64,
+                        num_labels=3)
+    torch.manual_seed(3)
+    model = BertForSequenceClassification(hf_cfg).eval()
+    cfg = TransformerConfig(model_type="bert", **TINY4, num_labels=3,
+                            vocab_size=100, max_position_embeddings=64)
+    weights = {k: v.numpy() for k, v in model.state_dict().items()}
+    partition = [(1, 8), (9, 16)]
+    mesh = spmd.make_pipeline_mesh(2, dp=2, tp=2)
+    pipe = spmd.build_spmd_pipeline(
+        bert_mod.FAMILY, cfg, partition,
+        _stage_params(bert_mod, cfg, partition, weights), mesh)
+    ids = jnp.asarray(np.random.default_rng(4).integers(0, 100, size=(4, 2, 9)),
+                      dtype=jnp.int32)
+    got = np.asarray(pipe.run(ids))
+    expected = _expected(bert_mod, cfg, weights, ids)
+    np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-5)
