@@ -49,11 +49,15 @@ def embed(p: Dict, input_ids: jax.Array, cfg: TransformerConfig) -> jax.Array:
     return layer_norm(p["ln"], hidden, cfg.layer_norm_eps)
 
 
-def sublayer(p: Dict, sub: int, data, cfg: TransformerConfig):
-    """One of the 4 schedulable sublayers (reference bert.py:41-52)."""
+def sublayer(p: Dict, sub: int, data, cfg: TransformerConfig,
+             attention_fn=None):
+    """One of the 4 schedulable sublayers (reference bert.py:41-52).
+
+    `attention_fn` overrides the attention core (see vit.sublayer)."""
     if sub == 0:
-        ctx = self_attention({"q": p["q"], "k": p["k"], "v": p["v"]},
-                             data, cfg.num_attention_heads)
+        ctx = (attention_fn or self_attention)(
+            {"q": p["q"], "k": p["k"], "v": p["v"]}, data,
+            cfg.num_attention_heads)
         return (ctx, data)
     if sub == 1:
         ctx, skip = data

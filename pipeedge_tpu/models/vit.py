@@ -49,12 +49,18 @@ def embed(p: Dict, pixel_values: jax.Array, cfg: TransformerConfig) -> jax.Array
     return hidden + p["pos"].astype(hidden.dtype)
 
 
-def sublayer(p: Dict, sub: int, data, cfg: TransformerConfig):
-    """One of the 4 schedulable sublayers (reference vit.py:55-70)."""
+def sublayer(p: Dict, sub: int, data, cfg: TransformerConfig,
+             attention_fn=None):
+    """One of the 4 schedulable sublayers (reference vit.py:55-70).
+
+    `attention_fn(qkv_params, x, num_heads)` overrides the attention core —
+    the hook sequence-parallel execution uses to swap in ring attention
+    over a mesh axis (parallel/spmd.py) without duplicating the block."""
     if sub == 0:
         normed = layer_norm(p["ln_before"], data, cfg.layer_norm_eps)
-        ctx = self_attention({"q": p["q"], "k": p["k"], "v": p["v"]},
-                             normed, cfg.num_attention_heads)
+        ctx = (attention_fn or self_attention)(
+            {"q": p["q"], "k": p["k"], "v": p["v"]}, normed,
+            cfg.num_attention_heads)
         return (ctx, data)
     if sub == 1:
         ctx, skip = data

@@ -163,17 +163,32 @@ class SpmdPipeline:
         n_ticks = n_ubatch + n_stages - 1
         dp = mesh.shape.get("dp", 1)
 
+        sp = mesh.shape.get("sp", 1)
+
         # trace shapes: embedded hidden + final output
         embed_shape = jax.eval_shape(
             partial(family.embed, cfg=cfg), self.params["embed"], inputs[0])
         b_local = embed_shape.shape[0] // dp
+        seq_total = embed_shape.shape[1]
+        if seq_total % sp:
+            raise ValueError(f"sequence length {seq_total} must divide by "
+                             f"the sp mesh axis ({sp})")
+        s_local = seq_total // sp
+        # per-device hidden: sequence-sharded over 'sp' (stage edges then
+        # carry only the local chunk — sequence-parallel pipeline comm)
         hidden_local = jax.ShapeDtypeStruct(
-            (b_local,) + embed_shape.shape[1:], embed_shape.dtype)
+            (b_local, s_local) + embed_shape.shape[2:], embed_shape.dtype)
+        # finalize consumes the FULL sequence (CLS token / pooler): under sp
+        # the last stage all-gathers the chunks first
         out_shape = jax.eval_shape(
             partial(family.finalize, cfg=cfg), self.params["final"],
-            jnp.zeros(hidden_local.shape, hidden_local.dtype))
+            jnp.zeros((b_local, seq_total) + embed_shape.shape[2:],
+                      embed_shape.dtype))
 
         tp = mesh.shape.get("tp", 1)
+        if tp > 1 and sp > 1:
+            raise ValueError("tp and sp mesh axes are mutually exclusive "
+                             "(Megatron TP assumes a full local sequence)")
         if tp > 1:
             # Megatron block body: kernels arrive as local column/row slices
             # (see the placement specs in build_spmd_pipeline), two psums
@@ -183,6 +198,29 @@ class SpmdPipeline:
 
             def block_apply(bp, x):
                 return tp_local(bp, x, cfg, "tp")
+        elif sp > 1:
+            # sequence-parallel block body: activations stay sequence-
+            # sharded [b, S/sp, D]; every sublayer is token-local except the
+            # attention core, which runs as exact ring attention over 'sp'
+            # (K/V chunks rotate via ppermute, streaming softmax —
+            # parallel/sequence.py)
+            from ..models.layers import dense
+            from .sequence import ring_attention
+
+            def sp_attention(qkv, x, num_heads):
+                b, s, d = x.shape
+                hd = d // num_heads
+                q = dense(qkv["q"], x).reshape(b, s, num_heads, hd)
+                k = dense(qkv["k"], x).reshape(b, s, num_heads, hd)
+                v = dense(qkv["v"], x).reshape(b, s, num_heads, hd)
+                ctx = ring_attention(q, k, v, "sp")
+                return ctx.reshape(b, s, d)
+
+            def block_apply(bp, x):
+                for sub in range(4):
+                    x = family.sublayer(bp, sub, x, cfg,
+                                        attention_fn=sp_attention)
+                return x
         else:
             def block_apply(bp, x):
                 for sub in range(4):
@@ -306,8 +344,14 @@ class SpmdPipeline:
 
             embedded = jax.lax.cond(
                 is_first, do_embed,
-                lambda si: jnp.zeros((n_ubatch,) + hidden_local.shape,
-                                     embed_shape.dtype), stacked_inputs)
+                lambda si: jnp.zeros(
+                    (n_ubatch, b_local, seq_total) + embed_shape.shape[2:],
+                    embed_shape.dtype), stacked_inputs)
+            if sp > 1:
+                # each sp member keeps only its sequence chunk
+                sp_idx = jax.lax.axis_index("sp")
+                embedded = jax.lax.dynamic_slice_in_dim(
+                    embedded, sp_idx * s_local, s_local, axis=2)
 
             outputs0 = jnp.zeros((n_ubatch,) + out_shape.shape, out_shape.dtype)
 
@@ -325,12 +369,19 @@ class SpmdPipeline:
                 # FLOPs on idle waiting at the same wall-clock.
                 h = run_blocks(blocks, n_valid, x)
                 out_idx = t - (n_stages - 1)
+
+                def fin(hh):
+                    if sp > 1:
+                        # pooler/classifier reads the full sequence (CLS at
+                        # position 0 lives on sp rank 0): gather the chunks
+                        hh = jax.lax.all_gather(hh, "sp", axis=1, tiled=True)
+                    return family.finalize(params["final"], hh, cfg).astype(
+                        out_shape.dtype)
+
                 # classifier head/pooler only on the last stage — for
                 # ViT-Huge's 21843-way head that is a real matmul per tick
                 logits = jax.lax.cond(
-                    is_last,
-                    lambda hh: family.finalize(params["final"], hh, cfg)
-                    .astype(out_shape.dtype),
+                    is_last, fin,
                     lambda hh: jnp.zeros(out_shape.shape, out_shape.dtype), h)
                 updated = jax.lax.dynamic_update_slice(
                     outputs, logits[None].astype(outputs.dtype),
@@ -445,22 +496,25 @@ def build_spmd_pipeline(family: FamilySpec, cfg: TransformerConfig,
                         stage_bits=stage_bits)
 
 
-def make_pipeline_mesh(n_stages: int, dp: int = 1, tp: int = 1,
+def make_pipeline_mesh(n_stages: int, dp: int = 1, tp: int = 1, sp: int = 1,
                        devices: Optional[Sequence[jax.Device]] = None,
                        stage_ranks: Optional[Sequence[int]] = None) -> Mesh:
-    """Build a ('dp', 'stage'[, 'tp']) mesh: tp innermost (fastest axis, so
-    the two per-block psums ride adjacent ICI links), stage next (ppermute
-    edges ride neighboring links).
+    """Build a ('dp', 'stage'[, 'tp'|'sp']) mesh: the within-stage axis (tp
+    Megatron sharding or sp ring attention) innermost — its per-block
+    collectives ride adjacent ICI links — stage next (ppermute edges ride
+    neighboring links). tp and sp are mutually exclusive.
 
     `stage_ranks[i]` places stage i on `devices[stage_ranks[i]]` (reference
-    `-r` rank-order semantics, runtime.py:657-687); requires dp=1, tp=1 and
+    `-r` rank-order semantics, runtime.py:657-687); requires dp=tp=sp=1 and
     distinct ranks.
     """
+    if tp > 1 and sp > 1:
+        raise ValueError("tp and sp mesh axes are mutually exclusive")
     if devices is None:
         devices = jax.devices()
     if stage_ranks is not None:
-        if dp != 1 or tp != 1:
-            raise ValueError("stage_ranks requires dp=1 and tp=1")
+        if dp != 1 or tp != 1 or sp != 1:
+            raise ValueError("stage_ranks requires dp=1, tp=1 and sp=1")
         if len(stage_ranks) != n_stages:
             raise ValueError(f"stage_ranks length {len(stage_ranks)} != "
                              f"{n_stages} stages")
@@ -471,11 +525,12 @@ def make_pipeline_mesh(n_stages: int, dp: int = 1, tp: int = 1,
                              f"({len(devices)} devices)")
         arr = np.asarray([devices[r] for r in stage_ranks]).reshape(1, n_stages)
         return Mesh(arr, ("dp", "stage"))
-    need = n_stages * dp * tp
+    inner, inner_name = (tp, "tp") if tp > 1 else (sp, "sp")
+    need = n_stages * dp * inner
     if len(devices) < need:
         raise ValueError(f"need {need} devices, have {len(devices)}")
-    if tp > 1:
-        arr = np.asarray(devices[:need]).reshape(dp, n_stages, tp)
-        return Mesh(arr, ("dp", "stage", "tp"))
+    if inner > 1:
+        arr = np.asarray(devices[:need]).reshape(dp, n_stages, inner)
+        return Mesh(arr, ("dp", "stage", inner_name))
     arr = np.asarray(devices[:need]).reshape(dp, n_stages)
     return Mesh(arr, ("dp", "stage"))

@@ -432,9 +432,16 @@ def run_pipeline_spmd(args, stage_layers, stage_quant, stage_ranks,
     # conflict check on the RAW argument, before any (potentially multi-GB)
     # stage weights load
     if stage_ranks and list(stage_ranks) != list(range(len(stage_layers))) \
-            and (args.spmd_dp > 1 or args.spmd_tp > 1):
+            and (args.spmd_dp > 1 or args.spmd_tp > 1 or args.spmd_sp > 1):
         raise RuntimeError("-r stage ranks cannot combine with "
-                           "--spmd-dp/--spmd-tp mesh axes")
+                           "--spmd-dp/--spmd-tp/--spmd-sp mesh axes")
+    need = len(stage_layers) * args.spmd_dp * max(args.spmd_tp, args.spmd_sp)
+    have = len(jax.devices())
+    if need > have:
+        raise RuntimeError(
+            f"mesh needs {need} devices (stages x dp x tp|sp = "
+            f"{len(stage_layers)} x {args.spmd_dp} x "
+            f"{max(args.spmd_tp, args.spmd_sp)}) but only {have} available")
     stage_params = []
     for i, (l, r) in enumerate(stage_layers):
         # stacked block layout required: the SPMD driver pads and re-stacks
@@ -455,7 +462,8 @@ def run_pipeline_spmd(args, stage_layers, stage_quant, stage_ranks,
                            "using default stage order", stage_ranks,
                            len(devices))
     mesh = spmd.make_pipeline_mesh(n_stages, dp=args.spmd_dp,
-                                   tp=args.spmd_tp, stage_ranks=ranks)
+                                   tp=args.spmd_tp, sp=args.spmd_sp,
+                                   stage_ranks=ranks)
     pipe = spmd.build_spmd_pipeline(entry.family.FAMILY, entry.config,
                                     stage_layers, stage_params, mesh,
                                     quant_bit=list(stage_quant) if stage_quant
@@ -971,11 +979,16 @@ def main():
                         help="base listener port for dcn mode defaults")
     parser.add_argument("--spmd-dp", type=int, default=1,
                         help="data-parallel mesh axis for the spmd driver "
-                             "(worldsize devices = stages x dp x tp)")
+                             "(devices needed = stages x dp x (tp or sp))")
     parser.add_argument("--spmd-tp", type=int, default=1,
                         help="Megatron tensor-parallel mesh axis for the "
                              "spmd driver: blocks stage-sharded AND "
                              "tp-sharded in one XLA program")
+    parser.add_argument("--spmd-sp", type=int, default=1,
+                        help="sequence-parallel mesh axis for the spmd "
+                             "driver: activations sequence-sharded, exact "
+                             "ring attention per block (long-context "
+                             "pipelines); exclusive with --spmd-tp")
     parser.add_argument("--stage-tp", type=int, default=1,
                         help="shard each dcn stage's blocks Megatron-style "
                              "over N local devices (block-aligned stages): "
