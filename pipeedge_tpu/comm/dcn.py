@@ -29,6 +29,7 @@ encoded as int arrays by the caller, runtime.py's CMD_SCHED tensor format).
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import socket
 import struct
@@ -207,6 +208,16 @@ class DistDcnContext(DistContext):
         assert len(rank_addrs) == world_size
         self._rank_addrs = list(rank_addrs)
         self._cmd_handler = cmd_handler
+        # env override so small test fleets / fast-failing deployments don't
+        # wait the full minute for a peer that will never come up
+        env_timeout = os.getenv("DCN_CONNECT_TIMEOUT")
+        if env_timeout:
+            try:
+                self.CONNECT_TIMEOUT = float(env_timeout)
+            except ValueError:
+                raise ValueError(
+                    f"DCN_CONNECT_TIMEOUT={env_timeout!r} is not a number "
+                    "(seconds)") from None
         self._listener: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
         self._reader_threads: List[threading.Thread] = []

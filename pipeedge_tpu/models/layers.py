@@ -74,19 +74,29 @@ def _use_fused_attention(seq_len: int) -> bool:
 
 
 def self_attention(p, x: jax.Array, num_heads: int,
-                   mask: Optional[jax.Array] = None) -> jax.Array:
+                   mask: Optional[jax.Array] = None,
+                   core_fn=None) -> jax.Array:
     """Multi-head self-attention context (pre-projection), batched over [B,S,D].
 
     Matches HF `{ViT,Bert}SelfAttention` semantics: returns the concatenated
     per-head context; the output projection lives in the next sublayer
     (reference vit.py:58-63). Softmax in float32. On TPU the
     softmax(QK^T)V core runs as a fused Pallas kernel (ops/attention.py).
+
+    `core_fn(q, k, v) -> ctx` ([B,S,H,D]-shaped) overrides the attention
+    core while reusing THIS projection code — how sequence-parallel
+    execution swaps in ring attention (parallel/spmd.py).
     """
     b, s, d = x.shape
     hd = d // num_heads
     q = dense(p["q"], x).reshape(b, s, num_heads, hd)
     k = dense(p["k"], x).reshape(b, s, num_heads, hd)
     v = dense(p["v"], x).reshape(b, s, num_heads, hd)
+    if core_fn is not None:
+        # the override receives no mask; reject the combination rather than
+        # silently attending to padding tokens
+        assert mask is None, "core_fn overrides do not support masks"
+        return core_fn(q, k, v).reshape(b, s, d)
     if mask is None and _use_fused_attention(s):
         from ..ops.attention import fused_attention
         return fused_attention(q, k, v).reshape(b, s, d)

@@ -204,17 +204,14 @@ class SpmdPipeline:
             # attention core, which runs as exact ring attention over 'sp'
             # (K/V chunks rotate via ppermute, streaming softmax —
             # parallel/sequence.py)
-            from ..models.layers import dense
+            from ..models.layers import self_attention
             from .sequence import ring_attention
 
             def sp_attention(qkv, x, num_heads):
-                b, s, d = x.shape
-                hd = d // num_heads
-                q = dense(qkv["q"], x).reshape(b, s, num_heads, hd)
-                k = dense(qkv["k"], x).reshape(b, s, num_heads, hd)
-                v = dense(qkv["v"], x).reshape(b, s, num_heads, hd)
-                ctx = ring_attention(q, k, v, "sp")
-                return ctx.reshape(b, s, d)
+                # reuse the family projection code; only the core changes
+                return self_attention(
+                    qkv, x, num_heads,
+                    core_fn=lambda q, k, v: ring_attention(q, k, v, "sp"))
 
             def block_apply(bp, x):
                 for sub in range(4):
@@ -342,16 +339,38 @@ class SpmdPipeline:
                 return jax.vmap(
                     lambda u: family.embed(params["embed"], u, cfg))(si)
 
-            embedded = jax.lax.cond(
-                is_first, do_embed,
-                lambda si: jnp.zeros(
-                    (n_ubatch, b_local, seq_total) + embed_shape.shape[2:],
-                    embed_shape.dtype), stacked_inputs)
             if sp > 1:
-                # each sp member keeps only its sequence chunk
+                # Long-context memory: pre-embedding all M microbatches at
+                # FULL sequence would give stage 0 an [M, b, S, D] buffer —
+                # exactly the scaling sp sheds. Instead embed one microbatch
+                # per tick (inside `tick` below, stage 0 only) and keep the
+                # local chunk. Trade: embed joins stage 0's tick latency
+                # (small vs a stage of blocks); the full-seq [b, S, D]
+                # intermediate is transient.
                 sp_idx = jax.lax.axis_index("sp")
-                embedded = jax.lax.dynamic_slice_in_dim(
-                    embedded, sp_idx * s_local, s_local, axis=2)
+
+                def embed_chunk(si_u):
+                    full = family.embed(params["embed"], si_u, cfg)
+                    return jax.lax.dynamic_slice_in_dim(
+                        full, sp_idx * s_local, s_local, axis=1)
+
+                def embed_at(t):
+                    return jax.lax.cond(
+                        is_first,
+                        lambda u: embed_chunk(u),
+                        lambda u: jnp.zeros(hidden_local.shape,
+                                            embed_shape.dtype),
+                        stacked_inputs[t])
+            else:
+                embedded = jax.lax.cond(
+                    is_first, do_embed,
+                    lambda si: jnp.zeros(
+                        (n_ubatch, b_local, seq_total)
+                        + embed_shape.shape[2:],
+                        embed_shape.dtype), stacked_inputs)
+
+                def embed_at(t):
+                    return embedded[t]
 
             outputs0 = jnp.zeros((n_ubatch,) + out_shape.shape, out_shape.dtype)
 
@@ -359,7 +378,7 @@ class SpmdPipeline:
                 prev_enc, outputs = carry
                 recv = decode(permute_payload(prev_enc), stage)
                 in_idx = jnp.clip(t, 0, n_ubatch - 1)
-                x = jnp.where(is_first, embedded[in_idx], recv)
+                x = jnp.where(is_first, embed_at(in_idx), recv)
                 # Every stage runs its blocks every tick, including fill
                 # ticks (garbage in-flight) and drain ticks (stage 0 on a
                 # clamped stale input). This is deliberate: ticks are

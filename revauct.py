@@ -213,18 +213,30 @@ def main_dcn(args) -> None:
         if args.rank == 0:
             # broadcast the auction request (reference rpc_async fan-out,
             # revauct.py:171-174); rank 0 bids locally
-            ctx.cmd_broadcast(CMD_BID, [
-                np.frombuffer(args.model_name.encode(), np.uint8),
-                np.asarray(args.ubatch_size, np.int32),
-                np.frombuffer(DTYPE.encode(), np.uint8)])
+            try:
+                ctx.cmd_broadcast(CMD_BID, [
+                    np.frombuffer(args.model_name.encode(), np.uint8),
+                    np.asarray(args.ubatch_size, np.int32),
+                    np.frombuffer(DTYPE.encode(), np.uint8)])
+            except ConnectionError as exc:
+                # release the bidders that ARE up before failing
+                ctx.cmd_broadcast(CMD_STOP, best_effort=True)
+                raise RuntimeError(
+                    f"auction request undeliverable: {exc}") from None
             try:
                 bids_in_order = [bid_latency_for_host(
                     args.host, args.dev_type, cfg, args.model_name,
                     args.ubatch_size, DTYPE)]
                 for rank in range(1, args.worldsize):
-                    blob = ctx.recv_tensors(rank,
-                                            timeout=args.auction_timeout,
-                                            channel=dcn.CHANNEL_BIDS)
+                    try:
+                        blob = ctx.recv_tensors(rank,
+                                                timeout=args.auction_timeout,
+                                                channel=dcn.CHANNEL_BIDS)
+                    except (queue.Empty, ConnectionError) as exc:
+                        raise RuntimeError(
+                            f"no bid from rank {rank} within "
+                            f"{args.auction_timeout}s ({exc.__class__.__name__}"
+                            f"); is it up and bidding?") from None
                     bid = json.loads(bytes(blob[0]).decode())
                     bids_in_order.append(
                         (bid['host'],
@@ -255,9 +267,18 @@ def main_dcn(args) -> None:
             blob = json.dumps({'host': host, 'shards': payload[0],
                                'costs': payload[1],
                                'neighbors': payload[2]}).encode()
-            ctx.send_tensors(0, [np.frombuffer(blob, np.uint8)],
-                             channel=dcn.CHANNEL_BIDS)
-            stop_ev.wait(timeout=args.auction_timeout)
+            try:
+                ctx.send_tensors(0, [np.frombuffer(blob, np.uint8)],
+                                 channel=dcn.CHANNEL_BIDS)
+            except OSError as exc:
+                raise RuntimeError(
+                    f"rank {args.rank}: could not deliver bid to the "
+                    f"auctioneer ({exc}); is rank 0 still up?") from None
+            if stop_ev.wait(timeout=args.auction_timeout):
+                logger.info("rank %d: released by auctioneer", args.rank)
+            else:
+                logger.warning("rank %d: no CMD_STOP within %ss; exiting",
+                               args.rank, args.auction_timeout)
 
 
 def main() -> None:

@@ -435,6 +435,9 @@ def run_pipeline_spmd(args, stage_layers, stage_quant, stage_ranks,
             and (args.spmd_dp > 1 or args.spmd_tp > 1 or args.spmd_sp > 1):
         raise RuntimeError("-r stage ranks cannot combine with "
                            "--spmd-dp/--spmd-tp/--spmd-sp mesh axes")
+    if args.spmd_tp > 1 and args.spmd_sp > 1:
+        raise RuntimeError("--spmd-tp and --spmd-sp are mutually exclusive "
+                           "(Megatron TP assumes a full local sequence)")
     need = len(stage_layers) * args.spmd_dp * max(args.spmd_tp, args.spmd_sp)
     have = len(jax.devices())
     if need > have:

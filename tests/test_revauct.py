@@ -261,3 +261,61 @@ def test_revauct_distributed_dcn_matches_centralized(tmp_path):
             if layers:
                 covered.extend(range(layers[0], layers[1] + 1))
     assert covered == list(range(1, n + 1))
+
+
+def test_revauct_dcn_missing_bidder_releases_fleet(tmp_path):
+    """A bidder that never shows up must not hang the auction: the
+    auctioneer fails fast (broadcast undeliverable) or after
+    --auction-timeout (connected but silent), and either way releases the
+    live bidders via CMD_STOP."""
+    import socket as socket_mod
+    n = 8
+    models = {"pipeedge/test-tiny-vit": {
+        "layers": n, "parameters_in": 768, "parameters_out": [1000] * n,
+        "mem_MB": [50.0] * n}}
+    types = {"t0": {"mem_MB": 300, "bw_Mbps": 10000, "model_profiles": {
+        "pipeedge/test-tiny-vit": [{"dtype": DTYPE, "batch_size": 2,
+                                    "time_s": [0.01] * n}]}}}
+    neighbors = {h: {o: {"bw_Mbps": 10000} for o in ("c0", "c1", "c2")
+                     if o != h} for h in ("c0", "c1", "c2")}
+    for r in range(2):
+        d = tmp_path / f"rank{r}"
+        d.mkdir()
+        for fname, data in (("models.yml", models),
+                            ("device_types.yml", types),
+                            ("device_neighbors_world.yml", neighbors)):
+            with open(d / fname, "w") as f:
+                yaml.safe_dump(data, f, default_flow_style=None)
+    socks = [socket_mod.create_server(("127.0.0.1", 0)) for _ in range(3)]
+    addrs = ",".join(f"127.0.0.1:{s.getsockname()[1]}" for s in socks)
+    for s in socks:
+        s.close()
+    # short dial deadline: the auctioneer must fail fast on the absent
+    # rank and still have time to release the live bidder
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               DCN_CONNECT_TIMEOUT="5")
+    base = [sys.executable, os.path.join(REPO, "revauct.py")]
+    opts = ["-m", "pipeedge/test-tiny-vit", "-u", "2", "-c", "dcn",
+            "--dcn-addrs", addrs, "--auction-timeout", "60"]
+    # rank 2 never starts
+    bidder = subprocess.Popen(
+        base + ["1", "3"] + opts + ["--host", "c1", "--dev-type", "t0"],
+        cwd=str(tmp_path / "rank1"), env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    try:
+        auctioneer = subprocess.run(
+            base + ["0", "3"] + opts + ["--host", "c0", "--dev-type", "t0"],
+            capture_output=True, env=env, cwd=str(tmp_path / "rank0"),
+            text=True, timeout=120)
+        bout = bidder.communicate(timeout=60)[0]
+    finally:
+        bidder.kill()
+    assert auctioneer.returncode != 0
+    out = auctioneer.stdout + auctioneer.stderr
+    # unreachable at broadcast time -> fast "undeliverable" failure; a rank
+    # that connects but never bids -> "no bid from rank" after the timeout
+    assert "undeliverable" in out or "no bid from rank 2" in out, out
+    # the live bidder was RELEASED by the auctioneer's CMD_STOP — not its
+    # own --auction-timeout (60s; the subprocess wait above is shorter)
+    assert bidder.returncode == 0, bout
+    assert "released by auctioneer" in bout, bout

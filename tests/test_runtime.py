@@ -157,3 +157,20 @@ def test_runtime_spmd_dp_tp_mesh(tmp_path):
         timeout=300)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "latency_sec=" in proc.stdout
+
+
+def test_runtime_spmd_sp_mesh(tmp_path):
+    """CLI spmd driver with sequence parallelism inside pipeline stages
+    (ring attention over 'sp'; BERT synthetic tokens, seq divisible)."""
+    import subprocess
+    import sys
+    env = dict(os.environ, PYTHONPATH=REPO,
+               XLA_FLAGS="--xla_force_host_platform_device_count=4")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "runtime.py"), "0", "4",
+         "--platform", "cpu", "-c", "spmd", "-m", "pipeedge/test-tiny-bert",
+         "-b", "8", "-u", "4", "-pt", "1,4,5,8", "--spmd-sp", "2"],
+        capture_output=True, env=env, cwd=str(tmp_path), text=True,
+        timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "latency_sec=" in proc.stdout
