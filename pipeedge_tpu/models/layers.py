@@ -93,9 +93,11 @@ def self_attention(p, x: jax.Array, num_heads: int,
     k = dense(p["k"], x).reshape(b, s, num_heads, hd)
     v = dense(p["v"], x).reshape(b, s, num_heads, hd)
     if core_fn is not None:
-        # the override receives no mask; reject the combination rather than
-        # silently attending to padding tokens
-        assert mask is None, "core_fn overrides do not support masks"
+        if mask is not None:
+            # the override receives no mask; reject the combination rather
+            # than silently attending to padding tokens
+            raise NotImplementedError(
+                "core_fn overrides do not support masks")
         return core_fn(q, k, v).reshape(b, s, d)
     if mask is None and _use_fused_attention(s):
         from ..ops.attention import fused_attention

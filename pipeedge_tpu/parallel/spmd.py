@@ -135,6 +135,7 @@ class SpmdPipeline:
     max_blocks: int
     params: Dict            # {'embed', 'final', 'blocks', 'n_blocks'}
     stage_bits: Tuple[int, ...] = (0,)
+    sp_kind: str = "ring"   # sp attention core: 'ring' | 'ulysses'
     _compiled: Dict[Tuple, Any] = dataclasses.field(default_factory=dict)
 
     @property
@@ -205,13 +206,22 @@ class SpmdPipeline:
             # (K/V chunks rotate via ppermute, streaming softmax —
             # parallel/sequence.py)
             from ..models.layers import self_attention
-            from .sequence import ring_attention
+            from .sequence import ring_attention, ulysses_attention
+            if self.sp_kind == "ulysses":
+                if cfg.num_attention_heads % sp:
+                    raise ValueError(
+                        f"ulysses sp={sp} requires head count "
+                        f"({cfg.num_attention_heads}) divisible by sp")
+                core = partial(ulysses_attention, axis_name="sp")
+            elif self.sp_kind == "ring":
+                core = partial(ring_attention, axis_name="sp")
+            else:
+                raise ValueError(f"unknown sp_kind {self.sp_kind!r} "
+                                 "(ring | ulysses)")
 
             def sp_attention(qkv, x, num_heads):
                 # reuse the family projection code; only the core changes
-                return self_attention(
-                    qkv, x, num_heads,
-                    core_fn=lambda q, k, v: ring_attention(q, k, v, "sp"))
+                return self_attention(qkv, x, num_heads, core_fn=core)
 
             def block_apply(bp, x):
                 for sub in range(4):
@@ -435,7 +445,7 @@ class SpmdPipeline:
 def build_spmd_pipeline(family: FamilySpec, cfg: TransformerConfig,
                         partition: Sequence[Tuple[int, int]],
                         stage_params: Sequence[Dict], mesh: Mesh,
-                        quant_bit=0) -> SpmdPipeline:
+                        quant_bit=0, sp_kind: str = "ring") -> SpmdPipeline:
     """Assemble an `SpmdPipeline` from per-stage shard parameter pytrees.
 
     `stage_params[i]` is the pytree built by a family loader for stage i's
@@ -512,7 +522,7 @@ def build_spmd_pipeline(family: FamilySpec, cfg: TransformerConfig,
     }
     return SpmdPipeline(family=family, cfg=cfg, mesh=mesh, n_stages=n_stages,
                         max_blocks=max_b, params=params,
-                        stage_bits=stage_bits)
+                        stage_bits=stage_bits, sp_kind=sp_kind)
 
 
 def make_pipeline_mesh(n_stages: int, dp: int = 1, tp: int = 1, sp: int = 1,

@@ -470,7 +470,7 @@ def run_pipeline_spmd(args, stage_layers, stage_quant, stage_ranks,
     pipe = spmd.build_spmd_pipeline(entry.family.FAMILY, entry.config,
                                     stage_layers, stage_params, mesh,
                                     quant_bit=list(stage_quant) if stage_quant
-                                    else 0)
+                                    else 0, sp_kind=args.spmd_sp_kind)
     for lb in labels:
         label_queue.put(lb)
     inputs = jnp.asarray(np.stack(ubatches),
@@ -992,6 +992,10 @@ def main():
                              "driver: activations sequence-sharded, exact "
                              "ring attention per block (long-context "
                              "pipelines); exclusive with --spmd-tp")
+    parser.add_argument("--spmd-sp-kind", default="ring",
+                        choices=["ring", "ulysses"],
+                        help="sp attention core: K/V ring rotation or "
+                             "Ulysses all-to-all head resharding")
     parser.add_argument("--stage-tp", type=int, default=1,
                         help="shard each dcn stage's blocks Megatron-style "
                              "over N local devices (block-aligned stages): "

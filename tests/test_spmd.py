@@ -263,3 +263,26 @@ def test_spmd_sp_seq_divisibility_error(tiny_vit4):
     inputs = jnp.asarray(np.zeros((3, 2, 3, 16, 16), np.float32))
     with pytest.raises(ValueError, match="sequence length 17"):
         pipe.run(inputs)
+
+
+def test_spmd_sp_ulysses_matches_oracle():
+    from transformers import BertConfig, BertForSequenceClassification
+    hf_cfg = BertConfig(**TINY4, vocab_size=100, max_position_embeddings=64,
+                        num_labels=3)
+    torch.manual_seed(3)
+    model = BertForSequenceClassification(hf_cfg).eval()
+    cfg = TransformerConfig(model_type="bert", **TINY4, num_labels=3,
+                            vocab_size=100, max_position_embeddings=64)
+    weights = {k: v.numpy() for k, v in model.state_dict().items()}
+    partition = [(1, 8), (9, 16)]
+    mesh = spmd.make_pipeline_mesh(2, dp=2, sp=2)
+    pipe = spmd.build_spmd_pipeline(
+        bert_mod.FAMILY, cfg, partition,
+        _stage_params(bert_mod, cfg, partition, weights), mesh,
+        sp_kind="ulysses")
+    ids = jnp.asarray(
+        np.random.default_rng(9).integers(0, 100, size=(3, 4, 12)),
+        dtype=jnp.int32)
+    got = np.asarray(pipe.run(ids))
+    expected = _expected(bert_mod, cfg, weights, ids)
+    np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-5)
