@@ -291,3 +291,49 @@ def test_tp_stage_matches_plain_stage():
             got = np.asarray(fn_tp(p_tp, payload))
             np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
             payload = fn_ref(p_ref, payload)
+
+
+def test_multi_round_shifting_fleet(tmp_path):
+    """The whole control-plane feature matrix on one fleet: three schedule
+    rounds where the partition, rank order, stage count, AND the idle set
+    all change between rounds, with adaptive quantization and TP-sharded
+    stages throughout. Round 2 runs single-stage on a rank that was idle in
+    round 1; round 3 swaps the rank order of round 1."""
+    addrs = ",".join(f"127.0.0.1:{p}" for p in _free_ports(4))
+    common = [sys.executable, os.path.join(REPO, "runtime.py")]
+    opts = ["-c", "dcn", "--platform", "cpu", "--stage-tp", "2",
+            "-m", "pipeedge/test-tiny-vit", "-b", "24", "-u", "4",
+            "-pt", "1,4,5,8;1,8;1,4,5,8", "-q", "8,0;0;4,0",
+            "-r", "0,1;2;1,0", "--dcn-addrs", addrs,
+            "--sched-timeout", "180"]
+    env = dict(os.environ, PYTHONPATH=REPO, ADAPTIVE_QUANT="HEURISTIC",
+               SEND_CONSTRAINT="100", WINDOW_SIZE="3",
+               XLA_FLAGS="--xla_force_host_platform_device_count=2")
+    rank_dirs = []
+    for r in range(4):
+        d = tmp_path / f"rank{r}"
+        d.mkdir()
+        rank_dirs.append(d)
+    workers = [subprocess.Popen(common + [str(r), "4"] + opts,
+                                cwd=rank_dirs[r], env=env,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+               for r in (1, 2, 3)]
+    try:
+        data = subprocess.run(common + ["0", "4"] + opts, cwd=rank_dirs[0],
+                              env=env, capture_output=True, text=True,
+                              timeout=420)
+        wouts = [w.communicate(timeout=60)[0] for w in workers]
+    finally:
+        for w in workers:
+            w.kill()
+    assert data.returncode == 0, data.stdout + data.stderr
+    assert data.stdout.count("latency_sec=") == 3, data.stdout
+    for r, wout in zip((1, 2, 3), wouts):
+        assert workers[r - 1].returncode == 0, f"rank {r}:\n{wout}"
+        assert "Traceback" not in wout, wout
+    # rank 2 idles in round 1, runs the whole model in round 2
+    assert "not in schedule; idling" in wouts[1]
+    assert "stage 0: layers [1, 8]" in wouts[1]
+    # rank 3 never appears in any schedule: idles all three rounds
+    assert wouts[2].count("not in schedule; idling") == 3
