@@ -49,28 +49,53 @@ class _OutReader:
         return "".join(self.lines)
 
 
-def test_two_process_dcn_runtime_quantized_edge(tmp_path):
-    addrs = ",".join(f"127.0.0.1:{p}" for p in _free_ports(2))
+
+def _run_fleet(tmp_path, opts, world, env_extra=None, per_rank_dirs=False,
+               data_timeout=300):
+    """Launch a `world`-rank DCN fleet (workers as Popen, the data rank in
+    the foreground), collect everyone's output.
+
+    Returns (data CompletedProcess, [worker stdout by rank], rank_dirs).
+    `opts` excludes --dcn-addrs (allocated here). Worker processes are
+    always killed on exit."""
+    addrs = ",".join(f"127.0.0.1:{p}" for p in _free_ports(world))
     common = [sys.executable, os.path.join(REPO, "runtime.py")]
-    opts = ["-c", "dcn", "--platform", "cpu",
-            "-m", "pipeedge/test-tiny-vit", "-b", "16", "-u", "4",
-            "-pt", "1,4,5,8", "-q", "8,0", "-r", "0,1",
-            "--dcn-addrs", addrs, "--sched-timeout", "120"]
-    env = dict(os.environ, PYTHONPATH=REPO)
-    worker = subprocess.Popen(common + ["1", "2"] + opts, cwd=tmp_path,
-                              env=env, stdout=subprocess.PIPE,
-                              stderr=subprocess.STDOUT, text=True)
+    argv = opts + ["--dcn-addrs", addrs]
+    env = dict(os.environ, PYTHONPATH=REPO, **(env_extra or {}))
+    if per_rank_dirs:
+        rank_dirs = []
+        for r in range(world):
+            d = tmp_path / f"rank{r}"
+            d.mkdir()
+            rank_dirs.append(d)
+    else:
+        rank_dirs = [tmp_path] * world
+    workers = [subprocess.Popen(common + [str(r), str(world)] + argv,
+                                cwd=rank_dirs[r], env=env,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+               for r in range(1, world)]
     try:
-        data = subprocess.run(common + ["0", "2"] + opts, cwd=tmp_path,
-                              env=env, capture_output=True, text=True,
-                              timeout=240)
-        wout, _ = worker.communicate(timeout=60)
+        data = subprocess.run(common + ["0", str(world)] + argv,
+                              cwd=rank_dirs[0], env=env, capture_output=True,
+                              text=True, timeout=data_timeout)
+        wouts = [w.communicate(timeout=60)[0] for w in workers]
     finally:
-        worker.kill()
+        for w in workers:
+            w.kill()
+    for r, (w, wout) in enumerate(zip(workers, wouts), start=1):
+        assert w.returncode == 0, f"rank {r}:\n{wout}"
+    return data, wouts, rank_dirs
+
+def test_two_process_dcn_runtime_quantized_edge(tmp_path):
+    data, wouts, _ = _run_fleet(
+        tmp_path, ["-c", "dcn", "--platform", "cpu",
+                   "-m", "pipeedge/test-tiny-vit", "-b", "16", "-u", "4",
+                   "-pt", "1,4,5,8", "-q", "8,0", "-r", "0,1",
+                   "--sched-timeout", "120"], world=2, data_timeout=240)
     assert data.returncode == 0, data.stdout + data.stderr
     assert "latency_sec=" in data.stdout
-    assert worker.returncode == 0, wout
-    assert "======= pipeedge/test-tiny-vit stage 1: layers [5, 8]" in wout
+    assert "======= pipeedge/test-tiny-vit stage 1: layers [5, 8]" in wouts[0]
 
 
 def test_two_process_dcn_adaptive_quant(tmp_path):
@@ -78,31 +103,14 @@ def test_two_process_dcn_adaptive_quant(tmp_path):
     send window via the transport hooks and adapts its output-edge bitwidth;
     the bitwidth rides the wire header so rank 1 decodes without
     coordination (reference per-rank policy, runtime.py:121-216)."""
-    addrs = ",".join(f"127.0.0.1:{p}" for p in _free_ports(2))
-    common = [sys.executable, os.path.join(REPO, "runtime.py")]
-    opts = ["-c", "dcn", "--platform", "cpu",
-            "-m", "pipeedge/test-tiny-vit", "-b", "24", "-u", "4",
-            "-pt", "1,4,5,8", "-q", "8,0", "-r", "0,1",
-            "--dcn-addrs", addrs, "--sched-timeout", "120"]
-    rank_dirs = []
-    for r in range(2):
-        d = tmp_path / f"rank{r}"
-        d.mkdir()
-        rank_dirs.append(d)
-    env = dict(os.environ, PYTHONPATH=REPO, ADAPTIVE_QUANT="HEURISTIC",
-               SEND_CONSTRAINT="100", WINDOW_SIZE="3")
-    worker = subprocess.Popen(common + ["1", "2"] + opts, cwd=rank_dirs[1],
-                              env=env, stdout=subprocess.PIPE,
-                              stderr=subprocess.STDOUT, text=True)
-    try:
-        data = subprocess.run(common + ["0", "2"] + opts, cwd=rank_dirs[0],
-                              env=env, capture_output=True, text=True,
-                              timeout=240)
-        wout, _ = worker.communicate(timeout=60)
-    finally:
-        worker.kill()
+    data, wouts, rank_dirs = _run_fleet(
+        tmp_path, ["-c", "dcn", "--platform", "cpu",
+                   "-m", "pipeedge/test-tiny-vit", "-b", "24", "-u", "4",
+                   "-pt", "1,4,5,8", "-q", "8,0", "-r", "0,1",
+                   "--sched-timeout", "120"], world=2,
+        env_extra={"ADAPTIVE_QUANT": "HEURISTIC", "SEND_CONSTRAINT": "100",
+                   "WINDOW_SIZE": "3"}, per_rank_dirs=True, data_timeout=240)
     assert data.returncode == 0, data.stdout + data.stderr
-    assert worker.returncode == 0, wout
     # the data rank hosts stage 0, whose policy adapts its output edge
     assert "Adaptive quantization" in data.stdout + data.stderr
     # transport hooks produced per-rank wire telemetry CSVs
@@ -155,36 +163,15 @@ def test_four_process_idle_rank_adaptive_quant(tmp_path):
     until CMD_STOP (reference model_cfg.py:154-159, runtime.py:456-460), while
     the scheduled ranks run a mixed-bitwidth quantized pipeline with the
     adaptive policy live on every edge's owner."""
-    addrs = ",".join(f"127.0.0.1:{p}" for p in _free_ports(4))
-    common = [sys.executable, os.path.join(REPO, "runtime.py")]
-    opts = ["-c", "dcn", "--platform", "cpu",
-            "-m", "pipeedge/test-tiny-vit", "-b", "32", "-u", "4",
-            "-pt", "1,2,3,5,6,8", "-q", "8,4,0", "-r", "0,1,2",
-            "--dcn-addrs", addrs, "--sched-timeout", "180"]
-    rank_dirs = []
-    for r in range(4):
-        d = tmp_path / f"rank{r}"
-        d.mkdir()
-        rank_dirs.append(d)
-    env = dict(os.environ, PYTHONPATH=REPO, ADAPTIVE_QUANT="HEURISTIC",
-               SEND_CONSTRAINT="100", WINDOW_SIZE="3")
-    workers = [subprocess.Popen(common + [str(r), "4"] + opts,
-                                cwd=rank_dirs[r], env=env,
-                                stdout=subprocess.PIPE,
-                                stderr=subprocess.STDOUT, text=True)
-               for r in (1, 2, 3)]
-    try:
-        data = subprocess.run(common + ["0", "4"] + opts, cwd=rank_dirs[0],
-                              env=env, capture_output=True, text=True,
-                              timeout=300)
-        wouts = [w.communicate(timeout=60)[0] for w in workers]
-    finally:
-        for w in workers:
-            w.kill()
+    data, wouts, rank_dirs = _run_fleet(
+        tmp_path, ["-c", "dcn", "--platform", "cpu",
+                   "-m", "pipeedge/test-tiny-vit", "-b", "32", "-u", "4",
+                   "-pt", "1,2,3,5,6,8", "-q", "8,4,0", "-r", "0,1,2",
+                   "--sched-timeout", "180"], world=4,
+        env_extra={"ADAPTIVE_QUANT": "HEURISTIC", "SEND_CONSTRAINT": "100",
+                   "WINDOW_SIZE": "3"}, per_rank_dirs=True)
     assert data.returncode == 0, data.stdout + data.stderr
     assert "latency_sec=" in data.stdout
-    for r, wout in zip((1, 2, 3), wouts):
-        assert workers[r - 1].returncode == 0, wout
     assert "stage 1: layers [3, 5]" in wouts[0]
     assert "stage 2: layers [6, 8]" in wouts[1]
     assert "not in schedule; idling" in wouts[2]
@@ -204,32 +191,19 @@ def test_live_reschedule_two_rounds(tmp_path):
     consumes exactly one schedule. Here the data rank broadcasts a second,
     DIFFERENT partition at the run boundary and the same worker processes
     rebuild their stages and run again — ending on an empty CMD_SCHED."""
-    addrs = ",".join(f"127.0.0.1:{p}" for p in _free_ports(2))
-    common = [sys.executable, os.path.join(REPO, "runtime.py")]
-    opts = ["-c", "dcn", "--platform", "cpu",
-            "-m", "pipeedge/test-tiny-vit", "-b", "16", "-u", "4",
-            "-pt", "1,4,5,8;1,2,3,8", "-q", "8,0;4,0", "-r", "0,1",
-            "--dcn-addrs", addrs, "--sched-timeout", "180"]
-    env = dict(os.environ, PYTHONPATH=REPO)
-    worker = subprocess.Popen(common + ["1", "2"] + opts, cwd=tmp_path,
-                              env=env, stdout=subprocess.PIPE,
-                              stderr=subprocess.STDOUT, text=True)
-    try:
-        data = subprocess.run(common + ["0", "2"] + opts, cwd=tmp_path,
-                              env=env, capture_output=True, text=True,
-                              timeout=300)
-        wout, _ = worker.communicate(timeout=60)
-    finally:
-        worker.kill()
+    data, wouts, _ = _run_fleet(
+        tmp_path, ["-c", "dcn", "--platform", "cpu",
+                   "-m", "pipeedge/test-tiny-vit", "-b", "16", "-u", "4",
+                   "-pt", "1,4,5,8;1,2,3,8", "-q", "8,0;4,0", "-r", "0,1",
+                   "--sched-timeout", "180"], world=2)
     assert data.returncode == 0, data.stdout + data.stderr
     # one latency report per round
     assert data.stdout.count("latency_sec=") == 2, data.stdout
     assert "re-schedule: broadcasting round 1" in data.stdout + data.stderr
-    assert worker.returncode == 0, wout
     # the worker rebuilt its stage with the round-2 partition
-    assert "stage 1: layers [5, 8]" in wout
-    assert "stage 1: layers [3, 8]" in wout
-    assert "empty CMD_SCHED; shutting down" in wout
+    assert "stage 1: layers [5, 8]" in wouts[0]
+    assert "stage 1: layers [3, 8]" in wouts[0]
+    assert "empty CMD_SCHED; shutting down" in wouts[0]
 
 
 def test_dcn_stage_tp_hierarchical(tmp_path):
@@ -238,29 +212,16 @@ def test_dcn_stage_tp_hierarchical(tmp_path):
     stage's blocks over its local devices (--stage-tp). Numerical equality
     of the TP block against the plain block is covered by
     tests/test_tensor_parallel.py; this exercises the full runtime path."""
-    addrs = ",".join(f"127.0.0.1:{p}" for p in _free_ports(2))
-    common = [sys.executable, os.path.join(REPO, "runtime.py")]
-    opts = ["-c", "dcn", "--platform", "cpu", "--stage-tp", "2",
-            "-m", "pipeedge/test-tiny-vit", "-b", "16", "-u", "4",
-            "-pt", "1,4,5,8", "-q", "8,0", "-r", "0,1",
-            "--dcn-addrs", addrs, "--sched-timeout", "180"]
-    env = dict(os.environ, PYTHONPATH=REPO,
-               XLA_FLAGS="--xla_force_host_platform_device_count=2")
-    worker = subprocess.Popen(common + ["1", "2"] + opts, cwd=tmp_path,
-                              env=env, stdout=subprocess.PIPE,
-                              stderr=subprocess.STDOUT, text=True)
-    try:
-        data = subprocess.run(common + ["0", "2"] + opts, cwd=tmp_path,
-                              env=env, capture_output=True, text=True,
-                              timeout=300)
-        wout, _ = worker.communicate(timeout=60)
-    finally:
-        worker.kill()
+    data, wouts, _ = _run_fleet(
+        tmp_path, ["-c", "dcn", "--platform", "cpu", "--stage-tp", "2",
+                   "-m", "pipeedge/test-tiny-vit", "-b", "16", "-u", "4",
+                   "-pt", "1,4,5,8", "-q", "8,0", "-r", "0,1",
+                   "--sched-timeout", "180"], world=2,
+        env_extra={"XLA_FLAGS": "--xla_force_host_platform_device_count=2"})
     assert data.returncode == 0, data.stdout + data.stderr
     assert "latency_sec=" in data.stdout
     assert "TP-sharded over 2 local devices" in data.stdout + data.stderr
-    assert worker.returncode == 0, wout
-    assert "TP-sharded over 2 local devices" in wout
+    assert "TP-sharded over 2 local devices" in wouts[0]
 
 
 def test_tp_stage_matches_plain_stage():
@@ -299,38 +260,18 @@ def test_multi_round_shifting_fleet(tmp_path):
     all change between rounds, with adaptive quantization and TP-sharded
     stages throughout. Round 2 runs single-stage on a rank that was idle in
     round 1; round 3 swaps the rank order of round 1."""
-    addrs = ",".join(f"127.0.0.1:{p}" for p in _free_ports(4))
-    common = [sys.executable, os.path.join(REPO, "runtime.py")]
-    opts = ["-c", "dcn", "--platform", "cpu", "--stage-tp", "2",
-            "-m", "pipeedge/test-tiny-vit", "-b", "24", "-u", "4",
-            "-pt", "1,4,5,8;1,8;1,4,5,8", "-q", "8,0;0;4,0",
-            "-r", "0,1;2;1,0", "--dcn-addrs", addrs,
-            "--sched-timeout", "180"]
-    env = dict(os.environ, PYTHONPATH=REPO, ADAPTIVE_QUANT="HEURISTIC",
-               SEND_CONSTRAINT="100", WINDOW_SIZE="3",
-               XLA_FLAGS="--xla_force_host_platform_device_count=2")
-    rank_dirs = []
-    for r in range(4):
-        d = tmp_path / f"rank{r}"
-        d.mkdir()
-        rank_dirs.append(d)
-    workers = [subprocess.Popen(common + [str(r), "4"] + opts,
-                                cwd=rank_dirs[r], env=env,
-                                stdout=subprocess.PIPE,
-                                stderr=subprocess.STDOUT, text=True)
-               for r in (1, 2, 3)]
-    try:
-        data = subprocess.run(common + ["0", "4"] + opts, cwd=rank_dirs[0],
-                              env=env, capture_output=True, text=True,
-                              timeout=420)
-        wouts = [w.communicate(timeout=60)[0] for w in workers]
-    finally:
-        for w in workers:
-            w.kill()
+    data, wouts, _ = _run_fleet(
+        tmp_path, ["-c", "dcn", "--platform", "cpu", "--stage-tp", "2",
+                   "-m", "pipeedge/test-tiny-vit", "-b", "24", "-u", "4",
+                   "-pt", "1,4,5,8;1,8;1,4,5,8", "-q", "8,0;0;4,0",
+                   "-r", "0,1;2;1,0", "--sched-timeout", "180"], world=4,
+        env_extra={"ADAPTIVE_QUANT": "HEURISTIC", "SEND_CONSTRAINT": "100",
+                   "WINDOW_SIZE": "3",
+                   "XLA_FLAGS": "--xla_force_host_platform_device_count=2"},
+        per_rank_dirs=True, data_timeout=420)
     assert data.returncode == 0, data.stdout + data.stderr
     assert data.stdout.count("latency_sec=") == 3, data.stdout
-    for r, wout in zip((1, 2, 3), wouts):
-        assert workers[r - 1].returncode == 0, f"rank {r}:\n{wout}"
+    for wout in wouts:
         assert "Traceback" not in wout, wout
     # rank 2 idles in round 1, runs the whole model in round 2
     assert "not in schedule; idling" in wouts[1]
