@@ -1,0 +1,176 @@
+"""Expert parallelism: a switch-routed FFN with experts sharded over an
+'ep' mesh axis.
+
+NEW capability beyond the reference (SURVEY.md §2.4: PipeEdge has no MoE
+models, so expert parallelism is n/a there). This module provides the
+mesh-axis mechanics so an MoE block composes with the pipeline the same way
+tp/sp do: parameters shard over 'ep' (each device owns n_experts/n local
+experts), tokens are routed top-1 with a fixed per-expert capacity (static
+shapes — XLA requirement), each device computes only its own experts'
+tokens, and one `psum` combines the expert outputs.
+
+Routing semantics (Switch Transformer style, top-1):
+- router logits [T, E] -> softmax -> each token's expert + gate weight;
+- per expert, the C highest-probability tokens assigned to it are kept
+  (C = capacity_factor * T / E, rounded up); overflow tokens pass through
+  unchanged (the standard capacity-drop residual behavior).
+
+Exactness: `ep_ffn` over an n-device 'ep' axis matches the single-device
+reference (`reference_moe_ffn`) to float tolerance (the distributed
+combine re-associates one add) — tested in tests/test_expert.py.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.layers import TransformerConfig, gelu
+
+
+def init_moe_params(cfg: TransformerConfig, n_experts: int,
+                    seed: int = 0) -> Dict:
+    """Router + per-expert MLP params (expert axis leading)."""
+    rng = np.random.default_rng(seed)
+    d, f = cfg.hidden_size, cfg.intermediate_size
+
+    def glorot(*shape):
+        fan = shape[-2] + shape[-1]
+        return jnp.asarray(rng.normal(0, math.sqrt(2.0 / fan), shape),
+                           jnp.float32)
+
+    return {
+        "router": {"w": glorot(d, n_experts),
+                   "b": jnp.zeros((n_experts,), jnp.float32)},
+        "experts": {
+            "mlp_up": {"w": glorot(n_experts, d, f),
+                       "b": jnp.zeros((n_experts, f), jnp.float32)},
+            "mlp_down": {"w": glorot(n_experts, f, d),
+                         "b": jnp.zeros((n_experts, d), jnp.float32)},
+        },
+    }
+
+
+def _routing(router, x, n_experts: int, capacity: int):
+    """Top-1 routing with per-expert capacity.
+
+    Returns (expert_of_token [T], gate [T], keep [E, C] token indices,
+    kept [E, C] validity) — deterministic, static shapes."""
+    t = x.shape[0]
+    logits = x @ router["w"] + router["b"]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gate = jnp.max(probs, axis=-1)            # [T]
+    expert = jnp.argmax(probs, axis=-1)       # [T]
+    # per expert: the C highest-gate tokens assigned to it
+    assigned = jnp.where(expert[None, :] == jnp.arange(n_experts)[:, None],
+                         gate[None, :], -jnp.inf)          # [E, T]
+    top_gate, keep = jax.lax.top_k(assigned, capacity)     # [E, C]
+    kept = jnp.isfinite(top_gate)
+    return expert, gate, keep, kept
+
+
+def reference_moe_ffn(params: Dict, x: jax.Array, n_experts: int,
+                      capacity_factor: float = 1.25) -> jax.Array:
+    """Single-device oracle: identical routing, experts applied in a loop."""
+    b, s, d = x.shape
+    tokens = x.reshape(-1, d)
+    t = tokens.shape[0]
+    capacity = max(1, min(t, math.ceil(capacity_factor * t / n_experts)))
+    _, gate, keep, kept = _routing(params["router"], tokens, n_experts,
+                                   capacity)
+    out = tokens  # capacity-dropped tokens pass through (residual)
+    for e in range(n_experts):
+        ids = keep[e]
+        xe = tokens[ids]
+        up = gelu(xe @ params["experts"]["mlp_up"]["w"][e]
+                  + params["experts"]["mlp_up"]["b"][e])
+        ye = up @ params["experts"]["mlp_down"]["w"][e] \
+            + params["experts"]["mlp_down"]["b"][e]
+        ye = ye * gate[ids][:, None] + tokens[ids]
+        out = out.at[ids].set(jnp.where(kept[e][:, None], ye, out[ids]))
+    return out.reshape(b, s, d)
+
+
+def _ep_local(params: Dict, x: jax.Array, *, n_experts: int,
+              capacity: int, axis: str) -> jax.Array:
+    """Per-device body under shard_map: local experts [E/n, ...], tokens
+    replicated; each device computes its experts' capacity slots and a psum
+    combines."""
+    n = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    e_local = n_experts // n
+    b, s, d = x.shape
+    tokens = x.reshape(-1, d)
+    _, gate, keep, kept = _routing(params["router"], tokens, n_experts,
+                                   capacity)
+    # this device's expert rows in the global routing tables
+    first = idx * e_local
+    my_keep = jax.lax.dynamic_slice_in_dim(keep, first, e_local, axis=0)
+    my_kept = jax.lax.dynamic_slice_in_dim(kept, first, e_local, axis=0)
+
+    def one_expert(w_up, b_up, w_down, b_down, ids, valid):
+        xe = tokens[ids]
+        up = gelu(xe @ w_up + b_up)
+        ye = up @ w_down + b_down
+        delta = (ye * gate[ids][:, None] + tokens[ids]) - tokens[ids]
+        return jnp.where(valid[:, None], delta, 0.0), ids
+
+    deltas, ids = jax.vmap(one_expert)(
+        params["experts"]["mlp_up"]["w"], params["experts"]["mlp_up"]["b"],
+        params["experts"]["mlp_down"]["w"],
+        params["experts"]["mlp_down"]["b"], my_keep, my_kept)
+    # scatter-add local expert deltas, then combine across the ep axis
+    local = jnp.zeros_like(tokens).at[ids.reshape(-1)].add(
+        deltas.reshape(-1, d))
+    combined = jax.lax.psum(local, axis)
+    return (tokens + combined).reshape(b, s, d)
+
+
+def make_ep_ffn_fn(cfg: TransformerConfig, mesh: Mesh, n_experts: int,
+                   capacity_factor: float = 1.25, axis: str = "ep"):
+    """Jitted `fn(params, x) -> x`: switch-FFN with experts sharded over
+    `axis`. Place params with `shard_moe_params` first. Token count must be
+    static per call (standard XLA); capacity derives from it."""
+    n = mesh.shape[axis]
+    if n_experts % n:
+        raise ValueError(f"n_experts ({n_experts}) must divide by the ep "
+                         f"axis size ({n})")
+
+    param_specs = {
+        "router": {"w": P(), "b": P()},
+        "experts": {
+            "mlp_up": {"w": P(axis), "b": P(axis)},
+            "mlp_down": {"w": P(axis), "b": P(axis)},
+        },
+    }
+
+    def fn(params, x):
+        b, s, _ = x.shape
+        capacity = max(1, min(b * s,
+                              math.ceil(capacity_factor * b * s
+                                        / n_experts)))
+        body = jax.shard_map(
+            partial(_ep_local, n_experts=n_experts, capacity=capacity,
+                    axis=axis),
+            mesh=mesh, in_specs=(param_specs, P()), out_specs=P(),
+            check_vma=False)
+        return body(params, x)
+
+    return jax.jit(fn)
+
+
+def shard_moe_params(params: Dict, mesh: Mesh, axis: str = "ep") -> Dict:
+    """Place MoE params: experts sharded over `axis`, router replicated."""
+    def put(x, spec):
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return {
+        "router": {k: put(v, P()) for k, v in params["router"].items()},
+        "experts": jax.tree_util.tree_map(
+            lambda v: put(v, P(axis)), params["experts"]),
+    }

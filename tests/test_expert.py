@@ -1,0 +1,70 @@
+"""Expert parallelism: the ep-sharded switch-FFN must match the
+single-device routing oracle exactly, and capacity overflow must drop to
+the residual path."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from pipeedge_tpu.models.layers import TransformerConfig
+from pipeedge_tpu.parallel import expert as ep_mod
+
+CFG = TransformerConfig(model_type="vit", hidden_size=32,
+                        num_hidden_layers=1, num_attention_heads=4,
+                        intermediate_size=64, num_labels=0, image_size=16,
+                        patch_size=4)
+
+
+@pytest.mark.parametrize("n_ep", [2, 4])
+def test_ep_ffn_matches_reference(n_ep):
+    n_experts = 8
+    params = ep_mod.init_moe_params(CFG, n_experts, seed=1)
+    x = jnp.asarray(np.random.default_rng(2).normal(size=(2, 16, 32)),
+                    jnp.float32)
+    expected = np.asarray(ep_mod.reference_moe_ffn(params, x, n_experts))
+    mesh = Mesh(np.asarray(jax.devices()[:n_ep]), ("ep",))
+    fn = ep_mod.make_ep_ffn_fn(CFG, mesh, n_experts)
+    got = np.asarray(fn(ep_mod.shard_moe_params(params, mesh), x))
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_ep_capacity_drops_to_residual():
+    """With capacity_factor small enough, overflow tokens must pass through
+    unchanged (the switch-style residual drop)."""
+    n_experts = 4
+    params = ep_mod.init_moe_params(CFG, n_experts, seed=3)
+    x = jnp.asarray(np.random.default_rng(4).normal(size=(1, 32, 32)),
+                    jnp.float32)
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("ep",))
+    fn = ep_mod.make_ep_ffn_fn(CFG, mesh, n_experts, capacity_factor=0.125)
+    out = np.asarray(fn(ep_mod.shard_moe_params(params, mesh), x))
+    # capacity = ceil(0.125 * 32 / 4) = 1 slot per expert -> at most
+    # n_experts tokens transformed; everyone else must be untouched
+    changed = (np.abs(out - np.asarray(x)) > 1e-7).any(axis=-1).sum()
+    assert 0 < changed <= n_experts, changed
+    ref = np.asarray(ep_mod.reference_moe_ffn(params, x, n_experts,
+                                              capacity_factor=0.125))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_ep_requires_divisible_experts():
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("ep",))
+    with pytest.raises(ValueError, match="must divide"):
+        ep_mod.make_ep_ffn_fn(CFG, mesh, n_experts=6)
+
+
+def test_ep_capacity_clamps_to_token_count():
+    """capacity_factor large enough that per-expert capacity exceeds the
+    token count must clamp, not crash top_k."""
+    n_experts = 2
+    params = ep_mod.init_moe_params(CFG, n_experts, seed=5)
+    x = jnp.asarray(np.random.default_rng(6).normal(size=(1, 16, 32)),
+                    jnp.float32)
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("ep",))
+    fn = ep_mod.make_ep_ffn_fn(CFG, mesh, n_experts, capacity_factor=8.0)
+    got = np.asarray(fn(ep_mod.shard_moe_params(params, mesh), x))
+    ref = np.asarray(ep_mod.reference_moe_ffn(params, x, n_experts,
+                                              capacity_factor=8.0))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
