@@ -67,9 +67,33 @@ def _model_flops_per_image(cfg) -> float:
     return l * per_block + embed + head
 
 
+def _require_live_backend(timeout_s: float = 180.0) -> None:
+    """Fail fast (with a diagnosable JSON line) if the backend cannot run a
+    trivial computation within `timeout_s` — a wedged/held tunnel lease
+    otherwise hangs the whole bench with no output."""
+    import os
+    import threading
+
+    done = threading.Event()
+
+    def probe():
+        float(jnp.ones((2, 2)).sum())
+        done.set()
+
+    threading.Thread(target=probe, daemon=True).start()
+    if not done.wait(timeout=timeout_s):
+        print(json.dumps({
+            "metric": "vit_large_images_per_sec_b8", "value": 0,
+            "unit": "images/sec", "vs_baseline": 0,
+            "error": f"backend unresponsive after {timeout_s}s (TPU tunnel "
+                     "lease held/wedged?)"}), flush=True)
+        os._exit(1)
+
+
 def main():
     from pipeedge_tpu.models import registry
 
+    _require_live_backend()
     name = "google/vit-large-patch16-224"
     cfg = registry.get_model_entry(name).config
     fn, params, _ = registry.module_shard_factory(
