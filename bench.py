@@ -75,18 +75,24 @@ def _require_live_backend(timeout_s: float = 180.0) -> None:
     import threading
 
     done = threading.Event()
+    failure = []
 
     def probe():
-        float(jnp.ones((2, 2)).sum())
+        try:
+            float(jnp.ones((2, 2)).sum())
+        except Exception as exc:  # noqa: BLE001 - reported verbatim below
+            failure.append(f"{exc.__class__.__name__}: {exc}")
         done.set()
 
     threading.Thread(target=probe, daemon=True).start()
-    if not done.wait(timeout=timeout_s):
+    if not done.wait(timeout=timeout_s) or failure:
+        reason = failure[0] if failure else (
+            f"backend unresponsive after {timeout_s}s (TPU tunnel lease "
+            "held/wedged?)")
         print(json.dumps({
             "metric": "vit_large_images_per_sec_b8", "value": 0,
             "unit": "images/sec", "vs_baseline": 0,
-            "error": f"backend unresponsive after {timeout_s}s (TPU tunnel "
-                     "lease held/wedged?)"}), flush=True)
+            "error": reason}), flush=True)
         os._exit(1)
 
 

@@ -117,7 +117,7 @@ def _ep_local(params: Dict, x: jax.Array, *, n_experts: int,
         xe = tokens[ids]
         up = gelu(xe @ w_up + b_up)
         ye = up @ w_down + b_down
-        delta = (ye * gate[ids][:, None] + tokens[ids]) - tokens[ids]
+        delta = ye * gate[ids][:, None]  # the token's residual stays put
         return jnp.where(valid[:, None], delta, 0.0), ids
 
     deltas, ids = jax.vmap(one_expert)(
