@@ -80,18 +80,31 @@ def time_shard_fn(fn, params, payload, iterations: int, warmup: bool = True) -> 
     return best / iterations
 
 
+def _compile_and_analyze(fn, params, payload) -> Tuple[Optional[Any], int]:
+    """AOT-compile `fn` once (registry fns are already jitted); return the
+    compiled executable (None if lowering unsupported) and its temp-buffer
+    bytes. The caller can execute the returned executable directly, so the
+    same compilation serves memory analysis and the forward pass."""
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    try:
+        compiled = jitted.lower(params, payload).compile()
+    except Exception as exc:  # AOT path availability varies by backend
+        logger.debug("AOT compile unavailable: %s", exc)
+        return None, 0
+    temp = 0
+    try:
+        analysis = compiled.memory_analysis()
+        if analysis is not None:
+            temp = int(getattr(analysis, "temp_size_in_bytes", 0))
+    except Exception as exc:  # memory_analysis availability varies by backend
+        logger.debug("memory_analysis unavailable: %s", exc)
+    return compiled, temp
+
+
 def shard_memory_bytes(fn, params, payload) -> int:
     """Memory footprint: exact parameter bytes + compiled temp buffers."""
     from .models import params_bytes
-    total = params_bytes(params)
-    try:
-        compiled = jax.jit(fn).lower(params, payload).compile()
-        analysis = compiled.memory_analysis()
-        if analysis is not None:
-            total += int(getattr(analysis, "temp_size_in_bytes", 0))
-    except Exception as exc:  # memory_analysis availability varies by backend
-        logger.debug("memory_analysis unavailable: %s", exc)
-    return total
+    return params_bytes(params) + _compile_and_analyze(fn, params, payload)[1]
 
 
 def default_inputs(model_name: str, batch_size: int,
@@ -108,21 +121,68 @@ def default_inputs(model_name: str, batch_size: int,
         dtype=dtype)
 
 
+def _struct_sig(tree) -> Tuple:
+    """Hashable structural signature of a pytree: treedef + leaf shapes/dtypes."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return (treedef, tuple((tuple(l.shape), str(l.dtype)) for l in leaves))
+
+
+def _measure_layer(fn, params, payload, iterations: int, warmup: bool,
+                   ) -> Tuple[float, int, Any]:
+    """(avg seconds, memory bytes, output payload) for one layer shard.
+    One timing compile (the scan) + one AOT compile shared between memory
+    analysis and the chained forward."""
+    from .models import params_bytes
+    t = time_shard_fn(fn, params, payload, iterations, warmup=warmup)
+    compiled, temp = _compile_and_analyze(fn, params, payload)
+    mem = params_bytes(params) + temp
+    out = compiled(params, payload) if compiled is not None else fn(params, payload)
+    return t, mem, out
+
+
 def profile_layers_individually(model_name: str, model_file: Optional[str],
                                 inputs, layer_start: int, layer_end: int,
                                 warmup: bool, iterations: int,
-                                dtype=jnp.float32) -> List[Dict[str, Any]]:
+                                dtype=jnp.float32,
+                                reuse_identical: bool = True,
+                                ) -> List[Dict[str, Any]]:
     """Profile each layer separately, chaining outputs into the next layer's
-    inputs (reference profiler.py:133-145)."""
+    inputs (reference profiler.py:133-145).
+
+    With `reuse_identical` (default), layers whose computation is structurally
+    identical to an already-measured one — same sublayer kind ((layer-1) % 4,
+    the repo-wide 4-sublayers-per-block convention), same head/tail role, and
+    same input shapes — reuse that measurement instead of re-building,
+    re-compiling, and re-timing. All registered models have homogeneous
+    blocks (scalar HF hidden/intermediate sizes), so this key also pins the
+    parameter shapes; a cache hit therefore skips the factory entirely (no
+    per-layer weight materialization or host->device transfer). Transformer
+    blocks repeat every 4 sublayers, so a 96-layer ViT-Large profile needs
+    only ~6 real measurements. Timing on XLA is weight- and value-independent
+    for these shards (no data-dependent control flow), so this is exact, and
+    it matters on tunneled TPU backends where every avoided compile costs
+    seconds. `--exhaustive` (CLI) restores the reference's measure-every-layer
+    behavior.
+    """
     results = []
     payload = inputs
+    model_layers = registry.get_model_layers(model_name)
+    cache: Dict[Tuple, Tuple[float, int, Any]] = {}
     for layer in range(layer_start, layer_end + 1):
-        fn, params, _ = registry.module_shard_factory(
-            model_name, model_file, layer, layer, dtype=dtype)
         shape_in = _payload_shapes(payload)
-        t = time_shard_fn(fn, params, payload, iterations, warmup=warmup)
-        mem = shard_memory_bytes(fn, params, payload)
-        out = fn(params, payload)
+        key = ((layer - 1) % 4, layer == 1, layer == model_layers,
+               _struct_sig(payload))
+        hit = cache.get(key) if reuse_identical else None
+        if hit is not None:
+            t, mem, out = hit
+            note = " (reused: identical structure)"
+        else:
+            fn, params, _ = registry.module_shard_factory(
+                model_name, model_file, layer, layer, dtype=dtype)
+            t, mem, out = _measure_layer(fn, params, payload, iterations,
+                                         warmup)
+            cache[key] = (t, mem, out)
+            note = ""
         results.append({
             "layer": layer,
             "time": float(t),
@@ -130,7 +190,8 @@ def profile_layers_individually(model_name: str, model_file: Optional[str],
             "shape_in": shape_in,
             "shape_out": _payload_shapes(out),
         })
-        logger.info("layer %d: %.6f s, %.2f MB", layer, t, results[-1]["memory"])
+        logger.info("layer %d: %.6f s, %.2f MB%s", layer, t,
+                    results[-1]["memory"], note)
         payload = out
     return results
 

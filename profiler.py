@@ -48,6 +48,10 @@ def main():
     parser.add_argument("--no-warmup", action="store_false", dest="warmup")
     parser.add_argument("-i", "--iterations", default=16, type=int,
                         help="iterations to average runtime over")
+    parser.add_argument("--exhaustive", action="store_true",
+                        help="measure every layer even when structurally "
+                             "identical to an already-measured one (the "
+                             "default reuses such measurements)")
     parser.add_argument("--trace", type=str, default=None, metavar="DIR",
                         help="capture a JAX profiler trace of the measured "
                              "forwards into DIR")
@@ -89,7 +93,8 @@ def main():
     with tracing.trace(args.trace):
         results = prof.profile_layers_individually(
             args.model_name, args.model_file, inputs, args.layer_start,
-            layer_end, args.warmup, args.iterations, dtype=dtype)
+            layer_end, args.warmup, args.iterations, dtype=dtype,
+            reuse_identical=not args.exhaustive)
 
     profile_results["profile_data"].extend(results)
     profile_results["profile_data"].sort(key=lambda pd: pd["layer"])

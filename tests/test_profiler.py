@@ -25,7 +25,7 @@ def profile_results():
     inputs = prof.default_inputs(MODEL, 2)
     results = prof.profile_layers_individually(
         MODEL, None, inputs, 1, registry.get_model_layers(MODEL),
-        warmup=True, iterations=2)
+        warmup=True, iterations=2, reuse_identical=False)
     return {
         "model_name": MODEL,
         "dtype": "float32",
@@ -51,6 +51,31 @@ def test_profile_schema_and_chaining(profile_results):
     # first input: image dims; last output: logits
     assert data[0]["shape_in"] == [[3, 16, 16]]
     assert data[-1]["shape_out"] == [[5]]
+
+
+def test_reuse_identical_matches_exhaustive(profile_results, monkeypatch):
+    """Structural memoization measures only the unique layer computations
+    (embed+block sublayers+tail) and reproduces the exhaustive schema."""
+    measured = []
+    real = prof.time_shard_fn
+
+    def counting(fn, params, payload, iterations, warmup=True):
+        measured.append(1)
+        return real(fn, params, payload, iterations, warmup=warmup)
+
+    monkeypatch.setattr(prof, "time_shard_fn", counting)
+    inputs = prof.default_inputs(MODEL, 2)
+    results = prof.profile_layers_individually(
+        MODEL, None, inputs, 1, registry.get_model_layers(MODEL),
+        warmup=True, iterations=2)
+    # 8 layers = 2 blocks: layer 1 (embed+type0), types 1-3, type0 bare,
+    # layer 8 (type3+tail) -> 6 unique computations
+    assert len(measured) == 6
+    exhaustive = profile_results["profile_data"]
+    assert [(d["layer"], d["shape_in"], d["shape_out"], d["memory"])
+            for d in results] == \
+           [(d["layer"], d["shape_in"], d["shape_out"], d["memory"])
+            for d in exhaustive]
 
 
 def test_validate_profile_results(profile_results):
