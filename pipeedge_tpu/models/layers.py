@@ -20,7 +20,7 @@ class TransformerConfig:
     """Static model hyperparameters (stands in for HF `AutoConfig`, which the
     reference fetches over the network — model_cfg.py:57-66; here configs are
     local constants so the framework runs with zero egress)."""
-    model_type: str              # 'vit' | 'bert' | 'deit'
+    model_type: str              # 'vit' | 'bert' | 'deit' | 'gpt2'
     hidden_size: int
     num_hidden_layers: int       # transformer blocks (sublayers = 4x this)
     num_attention_heads: int
@@ -73,9 +73,18 @@ def _use_fused_attention(seq_len: int) -> bool:
     return jax.default_backend() == "tpu" and seq_len >= 1024
 
 
+def apply_causal_mask(scores: jax.Array) -> jax.Array:
+    """Mask strictly-future key positions in [..., S_q, S_k] scores
+    (shared by the XLA attention path and the TP block bodies)."""
+    s_q, s_k = scores.shape[-2], scores.shape[-1]
+    q_pos = jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 0)
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 1)
+    return jnp.where(k_pos <= q_pos, scores, -1e30)
+
+
 def self_attention(p, x: jax.Array, num_heads: int,
                    mask: Optional[jax.Array] = None,
-                   core_fn=None) -> jax.Array:
+                   core_fn=None, causal: bool = False) -> jax.Array:
     """Multi-head self-attention context (pre-projection), batched over [B,S,D].
 
     Matches HF `{ViT,Bert}SelfAttention` semantics: returns the concatenated
@@ -83,9 +92,15 @@ def self_attention(p, x: jax.Array, num_heads: int,
     (reference vit.py:58-63). Softmax in float32. On TPU the
     softmax(QK^T)V core runs as a fused Pallas kernel (ops/attention.py).
 
+    `causal` applies a lower-triangular mask (decoder families, e.g. GPT-2);
+    the fused kernel handles it natively (and skips past-frontier K/V
+    blocks), so the long-sequence perf path covers decoders too.
+
     `core_fn(q, k, v) -> ctx` ([B,S,H,D]-shaped) overrides the attention
     core while reusing THIS projection code — how sequence-parallel
-    execution swaps in ring attention (parallel/spmd.py).
+    execution swaps in ring attention (parallel/spmd.py). A core_fn is
+    responsible for its own causal masking (ring/Ulysses attention take a
+    `causal` flag), so `causal` is ignored on that path.
     """
     b, s, d = x.shape
     hd = d // num_heads
@@ -101,10 +116,12 @@ def self_attention(p, x: jax.Array, num_heads: int,
         return core_fn(q, k, v).reshape(b, s, d)
     if mask is None and _use_fused_attention(s):
         from ..ops.attention import fused_attention
-        return fused_attention(q, k, v).reshape(b, s, d)
+        return fused_attention(q, k, v, causal=causal).reshape(b, s, d)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32)
     scores = scores / jnp.sqrt(jnp.float32(hd))
+    if causal:
+        scores = apply_causal_mask(scores)
     if mask is not None:
         # mask: [B, S] with 1 = attend, 0 = ignore
         bias = jnp.where(mask[:, None, None, :] > 0, 0.0, -1e9).astype(jnp.float32)
@@ -118,6 +135,11 @@ def self_attention(p, x: jax.Array, num_heads: int,
 def gelu(x: jax.Array) -> jax.Array:
     """Exact (erf) GeLU, matching torch `nn.GELU()` default used by HF."""
     return jax.nn.gelu(x, approximate=False)
+
+
+def gelu_new(x: jax.Array) -> jax.Array:
+    """Tanh-approximate GeLU, matching HF `gelu_new` (GPT-2's activation)."""
+    return jax.nn.gelu(x, approximate=True)
 
 
 def patchify(x: jax.Array, patch: int) -> jax.Array:

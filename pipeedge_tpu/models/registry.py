@@ -1,6 +1,7 @@
 """Model registry and shard factories (parity with /root/reference/model_cfg.py).
 
-Same 9 supported models and layer counts (model_cfg.py:24-43); layer counts
+Same 9 supported models and layer counts (model_cfg.py:24-43), plus a
+causal-decoder family (GPT-2/GPT-2-medium) the reference lacks; layer counts
 are in sublayers (4 per transformer block). Unlike the reference, model
 configs are local constants rather than `AutoConfig.from_pretrained` network
 fetches (model_cfg.py:57-66), so everything works with zero egress; the
@@ -22,6 +23,7 @@ from .layers import TransformerConfig
 from .shard import make_shard_fn, unstack_blocks
 from . import bert as bert_mod
 from . import deit as deit_mod
+from . import gpt2 as gpt2_mod
 from . import vit as vit_mod
 
 logger = logging.getLogger(__name__)
@@ -57,6 +59,15 @@ def _deit(name, layers, weights, hidden, blocks, heads, inter):
         num_attention_heads=heads, intermediate_size=inter, num_labels=1000))
 
 
+def _gpt2(name, layers, weights, hidden, blocks, heads, inter,
+          vocab=50257, max_pos=1024):
+    return ModelEntry(name, layers, weights, gpt2_mod, TransformerConfig(
+        model_type="gpt2", hidden_size=hidden, num_hidden_layers=blocks,
+        num_attention_heads=heads, intermediate_size=inter,
+        layer_norm_eps=1e-5, vocab_size=vocab,
+        max_position_embeddings=max_pos))
+
+
 _MODELS: Dict[str, ModelEntry] = {e.name: e for e in [
     _vit("google/vit-base-patch16-224", 48, "ViT-B_16-224.npz", 768, 12, 12, 3072, 1000),
     _vit("google/vit-large-patch16-224", 96, "ViT-L_16-224.npz", 1024, 24, 16, 4096, 1000),
@@ -71,10 +82,15 @@ _MODELS: Dict[str, ModelEntry] = {e.name: e for e in [
           384, 12, 6, 1536),
     _deit("facebook/deit-tiny-distilled-patch16-224", 48, "DeiT_T_distilled.npz",
           192, 12, 3, 768),
+    # causal-decoder family: beyond the reference's encoder-only list
+    _gpt2("gpt2", 48, "GPT2.npz", 768, 12, 12, 3072),
+    _gpt2("gpt2-medium", 96, "GPT2-M.npz", 1024, 24, 16, 4096),
     # tiny synthetic models for fast tests / CI (not in the reference's list)
     _vit("pipeedge/test-tiny-vit", 8, "test-tiny-vit.npz", 32, 2, 4, 64, 5,
          patch=4, img=16),
     _bert("pipeedge/test-tiny-bert", 8, "test-tiny-bert.npz", 32, 2, 4, 64, 2),
+    _gpt2("pipeedge/test-tiny-gpt2", 8, "test-tiny-gpt2.npz", 32, 2, 4, 64,
+          vocab=100, max_pos=64),
 ]}
 
 

@@ -26,16 +26,20 @@ _NEG_INF = -1e30
 
 
 def _attention_kernel(q_ref, k_ref, v_ref, o_ref, *, kv_block: int,
-                      scale: float, valid_len: int):
+                      scale: float, valid_len: int, causal: bool = False):
     """One (batch*head, q-block) cell: stream K/V blocks with online softmax.
 
     `valid_len` masks zero-padded key positions (sequence lengths are padded
-    to the TPU sublane multiple of 8 by the wrapper).
+    to the TPU sublane multiple of 8 by the wrapper). `causal` additionally
+    masks future keys and skips K/V blocks entirely past this q-block's
+    causal frontier (the streaming loop stops early, so the lower-triangle
+    work is ~halved).
     """
     q = q_ref[0].astype(jnp.float32)          # [q_blk, D]
     seq_len = k_ref.shape[1]
     n_kv = seq_len // kv_block
     q_blk = q.shape[0]
+    q_start = pl.program_id(1) * q_blk
 
     def body(i, carry):
         m_prev, l_prev, acc = carry
@@ -44,6 +48,12 @@ def _attention_kernel(q_ref, k_ref, v_ref, o_ref, *, kv_block: int,
         scores = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale     # [q_blk, kv_blk]
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (q_blk, kv_block), 0)
+            k_pos = i * kv_block + jax.lax.broadcasted_iota(
+                jnp.int32, (q_blk, kv_block), 1)
+            scores = jnp.where(k_pos <= q_pos, scores, _NEG_INF)
         if valid_len != seq_len:
             k_pos = i * kv_block + jax.lax.broadcasted_iota(
                 jnp.int32, (q_blk, kv_block), 1)
@@ -61,7 +71,13 @@ def _attention_kernel(q_ref, k_ref, v_ref, o_ref, *, kv_block: int,
     m0 = jnp.full((q_blk,), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((q_blk,), jnp.float32)
     acc0 = jnp.zeros((q_blk, q_ref.shape[2]), jnp.float32)
-    _, l, acc = jax.lax.fori_loop(0, n_kv, body, (m0, l0, acc0))
+    if causal:
+        # last K/V block any row of this q-block may attend to
+        n_kv_eff = jnp.minimum((q_start + q_blk + kv_block - 1) // kv_block,
+                               n_kv)
+    else:
+        n_kv_eff = n_kv
+    _, l, acc = jax.lax.fori_loop(0, n_kv_eff, body, (m0, l0, acc0))
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
 
 
@@ -76,9 +92,11 @@ def _pick_block(seq_len: int, preferred: int) -> int:
     return seq_len
 
 
-@functools.partial(jax.jit, static_argnames=("q_block", "kv_block", "interpret"))
+@functools.partial(jax.jit, static_argnames=("q_block", "kv_block",
+                                             "causal", "interpret"))
 def fused_attention_bhsd(q: jax.Array, k: jax.Array, v: jax.Array,
                          q_block: int = 128, kv_block: int = 128,
+                         causal: bool = False,
                          interpret: bool = False) -> jax.Array:
     """Fused attention over [BH, S, D] tensors (already head-flattened)."""
     bh, seq_len, d = q.shape
@@ -95,7 +113,7 @@ def fused_attention_bhsd(q: jax.Array, k: jax.Array, v: jax.Array,
     kv_blk = _pick_block(s_pad, kv_block)
     grid = (bh, s_pad // q_blk)
     kernel = functools.partial(_attention_kernel, kv_block=kv_blk, scale=scale,
-                               valid_len=seq_len)
+                               valid_len=seq_len, causal=causal)
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -113,7 +131,7 @@ def fused_attention_bhsd(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def fused_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     q_block: int = 128, kv_block: int = 128,
-                    interpret: bool = False) -> jax.Array:
+                    causal: bool = False, interpret: bool = False) -> jax.Array:
     """Fused attention over [B, S, H, D] tensors; returns the same layout."""
     b, s, h, d = q.shape
 
@@ -121,7 +139,8 @@ def fused_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
     out = fused_attention_bhsd(flat(q), flat(k), flat(v), q_block=q_block,
-                               kv_block=kv_block, interpret=interpret)
+                               kv_block=kv_block, causal=causal,
+                               interpret=interpret)
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 

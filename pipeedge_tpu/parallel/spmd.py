@@ -219,9 +219,11 @@ class SpmdPipeline:
                 raise ValueError(f"unknown sp_kind {self.sp_kind!r} "
                                  "(ring | ulysses)")
 
-            def sp_attention(qkv, x, num_heads):
+            def sp_attention(qkv, x, num_heads, causal=False):
                 # reuse the family projection code; only the core changes
-                return self_attention(qkv, x, num_heads, core_fn=core)
+                # (ring/Ulysses cores handle causal masking themselves)
+                c = partial(core, causal=True) if causal else core
+                return self_attention(qkv, x, num_heads, core_fn=c)
 
             def block_apply(bp, x):
                 for sub in range(4):

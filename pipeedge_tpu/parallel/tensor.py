@@ -19,7 +19,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..models.layers import TransformerConfig, gelu, layer_norm
+from ..models.layers import (TransformerConfig, apply_causal_mask, gelu,
+                             layer_norm)
 
 
 def _shard_by_specs(params: Dict, specs: Dict, mesh: Mesh,
@@ -43,9 +44,10 @@ def shard_vit_block_params(params: Dict, mesh: Mesh, axis: str = "tp") -> Dict:
 
 
 def _tp_block_local(p: Dict, x: jax.Array, cfg: TransformerConfig,
-                    axis: str) -> jax.Array:
+                    axis: str, act=gelu, causal: bool = False) -> jax.Array:
     """Per-device block body under shard_map: local head/hidden slices +
-    two psums. `x` is replicated across the tp axis."""
+    two psums. `x` is replicated across the tp axis. Serves every pre-LN
+    family: ViT/DeiT as-is, GPT-2 via act=gelu_new + causal=True."""
     n = jax.lax.axis_size(axis)
     heads_local = cfg.num_attention_heads // n
     b, s, d = x.shape
@@ -63,6 +65,8 @@ def _tp_block_local(p: Dict, x: jax.Array, cfg: TransformerConfig,
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) / jnp.sqrt(
                             jnp.float32(hd))
+    if causal:
+        scores = apply_causal_mask(scores)
     probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
     ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v,
                      preferred_element_type=jnp.float32).astype(x.dtype)
@@ -76,7 +80,7 @@ def _tp_block_local(p: Dict, x: jax.Array, cfg: TransformerConfig,
     normed = layer_norm(p["ln_after"], x, cfg.layer_norm_eps)
     up = jnp.dot(normed, p["mlp_up"]["w"].astype(x.dtype),
                  preferred_element_type=jnp.float32) + p["mlp_up"]["b"]
-    hidden = gelu(up.astype(x.dtype))
+    hidden = act(up.astype(x.dtype))
     down = jnp.dot(hidden, p["mlp_down"]["w"].astype(x.dtype),
                    preferred_element_type=jnp.float32)
     down = jax.lax.psum(down, axis) + p["mlp_down"]["b"]
@@ -97,6 +101,10 @@ def family_tp_plan(cfg: TransformerConfig):
     body — goes through this, so adding a family is one edit."""
     if cfg.model_type == "bert":
         return _BERT_PARAM_SPECS, _tp_bert_block_local
+    if cfg.model_type == "gpt2":
+        from ..models.layers import gelu_new
+        return _VIT_PARAM_SPECS, partial(_tp_block_local, act=gelu_new,
+                                         causal=True)
     return _VIT_PARAM_SPECS, _tp_block_local
 
 

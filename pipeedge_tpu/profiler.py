@@ -113,8 +113,9 @@ def default_inputs(model_name: str, batch_size: int,
     (profiler.py:204-220: random images; tokenized input ids for BERT)."""
     cfg = registry.get_model_config(model_name)
     rng = np.random.default_rng(0)
-    if cfg.model_type == "bert":
-        ids = rng.integers(0, cfg.vocab_size, size=(batch_size, 512))
+    if cfg.vocab_size:  # token models: BERT (512-token refs) and GPT-2
+        seq = min(512, cfg.max_position_embeddings or 512)
+        ids = rng.integers(0, cfg.vocab_size, size=(batch_size, seq))
         return jnp.asarray(ids, dtype=jnp.int32)
     return jnp.asarray(rng.normal(size=(
         batch_size, cfg.num_channels, cfg.image_size, cfg.image_size)),

@@ -102,15 +102,19 @@ def handle_results(tensors) -> None:
     confidence."""
     outputs = np.asarray(tensors)
     n_items = get_microbatch_size(outputs, verify=True)
-    if label_queue.empty():
-        exp = np.exp(outputs - outputs.max(axis=-1, keepdims=True))
-        probs = exp / exp.sum(axis=-1, keepdims=True)
-        acc = float(probs.max(axis=-1).sum())
-    else:
-        ubatch_labels = label_queue.get()
+    # class labels only apply to [B, n_classes] outputs; per-token logits
+    # (causal LMs, [B, S, vocab]) fall back to softmax confidence. Pop the
+    # label queue either way so it stays in sync with the microbatch stream.
+    ubatch_labels = None if label_queue.empty() else label_queue.get()
+    if ubatch_labels is not None and outputs.ndim == 2:
         assert len(outputs) == len(ubatch_labels)
         pred = outputs.argmax(axis=-1)
         acc = int((pred == np.asarray(ubatch_labels)).sum())
+    else:
+        exp = np.exp(outputs - outputs.max(axis=-1, keepdims=True))
+        probs = exp / exp.sum(axis=-1, keepdims=True)
+        conf = probs.max(axis=-1)   # [B] or [B, S]
+        acc = float(conf.reshape(conf.shape[0], -1).mean(axis=1).sum())
     monitoring.iteration(MONITORING_KEY_OUTPUT, work=n_items, accuracy=acc,
                          safe=False)
     logger.debug("outputs is %s", outputs)
@@ -228,10 +232,10 @@ def load_dataset(dataset_cfg: dict, model_name: str, batch_size: int,
                 batch_size, shape=(cfg.num_channels, cfg.image_size,
                                    cfg.image_size),
                 n_labels=max(cfg.num_labels, 2))
-    elif cfg.model_type == 'bert':
+    elif cfg.vocab_size:  # token models: BERT and GPT-2
         dataset = data_utils.synthetic_token_dataset(
-            batch_size, seq_len=64, vocab_size=cfg.vocab_size or 30522,
-            n_labels=max(cfg.num_labels, 2))
+            batch_size, seq_len=min(64, cfg.max_position_embeddings or 64),
+            vocab_size=cfg.vocab_size, n_labels=max(cfg.num_labels, 2))
     else:
         dataset = data_utils.synthetic_image_dataset(
             batch_size, shape=(cfg.num_channels, cfg.image_size, cfg.image_size),

@@ -33,6 +33,14 @@ def _hf_config_for(cfg):
             return ViTConfig(**kwargs)
         from transformers import DeiTConfig
         return DeiTConfig(**kwargs)
+    if cfg.model_type == "gpt2":
+        from transformers import GPT2Config
+        return GPT2Config(n_embd=cfg.hidden_size,
+                          n_layer=cfg.num_hidden_layers,
+                          n_head=cfg.num_attention_heads,
+                          n_inner=cfg.intermediate_size,
+                          vocab_size=cfg.vocab_size,
+                          n_positions=cfg.max_position_embeddings)
     from transformers import BertConfig
     return BertConfig(**kwargs, vocab_size=cfg.vocab_size,
                       max_position_embeddings=cfg.max_position_embeddings,
@@ -46,6 +54,8 @@ def _hf_model(model_name: str, cfg, random_init: bool):
         from transformers import ViTForImageClassification as Cls
     elif cfg.model_type == "deit":
         from transformers import DeiTForImageClassificationWithTeacher as Cls
+    elif cfg.model_type == "gpt2":
+        from transformers import GPT2LMHeadModel as Cls
     elif cfg.num_labels > 0:
         from transformers import BertForSequenceClassification as Cls
     else:
@@ -65,7 +75,7 @@ def save_weights(model_name: str, model_file: str, random_init: bool = False) ->
     if cfg.model_type in ("vit", "deit"):
         weights = entry.family.hf_to_npz_weights(state_dict, cfg)
     else:
-        weights = state_dict  # BERT's native format IS the HF state dict
+        weights = state_dict  # BERT/GPT-2 native format IS the HF state dict
     np.savez(model_file, **weights)
 
 

@@ -7,9 +7,13 @@ import jax.numpy as jnp
 from pipeedge_tpu.ops.attention import fused_attention
 
 
-def _reference(q, k, v):
+def _reference(q, k, v, causal=False):
     d = q.shape[-1]
     scores = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    if causal:
+        s = q.shape[1]
+        mask = np.tril(np.ones((s, s), bool))
+        scores = np.where(mask, scores, -1e30)
     scores = scores - scores.max(axis=-1, keepdims=True)
     p = np.exp(scores)
     p /= p.sum(axis=-1, keepdims=True)
@@ -27,6 +31,20 @@ def test_matches_reference(shape):
     out = np.asarray(fused_attention(jnp.asarray(q), jnp.asarray(k),
                                      jnp.asarray(v), interpret=True))
     np.testing.assert_allclose(out, _reference(q, k, v), rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("shape", [
+    (2, 128, 4, 64),    # block-aligned: exercises the early-stop loop bound
+    (1, 100, 3, 32),    # padded sequence + causal masking combined
+])
+def test_causal_matches_reference(shape):
+    rng = np.random.default_rng(3)
+    q, k, v = (rng.normal(size=shape).astype(np.float32) for _ in range(3))
+    out = np.asarray(fused_attention(jnp.asarray(q), jnp.asarray(k),
+                                     jnp.asarray(v), q_block=32, kv_block=32,
+                                     causal=True, interpret=True))
+    np.testing.assert_allclose(out, _reference(q, k, v, causal=True),
+                               rtol=2e-4, atol=2e-5)
 
 
 def test_bfloat16_inputs():

@@ -17,6 +17,7 @@ import jax.numpy as jnp  # noqa: E402
 from pipeedge_tpu.models import ShardConfig, block_slices, edge_arity, plan_shard  # noqa: E402
 from pipeedge_tpu.models import bert as bert_mod  # noqa: E402
 from pipeedge_tpu.models import deit as deit_mod  # noqa: E402
+from pipeedge_tpu.models import gpt2 as gpt2_mod  # noqa: E402
 from pipeedge_tpu.models import vit as vit_mod  # noqa: E402
 from pipeedge_tpu.models.layers import TransformerConfig  # noqa: E402
 from pipeedge_tpu.models.shard import make_shard_fn  # noqa: E402
@@ -72,6 +73,22 @@ def deit_setup():
     return cfg, weights, np.asarray(x), expected
 
 
+@pytest.fixture(scope="module")
+def gpt2_setup():
+    from transformers import GPT2Config, GPT2LMHeadModel
+    hf_cfg = GPT2Config(n_embd=32, n_layer=3, n_head=4, n_inner=64,
+                        vocab_size=100, n_positions=64)
+    torch.manual_seed(3)
+    model = GPT2LMHeadModel(hf_cfg).eval()
+    cfg = TransformerConfig(model_type="gpt2", **TINY, layer_norm_eps=1e-5,
+                            vocab_size=100, max_position_embeddings=64)
+    weights = {k: v.numpy() for k, v in model.state_dict().items()}
+    ids = torch.randint(0, 100, (2, 9))
+    with torch.no_grad():
+        expected = model(ids).logits.numpy()
+    return cfg, weights, np.asarray(ids), expected
+
+
 def _run_partition(family, cfg, weights, x, partition):
     """Run shards for `partition` = [(l0, r0), (l1, r1), ...] in sequence."""
     total = 4 * cfg.num_hidden_layers
@@ -116,6 +133,31 @@ def test_deit_parity_and_composition(deit_setup, partition):
     cfg, weights, x, expected = deit_setup
     got = _run_partition(deit_mod, cfg, weights, x, partition)
     np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("partition", PARTITIONS)
+def test_gpt2_parity_and_composition(gpt2_setup, partition):
+    """Causal-decoder parity vs HF GPT2LMHeadModel (per-token vocab logits),
+    including mid-block cuts where a (ctx, residual) 2-tuple crosses the
+    stage edge — beyond-reference family, same shard machinery."""
+    cfg, weights, ids, expected = gpt2_setup
+    got = _run_partition(gpt2_mod, cfg, weights, ids, partition)
+    np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-4)
+
+
+def test_gpt2_causal_masking(gpt2_setup):
+    """Perturbing future tokens must not change earlier positions' logits."""
+    cfg, weights, ids, _ = gpt2_setup
+    total = 4 * cfg.num_hidden_layers
+    sc = ShardConfig(1, total, is_first=True, is_last=True)
+    params = gpt2_mod.load_params(cfg, sc, weights)
+    fn = make_shard_fn(gpt2_mod.FAMILY, cfg, sc)
+    base = np.asarray(fn(params, jnp.asarray(ids)))
+    mutated = np.array(ids)
+    mutated[:, 5:] = (mutated[:, 5:] + 1) % 100
+    got = np.asarray(fn(params, jnp.asarray(mutated)))
+    np.testing.assert_array_equal(base[:, :5], got[:, :5])
+    assert not np.allclose(base[:, 5:], got[:, 5:])
 
 
 def test_unrolled_blocks_match_scanned(vit_setup):

@@ -74,6 +74,20 @@ def test_spmd_falls_back_on_midblock_cut(tmp_path):
     assert "falling back to host driver" in proc.stderr + proc.stdout
 
 
+def test_gpt2_host_and_spmd(tmp_path):
+    """Causal-decoder family end-to-end through the runtime CLI: 2-stage
+    host driver with a quantized edge, then the SPMD driver."""
+    proc = _run(tmp_path, "0", "2", "-m", "pipeedge/test-tiny-gpt2",
+                "-pt", "1,4,5,8", "-q", "8,0", "-b", "4", "-u", "2")
+    assert proc.returncode == 0, proc.stderr
+    assert _throughput(proc) > 0
+    proc = _run(tmp_path, "0", "2", "-c", "spmd",
+                "-m", "pipeedge/test-tiny-gpt2", "-pt", "1,4,5,8",
+                "-b", "4", "-u", "2")
+    assert proc.returncode == 0, proc.stderr
+    assert _throughput(proc) > 0
+
+
 def test_nonzero_rank_exits(tmp_path):
     proc = _run(tmp_path, "1", "2", "-m", MODEL)
     assert proc.returncode == 0

@@ -13,6 +13,7 @@ import jax.numpy as jnp  # noqa: E402
 
 from pipeedge_tpu.models import ShardConfig  # noqa: E402
 from pipeedge_tpu.models import bert as bert_mod  # noqa: E402
+from pipeedge_tpu.models import gpt2 as gpt2_mod  # noqa: E402
 from pipeedge_tpu.models import vit as vit_mod  # noqa: E402
 from pipeedge_tpu.models.layers import TransformerConfig  # noqa: E402
 from pipeedge_tpu.models.shard import make_shard_fn  # noqa: E402
@@ -251,6 +252,54 @@ def test_spmd_dp_stage_sp_mesh(tiny_vit4):
     got = np.asarray(pipe.run(ids))
     expected = _expected(bert_mod, cfg, weights, ids)
     np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-5)
+
+
+def _tiny_gpt2():
+    from transformers import GPT2Config, GPT2LMHeadModel
+    hf_cfg = GPT2Config(n_embd=32, n_layer=4, n_head=4, n_inner=64,
+                        vocab_size=100, n_positions=64)
+    torch.manual_seed(5)
+    model = GPT2LMHeadModel(hf_cfg).eval()
+    cfg = TransformerConfig(model_type="gpt2", **TINY4, layer_norm_eps=1e-5,
+                            vocab_size=100, max_position_embeddings=64)
+    weights = {k: v.numpy() for k, v in model.state_dict().items()}
+    return cfg, weights
+
+
+def test_spmd_gpt2_sp_causal_ring():
+    """Causal decoder through the pp x dp x sp program: sequence-sharded
+    stages with CAUSAL ring attention (the long-context decode shape), last
+    stage all-gathers for the full-sequence LM head — vs the single-shard
+    oracle (itself HF-parity-tested in test_models.py)."""
+    cfg, weights = _tiny_gpt2()
+    partition = [(1, 8), (9, 16)]
+    mesh = spmd.make_pipeline_mesh(2, dp=2, sp=2)
+    pipe = spmd.build_spmd_pipeline(
+        gpt2_mod.FAMILY, cfg, partition,
+        _stage_params(gpt2_mod, cfg, partition, weights), mesh)
+    ids = jnp.asarray(
+        np.random.default_rng(11).integers(0, 100, size=(3, 4, 12)),
+        dtype=jnp.int32)
+    got = np.asarray(pipe.run(ids))
+    expected = _expected(gpt2_mod, cfg, weights, ids)
+    np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-4)
+
+
+def test_spmd_gpt2_tp():
+    """Causal decoder through pp x tp: Megatron-sharded blocks reuse the
+    ViT spec table (same param names) with the causal+gelu_new body."""
+    cfg, weights = _tiny_gpt2()
+    partition = [(1, 8), (9, 16)]
+    mesh = spmd.make_pipeline_mesh(2, dp=2, tp=2)
+    pipe = spmd.build_spmd_pipeline(
+        gpt2_mod.FAMILY, cfg, partition,
+        _stage_params(gpt2_mod, cfg, partition, weights), mesh)
+    ids = jnp.asarray(
+        np.random.default_rng(12).integers(0, 100, size=(4, 2, 9)),
+        dtype=jnp.int32)
+    got = np.asarray(pipe.run(ids))
+    expected = _expected(gpt2_mod, cfg, weights, ids)
+    np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-4)
 
 
 def test_spmd_sp_seq_divisibility_error(tiny_vit4):

@@ -13,7 +13,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.mark.parametrize("model", ["pipeedge/test-tiny-vit",
-                                   "pipeedge/test-tiny-bert"])
+                                   "pipeedge/test-tiny-bert",
+                                   "pipeedge/test-tiny-gpt2"])
 def test_save_random_weights_and_load(model, tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
@@ -29,7 +30,7 @@ def test_save_random_weights_and_load(model, tmp_path, monkeypatch):
     layers = registry.get_model_layers(model)
     fn, params, _ = registry.module_shard_factory(model, weights_file, 1, layers)
     cfg = registry.get_model_config(model)
-    if cfg.model_type == "bert":
+    if cfg.vocab_size:  # token models: BERT and GPT-2
         x = jnp.asarray(np.random.default_rng(0).integers(
             0, cfg.vocab_size, size=(2, 9)), dtype=jnp.int32)
     else:

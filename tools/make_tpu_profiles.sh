@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Generate the committed TPU chip profiles (profiles/README.md recipe) in one
+# serialized chip session: per-layer profiles for ViT-B and ViT-L, the
+# scheduler YAML conversions, and a bench.py run. Run from the repo root on a
+# machine with the real chip. The chip is single-tenant — never run two chip
+# processes at once, and never SIGKILL a running one (stale-lease wedge).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+mkdir -p profiles/tpu
+
+run() { echo "=== $*" >&2; stdbuf -oL -eL "$@"; }
+
+run python profiler.py -m google/vit-base-patch16-224 -b 8 -t bfloat16 \
+    -o profiles/tpu/profiler_results_vitb.yml
+run python profiler.py -m google/vit-large-patch16-224 -b 8 -t bfloat16 \
+    -o profiles/tpu/profiler_results_vitl.yml
+
+run python profiler_results_to_models.py \
+    -i profiles/tpu/profiler_results_vitb.yml -o profiles/tpu/models.yml
+run python profiler_results_to_models.py \
+    -i profiles/tpu/profiler_results_vitl.yml -o profiles/tpu/models.yml
+# -dtm 16384: v5e HBM MB; -dtb 100000: ~100 Gbps per-link planning number
+# for the scheduler's min(src,dst) bandwidth model.
+run python profiler_results_to_device_types.py tpu-v5e \
+    -i profiles/tpu/profiler_results_vitb.yml -o profiles/tpu/device_types.yml \
+    -dtm 16384 -dtb 100000
+run python profiler_results_to_device_types.py tpu-v5e \
+    -i profiles/tpu/profiler_results_vitl.yml -o profiles/tpu/device_types.yml \
+    -dtm 16384 -dtb 100000
+python -c "import yaml; yaml.safe_dump(
+    {'tpu-v5e': ['tpu0', 'tpu1', 'tpu2', 'tpu3']},
+    open('profiles/tpu/devices.yml', 'w'))"
+
+run python bench.py
