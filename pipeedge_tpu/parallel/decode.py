@@ -1,0 +1,242 @@
+"""Autoregressive KV-cache decoding through the pipeline (GPT-2 family).
+
+NEW capability beyond the reference (whose model list is encoder-only and
+whose runtime is single-shot batch inference). TPU-first design:
+
+- **Static shapes everywhere**: the KV cache is a fixed [n_blocks, B,
+  max_len, H, Dh] buffer per stage; the current length rides as a traced
+  scalar `pos`, future positions are masked. One compiled prefill program +
+  one compiled decode-step program per stage serve the whole generation —
+  no per-step recompilation (the reference's dynamic-shape wire protocol
+  has no answer to this; SURVEY.md §7 'hard parts').
+- **Block-aligned pipeline stages**: each stage holds its blocks' cache,
+  consumes the previous stage's hidden state for the current token, and
+  returns its own — the same stage-edge discipline as the forward
+  pipeline (quantizable, device-placeable). Autoregression serializes
+  decode steps, so parallelism comes from the batch dimension; stages
+  still split the model across devices for memory capacity.
+- Attention over the cache streams as one [B, H, 1, T_max] masked matmul —
+  MXU-shaped, no gather.
+
+Greedy decoding matches HF `GPT2LMHeadModel.generate(do_sample=False)`
+token-for-token (tests/test_decode.py).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models import ShardConfig, plan_shard
+from ..models.layers import (TransformerConfig, dense, gelu_new, layer_norm)
+
+Cache = Dict[str, jax.Array]   # {'k': [L, B, T, H, Dh], 'v': [L, B, T, H, Dh]}
+
+
+def init_cache(cfg: TransformerConfig, n_blocks: int, batch: int,
+               max_len: int, dtype=jnp.float32) -> Cache:
+    """Zeroed stacked KV cache for `n_blocks` blocks."""
+    shape = (n_blocks, batch, max_len, cfg.num_attention_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _qkv(p: Dict, normed: jax.Array, cfg: TransformerConfig):
+    b, s, _ = normed.shape
+    h, hd = cfg.num_attention_heads, cfg.head_dim
+    return (dense(p["q"], normed).reshape(b, s, h, hd),
+            dense(p["k"], normed).reshape(b, s, h, hd),
+            dense(p["v"], normed).reshape(b, s, h, hd))
+
+
+def _attend(q: jax.Array, k: jax.Array, v: jax.Array, keep: jax.Array,
+            cfg: TransformerConfig) -> jax.Array:
+    """Masked attention of q [B,S,H,Dh] over k/v [B,T,H,Dh]; `keep`
+    [S, T] marks key positions each query may attend to."""
+    b, s, h, hd = q.shape
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(hd))
+    scores = jnp.where(keep[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v,
+                     preferred_element_type=jnp.float32).astype(q.dtype)
+    return ctx.reshape(b, s, h * hd)
+
+
+def _block_step(p: Dict, x: jax.Array, k_cache: jax.Array,
+                v_cache: jax.Array, pos, cfg: TransformerConfig,
+                prefill: bool) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One GPT-2 block over current token(s) with cache read/update.
+
+    Prefill: x is the full prompt [B, S, D] written at positions [0, S);
+    decode: x is one token [B, 1, D] written at position `pos`."""
+    t_max = k_cache.shape[1]
+    normed = layer_norm(p["ln_before"], x, cfg.layer_norm_eps)
+    q, k_new, v_new = _qkv(p, normed, cfg)
+    if prefill:
+        s = x.shape[1]
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k_new.astype(k_cache.dtype), (0, 0, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v_new.astype(v_cache.dtype), (0, 0, 0, 0))
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, (s, t_max), 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (s, t_max), 1)
+        keep = k_pos <= q_pos          # causal within the prompt
+    else:
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k_new.astype(k_cache.dtype), (0, pos, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v_new.astype(v_cache.dtype), (0, pos, 0, 0))
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (1, t_max), 1)
+        keep = k_pos <= pos            # attend to [0, pos]
+    ctx = _attend(q, k_cache.astype(q.dtype), v_cache.astype(q.dtype),
+                  keep, cfg)
+    x = dense(p["attn_out"], ctx) + x
+    normed = layer_norm(p["ln_after"], x, cfg.layer_norm_eps)
+    x = dense(p["mlp_down"], gelu_new(dense(p["mlp_up"], normed))) + x
+    return x, k_cache, v_cache
+
+
+def _stage_blocks(params: Dict) -> jax.Array:
+    """The stacked blocks pytree of a decode stage (block-aligned shard)."""
+    blocks = params.get("blocks")
+    if blocks is None:
+        raise ValueError("decode stages must contain full blocks "
+                         "(block-aligned partition)")
+    if isinstance(blocks, (tuple, list)):  # unrolled layout -> restack
+        blocks = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
+    return blocks
+
+
+def _run_blocks(blocks, x, cache: Cache, pos, cfg: TransformerConfig,
+                prefill: bool) -> Tuple[jax.Array, Cache]:
+    def body(carry, xs):
+        bp, kc, vc = xs
+        y, kc, vc = _block_step(bp, carry, kc, vc, pos, cfg, prefill)
+        return y, (kc, vc)
+
+    x, (ks, vs) = jax.lax.scan(body, x, (blocks, cache["k"], cache["v"]))
+    return x, {"k": ks, "v": vs}
+
+
+def make_stage_fns(family, cfg: TransformerConfig, shard_config: ShardConfig):
+    """(prefill_fn, decode_fn) for one block-aligned pipeline stage.
+
+    prefill_fn(params, data, cache)        -> (out, cache)   data: ids|hidden
+    decode_fn(params, data, cache, pos)    -> (out, cache)   data: ids|hidden
+
+    First stage embeds token ids (decode positions offset by `pos`); last
+    stage applies the final LN + LM head and returns per-token logits.
+    """
+    plan = plan_shard(shard_config)
+    if plan.head is not None or plan.tail is not None:
+        raise ValueError("decode requires a block-aligned partition "
+                         f"(layers [{shard_config.layer_start}, "
+                         f"{shard_config.layer_end}] cut mid-block)")
+
+    def run(params, data, cache, pos, prefill):
+        if shard_config.is_first:
+            if prefill:
+                data = family.embed(params["embeddings"], data, cfg)
+            else:
+                wpe = jax.lax.dynamic_slice_in_dim(
+                    params["embeddings"]["wpe"], pos, 1)
+                data = jnp.take(params["embeddings"]["wte"], data,
+                                axis=0) + wpe[None]
+        data, cache = _run_blocks(_stage_blocks(params), data, cache, pos,
+                                  cfg, prefill)
+        if shard_config.is_last:
+            data = family.finalize(params["final"], data, cfg)
+        return data, cache
+
+    prefill_fn = jax.jit(partial(run, pos=0, prefill=True))
+    decode_fn = jax.jit(partial(run, prefill=False))
+    return prefill_fn, decode_fn
+
+
+class DecodePipeline:
+    """Host-driven pipelined greedy decoding over block-aligned stages.
+
+    `stage_params[i]` are forward-pipeline shard params (the same pytrees
+    `module_shard_factory` builds); caches are per-stage. Decode steps are
+    serial (autoregression), so batch is the throughput axis; stages
+    partition the model across devices for capacity, exactly like the
+    forward pipeline. `devices` optionally places each stage (device_put,
+    mirroring the host pipeline driver).
+    """
+
+    def __init__(self, family, cfg: TransformerConfig,
+                 partition: Sequence[Tuple[int, int]],
+                 stage_params: Sequence[Dict], max_len: int,
+                 devices: Optional[Sequence] = None, dtype=jnp.float32):
+        total = 4 * cfg.num_hidden_layers
+        expect = 1
+        for l, r in partition:
+            if l != expect:
+                raise ValueError(f"partition {list(partition)} does not "
+                                 f"contiguously cover [1, {total}]")
+            expect = r + 1
+        if expect != total + 1:
+            raise ValueError(f"partition {list(partition)} does not "
+                             f"contiguously cover [1, {total}]")
+        if cfg.max_position_embeddings and max_len > cfg.max_position_embeddings:
+            raise ValueError(f"max_len {max_len} exceeds the model's "
+                             f"{cfg.max_position_embeddings} positions")
+        self.cfg = cfg
+        self.max_len = max_len
+        self.stages = []
+        for i, (l, r) in enumerate(partition):
+            sc = ShardConfig(l, r, is_first=l == 1, is_last=r == total)
+            pre, dec = make_stage_fns(family, cfg, sc)
+            params = dict(stage_params[i])
+            # restack an unrolled block layout ONCE here, not per traced call
+            params["blocks"] = _stage_blocks(params)
+            if devices is not None:
+                params = jax.device_put(params, devices[i])
+            n_blocks = (r - l + 1) // 4
+            self.stages.append({"prefill": pre, "decode": dec,
+                                "params": params, "n_blocks": n_blocks,
+                                "device": None if devices is None
+                                else devices[i]})
+        self.dtype = dtype
+
+    def _fresh_caches(self, batch: int) -> List[Cache]:
+        caches = []
+        for st in self.stages:
+            c = init_cache(self.cfg, st["n_blocks"], batch, self.max_len,
+                           self.dtype)
+            if st["device"] is not None:
+                c = jax.device_put(c, st["device"])
+            caches.append(c)
+        return caches
+
+    def generate(self, ids, new_tokens: int):
+        """Greedy-decode `new_tokens` continuations of prompt `ids` [B, S].
+
+        Returns [B, S + new_tokens] token ids (prompt included)."""
+        ids = jnp.asarray(ids, jnp.int32)
+        batch, prompt_len = ids.shape
+        if new_tokens <= 0:
+            return ids
+        if prompt_len + new_tokens > self.max_len:
+            raise ValueError(f"prompt {prompt_len} + {new_tokens} new tokens "
+                             f"exceeds max_len {self.max_len}")
+        caches = self._fresh_caches(batch)
+        data = ids
+        for i, st in enumerate(self.stages):
+            if st["device"] is not None:
+                data = jax.device_put(data, st["device"])
+            data, caches[i] = st["prefill"](st["params"], data, caches[i])
+        tokens = [jnp.argmax(data[:, prompt_len - 1], axis=-1)]
+        for step in range(1, new_tokens):
+            pos = prompt_len + step - 1
+            data = tokens[-1][:, None]
+            for i, st in enumerate(self.stages):
+                if st["device"] is not None:
+                    data = jax.device_put(data, st["device"])
+                data, caches[i] = st["decode"](st["params"], data, caches[i],
+                                               pos)
+            tokens.append(jnp.argmax(data[:, 0], axis=-1))
+        return jnp.concatenate([ids, jnp.stack(tokens, axis=1)], axis=1)

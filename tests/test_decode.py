@@ -1,0 +1,122 @@
+"""KV-cache pipelined decoding vs HF greedy generation (GPT-2 family)."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax.numpy as jnp  # noqa: E402
+
+from pipeedge_tpu.models import ShardConfig  # noqa: E402
+from pipeedge_tpu.models import gpt2 as gpt2_mod  # noqa: E402
+from pipeedge_tpu.models.layers import TransformerConfig  # noqa: E402
+from pipeedge_tpu.parallel import decode  # noqa: E402
+
+TINY = dict(hidden_size=32, num_hidden_layers=3, num_attention_heads=4,
+            intermediate_size=64)
+
+
+@pytest.fixture(scope="module")
+def gpt2_setup():
+    from transformers import GPT2Config, GPT2LMHeadModel
+    hf_cfg = GPT2Config(n_embd=32, n_layer=3, n_head=4, n_inner=64,
+                        vocab_size=100, n_positions=64)
+    torch.manual_seed(7)
+    model = GPT2LMHeadModel(hf_cfg).eval()
+    cfg = TransformerConfig(model_type="gpt2", **TINY, layer_norm_eps=1e-5,
+                            vocab_size=100, max_position_embeddings=64)
+    weights = {k: v.numpy() for k, v in model.state_dict().items()}
+    return cfg, weights, model
+
+
+def _stage_params(cfg, partition, weights):
+    total = 4 * cfg.num_hidden_layers
+    return [gpt2_mod.load_params(
+        cfg, ShardConfig(l, r, is_first=l == 1, is_last=r == total), weights)
+        for l, r in partition]
+
+
+@pytest.mark.parametrize("partition", [
+    [(1, 12)],
+    [(1, 4), (5, 12)],
+    [(1, 4), (5, 8), (9, 12)],
+])
+def test_greedy_matches_hf_generate(gpt2_setup, partition):
+    """Pipelined KV-cache greedy decode == HF generate(do_sample=False),
+    token for token, for 1..3 stage partitions."""
+    cfg, weights, model = gpt2_setup
+    pipe = decode.DecodePipeline(
+        gpt2_mod.FAMILY, cfg, partition,
+        _stage_params(cfg, partition, weights), max_len=32)
+    ids = np.asarray(
+        np.random.default_rng(21).integers(0, 100, size=(3, 7)), np.int64)
+    got = np.asarray(pipe.generate(ids, new_tokens=8))
+    with torch.no_grad():
+        expected = model.generate(
+            torch.from_numpy(ids), max_new_tokens=8, do_sample=False,
+            pad_token_id=0).numpy()
+    np.testing.assert_array_equal(got, expected)
+
+
+def test_decode_matches_teacher_forcing(gpt2_setup):
+    """Step-by-step cached logits == full-sequence forward logits."""
+    cfg, weights, _ = gpt2_setup
+    total = 4 * cfg.num_hidden_layers
+    sc = ShardConfig(1, total, is_first=True, is_last=True)
+    params = gpt2_mod.load_params(cfg, sc, weights)
+    pre, dec = decode.make_stage_fns(gpt2_mod.FAMILY, cfg, sc)
+    ids = jnp.asarray(
+        np.random.default_rng(5).integers(0, 100, size=(2, 10)), jnp.int32)
+    cache = decode.init_cache(cfg, cfg.num_hidden_layers, 2, 16)
+    params = dict(params)
+    params["blocks"] = decode._stage_blocks(params)
+
+    from pipeedge_tpu.models.shard import make_shard_fn
+    full = np.asarray(make_shard_fn(gpt2_mod.FAMILY, cfg, sc)(params,
+                                                              ids))
+    got, cache = pre(params, ids[:, :6], cache)
+    np.testing.assert_allclose(np.asarray(got), full[:, :6], rtol=2e-5,
+                               atol=2e-5)
+    for t in range(6, 10):
+        got, cache = dec(params, ids[:, t:t + 1], cache, t)
+        np.testing.assert_allclose(np.asarray(got)[:, 0], full[:, t],
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_generate_cli(tmp_path):
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "generate.py"),
+         "-m", "pipeedge/test-tiny-gpt2", "-pt", "1,4,5,8", "-b", "2",
+         "--prompt-len", "6", "--new-tokens", "5"],
+        capture_output=True, env=env, cwd=str(tmp_path), text=True,
+        timeout=300)
+    assert proc.returncode == 0, proc.stderr
+    assert "tok/s" in proc.stdout
+
+
+def test_decode_validation_errors(gpt2_setup):
+    cfg, weights, _ = gpt2_setup
+    with pytest.raises(ValueError, match="block-aligned"):
+        decode.make_stage_fns(gpt2_mod.FAMILY, cfg,
+                              ShardConfig(1, 6, is_first=True, is_last=False))
+    with pytest.raises(ValueError, match="contiguously cover"):
+        decode.DecodePipeline(gpt2_mod.FAMILY, cfg, [(1, 4)],
+                              _stage_params(cfg, [(1, 4)], weights),
+                              max_len=8)
+    with pytest.raises(ValueError, match="positions"):
+        decode.DecodePipeline(gpt2_mod.FAMILY, cfg, [(1, 12)],
+                              _stage_params(cfg, [(1, 12)], weights),
+                              max_len=100)  # > max_position_embeddings=64
+    partition = [(1, 12)]
+    pipe = decode.DecodePipeline(
+        gpt2_mod.FAMILY, cfg, partition,
+        _stage_params(cfg, partition, weights), max_len=8)
+    with pytest.raises(ValueError, match="exceeds max_len"):
+        pipe.generate(np.zeros((1, 6), np.int64), new_tokens=4)
+    # new_tokens=0 honors the [B, S + new_tokens] contract
+    ids = np.zeros((1, 4), np.int64)
+    assert np.asarray(pipe.generate(ids, 0)).shape == (1, 4)
