@@ -82,20 +82,49 @@ def test_decode_matches_teacher_forcing(gpt2_setup):
                                    rtol=2e-5, atol=2e-5)
 
 
+def test_int8_kv_cache_close_to_exact(gpt2_setup):
+    """int8-quantized KV cache (QuantPipe idea applied to decode): cached
+    step logits stay close to the exact full-sequence forward."""
+    cfg, weights, _ = gpt2_setup
+    total = 4 * cfg.num_hidden_layers
+    sc = ShardConfig(1, total, is_first=True, is_last=True)
+    params = dict(gpt2_mod.load_params(cfg, sc, weights))
+    params["blocks"] = decode._stage_blocks(params)
+    pre, dec = decode.make_stage_fns(gpt2_mod.FAMILY, cfg, sc)
+    ids = jnp.asarray(
+        np.random.default_rng(6).integers(0, 100, size=(2, 10)), jnp.int32)
+    cache = decode.init_cache(cfg, cfg.num_hidden_layers, 2, 16, cache_bits=8)
+    assert cache["k"].dtype == jnp.int8
+
+    from pipeedge_tpu.models.shard import make_shard_fn
+    full = np.asarray(make_shard_fn(gpt2_mod.FAMILY, cfg, sc)(params, ids))
+    got, cache = pre(params, ids[:, :6], cache)
+    np.testing.assert_allclose(np.asarray(got), full[:, :6], rtol=0.1,
+                               atol=0.05)
+    for t in range(6, 10):
+        got, cache = dec(params, ids[:, t:t + 1], cache, t)
+        np.testing.assert_allclose(np.asarray(got)[:, 0], full[:, t],
+                                   rtol=0.1, atol=0.05)
+
+    with pytest.raises(ValueError, match="cache_bits"):
+        decode.init_cache(cfg, 2, 1, 8, cache_bits=4)
+
+
 def test_generate_cli(tmp_path):
     import os
     import subprocess
     import sys
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
-    proc = subprocess.run(
-        [sys.executable, os.path.join(repo, "tools", "generate.py"),
-         "-m", "pipeedge/test-tiny-gpt2", "-pt", "1,4,5,8", "-b", "2",
-         "--prompt-len", "6", "--new-tokens", "5"],
-        capture_output=True, env=env, cwd=str(tmp_path), text=True,
-        timeout=300)
-    assert proc.returncode == 0, proc.stderr
-    assert "tok/s" in proc.stdout
+    for extra in ([], ["--kv-bits", "8"]):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools", "generate.py"),
+             "-m", "pipeedge/test-tiny-gpt2", "-pt", "1,4,5,8", "-b", "2",
+             "--prompt-len", "6", "--new-tokens", "5"] + extra,
+            capture_output=True, env=env, cwd=str(tmp_path), text=True,
+            timeout=300)
+        assert proc.returncode == 0, proc.stderr
+        assert "tok/s" in proc.stdout
 
 
 def test_decode_validation_errors(gpt2_setup):

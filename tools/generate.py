@@ -43,6 +43,9 @@ def main():
                         help="cache capacity (default: prompt+new tokens)")
     parser.add_argument("-t", "--dtype", default="float32",
                         choices=["float32", "bfloat16"])
+    parser.add_argument("--kv-bits", default=0, type=int, choices=[0, 8],
+                        help="int8-quantize the KV cache (halves decode "
+                             "HBM traffic; 0 = full precision)")
     args = parser.parse_args()
 
     cfg = registry.get_model_config(args.model_name)
@@ -64,7 +67,7 @@ def main():
     max_len = args.max_len or args.prompt_len + args.new_tokens
     pipe = decode.DecodePipeline(registry.get_model_entry(
         args.model_name).family.FAMILY, cfg, partition, stage_params,
-        max_len=max_len, dtype=dtype)
+        max_len=max_len, dtype=dtype, cache_bits=args.kv_bits)
 
     ids = np.random.default_rng(0).integers(
         0, cfg.vocab_size, size=(args.batch_size, args.prompt_len))
