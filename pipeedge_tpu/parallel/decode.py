@@ -100,19 +100,15 @@ def _attend(q: jax.Array, k: jax.Array, v: jax.Array, keep: jax.Array,
     return ctx.reshape(b, s, h * hd)
 
 
-def _block_step(p: Dict, x: jax.Array, bcache: Cache, pos,
-                cfg: TransformerConfig,
-                prefill: bool) -> Tuple[jax.Array, Cache]:
-    """One GPT-2 block over current token(s) with cache read/update.
-
-    Prefill: x is the full prompt [B, S, D] written at positions [0, S);
-    decode: x is one token [B, 1, D] written at position `pos`. `bcache`
-    is this block's cache slice {k, v[, *_scale, *_shift]}."""
+def _cache_update_and_read(bcache: Cache, k_new: jax.Array, v_new: jax.Array,
+                           pos, prefill: bool, s: int,
+                           dtype) -> Tuple[jax.Array, jax.Array, jax.Array,
+                                           Cache]:
+    """Write the new K/V rows at [pos, pos+S) and return (k, v, keep, cache)
+    for attention over the whole (masked) cache window."""
     t_max = bcache["k"].shape[1]
     quantized = "k_scale" in bcache
     bcache = dict(bcache)
-    normed = layer_norm(p["ln_before"], x, cfg.layer_norm_eps)
-    q, k_new, v_new = _qkv(p, normed, cfg)
     start = (0, 0, 0, 0) if prefill else (0, pos, 0, 0)
     if quantized:
         for t, new in (("k", k_new), ("v", v_new)):
@@ -123,32 +119,67 @@ def _block_step(p: Dict, x: jax.Array, bcache: Cache, pos,
             bcache[f"{t}_shift"] = jax.lax.dynamic_update_slice(
                 bcache[f"{t}_shift"], shift, start[:2])
         k = _dequantize_rows(bcache["k"], bcache["k_scale"],
-                             bcache["k_shift"], q.dtype)
+                             bcache["k_shift"], dtype)
         v = _dequantize_rows(bcache["v"], bcache["v_scale"],
-                             bcache["v_shift"], q.dtype)
+                             bcache["v_shift"], dtype)
         # the freshly computed rows are in hand — attend over them exactly;
         # quantization error applies only to genuinely cached positions
-        k = jax.lax.dynamic_update_slice(k, k_new.astype(q.dtype), start)
-        v = jax.lax.dynamic_update_slice(v, v_new.astype(q.dtype), start)
+        k = jax.lax.dynamic_update_slice(k, k_new.astype(dtype), start)
+        v = jax.lax.dynamic_update_slice(v, v_new.astype(dtype), start)
     else:
         for t, new in (("k", k_new), ("v", v_new)):
             bcache[t] = jax.lax.dynamic_update_slice(
                 bcache[t], new.astype(bcache[t].dtype), start)
-        k = bcache["k"].astype(q.dtype)
-        v = bcache["v"].astype(q.dtype)
+        k = bcache["k"].astype(dtype)
+        v = bcache["v"].astype(dtype)
     if prefill:
-        s = x.shape[1]
         q_pos = jax.lax.broadcasted_iota(jnp.int32, (s, t_max), 0)
         k_pos = jax.lax.broadcasted_iota(jnp.int32, (s, t_max), 1)
         keep = k_pos <= q_pos          # causal within the prompt
     else:
         k_pos = jax.lax.broadcasted_iota(jnp.int32, (1, t_max), 1)
         keep = k_pos <= pos            # attend to [0, pos]
+    return k, v, keep, bcache
+
+
+def _block_step(p: Dict, x: jax.Array, bcache: Cache, pos,
+                cfg: TransformerConfig,
+                prefill: bool) -> Tuple[jax.Array, Cache]:
+    """One GPT-2 block over current token(s) with cache read/update.
+
+    Prefill: x is the full prompt [B, S, D] written at positions [0, S);
+    decode: x is one token [B, 1, D] written at position `pos`. `bcache`
+    is this block's cache slice {k, v[, *_scale, *_shift]}."""
+    normed = layer_norm(p["ln_before"], x, cfg.layer_norm_eps)
+    q, k_new, v_new = _qkv(p, normed, cfg)
+    k, v, keep, bcache = _cache_update_and_read(
+        bcache, k_new, v_new, pos, prefill, x.shape[1], q.dtype)
     ctx = _attend(q, k, v, keep, cfg)
     x = dense(p["attn_out"], ctx) + x
     normed = layer_norm(p["ln_after"], x, cfg.layer_norm_eps)
     x = dense(p["mlp_down"], gelu_new(dense(p["mlp_up"], normed))) + x
     return x, bcache
+
+
+def _block_step_tp(p: Dict, x: jax.Array, bcache: Cache, pos,
+                   cfg: TransformerConfig, prefill: bool,
+                   axis: str) -> Tuple[jax.Array, Cache]:
+    """Megatron tensor-parallel block step under `shard_map`: the shared
+    projection/psum/MLP body from parallel/tensor.py with the attention
+    core swapped for a cache-attend over the head-sharded KV cache."""
+    from .tensor import _tp_block_local
+
+    new_cache = {}
+
+    def cache_attend(q, k_new, v_new):
+        k, v, keep, bc = _cache_update_and_read(
+            bcache, k_new, v_new, pos, prefill, x.shape[1], q.dtype)
+        new_cache.update(bc)
+        return _attend(q, k, v, keep, cfg)      # [b, s, h_local * hd]
+
+    y = _tp_block_local(p, x, cfg, axis, act=gelu_new,
+                        qkv_to_ctx=cache_attend)
+    return y, new_cache
 
 
 def _stage_blocks(params: Dict) -> jax.Array:
@@ -163,10 +194,10 @@ def _stage_blocks(params: Dict) -> jax.Array:
 
 
 def _run_blocks(blocks, x, cache: Cache, pos, cfg: TransformerConfig,
-                prefill: bool) -> Tuple[jax.Array, Cache]:
+                prefill: bool, block_fn=_block_step) -> Tuple[jax.Array, Cache]:
     def body(carry, xs):
         bp, bc = xs
-        y, bc = _block_step(bp, carry, bc, pos, cfg, prefill)
+        y, bc = block_fn(bp, carry, bc, pos, cfg, prefill)
         return y, bc
 
     return jax.lax.scan(body, x, (blocks, cache))
@@ -181,6 +212,15 @@ def make_stage_fns(family, cfg: TransformerConfig, shard_config: ShardConfig):
     First stage embeds token ids (decode positions offset by `pos`); last
     stage applies the final LN + LM head and returns per-token logits.
     """
+    run = _make_stage_run(family, cfg, shard_config)
+    prefill_fn = jax.jit(partial(run, pos=0, prefill=True))
+    decode_fn = jax.jit(partial(run, prefill=False))
+    return prefill_fn, decode_fn
+
+
+def _make_stage_run(family, cfg: TransformerConfig,
+                    shard_config: ShardConfig, block_fn=_block_step,
+                    finalize_fn=None):
     plan = plan_shard(shard_config)
     if plan.head is not None or plan.tail is not None:
         raise ValueError("decode requires a block-aligned partition "
@@ -197,13 +237,90 @@ def make_stage_fns(family, cfg: TransformerConfig, shard_config: ShardConfig):
                 data = jnp.take(params["embeddings"]["wte"], data,
                                 axis=0) + wpe[None]
         data, cache = _run_blocks(_stage_blocks(params), data, cache, pos,
-                                  cfg, prefill)
+                                  cfg, prefill, block_fn=block_fn)
         if shard_config.is_last:
-            data = family.finalize(params["final"], data, cfg)
+            data = (finalize_fn or family.finalize)(params["final"], data,
+                                                    cfg)
         return data, cache
 
-    prefill_fn = jax.jit(partial(run, pos=0, prefill=True))
-    decode_fn = jax.jit(partial(run, prefill=False))
+    return run
+
+
+def _tp_shards_head(cfg: TransformerConfig, n: int) -> bool:
+    """Vocab-shard the LM head when the vocab divides the tp degree — at
+    decode the head matmul is a third of GPT-2's per-token FLOPs, so
+    leaving it replicated would cap the tp speedup around 3x. An
+    indivisible vocab (gpt2's 50257 is prime) falls back to replicated."""
+    return cfg.vocab_size > 0 and n > 1 and cfg.vocab_size % n == 0
+
+
+def tp_param_specs(params: Dict, cfg: TransformerConfig, n: int,
+                   axis: str = "tp"):
+    """Partition-spec pytree for one decode stage's params under Megatron
+    TP (degree `n`): blocks per the family spec table (leading block axis
+    replicated), embeddings replicated, LM head vocab-sharded when
+    divisible (`_tp_shards_head`)."""
+    from jax.sharding import PartitionSpec as P
+
+    from .tensor import _rename_axis, family_tp_plan
+    table, _ = family_tp_plan(cfg)
+    table = _rename_axis(table, axis)
+    specs = {k: jax.tree_util.tree_map(lambda _: P(), v)
+             for k, v in params.items() if k != "blocks"}
+    specs["blocks"] = jax.tree_util.tree_map(
+        lambda _, s: P(*((None,) + tuple(s))), params["blocks"], table)
+    if "final" in params and "head" in params["final"] \
+            and _tp_shards_head(cfg, n):
+        specs["final"]["head"] = {"w": P(None, axis), "b": P(axis)}
+    return specs
+
+
+def tp_cache_specs(cache: Cache, axis: str = "tp"):
+    """Head-shard the K/V buffers (axis 3 of [L, B, T, H, Dh])."""
+    from jax.sharding import PartitionSpec as P
+    return {k: P(None, None, None, axis, None) for k in cache}
+
+
+def make_tp_stage_fns(family, cfg: TransformerConfig,
+                      shard_config: ShardConfig, mesh, params: Dict,
+                      axis: str = "tp"):
+    """Tensor-parallel variant of `make_stage_fns`: the stage executes under
+    `shard_map` over `axis` with head-sharded KV cache and the 2-psum
+    Megatron block body — decode-step latency scales with the tp degree.
+    `params` (stacked-blocks layout) supplies the pytree structure for the
+    partition specs; int8 caches are not supported under tp (per-device
+    scale rows would diverge)."""
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis]
+    if cfg.num_attention_heads % n:
+        raise ValueError(f"tp={n} requires head count "
+                         f"({cfg.num_attention_heads}) divisible by tp")
+
+    def tp_finalize(pf, hidden, cfg):
+        # final LN replicated; LM head column-sharded over the vocab, local
+        # logit slices all-gathered back to the full [B, S, V]
+        hidden = layer_norm(pf["ln"], hidden, cfg.layer_norm_eps)
+        y = jnp.dot(hidden, pf["head"]["w"].astype(hidden.dtype),
+                    preferred_element_type=jnp.float32) + pf["head"]["b"]
+        return jax.lax.all_gather(y.astype(hidden.dtype), axis,
+                                  axis=y.ndim - 1, tiled=True)
+
+    run = _make_stage_run(family, cfg, shard_config,
+                          block_fn=partial(_block_step_tp, axis=axis),
+                          finalize_fn=tp_finalize
+                          if _tp_shards_head(cfg, n) else None)
+    p_specs = tp_param_specs(params, cfg, n, axis)
+    c_specs = tp_cache_specs(init_cache(cfg, 1, 1, 1), axis)
+
+    prefill_fn = jax.jit(jax.shard_map(
+        partial(run, pos=0, prefill=True), mesh=mesh,
+        in_specs=(p_specs, P(), c_specs), out_specs=(P(), c_specs),
+        check_vma=False))
+    decode_fn = jax.jit(jax.shard_map(
+        partial(run, prefill=False), mesh=mesh,
+        in_specs=(p_specs, P(), c_specs, P()), out_specs=(P(), c_specs),
+        check_vma=False))
     return prefill_fn, decode_fn
 
 
@@ -222,7 +339,7 @@ class DecodePipeline:
                  partition: Sequence[Tuple[int, int]],
                  stage_params: Sequence[Dict], max_len: int,
                  devices: Optional[Sequence] = None, dtype=jnp.float32,
-                 cache_bits: int = 0):
+                 cache_bits: int = 0, mesh=None, tp_axis: str = "tp"):
         total = 4 * cfg.num_hidden_layers
         expect = 1
         for l, r in partition:
@@ -236,22 +353,38 @@ class DecodePipeline:
         if cfg.max_position_embeddings and max_len > cfg.max_position_embeddings:
             raise ValueError(f"max_len {max_len} exceeds the model's "
                              f"{cfg.max_position_embeddings} positions")
+        if mesh is not None and cache_bits:
+            raise ValueError("int8 KV cache is not supported under tensor "
+                             "parallelism (per-device scale rows diverge)")
+        if mesh is not None and devices is not None:
+            raise ValueError("pass either per-stage `devices` or a tp "
+                             "`mesh`, not both")
         self.cfg = cfg
         self.max_len = max_len
+        self.mesh, self.tp_axis = mesh, tp_axis
         self.stages = []
         for i, (l, r) in enumerate(partition):
             sc = ShardConfig(l, r, is_first=l == 1, is_last=r == total)
-            pre, dec = make_stage_fns(family, cfg, sc)
             params = dict(stage_params[i])
             # restack an unrolled block layout ONCE here, not per traced call
             params["blocks"] = _stage_blocks(params)
-            if devices is not None:
-                params = jax.device_put(params, devices[i])
+            if mesh is not None:
+                from jax.sharding import NamedSharding
+                pre, dec = make_tp_stage_fns(family, cfg, sc, mesh, params,
+                                             axis=tp_axis)
+                params = jax.tree_util.tree_map(
+                    lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+                    params, tp_param_specs(params, cfg, mesh.shape[tp_axis],
+                                           tp_axis))
+            else:
+                pre, dec = make_stage_fns(family, cfg, sc)
+                if devices is not None:
+                    params = jax.device_put(params, devices[i])
             n_blocks = (r - l + 1) // 4
             self.stages.append({"prefill": pre, "decode": dec,
                                 "params": params, "n_blocks": n_blocks,
-                                "device": None if devices is None
-                                else devices[i]})
+                                "device": None if devices is None or
+                                mesh is not None else devices[i]})
         self.dtype = dtype
         self.cache_bits = cache_bits
 
@@ -260,7 +393,12 @@ class DecodePipeline:
         for st in self.stages:
             c = init_cache(self.cfg, st["n_blocks"], batch, self.max_len,
                            self.dtype, cache_bits=self.cache_bits)
-            if st["device"] is not None:
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding
+                specs = tp_cache_specs(c, self.tp_axis)
+                c = {k: jax.device_put(v, NamedSharding(self.mesh, specs[k]))
+                     for k, v in c.items()}
+            elif st["device"] is not None:
                 c = jax.device_put(c, st["device"])
             caches.append(c)
         return caches

@@ -44,10 +44,16 @@ def shard_vit_block_params(params: Dict, mesh: Mesh, axis: str = "tp") -> Dict:
 
 
 def _tp_block_local(p: Dict, x: jax.Array, cfg: TransformerConfig,
-                    axis: str, act=gelu, causal: bool = False) -> jax.Array:
+                    axis: str, act=gelu, causal: bool = False,
+                    qkv_to_ctx=None) -> jax.Array:
     """Per-device block body under shard_map: local head/hidden slices +
     two psums. `x` is replicated across the tp axis. Serves every pre-LN
-    family: ViT/DeiT as-is, GPT-2 via act=gelu_new + causal=True."""
+    family: ViT/DeiT as-is, GPT-2 via act=gelu_new + causal=True.
+
+    `qkv_to_ctx(q, k, v) -> ctx` ([b, s, h_local*hd]) overrides the
+    attention core over the local heads — how KV-cache decoding plugs its
+    cache-attend into this same projection/psum/MLP body
+    (parallel/decode.py)."""
     n = jax.lax.axis_size(axis)
     heads_local = cfg.num_attention_heads // n
     b, s, d = x.shape
@@ -62,15 +68,18 @@ def _tp_block_local(p: Dict, x: jax.Array, cfg: TransformerConfig,
         return y.astype(x.dtype).reshape(b, s, heads_local, hd)
 
     q, k, v = proj("q"), proj("k"), proj("v")
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                        preferred_element_type=jnp.float32) / jnp.sqrt(
-                            jnp.float32(hd))
-    if causal:
-        scores = apply_causal_mask(scores)
-    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v,
-                     preferred_element_type=jnp.float32).astype(x.dtype)
-    ctx = ctx.reshape(b, s, heads_local * hd)
+    if qkv_to_ctx is not None:
+        ctx = qkv_to_ctx(q, k, v)
+    else:
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32) / jnp.sqrt(
+                                jnp.float32(hd))
+        if causal:
+            scores = apply_causal_mask(scores)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v,
+                         preferred_element_type=jnp.float32).astype(x.dtype)
+        ctx = ctx.reshape(b, s, heads_local * hd)
     # row-parallel output projection: partial products summed across devices
     attn = jnp.dot(ctx, p["attn_out"]["w"].astype(x.dtype),
                    preferred_element_type=jnp.float32)

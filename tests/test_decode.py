@@ -110,6 +110,33 @@ def test_int8_kv_cache_close_to_exact(gpt2_setup):
         decode.init_cache(cfg, 2, 1, 8, cache_bits=4)
 
 
+def test_tp_decode_matches_plain(gpt2_setup):
+    """Megatron tensor-parallel decode (head-sharded KV cache, 2 psums per
+    block under shard_map) generates the same tokens as the single-device
+    pipeline."""
+    import jax
+    from jax.sharding import Mesh
+    cfg, weights, _ = gpt2_setup
+    ids = np.asarray(
+        np.random.default_rng(31).integers(0, 100, size=(2, 6)), np.int64)
+    for partition in ([(1, 12)], [(1, 8), (9, 12)]):
+        sp = _stage_params(cfg, partition, weights)
+        plain = decode.DecodePipeline(gpt2_mod.FAMILY, cfg, partition, sp,
+                                      max_len=24)
+        mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+        tp = decode.DecodePipeline(gpt2_mod.FAMILY, cfg, partition, sp,
+                                   max_len=24, mesh=mesh)
+        got_plain = np.asarray(plain.generate(ids, 8))
+        got_tp = np.asarray(tp.generate(ids, 8))
+        np.testing.assert_array_equal(got_tp, got_plain)
+
+    with pytest.raises(ValueError, match="not supported under tensor"):
+        decode.DecodePipeline(gpt2_mod.FAMILY, cfg, [(1, 12)],
+                              _stage_params(cfg, [(1, 12)], weights),
+                              max_len=24, cache_bits=8,
+                              mesh=Mesh(np.array(jax.devices()[:2]), ("tp",)))
+
+
 def test_generate_cli(tmp_path):
     import os
     import subprocess

@@ -46,6 +46,9 @@ def main():
     parser.add_argument("--kv-bits", default=0, type=int, choices=[0, 8],
                         help="int8-quantize the KV cache (halves decode "
                              "HBM traffic; 0 = full precision)")
+    parser.add_argument("--tp", default=1, type=int,
+                        help="Megatron tensor-parallel degree per stage "
+                             "(head-sharded KV cache, shard_map)")
     args = parser.parse_args()
 
     cfg = registry.get_model_config(args.model_name)
@@ -65,9 +68,17 @@ def main():
             unroll=False)  # DecodePipeline wants the stacked block layout
         stage_params.append(params)
     max_len = args.max_len or args.prompt_len + args.new_tokens
+    mesh = None
+    if args.tp > 1:
+        import jax
+        from jax.sharding import Mesh
+        if len(jax.devices()) < args.tp:
+            parser.error(f"--tp {args.tp} needs {args.tp} devices, only "
+                         f"{len(jax.devices())} visible")
+        mesh = Mesh(np.array(jax.devices()[:args.tp]), ("tp",))
     pipe = decode.DecodePipeline(registry.get_model_entry(
         args.model_name).family.FAMILY, cfg, partition, stage_params,
-        max_len=max_len, dtype=dtype, cache_bits=args.kv_bits)
+        max_len=max_len, dtype=dtype, cache_bits=args.kv_bits, mesh=mesh)
 
     ids = np.random.default_rng(0).integers(
         0, cfg.vocab_size, size=(args.batch_size, args.prompt_len))
