@@ -247,10 +247,11 @@ def _make_stage_run(family, cfg: TransformerConfig,
 
 
 def _tp_shards_head(cfg: TransformerConfig, n: int) -> bool:
-    """Vocab-shard the LM head when the vocab divides the tp degree — at
-    decode the head matmul is a third of GPT-2's per-token FLOPs, so
-    leaving it replicated would cap the tp speedup around 3x. An
-    indivisible vocab (gpt2's 50257 is prime) falls back to replicated."""
+    """Vocab-shard the LM head when the tp degree divides the vocab size —
+    at decode the head matmul is a third of GPT-2's per-token FLOPs, so
+    leaving it replicated would cap the tp speedup around 3x. A
+    non-divisible combination (e.g. gpt2's 50257 at tp=2/4/8) falls back
+    to a replicated head."""
     return cfg.vocab_size > 0 and n > 1 and cfg.vocab_size % n == 0
 
 
@@ -321,7 +322,9 @@ def make_tp_stage_fns(family, cfg: TransformerConfig,
         partial(run, prefill=False), mesh=mesh,
         in_specs=(p_specs, P(), c_specs, P()), out_specs=(P(), c_specs),
         check_vma=False))
-    return prefill_fn, decode_fn
+    # p_specs is returned so callers place params with the SAME specs the
+    # program compiled against (drift would silently reshard every call)
+    return prefill_fn, decode_fn, p_specs
 
 
 class DecodePipeline:
@@ -370,12 +373,11 @@ class DecodePipeline:
             params["blocks"] = _stage_blocks(params)
             if mesh is not None:
                 from jax.sharding import NamedSharding
-                pre, dec = make_tp_stage_fns(family, cfg, sc, mesh, params,
-                                             axis=tp_axis)
+                pre, dec, p_specs = make_tp_stage_fns(
+                    family, cfg, sc, mesh, params, axis=tp_axis)
                 params = jax.tree_util.tree_map(
                     lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-                    params, tp_param_specs(params, cfg, mesh.shape[tp_axis],
-                                           tp_axis))
+                    params, p_specs)
             else:
                 pre, dec = make_stage_fns(family, cfg, sc)
                 if devices is not None:
