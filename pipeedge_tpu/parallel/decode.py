@@ -405,10 +405,15 @@ class DecodePipeline:
             caches.append(c)
         return caches
 
-    def generate(self, ids, new_tokens: int):
-        """Greedy-decode `new_tokens` continuations of prompt `ids` [B, S].
+    def generate(self, ids, new_tokens: int, temperature: float = 0.0,
+                 top_k: int = 0, seed: int = 0, step_callback=None):
+        """Decode `new_tokens` continuations of prompt `ids` [B, S].
 
-        Returns [B, S + new_tokens] token ids (prompt included)."""
+        `temperature=0` (default) is greedy argmax; otherwise tokens are
+        sampled from logits/temperature, optionally truncated to the
+        `top_k` most likely. `step_callback(step, tokens)` fires after each
+        decode step (e.g. for monitoring heartbeats). Returns
+        [B, S + new_tokens] token ids (prompt included)."""
         ids = jnp.asarray(ids, jnp.int32)
         batch, prompt_len = ids.shape
         if new_tokens <= 0:
@@ -416,13 +421,28 @@ class DecodePipeline:
         if prompt_len + new_tokens > self.max_len:
             raise ValueError(f"prompt {prompt_len} + {new_tokens} new tokens "
                              f"exceeds max_len {self.max_len}")
+        rng = jax.random.PRNGKey(seed)
+
+        @jax.jit
+        def pick(logits, rng):
+            if temperature <= 0.0:
+                return jnp.argmax(logits, axis=-1)
+            logits = logits / jnp.float32(temperature)
+            if top_k > 0:
+                kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+                logits = jnp.where(logits >= kth, logits, -jnp.inf)
+            return jax.random.categorical(rng, logits, axis=-1)
+
         caches = self._fresh_caches(batch)
         data = ids
         for i, st in enumerate(self.stages):
             if st["device"] is not None:
                 data = jax.device_put(data, st["device"])
             data, caches[i] = st["prefill"](st["params"], data, caches[i])
-        tokens = [jnp.argmax(data[:, prompt_len - 1], axis=-1)]
+        rng, sub = jax.random.split(rng)
+        tokens = [pick(data[:, prompt_len - 1].astype(jnp.float32), sub)]
+        if step_callback is not None:
+            step_callback(0, tokens[-1])
         for step in range(1, new_tokens):
             pos = prompt_len + step - 1
             data = tokens[-1][:, None]
@@ -431,5 +451,8 @@ class DecodePipeline:
                     data = jax.device_put(data, st["device"])
                 data, caches[i] = st["decode"](st["params"], data, caches[i],
                                                pos)
-            tokens.append(jnp.argmax(data[:, 0], axis=-1))
+            rng, sub = jax.random.split(rng)
+            tokens.append(pick(data[:, 0].astype(jnp.float32), sub))
+            if step_callback is not None:
+                step_callback(step, tokens[-1])
         return jnp.concatenate([ids, jnp.stack(tokens, axis=1)], axis=1)

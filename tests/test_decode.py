@@ -110,6 +110,33 @@ def test_int8_kv_cache_close_to_exact(gpt2_setup):
         decode.init_cache(cfg, 2, 1, 8, cache_bits=4)
 
 
+def test_sampling_and_step_callback(gpt2_setup):
+    """Temperature sampling: deterministic per seed, varies across seeds,
+    stays in-vocab; temperature=0 equals greedy; callback fires per step."""
+    cfg, weights, _ = gpt2_setup
+    partition = [(1, 12)]
+    pipe = decode.DecodePipeline(
+        gpt2_mod.FAMILY, cfg, partition,
+        _stage_params(cfg, partition, weights), max_len=32)
+    ids = np.asarray(
+        np.random.default_rng(41).integers(0, 100, size=(2, 6)), np.int64)
+    steps = []
+    greedy = np.asarray(pipe.generate(
+        ids, 8, temperature=0.0, step_callback=lambda s, t: steps.append(s)))
+    assert steps == list(range(8))
+    greedy2 = np.asarray(pipe.generate(ids, 8))
+    np.testing.assert_array_equal(greedy, greedy2)
+    s_a = np.asarray(pipe.generate(ids, 8, temperature=0.9, seed=1))
+    s_a2 = np.asarray(pipe.generate(ids, 8, temperature=0.9, seed=1))
+    s_b = np.asarray(pipe.generate(ids, 8, temperature=0.9, seed=2))
+    np.testing.assert_array_equal(s_a, s_a2)
+    assert not np.array_equal(s_a, s_b)
+    assert s_a[:, 6:].min() >= 0 and s_a[:, 6:].max() < 100
+    # top-k=1 collapses sampling to greedy regardless of temperature
+    top1 = np.asarray(pipe.generate(ids, 8, temperature=0.9, top_k=1, seed=3))
+    np.testing.assert_array_equal(top1, greedy)
+
+
 def test_tp_decode_matches_plain(gpt2_setup):
     """Megatron tensor-parallel decode (head-sharded KV cache, 2 psums per
     block under shard_map) generates the same tokens as the single-device

@@ -49,6 +49,16 @@ def main():
     parser.add_argument("--tp", default=1, type=int,
                         help="Megatron tensor-parallel degree per stage "
                              "(head-sharded KV cache, shard_map)")
+    parser.add_argument("--temperature", default=0.0, type=float,
+                        help="sampling temperature (0 = greedy)")
+    parser.add_argument("--top-k", default=0, type=int,
+                        help="sample only from the k most likely tokens "
+                             "(0 = full distribution)")
+    parser.add_argument("--seed", default=0, type=int,
+                        help="sampling PRNG seed")
+    parser.add_argument("--monitor", action="store_true",
+                        help="record per-step heartbeats to decode.csv "
+                             "(overwrites an existing decode.csv in cwd)")
     args = parser.parse_args()
 
     cfg = registry.get_model_config(args.model_name)
@@ -80,12 +90,34 @@ def main():
         args.model_name).family.FAMILY, cfg, partition, stage_params,
         max_len=max_len, dtype=dtype, cache_bits=args.kv_bits, mesh=mesh)
 
+    heartbeat = None
+    if args.monitor:
+        import jax
+        import monitoring
+        monitoring.init("decode", window_size=16, work_type="tokens")
+
+        def heartbeat(step, tokens):
+            # per-step heartbeat -> decode.csv. JAX dispatch is async, so
+            # fence on the step's tokens to time real emission, not host
+            # dispatch. The first beat establishes the time base
+            # (runtime.py's safe=False pattern), so decode.csv carries
+            # new_tokens - 1 intervals.
+            jax.block_until_ready(tokens)
+            monitoring.iteration("decode", work=int(tokens.shape[0]),
+                                 safe=False)
+
+    sample_kw = dict(temperature=args.temperature, top_k=args.top_k,
+                     seed=args.seed)
     ids = np.random.default_rng(0).integers(
         0, cfg.vocab_size, size=(args.batch_size, args.prompt_len))
-    out = np.asarray(pipe.generate(ids, 2))     # compile prefill+decode
+    out = np.asarray(pipe.generate(ids, 2, **sample_kw))  # compile programs
     tik = time.monotonic()
-    out = np.asarray(pipe.generate(ids, args.new_tokens))
+    out = np.asarray(pipe.generate(ids, args.new_tokens,
+                                   step_callback=heartbeat, **sample_kw))
     dt = time.monotonic() - tik
+    if args.monitor:
+        import monitoring
+        monitoring.finish()
     print(f"generated {args.batch_size}x{args.new_tokens} tokens in "
           f"{dt:.3f}s = {args.batch_size * args.new_tokens / dt:.1f} tok/s "
           f"({len(partition)} stages)")
