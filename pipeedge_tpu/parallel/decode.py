@@ -327,6 +327,50 @@ def make_tp_stage_fns(family, cfg: TransformerConfig,
     return prefill_fn, decode_fn, p_specs
 
 
+def validate_partition(partition: Sequence[Tuple[int, int]],
+                       total: int) -> None:
+    """Require `partition` to contiguously cover [1, total] in order."""
+    expect = 1
+    for l, r in partition:
+        if l != expect:
+            raise ValueError(f"partition {list(partition)} does not "
+                             f"contiguously cover [1, {total}]")
+        expect = r + 1
+    if expect != total + 1:
+        raise ValueError(f"partition {list(partition)} does not "
+                         f"contiguously cover [1, {total}]")
+
+
+def validate_capacity(cfg: TransformerConfig, max_len: int,
+                      prompt_len: int = 0, new_tokens: int = 0) -> None:
+    """Reject cache/position overflows up front: dynamic_update_slice
+    clamps out-of-range starts, so an overflow would silently corrupt the
+    last cache row instead of erroring."""
+    if cfg.max_position_embeddings and max_len > cfg.max_position_embeddings:
+        raise ValueError(f"max_len {max_len} exceeds the model's "
+                         f"{cfg.max_position_embeddings} positions")
+    if prompt_len + new_tokens > max_len:
+        raise ValueError(f"prompt {prompt_len} + {new_tokens} new tokens "
+                         f"exceeds max_len {max_len}")
+
+
+def make_token_picker(temperature: float = 0.0, top_k: int = 0):
+    """Jitted `pick(logits [B, V], rng) -> tokens [B]`: greedy argmax at
+    temperature 0, else categorical sampling over logits/temperature,
+    optionally truncated to the `top_k` most likely."""
+    @jax.jit
+    def pick(logits, rng):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1)
+        scaled = logits / jnp.float32(temperature)
+        if top_k > 0:
+            kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]
+            scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
+        return jax.random.categorical(rng, scaled, axis=-1)
+
+    return pick
+
+
 class DecodePipeline:
     """Host-driven pipelined greedy decoding over block-aligned stages.
 
@@ -344,18 +388,8 @@ class DecodePipeline:
                  devices: Optional[Sequence] = None, dtype=jnp.float32,
                  cache_bits: int = 0, mesh=None, tp_axis: str = "tp"):
         total = 4 * cfg.num_hidden_layers
-        expect = 1
-        for l, r in partition:
-            if l != expect:
-                raise ValueError(f"partition {list(partition)} does not "
-                                 f"contiguously cover [1, {total}]")
-            expect = r + 1
-        if expect != total + 1:
-            raise ValueError(f"partition {list(partition)} does not "
-                             f"contiguously cover [1, {total}]")
-        if cfg.max_position_embeddings and max_len > cfg.max_position_embeddings:
-            raise ValueError(f"max_len {max_len} exceeds the model's "
-                             f"{cfg.max_position_embeddings} positions")
+        validate_partition(partition, total)
+        validate_capacity(cfg, max_len)
         if mesh is not None and cache_bits:
             raise ValueError("int8 KV cache is not supported under tensor "
                              "parallelism (per-device scale rows diverge)")
@@ -418,20 +452,9 @@ class DecodePipeline:
         batch, prompt_len = ids.shape
         if new_tokens <= 0:
             return ids
-        if prompt_len + new_tokens > self.max_len:
-            raise ValueError(f"prompt {prompt_len} + {new_tokens} new tokens "
-                             f"exceeds max_len {self.max_len}")
+        validate_capacity(self.cfg, self.max_len, prompt_len, new_tokens)
         rng = jax.random.PRNGKey(seed)
-
-        @jax.jit
-        def pick(logits, rng):
-            if temperature <= 0.0:
-                return jnp.argmax(logits, axis=-1)
-            logits = logits / jnp.float32(temperature)
-            if top_k > 0:
-                kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
-                logits = jnp.where(logits >= kth, logits, -jnp.inf)
-            return jax.random.categorical(rng, logits, axis=-1)
+        pick = make_token_picker(temperature, top_k)
 
         caches = self._fresh_caches(batch)
         data = ids

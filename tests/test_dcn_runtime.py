@@ -51,15 +51,17 @@ class _OutReader:
 
 
 def _run_fleet(tmp_path, opts, world, env_extra=None, per_rank_dirs=False,
-               data_timeout=300):
+               data_timeout=300, script="runtime.py",
+               rank_argv=lambda r, world: [str(r), str(world)]):
     """Launch a `world`-rank DCN fleet (workers as Popen, the data rank in
     the foreground), collect everyone's output.
 
     Returns (data CompletedProcess, [worker stdout by rank], rank_dirs).
     `opts` excludes --dcn-addrs (allocated here). Worker processes are
-    always killed on exit."""
+    always killed on exit. `script` (repo-relative) + `rank_argv(r, world)`
+    cover CLIs with other rank conventions (e.g. tools/generate.py)."""
     addrs = ",".join(f"127.0.0.1:{p}" for p in _free_ports(world))
-    common = [sys.executable, os.path.join(REPO, "runtime.py")]
+    common = [sys.executable, os.path.join(REPO, script)]
     argv = opts + ["--dcn-addrs", addrs]
     env = dict(os.environ, PYTHONPATH=REPO, **(env_extra or {}))
     if per_rank_dirs:
@@ -70,13 +72,13 @@ def _run_fleet(tmp_path, opts, world, env_extra=None, per_rank_dirs=False,
             rank_dirs.append(d)
     else:
         rank_dirs = [tmp_path] * world
-    workers = [subprocess.Popen(common + [str(r), str(world)] + argv,
+    workers = [subprocess.Popen(common + rank_argv(r, world) + argv,
                                 cwd=rank_dirs[r], env=env,
                                 stdout=subprocess.PIPE,
                                 stderr=subprocess.STDOUT, text=True)
                for r in range(1, world)]
     try:
-        data = subprocess.run(common + ["0", str(world)] + argv,
+        data = subprocess.run(common + rank_argv(0, world) + argv,
                               cwd=rank_dirs[0], env=env, capture_output=True,
                               text=True, timeout=data_timeout)
         wouts = [w.communicate(timeout=60)[0] for w in workers]

@@ -181,6 +181,47 @@ def test_generate_cli(tmp_path):
         assert "tok/s" in proc.stdout
 
 
+def test_generate_dcn_matches_local(tmp_path):
+    """Pipelined decoding across two OS processes over TCP produces the
+    same greedy continuation as the local two-stage pipeline (shared
+    weights file)."""
+    import os
+    import subprocess
+    import sys
+
+    from test_dcn_runtime import _run_fleet
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo,
+               DCN_CONNECT_TIMEOUT="20")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "save_model_weights.py"),
+         "-m", "pipeedge/test-tiny-gpt2", "--random"],
+        capture_output=True, env=env, cwd=str(tmp_path), text=True,
+        timeout=300)
+    assert proc.returncode == 0, proc.stderr
+    npz = str(tmp_path / "test-tiny-gpt2.npz")
+
+    opts = ["-m", "pipeedge/test-tiny-gpt2", "-M", npz, "-pt", "1,4,5,8",
+            "-b", "2", "--prompt-len", "6", "--new-tokens", "5"]
+    local = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "generate.py")] + opts,
+        capture_output=True, env=env, cwd=str(tmp_path), text=True,
+        timeout=300)
+    assert local.returncode == 0, local.stderr
+    want = [l for l in local.stdout.splitlines() if "continuation" in l]
+    assert want
+
+    data, _, _ = _run_fleet(
+        tmp_path, opts, world=2,
+        env_extra={"JAX_PLATFORMS": "cpu", "DCN_CONNECT_TIMEOUT": "20"},
+        script="tools/generate.py",
+        rank_argv=lambda rank, world: ["--rank", str(rank)])
+    assert data.returncode == 0, data.stdout + data.stderr
+    got = [l for l in data.stdout.splitlines() if "continuation" in l]
+    assert got == want, (got, want)
+    assert "2 DCN ranks" in data.stdout
+
+
 def test_decode_validation_errors(gpt2_setup):
     cfg, weights, _ = gpt2_setup
     with pytest.raises(ValueError, match="block-aligned"):

@@ -18,6 +18,108 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
+def run_dcn(args, cfg, total, partition, max_len, dtype):
+    """Pipelined decoding across OS processes over TCP (DCN): stage i runs
+    on rank i; every rank launches the same command with its own --rank, so
+    the step count is known fleet-wide and no control plane is needed. Per
+    step, the token's hidden state hops rank-to-rank on CHANNEL_DATA and
+    the last rank returns the next-token logits to rank 0 on
+    CHANNEL_RESULTS (the same edge discipline as runtime.py's DCN driver).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from pipeedge_tpu.comm import dcn
+    from pipeedge_tpu.models import registry
+    from pipeedge_tpu.parallel import decode
+
+    world = len(partition)
+    rank = args.rank
+    if not 0 <= rank < world:
+        raise SystemExit(f"--rank {rank} outside the {world}-stage partition")
+    decode.validate_partition(partition, total)
+    decode.validate_capacity(cfg, max_len, args.prompt_len, args.new_tokens)
+    addrs = dcn.parse_rank_addrs(args.dcn_addrs, world, 29600)
+    l, r = partition[rank]
+    _, params, sc = registry.module_shard_factory(
+        args.model_name, args.model_file, l, r, stage=rank, dtype=dtype,
+        unroll=False)
+    family = registry.get_model_entry(args.model_name).family.FAMILY
+    prefill_fn, decode_fn = decode.make_stage_fns(family, cfg, sc)
+    params = dict(params)
+    params["blocks"] = decode._stage_blocks(params)
+    pick = decode.make_token_picker(args.temperature, args.top_k)
+    prompt = args.prompt_len
+    ids = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(args.batch_size, prompt))
+
+    with dcn.DistDcnContext(world, rank, addrs) as ctx:
+
+        def run_once(new_tokens):
+            """One full fleet-lockstep generation (prefill + steps). Every
+            rank executes the same step count, so no control plane is
+            needed; returns rank 0's tokens."""
+            cache = decode.init_cache(cfg, (r - l + 1) // 4,
+                                      args.batch_size, max_len, dtype)
+            rng = jax.random.PRNGKey(args.seed)
+            tokens = []
+
+            def stage_step(data, pos, fn):
+                nonlocal cache
+                if not sc.is_first:
+                    data = jnp.asarray(ctx.recv_tensors(rank - 1)[0],
+                                       dtype=dtype)
+                out, cache = fn(params, data, cache) if pos is None else \
+                    fn(params, data, cache, pos)
+                if not sc.is_last:
+                    ctx.send_tensors(rank + 1, [np.asarray(out)])
+                elif world > 1:
+                    # last position's logits back to rank 0
+                    last = out[:, -1] if pos is None else out[:, 0]
+                    ctx.send_tensors(0, [np.asarray(last)],
+                                     channel=dcn.CHANNEL_RESULTS)
+                return out
+
+            def next_token(out, pos):
+                nonlocal rng
+                if world > 1:
+                    logits = jnp.asarray(
+                        ctx.recv_tensors(world - 1,
+                                         channel=dcn.CHANNEL_RESULTS)[0])
+                else:
+                    logits = out[:, prompt - 1] if pos is None else out[:, 0]
+                rng, sub = jax.random.split(rng)
+                return pick(logits.astype(jnp.float32), sub)
+
+            out = stage_step(
+                jnp.asarray(ids, jnp.int32) if sc.is_first else None,
+                None, prefill_fn)
+            if rank == 0:
+                tokens.append(next_token(out, None))
+            for step in range(1, new_tokens):
+                pos = prompt + step - 1
+                data = tokens[-1][:, None] if sc.is_first else None
+                out = stage_step(data, pos, decode_fn)
+                if rank == 0:
+                    tokens.append(next_token(out, pos))
+            return tokens
+
+        run_once(min(2, args.new_tokens))   # compile programs fleet-wide
+        tik = time.monotonic()
+        tokens = run_once(args.new_tokens)
+        if rank == 0:
+            dt = time.monotonic() - tik
+            result = np.concatenate(
+                [ids, np.stack([np.asarray(t) for t in tokens], axis=1)],
+                axis=1)
+            print(f"generated {args.batch_size}x{args.new_tokens} tokens in "
+                  f"{dt:.3f}s = "
+                  f"{args.batch_size * args.new_tokens / dt:.1f} tok/s "
+                  f"({world} DCN ranks)")
+            print("sample continuation ids:",
+                  result[0, prompt:].tolist())
+
+
 def main():
     from pipeedge_tpu.utils import apply_env_platform
     apply_env_platform()
@@ -59,6 +161,13 @@ def main():
     parser.add_argument("--monitor", action="store_true",
                         help="record per-step heartbeats to decode.csv "
                              "(overwrites an existing decode.csv in cwd)")
+    parser.add_argument("--rank", default=0, type=int,
+                        help="this process's rank in a DCN fleet")
+    parser.add_argument("--dcn-addrs", default=None, type=str,
+                        help="comma-separated host:port per rank: run the "
+                             "pipeline across OS processes over TCP (stage "
+                             "i on rank i; launch the same command on every "
+                             "rank with its own --rank)")
     args = parser.parse_args()
 
     cfg = registry.get_model_config(args.model_name)
@@ -71,13 +180,19 @@ def main():
         partition = list(zip(nums[::2], nums[1::2]))
     else:
         partition = [(1, total)]
+    max_len = args.max_len or args.prompt_len + args.new_tokens
+    if args.dcn_addrs is not None:
+        if args.tp > 1 or args.kv_bits or args.monitor:
+            parser.error("--dcn-addrs does not compose with --tp/--kv-bits/"
+                         "--monitor in this demo")
+        run_dcn(args, cfg, total, partition, max_len, dtype)
+        return
     stage_params = []
     for i, (l, r) in enumerate(partition):
         _, params, _ = registry.module_shard_factory(
             args.model_name, args.model_file, l, r, stage=i, dtype=dtype,
             unroll=False)  # DecodePipeline wants the stacked block layout
         stage_params.append(params)
-    max_len = args.max_len or args.prompt_len + args.new_tokens
     mesh = None
     if args.tp > 1:
         import jax
