@@ -182,7 +182,7 @@ def _block_step_tp(p: Dict, x: jax.Array, bcache: Cache, pos,
     return y, new_cache
 
 
-def _stage_blocks(params: Dict) -> jax.Array:
+def stage_blocks(params: Dict) -> jax.Array:
     """The stacked blocks pytree of a decode stage (block-aligned shard)."""
     blocks = params.get("blocks")
     if blocks is None:
@@ -236,7 +236,7 @@ def _make_stage_run(family, cfg: TransformerConfig,
                     params["embeddings"]["wpe"], pos, 1)
                 data = jnp.take(params["embeddings"]["wte"], data,
                                 axis=0) + wpe[None]
-        data, cache = _run_blocks(_stage_blocks(params), data, cache, pos,
+        data, cache = _run_blocks(stage_blocks(params), data, cache, pos,
                                   cfg, prefill, block_fn=block_fn)
         if shard_config.is_last:
             data = (finalize_fn or family.finalize)(params["final"], data,
@@ -404,7 +404,7 @@ class DecodePipeline:
             sc = ShardConfig(l, r, is_first=l == 1, is_last=r == total)
             params = dict(stage_params[i])
             # restack an unrolled block layout ONCE here, not per traced call
-            params["blocks"] = _stage_blocks(params)
+            params["blocks"] = stage_blocks(params)
             if mesh is not None:
                 from jax.sharding import NamedSharding
                 pre, dec, p_specs = make_tp_stage_fns(

@@ -68,7 +68,7 @@ def test_decode_matches_teacher_forcing(gpt2_setup):
         np.random.default_rng(5).integers(0, 100, size=(2, 10)), jnp.int32)
     cache = decode.init_cache(cfg, cfg.num_hidden_layers, 2, 16)
     params = dict(params)
-    params["blocks"] = decode._stage_blocks(params)
+    params["blocks"] = decode.stage_blocks(params)
 
     from pipeedge_tpu.models.shard import make_shard_fn
     full = np.asarray(make_shard_fn(gpt2_mod.FAMILY, cfg, sc)(params,
@@ -89,7 +89,7 @@ def test_int8_kv_cache_close_to_exact(gpt2_setup):
     total = 4 * cfg.num_hidden_layers
     sc = ShardConfig(1, total, is_first=True, is_last=True)
     params = dict(gpt2_mod.load_params(cfg, sc, weights))
-    params["blocks"] = decode._stage_blocks(params)
+    params["blocks"] = decode.stage_blocks(params)
     pre, dec = decode.make_stage_fns(gpt2_mod.FAMILY, cfg, sc)
     ids = jnp.asarray(
         np.random.default_rng(6).integers(0, 100, size=(2, 10)), jnp.int32)

@@ -78,6 +78,24 @@ def test_reuse_identical_matches_exhaustive(profile_results, monkeypatch):
             for d in exhaustive]
 
 
+def test_profile_gpt2_family():
+    """The causal-decoder family profiles per layer like the encoders:
+    token-id inputs, chained payloads (incl. mid-block 2-tuples), LM-head
+    logits at the end — so the profile -> models.yml/device_types.yml ->
+    sched-pipeline loop covers GPT-2 unchanged."""
+    model = "pipeedge/test-tiny-gpt2"
+    inputs = prof.default_inputs(model, 2)
+    assert inputs.dtype == jnp.int32 and inputs.shape == (2, 64)
+    results = prof.profile_layers_individually(
+        model, None, inputs, 1, registry.get_model_layers(model),
+        warmup=True, iterations=2)
+    assert [d["layer"] for d in results] == list(range(1, 9))
+    for a, b in zip(results, results[1:]):
+        assert a["shape_out"] == b["shape_in"]
+    assert len(results[0]["shape_out"]) == 2    # (ctx, residual) mid-block
+    assert results[-1]["shape_out"] == [[64, 100]]  # per-token vocab logits
+
+
 def test_validate_profile_results(profile_results):
     prof.validate_profile_results(profile_results, MODEL, "float32", 2, 8, 9, 9)
     with pytest.raises(AssertionError):

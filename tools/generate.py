@@ -18,6 +18,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
+def prompt_ids(args, cfg):
+    """Synthetic prompt token ids [B, prompt_len] (seeded, rank-consistent)."""
+    return np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(args.batch_size, args.prompt_len))
+
+
+def print_summary(args, dt, result, label):
+    print(f"generated {args.batch_size}x{args.new_tokens} tokens in "
+          f"{dt:.3f}s = {args.batch_size * args.new_tokens / dt:.1f} tok/s "
+          f"({label})")
+    print("sample continuation ids:", result[0, args.prompt_len:].tolist())
+
+
 def run_dcn(args, cfg, total, partition, max_len, dtype):
     """Pipelined decoding across OS processes over TCP (DCN): stage i runs
     on rank i; every rank launches the same command with its own --rank, so
@@ -47,11 +60,10 @@ def run_dcn(args, cfg, total, partition, max_len, dtype):
     family = registry.get_model_entry(args.model_name).family.FAMILY
     prefill_fn, decode_fn = decode.make_stage_fns(family, cfg, sc)
     params = dict(params)
-    params["blocks"] = decode._stage_blocks(params)
+    params["blocks"] = decode.stage_blocks(params)
     pick = decode.make_token_picker(args.temperature, args.top_k)
     prompt = args.prompt_len
-    ids = np.random.default_rng(0).integers(
-        0, cfg.vocab_size, size=(args.batch_size, prompt))
+    ids = prompt_ids(args, cfg)
 
     with dcn.DistDcnContext(world, rank, addrs) as ctx:
 
@@ -112,12 +124,7 @@ def run_dcn(args, cfg, total, partition, max_len, dtype):
             result = np.concatenate(
                 [ids, np.stack([np.asarray(t) for t in tokens], axis=1)],
                 axis=1)
-            print(f"generated {args.batch_size}x{args.new_tokens} tokens in "
-                  f"{dt:.3f}s = "
-                  f"{args.batch_size * args.new_tokens / dt:.1f} tok/s "
-                  f"({world} DCN ranks)")
-            print("sample continuation ids:",
-                  result[0, prompt:].tolist())
+            print_summary(args, dt, result, f"{world} DCN ranks")
 
 
 def main():
@@ -169,6 +176,8 @@ def main():
                              "i on rank i; launch the same command on every "
                              "rank with its own --rank)")
     args = parser.parse_args()
+    if args.new_tokens < 1:
+        parser.error("--new-tokens must be >= 1")
 
     cfg = registry.get_model_config(args.model_name)
     total = registry.get_model_layers(args.model_name)
@@ -223,8 +232,7 @@ def main():
 
     sample_kw = dict(temperature=args.temperature, top_k=args.top_k,
                      seed=args.seed)
-    ids = np.random.default_rng(0).integers(
-        0, cfg.vocab_size, size=(args.batch_size, args.prompt_len))
+    ids = prompt_ids(args, cfg)
     out = np.asarray(pipe.generate(ids, 2, **sample_kw))  # compile programs
     tik = time.monotonic()
     out = np.asarray(pipe.generate(ids, args.new_tokens,
@@ -233,10 +241,7 @@ def main():
     if args.monitor:
         import monitoring
         monitoring.finish()
-    print(f"generated {args.batch_size}x{args.new_tokens} tokens in "
-          f"{dt:.3f}s = {args.batch_size * args.new_tokens / dt:.1f} tok/s "
-          f"({len(partition)} stages)")
-    print("sample continuation ids:", out[0, args.prompt_len:].tolist())
+    print_summary(args, dt, out, f"{len(partition)} stages")
 
 
 if __name__ == "__main__":
