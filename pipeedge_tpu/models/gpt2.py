@@ -65,9 +65,20 @@ def sublayer(p: Dict, sub: int, data, cfg: TransformerConfig,
         return dense(p["attn_out"], ctx) + skip
     if sub == 2:
         normed = layer_norm(p["ln_after"], data, cfg.layer_norm_eps)
+        if cfg.n_experts:
+            # switch-FFN (Switch Transformer top-1): the whole routed
+            # expert computation lives in sublayer 2 (capacity routing
+            # cannot span a pipeline cut), so the sublayer-2 edge carries
+            # (delta, residual) like the dense path's (mlp_h, residual)
+            from ..parallel.expert import moe_ffn_delta
+            delta = moe_ffn_delta(p["moe"], normed, cfg.n_experts,
+                                  cfg.capacity_factor, act=gelu_new)
+            return (delta, data)
         return (gelu_new(dense(p["mlp_up"], normed)), data)
     if sub == 3:
         mlp_h, skip = data
+        if cfg.n_experts:
+            return mlp_h + skip      # delta from sublayer 2 + residual
         return dense(p["mlp_down"], mlp_h) + skip
     raise ValueError(f"sublayer must be 0..3, got {sub}")
 
@@ -123,9 +134,24 @@ def load_params(cfg: TransformerConfig, shard_config: ShardConfig,
         if 2 in subs:
             p["ln_after"] = {"scale": _a(sd[root + "ln_2.weight"], dtype),
                              "bias": _a(sd[root + "ln_2.bias"], dtype)}
-            p["mlp_up"] = {"w": _a(sd[root + "mlp.c_fc.weight"], dtype),
-                           "b": _a(sd[root + "mlp.c_fc.bias"], dtype)}
-        if 3 in subs:
+            if cfg.n_experts:
+                p["moe"] = {
+                    "router": {
+                        "w": _a(sd[root + "moe.router.weight"], dtype),
+                        "b": _a(sd[root + "moe.router.bias"], dtype)},
+                    "experts": {
+                        "mlp_up": {
+                            "w": _a(sd[root + "moe.experts.c_fc.weight"], dtype),
+                            "b": _a(sd[root + "moe.experts.c_fc.bias"], dtype)},
+                        "mlp_down": {
+                            "w": _a(sd[root + "moe.experts.c_proj.weight"], dtype),
+                            "b": _a(sd[root + "moe.experts.c_proj.bias"], dtype)},
+                    },
+                }
+            else:
+                p["mlp_up"] = {"w": _a(sd[root + "mlp.c_fc.weight"], dtype),
+                               "b": _a(sd[root + "mlp.c_fc.bias"], dtype)}
+        if 3 in subs and not cfg.n_experts:
             p["mlp_down"] = {"w": _a(sd[root + "mlp.c_proj.weight"], dtype),
                              "b": _a(sd[root + "mlp.c_proj.bias"], dtype)}
         return p
@@ -138,6 +164,41 @@ def load_params(cfg: TransformerConfig, shard_config: ShardConfig,
                          "b": jnp.zeros((np.asarray(head).shape[0],), dtype)}}
 
     return build_shard_params(shard_config, get_embed, get_block, get_final)
+
+
+def moe_state_dict(cfg: TransformerConfig, seed: int = 0) -> Dict:
+    """Deterministic random full-model state dict for MoE configs, in the
+    flat npz key layout `load_params` reads (`h.{i}.moe.*` for the routed
+    FFN). No pretrained checkpoints exist for this synthetic family, so
+    this is the weights-file story (save_model_weights.py --random)."""
+    assert cfg.n_experts > 0
+    rng = np.random.default_rng(seed)
+    d, it, e = cfg.hidden_size, cfg.intermediate_size, cfg.n_experts
+
+    def mat(*shape):
+        return rng.normal(0, 0.02, size=shape).astype(np.float32)
+
+    sd = {"wte.weight": mat(cfg.vocab_size, d),
+          "wpe.weight": mat(cfg.max_position_embeddings, d),
+          "ln_f.weight": np.ones(d, np.float32),
+          "ln_f.bias": np.zeros(d, np.float32)}
+    for i in range(cfg.num_hidden_layers):
+        root = f"h.{i}."
+        sd[root + "ln_1.weight"] = np.ones(d, np.float32)
+        sd[root + "ln_1.bias"] = np.zeros(d, np.float32)
+        sd[root + "attn.c_attn.weight"] = mat(d, 3 * d)
+        sd[root + "attn.c_attn.bias"] = np.zeros(3 * d, np.float32)
+        sd[root + "attn.c_proj.weight"] = mat(d, d)
+        sd[root + "attn.c_proj.bias"] = np.zeros(d, np.float32)
+        sd[root + "ln_2.weight"] = np.ones(d, np.float32)
+        sd[root + "ln_2.bias"] = np.zeros(d, np.float32)
+        sd[root + "moe.router.weight"] = mat(d, e)
+        sd[root + "moe.router.bias"] = np.zeros(e, np.float32)
+        sd[root + "moe.experts.c_fc.weight"] = mat(e, d, it)
+        sd[root + "moe.experts.c_fc.bias"] = np.zeros((e, it), np.float32)
+        sd[root + "moe.experts.c_proj.weight"] = mat(e, it, d)
+        sd[root + "moe.experts.c_proj.bias"] = np.zeros((e, d), np.float32)
+    return sd
 
 
 def init_params(cfg: TransformerConfig, shard_config: ShardConfig,
@@ -169,8 +230,20 @@ def init_params(cfg: TransformerConfig, shard_config: ShardConfig,
             p["attn_out"] = {"w": mat(d, d), "b": vec(d)}
         if 2 in subs:
             p["ln_after"] = ln()
-            p["mlp_up"] = {"w": mat(d, it), "b": vec(it)}
-        if 3 in subs:
+            if cfg.n_experts:
+                e = cfg.n_experts
+                p["moe"] = {
+                    "router": {"w": mat(d, e), "b": vec(e)},
+                    "experts": {
+                        "mlp_up": {"w": mat(e, d, it),
+                                   "b": jnp.zeros((e, it), dtype)},
+                        "mlp_down": {"w": mat(e, it, d),
+                                     "b": jnp.zeros((e, d), dtype)},
+                    },
+                }
+            else:
+                p["mlp_up"] = {"w": mat(d, it), "b": vec(it)}
+        if 3 in subs and not cfg.n_experts:
             p["mlp_down"] = {"w": mat(it, d), "b": vec(d)}
         return p
 

@@ -35,6 +35,9 @@ class TransformerConfig:
     vocab_size: int = 0
     max_position_embeddings: int = 0
     type_vocab_size: int = 2
+    # mixture-of-experts (switch-FFN blocks; 0 = dense FFN)
+    n_experts: int = 0
+    capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:

@@ -60,12 +60,13 @@ def _deit(name, layers, weights, hidden, blocks, heads, inter):
 
 
 def _gpt2(name, layers, weights, hidden, blocks, heads, inter,
-          vocab=50257, max_pos=1024):
+          vocab=50257, max_pos=1024, n_experts=0, capacity_factor=1.25):
     return ModelEntry(name, layers, weights, gpt2_mod, TransformerConfig(
         model_type="gpt2", hidden_size=hidden, num_hidden_layers=blocks,
         num_attention_heads=heads, intermediate_size=inter,
         layer_norm_eps=1e-5, vocab_size=vocab,
-        max_position_embeddings=max_pos))
+        max_position_embeddings=max_pos, n_experts=n_experts,
+        capacity_factor=capacity_factor))
 
 
 _MODELS: Dict[str, ModelEntry] = {e.name: e for e in [
@@ -85,12 +86,21 @@ _MODELS: Dict[str, ModelEntry] = {e.name: e for e in [
     # causal-decoder family: beyond the reference's encoder-only list
     _gpt2("gpt2", 48, "GPT2.npz", 768, 12, 12, 3072),
     _gpt2("gpt2-medium", 96, "GPT2-M.npz", 1024, 24, 16, 4096),
+    # synthetic switch-MoE decoder (top-1 routed FFN, 8 experts/block)
+    _gpt2("pipeedge/gpt2-moe-8e", 48, "GPT2-MoE-8E.npz", 768, 12, 12, 3072,
+          n_experts=8),
     # tiny synthetic models for fast tests / CI (not in the reference's list)
     _vit("pipeedge/test-tiny-vit", 8, "test-tiny-vit.npz", 32, 2, 4, 64, 5,
          patch=4, img=16),
     _bert("pipeedge/test-tiny-bert", 8, "test-tiny-bert.npz", 32, 2, 4, 64, 2),
     _gpt2("pipeedge/test-tiny-gpt2", 8, "test-tiny-gpt2.npz", 32, 2, 4, 64,
           vocab=100, max_pos=64),
+    # capacity_factor = n_experts -> no capacity drops: routing is then a
+    # pure per-token top-1 gate, which is causal and batch-size-invariant,
+    # so cached decode and split pipelines match the full forward exactly
+    # (capacity-bounded models trade that exactness for bounded compute)
+    _gpt2("pipeedge/test-tiny-moe", 8, "test-tiny-moe.npz", 32, 2, 4, 64,
+          vocab=100, max_pos=64, n_experts=4, capacity_factor=4.0),
 ]}
 
 

@@ -157,7 +157,18 @@ def _block_step(p: Dict, x: jax.Array, bcache: Cache, pos,
     ctx = _attend(q, k, v, keep, cfg)
     x = dense(p["attn_out"], ctx) + x
     normed = layer_norm(p["ln_after"], x, cfg.layer_norm_eps)
-    x = dense(p["mlp_down"], gelu_new(dense(p["mlp_up"], normed))) + x
+    if cfg.n_experts:
+        # Capacity routing is NOT causal: a full-sequence forward lets
+        # tokens compete for expert slots across the whole sequence, which
+        # a cached decode step (routing only the current tokens) cannot
+        # reproduce. With capacity_factor >= n_experts (no drops) routing
+        # is a pure per-token gate and decode matches the forward exactly;
+        # capacity-bounded models route each step's token set on its own.
+        from .expert import moe_ffn_delta
+        x = x + moe_ffn_delta(p["moe"], normed, cfg.n_experts,
+                              cfg.capacity_factor, act=gelu_new)
+    else:
+        x = dense(p["mlp_down"], gelu_new(dense(p["mlp_up"], normed))) + x
     return x, bcache
 
 
@@ -297,6 +308,10 @@ def make_tp_stage_fns(family, cfg: TransformerConfig,
     if cfg.num_attention_heads % n:
         raise ValueError(f"tp={n} requires head count "
                          f"({cfg.num_attention_heads}) divisible by tp")
+    if cfg.n_experts:
+        raise NotImplementedError(
+            "tensor-parallel decode does not cover MoE blocks (experts "
+            "shard over 'ep', not 'tp')")
 
     def tp_finalize(pf, hidden, cfg):
         # final LN replicated; LM head column-sharded over the vocab, local

@@ -14,6 +14,15 @@ Routing semantics (Switch Transformer style, top-1):
 - per expert, the C highest-probability tokens assigned to it are kept
   (C = capacity_factor * T / E, rounded up); overflow tokens pass through
   unchanged (the standard capacity-drop residual behavior).
+- T is the token set the caller presents: under data parallelism each dp
+  shard routes its own tokens with its own capacity (the standard
+  data-parallel MoE semantics) — outputs are batch-size-dependent by
+  construction, like any capacity-routed MoE.
+
+The GPT-2 family consumes this as `moe_ffn_delta` for its routed-FFN
+blocks (models/gpt2.py, registry models pipeedge/gpt2-moe-8e and
+pipeedge/test-tiny-moe), so MoE decoders run through the shard engine,
+host/SPMD pipelines, and KV-cache decoding (tests/test_moe_family.py).
 
 Exactness: `ep_ffn` over an n-device 'ep' axis matches the single-device
 reference (`reference_moe_ffn`) to float tolerance (the distributed
@@ -74,13 +83,48 @@ def _routing(router, x, n_experts: int, capacity: int):
     return expert, gate, keep, kept
 
 
+def moe_capacity(n_tokens: int, n_experts: int,
+                 capacity_factor: float) -> int:
+    """Per-expert token capacity (static; standard switch formula)."""
+    return max(1, min(n_tokens,
+                      math.ceil(capacity_factor * n_tokens / n_experts)))
+
+
+def moe_ffn_delta(params: Dict, normed: jax.Array, n_experts: int,
+                  capacity_factor: float = 1.25,
+                  act=gelu) -> jax.Array:
+    """Single-device switch-FFN **delta**: gate * expert(normed) per kept
+    token, zeros for capacity-dropped tokens. Pre-LN families add this to
+    the raw residual (h = x + delta), so the residual semantics live with
+    the caller — this is the form the GPT-2 MoE blocks use
+    (models/gpt2.py). Jittable; expert loop is vmapped."""
+    b, s, d = normed.shape
+    tokens = normed.reshape(-1, d)
+    capacity = moe_capacity(tokens.shape[0], n_experts, capacity_factor)
+    _, gate, keep, kept = _routing(params["router"], tokens, n_experts,
+                                   capacity)
+
+    def one_expert(w_up, b_up, w_down, b_down, ids, valid):
+        xe = tokens[ids]
+        up = act(xe @ w_up + b_up)
+        ye = up @ w_down + b_down
+        return jnp.where(valid[:, None], ye * gate[ids][:, None], 0.0), ids
+
+    deltas, ids = jax.vmap(one_expert)(
+        params["experts"]["mlp_up"]["w"], params["experts"]["mlp_up"]["b"],
+        params["experts"]["mlp_down"]["w"],
+        params["experts"]["mlp_down"]["b"], keep, kept)
+    delta = jnp.zeros_like(tokens).at[ids.reshape(-1)].add(
+        deltas.reshape(-1, d))
+    return delta.reshape(b, s, d).astype(normed.dtype)
+
+
 def reference_moe_ffn(params: Dict, x: jax.Array, n_experts: int,
                       capacity_factor: float = 1.25) -> jax.Array:
     """Single-device oracle: identical routing, experts applied in a loop."""
     b, s, d = x.shape
     tokens = x.reshape(-1, d)
-    t = tokens.shape[0]
-    capacity = max(1, min(t, math.ceil(capacity_factor * t / n_experts)))
+    capacity = moe_capacity(tokens.shape[0], n_experts, capacity_factor)
     _, gate, keep, kept = _routing(params["router"], tokens, n_experts,
                                    capacity)
     out = tokens  # capacity-dropped tokens pass through (residual)
@@ -97,7 +141,7 @@ def reference_moe_ffn(params: Dict, x: jax.Array, n_experts: int,
 
 
 def _ep_local(params: Dict, x: jax.Array, *, n_experts: int,
-              capacity: int, axis: str) -> jax.Array:
+              capacity: int, axis: str, act=gelu) -> jax.Array:
     """Per-device body under shard_map: local experts [E/n, ...], tokens
     replicated; each device computes its experts' capacity slots and a psum
     combines."""
@@ -115,7 +159,7 @@ def _ep_local(params: Dict, x: jax.Array, *, n_experts: int,
 
     def one_expert(w_up, b_up, w_down, b_down, ids, valid):
         xe = tokens[ids]
-        up = gelu(xe @ w_up + b_up)
+        up = act(xe @ w_up + b_up)
         ye = up @ w_down + b_down
         delta = ye * gate[ids][:, None]  # the token's residual stays put
         return jnp.where(valid[:, None], delta, 0.0), ids
@@ -132,7 +176,8 @@ def _ep_local(params: Dict, x: jax.Array, *, n_experts: int,
 
 
 def make_ep_ffn_fn(cfg: TransformerConfig, mesh: Mesh, n_experts: int,
-                   capacity_factor: float = 1.25, axis: str = "ep"):
+                   capacity_factor: float = 1.25, axis: str = "ep",
+                   act=gelu):
     """Jitted `fn(params, x) -> x`: switch-FFN with experts sharded over
     `axis`. Place params with `shard_moe_params` first. Token count must be
     static per call (standard XLA); capacity derives from it."""
@@ -151,12 +196,10 @@ def make_ep_ffn_fn(cfg: TransformerConfig, mesh: Mesh, n_experts: int,
 
     def fn(params, x):
         b, s, _ = x.shape
-        capacity = max(1, min(b * s,
-                              math.ceil(capacity_factor * b * s
-                                        / n_experts)))
+        capacity = moe_capacity(b * s, n_experts, capacity_factor)
         body = jax.shard_map(
             partial(_ep_local, n_experts=n_experts, capacity=capacity,
-                    axis=axis),
+                    axis=axis, act=act),
             mesh=mesh, in_specs=(param_specs, P()), out_specs=P(),
             check_vma=False)
         return body(params, x)

@@ -503,6 +503,13 @@ def build_spmd_pipeline(family: FamilySpec, cfg: TransformerConfig,
                 f"mesh tp={tp} must divide attention heads "
                 f"({cfg.num_attention_heads}) and intermediate size "
                 f"({cfg.intermediate_size})")
+    if cfg.n_experts and (tp > 1 or mesh.shape.get("sp", 1) > 1):
+        # tp: expert kernels shard over 'ep', not the Megatron table;
+        # sp: routing over a local sequence chunk changes the capacity
+        # semantics (per-chunk instead of global top-C) — refuse rather
+        # than silently compute something different from the oracle
+        raise NotImplementedError(
+            "MoE blocks do not compose with the 'tp'/'sp' mesh axes")
     params = {
         "embed": stage_params[0]["embeddings"],
         "final": stage_params[-1]["final"],

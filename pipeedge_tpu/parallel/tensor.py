@@ -108,6 +108,10 @@ def family_tp_plan(cfg: TransformerConfig):
     (param spec table, per-device block body). Every TP consumer — the
     placement helpers here and the SPMD pipeline's stacked specs/block
     body — goes through this, so adding a family is one edit."""
+    if cfg.n_experts:
+        raise NotImplementedError(
+            "Megatron TP does not cover MoE blocks (experts shard over "
+            "'ep', not the column/row kernel table)")
     if cfg.model_type == "bert":
         return _BERT_PARAM_SPECS, _tp_bert_block_local
     if cfg.model_type == "gpt2":

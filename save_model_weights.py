@@ -70,6 +70,13 @@ def save_weights(model_name: str, model_file: str, random_init: bool = False) ->
     """Convert an HF model to the reference npz format for `model_name`."""
     entry = registry.get_model_entry(model_name)
     cfg = entry.config
+    if cfg.n_experts:
+        # synthetic MoE family: no pretrained checkpoint exists to convert
+        if not random_init:
+            raise ValueError(f"{model_name} is a synthetic MoE model with "
+                             "no pretrained checkpoint; pass --random")
+        np.savez(model_file, **entry.family.moe_state_dict(cfg))
+        return
     model = _hf_model(model_name, cfg, random_init)
     state_dict = {k: v.numpy() for k, v in model.state_dict().items()}
     if cfg.model_type in ("vit", "deit"):
