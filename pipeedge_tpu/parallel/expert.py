@@ -90,20 +90,13 @@ def moe_capacity(n_tokens: int, n_experts: int,
                       math.ceil(capacity_factor * n_tokens / n_experts)))
 
 
-def moe_ffn_delta(params: Dict, normed: jax.Array, n_experts: int,
-                  capacity_factor: float = 1.25,
-                  act=gelu) -> jax.Array:
-    """Single-device switch-FFN **delta**: gate * expert(normed) per kept
-    token, zeros for capacity-dropped tokens. Pre-LN families add this to
-    the raw residual (h = x + delta), so the residual semantics live with
-    the caller — this is the form the GPT-2 MoE blocks use
-    (models/gpt2.py). Jittable; expert loop is vmapped."""
-    b, s, d = normed.shape
-    tokens = normed.reshape(-1, d)
-    capacity = moe_capacity(tokens.shape[0], n_experts, capacity_factor)
-    _, gate, keep, kept = _routing(params["router"], tokens, n_experts,
-                                   capacity)
-
+def _scatter_expert_deltas(experts: Dict, tokens: jax.Array, gate, keep,
+                           kept, act) -> jax.Array:
+    """THE expert-compute core shared by the single-device delta FFN and
+    the ep-sharded body: vmap act(x@up)@down over the (possibly local)
+    expert slab, gate, zero invalid slots, scatter-add into token rows.
+    One implementation, so the family FFN and the 'ep' axis cannot
+    diverge."""
     def one_expert(w_up, b_up, w_down, b_down, ids, valid):
         xe = tokens[ids]
         up = act(xe @ w_up + b_up)
@@ -111,11 +104,27 @@ def moe_ffn_delta(params: Dict, normed: jax.Array, n_experts: int,
         return jnp.where(valid[:, None], ye * gate[ids][:, None], 0.0), ids
 
     deltas, ids = jax.vmap(one_expert)(
-        params["experts"]["mlp_up"]["w"], params["experts"]["mlp_up"]["b"],
-        params["experts"]["mlp_down"]["w"],
-        params["experts"]["mlp_down"]["b"], keep, kept)
-    delta = jnp.zeros_like(tokens).at[ids.reshape(-1)].add(
-        deltas.reshape(-1, d))
+        experts["mlp_up"]["w"], experts["mlp_up"]["b"],
+        experts["mlp_down"]["w"], experts["mlp_down"]["b"], keep, kept)
+    return jnp.zeros_like(tokens).at[ids.reshape(-1)].add(
+        deltas.reshape(-1, tokens.shape[-1]))
+
+
+def moe_ffn_delta(params: Dict, normed: jax.Array, n_experts: int,
+                  capacity_factor: float, *, act) -> jax.Array:
+    """Single-device switch-FFN **delta**: gate * expert(normed) per kept
+    token, zeros for capacity-dropped tokens. Pre-LN families add this to
+    the raw residual (h = x + delta), so the residual semantics live with
+    the caller — this is the form the GPT-2 MoE blocks use
+    (models/gpt2.py). `act` is required (GPT-2 uses gelu_new; a defaulted
+    activation would be a silent-wrong-numbers trap)."""
+    b, s, d = normed.shape
+    tokens = normed.reshape(-1, d)
+    capacity = moe_capacity(tokens.shape[0], n_experts, capacity_factor)
+    _, gate, keep, kept = _routing(params["router"], tokens, n_experts,
+                                   capacity)
+    delta = _scatter_expert_deltas(params["experts"], tokens, gate, keep,
+                                   kept, act)
     return delta.reshape(b, s, d).astype(normed.dtype)
 
 
@@ -156,28 +165,17 @@ def _ep_local(params: Dict, x: jax.Array, *, n_experts: int,
     first = idx * e_local
     my_keep = jax.lax.dynamic_slice_in_dim(keep, first, e_local, axis=0)
     my_kept = jax.lax.dynamic_slice_in_dim(kept, first, e_local, axis=0)
-
-    def one_expert(w_up, b_up, w_down, b_down, ids, valid):
-        xe = tokens[ids]
-        up = act(xe @ w_up + b_up)
-        ye = up @ w_down + b_down
-        delta = ye * gate[ids][:, None]  # the token's residual stays put
-        return jnp.where(valid[:, None], delta, 0.0), ids
-
-    deltas, ids = jax.vmap(one_expert)(
-        params["experts"]["mlp_up"]["w"], params["experts"]["mlp_up"]["b"],
-        params["experts"]["mlp_down"]["w"],
-        params["experts"]["mlp_down"]["b"], my_keep, my_kept)
-    # scatter-add local expert deltas, then combine across the ep axis
-    local = jnp.zeros_like(tokens).at[ids.reshape(-1)].add(
-        deltas.reshape(-1, d))
+    # local expert deltas (shared core), then combine across the ep axis;
+    # dropped tokens keep their residual (delta 0)
+    local = _scatter_expert_deltas(params["experts"], tokens, gate, my_keep,
+                                   my_kept, act)
     combined = jax.lax.psum(local, axis)
     return (tokens + combined).reshape(b, s, d)
 
 
 def make_ep_ffn_fn(cfg: TransformerConfig, mesh: Mesh, n_experts: int,
-                   capacity_factor: float = 1.25, axis: str = "ep",
-                   act=gelu):
+                   capacity_factor: float = 1.25, axis: str = "ep", *,
+                   act):
     """Jitted `fn(params, x) -> x`: switch-FFN with experts sharded over
     `axis`. Place params with `shard_moe_params` first. Token count must be
     static per call (standard XLA); capacity derives from it."""

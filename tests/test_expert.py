@@ -8,7 +8,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
-from pipeedge_tpu.models.layers import TransformerConfig
+from pipeedge_tpu.models.layers import TransformerConfig, gelu
 from pipeedge_tpu.parallel import expert as ep_mod
 
 CFG = TransformerConfig(model_type="vit", hidden_size=32,
@@ -25,7 +25,7 @@ def test_ep_ffn_matches_reference(n_ep):
                     jnp.float32)
     expected = np.asarray(ep_mod.reference_moe_ffn(params, x, n_experts))
     mesh = Mesh(np.asarray(jax.devices()[:n_ep]), ("ep",))
-    fn = ep_mod.make_ep_ffn_fn(CFG, mesh, n_experts)
+    fn = ep_mod.make_ep_ffn_fn(CFG, mesh, n_experts, act=gelu)
     got = np.asarray(fn(ep_mod.shard_moe_params(params, mesh), x))
     np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
 
@@ -38,7 +38,7 @@ def test_ep_capacity_drops_to_residual():
     x = jnp.asarray(np.random.default_rng(4).normal(size=(1, 32, 32)),
                     jnp.float32)
     mesh = Mesh(np.asarray(jax.devices()[:2]), ("ep",))
-    fn = ep_mod.make_ep_ffn_fn(CFG, mesh, n_experts, capacity_factor=0.125)
+    fn = ep_mod.make_ep_ffn_fn(CFG, mesh, n_experts, capacity_factor=0.125, act=gelu)
     out = np.asarray(fn(ep_mod.shard_moe_params(params, mesh), x))
     # capacity = ceil(0.125 * 32 / 4) = 1 slot per expert -> at most
     # n_experts tokens transformed; everyone else must be untouched
@@ -52,7 +52,7 @@ def test_ep_capacity_drops_to_residual():
 def test_ep_requires_divisible_experts():
     mesh = Mesh(np.asarray(jax.devices()[:4]), ("ep",))
     with pytest.raises(ValueError, match="must divide"):
-        ep_mod.make_ep_ffn_fn(CFG, mesh, n_experts=6)
+        ep_mod.make_ep_ffn_fn(CFG, mesh, n_experts=6, act=gelu)
 
 
 def test_ep_capacity_clamps_to_token_count():
@@ -63,7 +63,7 @@ def test_ep_capacity_clamps_to_token_count():
     x = jnp.asarray(np.random.default_rng(6).normal(size=(1, 16, 32)),
                     jnp.float32)
     mesh = Mesh(np.asarray(jax.devices()[:2]), ("ep",))
-    fn = ep_mod.make_ep_ffn_fn(CFG, mesh, n_experts, capacity_factor=8.0)
+    fn = ep_mod.make_ep_ffn_fn(CFG, mesh, n_experts, capacity_factor=8.0, act=gelu)
     got = np.asarray(fn(ep_mod.shard_moe_params(params, mesh), x))
     ref = np.asarray(ep_mod.reference_moe_ffn(params, x, n_experts,
                                               capacity_factor=8.0))

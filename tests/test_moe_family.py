@@ -13,7 +13,7 @@ from jax.sharding import Mesh
 
 from pipeedge_tpu.models import ShardConfig
 from pipeedge_tpu.models import gpt2 as gpt2_mod
-from pipeedge_tpu.models.layers import gelu_new
+from pipeedge_tpu.models.layers import gelu, gelu_new
 from pipeedge_tpu.models.registry import get_model_config
 from pipeedge_tpu.models.shard import make_shard_fn
 from pipeedge_tpu.parallel import decode, expert, spmd
@@ -41,7 +41,7 @@ def test_moe_delta_matches_reference_ffn(moe_setup):
     params = expert.init_moe_params(cfg, n_experts=4, seed=1)
     x = jnp.asarray(np.random.default_rng(2).normal(size=(2, 8, 32)),
                     jnp.float32)
-    delta = expert.moe_ffn_delta(params, x, 4)
+    delta = expert.moe_ffn_delta(params, x, 4, 1.25, act=gelu)
     full = expert.reference_moe_ffn(params, x, 4)
     np.testing.assert_allclose(np.asarray(delta), np.asarray(full - x),
                                rtol=2e-5, atol=2e-5)
@@ -57,7 +57,7 @@ def test_moe_delta_matches_ep_sharded(moe_setup):
                     jnp.float32)
     ep_fn = expert.make_ep_ffn_fn(cfg, mesh, n_experts=4, act=gelu_new)
     ep_out = ep_fn(expert.shard_moe_params(params, mesh), x)
-    delta = expert.moe_ffn_delta(params, x, 4, act=gelu_new)
+    delta = expert.moe_ffn_delta(params, x, 4, 1.25, act=gelu_new)
     np.testing.assert_allclose(np.asarray(ep_out - x), np.asarray(delta),
                                rtol=2e-5, atol=2e-5)
 
