@@ -40,6 +40,16 @@ SUBLAYER_PARAMS = {
     3: ("mlp_down",),
 }
 
+# routed-FFN (cfg.n_experts > 0) variant: the whole switch-FFN lives in
+# sublayer 2 (capacity routing cannot span a pipeline cut); sublayer 3 is
+# the parameter-free residual add
+MOE_SUBLAYER_PARAMS = {
+    0: ("ln_before", "q", "k", "v"),
+    1: ("attn_out",),
+    2: ("ln_after", "moe"),
+    3: (),
+}
+
 
 def embed(p: Dict, input_ids: jax.Array, cfg: TransformerConfig) -> jax.Array:
     """Token embedding + learned position embedding (HF `GPT2Model.forward`)."""
