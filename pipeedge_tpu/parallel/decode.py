@@ -369,6 +369,18 @@ def validate_capacity(cfg: TransformerConfig, max_len: int,
                          f"exceeds max_len {max_len}")
 
 
+def _repeat_batch(tree, k: int):
+    """Tile the batch axis (axis 1 of [L, B, ...] cache leaves) k times:
+    beam b of batch i occupies row i*k + b."""
+    return jax.tree_util.tree_map(
+        lambda x: jnp.repeat(x, k, axis=1), tree)
+
+
+def _gather_batch(tree, rows: jax.Array):
+    """Reorder the batch axis of cache leaves by `rows` [B*k]."""
+    return jax.tree_util.tree_map(lambda x: jnp.take(x, rows, axis=1), tree)
+
+
 def make_token_picker(temperature: float = 0.0, top_k: int = 0):
     """Jitted `pick(logits [B, V], rng) -> tokens [B]`: greedy argmax at
     temperature 0, else categorical sampling over logits/temperature,
@@ -494,3 +506,64 @@ class DecodePipeline:
             if step_callback is not None:
                 step_callback(step, tokens[-1])
         return jnp.concatenate([ids, jnp.stack(tokens, axis=1)], axis=1)
+
+    def generate_beam(self, ids, new_tokens: int, beams: int):
+        """Beam-search decode: keep the `beams` highest log-probability
+        continuations per prompt, return the best [B, S + new_tokens].
+
+        Beams fold into the batch axis (row i*beams + b), so the compiled
+        stage programs are reused unchanged at batch B*beams; on each
+        reshuffle the per-stage caches are reordered along that axis to
+        follow their surviving parent beams. Pure max-log-prob beam search:
+        fixed horizon, no EOS/length normalization (all hypotheses share a
+        length), matching the exhaustive oracle in tests/test_decode.py."""
+        ids = jnp.asarray(ids, jnp.int32)
+        batch, prompt_len = ids.shape
+        if new_tokens <= 0:
+            return ids
+        if beams < 1:
+            raise ValueError(f"beams must be >= 1, got {beams}")
+        if beams == 1:
+            # a width-1 beam IS greedy; skip the per-step cache gather
+            return self.generate(ids, new_tokens)
+        validate_capacity(self.cfg, self.max_len, prompt_len, new_tokens)
+
+        # prefill once at batch B, then tile each prompt's cache per beam
+        caches = self._fresh_caches(batch)
+        data = ids
+        for i, st in enumerate(self.stages):
+            if st["device"] is not None:
+                data = jax.device_put(data, st["device"])
+            data, caches[i] = st["prefill"](st["params"], data, caches[i])
+        caches = [_repeat_batch(c, beams) for c in caches]
+
+        logp = jax.nn.log_softmax(
+            data[:, prompt_len - 1].astype(jnp.float32), axis=-1)  # [B, V]
+        scores, first = jax.lax.top_k(logp, beams)        # [B, beams]
+        history = first[..., None]                        # [B, beams, 1]
+
+        for step in range(1, new_tokens):
+            pos = prompt_len + step - 1
+            data = history[:, :, -1].reshape(batch * beams, 1)
+            for i, st in enumerate(self.stages):
+                if st["device"] is not None:
+                    data = jax.device_put(data, st["device"])
+                data, caches[i] = st["decode"](st["params"], data, caches[i],
+                                               pos)
+            logp = jax.nn.log_softmax(
+                data[:, 0].astype(jnp.float32), axis=-1)  # [B*beams, V]
+            vocab = logp.shape[-1]
+            total = scores[..., None] + logp.reshape(batch, beams, vocab)
+            scores, flat = jax.lax.top_k(total.reshape(batch, -1), beams)
+            parent = flat // vocab                        # [B, beams]
+            token = flat % vocab
+            rows = (jnp.arange(batch)[:, None] * beams + parent).reshape(-1)
+            caches = [_gather_batch(c, rows) for c in caches]
+            history = jnp.concatenate(
+                [jnp.take_along_axis(history, parent[..., None], axis=1),
+                 token[..., None]], axis=2)
+
+        best = jnp.argmax(scores, axis=1)
+        best_hist = jnp.take_along_axis(
+            history, best[:, None, None], axis=1)[:, 0]   # [B, new_tokens]
+        return jnp.concatenate([ids, best_hist], axis=1)

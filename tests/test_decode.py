@@ -137,6 +137,50 @@ def test_sampling_and_step_callback(gpt2_setup):
     np.testing.assert_array_equal(top1, greedy)
 
 
+def test_beam_search_matches_oracle(gpt2_setup):
+    """generate_beam == a step-by-step numpy beam search over full
+    (no-cache) forward log-probs; beams=1 degenerates to greedy."""
+    cfg, weights, _ = gpt2_setup
+    partition = [(1, 4), (5, 12)]
+    pipe = decode.DecodePipeline(
+        gpt2_mod.FAMILY, cfg, partition,
+        _stage_params(cfg, partition, weights), max_len=32)
+    ids = np.asarray(
+        np.random.default_rng(51).integers(0, 100, size=(2, 6)), np.int64)
+
+    got1 = np.asarray(pipe.generate_beam(ids, 6, beams=1))
+    np.testing.assert_array_equal(got1, np.asarray(pipe.generate(ids, 6)))
+
+    beams, steps = 3, 4
+    got = np.asarray(pipe.generate_beam(ids, steps, beams=beams))
+
+    # oracle: full forward per hypothesis, exact same beam semantics
+    total = 4 * cfg.num_hidden_layers
+    sc = ShardConfig(1, total, is_first=True, is_last=True)
+    params = gpt2_mod.load_params(cfg, sc, weights)
+    from pipeedge_tpu.models.shard import make_shard_fn
+    fn = make_shard_fn(gpt2_mod.FAMILY, cfg, sc)
+
+    def logprobs(seqs):   # [N, S] -> [N, V] next-token log-probs
+        logits = np.asarray(fn(params, jnp.asarray(seqs, jnp.int32)))
+        x = logits[:, -1].astype(np.float64)
+        x = x - x.max(axis=-1, keepdims=True)
+        return x - np.log(np.exp(x).sum(axis=-1, keepdims=True))
+
+    for b in range(ids.shape[0]):
+        lp = logprobs(ids[b:b + 1])[0]
+        order = np.argsort(-lp)[:beams]
+        hyps = [(lp[t], [int(t)]) for t in order]
+        for _ in range(steps - 1):
+            seqs = np.stack([np.concatenate([ids[b], h[1]]) for h in hyps])
+            lps = logprobs(seqs)
+            cand = [(h[0] + lps[i][t], h[1] + [int(t)])
+                    for i, h in enumerate(hyps) for t in range(cfg.vocab_size)]
+            cand.sort(key=lambda c: -c[0])
+            hyps = cand[:beams]
+        np.testing.assert_array_equal(got[b, 6:], np.asarray(hyps[0][1]))
+
+
 def test_tp_decode_matches_plain(gpt2_setup):
     """Megatron tensor-parallel decode (head-sharded KV cache, 2 psums per
     block under shard_map) generates the same tokens as the single-device

@@ -165,6 +165,9 @@ def main():
                              "(0 = full distribution)")
     parser.add_argument("--seed", default=0, type=int,
                         help="sampling PRNG seed")
+    parser.add_argument("--beams", default=0, type=int,
+                        help="beam-search width (0 = greedy/sampling; "
+                             "local pipeline mode only)")
     parser.add_argument("--monitor", action="store_true",
                         help="record per-step heartbeats to decode.csv "
                              "(overwrites an existing decode.csv in cwd)")
@@ -190,10 +193,15 @@ def main():
     else:
         partition = [(1, total)]
     max_len = args.max_len or args.prompt_len + args.new_tokens
+    if args.beams and args.temperature > 0:
+        parser.error("--beams and --temperature are mutually exclusive")
+    if args.beams and args.monitor:
+        parser.error("--monitor records per-step heartbeats only for "
+                     "greedy/sampled generation, not --beams")
     if args.dcn_addrs is not None:
-        if args.tp > 1 or args.kv_bits or args.monitor:
+        if args.tp > 1 or args.kv_bits or args.monitor or args.beams:
             parser.error("--dcn-addrs does not compose with --tp/--kv-bits/"
-                         "--monitor in this demo")
+                         "--monitor/--beams in this demo")
         run_dcn(args, cfg, total, partition, max_len, dtype)
         return
     stage_params = []
@@ -230,18 +238,25 @@ def main():
             monitoring.iteration("decode", work=int(tokens.shape[0]),
                                  safe=False)
 
-    sample_kw = dict(temperature=args.temperature, top_k=args.top_k,
-                     seed=args.seed)
     ids = prompt_ids(args, cfg)
-    out = np.asarray(pipe.generate(ids, 2, **sample_kw))  # compile programs
+    if args.beams:
+        run = lambda n, cb=None: np.asarray(
+            pipe.generate_beam(ids, n, beams=args.beams))
+        label = f"{len(partition)} stages, beam {args.beams}"
+    else:
+        sample_kw = dict(temperature=args.temperature, top_k=args.top_k,
+                         seed=args.seed)
+        run = lambda n, cb=None: np.asarray(
+            pipe.generate(ids, n, step_callback=cb, **sample_kw))
+        label = f"{len(partition)} stages"
+    run(min(2, args.new_tokens))   # compile programs
     tik = time.monotonic()
-    out = np.asarray(pipe.generate(ids, args.new_tokens,
-                                   step_callback=heartbeat, **sample_kw))
+    out = run(args.new_tokens, heartbeat)
     dt = time.monotonic() - tik
     if args.monitor:
         import monitoring
         monitoring.finish()
-    print_summary(args, dt, out, f"{len(partition)} stages")
+    print_summary(args, dt, out, label)
 
 
 if __name__ == "__main__":
