@@ -142,6 +142,25 @@ def _cache_update_and_read(bcache: Cache, k_new: jax.Array, v_new: jax.Array,
     return k, v, keep, bcache
 
 
+def _block_tail(p: Dict, x: jax.Array, ctx: jax.Array,
+                cfg: TransformerConfig) -> jax.Array:
+    """Post-attention half of a GPT-2 block (output proj + residual, FFN +
+    residual) — shared by the cached decode step and the sp prefill."""
+    x = dense(p["attn_out"], ctx) + x
+    normed = layer_norm(p["ln_after"], x, cfg.layer_norm_eps)
+    if cfg.n_experts:
+        # Capacity routing is NOT causal: a full-sequence forward lets
+        # tokens compete for expert slots across the whole sequence, which
+        # a cached decode step (routing only the current tokens) cannot
+        # reproduce. With capacity_factor >= n_experts (no drops) routing
+        # is a pure per-token gate and decode matches the forward exactly;
+        # capacity-bounded models route each step's token set on its own.
+        from .expert import moe_ffn_delta
+        return x + moe_ffn_delta(p["moe"], normed, cfg.n_experts,
+                                 cfg.capacity_factor, act=gelu_new)
+    return dense(p["mlp_down"], gelu_new(dense(p["mlp_up"], normed))) + x
+
+
 def _block_step(p: Dict, x: jax.Array, bcache: Cache, pos,
                 cfg: TransformerConfig,
                 prefill: bool) -> Tuple[jax.Array, Cache]:
@@ -155,21 +174,7 @@ def _block_step(p: Dict, x: jax.Array, bcache: Cache, pos,
     k, v, keep, bcache = _cache_update_and_read(
         bcache, k_new, v_new, pos, prefill, x.shape[1], q.dtype)
     ctx = _attend(q, k, v, keep, cfg)
-    x = dense(p["attn_out"], ctx) + x
-    normed = layer_norm(p["ln_after"], x, cfg.layer_norm_eps)
-    if cfg.n_experts:
-        # Capacity routing is NOT causal: a full-sequence forward lets
-        # tokens compete for expert slots across the whole sequence, which
-        # a cached decode step (routing only the current tokens) cannot
-        # reproduce. With capacity_factor >= n_experts (no drops) routing
-        # is a pure per-token gate and decode matches the forward exactly;
-        # capacity-bounded models route each step's token set on its own.
-        from .expert import moe_ffn_delta
-        x = x + moe_ffn_delta(p["moe"], normed, cfg.n_experts,
-                              cfg.capacity_factor, act=gelu_new)
-    else:
-        x = dense(p["mlp_down"], gelu_new(dense(p["mlp_up"], normed))) + x
-    return x, bcache
+    return _block_tail(p, x, ctx, cfg), bcache
 
 
 def _block_step_tp(p: Dict, x: jax.Array, bcache: Cache, pos,
@@ -231,7 +236,7 @@ def make_stage_fns(family, cfg: TransformerConfig, shard_config: ShardConfig):
 
 def _make_stage_run(family, cfg: TransformerConfig,
                     shard_config: ShardConfig, block_fn=_block_step,
-                    finalize_fn=None):
+                    finalize_fn=None, embed_fn=None):
     plan = plan_shard(shard_config)
     if plan.head is not None or plan.tail is not None:
         raise ValueError("decode requires a block-aligned partition "
@@ -240,7 +245,9 @@ def _make_stage_run(family, cfg: TransformerConfig,
 
     def run(params, data, cache, pos, prefill):
         if shard_config.is_first:
-            if prefill:
+            if embed_fn is not None:
+                data = embed_fn(params["embeddings"], data)
+            elif prefill:
                 data = family.embed(params["embeddings"], data, cfg)
             else:
                 wpe = jax.lax.dynamic_slice_in_dim(
@@ -398,6 +405,67 @@ def make_token_picker(temperature: float = 0.0, top_k: int = 0):
     return pick
 
 
+def make_sp_prefill_fn(family, cfg: TransformerConfig,
+                       shard_config: ShardConfig, mesh, axis: str = "sp"):
+    """Sequence-parallel prefill for decoding: the O(S^2) prompt pass —
+    the long-context bottleneck — runs with activations sequence-sharded
+    over `axis` and exact causal ring attention per block
+    (parallel/sequence.py, K/V chunks rotate via ppermute); each block's
+    K/V rows are all-gathered into the stage cache, which comes back
+    replicated so the per-token decode steps run unchanged. Stage edges
+    carry only the local sequence chunk.
+
+    Requires a block-aligned dense stage (MoE refuses: routing a local
+    chunk changes capacity semantics) and prompt length divisible by the
+    sp degree."""
+    from jax.sharding import PartitionSpec as P
+
+    from .sequence import ring_attention
+
+    if cfg.n_experts:
+        raise NotImplementedError(
+            "sequence-parallel prefill does not cover MoE blocks "
+            "(per-chunk routing would change capacity semantics)")
+    n = mesh.shape[axis]
+
+    def block_prefill(p, x, bcache, pos, cfg_, prefill):
+        """One block over the local chunk [B, S/n, D]: causal ring
+        attention for the output, all-gathered K/V into the cache; the
+        post-attention half is the shared _block_tail."""
+        normed = layer_norm(p["ln_before"], x, cfg_.layer_norm_eps)
+        q, k_new, v_new = _qkv(p, normed, cfg_)
+        ctx = ring_attention(q, k_new, v_new, axis, causal=True)
+        b, s_local, h, hd = q.shape
+        x = _block_tail(p, x, ctx.reshape(b, s_local, h * hd), cfg_)
+        bcache = dict(bcache)
+        for t, new in (("k", k_new), ("v", v_new)):
+            full = jax.lax.all_gather(new, axis, axis=1, tiled=True)
+            bcache[t] = jax.lax.dynamic_update_slice(
+                bcache[t], full.astype(bcache[t].dtype), (0, 0, 0, 0))
+        return x, bcache
+
+    def sp_embed(pe, ids):
+        """Embed this device's prompt chunk at its global positions."""
+        idx = jax.lax.axis_index(axis)
+        chunk = ids.shape[1] // n
+        local = jax.lax.dynamic_slice_in_dim(ids, idx * chunk, chunk, 1)
+        wpe = jax.lax.dynamic_slice_in_dim(pe["wpe"], idx * chunk, chunk)
+        return jnp.take(pe["wte"], local, axis=0) + wpe[None]
+
+    def sp_finalize(pf, hidden, cfg_):
+        hidden = jax.lax.all_gather(hidden, axis, axis=1, tiled=True)
+        return family.finalize(pf, hidden, cfg_)
+
+    run = _make_stage_run(family, cfg, shard_config, block_fn=block_prefill,
+                          finalize_fn=sp_finalize, embed_fn=sp_embed)
+    edge_in = P() if shard_config.is_first else P(None, axis)
+    edge_out = P() if shard_config.is_last else P(None, axis)
+    return jax.jit(jax.shard_map(
+        partial(run, pos=0, prefill=True), mesh=mesh,
+        in_specs=(P(), edge_in, P()), out_specs=(edge_out, P()),
+        check_vma=False))
+
+
 class DecodePipeline:
     """Host-driven pipelined greedy decoding over block-aligned stages.
 
@@ -413,7 +481,8 @@ class DecodePipeline:
                  partition: Sequence[Tuple[int, int]],
                  stage_params: Sequence[Dict], max_len: int,
                  devices: Optional[Sequence] = None, dtype=jnp.float32,
-                 cache_bits: int = 0, mesh=None, tp_axis: str = "tp"):
+                 cache_bits: int = 0, mesh=None, tp_axis: str = "tp",
+                 sp_mesh=None, sp_axis: str = "sp"):
         total = 4 * cfg.num_hidden_layers
         validate_partition(partition, total)
         validate_capacity(cfg, max_len)
@@ -423,6 +492,10 @@ class DecodePipeline:
         if mesh is not None and devices is not None:
             raise ValueError("pass either per-stage `devices` or a tp "
                              "`mesh`, not both")
+        if sp_mesh is not None and (mesh is not None or cache_bits
+                                    or devices is not None):
+            raise ValueError("sp_mesh (sequence-parallel prefill) does not "
+                             "compose with tp mesh/int8 cache/devices")
         self.cfg = cfg
         self.max_len = max_len
         self.mesh, self.tp_axis = mesh, tp_axis
@@ -441,6 +514,9 @@ class DecodePipeline:
                     params, p_specs)
             else:
                 pre, dec = make_stage_fns(family, cfg, sc)
+                if sp_mesh is not None:
+                    pre = make_sp_prefill_fn(family, cfg, sc, sp_mesh,
+                                             axis=sp_axis)
                 if devices is not None:
                     params = jax.device_put(params, devices[i])
             n_blocks = (r - l + 1) // 4
@@ -450,6 +526,7 @@ class DecodePipeline:
                                 mesh is not None else devices[i]})
         self.dtype = dtype
         self.cache_bits = cache_bits
+        self.sp_degree = sp_mesh.shape[sp_axis] if sp_mesh is not None else 1
 
     def _fresh_caches(self, batch: int) -> List[Cache]:
         caches = []
@@ -480,6 +557,9 @@ class DecodePipeline:
         if new_tokens <= 0:
             return ids
         validate_capacity(self.cfg, self.max_len, prompt_len, new_tokens)
+        if prompt_len % self.sp_degree:
+            raise ValueError(f"prompt length {prompt_len} not divisible by "
+                             f"the sp prefill degree {self.sp_degree}")
         rng = jax.random.PRNGKey(seed)
         pick = make_token_picker(temperature, top_k)
 
@@ -527,6 +607,9 @@ class DecodePipeline:
             # a width-1 beam IS greedy; skip the per-step cache gather
             return self.generate(ids, new_tokens)
         validate_capacity(self.cfg, self.max_len, prompt_len, new_tokens)
+        if prompt_len % self.sp_degree:
+            raise ValueError(f"prompt length {prompt_len} not divisible by "
+                             f"the sp prefill degree {self.sp_degree}")
 
         # prefill once at batch B, then tile each prompt's cache per beam
         caches = self._fresh_caches(batch)

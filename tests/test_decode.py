@@ -208,6 +208,32 @@ def test_tp_decode_matches_plain(gpt2_setup):
                               mesh=Mesh(np.array(jax.devices()[:2]), ("tp",)))
 
 
+def test_sp_prefill_matches_plain(gpt2_setup):
+    """Sequence-parallel prefill (causal ring attention over an 'sp' mesh,
+    K/V all-gathered into the caches) + plain decode steps == the
+    single-device pipeline, token for token."""
+    import jax
+    from jax.sharding import Mesh
+    cfg, weights, _ = gpt2_setup
+    ids = np.asarray(
+        np.random.default_rng(61).integers(0, 100, size=(2, 8)), np.int64)
+    for partition in ([(1, 12)], [(1, 8), (9, 12)]):
+        sp = _stage_params(cfg, partition, weights)
+        plain = decode.DecodePipeline(gpt2_mod.FAMILY, cfg, partition, sp,
+                                      max_len=24)
+        sp_mesh = Mesh(np.array(jax.devices()[:2]), ("sp",))
+        piped = decode.DecodePipeline(gpt2_mod.FAMILY, cfg, partition, sp,
+                                      max_len=24, sp_mesh=sp_mesh)
+        got = np.asarray(piped.generate(ids, 8))
+        np.testing.assert_array_equal(got, np.asarray(plain.generate(ids, 8)))
+    with pytest.raises(ValueError, match="not divisible by"):
+        piped.generate(ids[:, :7], 4)
+    with pytest.raises(ValueError, match="does not compose"):
+        decode.DecodePipeline(gpt2_mod.FAMILY, cfg, [(1, 12)],
+                              _stage_params(cfg, [(1, 12)], weights),
+                              max_len=24, sp_mesh=sp_mesh, cache_bits=8)
+
+
 def test_generate_cli(tmp_path):
     import os
     import subprocess

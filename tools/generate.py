@@ -158,6 +158,10 @@ def main():
     parser.add_argument("--tp", default=1, type=int,
                         help="Megatron tensor-parallel degree per stage "
                              "(head-sharded KV cache, shard_map)")
+    parser.add_argument("--sp", default=1, type=int,
+                        help="sequence-parallel PREFILL degree (causal ring "
+                             "attention over the prompt; decode steps stay "
+                             "single-device)")
     parser.add_argument("--temperature", default=0.0, type=float,
                         help="sampling temperature (0 = greedy)")
     parser.add_argument("--top-k", default=0, type=int,
@@ -199,9 +203,10 @@ def main():
         parser.error("--monitor records per-step heartbeats only for "
                      "greedy/sampled generation, not --beams")
     if args.dcn_addrs is not None:
-        if args.tp > 1 or args.kv_bits or args.monitor or args.beams:
-            parser.error("--dcn-addrs does not compose with --tp/--kv-bits/"
-                         "--monitor/--beams in this demo")
+        if args.tp > 1 or args.sp > 1 or args.kv_bits or args.monitor \
+                or args.beams:
+            parser.error("--dcn-addrs does not compose with --tp/--sp/"
+                         "--kv-bits/--monitor/--beams in this demo")
         run_dcn(args, cfg, total, partition, max_len, dtype)
         return
     stage_params = []
@@ -210,17 +215,24 @@ def main():
             args.model_name, args.model_file, l, r, stage=i, dtype=dtype,
             unroll=False)  # DecodePipeline wants the stacked block layout
         stage_params.append(params)
-    mesh = None
-    if args.tp > 1:
+    mesh = sp_mesh = None
+    if args.tp > 1 or args.sp > 1:
         import jax
         from jax.sharding import Mesh
-        if len(jax.devices()) < args.tp:
-            parser.error(f"--tp {args.tp} needs {args.tp} devices, only "
+        need = max(args.tp, args.sp)
+        if len(jax.devices()) < need:
+            parser.error(f"--tp/--sp {need} needs {need} devices, only "
                          f"{len(jax.devices())} visible")
-        mesh = Mesh(np.array(jax.devices()[:args.tp]), ("tp",))
+        if args.tp > 1 and args.sp > 1:
+            parser.error("--tp and --sp are mutually exclusive in this demo")
+        if args.tp > 1:
+            mesh = Mesh(np.array(jax.devices()[:args.tp]), ("tp",))
+        else:
+            sp_mesh = Mesh(np.array(jax.devices()[:args.sp]), ("sp",))
     pipe = decode.DecodePipeline(registry.get_model_entry(
         args.model_name).family.FAMILY, cfg, partition, stage_params,
-        max_len=max_len, dtype=dtype, cache_bits=args.kv_bits, mesh=mesh)
+        max_len=max_len, dtype=dtype, cache_bits=args.kv_bits, mesh=mesh,
+        sp_mesh=sp_mesh)
 
     heartbeat = None
     if args.monitor:
