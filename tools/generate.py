@@ -225,6 +225,9 @@ def main():
                          f"{len(jax.devices())} visible")
         if args.tp > 1 and args.sp > 1:
             parser.error("--tp and --sp are mutually exclusive in this demo")
+        if args.sp > 1 and args.prompt_len % args.sp:
+            parser.error(f"--prompt-len {args.prompt_len} must divide by "
+                         f"--sp {args.sp}")
         if args.tp > 1:
             mesh = Mesh(np.array(jax.devices()[:args.tp]), ("tp",))
         else:
