@@ -96,6 +96,21 @@ def test_profile_gpt2_family():
     assert results[-1]["shape_out"] == [[64, 100]]  # per-token vocab logits
 
 
+def test_profile_moe_family():
+    """MoE blocks profile per layer too: the routed FFN is all in sublayer
+    2, sublayer 3 is the parameter-free residual add."""
+    model = "pipeedge/test-tiny-moe"
+    inputs = prof.default_inputs(model, 2)
+    results = prof.profile_layers_individually(
+        model, None, inputs, 1, registry.get_model_layers(model),
+        warmup=True, iterations=2)
+    assert [d["layer"] for d in results] == list(range(1, 9))
+    for a, b in zip(results, results[1:]):
+        assert a["shape_out"] == b["shape_in"]
+    assert len(results[2]["shape_out"]) == 2   # (delta, residual) after sub 2
+    assert results[-1]["shape_out"] == [[64, 100]]
+
+
 def test_validate_profile_results(profile_results):
     prof.validate_profile_results(profile_results, MODEL, "float32", 2, 8, 9, 9)
     with pytest.raises(AssertionError):
