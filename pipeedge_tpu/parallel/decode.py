@@ -406,35 +406,40 @@ def make_token_picker(temperature: float = 0.0, top_k: int = 0):
 
 
 def make_sp_prefill_fn(family, cfg: TransformerConfig,
-                       shard_config: ShardConfig, mesh, axis: str = "sp"):
+                       shard_config: ShardConfig, mesh, axis: str = "sp",
+                       sp_kind: str = "ring"):
     """Sequence-parallel prefill for decoding: the O(S^2) prompt pass —
     the long-context bottleneck — runs with activations sequence-sharded
-    over `axis` and exact causal ring attention per block
-    (parallel/sequence.py, K/V chunks rotate via ppermute); each block's
-    K/V rows are all-gathered into the stage cache, which comes back
-    replicated so the per-token decode steps run unchanged. Stage edges
-    carry only the local sequence chunk.
+    over `axis` and an exact causal attention core per block chosen by
+    `sp_kind` (parallel/sequence.py::resolve_sp_core — 'ring' streams K/V
+    chunks via ppermute with blockwise softmax, the long-context choice;
+    'ulysses' all-to-all reshards heads<->sequence but materializes full
+    [S, S] scores per local head group and requires heads divisible by the
+    sp degree). Each block's K/V rows are all-gathered into the stage
+    cache, which comes back replicated so the per-token decode steps run
+    unchanged. Stage edges carry only the local sequence chunk.
 
     Requires a block-aligned dense stage (MoE refuses: routing a local
     chunk changes capacity semantics) and prompt length divisible by the
     sp degree."""
     from jax.sharding import PartitionSpec as P
 
-    from .sequence import ring_attention
+    from .sequence import resolve_sp_core
 
     if cfg.n_experts:
         raise NotImplementedError(
             "sequence-parallel prefill does not cover MoE blocks "
             "(per-chunk routing would change capacity semantics)")
     n = mesh.shape[axis]
+    core = resolve_sp_core(sp_kind, cfg.num_attention_heads, n)
 
     def block_prefill(p, x, bcache, pos, cfg_, prefill):
-        """One block over the local chunk [B, S/n, D]: causal ring
+        """One block over the local chunk [B, S/n, D]: causal ring/Ulysses
         attention for the output, all-gathered K/V into the cache; the
         post-attention half is the shared _block_tail."""
         normed = layer_norm(p["ln_before"], x, cfg_.layer_norm_eps)
         q, k_new, v_new = _qkv(p, normed, cfg_)
-        ctx = ring_attention(q, k_new, v_new, axis, causal=True)
+        ctx = core(q, k_new, v_new, axis, causal=True)
         b, s_local, h, hd = q.shape
         x = _block_tail(p, x, ctx.reshape(b, s_local, h * hd), cfg_)
         bcache = dict(bcache)
@@ -482,7 +487,7 @@ class DecodePipeline:
                  stage_params: Sequence[Dict], max_len: int,
                  devices: Optional[Sequence] = None, dtype=jnp.float32,
                  cache_bits: int = 0, mesh=None, tp_axis: str = "tp",
-                 sp_mesh=None, sp_axis: str = "sp"):
+                 sp_mesh=None, sp_axis: str = "sp", sp_kind: str = "ring"):
         total = 4 * cfg.num_hidden_layers
         validate_partition(partition, total)
         validate_capacity(cfg, max_len)
@@ -516,7 +521,7 @@ class DecodePipeline:
                 pre, dec = make_stage_fns(family, cfg, sc)
                 if sp_mesh is not None:
                     pre = make_sp_prefill_fn(family, cfg, sc, sp_mesh,
-                                             axis=sp_axis)
+                                             axis=sp_axis, sp_kind=sp_kind)
                 if devices is not None:
                     params = jax.device_put(params, devices[i])
             n_blocks = (r - l + 1) // 4

@@ -129,6 +129,24 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return to_seq(ctx)
 
 
+def resolve_sp_core(sp_kind: str, num_heads: int, n: int):
+    """THE dispatch point for the sequence-parallel attention core (shared
+    by the SPMD pipeline and the decode prefill): 'ring' streams K/V chunks
+    via ppermute with a blockwise softmax (O(S * chunk) score memory — the
+    long-context choice); 'ulysses' all-to-all reshards heads<->sequence
+    and materializes full [S, S] scores per local head group (cheaper
+    collectives, but score memory grows quadratically with S). Validates
+    the Ulysses head-divisibility requirement."""
+    if sp_kind == "ring":
+        return ring_attention
+    if sp_kind == "ulysses":
+        if num_heads % n:
+            raise ValueError(f"ulysses sp={n} requires head count "
+                             f"({num_heads}) divisible by sp")
+        return ulysses_attention
+    raise ValueError(f"unknown sp_kind {sp_kind!r} (ring | ulysses)")
+
+
 def make_sequence_parallel_attention(mesh: Mesh, axis_name: str = "sp",
                                      kind: str = "ring",
                                      causal: bool = False):

@@ -206,18 +206,10 @@ class SpmdPipeline:
             # (K/V chunks rotate via ppermute, streaming softmax —
             # parallel/sequence.py)
             from ..models.layers import self_attention
-            from .sequence import ring_attention, ulysses_attention
-            if self.sp_kind == "ulysses":
-                if cfg.num_attention_heads % sp:
-                    raise ValueError(
-                        f"ulysses sp={sp} requires head count "
-                        f"({cfg.num_attention_heads}) divisible by sp")
-                core = partial(ulysses_attention, axis_name="sp")
-            elif self.sp_kind == "ring":
-                core = partial(ring_attention, axis_name="sp")
-            else:
-                raise ValueError(f"unknown sp_kind {self.sp_kind!r} "
-                                 "(ring | ulysses)")
+            from .sequence import resolve_sp_core
+            core = partial(resolve_sp_core(self.sp_kind,
+                                           cfg.num_attention_heads, sp),
+                           axis_name="sp")
 
             def sp_attention(qkv, x, num_heads, causal=False):
                 # reuse the family projection code; only the core changes

@@ -221,11 +221,14 @@ def test_sp_prefill_matches_plain(gpt2_setup):
         sp = _stage_params(cfg, partition, weights)
         plain = decode.DecodePipeline(gpt2_mod.FAMILY, cfg, partition, sp,
                                       max_len=24)
+        want = np.asarray(plain.generate(ids, 8))
         sp_mesh = Mesh(np.array(jax.devices()[:2]), ("sp",))
-        piped = decode.DecodePipeline(gpt2_mod.FAMILY, cfg, partition, sp,
-                                      max_len=24, sp_mesh=sp_mesh)
-        got = np.asarray(piped.generate(ids, 8))
-        np.testing.assert_array_equal(got, np.asarray(plain.generate(ids, 8)))
+        for kind in ("ring", "ulysses"):
+            piped = decode.DecodePipeline(gpt2_mod.FAMILY, cfg, partition,
+                                          sp, max_len=24, sp_mesh=sp_mesh,
+                                          sp_kind=kind)
+            got = np.asarray(piped.generate(ids, 8))
+            np.testing.assert_array_equal(got, want)
     with pytest.raises(ValueError, match="not divisible by"):
         piped.generate(ids[:, :7], 4)
     with pytest.raises(ValueError, match="does not compose"):
