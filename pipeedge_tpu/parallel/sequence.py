@@ -129,18 +129,21 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return to_seq(ctx)
 
 
-def resolve_sp_core(sp_kind: str, num_heads: int, n: int):
+def resolve_sp_core(sp_kind: str, num_heads: Optional[int] = None,
+                    n: Optional[int] = None):
     """THE dispatch point for the sequence-parallel attention core (shared
-    by the SPMD pipeline and the decode prefill): 'ring' streams K/V chunks
-    via ppermute with a blockwise softmax (O(S * chunk) score memory — the
-    long-context choice); 'ulysses' all-to-all reshards heads<->sequence
-    and materializes full [S, S] scores per local head group (cheaper
-    collectives, but score memory grows quadratically with S). Validates
-    the Ulysses head-divisibility requirement."""
+    by the SPMD pipeline, the decode prefill, and the standalone wrapper):
+    'ring' streams K/V chunks via ppermute with a blockwise softmax
+    (O((S/n)^2) score memory — the long-context choice); 'ulysses'
+    all-to-all reshards heads<->sequence and materializes full [S, S]
+    scores per local head group (cheaper collectives, but score memory
+    grows quadratically with S). Validates the Ulysses head-divisibility
+    requirement when `num_heads`/`n` are supplied (ulysses_attention also
+    asserts it at trace time)."""
     if sp_kind == "ring":
         return ring_attention
     if sp_kind == "ulysses":
-        if num_heads % n:
+        if num_heads is not None and n and num_heads % n:
             raise ValueError(f"ulysses sp={n} requires head count "
                              f"({num_heads}) divisible by sp")
         return ulysses_attention
@@ -152,7 +155,7 @@ def make_sequence_parallel_attention(mesh: Mesh, axis_name: str = "sp",
                                      causal: bool = False):
     """Build a jitted `fn(q, k, v) -> out` over globally-shaped [B, S, H, D]
     arrays with the sequence axis sharded over `axis_name`."""
-    inner = ring_attention if kind == "ring" else ulysses_attention
+    inner = resolve_sp_core(kind)
     spec = P(None, axis_name)
 
     @jax.jit

@@ -201,10 +201,10 @@ class SpmdPipeline:
                 return tp_local(bp, x, cfg, "tp")
         elif sp > 1:
             # sequence-parallel block body: activations stay sequence-
-            # sharded [b, S/sp, D]; every sublayer is token-local except the
-            # attention core, which runs as exact ring attention over 'sp'
-            # (K/V chunks rotate via ppermute, streaming softmax —
-            # parallel/sequence.py)
+            # sharded [b, S/sp, D]; every sublayer is token-local except
+            # the attention core, which runs as the exact sp core selected
+            # by sp_kind (ring ppermute streaming or Ulysses all-to-all —
+            # parallel/sequence.py::resolve_sp_core)
             from ..models.layers import self_attention
             from .sequence import resolve_sp_core
             core = partial(resolve_sp_core(self.sp_kind,

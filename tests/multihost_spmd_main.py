@@ -62,8 +62,35 @@ def main() -> None:
         out = pipe.run(inputs)
         jax.block_until_ready(out)
         assert out.shape == (n_stages + 1, batch, 5), out.shape
+
+        # tensor-parallel KV-cache decoding over a PROCESS-SPANNING 'tp'
+        # mesh: the head-sharded cache and the vocab-sharded LM head cross
+        # the host boundary (the dimension the reference cannot span)
+        from jax.sharding import Mesh
+        from pipeedge_tpu.models import gpt2 as gpt2_mod
+        from pipeedge_tpu.parallel import decode as dec_mod
+        g_cfg = TransformerConfig(
+            model_type="gpt2", hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=64, layer_norm_eps=1e-5,
+            vocab_size=48, max_position_embeddings=32)
+        g_partition = [(1, 4), (5, 8)]
+        g_params = [gpt2_mod.init_params(
+            g_cfg, ShardConfig(l, r, is_first=l == 1, is_last=r == 8),
+            seed=0) for l, r in g_partition]
+        # 2 devices from each process so the tp axis genuinely crosses the
+        # host boundary (devices are ordered process-major)
+        picks = [0, 1, n_local, n_local + 1] if world > 1 else [0, 1, 2, 3]
+        tp_mesh = Mesh(np.asarray(jax.devices())[picks], ("tp",))
+        g_pipe = dec_mod.DecodePipeline(gpt2_mod.FAMILY, g_cfg, g_partition,
+                                        g_params, max_len=16, mesh=tp_mesh)
+        g_out = g_pipe.generate(
+            np.random.default_rng(3).integers(0, 48, size=(2, 5)),
+            new_tokens=3)
+        jax.block_until_ready(g_out)
+        assert g_out.shape == (2, 8), g_out.shape
+
         print(f"MULTIHOST-OK rank={rank} local={n_local} global={n_global} "
-              f"out={out.shape}", flush=True)
+              f"out={out.shape} decode={g_out.shape}", flush=True)
 
 
 if __name__ == "__main__":
