@@ -1,0 +1,83 @@
+"""Host-side quantized wire codec for DCN transports.
+
+Stage payloads cross process boundaries as a tensor list: a scalar int32
+bitwidth header, then per payload tensor either the raw array (bit=0) or a
+[packed_uint32, scale, shift, shape] quadruple. The bitwidth travels ON the
+wire — the reference ships it as the 5th element of every encoded tensor
+(/root/reference/src/pipeedge/quantization/basic_op.py:143) — so the
+consumer can decode even when the producer's adaptive policy changes the
+bitwidth mid-run. Packing runs in the native C++ codec when built
+(host-side, off the accelerator; bit-identical to the XLA ops —
+ops/native_quant.py), else via the XLA ops.
+
+Consumers: the DCN runtime driver (runtime.py) and the DCN decode mode
+(tools/generate.py --edge-bits).
+"""
+from __future__ import annotations
+
+import os
+from typing import List
+
+import numpy as np
+
+
+def native_wire_codec(bit: int):
+    """The native host-side codec when usable for this bitwidth, else None.
+    PIPEEDGE_NATIVE_QUANT=0 disables it."""
+    if bit == 0 or bit > 16 or os.getenv("PIPEEDGE_NATIVE_QUANT", "1") != "1":
+        return None
+    from ..ops import native_quant
+    return native_quant if native_quant.available() else None
+
+
+def wire_encode(out, bit: int) -> List[np.ndarray]:
+    """Stage output (tensor or tuple) -> wire tensor list."""
+    import jax.numpy as jnp
+
+    from ..ops import quant as quant_ops
+    tensors = out if isinstance(out, tuple) else (out,)
+    wire = [np.asarray(bit, np.int32)]
+    if bit == 0:
+        return wire + [np.asarray(t) for t in tensors]
+    native = native_wire_codec(bit)
+    for t in tensors:
+        if native is not None:
+            arr = np.asarray(t, np.float32)
+            packed, scale, shift = native.encode_outerdim(arr, bit)
+            wire += [packed, scale, shift, np.asarray(arr.shape, np.int64)]
+        else:
+            enc = quant_ops.tensor_encode_outerdim(jnp.asarray(t), bit)
+            wire += [np.asarray(enc.data), np.asarray(enc.scale),
+                     np.asarray(enc.shift), np.asarray(enc.shape, np.int64)]
+    return wire
+
+
+def wire_decode(tensors: List[np.ndarray], dtype):
+    """Inverse of `wire_encode` (bitwidth read from the wire header);
+    returns the stage payload (tensor/tuple)."""
+    import jax.numpy as jnp
+
+    from ..ops import quant as quant_ops
+    bit = int(tensors[0])
+    tensors = tensors[1:]
+    if bit == 0:
+        out = tuple(jnp.asarray(t) for t in tensors)
+    else:
+        assert len(tensors) % 4 == 0
+        native = native_wire_codec(bit)
+        out = []
+        for i in range(0, len(tensors), 4):
+            data, scale, shift, shape = tensors[i:i + 4]
+            if native is not None:
+                dec = native.decode_outerdim(data, scale, shift,
+                                             tuple(int(s) for s in shape),
+                                             bit)
+                out.append(jnp.asarray(dec, dtype=dtype))
+            else:
+                enc = quant_ops.QuantizedTensor(
+                    data=jnp.asarray(data), scale=jnp.asarray(scale),
+                    shift=jnp.asarray(shift),
+                    shape=tuple(int(s) for s in shape), bit=bit)
+                out.append(quant_ops.tensor_decode_outerdim(enc).astype(dtype))
+        out = tuple(out)
+    return out[0] if len(out) == 1 else out

@@ -488,73 +488,11 @@ def run_pipeline_spmd(args, stage_layers, stage_quant, stage_ranks,
     _report(tik, tok, ubatches)
 
 
-def _native_wire_codec(bit: int):
-    """The native host-side codec when usable for this bitwidth (bit-identical
-    wire format, native_quant.py), else None. PIPEEDGE_NATIVE_QUANT=0
-    disables it."""
-    if bit == 0 or bit > 16 or os.getenv("PIPEEDGE_NATIVE_QUANT", "1") != "1":
-        return None
-    from pipeedge_tpu.ops import native_quant
-    return native_quant if native_quant.available() else None
-
-
-def _wire_encode(out, bit: int) -> List[np.ndarray]:
-    """Stage output -> wire tensor list: a scalar int32 bitwidth header, then
-    per payload tensor either the raw array (bit=0) or a [packed_uint32,
-    scale, shift, shape] quadruple. The bitwidth travels ON the wire — the
-    reference ships it as the 5th element of every encoded tensor
-    (basic_op.py:143) — so the consumer can decode even when the producer's
-    adaptive policy changes the bitwidth mid-run. Packing runs in the native
-    codec when built (host-side, off the accelerator), else via the XLA
-    ops."""
-    import jax.numpy as jnp
-
-    from pipeedge_tpu.ops import quant as quant_ops
-    tensors = out if isinstance(out, tuple) else (out,)
-    wire = [np.asarray(bit, np.int32)]
-    if bit == 0:
-        return wire + [np.asarray(t) for t in tensors]
-    native = _native_wire_codec(bit)
-    for t in tensors:
-        if native is not None:
-            arr = np.asarray(t, np.float32)
-            packed, scale, shift = native.encode_outerdim(arr, bit)
-            wire += [packed, scale, shift, np.asarray(arr.shape, np.int64)]
-        else:
-            enc = quant_ops.tensor_encode_outerdim(jnp.asarray(t), bit)
-            wire += [np.asarray(enc.data), np.asarray(enc.scale),
-                     np.asarray(enc.shift), np.asarray(enc.shape, np.int64)]
-    return wire
-
-
-def _wire_decode(tensors: List[np.ndarray], dtype):
-    """Inverse of `_wire_encode` (bitwidth read from the wire header);
-    returns the stage payload (tensor/tuple)."""
-    import jax.numpy as jnp
-
-    from pipeedge_tpu.ops import quant as quant_ops
-    bit = int(tensors[0])
-    tensors = tensors[1:]
-    if bit == 0:
-        out = tuple(jnp.asarray(t) for t in tensors)
-    else:
-        assert len(tensors) % 4 == 0
-        native = _native_wire_codec(bit)
-        out = []
-        for i in range(0, len(tensors), 4):
-            data, scale, shift, shape = tensors[i:i + 4]
-            if native is not None:
-                dec = native.decode_outerdim(data, scale, shift,
-                                             tuple(int(s) for s in shape), bit)
-                out.append(jnp.asarray(dec, dtype=dtype))
-            else:
-                enc = quant_ops.QuantizedTensor(
-                    data=jnp.asarray(data), scale=jnp.asarray(scale),
-                    shift=jnp.asarray(shift),
-                    shape=tuple(int(s) for s in shape), bit=bit)
-                out.append(quant_ops.tensor_decode_outerdim(enc).astype(dtype))
-        out = tuple(out)
-    return out[0] if len(out) == 1 else out
+# Host-side quantized wire codec: moved to the library
+# (pipeedge_tpu/comm/wire.py) so the DCN decode mode shares it; aliased here
+# for the runtime call sites and existing tests.
+from pipeedge_tpu.comm.wire import (wire_decode as _wire_decode,
+                                    wire_encode as _wire_encode)
 
 
 def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:

@@ -294,6 +294,19 @@ def test_generate_dcn_matches_local(tmp_path):
     assert got == want, (got, want)
     assert "2 DCN ranks" in data.stdout
 
+    # quantized stage edges (QuantPipe compression on the wire): the fleet
+    # still decodes end-to-end (tokens may differ within quant error)
+    data, _, _ = _run_fleet(
+        tmp_path, opts + ["--edge-bits", "8"], world=2,
+        env_extra={"JAX_PLATFORMS": "cpu", "DCN_CONNECT_TIMEOUT": "20",
+                   "PIPEEDGE_NATIVE_QUANT": "0"},
+        script="tools/generate.py",
+        rank_argv=lambda rank, world: ["--rank", str(rank)])
+    assert data.returncode == 0, data.stdout + data.stderr
+    assert "2 DCN ranks" in data.stdout
+    q_lines = [l for l in data.stdout.splitlines() if "continuation" in l]
+    assert q_lines and q_lines[0].count(",") == 4  # 5 tokens emitted
+
 
 def test_decode_validation_errors(gpt2_setup):
     cfg, weights, _ = gpt2_setup

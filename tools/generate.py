@@ -42,7 +42,7 @@ def run_dcn(args, cfg, total, partition, max_len, dtype):
     import jax
     import jax.numpy as jnp
 
-    from pipeedge_tpu.comm import dcn
+    from pipeedge_tpu.comm import dcn, wire
     from pipeedge_tpu.models import registry
     from pipeedge_tpu.parallel import decode
 
@@ -79,12 +79,13 @@ def run_dcn(args, cfg, total, partition, max_len, dtype):
             def stage_step(data, pos, fn):
                 nonlocal cache
                 if not sc.is_first:
-                    data = jnp.asarray(ctx.recv_tensors(rank - 1)[0],
-                                       dtype=dtype)
+                    data = wire.wire_decode(ctx.recv_tensors(rank - 1),
+                                            dtype)
                 out, cache = fn(params, data, cache) if pos is None else \
                     fn(params, data, cache, pos)
                 if not sc.is_last:
-                    ctx.send_tensors(rank + 1, [np.asarray(out)])
+                    ctx.send_tensors(rank + 1,
+                                     wire.wire_encode(out, args.edge_bits))
                 elif world > 1:
                     # last position's logits back to rank 0
                     last = out[:, -1] if pos is None else out[:, 0]
@@ -177,6 +178,11 @@ def main():
                              "(overwrites an existing decode.csv in cwd)")
     parser.add_argument("--rank", default=0, type=int,
                         help="this process's rank in a DCN fleet")
+    parser.add_argument("--edge-bits", default=0, type=int,
+                        choices=[0, 2, 4, 6, 8, 16],
+                        help="quantize DCN stage edges (QuantPipe activation "
+                             "compression on the wire; prefill hand-offs are "
+                             "[B, S, D])")
     parser.add_argument("--dcn-addrs", default=None, type=str,
                         help="comma-separated host:port per rank: run the "
                              "pipeline across OS processes over TCP (stage "
@@ -202,6 +208,9 @@ def main():
     if args.beams and args.monitor:
         parser.error("--monitor records per-step heartbeats only for "
                      "greedy/sampled generation, not --beams")
+    if args.edge_bits and args.dcn_addrs is None:
+        parser.error("--edge-bits applies to DCN stage edges; pass "
+                     "--dcn-addrs")
     if args.dcn_addrs is not None:
         if args.tp > 1 or args.sp > 1 or args.kv_bits or args.monitor \
                 or args.beams:
