@@ -143,11 +143,15 @@ def _cache_update_and_read(bcache: Cache, k_new: jax.Array, v_new: jax.Array,
 
 
 def _block_tail(p: Dict, x: jax.Array, ctx: jax.Array,
-                cfg: TransformerConfig) -> jax.Array:
+                cfg: TransformerConfig, ffn_delta=None) -> jax.Array:
     """Post-attention half of a GPT-2 block (output proj + residual, FFN +
-    residual) — shared by the cached decode step and the sp prefill."""
+    residual) — shared by the cached decode step, the sp prefill, and the
+    ep decode step. `ffn_delta(p, normed) -> delta` overrides the FFN
+    (expert-parallel execution plugs in the ep-sharded routed FFN)."""
     x = dense(p["attn_out"], ctx) + x
     normed = layer_norm(p["ln_after"], x, cfg.layer_norm_eps)
+    if ffn_delta is not None:
+        return x + ffn_delta(p, normed)
     if cfg.n_experts:
         # Capacity routing is NOT causal: a full-sequence forward lets
         # tokens compete for expert slots across the whole sequence, which
@@ -161,6 +165,18 @@ def _block_tail(p: Dict, x: jax.Array, ctx: jax.Array,
     return dense(p["mlp_down"], gelu_new(dense(p["mlp_up"], normed))) + x
 
 
+def _attention_core(p: Dict, x: jax.Array, bcache: Cache, pos,
+                    cfg: TransformerConfig,
+                    prefill: bool) -> Tuple[jax.Array, Cache]:
+    """ln + qkv + cache update + masked attend: the cached attention half
+    shared by the plain and expert-parallel decode steps."""
+    normed = layer_norm(p["ln_before"], x, cfg.layer_norm_eps)
+    q, k_new, v_new = _qkv(p, normed, cfg)
+    k, v, keep, bcache = _cache_update_and_read(
+        bcache, k_new, v_new, pos, prefill, x.shape[1], q.dtype)
+    return _attend(q, k, v, keep, cfg), bcache
+
+
 def _block_step(p: Dict, x: jax.Array, bcache: Cache, pos,
                 cfg: TransformerConfig,
                 prefill: bool) -> Tuple[jax.Array, Cache]:
@@ -169,11 +185,7 @@ def _block_step(p: Dict, x: jax.Array, bcache: Cache, pos,
     Prefill: x is the full prompt [B, S, D] written at positions [0, S);
     decode: x is one token [B, 1, D] written at position `pos`. `bcache`
     is this block's cache slice {k, v[, *_scale, *_shift]}."""
-    normed = layer_norm(p["ln_before"], x, cfg.layer_norm_eps)
-    q, k_new, v_new = _qkv(p, normed, cfg)
-    k, v, keep, bcache = _cache_update_and_read(
-        bcache, k_new, v_new, pos, prefill, x.shape[1], q.dtype)
-    ctx = _attend(q, k, v, keep, cfg)
+    ctx, bcache = _attention_core(p, x, bcache, pos, cfg, prefill)
     return _block_tail(p, x, ctx, cfg), bcache
 
 
@@ -405,6 +417,57 @@ def make_token_picker(temperature: float = 0.0, top_k: int = 0):
     return pick
 
 
+def make_ep_stage_fns(family, cfg: TransformerConfig,
+                      shard_config: ShardConfig, mesh, params: Dict,
+                      axis: str = "ep"):
+    """Expert-parallel variant of `make_stage_fns` for MoE stages: the
+    routed FFN's experts shard over `axis` (each device computes its local
+    experts' tokens, one psum combines — parallel/expert.py's layout inside
+    the decode step). Attention and the KV cache are replicated across the
+    ep axis (experts hold the dominant parameter mass in an MoE decoder).
+    Returns (prefill_fn, decode_fn, param_specs) — place params with the
+    returned specs."""
+    from jax.sharding import PartitionSpec as P
+
+    from .expert import ep_ffn_delta
+
+    if not cfg.n_experts:
+        raise ValueError("make_ep_stage_fns requires an MoE config "
+                         "(cfg.n_experts > 0)")
+    n = mesh.shape[axis]
+    if cfg.n_experts % n:
+        raise ValueError(f"ep={n} must divide n_experts ({cfg.n_experts})")
+
+    def ffn_delta(p, normed):
+        return ep_ffn_delta(p["moe"], normed, cfg.n_experts,
+                            cfg.capacity_factor, axis, act=gelu_new)
+
+    def block_step_ep(p, x, bcache, pos, cfg_, prefill):
+        ctx, bcache = _attention_core(p, x, bcache, pos, cfg_, prefill)
+        return _block_tail(p, x, ctx, cfg_, ffn_delta=ffn_delta), bcache
+
+    run = _make_stage_run(family, cfg, shard_config, block_fn=block_step_ep)
+    # experts shard on their leading axis (under the stacked block axis);
+    # everything else — attention weights, cache — replicated
+    p_specs = {k: jax.tree_util.tree_map(lambda _: P(), v)
+               for k, v in params.items() if k != "blocks"}
+    p_specs["blocks"] = jax.tree_util.tree_map(lambda _: P(),
+                                               params["blocks"])
+    p_specs["blocks"]["moe"]["experts"] = jax.tree_util.tree_map(
+        lambda _: P(None, axis), params["blocks"]["moe"]["experts"])
+    c_specs = {"k": P(), "v": P()}
+
+    prefill_fn = jax.jit(jax.shard_map(
+        partial(run, pos=0, prefill=True), mesh=mesh,
+        in_specs=(p_specs, P(), c_specs), out_specs=(P(), c_specs),
+        check_vma=False))
+    decode_fn = jax.jit(jax.shard_map(
+        partial(run, prefill=False), mesh=mesh,
+        in_specs=(p_specs, P(), c_specs, P()), out_specs=(P(), c_specs),
+        check_vma=False))
+    return prefill_fn, decode_fn, p_specs
+
+
 def make_sp_prefill_fn(family, cfg: TransformerConfig,
                        shard_config: ShardConfig, mesh, axis: str = "sp",
                        sp_kind: str = "ring"):
@@ -487,7 +550,8 @@ class DecodePipeline:
                  stage_params: Sequence[Dict], max_len: int,
                  devices: Optional[Sequence] = None, dtype=jnp.float32,
                  cache_bits: int = 0, mesh=None, tp_axis: str = "tp",
-                 sp_mesh=None, sp_axis: str = "sp", sp_kind: str = "ring"):
+                 sp_mesh=None, sp_axis: str = "sp", sp_kind: str = "ring",
+                 ep_mesh=None, ep_axis: str = "ep"):
         total = 4 * cfg.num_hidden_layers
         validate_partition(partition, total)
         validate_capacity(cfg, max_len)
@@ -501,6 +565,11 @@ class DecodePipeline:
                                     or devices is not None):
             raise ValueError("sp_mesh (sequence-parallel prefill) does not "
                              "compose with tp mesh/int8 cache/devices")
+        if ep_mesh is not None and (mesh is not None or sp_mesh is not None
+                                    or cache_bits or devices is not None):
+            raise ValueError("ep_mesh (expert-parallel MoE decode) does not "
+                             "compose with tp/sp meshes, int8 cache, or "
+                             "devices")
         self.cfg = cfg
         self.max_len = max_len
         self.mesh, self.tp_axis = mesh, tp_axis
@@ -510,12 +579,17 @@ class DecodePipeline:
             params = dict(stage_params[i])
             # restack an unrolled block layout ONCE here, not per traced call
             params["blocks"] = stage_blocks(params)
-            if mesh is not None:
+            sharded = ((make_tp_stage_fns, mesh, tp_axis)
+                       if mesh is not None else
+                       (make_ep_stage_fns, ep_mesh, ep_axis)
+                       if ep_mesh is not None else None)
+            if sharded is not None:
                 from jax.sharding import NamedSharding
-                pre, dec, p_specs = make_tp_stage_fns(
-                    family, cfg, sc, mesh, params, axis=tp_axis)
+                maker, m, ax = sharded
+                pre, dec, p_specs = maker(family, cfg, sc, m, params,
+                                          axis=ax)
                 params = jax.tree_util.tree_map(
-                    lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+                    lambda x, s: jax.device_put(x, NamedSharding(m, s)),
                     params, p_specs)
             else:
                 pre, dec = make_stage_fns(family, cfg, sc)

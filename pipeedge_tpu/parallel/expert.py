@@ -161,16 +161,42 @@ def _ep_local(params: Dict, x: jax.Array, *, n_experts: int,
     tokens = x.reshape(-1, d)
     _, gate, keep, kept = _routing(params["router"], tokens, n_experts,
                                    capacity)
-    # this device's expert rows in the global routing tables
+    combined = _ep_delta_from_routing(params, tokens, gate, keep, kept,
+                                      n_experts, axis, act)
+    return (tokens + combined).reshape(b, s, d)
+
+
+def _ep_delta_from_routing(params: Dict, tokens: jax.Array, gate, keep,
+                           kept, n_experts: int, axis: str,
+                           act) -> jax.Array:
+    """This device's expert rows of the global routing tables -> local
+    deltas (shared core) -> psum combine across `axis`. Used by the
+    standalone ep FFN and the expert-parallel decode step."""
+    n = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    e_local = n_experts // n
     first = idx * e_local
     my_keep = jax.lax.dynamic_slice_in_dim(keep, first, e_local, axis=0)
     my_kept = jax.lax.dynamic_slice_in_dim(kept, first, e_local, axis=0)
-    # local expert deltas (shared core), then combine across the ep axis;
-    # dropped tokens keep their residual (delta 0)
     local = _scatter_expert_deltas(params["experts"], tokens, gate, my_keep,
                                    my_kept, act)
-    combined = jax.lax.psum(local, axis)
-    return (tokens + combined).reshape(b, s, d)
+    return jax.lax.psum(local, axis)
+
+
+def ep_ffn_delta(params: Dict, normed: jax.Array, n_experts: int,
+                 capacity_factor: float, axis: str, *, act) -> jax.Array:
+    """Expert-parallel counterpart of `moe_ffn_delta`: the same routed-FFN
+    delta with the expert slab sharded over `axis` (call under shard_map).
+    Exact vs the single-device delta — top-1 routing means the psum adds
+    exactly one nonzero term per token."""
+    b, s, d = normed.shape
+    tokens = normed.reshape(-1, d)
+    capacity = moe_capacity(tokens.shape[0], n_experts, capacity_factor)
+    _, gate, keep, kept = _routing(params["router"], tokens, n_experts,
+                                   capacity)
+    delta = _ep_delta_from_routing(params, tokens, gate, keep, kept,
+                                   n_experts, axis, act)
+    return delta.reshape(b, s, d).astype(normed.dtype)
 
 
 def make_ep_ffn_fn(cfg: TransformerConfig, mesh: Mesh, n_experts: int,

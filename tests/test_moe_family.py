@@ -13,7 +13,7 @@ from jax.sharding import Mesh
 
 from pipeedge_tpu.models import ShardConfig
 from pipeedge_tpu.models import gpt2 as gpt2_mod
-from pipeedge_tpu.models.layers import gelu, gelu_new
+from pipeedge_tpu.models.layers import TransformerConfig, gelu, gelu_new
 from pipeedge_tpu.models.registry import get_model_config
 from pipeedge_tpu.models.shard import make_shard_fn
 from pipeedge_tpu.parallel import decode, expert, spmd
@@ -133,6 +133,33 @@ def test_moe_decode_matches_forward_greedy(moe_setup):
                               max_len=16,
                               mesh=Mesh(np.asarray(jax.devices()[:2]),
                                         ("tp",)))
+
+
+def test_moe_ep_decode_matches_plain(moe_setup):
+    """Expert-parallel MoE decode: experts shard over an 'ep' mesh inside
+    the decode step (global routing, local expert slab, one psum), cache
+    replicated — same tokens as the single-device pipeline (top-1 routing
+    means the psum adds exactly one nonzero term, so this is exact)."""
+    cfg, weights = moe_setup
+    partition = [(1, 4), (5, 8)]
+    stage_params = [_shard(cfg, weights, l, r)[0] for l, r in partition]
+    ids = np.random.default_rng(13).integers(0, 100, size=(2, 5))
+    plain = decode.DecodePipeline(gpt2_mod.FAMILY, cfg, partition,
+                                  stage_params, max_len=16)
+    want = np.asarray(plain.generate(ids, 6))
+    ep_mesh = Mesh(np.asarray(jax.devices()[:2]), ("ep",))
+    piped = decode.DecodePipeline(gpt2_mod.FAMILY, cfg, partition,
+                                  stage_params, max_len=16, ep_mesh=ep_mesh)
+    got = np.asarray(piped.generate(ids, 6))
+    np.testing.assert_array_equal(got, want)
+    with pytest.raises(ValueError, match="requires an MoE config"):
+        decode.make_ep_stage_fns(
+            gpt2_mod.FAMILY,
+            TransformerConfig(model_type="gpt2", hidden_size=32,
+                              num_hidden_layers=2, num_attention_heads=4,
+                              intermediate_size=64, vocab_size=100,
+                              max_position_embeddings=64),
+            ShardConfig(1, 8, is_first=True, is_last=True), ep_mesh, {})
 
 
 def test_moe_runtime_cli(tmp_path):

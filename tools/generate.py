@@ -163,6 +163,9 @@ def main():
                         help="sequence-parallel PREFILL degree (causal ring "
                              "attention over the prompt; decode steps stay "
                              "single-device)")
+    parser.add_argument("--ep", default=1, type=int,
+                        help="expert-parallel degree for MoE models "
+                             "(experts shard over an 'ep' mesh per stage)")
     parser.add_argument("--temperature", default=0.0, type=float,
                         help="sampling temperature (0 = greedy)")
     parser.add_argument("--top-k", default=0, type=int,
@@ -212,9 +215,9 @@ def main():
         parser.error("--edge-bits applies to DCN stage edges; pass "
                      "--dcn-addrs")
     if args.dcn_addrs is not None:
-        if args.tp > 1 or args.sp > 1 or args.kv_bits or args.monitor \
-                or args.beams:
-            parser.error("--dcn-addrs does not compose with --tp/--sp/"
+        if args.tp > 1 or args.sp > 1 or args.ep > 1 or args.kv_bits \
+                or args.monitor or args.beams:
+            parser.error("--dcn-addrs does not compose with --tp/--sp/--ep/"
                          "--kv-bits/--monitor/--beams in this demo")
         run_dcn(args, cfg, total, partition, max_len, dtype)
         return
@@ -224,27 +227,30 @@ def main():
             args.model_name, args.model_file, l, r, stage=i, dtype=dtype,
             unroll=False)  # DecodePipeline wants the stacked block layout
         stage_params.append(params)
-    mesh = sp_mesh = None
-    if args.tp > 1 or args.sp > 1:
+    mesh = sp_mesh = ep_mesh = None
+    if args.tp > 1 or args.sp > 1 or args.ep > 1:
         import jax
         from jax.sharding import Mesh
-        need = max(args.tp, args.sp)
+        need = max(args.tp, args.sp, args.ep)
         if len(jax.devices()) < need:
-            parser.error(f"--tp/--sp {need} needs {need} devices, only "
-                         f"{len(jax.devices())} visible")
-        if args.tp > 1 and args.sp > 1:
-            parser.error("--tp and --sp are mutually exclusive in this demo")
+            parser.error(f"--tp/--sp/--ep {need} needs {need} devices, "
+                         f"only {len(jax.devices())} visible")
+        if sum(x > 1 for x in (args.tp, args.sp, args.ep)) > 1:
+            parser.error("--tp/--sp/--ep are mutually exclusive in this "
+                         "demo")
         if args.sp > 1 and args.prompt_len % args.sp:
             parser.error(f"--prompt-len {args.prompt_len} must divide by "
                          f"--sp {args.sp}")
         if args.tp > 1:
             mesh = Mesh(np.array(jax.devices()[:args.tp]), ("tp",))
-        else:
+        elif args.sp > 1:
             sp_mesh = Mesh(np.array(jax.devices()[:args.sp]), ("sp",))
+        else:
+            ep_mesh = Mesh(np.array(jax.devices()[:args.ep]), ("ep",))
     pipe = decode.DecodePipeline(registry.get_model_entry(
         args.model_name).family.FAMILY, cfg, partition, stage_params,
         max_len=max_len, dtype=dtype, cache_bits=args.kv_bits, mesh=mesh,
-        sp_mesh=sp_mesh)
+        sp_mesh=sp_mesh, ep_mesh=ep_mesh)
 
     heartbeat = None
     if args.monitor:
