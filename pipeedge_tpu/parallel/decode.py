@@ -375,6 +375,23 @@ def validate_partition(partition: Sequence[Tuple[int, int]],
                          f"contiguously cover [1, {total}]")
 
 
+def round_partition_to_blocks(partition: Sequence[Tuple[int, int]],
+                              total: int) -> List[Tuple[int, int]]:
+    """Round a sublayer-granular partition (e.g. from the native
+    sched-pipeline scheduler, which cuts at quarter-block granularity) to
+    the block-aligned cuts decoding requires: each interior cut moves to
+    the nearest block boundary (multiple of 4), empty stages are dropped.
+    Coverage of [1, total] is preserved."""
+    if total % 4:
+        raise ValueError(f"total sublayers {total} not a multiple of 4")
+    cuts = [r for (_, r) in partition[:-1]]
+    rounded = sorted({min(total - 4, max(4, round(c / 4) * 4))
+                      for c in cuts})
+    bounds = [0] + [c for c in rounded if c < total] + [total]
+    return [(bounds[i] + 1, bounds[i + 1]) for i in range(len(bounds) - 1)
+            if bounds[i + 1] > bounds[i]]
+
+
 def validate_capacity(cfg: TransformerConfig, max_len: int,
                       prompt_len: int = 0, new_tokens: int = 0) -> None:
     """Reject cache/position overflows up front: dynamic_update_slice
@@ -449,10 +466,7 @@ def make_ep_stage_fns(family, cfg: TransformerConfig,
     run = _make_stage_run(family, cfg, shard_config, block_fn=block_step_ep)
     # experts shard on their leading axis (under the stacked block axis);
     # everything else — attention weights, cache — replicated
-    p_specs = {k: jax.tree_util.tree_map(lambda _: P(), v)
-               for k, v in params.items() if k != "blocks"}
-    p_specs["blocks"] = jax.tree_util.tree_map(lambda _: P(),
-                                               params["blocks"])
+    p_specs = jax.tree_util.tree_map(lambda _: P(), params)
     p_specs["blocks"]["moe"]["experts"] = jax.tree_util.tree_map(
         lambda _: P(None, axis), params["blocks"]["moe"]["experts"])
     c_specs = {"k": P(), "v": P()}

@@ -154,9 +154,6 @@ def _ep_local(params: Dict, x: jax.Array, *, n_experts: int,
     """Per-device body under shard_map: local experts [E/n, ...], tokens
     replicated; each device computes its experts' capacity slots and a psum
     combines."""
-    n = jax.lax.axis_size(axis)
-    idx = jax.lax.axis_index(axis)
-    e_local = n_experts // n
     b, s, d = x.shape
     tokens = x.reshape(-1, d)
     _, gate, keep, kept = _routing(params["router"], tokens, n_experts,

@@ -308,6 +308,23 @@ def test_generate_dcn_matches_local(tmp_path):
     assert q_lines and q_lines[0].count(",") == 4  # 5 tokens emitted
 
 
+def test_round_partition_to_blocks():
+    """Sublayer-granular scheduler cuts round to block boundaries with
+    coverage preserved (the profile->schedule->decode glue)."""
+    r = decode.round_partition_to_blocks
+    assert r([(1, 6), (7, 12)], 12) == [(1, 8), (9, 12)]
+    assert r([(1, 5), (6, 7), (8, 12)], 12) == [(1, 4), (5, 8), (9, 12)]
+    assert r([(1, 12)], 12) == [(1, 12)]
+    # cuts collapsing onto the same boundary merge stages
+    assert r([(1, 5), (6, 6), (7, 12)], 12) == [(1, 4), (5, 8), (9, 12)]
+    assert r([(1, 1), (2, 2), (3, 12)], 12) == [(1, 4), (5, 12)]
+    for part in (r([(1, 3), (4, 9), (10, 12)], 12),):
+        covered = [x for l, rr in part for x in range(l, rr + 1)]
+        assert covered == list(range(1, 13))
+    with pytest.raises(ValueError, match="multiple of 4"):
+        r([(1, 5)], 5)
+
+
 def test_decode_validation_errors(gpt2_setup):
     cfg, weights, _ = gpt2_setup
     with pytest.raises(ValueError, match="block-aligned"):

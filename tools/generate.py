@@ -181,6 +181,9 @@ def main():
                              "(overwrites an existing decode.csv in cwd)")
     parser.add_argument("--rank", default=0, type=int,
                         help="this process's rank in a DCN fleet")
+    parser.add_argument("-sm", "--sched-models-file", default=None)
+    parser.add_argument("-sdt", "--sched-dev-types-file", default=None)
+    parser.add_argument("-sd", "--sched-dev-file", default=None)
     parser.add_argument("--edge-bits", default=0, type=int,
                         choices=[0, 2, 4, 6, 8, 16],
                         help="quantize DCN stage edges (QuantPipe activation "
@@ -203,6 +206,24 @@ def main():
         if len(nums) % 2:
             parser.error(f"-pt needs an even count of layer bounds: {nums}")
         partition = list(zip(nums[::2], nums[1::2]))
+    elif args.sched_models_file:
+        # profile-driven partitioning: the native DP scheduler cuts at
+        # sublayer granularity (its cost model is per quarter-block);
+        # decoding needs block-aligned stages, so round the cuts to the
+        # nearest block boundary
+        from pipeedge_tpu.sched.scheduler import sched_pipeline
+        sched = sched_pipeline(args.model_name, 2, 2, args.batch_size,
+                               models_file=args.sched_models_file,
+                               dev_types_file=args.sched_dev_types_file,
+                               dev_file=args.sched_dev_file)
+        if not sched:
+            raise SystemExit("No viable schedule found")
+        raw = [tuple(int(v) for v in layers)
+               for stage in sched for layers in stage.values()]
+        partition = decode.round_partition_to_blocks(raw, total)
+        if partition != raw:
+            print(f"scheduler partition {raw} rounded to block-aligned "
+                  f"{partition}")
     else:
         partition = [(1, total)]
     max_len = args.max_len or args.prompt_len + args.new_tokens
