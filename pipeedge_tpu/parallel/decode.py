@@ -636,15 +636,58 @@ class DecodePipeline:
             caches.append(c)
         return caches
 
+    def _prefill(self, ids, prefill_ubatch: Optional[int] = None):
+        """Run the prompt through all stages; returns (last-stage output,
+        per-stage caches).
+
+        `prefill_ubatch` splits the batch into chunks so prefill PIPELINES
+        across stages: JAX dispatch is asynchronous, so stage i's program
+        runs on chunk c+1 while stage i+1 processes chunk c — the standard
+        fill/drain overlap, with per-chunk caches concatenated on the batch
+        axis afterwards. (For capacity-bounded MoE models chunking changes
+        the routed token set, like any batch-size change.)"""
+        batch = ids.shape[0]
+
+        def run_stages(data):
+            caches = self._fresh_caches(data.shape[0])
+            for i, st in enumerate(self.stages):
+                if st["device"] is not None:
+                    data = jax.device_put(data, st["device"])
+                data, caches[i] = st["prefill"](st["params"], data,
+                                                caches[i])
+            return data, caches
+
+        if prefill_ubatch is None or prefill_ubatch >= batch:
+            return run_stages(ids)
+        if prefill_ubatch <= 0:
+            raise ValueError(f"prefill_ubatch must be positive, got "
+                             f"{prefill_ubatch}")
+        if batch % prefill_ubatch:
+            raise ValueError(f"batch {batch} not divisible by "
+                             f"prefill_ubatch {prefill_ubatch}")
+        outs, chunk_caches = [], []
+        for c0 in range(0, batch, prefill_ubatch):
+            data, caches = run_stages(ids[c0:c0 + prefill_ubatch])
+            outs.append(data)
+            chunk_caches.append(caches)
+        merged = [jax.tree_util.tree_map(
+            lambda *xs: jnp.concatenate(xs, axis=1), *[cc[i] for cc in
+                                                       chunk_caches])
+            for i in range(len(self.stages))]
+        return jnp.concatenate(outs, axis=0), merged
+
     def generate(self, ids, new_tokens: int, temperature: float = 0.0,
-                 top_k: int = 0, seed: int = 0, step_callback=None):
+                 top_k: int = 0, seed: int = 0, step_callback=None,
+                 prefill_ubatch: Optional[int] = None):
         """Decode `new_tokens` continuations of prompt `ids` [B, S].
 
         `temperature=0` (default) is greedy argmax; otherwise tokens are
         sampled from logits/temperature, optionally truncated to the
         `top_k` most likely. `step_callback(step, tokens)` fires after each
-        decode step (e.g. for monitoring heartbeats). Returns
-        [B, S + new_tokens] token ids (prompt included)."""
+        decode step (e.g. for monitoring heartbeats). `prefill_ubatch`
+        pipelines the prompt pass across stages in batch chunks (see
+        `_prefill`). Returns [B, S + new_tokens] token ids (prompt
+        included)."""
         ids = jnp.asarray(ids, jnp.int32)
         batch, prompt_len = ids.shape
         if new_tokens <= 0:
@@ -656,12 +699,7 @@ class DecodePipeline:
         rng = jax.random.PRNGKey(seed)
         pick = make_token_picker(temperature, top_k)
 
-        caches = self._fresh_caches(batch)
-        data = ids
-        for i, st in enumerate(self.stages):
-            if st["device"] is not None:
-                data = jax.device_put(data, st["device"])
-            data, caches[i] = st["prefill"](st["params"], data, caches[i])
+        data, caches = self._prefill(ids, prefill_ubatch)
         rng, sub = jax.random.split(rng)
         tokens = [pick(data[:, prompt_len - 1].astype(jnp.float32), sub)]
         if step_callback is not None:
@@ -705,12 +743,7 @@ class DecodePipeline:
                              f"the sp prefill degree {self.sp_degree}")
 
         # prefill once at batch B, then tile each prompt's cache per beam
-        caches = self._fresh_caches(batch)
-        data = ids
-        for i, st in enumerate(self.stages):
-            if st["device"] is not None:
-                data = jax.device_put(data, st["device"])
-            data, caches[i] = st["prefill"](st["params"], data, caches[i])
+        data, caches = self._prefill(ids)
         caches = [_repeat_batch(c, beams) for c in caches]
 
         logp = jax.nn.log_softmax(

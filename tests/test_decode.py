@@ -308,6 +308,23 @@ def test_generate_dcn_matches_local(tmp_path):
     assert q_lines and q_lines[0].count(",") == 4  # 5 tokens emitted
 
 
+def test_chunked_prefill_matches_whole(gpt2_setup):
+    """prefill_ubatch pipelines the prompt pass in batch chunks; tokens
+    must match the unchunked run exactly (dense model: routing-free)."""
+    cfg, weights, _ = gpt2_setup
+    partition = [(1, 4), (5, 12)]
+    pipe = decode.DecodePipeline(
+        gpt2_mod.FAMILY, cfg, partition,
+        _stage_params(cfg, partition, weights), max_len=24)
+    ids = np.asarray(
+        np.random.default_rng(71).integers(0, 100, size=(4, 6)), np.int64)
+    want = np.asarray(pipe.generate(ids, 7))
+    got = np.asarray(pipe.generate(ids, 7, prefill_ubatch=2))
+    np.testing.assert_array_equal(got, want)
+    with pytest.raises(ValueError, match="not divisible by"):
+        pipe.generate(ids[:3], 4, prefill_ubatch=2)
+
+
 def test_round_partition_to_blocks():
     """Sublayer-granular scheduler cuts round to block boundaries with
     coverage preserved (the profile->schedule->decode glue)."""

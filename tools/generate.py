@@ -176,6 +176,9 @@ def main():
     parser.add_argument("--beams", default=0, type=int,
                         help="beam-search width (0 = greedy/sampling; "
                              "local pipeline mode only)")
+    parser.add_argument("--prefill-ubatch", default=None, type=int,
+                        help="pipeline the prompt pass across stages in "
+                             "batch chunks of this size")
     parser.add_argument("--monitor", action="store_true",
                         help="record per-step heartbeats to decode.csv "
                              "(overwrites an existing decode.csv in cwd)")
@@ -232,14 +235,18 @@ def main():
     if args.beams and args.monitor:
         parser.error("--monitor records per-step heartbeats only for "
                      "greedy/sampled generation, not --beams")
+    if args.beams and args.prefill_ubatch:
+        parser.error("--prefill-ubatch applies to greedy/sampled "
+                     "generation, not --beams")
     if args.edge_bits and args.dcn_addrs is None:
         parser.error("--edge-bits applies to DCN stage edges; pass "
                      "--dcn-addrs")
     if args.dcn_addrs is not None:
         if args.tp > 1 or args.sp > 1 or args.ep > 1 or args.kv_bits \
-                or args.monitor or args.beams:
+                or args.monitor or args.beams or args.prefill_ubatch:
             parser.error("--dcn-addrs does not compose with --tp/--sp/--ep/"
-                         "--kv-bits/--monitor/--beams in this demo")
+                         "--kv-bits/--monitor/--beams/--prefill-ubatch in "
+                         "this demo")
         run_dcn(args, cfg, total, partition, max_len, dtype)
         return
     stage_params = []
@@ -296,7 +303,7 @@ def main():
         label = f"{len(partition)} stages, beam {args.beams}"
     else:
         sample_kw = dict(temperature=args.temperature, top_k=args.top_k,
-                         seed=args.seed)
+                         seed=args.seed, prefill_ubatch=args.prefill_ubatch)
         run = lambda n, cb=None: np.asarray(
             pipe.generate(ids, n, step_callback=cb, **sample_kw))
         label = f"{len(partition)} stages"
