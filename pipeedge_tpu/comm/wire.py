@@ -63,7 +63,11 @@ def wire_decode(tensors: List[np.ndarray], dtype):
     if bit == 0:
         out = tuple(jnp.asarray(t) for t in tensors)
     else:
-        assert len(tensors) % 4 == 0
+        if len(tensors) % 4:
+            raise ValueError(
+                f"malformed quantized wire frame: {len(tensors)} tensors "
+                "after the bitwidth header (expected a multiple of 4: "
+                "packed/scale/shift/shape per payload)")
         native = native_wire_codec(bit)
         out = []
         for i in range(0, len(tensors), 4):

@@ -191,10 +191,12 @@ def _block_step(p: Dict, x: jax.Array, bcache: Cache, pos,
 
 def _block_step_tp(p: Dict, x: jax.Array, bcache: Cache, pos,
                    cfg: TransformerConfig, prefill: bool,
-                   axis: str) -> Tuple[jax.Array, Cache]:
+                   axis: str, act=gelu_new,
+                   ffn_delta=None) -> Tuple[jax.Array, Cache]:
     """Megatron tensor-parallel block step under `shard_map`: the shared
     projection/psum/MLP body from parallel/tensor.py with the attention
-    core swapped for a cache-attend over the head-sharded KV cache."""
+    core swapped for a cache-attend over the head-sharded KV cache.
+    `ffn_delta` replaces the dense MLP (the tp x ep MoE composition)."""
     from .tensor import _tp_block_local
 
     new_cache = {}
@@ -205,8 +207,8 @@ def _block_step_tp(p: Dict, x: jax.Array, bcache: Cache, pos,
         new_cache.update(bc)
         return _attend(q, k, v, keep, cfg)      # [b, s, h_local * hd]
 
-    y = _tp_block_local(p, x, cfg, axis, act=gelu_new,
-                        qkv_to_ctx=cache_attend)
+    y = _tp_block_local(p, x, cfg, axis, act=act,
+                        qkv_to_ctx=cache_attend, ffn_delta=ffn_delta)
     return y, new_cache
 
 
@@ -330,7 +332,8 @@ def make_tp_stage_fns(family, cfg: TransformerConfig,
     if cfg.n_experts:
         raise NotImplementedError(
             "tensor-parallel decode does not cover MoE blocks (experts "
-            "shard over 'ep', not 'tp')")
+            "shard over 'ep', not 'tp') — use make_tp_ep_stage_fns / "
+            "DecodePipeline(tp_ep_mesh=...) for the tp x ep composition")
 
     def tp_finalize(pf, hidden, cfg):
         # final LN replicated; LM head column-sharded over the vocab, local
@@ -380,12 +383,14 @@ def round_partition_to_blocks(partition: Sequence[Tuple[int, int]],
     """Round a sublayer-granular partition (e.g. from the native
     sched-pipeline scheduler, which cuts at quarter-block granularity) to
     the block-aligned cuts decoding requires: each interior cut moves to
-    the nearest block boundary (multiple of 4), empty stages are dropped.
-    Coverage of [1, total] is preserved."""
+    the nearest block boundary (multiple of 4; a cut exactly halfway
+    between boundaries rounds UP — an explicit tie rule, where Python's
+    round() would banker's-round to the even block), empty stages are
+    dropped. Coverage of [1, total] is preserved."""
     if total % 4:
         raise ValueError(f"total sublayers {total} not a multiple of 4")
     cuts = [r for (_, r) in partition[:-1]]
-    rounded = sorted({min(total - 4, max(4, round(c / 4) * 4))
+    rounded = sorted({min(total - 4, max(4, int(c / 4 + 0.5) * 4))
                       for c in cuts})
     bounds = [0] + [c for c in rounded if c < total] + [total]
     return [(bounds[i] + 1, bounds[i + 1]) for i in range(len(bounds) - 1)
@@ -417,21 +422,32 @@ def _gather_batch(tree, rows: jax.Array):
     return jax.tree_util.tree_map(lambda x: jnp.take(x, rows, axis=1), tree)
 
 
-def make_token_picker(temperature: float = 0.0, top_k: int = 0):
-    """Jitted `pick(logits [B, V], rng) -> tokens [B]`: greedy argmax at
-    temperature 0, else categorical sampling over logits/temperature,
-    optionally truncated to the `top_k` most likely."""
-    @jax.jit
-    def pick(logits, rng):
-        if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1)
-        scaled = logits / jnp.float32(temperature)
-        if top_k > 0:
-            kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]
-            scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
-        return jax.random.categorical(rng, scaled, axis=-1)
+@partial(jax.jit, static_argnames=("temperature", "top_k"))
+def _pick_token(logits, rng, temperature: float, top_k: int):
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1)
+    scaled = logits / jnp.float32(temperature)
+    if top_k > 0:
+        # keep EXACTLY top_k candidates: scatter the top_k values back by
+        # index. A threshold compare (scaled >= kth) admits every logit
+        # tied with the k-th value, growing the candidate set on ties.
+        vals, idx = jax.lax.top_k(scaled, top_k)
+        rows = jnp.arange(scaled.shape[0])[:, None]
+        scaled = jnp.full_like(scaled, -jnp.inf).at[rows, idx].set(vals)
+    return jax.random.categorical(rng, scaled, axis=-1)
 
-    return pick
+
+def make_token_picker(temperature: float = 0.0, top_k: int = 0):
+    """`pick(logits [B, V], rng) -> tokens [B]`: greedy argmax at
+    temperature 0, else categorical sampling over logits/temperature,
+    optionally truncated to exactly the `top_k` most likely (ties at the
+    k-th value broken by index order, matching `jax.lax.top_k`).
+
+    Binds a module-level jitted function with static (temperature, top_k),
+    so repeated generate() calls with the same settings hit the jit cache
+    instead of retracing a fresh closure."""
+    return partial(_pick_token, temperature=float(temperature),
+                   top_k=int(top_k))
 
 
 def make_ep_stage_fns(family, cfg: TransformerConfig,
@@ -470,6 +486,87 @@ def make_ep_stage_fns(family, cfg: TransformerConfig,
     p_specs["blocks"]["moe"]["experts"] = jax.tree_util.tree_map(
         lambda _: P(None, axis), params["blocks"]["moe"]["experts"])
     c_specs = {"k": P(), "v": P()}
+
+    prefill_fn = jax.jit(jax.shard_map(
+        partial(run, pos=0, prefill=True), mesh=mesh,
+        in_specs=(p_specs, P(), c_specs), out_specs=(P(), c_specs),
+        check_vma=False))
+    decode_fn = jax.jit(jax.shard_map(
+        partial(run, prefill=False), mesh=mesh,
+        in_specs=(p_specs, P(), c_specs, P()), out_specs=(P(), c_specs),
+        check_vma=False))
+    return prefill_fn, decode_fn, p_specs
+
+
+def make_tp_ep_stage_fns(family, cfg: TransformerConfig,
+                         shard_config: ShardConfig, mesh, params: Dict,
+                         tp_axis: str = "tp", ep_axis: str = "ep"):
+    """The MoE serving composition: attention tensor-parallel over
+    `tp_axis` AND experts expert-parallel over `ep_axis`, in ONE mesh and
+    one shard_map program per stage.
+
+    This is the layout a real MoE serving stack needs — attention (and its
+    KV cache) head-sharded so decode-step latency scales with tp, experts
+    sharded so the dominant parameter mass splits across ep — and it is
+    exact: attention psums over tp reproduce the dense result, routing
+    sees the full (replicated) token set so top-1 capacity semantics are
+    untouched, and the expert psum over ep adds exactly one nonzero term
+    per token (parallel/expert.py). Cache rows shard over tp and
+    replicate over ep; embeddings, router, and LM head stay replicated.
+
+    Returns (prefill_fn, decode_fn, param_specs) — place params with the
+    returned specs. int8 caches are excluded for the same per-device
+    scale-row reason as plain tp decode."""
+    from jax.sharding import PartitionSpec as P
+
+    from .expert import ep_ffn_delta
+    from .tensor import _rename_axis, family_tp_ep_plan
+
+    if not cfg.n_experts:
+        raise ValueError("make_tp_ep_stage_fns requires an MoE config "
+                         "(cfg.n_experts > 0); use make_tp_stage_fns for "
+                         "dense models")
+    ntp, nep = mesh.shape[tp_axis], mesh.shape[ep_axis]
+    if cfg.num_attention_heads % ntp:
+        raise ValueError(f"tp={ntp} requires head count "
+                         f"({cfg.num_attention_heads}) divisible by tp")
+    if cfg.n_experts % nep:
+        raise ValueError(f"ep={nep} must divide n_experts "
+                         f"({cfg.n_experts})")
+    # single family-dispatch point (tensor.py), like family_tp_plan for
+    # dense TP: attention spec table + the family's FFN activation
+    fam_specs, act = family_tp_ep_plan(cfg)
+
+    def ffn_delta(p, normed):
+        return ep_ffn_delta(p["moe"], normed, cfg.n_experts,
+                            cfg.capacity_factor, ep_axis, act=act)
+
+    # the tp decode block step, with the dense MLP swapped for the
+    # ep-sharded routed FFN — one cache-attend implementation for both
+    run = _make_stage_run(family, cfg, shard_config,
+                          block_fn=partial(_block_step_tp, axis=tp_axis,
+                                           act=act, ffn_delta=ffn_delta))
+
+    # blocks: attention per the family's Megatron spec table over tp
+    # (stacked block axis leading), router replicated, expert slabs over ep
+    att_specs = _rename_axis(fam_specs, tp_axis)
+    p_specs = {k: jax.tree_util.tree_map(lambda _: P(), v)
+               for k, v in params.items() if k != "blocks"}
+    bspecs = {}
+    for k, v in params["blocks"].items():
+        if k == "moe":
+            bspecs[k] = {
+                "router": jax.tree_util.tree_map(lambda _: P(None),
+                                                 v["router"]),
+                "experts": jax.tree_util.tree_map(
+                    lambda _: P(None, ep_axis), v["experts"]),
+            }
+        else:
+            bspecs[k] = jax.tree_util.tree_map(
+                lambda _, s: P(*((None,) + tuple(s))), v, att_specs[k])
+    p_specs["blocks"] = bspecs
+    # same head-axis convention _fresh_caches places with (tp_cache_specs)
+    c_specs = tp_cache_specs(init_cache(cfg, 1, 1, 1), tp_axis)
 
     prefill_fn = jax.jit(jax.shard_map(
         partial(run, pos=0, prefill=True), mesh=mesh,
@@ -565,7 +662,7 @@ class DecodePipeline:
                  devices: Optional[Sequence] = None, dtype=jnp.float32,
                  cache_bits: int = 0, mesh=None, tp_axis: str = "tp",
                  sp_mesh=None, sp_axis: str = "sp", sp_kind: str = "ring",
-                 ep_mesh=None, ep_axis: str = "ep"):
+                 ep_mesh=None, ep_axis: str = "ep", tp_ep_mesh=None):
         total = 4 * cfg.num_hidden_layers
         validate_partition(partition, total)
         validate_capacity(cfg, max_len)
@@ -584,15 +681,35 @@ class DecodePipeline:
             raise ValueError("ep_mesh (expert-parallel MoE decode) does not "
                              "compose with tp/sp meshes, int8 cache, or "
                              "devices")
+        if tp_ep_mesh is not None and (mesh is not None or ep_mesh is not None
+                                       or sp_mesh is not None or cache_bits
+                                       or devices is not None):
+            raise ValueError("tp_ep_mesh (tp x ep MoE decode) replaces the "
+                             "single-axis meshes; it does not compose with "
+                             "mesh/ep_mesh/sp_mesh, int8 cache, or devices")
         self.cfg = cfg
         self.max_len = max_len
         self.mesh, self.tp_axis = mesh, tp_axis
+        self.tp_ep_mesh = tp_ep_mesh
         self.stages = []
         for i, (l, r) in enumerate(partition):
             sc = ShardConfig(l, r, is_first=l == 1, is_last=r == total)
             params = dict(stage_params[i])
             # restack an unrolled block layout ONCE here, not per traced call
             params["blocks"] = stage_blocks(params)
+            if tp_ep_mesh is not None:
+                from jax.sharding import NamedSharding
+                pre, dec, p_specs = make_tp_ep_stage_fns(
+                    family, cfg, sc, tp_ep_mesh, params,
+                    tp_axis=tp_axis, ep_axis=ep_axis)
+                params = jax.tree_util.tree_map(
+                    lambda x, s: jax.device_put(
+                        x, NamedSharding(tp_ep_mesh, s)), params, p_specs)
+                n_blocks = (r - l + 1) // 4
+                self.stages.append({"prefill": pre, "decode": dec,
+                                    "params": params, "n_blocks": n_blocks,
+                                    "device": None})
+                continue
             sharded = ((make_tp_stage_fns, mesh, tp_axis)
                        if mesh is not None else
                        (make_ep_stage_fns, ep_mesh, ep_axis)
@@ -623,13 +740,15 @@ class DecodePipeline:
 
     def _fresh_caches(self, batch: int) -> List[Cache]:
         caches = []
+        cache_mesh = self.mesh if self.mesh is not None else self.tp_ep_mesh
         for st in self.stages:
             c = init_cache(self.cfg, st["n_blocks"], batch, self.max_len,
                            self.dtype, cache_bits=self.cache_bits)
-            if self.mesh is not None:
+            if cache_mesh is not None:
                 from jax.sharding import NamedSharding
+                # head axis over tp; replicated over ep when present
                 specs = tp_cache_specs(c, self.tp_axis)
-                c = {k: jax.device_put(v, NamedSharding(self.mesh, specs[k]))
+                c = {k: jax.device_put(v, NamedSharding(cache_mesh, specs[k]))
                      for k, v in c.items()}
             elif st["device"] is not None:
                 c = jax.device_put(c, st["device"])

@@ -128,6 +128,28 @@ def _struct_sig(tree) -> Tuple:
     return (treedef, tuple((tuple(l.shape), str(l.dtype)) for l in leaves))
 
 
+def _layer_cfg_sig(cfg, layer: int) -> Tuple:
+    """Hashable per-layer signature of the model config: scalar fields as-is,
+    sequence-valued fields indexed at this layer's block. All currently
+    registered families have scalar (homogeneous) configs, but a future
+    family with per-block heterogeneity (e.g. varying expert counts) must
+    not silently reuse another block's timing/memory, so the block's own
+    config slice is part of the reuse-cache key. Memoize per block
+    (profile_layers_individually) — the sig is layer-invariant for the
+    scalar configs every current family uses."""
+    import dataclasses
+
+    block = (layer - 1) // 4
+    sig = []
+    for f in dataclasses.fields(cfg):
+        v = getattr(cfg, f.name)
+        if isinstance(v, (list, tuple)):
+            sig.append((f.name, v[block] if block < len(v) else None))
+        else:
+            sig.append((f.name, v))
+    return tuple(sig)
+
+
 def _measure_layer(fn, params, payload, iterations: int, warmup: bool,
                    ) -> Tuple[float, int, Any]:
     """(avg seconds, memory bytes, output payload) for one layer shard.
@@ -168,11 +190,16 @@ def profile_layers_individually(model_name: str, model_file: Optional[str],
     results = []
     payload = inputs
     model_layers = registry.get_model_layers(model_name)
+    cfg_entry = registry.get_model_config(model_name)
     cache: Dict[Tuple, Tuple[float, int, Any]] = {}
+    block_sigs: Dict[int, Tuple] = {}
     for layer in range(layer_start, layer_end + 1):
         shape_in = _payload_shapes(payload)
+        block = (layer - 1) // 4
+        if block not in block_sigs:
+            block_sigs[block] = _layer_cfg_sig(cfg_entry, layer)
         key = ((layer - 1) % 4, layer == 1, layer == model_layers,
-               _struct_sig(payload))
+               _struct_sig(payload), block_sigs[block])
         hit = cache.get(key) if reuse_identical else None
         if hit is not None:
             t, mem, out = hit
