@@ -45,7 +45,7 @@ def shard_vit_block_params(params: Dict, mesh: Mesh, axis: str = "tp") -> Dict:
 
 def _tp_block_local(p: Dict, x: jax.Array, cfg: TransformerConfig,
                     axis: str, act=gelu, causal: bool = False,
-                    qkv_to_ctx=None) -> jax.Array:
+                    qkv_to_ctx=None, ffn_delta=None) -> jax.Array:
     """Per-device block body under shard_map: local head/hidden slices +
     two psums. `x` is replicated across the tp axis. Serves every pre-LN
     family: ViT/DeiT as-is, GPT-2 via act=gelu_new + causal=True.
@@ -53,7 +53,10 @@ def _tp_block_local(p: Dict, x: jax.Array, cfg: TransformerConfig,
     `qkv_to_ctx(q, k, v) -> ctx` ([b, s, h_local*hd]) overrides the
     attention core over the local heads — how KV-cache decoding plugs its
     cache-attend into this same projection/psum/MLP body
-    (parallel/decode.py)."""
+    (parallel/decode.py). `ffn_delta(p, normed) -> delta` replaces the
+    dense Megatron MLP entirely — how the tp x ep MoE decode plugs the
+    ep-sharded routed FFN under the tp-sharded attention
+    (decode.make_tp_ep_stage_fns)."""
     n = jax.lax.axis_size(axis)
     heads_local = cfg.num_attention_heads // n
     b, s, d = x.shape
@@ -87,6 +90,8 @@ def _tp_block_local(p: Dict, x: jax.Array, cfg: TransformerConfig,
     x = attn.astype(x.dtype) + x
 
     normed = layer_norm(p["ln_after"], x, cfg.layer_norm_eps)
+    if ffn_delta is not None:
+        return x + ffn_delta(p, normed)
     up = jnp.dot(normed, p["mlp_up"]["w"].astype(x.dtype),
                  preferred_element_type=jnp.float32) + p["mlp_up"]["b"]
     hidden = act(up.astype(x.dtype))
@@ -107,11 +112,15 @@ def family_tp_plan(cfg: TransformerConfig):
     """THE family dispatch point for tensor parallelism: returns
     (param spec table, per-device block body). Every TP consumer — the
     placement helpers here and the SPMD pipeline's stacked specs/block
-    body — goes through this, so adding a family is one edit."""
+    body — goes through this, so adding a family is one edit. MoE
+    configs refuse here (the dense column/row kernel table does not
+    describe a routed FFN) — the MoE composition lives in
+    `family_tp_ep_plan`."""
     if cfg.n_experts:
         raise NotImplementedError(
             "Megatron TP does not cover MoE blocks (experts shard over "
-            "'ep', not the column/row kernel table)")
+            "'ep', not the column/row kernel table) — see family_tp_ep_plan "
+            "/ decode.make_tp_ep_stage_fns for the tp x ep composition")
     if cfg.model_type == "bert":
         return _BERT_PARAM_SPECS, _tp_bert_block_local
     if cfg.model_type == "gpt2":
@@ -119,6 +128,24 @@ def family_tp_plan(cfg: TransformerConfig):
         return _VIT_PARAM_SPECS, partial(_tp_block_local, act=gelu_new,
                                          causal=True)
     return _VIT_PARAM_SPECS, _tp_block_local
+
+
+def family_tp_ep_plan(cfg: TransformerConfig):
+    """Family dispatch for the tp x ep MoE composition: returns
+    (attention param spec table over 'tp', FFN activation). The attention
+    half of an MoE block shards exactly like its dense family's attention
+    (column q/k/v, row attn_out, replicated LNs); the routed FFN shards
+    over 'ep' (parallel/expert.py). decode.make_tp_ep_stage_fns is the
+    consumer — adding an MoE family is one edit HERE, mirroring
+    family_tp_plan's single-dispatch-point contract."""
+    if not cfg.n_experts:
+        raise ValueError("family_tp_ep_plan requires an MoE config "
+                         "(cfg.n_experts > 0); use family_tp_plan")
+    if cfg.model_type == "gpt2":
+        from ..models.layers import gelu_new
+        return _VIT_PARAM_SPECS, gelu_new
+    raise NotImplementedError(
+        f"no tp x ep plan for MoE family {cfg.model_type!r}")
 
 
 def shard_block_params(cfg: TransformerConfig, params: Dict, mesh: Mesh,

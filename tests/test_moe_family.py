@@ -83,6 +83,7 @@ def test_moe_split_matches_whole(moe_setup, partition):
     assert expected.shape == (2, 9, 100)
 
 
+@pytest.mark.slow
 def test_moe_spmd_pipeline(moe_setup):
     """MoE blocks through the one-program SPMD pipeline (pp x dp).
 
@@ -111,6 +112,7 @@ def test_moe_spmd_pipeline(moe_setup):
                                  spmd.make_pipeline_mesh(2, tp=2))
 
 
+@pytest.mark.slow
 def test_moe_decode_matches_forward_greedy(moe_setup):
     """KV-cache greedy decode == no-cache greedy (full forward per step)."""
     cfg, weights = moe_setup
@@ -135,6 +137,7 @@ def test_moe_decode_matches_forward_greedy(moe_setup):
                                         ("tp",)))
 
 
+@pytest.mark.slow
 def test_moe_ep_decode_matches_plain(moe_setup):
     """Expert-parallel MoE decode: experts shard over an 'ep' mesh inside
     the decode step (global routing, local expert slab, one psum), cache
@@ -162,6 +165,46 @@ def test_moe_ep_decode_matches_plain(moe_setup):
             ShardConfig(1, 8, is_first=True, is_last=True), ep_mesh, {})
 
 
+@pytest.mark.slow
+def test_moe_tp_ep_decode_matches_plain(moe_setup):
+    """VERDICT r2 item 7 — the MoE serving composition: attention
+    tp-sharded AND experts ep-sharded in ONE ('tp','ep') mesh per decode
+    stage. Exact vs the single-device pipeline: attention psums over tp
+    reproduce the dense result, routing sees the full token set, and the
+    expert psum over ep adds one nonzero term per token."""
+    cfg, weights = moe_setup
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices for a 2x2 tp x ep mesh")
+    partition = [(1, 4), (5, 8)]
+    stage_params = [_shard(cfg, weights, l, r)[0] for l, r in partition]
+    ids = np.random.default_rng(17).integers(0, 100, size=(2, 5))
+    plain = decode.DecodePipeline(gpt2_mod.FAMILY, cfg, partition,
+                                  stage_params, max_len=16)
+    want = np.asarray(plain.generate(ids, 6))
+    mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(2, 2), ("tp", "ep"))
+    piped = decode.DecodePipeline(gpt2_mod.FAMILY, cfg, partition,
+                                  stage_params, max_len=16, tp_ep_mesh=mesh)
+    got = np.asarray(piped.generate(ids, 6))
+    np.testing.assert_array_equal(got, want)
+
+    # guard rails: dense configs refuse (use plain tp), bad divisibility
+    # refuses, and tp_ep_mesh does not stack with the single-axis meshes
+    dense_cfg = TransformerConfig(
+        model_type="gpt2", hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64, vocab_size=100,
+        max_position_embeddings=64)
+    with pytest.raises(ValueError, match="requires an MoE config"):
+        decode.make_tp_ep_stage_fns(
+            gpt2_mod.FAMILY, dense_cfg,
+            ShardConfig(1, 8, is_first=True, is_last=True), mesh, {})
+    with pytest.raises(ValueError, match="does not compose|replaces"):
+        decode.DecodePipeline(gpt2_mod.FAMILY, cfg, partition, stage_params,
+                              max_len=16, tp_ep_mesh=mesh,
+                              ep_mesh=Mesh(np.asarray(jax.devices()[:2]),
+                                           ("ep",)))
+
+
+@pytest.mark.fleet
 def test_moe_runtime_cli(tmp_path):
     """MoE decoder end-to-end through the runtime CLI (host driver with a
     quantized (delta, residual) edge, then the SPMD driver)."""
@@ -179,6 +222,7 @@ def test_moe_runtime_cli(tmp_path):
         assert "throughput_items_sec=" in proc.stdout
 
 
+@pytest.mark.fleet
 def test_moe_save_weights_roundtrip(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)

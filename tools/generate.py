@@ -38,6 +38,13 @@ def run_dcn(args, cfg, total, partition, max_len, dtype):
     step, the token's hidden state hops rank-to-rank on CHANNEL_DATA and
     the last rank returns the next-token logits to rank 0 on
     CHANNEL_RESULTS (the same edge discipline as runtime.py's DCN driver).
+
+    Adaptive edge quantization (env ADAPTIVE_QUANT=HEURISTIC|HEURISTIC2|
+    CONTROLLER + SEND_CONSTRAINT, reference runtime.py:121-216): each
+    non-last rank adapts its OWN output edge's bitwidth on its own measured
+    'send' telemetry window, exactly like the runtime driver's DCN mode —
+    `--edge-bits` is then the starting bitwidth, and the consumer needs no
+    coordination because the bitwidth rides the wire header (comm/wire.py).
     """
     import jax
     import jax.numpy as jnp
@@ -65,7 +72,31 @@ def run_dcn(args, cfg, total, partition, max_len, dtype):
     prompt = args.prompt_len
     ids = prompt_ids(args, cfg)
 
+    # mutable output-edge bitwidth + adaptive policy (non-last ranks own
+    # exactly one edge; the runtime driver's _EdgeQuantState/-callback are
+    # reused so policy behavior is identical across both DCN applications)
+    edge = adaptive = None
+    monitoring_mod = None
+    if world > 1 and not sc.is_last:
+        import runtime as runtime_mod
+        edge = runtime_mod._EdgeQuantState(args.edge_bits)
+        if os.getenv(runtime_mod.ENV_ADAPTIVE_QUANT):
+            import logging
+
+            import monitoring as monitoring_mod
+            logging.basicConfig(level=logging.INFO)
+            window = runtime_mod.get_window_size()
+            monitoring_mod.init(runtime_mod.MONITORING_KEY_SEND, window,
+                                work_type="Mbits")
+            monitoring_mod.add_key(runtime_mod.MONITORING_KEY_RECV,
+                                   work_type="Mbits")
+            adaptive = runtime_mod._make_adaptive_callback([edge], window)
+    step_beat = [0]
+
     with dcn.DistDcnContext(world, rank, addrs) as ctx:
+        if adaptive is not None:
+            import runtime as runtime_mod
+            runtime_mod._register_dcn_monitor_hooks(ctx)
 
         def run_once(new_tokens):
             """One full fleet-lockstep generation (prefill + steps). Every
@@ -84,8 +115,11 @@ def run_dcn(args, cfg, total, partition, max_len, dtype):
                 out, cache = fn(params, data, cache) if pos is None else \
                     fn(params, data, cache, pos)
                 if not sc.is_last:
-                    ctx.send_tensors(rank + 1,
-                                     wire.wire_encode(out, args.edge_bits))
+                    ctx.send_tensors(rank + 1, wire.wire_encode(
+                        out, edge.quant_bit if edge is not None else 0))
+                    if adaptive is not None:
+                        adaptive(step_beat[0], out)
+                        step_beat[0] += 1
                 elif world > 1:
                     # last position's logits back to rank 0
                     last = out[:, -1] if pos is None else out[:, 0]
@@ -126,6 +160,8 @@ def run_dcn(args, cfg, total, partition, max_len, dtype):
                 [ids, np.stack([np.asarray(t) for t in tokens], axis=1)],
                 axis=1)
             print_summary(args, dt, result, f"{world} DCN ranks")
+    if monitoring_mod is not None:
+        monitoring_mod.finish()
 
 
 def main():
@@ -165,7 +201,10 @@ def main():
                              "single-device)")
     parser.add_argument("--ep", default=1, type=int,
                         help="expert-parallel degree for MoE models "
-                             "(experts shard over an 'ep' mesh per stage)")
+                             "(experts shard over an 'ep' mesh per stage); "
+                             "combine with --tp for the tp x ep serving "
+                             "mesh (attention tp-sharded, experts "
+                             "ep-sharded)")
     parser.add_argument("--temperature", default=0.0, type=float,
                         help="sampling temperature (0 = greedy)")
     parser.add_argument("--top-k", default=0, type=int,
@@ -255,21 +294,26 @@ def main():
             args.model_name, args.model_file, l, r, stage=i, dtype=dtype,
             unroll=False)  # DecodePipeline wants the stacked block layout
         stage_params.append(params)
-    mesh = sp_mesh = ep_mesh = None
+    mesh = sp_mesh = ep_mesh = tp_ep_mesh = None
     if args.tp > 1 or args.sp > 1 or args.ep > 1:
         import jax
         from jax.sharding import Mesh
-        need = max(args.tp, args.sp, args.ep)
+        tp_with_ep = args.tp > 1 and args.ep > 1    # MoE serving: tp x ep
+        need = args.tp * args.ep if tp_with_ep else max(args.tp, args.sp,
+                                                        args.ep)
         if len(jax.devices()) < need:
             parser.error(f"--tp/--sp/--ep {need} needs {need} devices, "
                          f"only {len(jax.devices())} visible")
-        if sum(x > 1 for x in (args.tp, args.sp, args.ep)) > 1:
-            parser.error("--tp/--sp/--ep are mutually exclusive in this "
-                         "demo")
+        if args.sp > 1 and (args.tp > 1 or args.ep > 1):
+            parser.error("--sp is mutually exclusive with --tp/--ep in "
+                         "this demo")
         if args.sp > 1 and args.prompt_len % args.sp:
             parser.error(f"--prompt-len {args.prompt_len} must divide by "
                          f"--sp {args.sp}")
-        if args.tp > 1:
+        if tp_with_ep:
+            tp_ep_mesh = Mesh(np.array(jax.devices()[:need]).reshape(
+                args.tp, args.ep), ("tp", "ep"))
+        elif args.tp > 1:
             mesh = Mesh(np.array(jax.devices()[:args.tp]), ("tp",))
         elif args.sp > 1:
             sp_mesh = Mesh(np.array(jax.devices()[:args.sp]), ("sp",))
@@ -278,7 +322,7 @@ def main():
     pipe = decode.DecodePipeline(registry.get_model_entry(
         args.model_name).family.FAMILY, cfg, partition, stage_params,
         max_len=max_len, dtype=dtype, cache_bits=args.kv_bits, mesh=mesh,
-        sp_mesh=sp_mesh, ep_mesh=ep_mesh)
+        sp_mesh=sp_mesh, ep_mesh=ep_mesh, tp_ep_mesh=tp_ep_mesh)
 
     heartbeat = None
     if args.monitor:
