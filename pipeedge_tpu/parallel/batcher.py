@@ -1,0 +1,194 @@
+"""Continuous batching for pipelined decoding: concurrent requests fill the
+pipeline bubbles a single autoregressive stream leaves empty.
+
+A single stream decodes one token per FULL pipeline traversal — with K
+stages, every stage idles K-1 of every K stage-times (docs/DECODE.md).
+Interleaving S concurrent requests as a wave — stage i decoding request r
+while stage i+1 decodes request r-1 — keeps every stage busy once S >=
+K, multiplying aggregate tokens/sec by ~min(S, K) without touching the
+compiled stage programs.
+
+TPU-first constraints drive the design:
+
+- **Static shapes preserved**: each request keeps its OWN per-stage cache
+  slots (created at admission, freed at completion), so the compiled
+  prefill/decode programs are exactly DecodePipeline's — one program per
+  (batch, prompt-shape) signature, shared by every request with that
+  signature, and token-for-token identical to a solo `generate()` run.
+  There is no cross-request padding or masking to invalidate shapes.
+- **Wave scheduling, host-driven**: the scheduler advances one "tick" at a
+  time; per tick each stage dispatches at most one request's stage-step.
+  Stages are processed back-to-front so a request advances exactly one
+  stage per tick (and a token finishing at the last stage re-enters stage
+  0 within the same tick — no idle gap). JAX dispatch is asynchronous, so
+  with stages placed on distinct devices the per-tick dispatches execute
+  concurrently; the host never blocks inside a tick.
+- **Ready-queue admission**: requests wait in a FIFO until an active slot
+  frees (`max_active` bounds cache memory, default = enough to saturate
+  the pipeline); arrivals and completions interleave freely mid-run —
+  the "continuous" in continuous batching.
+
+The reference has no analogue (its runtime is single-shot batch inference;
+the decode subsystem itself is already beyond-reference — docs/DECODE.md).
+"""
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .decode import DecodePipeline, make_token_picker, validate_capacity
+
+
+@dataclass
+class _Request:
+    rid: object
+    ids: jnp.ndarray                 # [B, S] prompt (prompt included in result)
+    new_tokens: int
+    pick: object                     # jitted token picker
+    rng: jax.Array
+    prompt_len: int
+    caches: Optional[List] = None    # per-stage cache slots (admission)
+    tokens: List = field(default_factory=list)
+
+    @property
+    def pos(self) -> int:
+        """Cache position for the NEXT decode wave: the wave that produces
+        token len(tokens)+1 attends through position prompt_len +
+        len(tokens) - 1 (mirrors DecodePipeline.generate's pos)."""
+        return self.prompt_len + len(self.tokens) - 1
+
+
+class ContinuousBatcher:
+    """Wave-scheduled multi-request decoding over a `DecodePipeline`.
+
+    >>> batcher = ContinuousBatcher(pipe)
+    >>> batcher.submit("a", ids_a, new_tokens=8)
+    >>> batcher.submit("b", ids_b, new_tokens=5, temperature=0.7, seed=1)
+    >>> results = batcher.run()      # {"a": [B, S_a+8], "b": [B, S_b+5]}
+
+    Results are token-identical to `pipe.generate(ids, new_tokens, ...)`
+    run solo with the same sampling settings: the same compiled stage
+    programs run on the same per-request data; only the interleaving
+    differs. `stats` afterwards reports ticks/stage_steps/tokens — in
+    steady state with >= n_stages active requests every stage works every
+    tick, i.e. ~1 token per tick vs a solo stream's 1 per n_stages.
+    """
+
+    def __init__(self, pipe: DecodePipeline, max_active: Optional[int] = None):
+        if pipe.sp_degree != 1:
+            raise ValueError("continuous batching drives per-request decode "
+                             "waves; sp prefill is a whole-pipeline pass "
+                             "(prefill each request solo instead)")
+        self.pipe = pipe
+        self.n_stages = len(pipe.stages)
+        # n_stages slots saturate the pipeline; +1 hides the one-tick gap
+        # when a finished request's slot is re-admitted
+        self.max_active = (self.n_stages + 1 if max_active is None
+                           else max_active)
+        if self.max_active < 1:
+            raise ValueError(f"max_active must be >= 1, got {self.max_active}")
+        self.pending: deque = deque()
+        self.active = 0
+        self._live_rids = set()      # pending + admitted (not yet completed)
+        # stage i's input queue: (request, data, prefill?) tuples; `data`
+        # is token ids at stage 0, the previous stage's hidden state after
+        self._stage_q: List[deque] = [deque() for _ in range(self.n_stages)]
+        self.results: Dict = {}
+        self.stats = {"ticks": 0, "stage_steps": 0, "tokens": 0}
+
+    def submit(self, rid, ids, new_tokens: int, temperature: float = 0.0,
+               top_k: int = 0, seed: int = 0) -> None:
+        """Queue a request. `ids` [B, S] is a prompt batch decoded in
+        lockstep (B=1 for a single sequence); each distinct (B, S) shape
+        compiles its own prefill program, shared across requests."""
+        if rid in self.results or rid in self._live_rids:
+            raise ValueError(f"duplicate request id {rid!r}")
+        ids = jnp.asarray(ids, jnp.int32)
+        if new_tokens < 1:
+            raise ValueError(f"new_tokens must be >= 1, got {new_tokens}")
+        validate_capacity(self.pipe.cfg, self.pipe.max_len, ids.shape[1],
+                          new_tokens)
+        self._live_rids.add(rid)
+        self.pending.append(_Request(
+            rid=rid, ids=ids, new_tokens=new_tokens,
+            pick=make_token_picker(temperature, top_k),
+            rng=jax.random.PRNGKey(seed), prompt_len=ids.shape[1]))
+
+    def _admit(self) -> None:
+        while self.pending and self.active < self.max_active:
+            req = self.pending.popleft()
+            req.caches = self.pipe._fresh_caches(req.ids.shape[0])
+            self.active += 1
+            self._stage_q[0].append((req, req.ids, True))
+
+    def _finish_wave(self, req: _Request, out, prefill: bool,
+                     reentries: list) -> None:
+        """Last stage done: pick the next token, then complete or re-enter
+        stage 0 (same split-per-pick rng discipline as generate())."""
+        logits = out[:, req.prompt_len - 1] if prefill else out[:, 0]
+        req.rng, sub = jax.random.split(req.rng)
+        token = req.pick(logits.astype(jnp.float32), sub)
+        req.tokens.append(token)
+        self.stats["tokens"] += int(token.shape[0])
+        if len(req.tokens) >= req.new_tokens:
+            self.results[req.rid] = np.concatenate(
+                [np.asarray(req.ids),
+                 np.stack([np.asarray(t) for t in req.tokens], axis=1)],
+                axis=1)
+            req.caches = None        # free this request's cache slots
+            self.active -= 1
+            self._live_rids.discard(req.rid)
+        else:
+            reentries.append((req, token[:, None], False))
+
+    def tick(self) -> bool:
+        """Advance every stage by at most one stage-step; returns whether
+        any work remains.
+
+        Strict wave semantics: stages are drained back-to-front and a
+        token finishing at the last stage re-enters stage 0 only AFTER the
+        tick, so every request advances exactly one stage per tick and all
+        of a tick's dispatches belong to DISTINCT requests. That makes a
+        tick one parallel stage-time: no intra-tick data dependencies, so
+        with stages on distinct devices the asynchronously dispatched
+        steps genuinely overlap. (A solo request therefore costs exactly
+        n_stages ticks per token — the pipeline-bubble baseline the
+        batcher exists to fill.)"""
+        self._admit()
+        worked = False
+        reentries: list = []
+        for i in reversed(range(self.n_stages)):
+            if not self._stage_q[i]:
+                continue
+            req, data, prefill = self._stage_q[i].popleft()
+            st = self.pipe.stages[i]
+            if st["device"] is not None:
+                data = jax.device_put(data, st["device"])
+            if prefill:
+                out, req.caches[i] = st["prefill"](st["params"], data,
+                                                   req.caches[i])
+            else:
+                out, req.caches[i] = st["decode"](st["params"], data,
+                                                  req.caches[i], req.pos)
+            self.stats["stage_steps"] += 1
+            worked = True
+            if i + 1 < self.n_stages:
+                self._stage_q[i + 1].append((req, out, prefill))
+            else:
+                self._finish_wave(req, out, prefill, reentries)
+        self._stage_q[0].extend(reentries)
+        self.stats["ticks"] += worked
+        self._admit()                # a completion may free a slot mid-tick
+        return worked or self.active > 0 or bool(self.pending)
+
+    def run(self) -> Dict:
+        """Drive ticks until every submitted request completes; returns
+        {rid: [B, prompt+new_tokens] ids} (prompt included)."""
+        while self.tick():
+            pass
+        return self.results
