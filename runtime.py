@@ -165,6 +165,17 @@ def get_pipeline_sched(world_size: int, hosts: Optional[List[str]],
     native scheduler (reference runtime.py:291-355)."""
     if partition:
         logger.info("Scheduling: using user-defined partitioning")
+        # reject out-of-range/non-contiguous -pt up front: an oversized
+        # partition otherwise marks an interior stage is_last (its r ==
+        # model total), whose classifier logits then feed the next stage
+        # and fail with an unrelated broadcast error deep in layer_norm
+        from pipeedge_tpu.parallel.decode import validate_partition
+        total = registry.get_model_layers(model_name)
+        try:
+            validate_partition(partition, total)
+        except ValueError as exc:
+            raise RuntimeError(
+                f"-pt: {exc} ({model_name} has {total} sublayers)") from exc
         stage_layers = partition
         stage_quant = quant if quant else [0] * len(stage_layers)
         stage_ranks = rank_order if rank_order else list(range(len(stage_layers)))
@@ -462,12 +473,15 @@ def run_pipeline_spmd(args, stage_layers, stage_quant, stage_ranks,
     if stage_ranks and list(stage_ranks) != list(range(n_stages)):
         devices = jax.devices()
         mapped = [r % len(devices) for r in stage_ranks]
-        if len(set(mapped)) == n_stages:
-            ranks = mapped
-        else:
-            logger.warning("stage_ranks %s not distinct on %d devices; "
-                           "using default stage order", stage_ranks,
-                           len(devices))
+        if len(set(mapped)) != n_stages:
+            # hard error, not a silent identity fallback: the user asked
+            # for an explicit stage placement the mesh cannot honor
+            raise RuntimeError(
+                f"-r stage ranks {list(stage_ranks)} map to non-distinct "
+                f"devices {mapped} on {len(devices)} available devices; "
+                "spmd mode needs one distinct device per stage (drop -r "
+                "for the default identity order)")
+        ranks = mapped
     mesh = spmd.make_pipeline_mesh(n_stages, dp=args.spmd_dp,
                                    tp=args.spmd_tp, sp=args.spmd_sp,
                                    stage_ranks=ranks)

@@ -46,6 +46,7 @@ def test_restore_with_sharding(tmp_path, tiny_params):
     _assert_trees_equal(params, restored)
 
 
+@pytest.mark.fleet
 def test_stage_checkpoints_match_npz_loader(tmp_path):
     """Per-stage orbax checkpoints hold exactly what module_shard_factory
     loads from the npz for the same partition."""

@@ -11,6 +11,10 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+import pytest
+
+pytestmark = pytest.mark.fleet  # every test here spawns OS processes
+
 def _free_ports(n):
     socks = [socket.create_server(("127.0.0.1", 0)) for _ in range(n)]
     ports = [s.getsockname()[1] for s in socks]

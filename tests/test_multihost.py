@@ -10,6 +10,10 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+import pytest
+
+pytestmark = pytest.mark.fleet  # every test here spawns OS processes
+
 def test_two_process_spmd_pipeline():
     with socket.create_server(("127.0.0.1", 0)) as s:
         coord = f"127.0.0.1:{s.getsockname()[1]}"

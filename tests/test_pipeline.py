@@ -18,6 +18,8 @@ from pipeedge_tpu.models.layers import TransformerConfig  # noqa: E402
 from pipeedge_tpu.models.shard import make_shard_fn  # noqa: E402
 from pipeedge_tpu.parallel.pipeline import HostPipeline, PipelineStage  # noqa: E402
 
+pytestmark = pytest.mark.slow  # host-pipeline integration compiles per-stage programs
+
 TINY = dict(hidden_size=32, num_hidden_layers=3, num_attention_heads=4,
             intermediate_size=64)
 

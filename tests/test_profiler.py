@@ -16,6 +16,8 @@ from pipeedge_tpu import profiler as prof
 from pipeedge_tpu.models import registry
 from pipeedge_tpu.sched.scheduler import _REPO_BUILD_PATHS, sched_pipeline
 
+pytestmark = pytest.mark.slow  # profiles compile per-layer programs
+
 MODEL = "pipeedge/test-tiny-vit"
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -122,6 +124,7 @@ def test_validate_profile_results(profile_results):
 @pytest.mark.skipif(
     not (os.path.exists(_REPO_BUILD_PATHS[0]) or shutil.which("sched-pipeline")),
     reason="sched-pipeline binary not built")
+@pytest.mark.fleet
 def test_convert_and_schedule_end_to_end(profile_results, tmp_path):
     results_yml = tmp_path / "profiler_results.yml"
     with open(results_yml, "w", encoding="utf-8") as f:

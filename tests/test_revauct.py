@@ -141,6 +141,7 @@ def test_no_path_returns_empty():
     assert cost == float('inf')
 
 
+@pytest.mark.fleet
 def test_revauct_cli(tmp_path):
     n = 8
     models = {"pipeedge/test-tiny-vit": {
@@ -173,6 +174,7 @@ def test_revauct_cli(tmp_path):
     assert covered == list(range(1, n + 1))  # 1-based in CLI output
 
 
+@pytest.mark.fleet
 def test_revauct_distributed_dcn_matches_centralized(tmp_path):
     """Distributed auction over the DCN command plane (reference deployment,
     revauct.py:168-180): one process per rank, each bidding ONLY from its own
@@ -263,6 +265,7 @@ def test_revauct_distributed_dcn_matches_centralized(tmp_path):
     assert covered == list(range(1, n + 1))
 
 
+@pytest.mark.fleet
 def test_revauct_dcn_missing_bidder_releases_fleet(tmp_path):
     """A bidder that never shows up must not hang the auction: the
     auctioneer fails fast (broadcast undeliverable) or after

@@ -18,6 +18,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 MODEL = "pipeedge/test-tiny-vit"
 
 
+pytestmark = pytest.mark.fleet  # every test here spawns OS processes
+
 def _run(tmp_path, *extra, env_extra=None, timeout=300):
     env = dict(os.environ,
                JAX_PLATFORMS="cpu",

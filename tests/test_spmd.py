@@ -19,6 +19,8 @@ from pipeedge_tpu.models.layers import TransformerConfig  # noqa: E402
 from pipeedge_tpu.models.shard import make_shard_fn  # noqa: E402
 from pipeedge_tpu.parallel import spmd  # noqa: E402
 
+pytestmark = pytest.mark.slow  # every test compiles multi-stage shard_map programs
+
 TINY4 = dict(hidden_size=32, num_hidden_layers=4, num_attention_heads=4,
              intermediate_size=64)
 

@@ -16,6 +16,8 @@ CFG = TransformerConfig(model_type="vit", hidden_size=64, num_hidden_layers=1,
                         num_labels=0, image_size=16, patch_size=4)
 
 
+pytestmark = pytest.mark.slow  # tp blocks compile shard_map programs per degree
+
 def _block_params():
     params = vit_mod.init_params(CFG, ShardConfig(1, 4), seed=3)
     return jax.tree_util.tree_map(lambda x: x[0], params["blocks"])

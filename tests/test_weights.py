@@ -12,6 +12,8 @@ from pipeedge_tpu.models import registry
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+pytestmark = pytest.mark.fleet  # every test here spawns OS processes
+
 @pytest.mark.parametrize("model", ["pipeedge/test-tiny-vit",
                                    "pipeedge/test-tiny-bert",
                                    "pipeedge/test-tiny-gpt2"])
