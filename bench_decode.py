@@ -1,0 +1,134 @@
+"""Decode benchmark: GPT-2 KV-cache generation throughput on this chip.
+
+Prints ONE JSON line with the headline metric (tokens/sec at the largest
+batch) plus the measured matrix VERDICT r2 item 3 asks for:
+- tokens/sec + steady-state decode-step ms per batch size (default 1, 16)
+- prefill latency (ms) for the prompt pass
+- A/Bs at the largest batch: int8 KV cache on/off, chunked vs unchunked
+  prefill — measured, not reasoned.
+
+The reference has no decode subsystem (encoder-only model list,
+single-shot batch runtime — SURVEY.md §2.4), so there is no reference
+baseline: `vs_baseline` is null and the numbers stand as this
+framework's own record (docs/DECODE.md keeps the history).
+
+Method notes: generation runs through the same single-stage
+DecodePipeline users get from tools/generate.py (compiled prefill + one
+compiled decode-step program; steps dispatch asynchronously, the final
+token concat fences). Steady-state step time is measured as
+(t(N tokens) - t(N0 tokens)) / (N - N0), which cancels both the prefill
+and the fixed dispatch/readback overhead of the tunneled platform.
+Weights are random (zero egress); decode timing is weight-independent
+(same matmul shapes, no data-dependent control flow).
+"""
+import argparse
+import json
+import time
+
+
+def _time_generate(pipe, ids, new_tokens, reps=3, **kw):
+    import numpy as np
+    best = float("inf")
+    for _ in range(reps):
+        tik = time.monotonic()
+        out = pipe.generate(ids, new_tokens, **kw)
+        np.asarray(out)            # fence
+        best = min(best, time.monotonic() - tik)
+    return best
+
+
+def bench_pipe(pipe, ids, new_tokens, prefill_ubatch=None):
+    """(tokens/sec, steady step ms, prefill ms) for one pipeline+batch."""
+    kw = dict(prefill_ubatch=prefill_ubatch)
+    n0 = max(2, new_tokens // 8)
+    pipe.generate(ids, 2, **kw)            # compile prefill+step programs
+    t_full = _time_generate(pipe, ids, new_tokens, **kw)
+    t_n0 = _time_generate(pipe, ids, n0, **kw)
+    step_s = (t_full - t_n0) / (new_tokens - n0)
+    batch = ids.shape[0]
+    tok_per_sec = batch * new_tokens / t_full
+    # prefill latency ~= t_n0 minus its n0 decode steps
+    prefill_ms = max(0.0, (t_n0 - n0 * step_s)) * 1e3
+    return tok_per_sec, step_s * 1e3, prefill_ms
+
+
+def main():
+    from pipeedge_tpu.utils import apply_env_platform
+    apply_env_platform()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pipeedge_tpu.models import registry
+    from pipeedge_tpu.parallel import decode
+
+    p = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    p.add_argument("-m", "--model-name", default="gpt2")
+    p.add_argument("--prompt-len", default=128, type=int)
+    p.add_argument("--new-tokens", default=64, type=int)
+    p.add_argument("--batches", default="1,16",
+                   help="comma-separated batch sizes; the largest carries "
+                        "the headline metric and the A/Bs")
+    p.add_argument("-t", "--dtype", default="bfloat16",
+                   choices=["float32", "bfloat16"])
+    args = p.parse_args()
+
+    cfg = registry.get_model_config(args.model_name)
+    total = registry.get_model_layers(args.model_name)
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    max_len = args.prompt_len + args.new_tokens
+    decode.validate_capacity(cfg, max_len, args.prompt_len, args.new_tokens)
+    batches = sorted(int(b) for b in args.batches.split(","))
+
+    _, params, _ = registry.module_shard_factory(
+        args.model_name, None, 1, total, dtype=dtype, unroll=False)
+    family = registry.get_model_entry(args.model_name).family.FAMILY
+
+    def make_pipe(cache_bits=0):
+        return decode.DecodePipeline(
+            family, cfg, [(1, total)], [params], max_len=max_len,
+            dtype=dtype, cache_bits=cache_bits)
+
+    rng = np.random.default_rng(0)
+    pipe = make_pipe()
+    per_batch = {}
+    for b in batches:
+        ids = rng.integers(0, cfg.vocab_size, size=(b, args.prompt_len))
+        tps, step_ms, prefill_ms = bench_pipe(pipe, ids, args.new_tokens)
+        per_batch[b] = {"tokens_per_sec": round(tps, 1),
+                        "decode_step_ms": round(step_ms, 3),
+                        "prefill_ms": round(prefill_ms, 1)}
+
+    b_big = batches[-1]
+    ids_big = rng.integers(0, cfg.vocab_size, size=(b_big, args.prompt_len))
+
+    # A/B: int8 KV cache (same prompt set, fresh pipeline)
+    tps_int8, step_int8, _ = bench_pipe(make_pipe(cache_bits=8), ids_big,
+                                        args.new_tokens)
+    # A/B: chunked prefill (pipelines the prompt pass in batch chunks)
+    chunk = max(1, b_big // 4)
+    _, _, prefill_chunked = bench_pipe(pipe, ids_big, args.new_tokens,
+                                       prefill_ubatch=chunk)
+
+    import jax
+    print(json.dumps({
+        "metric": f"{args.model_name}_decode_tokens_per_sec_b{b_big}",
+        "value": per_batch[b_big]["tokens_per_sec"],
+        "unit": "tokens/sec",
+        "vs_baseline": None,     # the reference has no decode subsystem
+        "per_batch": {str(b): v for b, v in per_batch.items()},
+        "prompt_len": args.prompt_len,
+        "new_tokens": args.new_tokens,
+        "dtype": args.dtype,
+        "int8_kv": {"tokens_per_sec": round(tps_int8, 1),
+                    "decode_step_ms": round(step_int8, 3)},
+        "chunked_prefill_ms": round(prefill_chunked, 1),
+        "whole_prefill_ms": per_batch[b_big]["prefill_ms"],
+        "prefill_chunk": chunk,
+        "device_kind": jax.devices()[0].device_kind,
+    }))
+
+
+if __name__ == "__main__":
+    main()

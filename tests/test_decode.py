@@ -250,7 +250,8 @@ def test_generate_cli(tmp_path):
     import sys
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
-    for extra in ([], ["--kv-bits", "8"]):
+    for extra in ([], ["--kv-bits", "8"], ["--concurrent", "3"],
+                  ["--beams", "2"]):
         proc = subprocess.run(
             [sys.executable, os.path.join(repo, "tools", "generate.py"),
              "-m", "pipeedge/test-tiny-gpt2", "-pt", "1,4,5,8", "-b", "2",
@@ -259,6 +260,10 @@ def test_generate_cli(tmp_path):
             timeout=300)
         assert proc.returncode == 0, proc.stderr
         assert "tok/s" in proc.stdout
+        if extra[:1] == ["--concurrent"]:
+            assert "continuous batching" in proc.stdout
+        if extra[:1] == ["--beams"]:
+            assert "beam 2" in proc.stdout   # CLI really ran beam search
 
 
 @pytest.mark.fleet

@@ -1,0 +1,1 @@
+"""CLI tools; package marker so tests can import fixture recipes."""

@@ -218,6 +218,11 @@ def main():
     parser.add_argument("--prefill-ubatch", default=None, type=int,
                         help="pipeline the prompt pass across stages in "
                              "batch chunks of this size")
+    parser.add_argument("--concurrent", default=0, type=int,
+                        help="continuous batching: decode this many "
+                             "concurrent requests (each of -b sequences) "
+                             "wave-scheduled across the pipeline stages; "
+                             "tokens match solo runs per request")
     parser.add_argument("--monitor", action="store_true",
                         help="record per-step heartbeats to decode.csv "
                              "(overwrites an existing decode.csv in cwd)")
@@ -341,6 +346,34 @@ def main():
                                  safe=False)
 
     ids = prompt_ids(args, cfg)
+    if args.concurrent:
+        if args.beams or args.monitor or args.prefill_ubatch:
+            parser.error("--concurrent composes with greedy/sampled "
+                         "generation only (not --beams/--monitor/"
+                         "--prefill-ubatch)")
+        from pipeedge_tpu.parallel.batcher import ContinuousBatcher
+
+        def run_batch():
+            batcher = ContinuousBatcher(pipe)
+            for req in range(args.concurrent):
+                batcher.submit(req, ids, args.new_tokens,
+                               temperature=args.temperature,
+                               top_k=args.top_k, seed=args.seed + req)
+            return batcher, batcher.run()
+
+        run_batch()                      # compile programs
+        tik = time.monotonic()
+        batcher, results = run_batch()
+        dt = time.monotonic() - tik
+        n_tok = args.concurrent * args.batch_size * args.new_tokens
+        print(f"generated {args.concurrent}x{args.batch_size}x"
+              f"{args.new_tokens} tokens in {dt:.3f}s = {n_tok / dt:.1f} "
+              f"tok/s ({len(partition)} stages, continuous batching; "
+              f"{batcher.stats['ticks']} ticks, "
+              f"{batcher.stats['stage_steps']} stage-steps)")
+        print("sample continuation ids:",
+              results[0][0, args.prompt_len:].tolist())
+        return
     if args.beams:
         run = lambda n, cb=None: np.asarray(
             pipe.generate_beam(ids, n, beams=args.beams))

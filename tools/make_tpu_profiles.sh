@@ -32,3 +32,4 @@ python -c "import yaml; yaml.safe_dump(
     open('profiles/tpu/devices.yml', 'w'))"
 
 run python bench.py
+run python bench_decode.py
