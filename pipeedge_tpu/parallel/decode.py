@@ -212,6 +212,15 @@ def _block_step_tp(p: Dict, x: jax.Array, bcache: Cache, pos,
     return y, new_cache
 
 
+def single_token_embed(pe: Dict, tok: jax.Array, pos) -> jax.Array:
+    """Embed one decode-step token [B] at traced position `pos` ->
+    [B, 1, D]: wte row + dynamic-sliced wpe row. THE single-token
+    embedding rule — shared by the host stage runner and the SPMD wave
+    decoder so they cannot diverge."""
+    wpe = jax.lax.dynamic_slice_in_dim(pe["wpe"], pos, 1)
+    return jnp.take(pe["wte"], tok.reshape(-1), axis=0)[:, None] + wpe[None]
+
+
 def stage_blocks(params: Dict) -> jax.Array:
     """The stacked blocks pytree of a decode stage (block-aligned shard)."""
     blocks = params.get("blocks")
@@ -264,10 +273,7 @@ def _make_stage_run(family, cfg: TransformerConfig,
             elif prefill:
                 data = family.embed(params["embeddings"], data, cfg)
             else:
-                wpe = jax.lax.dynamic_slice_in_dim(
-                    params["embeddings"]["wpe"], pos, 1)
-                data = jnp.take(params["embeddings"]["wte"], data,
-                                axis=0) + wpe[None]
+                data = single_token_embed(params["embeddings"], data, pos)
         data, cache = _run_blocks(stage_blocks(params), data, cache, pos,
                                   cfg, prefill, block_fn=block_fn)
         if shard_config.is_last:

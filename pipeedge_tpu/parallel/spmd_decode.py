@@ -1,0 +1,327 @@
+"""SPMD wave decoding: continuous batching as ONE XLA program per phase.
+
+The host-driven `ContinuousBatcher` (parallel/batcher.py) dispatches one
+stage program per (stage, tick) — n_stages dispatches per tick, with the
+host in the loop. On real hardware each dispatch costs fixed overhead
+(tens of ms through a tunneled controller — docs/PERF.md), which dwarfs a
+decode step's compute. This module compiles the ENTIRE wave schedule into
+two `shard_map` programs over a ('stage',) mesh:
+
+- **prefill program**: R = n_stages requests enter stage 0 on successive
+  ticks; each stage prefills a different request per tick (full-prompt
+  pass), hidden states hop stage-to-stage via `lax.ppermute` over ICI,
+  and the last stage emits each request's first greedy token. 2K-1 ticks.
+- **decode program**: the steady-state wave — per tick, stage i decodes
+  the request whose wave is at stage i (`req = (t - i) mod K`), so every
+  stage works every tick and the fleet emits ~one token per tick
+  (min(S, K)x a solo stream, with ZERO host round-trips inside the
+  generation: one `lax.scan` over all (N-1)*K + K-1 ticks).
+
+Design notes (mirrors parallel/spmd.py's forward pipeline):
+- Stage-stacked zero-padded blocks with an `n_blocks` validity count;
+  embeddings/finalize run under `lax.cond` on the device-local stage
+  index, so only stage 0 pays the embed and only the last stage pays the
+  LM-head matmul per tick.
+- Per-stage KV caches hold every request's rows for that stage's blocks:
+  leaf [stage, max_b, R, B, T, H, Dh], sharded over 'stage'. A tick
+  dynamic-slices its request's cache, runs the shared cached block step
+  (parallel/decode.py `_block_step` — one attention/cache semantics for
+  host and SPMD decode), and writes back gated on tick validity so
+  fill/drain garbage never corrupts a cache.
+- Wave bookkeeping is arithmetic, not state: request r's decode wave m
+  runs pos = S_p + m - 1, and stage i at tick t serves req (t-i) mod K at
+  wave floor((t-i)/K)+1 — every device derives it from t, keeping all
+  replicated state in lockstep. New tokens broadcast last-stage -> all
+  via one psum (the only collective besides the edge ppermute).
+
+Scope: greedy decoding, R == n_stages request slots, equal prompt
+lengths/budgets per slot (the static-shape steady state; the host-driven
+batcher handles ragged arrivals). Token-identical to per-request
+`DecodePipeline.generate` (tests/test_spmd_decode.py).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models import ShardConfig
+from ..models.layers import TransformerConfig
+from ..models.shard import FamilySpec
+from . import decode as dec
+from .spmd import _pad_stack, partition_to_blocks
+
+
+class SpmdDecodePipeline:
+    """Wave-scheduled greedy decoding compiled over a ('stage',) mesh.
+
+    `generate(ids, new_tokens)` takes ids [R, B, S_p] — R = n_stages
+    request slots decoded concurrently — and returns [R, B, S_p + N].
+    """
+
+    def __init__(self, family: FamilySpec, cfg: TransformerConfig,
+                 partition: Sequence[Tuple[int, int]],
+                 stage_params: Sequence[Dict], mesh: Mesh, max_len: int,
+                 dtype=jnp.float32):
+        total = 4 * cfg.num_hidden_layers
+        dec.validate_partition(partition, total)
+        dec.validate_capacity(cfg, max_len)
+        block_ranges = partition_to_blocks(partition)
+        n_stages = len(partition)
+        if mesh.shape["stage"] != n_stages:
+            raise ValueError(f"mesh 'stage' axis {mesh.shape['stage']} != "
+                             f"{n_stages} pipeline stages")
+        if cfg.n_experts:
+            raise NotImplementedError(
+                "SPMD wave decode covers dense families; MoE decodes via "
+                "DecodePipeline(ep_mesh/tp_ep_mesh)")
+        self.family, self.cfg, self.mesh = family, cfg, mesh
+        self.n_stages, self.max_len, self.dtype = n_stages, max_len, dtype
+
+        stage_blocks, n_blocks = [], []
+        embed = final = None
+        for i, p in enumerate(stage_params):
+            p = dict(p)
+            p["blocks"] = dec.stage_blocks(p)
+            stage_blocks.append(p["blocks"])
+            n_blocks.append(block_ranges[i][1] - block_ranges[i][0] + 1)
+            if i == 0:
+                embed = p["embeddings"]
+            if i == n_stages - 1:
+                final = p["final"]
+        if embed is None or final is None:
+            raise ValueError("stage 0 must carry 'embeddings' and the last "
+                             "stage 'final'")
+        self.max_b = max(n_blocks)
+        self.params = {
+            "embed": embed, "final": final,
+            "blocks": _pad_stack(stage_blocks, self.max_b),
+            "n_blocks": jnp.asarray(n_blocks, jnp.int32),
+        }
+        self._programs: Dict = {}
+
+    # -- shared per-tick pieces -------------------------------------------
+
+    def _run_blocks(self, blocks, n_valid, x, bcache, pos, prefill):
+        """Scan this stage's (padded) blocks over x with cache read/update;
+        padded slots pass through unchanged."""
+        cfg = self.cfg
+
+        def step(carry, xs):
+            j, bp, bc = xs
+
+            def live(args):
+                c, cache_j = args
+                return dec._block_step(bp, c, cache_j, pos, cfg, prefill)
+
+            out, bc_new = jax.lax.cond(
+                j < n_valid, live, lambda args: args, (carry, bc))
+            return out, bc_new
+
+        idx = jnp.arange(self.max_b)
+        return jax.lax.scan(step, x, (idx, blocks, bcache))
+
+    def _cache_slice(self, caches, req):
+        """caches leaf [max_b, R, B, T, H, Dh] -> request slice [max_b, B,..]."""
+        return jax.tree_util.tree_map(
+            lambda c: jax.lax.dynamic_index_in_dim(c, req, axis=1,
+                                                   keepdims=False), caches)
+
+    def _cache_write(self, caches, bcache, req, valid):
+        def wr(c, new):
+            new = jnp.where(valid, new, jax.lax.dynamic_index_in_dim(
+                c, req, axis=1, keepdims=False))
+            return jax.lax.dynamic_update_index_in_dim(
+                c, new.astype(c.dtype), req, axis=1)
+
+        return jax.tree_util.tree_map(wr, caches, bcache)
+
+    def _zero_caches(self, r_slots, batch):
+        """Stage-sharded zero caches, allocated ALREADY sharded: a plain
+        jnp.zeros would materialize every stage's cache on one device (an
+        HBM spike ~n_stages x the per-device share) before resharding."""
+        from jax.sharding import NamedSharding
+        shape = (self.n_stages, self.max_b, r_slots, batch, self.max_len,
+                 self.cfg.num_attention_heads, self.cfg.head_dim)
+        sharding = NamedSharding(self.mesh, P("stage"))
+        zeros = jax.jit(lambda: jnp.zeros(shape, self.dtype),
+                        out_shardings=sharding)
+        return {"k": zeros(), "v": zeros()}
+
+    # -- compiled phases ---------------------------------------------------
+
+    def _build(self, r_slots: int, batch: int, prompt_len: int,
+               new_tokens: int):
+        family, cfg, k_stages = self.family, self.cfg, self.n_stages
+        d = cfg.hidden_size
+
+        def local(params, caches):
+            blocks = jax.tree_util.tree_map(lambda x: x[0], params["blocks"])
+            caches = {k: v[0] for k, v in caches.items()}
+            n_valid = params["n_blocks"][0]
+            stage = jax.lax.axis_index("stage")
+            return blocks, caches, n_valid, stage
+
+        def prefill_body(params, ids, caches):
+            """Wave-prefill all R requests; returns (caches, token1 [R, B])."""
+            blocks, caches, n_valid, stage = local(params, caches)
+            is_first = stage == 0
+            is_last = stage == k_stages - 1
+
+            tokens0 = jnp.zeros((r_slots, batch), jnp.int32)
+
+            def tick(carry, t):
+                hidden, caches, tokens = carry
+                recv = jax.lax.ppermute(
+                    hidden, "stage",
+                    [(i, (i + 1) % k_stages) for i in range(k_stages)])
+                req = jnp.mod(t - stage, r_slots)
+                valid = jnp.logical_and(t - stage >= 0,
+                                        t - stage < r_slots)
+                # stage 0 embeds its request's prompt; every other stage
+                # consumes the ppermuted hop (one cond, only stage 0 pays
+                # the embedding)
+                x = jax.lax.cond(
+                    is_first,
+                    lambda r: family.embed(
+                        params["embed"],
+                        jax.lax.dynamic_index_in_dim(ids, r, 0, False),
+                        cfg).astype(self.dtype),
+                    lambda r: recv, req)
+                bcache = self._cache_slice(caches, req)
+                h, bcache = self._run_blocks(blocks, n_valid, x, bcache,
+                                             0, prefill=True)
+                caches = self._cache_write(caches, bcache, req, valid)
+
+                def fin(hh):
+                    logits = family.finalize(params["final"], hh, cfg)
+                    return jnp.argmax(
+                        logits[:, prompt_len - 1].astype(jnp.float32),
+                        axis=-1).astype(jnp.int32)
+
+                tok = jax.lax.cond(
+                    is_last, fin,
+                    lambda hh: jnp.zeros((batch,), jnp.int32), h)
+                write = jnp.logical_and(valid, is_last)
+                upd = jax.lax.dynamic_update_index_in_dim(
+                    tokens, tok, req, axis=0)
+                tokens = jnp.where(write, upd, tokens)
+                return (h, caches, tokens), None
+
+            hidden0 = jnp.zeros((batch, prompt_len, d), self.dtype)
+            (_, caches, tokens), _ = jax.lax.scan(
+                tick, (hidden0, caches, tokens0),
+                jnp.arange(r_slots + k_stages - 1))
+            # only the last stage wrote tokens; fan out to every device
+            return ({k: v[None] for k, v in caches.items()},
+                    jax.lax.psum(tokens, "stage"))
+
+        def decode_body(params, token1, caches):
+            """All remaining waves: returns tokens [R, new_tokens, B]."""
+            blocks, caches, n_valid, stage = local(params, caches)
+            is_first = stage == 0
+            is_last = stage == k_stages - 1
+            n_waves = new_tokens - 1     # wave m in [1, n_waves] -> token m+1
+
+            def embed_tok(tok, pos):
+                # THE single-token embedding rule, shared with the host
+                # stage runner (decode.single_token_embed)
+                return dec.single_token_embed(
+                    params["embed"], tok, pos).astype(self.dtype)
+
+            outputs0 = jnp.zeros((r_slots, new_tokens, batch), jnp.int32)
+            outputs0 = outputs0.at[:, 0].set(token1)
+
+            def tick(carry, t):
+                hidden, caches, cur_tok, outputs = carry
+                recv = jax.lax.ppermute(
+                    hidden, "stage",
+                    [(i, (i + 1) % k_stages) for i in range(k_stages)])
+                req = jnp.mod(t - stage, r_slots)
+                wave = jnp.floor_divide(t - stage, r_slots) + 1
+                valid = jnp.logical_and(t - stage >= 0, wave <= n_waves)
+                pos = prompt_len + wave - 1
+
+                x = jax.lax.cond(
+                    is_first,
+                    lambda a: embed_tok(*a),
+                    lambda a: recv,
+                    (cur_tok[req], pos))
+                bcache = self._cache_slice(caches, req)
+                h, bcache = self._run_blocks(blocks, n_valid, x, bcache,
+                                             pos, prefill=False)
+                caches = self._cache_write(caches, bcache, req, valid)
+
+                def fin(hh):
+                    logits = family.finalize(params["final"], hh, cfg)
+                    return jnp.argmax(logits[:, 0].astype(jnp.float32),
+                                      axis=-1).astype(jnp.int32)
+
+                tok = jax.lax.cond(
+                    is_last, fin,
+                    lambda hh: jnp.zeros((batch,), jnp.int32), h)
+                # the request at the LAST stage this tick (device-uniform)
+                req_last = jnp.mod(t - (k_stages - 1), r_slots)
+                wave_last = jnp.floor_divide(t - (k_stages - 1), r_slots) + 1
+                valid_last = jnp.logical_and(t >= k_stages - 1,
+                                             wave_last <= n_waves)
+                # broadcast the new token to every stage (one psum)
+                tok_all = jax.lax.psum(tok, "stage")
+                upd = jax.lax.dynamic_update_index_in_dim(
+                    cur_tok, tok_all, req_last, axis=0)
+                cur_tok = jnp.where(valid_last, upd, cur_tok)
+                out_upd = jax.lax.dynamic_update_slice(
+                    outputs, tok_all[None, None],
+                    (req_last, jnp.clip(wave_last, 0, new_tokens - 1), 0))
+                outputs = jnp.where(valid_last, out_upd, outputs)
+                return (h, caches, cur_tok, outputs), None
+
+            hidden0 = jnp.zeros((batch, 1, d), self.dtype)
+            n_ticks = n_waves * r_slots + k_stages - 1
+            (_, _, _, outputs), _ = jax.lax.scan(
+                tick, (hidden0, caches, token1, outputs0),
+                jnp.arange(n_ticks))
+            return outputs
+
+        blocks_spec = jax.tree_util.tree_map(
+            lambda _: P("stage"), self.params["blocks"])
+        p_spec = {"embed": P(), "final": P(), "blocks": blocks_spec,
+                  "n_blocks": P("stage")}
+        c_spec = {"k": P("stage"), "v": P("stage")}
+        prefill = jax.jit(jax.shard_map(
+            prefill_body, mesh=self.mesh, in_specs=(p_spec, P(), c_spec),
+            out_specs=(c_spec, P()), check_vma=False))
+        decode_fn = jax.jit(jax.shard_map(
+            decode_body, mesh=self.mesh, in_specs=(p_spec, P(), c_spec),
+            out_specs=P(), check_vma=False))
+        return prefill, decode_fn
+
+    def generate(self, ids, new_tokens: int):
+        """Greedy-decode R = n_stages concurrent prompts [R, B, S_p] ->
+        [R, B, S_p + new_tokens]."""
+        ids = jnp.asarray(ids, jnp.int32)
+        if ids.ndim != 3 or ids.shape[0] != self.n_stages:
+            raise ValueError(f"ids must be [R={self.n_stages} slots, B, "
+                             f"S_p], got {ids.shape}")
+        r_slots, batch, prompt_len = ids.shape
+        if new_tokens < 1:
+            raise ValueError(f"new_tokens must be >= 1, got {new_tokens}")
+        dec.validate_capacity(self.cfg, self.max_len, prompt_len,
+                              new_tokens)
+        key = (batch, prompt_len, new_tokens)
+        if key not in self._programs:
+            self._programs[key] = self._build(r_slots, batch, prompt_len,
+                                              new_tokens)
+        prefill, decode_fn = self._programs[key]
+        caches = self._zero_caches(r_slots, batch)
+        caches, token1 = prefill(self.params, ids, caches)
+        if new_tokens == 1:
+            outputs = token1[:, None]                     # [R, 1, B]
+        else:
+            outputs = decode_fn(self.params, token1, caches)  # [R, N, B]
+        return jnp.concatenate(
+            [ids, jnp.transpose(outputs, (0, 2, 1))], axis=2)

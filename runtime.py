@@ -1128,4 +1128,8 @@ if __name__ == "__main__":
         level=logging.INFO,
         handlers=[logging.StreamHandler(sys.stdout),
                   logging.FileHandler("runtime.log", mode='a')])
+    from pipeedge_tpu.utils import apply_env_platform
+    apply_env_platform()  # JAX_PLATFORMS=cpu must mean cpu even though the
+    # TPU plugin overrides the env var (same guard as every other CLI);
+    # --platform cpu additionally forces the virtual device count
     main()

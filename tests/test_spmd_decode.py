@@ -1,0 +1,94 @@
+"""SPMD wave decode: one shard_map program per phase vs the host-driven
+pipeline, token for token."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh  # noqa: E402
+
+from pipeedge_tpu.models import ShardConfig  # noqa: E402
+from pipeedge_tpu.models import gpt2 as gpt2_mod  # noqa: E402
+from pipeedge_tpu.models.layers import TransformerConfig  # noqa: E402
+from pipeedge_tpu.parallel import decode  # noqa: E402
+from pipeedge_tpu.parallel.spmd_decode import SpmdDecodePipeline  # noqa: E402
+
+pytestmark = pytest.mark.slow  # compiles whole-wave shard_map programs
+
+TINY = dict(hidden_size=32, num_hidden_layers=3, num_attention_heads=4,
+            intermediate_size=64)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    from transformers import GPT2Config, GPT2LMHeadModel
+    hf_cfg = GPT2Config(n_embd=32, n_layer=3, n_head=4, n_inner=64,
+                        vocab_size=100, n_positions=64)
+    torch.manual_seed(7)
+    model = GPT2LMHeadModel(hf_cfg).eval()
+    cfg = TransformerConfig(model_type="gpt2", **TINY, layer_norm_eps=1e-5,
+                            vocab_size=100, max_position_embeddings=64)
+    weights = {k: v.numpy() for k, v in model.state_dict().items()}
+    return cfg, weights
+
+
+def _stage_params(cfg, partition, weights):
+    total = 4 * cfg.num_hidden_layers
+    return [gpt2_mod.load_params(
+        cfg, ShardConfig(l, r, is_first=l == 1, is_last=r == total), weights)
+        for l, r in partition]
+
+
+@pytest.mark.parametrize("partition", [
+    [(1, 4), (5, 8), (9, 12)],      # 3 equal stages
+    [(1, 8), (9, 12)],              # uneven: padded+masked block stacks
+])
+def test_spmd_wave_decode_matches_host_pipeline(setup, partition):
+    """R = n_stages concurrent greedy requests through the two shard_map
+    wave programs == each request solo through the host DecodePipeline."""
+    cfg, weights = setup
+    n_stages = len(partition)
+    mesh = Mesh(np.asarray(jax.devices()[:n_stages]), ("stage",))
+    stage_params = _stage_params(cfg, partition, weights)
+    wave = SpmdDecodePipeline(gpt2_mod.FAMILY, cfg, partition, stage_params,
+                              mesh, max_len=32)
+    host = decode.DecodePipeline(gpt2_mod.FAMILY, cfg, partition,
+                                 stage_params, max_len=32)
+    rng = np.random.default_rng(23)
+    ids = rng.integers(0, 100, size=(n_stages, 2, 7))
+    got = np.asarray(wave.generate(ids, new_tokens=6))
+    assert got.shape == (n_stages, 2, 13)
+    for r in range(n_stages):
+        solo = np.asarray(host.generate(ids[r], new_tokens=6))
+        np.testing.assert_array_equal(got[r], solo, err_msg=f"slot {r}")
+
+
+def test_spmd_wave_decode_single_token_and_validation(setup):
+    cfg, weights = setup
+    partition = [(1, 4), (5, 12)]
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("stage",))
+    stage_params = _stage_params(cfg, partition, weights)
+    wave = SpmdDecodePipeline(gpt2_mod.FAMILY, cfg, partition, stage_params,
+                              mesh, max_len=32)
+    host = decode.DecodePipeline(gpt2_mod.FAMILY, cfg, partition,
+                                 stage_params, max_len=32)
+    ids = np.random.default_rng(29).integers(0, 100, size=(2, 1, 5))
+    got = np.asarray(wave.generate(ids, new_tokens=1))
+    for r in range(2):
+        np.testing.assert_array_equal(
+            got[r], np.asarray(host.generate(ids[r], new_tokens=1)))
+
+    with pytest.raises(ValueError, match="slots"):
+        wave.generate(ids[:1], new_tokens=2)       # wrong R
+    with pytest.raises(ValueError, match="new_tokens"):
+        wave.generate(ids, new_tokens=0)
+    with pytest.raises(ValueError, match="exceeds"):
+        wave.generate(ids, new_tokens=1000)
+    moe_cfg = TransformerConfig(model_type="gpt2", **TINY,
+                                layer_norm_eps=1e-5, vocab_size=100,
+                                max_position_embeddings=64, n_experts=4)
+    with pytest.raises(NotImplementedError, match="MoE"):
+        SpmdDecodePipeline(gpt2_mod.FAMILY, moe_cfg, partition,
+                           stage_params, mesh, max_len=32)

@@ -164,6 +164,42 @@ def run_dcn(args, cfg, total, partition, max_len, dtype):
         monitoring_mod.finish()
 
 
+def run_spmd_wave(args, cfg, partition, stage_params, max_len, dtype):
+    """`--spmd-wave`: the whole continuous-batching wave schedule compiled
+    into shard_map programs over a ('stage',) mesh (n_stages request
+    slots, ppermute edges, zero host round-trips per tick)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from pipeedge_tpu.models import registry
+    from pipeedge_tpu.parallel.spmd_decode import SpmdDecodePipeline
+
+    n_stages = len(partition)
+    if len(jax.devices()) < n_stages:
+        raise SystemExit(f"--spmd-wave needs {n_stages} devices (one per "
+                         f"stage), only {len(jax.devices())} visible")
+    mesh = Mesh(np.asarray(jax.devices()[:n_stages]), ("stage",))
+    wave = SpmdDecodePipeline(registry.get_model_entry(
+        args.model_name).family.FAMILY, cfg, partition, stage_params,
+        mesh, max_len=max_len, dtype=dtype)
+    wave_ids = np.stack([
+        np.random.default_rng(r).integers(
+            0, cfg.vocab_size, size=(args.batch_size, args.prompt_len))
+        for r in range(n_stages)])
+    # warm with the SAME token budget: new_tokens sizes the compiled
+    # wave programs, so a shorter warmup would compile the wrong ones
+    np.asarray(wave.generate(wave_ids, args.new_tokens))
+    tik = time.monotonic()
+    out = np.asarray(wave.generate(wave_ids, args.new_tokens))
+    dt = time.monotonic() - tik
+    n_tok = n_stages * args.batch_size * args.new_tokens
+    print(f"generated {n_stages}x{args.batch_size}x{args.new_tokens} "
+          f"tokens in {dt:.3f}s = {n_tok / dt:.1f} tok/s "
+          f"({n_stages} stages, SPMD wave decode)")
+    print("sample continuation ids:",
+          out[0, 0, args.prompt_len:].tolist())
+
+
 def main():
     from pipeedge_tpu.utils import apply_env_platform
     apply_env_platform()
@@ -223,6 +259,12 @@ def main():
                              "concurrent requests (each of -b sequences) "
                              "wave-scheduled across the pipeline stages; "
                              "tokens match solo runs per request")
+    parser.add_argument("--spmd-wave", action="store_true",
+                        help="compile the whole wave schedule into one "
+                             "shard_map program per phase (n_stages "
+                             "request slots over a ('stage',) mesh, "
+                             "ppermute edges, zero host round-trips "
+                             "per tick); greedy only")
     parser.add_argument("--monitor", action="store_true",
                         help="record per-step heartbeats to decode.csv "
                              "(overwrites an existing decode.csv in cwd)")
@@ -285,6 +327,14 @@ def main():
     if args.edge_bits and args.dcn_addrs is None:
         parser.error("--edge-bits applies to DCN stage edges; pass "
                      "--dcn-addrs")
+    if args.spmd_wave and (
+            args.concurrent or args.beams or args.monitor
+            or args.prefill_ubatch or args.temperature > 0
+            or args.tp > 1 or args.sp > 1 or args.ep > 1 or args.kv_bits
+            or args.dcn_addrs is not None):
+        parser.error("--spmd-wave is greedy-only and does not compose "
+                     "with --concurrent/--beams/--monitor/--prefill-ubatch/"
+                     "--temperature/--tp/--sp/--ep/--kv-bits/--dcn-addrs")
     if args.dcn_addrs is not None:
         if args.tp > 1 or args.sp > 1 or args.ep > 1 or args.kv_bits \
                 or args.monitor or args.beams or args.prefill_ubatch:
@@ -299,6 +349,9 @@ def main():
             args.model_name, args.model_file, l, r, stage=i, dtype=dtype,
             unroll=False)  # DecodePipeline wants the stacked block layout
         stage_params.append(params)
+    if args.spmd_wave:
+        run_spmd_wave(args, cfg, partition, stage_params, max_len, dtype)
+        return
     mesh = sp_mesh = ep_mesh = tp_ep_mesh = None
     if args.tp > 1 or args.sp > 1 or args.ep > 1:
         import jax
