@@ -34,7 +34,9 @@ Design notes (mirrors parallel/spmd.py's forward pipeline):
   replicated state in lockstep. New tokens broadcast last-stage -> all
   via one psum (the only collective besides the edge ppermute).
 
-Scope: greedy decoding, R == n_stages request slots, equal prompt
+Scope: greedy or temperature/top-k sampled decoding (per-slot rng chains
+split once per picked token, in lockstep on every device — the host
+generate() discipline), R == n_stages request slots, equal prompt
 lengths/budgets per slot (the static-shape steady state; the host-driven
 batcher handles ragged arrivals). Token-identical to per-request
 `DecodePipeline.generate` (tests/test_spmd_decode.py).
@@ -57,7 +59,7 @@ from .spmd import _pad_stack, partition_to_blocks
 
 
 class SpmdDecodePipeline:
-    """Wave-scheduled greedy decoding compiled over a ('stage',) mesh.
+    """Wave-scheduled decoding compiled over a ('stage',) mesh.
 
     `generate(ids, new_tokens)` takes ids [R, B, S_p] — R = n_stages
     request slots decoded concurrently — and returns [R, B, S_p + N].
@@ -97,12 +99,27 @@ class SpmdDecodePipeline:
             raise ValueError("stage 0 must carry 'embeddings' and the last "
                              "stage 'final'")
         self.max_b = max(n_blocks)
-        self.params = {
+        # place params ONCE with the same shardings the programs compile
+        # against (spmd.py's placement discipline): blocks/n_blocks
+        # stage-sharded, embed/final replicated. Without this the padded
+        # stack would materialize on one device and reshard every call.
+        from jax.sharding import NamedSharding
+        params = {
             "embed": embed, "final": final,
             "blocks": _pad_stack(stage_blocks, self.max_b),
             "n_blocks": jnp.asarray(n_blocks, jnp.int32),
         }
+        shard = NamedSharding(mesh, P("stage"))
+        repl = NamedSharding(mesh, P())
+        self.params = {
+            "embed": jax.device_put(params["embed"], repl),
+            "final": jax.device_put(params["final"], repl),
+            "blocks": jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, shard), params["blocks"]),
+            "n_blocks": jax.device_put(params["n_blocks"], shard),
+        }
         self._programs: Dict = {}
+        self._cache_init: Dict = {}
 
     # -- shared per-tick pieces -------------------------------------------
 
@@ -143,21 +160,27 @@ class SpmdDecodePipeline:
     def _zero_caches(self, r_slots, batch):
         """Stage-sharded zero caches, allocated ALREADY sharded: a plain
         jnp.zeros would materialize every stage's cache on one device (an
-        HBM spike ~n_stages x the per-device share) before resharding."""
-        from jax.sharding import NamedSharding
-        shape = (self.n_stages, self.max_b, r_slots, batch, self.max_len,
-                 self.cfg.num_attention_heads, self.cfg.head_dim)
-        sharding = NamedSharding(self.mesh, P("stage"))
-        zeros = jax.jit(lambda: jnp.zeros(shape, self.dtype),
-                        out_shardings=sharding)
+        HBM spike ~n_stages x the per-device share) before resharding.
+        The jitted init is cached per shape so repeated generate() calls
+        hit the jit cache instead of recompiling."""
+        if (r_slots, batch) not in self._cache_init:
+            from jax.sharding import NamedSharding
+            shape = (self.n_stages, self.max_b, r_slots, batch,
+                     self.max_len, self.cfg.num_attention_heads,
+                     self.cfg.head_dim)
+            self._cache_init[(r_slots, batch)] = jax.jit(
+                partial(jnp.zeros, shape, self.dtype),
+                out_shardings=NamedSharding(self.mesh, P("stage")))
+        zeros = self._cache_init[(r_slots, batch)]
         return {"k": zeros(), "v": zeros()}
 
     # -- compiled phases ---------------------------------------------------
 
     def _build(self, r_slots: int, batch: int, prompt_len: int,
-               new_tokens: int):
+               new_tokens: int, temperature: float, top_k: int):
         family, cfg, k_stages = self.family, self.cfg, self.n_stages
         d = cfg.hidden_size
+        pick = dec.make_token_picker(temperature, top_k)
 
         def local(params, caches):
             blocks = jax.tree_util.tree_map(lambda x: x[0], params["blocks"])
@@ -166,8 +189,19 @@ class SpmdDecodePipeline:
             stage = jax.lax.axis_index("stage")
             return blocks, caches, n_valid, stage
 
-        def prefill_body(params, ids, caches):
-            """Wave-prefill all R requests; returns (caches, token1 [R, B])."""
+        def split_for(rngs, t):
+            """Split the key of the request at the LAST stage this tick —
+            computed identically on every device (replicated rngs, tick
+            arithmetic), so the fleet's rng state stays in lockstep. One
+            split per picked token, the host generate() discipline."""
+            req_last = jnp.mod(t - (k_stages - 1), r_slots)
+            key, sub = jax.random.split(rngs[req_last])
+            return req_last, jax.lax.dynamic_update_index_in_dim(
+                rngs, key, req_last, axis=0), sub
+
+        def prefill_body(params, ids, caches, rngs):
+            """Wave-prefill all R requests; returns (caches, token1 [R, B],
+            advanced rng keys)."""
             blocks, caches, n_valid, stage = local(params, caches)
             is_first = stage == 0
             is_last = stage == k_stages - 1
@@ -175,7 +209,7 @@ class SpmdDecodePipeline:
             tokens0 = jnp.zeros((r_slots, batch), jnp.int32)
 
             def tick(carry, t):
-                hidden, caches, tokens = carry
+                hidden, caches, tokens, rngs = carry
                 recv = jax.lax.ppermute(
                     hidden, "stage",
                     [(i, (i + 1) % k_stages) for i in range(k_stages)])
@@ -196,12 +230,15 @@ class SpmdDecodePipeline:
                 h, bcache = self._run_blocks(blocks, n_valid, x, bcache,
                                              0, prefill=True)
                 caches = self._cache_write(caches, bcache, req, valid)
+                req_last, rngs_new, sub = split_for(rngs, t)
+                valid_last = jnp.logical_and(t >= k_stages - 1,
+                                             t - (k_stages - 1) < r_slots)
+                rngs = jnp.where(valid_last, rngs_new, rngs)
 
                 def fin(hh):
                     logits = family.finalize(params["final"], hh, cfg)
-                    return jnp.argmax(
-                        logits[:, prompt_len - 1].astype(jnp.float32),
-                        axis=-1).astype(jnp.int32)
+                    return pick(logits[:, prompt_len - 1].astype(
+                        jnp.float32), sub).astype(jnp.int32)
 
                 tok = jax.lax.cond(
                     is_last, fin,
@@ -210,17 +247,17 @@ class SpmdDecodePipeline:
                 upd = jax.lax.dynamic_update_index_in_dim(
                     tokens, tok, req, axis=0)
                 tokens = jnp.where(write, upd, tokens)
-                return (h, caches, tokens), None
+                return (h, caches, tokens, rngs), None
 
             hidden0 = jnp.zeros((batch, prompt_len, d), self.dtype)
-            (_, caches, tokens), _ = jax.lax.scan(
-                tick, (hidden0, caches, tokens0),
+            (_, caches, tokens, rngs), _ = jax.lax.scan(
+                tick, (hidden0, caches, tokens0, rngs),
                 jnp.arange(r_slots + k_stages - 1))
             # only the last stage wrote tokens; fan out to every device
             return ({k: v[None] for k, v in caches.items()},
-                    jax.lax.psum(tokens, "stage"))
+                    jax.lax.psum(tokens, "stage"), rngs)
 
-        def decode_body(params, token1, caches):
+        def decode_body(params, token1, caches, rngs):
             """All remaining waves: returns tokens [R, new_tokens, B]."""
             blocks, caches, n_valid, stage = local(params, caches)
             is_first = stage == 0
@@ -237,7 +274,7 @@ class SpmdDecodePipeline:
             outputs0 = outputs0.at[:, 0].set(token1)
 
             def tick(carry, t):
-                hidden, caches, cur_tok, outputs = carry
+                hidden, caches, cur_tok, outputs, rngs = carry
                 recv = jax.lax.ppermute(
                     hidden, "stage",
                     [(i, (i + 1) % k_stages) for i in range(k_stages)])
@@ -255,20 +292,21 @@ class SpmdDecodePipeline:
                 h, bcache = self._run_blocks(blocks, n_valid, x, bcache,
                                              pos, prefill=False)
                 caches = self._cache_write(caches, bcache, req, valid)
+                # the request at the LAST stage this tick (device-uniform)
+                req_last, rngs_new, sub = split_for(rngs, t)
+                wave_last = jnp.floor_divide(t - (k_stages - 1), r_slots) + 1
+                valid_last = jnp.logical_and(t >= k_stages - 1,
+                                             wave_last <= n_waves)
+                rngs = jnp.where(valid_last, rngs_new, rngs)
 
                 def fin(hh):
                     logits = family.finalize(params["final"], hh, cfg)
-                    return jnp.argmax(logits[:, 0].astype(jnp.float32),
-                                      axis=-1).astype(jnp.int32)
+                    return pick(logits[:, 0].astype(jnp.float32),
+                                sub).astype(jnp.int32)
 
                 tok = jax.lax.cond(
                     is_last, fin,
                     lambda hh: jnp.zeros((batch,), jnp.int32), h)
-                # the request at the LAST stage this tick (device-uniform)
-                req_last = jnp.mod(t - (k_stages - 1), r_slots)
-                wave_last = jnp.floor_divide(t - (k_stages - 1), r_slots) + 1
-                valid_last = jnp.logical_and(t >= k_stages - 1,
-                                             wave_last <= n_waves)
                 # broadcast the new token to every stage (one psum)
                 tok_all = jax.lax.psum(tok, "stage")
                 upd = jax.lax.dynamic_update_index_in_dim(
@@ -278,12 +316,12 @@ class SpmdDecodePipeline:
                     outputs, tok_all[None, None],
                     (req_last, jnp.clip(wave_last, 0, new_tokens - 1), 0))
                 outputs = jnp.where(valid_last, out_upd, outputs)
-                return (h, caches, cur_tok, outputs), None
+                return (h, caches, cur_tok, outputs, rngs), None
 
             hidden0 = jnp.zeros((batch, 1, d), self.dtype)
             n_ticks = n_waves * r_slots + k_stages - 1
-            (_, _, _, outputs), _ = jax.lax.scan(
-                tick, (hidden0, caches, token1, outputs0),
+            (_, _, _, outputs, _), _ = jax.lax.scan(
+                tick, (hidden0, caches, token1, outputs0, rngs),
                 jnp.arange(n_ticks))
             return outputs
 
@@ -293,16 +331,24 @@ class SpmdDecodePipeline:
                   "n_blocks": P("stage")}
         c_spec = {"k": P("stage"), "v": P("stage")}
         prefill = jax.jit(jax.shard_map(
-            prefill_body, mesh=self.mesh, in_specs=(p_spec, P(), c_spec),
-            out_specs=(c_spec, P()), check_vma=False))
+            prefill_body, mesh=self.mesh,
+            in_specs=(p_spec, P(), c_spec, P()),
+            out_specs=(c_spec, P(), P()), check_vma=False))
         decode_fn = jax.jit(jax.shard_map(
-            decode_body, mesh=self.mesh, in_specs=(p_spec, P(), c_spec),
+            decode_body, mesh=self.mesh,
+            in_specs=(p_spec, P(), c_spec, P()),
             out_specs=P(), check_vma=False))
         return prefill, decode_fn
 
-    def generate(self, ids, new_tokens: int):
-        """Greedy-decode R = n_stages concurrent prompts [R, B, S_p] ->
-        [R, B, S_p + new_tokens]."""
+    def generate(self, ids, new_tokens: int, temperature: float = 0.0,
+                 top_k: int = 0, seeds=None):
+        """Decode R = n_stages concurrent prompts [R, B, S_p] ->
+        [R, B, S_p + new_tokens].
+
+        `temperature=0` is greedy; otherwise each slot samples with its
+        own rng chain seeded from `seeds[r]` (default: slot index), split
+        once per picked token — request r's token stream is identical to
+        `DecodePipeline.generate(ids[r], ..., seed=seeds[r])`."""
         ids = jnp.asarray(ids, jnp.int32)
         if ids.ndim != 3 or ids.shape[0] != self.n_stages:
             raise ValueError(f"ids must be [R={self.n_stages} slots, B, "
@@ -312,16 +358,26 @@ class SpmdDecodePipeline:
             raise ValueError(f"new_tokens must be >= 1, got {new_tokens}")
         dec.validate_capacity(self.cfg, self.max_len, prompt_len,
                               new_tokens)
-        key = (batch, prompt_len, new_tokens)
+        if seeds is None:
+            seeds = range(r_slots)
+        seeds = list(seeds)
+        if len(seeds) != r_slots:
+            raise ValueError(f"seeds must have {r_slots} entries, got "
+                             f"{len(seeds)}")
+        rngs = jnp.stack([jax.random.PRNGKey(s) for s in seeds])
+        key = (batch, prompt_len, new_tokens, float(temperature),
+               int(top_k))
         if key not in self._programs:
             self._programs[key] = self._build(r_slots, batch, prompt_len,
-                                              new_tokens)
+                                              new_tokens,
+                                              float(temperature),
+                                              int(top_k))
         prefill, decode_fn = self._programs[key]
         caches = self._zero_caches(r_slots, batch)
-        caches, token1 = prefill(self.params, ids, caches)
+        caches, token1, rngs = prefill(self.params, ids, caches, rngs)
         if new_tokens == 1:
             outputs = token1[:, None]                     # [R, 1, B]
         else:
-            outputs = decode_fn(self.params, token1, caches)  # [R, N, B]
+            outputs = decode_fn(self.params, token1, caches, rngs)
         return jnp.concatenate(
             [ids, jnp.transpose(outputs, (0, 2, 1))], axis=2)

@@ -65,6 +65,31 @@ def test_spmd_wave_decode_matches_host_pipeline(setup, partition):
         np.testing.assert_array_equal(got[r], solo, err_msg=f"slot {r}")
 
 
+def test_spmd_wave_sampling_matches_host_rng_discipline(setup):
+    """Sampled wave decoding: each slot's token stream reproduces its solo
+    host generate() run with the same temperature/top_k/seed — the fleet
+    splits each slot's key once per picked token, in lockstep."""
+    cfg, weights = setup
+    partition = [(1, 4), (5, 8), (9, 12)]
+    mesh = Mesh(np.asarray(jax.devices()[:3]), ("stage",))
+    stage_params = _stage_params(cfg, partition, weights)
+    wave = SpmdDecodePipeline(gpt2_mod.FAMILY, cfg, partition, stage_params,
+                              mesh, max_len=32)
+    host = decode.DecodePipeline(gpt2_mod.FAMILY, cfg, partition,
+                                 stage_params, max_len=32)
+    ids = np.random.default_rng(31).integers(0, 100, size=(3, 2, 6))
+    seeds = [5, 11, 2]
+    got = np.asarray(wave.generate(ids, new_tokens=5, temperature=0.9,
+                                   top_k=7, seeds=seeds))
+    for r in range(3):
+        solo = np.asarray(host.generate(ids[r], new_tokens=5,
+                                        temperature=0.9, top_k=7,
+                                        seed=seeds[r]))
+        np.testing.assert_array_equal(got[r], solo, err_msg=f"slot {r}")
+    with pytest.raises(ValueError, match="seeds"):
+        wave.generate(ids, new_tokens=2, temperature=0.9, seeds=[1])
+
+
 def test_spmd_wave_decode_single_token_and_validation(setup):
     cfg, weights = setup
     partition = [(1, 4), (5, 12)]

@@ -186,11 +186,13 @@ def run_spmd_wave(args, cfg, partition, stage_params, max_len, dtype):
         np.random.default_rng(r).integers(
             0, cfg.vocab_size, size=(args.batch_size, args.prompt_len))
         for r in range(n_stages)])
+    kw = dict(temperature=args.temperature, top_k=args.top_k,
+              seeds=[args.seed + r for r in range(n_stages)])
     # warm with the SAME token budget: new_tokens sizes the compiled
     # wave programs, so a shorter warmup would compile the wrong ones
-    np.asarray(wave.generate(wave_ids, args.new_tokens))
+    np.asarray(wave.generate(wave_ids, args.new_tokens, **kw))
     tik = time.monotonic()
-    out = np.asarray(wave.generate(wave_ids, args.new_tokens))
+    out = np.asarray(wave.generate(wave_ids, args.new_tokens, **kw))
     dt = time.monotonic() - tik
     n_tok = n_stages * args.batch_size * args.new_tokens
     print(f"generated {n_stages}x{args.batch_size}x{args.new_tokens} "
@@ -263,8 +265,8 @@ def main():
                         help="compile the whole wave schedule into one "
                              "shard_map program per phase (n_stages "
                              "request slots over a ('stage',) mesh, "
-                             "ppermute edges, zero host round-trips "
-                             "per tick); greedy only")
+                             "ppermute edges, zero host round-trips per "
+                             "tick); greedy or --temperature sampling")
     parser.add_argument("--monitor", action="store_true",
                         help="record per-step heartbeats to decode.csv "
                              "(overwrites an existing decode.csv in cwd)")
@@ -329,12 +331,12 @@ def main():
                      "--dcn-addrs")
     if args.spmd_wave and (
             args.concurrent or args.beams or args.monitor
-            or args.prefill_ubatch or args.temperature > 0
+            or args.prefill_ubatch
             or args.tp > 1 or args.sp > 1 or args.ep > 1 or args.kv_bits
             or args.dcn_addrs is not None):
-        parser.error("--spmd-wave is greedy-only and does not compose "
-                     "with --concurrent/--beams/--monitor/--prefill-ubatch/"
-                     "--temperature/--tp/--sp/--ep/--kv-bits/--dcn-addrs")
+        parser.error("--spmd-wave does not compose with --concurrent/"
+                     "--beams/--monitor/--prefill-ubatch/--tp/--sp/--ep/"
+                     "--kv-bits/--dcn-addrs")
     if args.dcn_addrs is not None:
         if args.tp > 1 or args.sp > 1 or args.ep > 1 or args.kv_bits \
                 or args.monitor or args.beams or args.prefill_ubatch:
