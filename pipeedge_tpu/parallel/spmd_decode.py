@@ -68,7 +68,7 @@ class SpmdDecodePipeline:
     def __init__(self, family: FamilySpec, cfg: TransformerConfig,
                  partition: Sequence[Tuple[int, int]],
                  stage_params: Sequence[Dict], mesh: Mesh, max_len: int,
-                 dtype=jnp.float32):
+                 dtype=jnp.float32, edge_bits: int = 0):
         total = 4 * cfg.num_hidden_layers
         dec.validate_partition(partition, total)
         dec.validate_capacity(cfg, max_len)
@@ -81,8 +81,12 @@ class SpmdDecodePipeline:
             raise NotImplementedError(
                 "SPMD wave decode covers dense families; MoE decodes via "
                 "DecodePipeline(ep_mesh/tp_ep_mesh)")
+        if edge_bits not in (0, 2, 4, 6, 8, 16):
+            raise ValueError(f"edge_bits must be one of 0/2/4/6/8/16, got "
+                             f"{edge_bits}")
         self.family, self.cfg, self.mesh = family, cfg, mesh
         self.n_stages, self.max_len, self.dtype = n_stages, max_len, dtype
+        self.edge_bits = edge_bits
 
         stage_blocks, n_blocks = [], []
         embed = final = None
@@ -201,31 +205,56 @@ class SpmdDecodePipeline:
 
         def prefill_body(params, ids, caches, rngs):
             """Wave-prefill all R requests; returns (caches, token1 [R, B],
-            advanced rng keys)."""
+            advanced rng keys). With `edge_bits`, the [B, S_p, D] prompt
+            hops — the wave decoder's big payloads — cross the stage edge
+            as packed uint32 (QuantPipe activation compression riding the
+            ppermute, like the forward SPMD pipeline's quantized edges);
+            the [B, 1, D] decode-step hops stay raw (metadata-sized)."""
+            from ..ops import quant as quant_ops
+
             blocks, caches, n_valid, stage = local(params, caches)
             is_first = stage == 0
             is_last = stage == k_stages - 1
+            bit = self.edge_bits
+
+            def edge_enc(h):
+                if bit == 0:
+                    return h
+                q = quant_ops.tensor_encode_outerdim(
+                    h.astype(jnp.float32), bit)
+                return (q.data, q.scale, q.shift)
+
+            def edge_dec(payload):
+                if bit == 0:
+                    return payload
+                data, scale, shift = payload
+                return quant_ops.tensor_decode_outerdim(
+                    quant_ops.QuantizedTensor(
+                        data=data, scale=scale, shift=shift,
+                        shape=(batch, prompt_len, d),
+                        bit=bit)).astype(self.dtype)
 
             tokens0 = jnp.zeros((r_slots, batch), jnp.int32)
 
             def tick(carry, t):
                 hidden, caches, tokens, rngs = carry
-                recv = jax.lax.ppermute(
-                    hidden, "stage",
-                    [(i, (i + 1) % k_stages) for i in range(k_stages)])
+                recv = jax.tree_util.tree_map(
+                    lambda leaf: jax.lax.ppermute(
+                        leaf, "stage",
+                        [(i, (i + 1) % k_stages) for i in range(k_stages)]),
+                    hidden)
                 req = jnp.mod(t - stage, r_slots)
                 valid = jnp.logical_and(t - stage >= 0,
                                         t - stage < r_slots)
                 # stage 0 embeds its request's prompt; every other stage
-                # consumes the ppermuted hop (one cond, only stage 0 pays
-                # the embedding)
+                # consumes the ppermuted (possibly packed) hop
                 x = jax.lax.cond(
                     is_first,
                     lambda r: family.embed(
                         params["embed"],
                         jax.lax.dynamic_index_in_dim(ids, r, 0, False),
                         cfg).astype(self.dtype),
-                    lambda r: recv, req)
+                    lambda r: edge_dec(recv), req)
                 bcache = self._cache_slice(caches, req)
                 h, bcache = self._run_blocks(blocks, n_valid, x, bcache,
                                              0, prefill=True)
@@ -247,9 +276,10 @@ class SpmdDecodePipeline:
                 upd = jax.lax.dynamic_update_index_in_dim(
                     tokens, tok, req, axis=0)
                 tokens = jnp.where(write, upd, tokens)
-                return (h, caches, tokens, rngs), None
+                return (edge_enc(h), caches, tokens, rngs), None
 
-            hidden0 = jnp.zeros((batch, prompt_len, d), self.dtype)
+            hidden0 = edge_enc(jnp.zeros((batch, prompt_len, d),
+                                         self.dtype))
             (_, caches, tokens, rngs), _ = jax.lax.scan(
                 tick, (hidden0, caches, tokens0, rngs),
                 jnp.arange(r_slots + k_stages - 1))

@@ -90,6 +90,34 @@ def test_spmd_wave_sampling_matches_host_rng_discipline(setup):
         wave.generate(ids, new_tokens=2, temperature=0.9, seeds=[1])
 
 
+def test_spmd_wave_quantized_prefill_edges(setup):
+    """edge_bits packs the [B, S_p, D] prefill hops (QuantPipe riding the
+    ppermute): the wave still decodes end-to-end with in-vocab tokens at
+    8-bit edges, and 16-bit edges are token-identical to raw on this tiny
+    model (quant error far below the argmax margins)."""
+    cfg, weights = setup
+    partition = [(1, 4), (5, 8), (9, 12)]
+    mesh = Mesh(np.asarray(jax.devices()[:3]), ("stage",))
+    stage_params = _stage_params(cfg, partition, weights)
+    raw = SpmdDecodePipeline(gpt2_mod.FAMILY, cfg, partition, stage_params,
+                             mesh, max_len=32)
+    ids = np.random.default_rng(37).integers(0, 100, size=(3, 2, 7))
+    want = np.asarray(raw.generate(ids, new_tokens=5))
+    q16 = SpmdDecodePipeline(gpt2_mod.FAMILY, cfg, partition, stage_params,
+                             mesh, max_len=32, edge_bits=16)
+    got16 = np.asarray(q16.generate(ids, new_tokens=5))
+    np.testing.assert_array_equal(got16, want)
+    q8 = SpmdDecodePipeline(gpt2_mod.FAMILY, cfg, partition, stage_params,
+                            mesh, max_len=32, edge_bits=8)
+    got8 = np.asarray(q8.generate(ids, new_tokens=5))
+    assert got8.shape == want.shape
+    assert got8[:, :, :7].tolist() == ids.tolist()   # prompts untouched
+    assert got8.min() >= 0 and got8.max() < 100      # in-vocab tokens
+    with pytest.raises(ValueError, match="edge_bits"):
+        SpmdDecodePipeline(gpt2_mod.FAMILY, cfg, partition, stage_params,
+                           mesh, max_len=32, edge_bits=5)
+
+
 def test_spmd_wave_decode_single_token_and_validation(setup):
     cfg, weights = setup
     partition = [(1, 4), (5, 12)]

@@ -181,7 +181,7 @@ def run_spmd_wave(args, cfg, partition, stage_params, max_len, dtype):
     mesh = Mesh(np.asarray(jax.devices()[:n_stages]), ("stage",))
     wave = SpmdDecodePipeline(registry.get_model_entry(
         args.model_name).family.FAMILY, cfg, partition, stage_params,
-        mesh, max_len=max_len, dtype=dtype)
+        mesh, max_len=max_len, dtype=dtype, edge_bits=args.edge_bits)
     wave_ids = np.stack([
         np.random.default_rng(r).integers(
             0, cfg.vocab_size, size=(args.batch_size, args.prompt_len))
@@ -277,9 +277,10 @@ def main():
     parser.add_argument("-sd", "--sched-dev-file", default=None)
     parser.add_argument("--edge-bits", default=0, type=int,
                         choices=[0, 2, 4, 6, 8, 16],
-                        help="quantize DCN stage edges (QuantPipe activation "
-                             "compression on the wire; prefill hand-offs are "
-                             "[B, S, D])")
+                        help="quantize stage edges (QuantPipe activation "
+                             "compression): DCN wire frames with "
+                             "--dcn-addrs, or the [B, S, D] prefill "
+                             "ppermute hops with --spmd-wave")
     parser.add_argument("--dcn-addrs", default=None, type=str,
                         help="comma-separated host:port per rank: run the "
                              "pipeline across OS processes over TCP (stage "
@@ -326,9 +327,9 @@ def main():
     if args.beams and args.prefill_ubatch:
         parser.error("--prefill-ubatch applies to greedy/sampled "
                      "generation, not --beams")
-    if args.edge_bits and args.dcn_addrs is None:
-        parser.error("--edge-bits applies to DCN stage edges; pass "
-                     "--dcn-addrs")
+    if args.edge_bits and args.dcn_addrs is None and not args.spmd_wave:
+        parser.error("--edge-bits applies to DCN stage edges or the SPMD "
+                     "wave prefill hops; pass --dcn-addrs or --spmd-wave")
     if args.spmd_wave and (
             args.concurrent or args.beams or args.monitor
             or args.prefill_ubatch
