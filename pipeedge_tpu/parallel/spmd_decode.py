@@ -217,22 +217,17 @@ class SpmdDecodePipeline:
             is_last = stage == k_stages - 1
             bit = self.edge_bits
 
+            # QuantizedTensor is a registered pytree (static shape/bit aux),
+            # so the encoded payload rides the tree_map'd ppermute directly
+            # — the same discipline as spmd.py's uniform quantized edges
             def edge_enc(h):
-                if bit == 0:
-                    return h
-                q = quant_ops.tensor_encode_outerdim(
-                    h.astype(jnp.float32), bit)
-                return (q.data, q.scale, q.shift)
+                return h if bit == 0 else \
+                    quant_ops.tensor_encode_outerdim(h, bit)
 
             def edge_dec(payload):
-                if bit == 0:
-                    return payload
-                data, scale, shift = payload
-                return quant_ops.tensor_decode_outerdim(
-                    quant_ops.QuantizedTensor(
-                        data=data, scale=scale, shift=shift,
-                        shape=(batch, prompt_len, d),
-                        bit=bit)).astype(self.dtype)
+                return payload if bit == 0 else \
+                    quant_ops.tensor_decode_outerdim(
+                        payload).astype(self.dtype)
 
             tokens0 = jnp.zeros((r_slots, batch), jnp.int32)
 
