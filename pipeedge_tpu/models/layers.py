@@ -38,10 +38,19 @@ class TransformerConfig:
     # mixture-of-experts (switch-FFN blocks; 0 = dense FFN)
     n_experts: int = 0
     capacity_factor: float = 1.25
+    # grouped-query attention (llama family): 0 = same as query heads
+    num_kv_heads: int = 0
+    # rotary position embedding base (llama family)
+    rope_theta: float = 10000.0
 
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_attention_heads
+
+    @property
+    def kv_heads(self) -> int:
+        """Key/value head count (GQA: fewer than query heads; 0 = equal)."""
+        return self.num_kv_heads or self.num_attention_heads
 
     @property
     def num_patches(self) -> int:
@@ -74,6 +83,31 @@ def _use_fused_attention(seq_len: int) -> bool:
     if env is not None:
         return env not in ("0", "false", "no")
     return jax.default_backend() == "tpu" and seq_len >= 1024
+
+
+def rms_norm(p, x: jax.Array, eps: float) -> jax.Array:
+    """RMSNorm (scale only, no mean subtraction — llama family), computed
+    in float32 like HF `LlamaRMSNorm`."""
+    xf = x.astype(jnp.float32)
+    normed = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1,
+                                         keepdims=True) + eps)
+    return (normed * p["scale"]).astype(x.dtype)
+
+
+def rope_rotate(x: jax.Array, pos: jax.Array, theta: float) -> jax.Array:
+    """Rotary position embedding on [B, S, H, Dh] at positions `pos` [S]
+    (HF llama convention: half-split rotate, angles in float32, one
+    frequency per pair duplicated across the two halves)."""
+    hd = x.shape[-1]
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32)
+                                / hd))
+    angles = pos.astype(jnp.float32)[:, None] * inv_freq[None]   # [S, hd/2]
+    cos = jnp.concatenate([jnp.cos(angles)] * 2, axis=-1)        # [S, hd]
+    sin = jnp.concatenate([jnp.sin(angles)] * 2, axis=-1)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    return (x * cos[None, :, None] + rotated
+            * sin[None, :, None]).astype(x.dtype)
 
 
 def apply_causal_mask(scores: jax.Array) -> jax.Array:

@@ -24,6 +24,7 @@ from .shard import make_shard_fn, unstack_blocks
 from . import bert as bert_mod
 from . import deit as deit_mod
 from . import gpt2 as gpt2_mod
+from . import llama as llama_mod
 from . import vit as vit_mod
 
 logger = logging.getLogger(__name__)
@@ -69,6 +70,15 @@ def _gpt2(name, layers, weights, hidden, blocks, heads, inter,
         capacity_factor=capacity_factor))
 
 
+def _llama(name, layers, weights, hidden, blocks, heads, kv_heads, inter,
+           vocab, max_pos, theta=10000.0):
+    return ModelEntry(name, layers, weights, llama_mod, TransformerConfig(
+        model_type="llama", hidden_size=hidden, num_hidden_layers=blocks,
+        num_attention_heads=heads, num_kv_heads=kv_heads,
+        intermediate_size=inter, layer_norm_eps=1e-5, vocab_size=vocab,
+        max_position_embeddings=max_pos, rope_theta=theta))
+
+
 _MODELS: Dict[str, ModelEntry] = {e.name: e for e in [
     _vit("google/vit-base-patch16-224", 48, "ViT-B_16-224.npz", 768, 12, 12, 3072, 1000),
     _vit("google/vit-large-patch16-224", 96, "ViT-L_16-224.npz", 1024, 24, 16, 4096, 1000),
@@ -89,12 +99,19 @@ _MODELS: Dict[str, ModelEntry] = {e.name: e for e in [
     # synthetic switch-MoE decoder (top-1 routed FFN, 8 experts/block)
     _gpt2("pipeedge/gpt2-moe-8e", 48, "GPT2-MoE-8E.npz", 768, 12, 12, 3072,
           n_experts=8),
+    # llama family: RoPE / RMSNorm / SwiGLU / grouped-query attention
+    _llama("meta-llama/Llama-2-7b-hf", 128, "Llama-2-7B.npz", 4096, 32, 32,
+           32, 11008, vocab=32000, max_pos=4096),
+    _llama("meta-llama/Meta-Llama-3-8B", 128, "Llama-3-8B.npz", 4096, 32,
+           32, 8, 14336, vocab=128256, max_pos=8192, theta=500000.0),
     # tiny synthetic models for fast tests / CI (not in the reference's list)
     _vit("pipeedge/test-tiny-vit", 8, "test-tiny-vit.npz", 32, 2, 4, 64, 5,
          patch=4, img=16),
     _bert("pipeedge/test-tiny-bert", 8, "test-tiny-bert.npz", 32, 2, 4, 64, 2),
     _gpt2("pipeedge/test-tiny-gpt2", 8, "test-tiny-gpt2.npz", 32, 2, 4, 64,
           vocab=100, max_pos=64),
+    _llama("pipeedge/test-tiny-llama", 8, "test-tiny-llama.npz", 32, 2, 4,
+           2, 64, vocab=100, max_pos=64),
     # capacity_factor = n_experts -> no capacity drops: routing is then a
     # pure per-token top-1 gate, which is causal and batch-size-invariant,
     # so cached decode and split pipelines match the full forward exactly

@@ -33,11 +33,20 @@ ShardData = Any  # jax.Array | tuple[jax.Array, jax.Array]
 
 @dataclasses.dataclass(frozen=True)
 class FamilySpec:
-    """Pure-function hooks defining a model family (vit/bert/deit)."""
+    """Pure-function hooks defining a model family (vit/bert/deit/gpt2/
+    llama). The two optional hooks plug a decoder family into the
+    KV-cache decode subsystem (parallel/decode.py): `cached_block_step`
+    replaces the default GPT-2-shaped block step, `decode_embed` the
+    default wte+wpe single-token embedding."""
     name: str
     embed: Callable[[Dict, Any, TransformerConfig], jax.Array]
     sublayer: Callable[[Dict, int, ShardData, TransformerConfig], ShardData]
     finalize: Callable[[Dict, jax.Array, TransformerConfig], jax.Array]
+    cached_block_step: Any = None    # (p, x, bcache, pos, cfg, prefill)
+    decode_embed: Any = None         # (embed_params, tok, pos) -> [B, 1, D]
+    # attention reads absolute positions (RoPE): chunk-local attention
+    # overrides (sequence-parallel cores) would rotate at wrong offsets
+    position_dependent_attention: bool = False
 
 
 def _apply_slice(family: FamilySpec, block_params: Dict, data: ShardData,

@@ -52,6 +52,8 @@ class _Request:
     pick: object                     # jitted token picker
     rng: jax.Array
     prompt_len: int
+    eos_token: Optional[int] = None  # stop early once every row emitted it
+    rows_done: Optional[np.ndarray] = None   # [B] eos seen per row
     caches: Optional[List] = None    # per-stage cache slots (admission)
     tokens: List = field(default_factory=list)
 
@@ -102,10 +104,18 @@ class ContinuousBatcher:
         self.stats = {"ticks": 0, "stage_steps": 0, "tokens": 0}
 
     def submit(self, rid, ids, new_tokens: int, temperature: float = 0.0,
-               top_k: int = 0, seed: int = 0) -> None:
+               top_k: int = 0, seed: int = 0,
+               eos_token: Optional[int] = None) -> None:
         """Queue a request. `ids` [B, S] is a prompt batch decoded in
         lockstep (B=1 for a single sequence); each distinct (B, S) shape
-        compiles its own prefill program, shared across requests."""
+        compiles its own prefill program, shared across requests.
+
+        `eos_token`: finish this request early — freeing its cache slots
+        for the ready queue — once EVERY row of its batch has emitted the
+        token (`new_tokens` stays the hard cap; rows that finished first
+        keep decoding until the whole request stops, like HF generate
+        without a pad-out). The continuous-batching payoff: short answers
+        release capacity immediately instead of padding to the cap."""
         if rid in self.results or rid in self._live_rids:
             raise ValueError(f"duplicate request id {rid!r}")
         ids = jnp.asarray(ids, jnp.int32)
@@ -117,7 +127,8 @@ class ContinuousBatcher:
         self.pending.append(_Request(
             rid=rid, ids=ids, new_tokens=new_tokens,
             pick=make_token_picker(temperature, top_k),
-            rng=jax.random.PRNGKey(seed), prompt_len=ids.shape[1]))
+            rng=jax.random.PRNGKey(seed), prompt_len=ids.shape[1],
+            eos_token=eos_token))
 
     def _admit(self) -> None:
         while self.pending and self.active < self.max_active:
@@ -127,24 +138,52 @@ class ContinuousBatcher:
             self._stage_q[0].append((req, req.ids, True))
 
     def _finish_wave(self, req: _Request, out, prefill: bool,
-                     reentries: list) -> None:
+                     reentries: list, eos_pending: list) -> None:
         """Last stage done: pick the next token, then complete or re-enter
-        stage 0 (same split-per-pick rng discipline as generate())."""
+        stage 0 (same split-per-pick rng discipline as generate()).
+
+        Requests with an eos_token defer their stop decision to AFTER the
+        tick's dispatch loop (`eos_pending`): the decision needs a host
+        readback of the token, and blocking here — the loop's first
+        iteration — would serialize every other stage's dispatch behind
+        this request's compute."""
         logits = out[:, req.prompt_len - 1] if prefill else out[:, 0]
         req.rng, sub = jax.random.split(req.rng)
         token = req.pick(logits.astype(jnp.float32), sub)
         req.tokens.append(token)
         self.stats["tokens"] += int(token.shape[0])
+        if req.eos_token is not None:
+            eos_pending.append(req)
+            return
         if len(req.tokens) >= req.new_tokens:
-            self.results[req.rid] = np.concatenate(
-                [np.asarray(req.ids),
-                 np.stack([np.asarray(t) for t in req.tokens], axis=1)],
-                axis=1)
-            req.caches = None        # free this request's cache slots
-            self.active -= 1
-            self._live_rids.discard(req.rid)
+            self._complete(req)
         else:
             reentries.append((req, token[:, None], False))
+
+    def _complete(self, req: _Request) -> None:
+        self.results[req.rid] = np.concatenate(
+            [np.asarray(req.ids),
+             np.stack([np.asarray(t) for t in req.tokens], axis=1)],
+            axis=1)
+        req.caches = None            # free this request's cache slots
+        self.active -= 1
+        self._live_rids.discard(req.rid)
+
+    def _decide_eos(self, req: _Request) -> None:
+        """Post-dispatch stop decision for an eos request: read back the
+        just-picked token (all of this tick's work is already dispatched,
+        so the fence overlaps other requests' device compute)."""
+        token = req.tokens[-1]
+        done = len(req.tokens) >= req.new_tokens
+        if not done:
+            hit = np.asarray(token) == req.eos_token
+            req.rows_done = hit if req.rows_done is None \
+                else req.rows_done | hit
+            done = bool(req.rows_done.all())
+        if done:
+            self._complete(req)
+        else:
+            self._stage_q[0].append((req, token[:, None], False))
 
     def tick(self) -> bool:
         """Advance every stage by at most one stage-step; returns whether
@@ -162,6 +201,7 @@ class ContinuousBatcher:
         self._admit()
         worked = False
         reentries: list = []
+        eos_pending: list = []
         for i in reversed(range(self.n_stages)):
             if not self._stage_q[i]:
                 continue
@@ -180,8 +220,10 @@ class ContinuousBatcher:
             if i + 1 < self.n_stages:
                 self._stage_q[i + 1].append((req, out, prefill))
             else:
-                self._finish_wave(req, out, prefill, reentries)
+                self._finish_wave(req, out, prefill, reentries, eos_pending)
         self._stage_q[0].extend(reentries)
+        for req in eos_pending:
+            self._decide_eos(req)
         self.stats["ticks"] += worked
         self._admit()                # a completion may free a slot mid-tick
         return worked or self.active > 0 or bool(self.pending)

@@ -45,8 +45,12 @@ def init_cache(cfg: TransformerConfig, n_blocks: int, batch: int,
     `cache_bits=8` stores K/V as int8 with per-position affine scales
     (QuantPipe's activation-compression idea applied to the decode cache):
     cache reads dominate decode-step HBM traffic, so int8 halves the
-    bandwidth bound vs bfloat16 at negligible logit error."""
-    shape = (n_blocks, batch, max_len, cfg.num_attention_heads, cfg.head_dim)
+    bandwidth bound vs bfloat16 at negligible logit error.
+
+    The head axis is `cfg.kv_heads` — equal to the query head count for
+    every family except GQA decoders (llama), whose cache is kv_heads/
+    num_attention_heads times smaller (the point of GQA)."""
+    shape = (n_blocks, batch, max_len, cfg.kv_heads, cfg.head_dim)
     if cache_bits == 0:
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
     if cache_bits != 8:
@@ -258,13 +262,17 @@ def make_stage_fns(family, cfg: TransformerConfig, shard_config: ShardConfig):
 
 
 def _make_stage_run(family, cfg: TransformerConfig,
-                    shard_config: ShardConfig, block_fn=_block_step,
+                    shard_config: ShardConfig, block_fn=None,
                     finalize_fn=None, embed_fn=None):
     plan = plan_shard(shard_config)
     if plan.head is not None or plan.tail is not None:
         raise ValueError("decode requires a block-aligned partition "
                          f"(layers [{shard_config.layer_start}, "
                          f"{shard_config.layer_end}] cut mid-block)")
+    if block_fn is None:
+        # family-dispatched cached block (llama supplies RoPE/GQA/SwiGLU);
+        # the default is the GPT-2-shaped step
+        block_fn = getattr(family, "cached_block_step", None) or _block_step
 
     def run(params, data, cache, pos, prefill):
         if shard_config.is_first:
@@ -273,7 +281,9 @@ def _make_stage_run(family, cfg: TransformerConfig,
             elif prefill:
                 data = family.embed(params["embeddings"], data, cfg)
             else:
-                data = single_token_embed(params["embeddings"], data, pos)
+                tok_embed = getattr(family, "decode_embed", None) \
+                    or single_token_embed
+                data = tok_embed(params["embeddings"], data, pos)
         data, cache = _run_blocks(stage_blocks(params), data, cache, pos,
                                   cfg, prefill, block_fn=block_fn)
         if shard_config.is_last:
@@ -610,6 +620,11 @@ def make_sp_prefill_fn(family, cfg: TransformerConfig,
         raise NotImplementedError(
             "sequence-parallel prefill does not cover MoE blocks "
             "(per-chunk routing would change capacity semantics)")
+    if getattr(family, "position_dependent_attention", False):
+        raise NotImplementedError(
+            f"sequence-parallel prefill does not cover the {family.name} "
+            "family (its attention is position-dependent — RoPE — and the "
+            "chunk-local sp cores have no global position offset)")
     n = mesh.shape[axis]
     core = resolve_sp_core(sp_kind, cfg.num_attention_heads, n)
 

@@ -129,15 +129,20 @@ class SpmdDecodePipeline:
 
     def _run_blocks(self, blocks, n_valid, x, bcache, pos, prefill):
         """Scan this stage's (padded) blocks over x with cache read/update;
-        padded slots pass through unchanged."""
+        padded slots pass through unchanged. The block body is the
+        family's cached step when it provides one (llama RoPE/GQA/SwiGLU),
+        else the default GPT-2-shaped step — same dispatch as the host
+        decode pipeline."""
         cfg = self.cfg
+        block_fn = getattr(self.family, "cached_block_step", None) \
+            or dec._block_step
 
         def step(carry, xs):
             j, bp, bc = xs
 
             def live(args):
                 c, cache_j = args
-                return dec._block_step(bp, c, cache_j, pos, cfg, prefill)
+                return block_fn(bp, c, cache_j, pos, cfg, prefill)
 
             out, bc_new = jax.lax.cond(
                 j < n_valid, live, lambda args: args, (carry, bc))
@@ -170,8 +175,7 @@ class SpmdDecodePipeline:
         if (r_slots, batch) not in self._cache_init:
             from jax.sharding import NamedSharding
             shape = (self.n_stages, self.max_b, r_slots, batch,
-                     self.max_len, self.cfg.num_attention_heads,
-                     self.cfg.head_dim)
+                     self.max_len, self.cfg.kv_heads, self.cfg.head_dim)
             self._cache_init[(r_slots, batch)] = jax.jit(
                 partial(jnp.zeros, shape, self.dtype),
                 out_shardings=NamedSharding(self.mesh, P("stage")))
@@ -290,10 +294,12 @@ class SpmdDecodePipeline:
             n_waves = new_tokens - 1     # wave m in [1, n_waves] -> token m+1
 
             def embed_tok(tok, pos):
-                # THE single-token embedding rule, shared with the host
-                # stage runner (decode.single_token_embed)
-                return dec.single_token_embed(
-                    params["embed"], tok, pos).astype(self.dtype)
+                # the family's single-token embedding rule, shared with
+                # the host stage runner (llama: wte only; default wte+wpe)
+                tok_embed = getattr(family, "decode_embed", None) \
+                    or dec.single_token_embed
+                return tok_embed(params["embed"], tok, pos).astype(
+                    self.dtype)
 
             outputs0 = jnp.zeros((r_slots, new_tokens, batch), jnp.int32)
             outputs0 = outputs0.at[:, 0].set(token1)

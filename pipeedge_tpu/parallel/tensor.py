@@ -127,6 +127,14 @@ def family_tp_plan(cfg: TransformerConfig):
         from ..models.layers import gelu_new
         return _VIT_PARAM_SPECS, partial(_tp_block_local, act=gelu_new,
                                          causal=True)
+    if cfg.model_type == "llama":
+        # the dense q/k/v column table assumes equal head widths and a
+        # 2-matmul MLP; llama's GQA k/v (kv_heads < heads) and gated
+        # SwiGLU need their own table/body — refuse rather than shard
+        # the wrong axes silently
+        raise NotImplementedError(
+            "Megatron TP has no llama plan yet (GQA k/v widths and the "
+            "gated SwiGLU MLP don't fit the dense column/row table)")
     return _VIT_PARAM_SPECS, _tp_block_local
 
 

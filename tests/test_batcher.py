@@ -152,6 +152,31 @@ def test_batched_rows_and_validation(tiny_pipe):
         ContinuousBatcher(tiny_pipe, max_active=0)
 
 
+def test_eos_early_stop_frees_slot_for_pending(tiny_pipe):
+    """A request with eos_token finishes the moment every row has emitted
+    it — its tokens are the solo stream truncated at the first eos — and
+    its freed cache slot admits a pending request."""
+    prompts = _prompts(4, seed0=47)
+    cap = 8
+    solo0 = np.asarray(tiny_pipe.generate(prompts[0], cap))
+    gen0 = solo0[0, prompts[0].shape[1]:]
+    eos = int(gen0[2])                      # the 3rd greedy token
+    n_stop = int(np.argmax(gen0 == eos)) + 1   # first occurrence
+
+    batcher = ContinuousBatcher(tiny_pipe, max_active=2)
+    batcher.submit(0, prompts[0], new_tokens=cap, eos_token=eos)
+    for i in (1, 2, 3):
+        batcher.submit(i, prompts[i], new_tokens=cap)
+    results = batcher.run()
+
+    np.testing.assert_array_equal(
+        results[0], solo0[:, :prompts[0].shape[1] + n_stop])
+    assert results[0][0, -1] == eos
+    for i in (1, 2, 3):
+        np.testing.assert_array_equal(
+            results[i], np.asarray(tiny_pipe.generate(prompts[i], cap)))
+
+
 def test_devices_placement_composes(tiny_pipe):
     """Stage-per-device placement (the host pipeline's deployment shape)
     composes with the batcher: results still solo-identical."""

@@ -215,7 +215,7 @@ def main():
     parser.add_argument("-m", "--model-name", default="gpt2",
                         choices=[n for n in registry.get_model_names()
                                  if registry.get_model_config(n).model_type
-                                 == "gpt2"])
+                                 in ("gpt2", "llama")])
     parser.add_argument("-M", "--model-file", default=None)
     parser.add_argument("-pt", "--partition", default=None,
                         help="comma-separated layer ranges, e.g. 1,24,25,48 "
