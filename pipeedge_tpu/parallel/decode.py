@@ -350,6 +350,12 @@ def make_tp_stage_fns(family, cfg: TransformerConfig,
             "tensor-parallel decode does not cover MoE blocks (experts "
             "shard over 'ep', not 'tp') — use make_tp_ep_stage_fns / "
             "DecodePipeline(tp_ep_mesh=...) for the tp x ep composition")
+    if getattr(family, "cached_block_step", None) is not None:
+        raise NotImplementedError(
+            f"tensor-parallel decode pairs the default (GPT-2-shaped) "
+            f"cached step with the Megatron body; the {family.name} "
+            "family's custom cached block step has no tp variant yet "
+            "(forward TP — make_tp_block_fn / --spmd-tp — does cover it)")
 
     def tp_finalize(pf, hidden, cfg):
         # final LN replicated; LM head column-sharded over the vocab, local

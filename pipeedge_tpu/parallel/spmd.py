@@ -490,11 +490,12 @@ def build_spmd_pipeline(family: FamilySpec, cfg: TransformerConfig,
 
     tp = mesh.shape.get("tp", 1)
     if tp > 1:
-        if cfg.num_attention_heads % tp or cfg.intermediate_size % tp:
+        if cfg.num_attention_heads % tp or cfg.intermediate_size % tp \
+                or cfg.kv_heads % tp:
             raise ValueError(
                 f"mesh tp={tp} must divide attention heads "
-                f"({cfg.num_attention_heads}) and intermediate size "
-                f"({cfg.intermediate_size})")
+                f"({cfg.num_attention_heads}), kv heads ({cfg.kv_heads}), "
+                f"and intermediate size ({cfg.intermediate_size})")
     if cfg.n_experts and (tp > 1 or mesh.shape.get("sp", 1) > 1):
         # tp: expert kernels shard over 'ep', not the Megatron table;
         # sp: routing over a local sequence chunk changes the capacity

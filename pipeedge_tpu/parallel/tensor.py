@@ -128,13 +128,7 @@ def family_tp_plan(cfg: TransformerConfig):
         return _VIT_PARAM_SPECS, partial(_tp_block_local, act=gelu_new,
                                          causal=True)
     if cfg.model_type == "llama":
-        # the dense q/k/v column table assumes equal head widths and a
-        # 2-matmul MLP; llama's GQA k/v (kv_heads < heads) and gated
-        # SwiGLU need their own table/body — refuse rather than shard
-        # the wrong axes silently
-        raise NotImplementedError(
-            "Megatron TP has no llama plan yet (GQA k/v widths and the "
-            "gated SwiGLU MLP don't fit the dense column/row table)")
+        return _LLAMA_PARAM_SPECS, _tp_llama_block_local
     return _VIT_PARAM_SPECS, _tp_block_local
 
 
@@ -201,6 +195,66 @@ def _tp_bert_block_local(p: Dict, x: jax.Array, cfg: TransformerConfig,
     return layer_norm(p["out_ln"], down.astype(x.dtype) + x,
                       cfg.layer_norm_eps)
 
+
+def _tp_llama_block_local(p: Dict, x: jax.Array, cfg: TransformerConfig,
+                          axis: str) -> jax.Array:
+    """Per-device llama block body (pre-RMSNorm, RoPE, GQA, SwiGLU).
+
+    Column-sharded q/k/v keep GQA grouping local: shard i holds query
+    heads [i*h/n, (i+1)*h/n) and kv heads [i*kv/n, (i+1)*kv/n), and query
+    head g's kv head g//(h/kv) lands on the same shard, so the local
+    repeat-and-attend needs no collective. Requires heads, kv_heads, and
+    intermediate_size divisible by the tp degree (reshapes fail loudly
+    otherwise). Two psums per block, like every Megatron body here."""
+    from ..models.layers import rms_norm, rope_rotate
+    from ..models.llama import _gqa_attend
+
+    n = jax.lax.axis_size(axis)
+    heads_local = cfg.num_attention_heads // n
+    kv_local = cfg.kv_heads // n
+    b, s, _ = x.shape
+    hd = cfg.head_dim
+
+    normed = rms_norm(p["ln_before"], x, cfg.layer_norm_eps)
+    pos = jnp.arange(s)
+
+    def proj(name, n_heads):
+        y = jnp.dot(normed, p[name]["w"].astype(x.dtype),
+                    preferred_element_type=jnp.float32) + p[name]["b"]
+        return y.astype(x.dtype).reshape(b, s, n_heads, hd)
+
+    q = rope_rotate(proj("q", heads_local), pos, cfg.rope_theta)
+    k = rope_rotate(proj("k", kv_local), pos, cfg.rope_theta)
+    v = proj("v", kv_local)
+    ctx = _gqa_attend(q, k, v, cfg)          # local heads, causal
+    attn = jnp.dot(ctx, p["attn_out"]["w"].astype(x.dtype),
+                   preferred_element_type=jnp.float32)
+    attn = jax.lax.psum(attn, axis) + p["attn_out"]["b"]
+    x = attn.astype(x.dtype) + x
+
+    normed = rms_norm(p["ln_after"], x, cfg.layer_norm_eps)
+    gate = jnp.dot(normed, p["mlp_gate"]["w"].astype(x.dtype),
+                   preferred_element_type=jnp.float32) + p["mlp_gate"]["b"]
+    up = jnp.dot(normed, p["mlp_up"]["w"].astype(x.dtype),
+                 preferred_element_type=jnp.float32) + p["mlp_up"]["b"]
+    hidden = jax.nn.silu(gate).astype(x.dtype) * up.astype(x.dtype)
+    down = jnp.dot(hidden, p["mlp_down"]["w"].astype(x.dtype),
+                   preferred_element_type=jnp.float32)
+    down = jax.lax.psum(down, axis) + p["mlp_down"]["b"]
+    return down.astype(x.dtype) + x
+
+
+_LLAMA_PARAM_SPECS = {
+    "q": {"w": P(None, "tp"), "b": P("tp")},
+    "k": {"w": P(None, "tp"), "b": P("tp")},
+    "v": {"w": P(None, "tp"), "b": P("tp")},
+    "attn_out": {"w": P("tp", None), "b": P()},
+    "mlp_gate": {"w": P(None, "tp"), "b": P("tp")},
+    "mlp_up": {"w": P(None, "tp"), "b": P("tp")},
+    "mlp_down": {"w": P("tp", None), "b": P()},
+    "ln_before": {"scale": P()},
+    "ln_after": {"scale": P()},
+}
 
 _VIT_PARAM_SPECS = {
     "q": {"w": P(None, "tp"), "b": P("tp")},

@@ -629,11 +629,12 @@ def _make_tp_stage(args, l, r, stage, dtype, restored):
                            "devices on this rank")
     entry = registry.get_model_entry(args.model_name)
     cfg = entry.config
-    if cfg.num_attention_heads % n_tp or cfg.intermediate_size % n_tp:
+    if cfg.num_attention_heads % n_tp or cfg.intermediate_size % n_tp \
+            or cfg.kv_heads % n_tp:
         raise RuntimeError(
             f"--stage-tp {n_tp} must divide attention heads "
-            f"({cfg.num_attention_heads}) and intermediate size "
-            f"({cfg.intermediate_size})")
+            f"({cfg.num_attention_heads}), kv heads ({cfg.kv_heads}), "
+            f"and intermediate size ({cfg.intermediate_size})")
     if (l - 1) % 4 or r % 4:
         raise RuntimeError(f"--stage-tp requires block-aligned stages; "
                            f"[{l}, {r}] cuts mid-block")
@@ -1022,11 +1023,13 @@ def main():
         # the peer-death abort)
         cfg = registry.get_model_config(args.model_name)
         if cfg.num_attention_heads % args.stage_tp \
-                or cfg.intermediate_size % args.stage_tp:
+                or cfg.intermediate_size % args.stage_tp \
+                or cfg.kv_heads % args.stage_tp:
             parser.error(
                 f"--stage-tp {args.stage_tp} must divide attention heads "
-                f"({cfg.num_attention_heads}) and intermediate size "
-                f"({cfg.intermediate_size}) of {args.model_name}")
+                f"({cfg.num_attention_heads}), kv heads ({cfg.kv_heads}), "
+                f"and intermediate size ({cfg.intermediate_size}) of "
+                f"{args.model_name}")
         for spec in pt_rounds:
             if not spec:
                 continue
