@@ -150,10 +150,42 @@ def cached_block_step(p: Dict, x, bcache, pos, cfg: TransformerConfig,
         bcache
 
 
+def tp_cached_block_step(p: Dict, x, bcache, pos, cfg: TransformerConfig,
+                         prefill: bool, axis: str):
+    """Tensor-parallel KV-cached llama block under `shard_map`: the
+    forward Megatron body (parallel/tensor.py `_tp_llama_block_local` —
+    ONE copy of the projection/psum/SwiGLU numerics) with the attention
+    core swapped for a cache-attend over the head-sharded GQA cache
+    slice. Requires heads AND kv_heads divisible by the tp degree."""
+    from ..parallel.decode import _cache_update_and_read
+    from ..parallel.tensor import _tp_llama_block_local
+
+    new_cache = {}
+
+    def cache_attend(q, k_new, v_new):
+        k, v, keep, bc = _cache_update_and_read(
+            bcache, k_new, v_new, pos, prefill, x.shape[1], q.dtype)
+        new_cache.update(bc)
+        return _gqa_attend(q, k, v, cfg, keep=keep)
+
+    pos_ids = jnp.arange(x.shape[1]) if prefill else jnp.asarray(pos)[None]
+    y = _tp_llama_block_local(p, x, cfg, axis, qkv_to_ctx=cache_attend,
+                              pos_ids=pos_ids)
+    return y, new_cache
+
+
+def tp_finalize(pf: Dict, hidden, cfg: TransformerConfig, axis: str):
+    """Vocab-sharded LM head under tp (shared helper, RMS norm)."""
+    from ..parallel.decode import tp_vocab_head_finalize
+    return tp_vocab_head_finalize(pf, hidden, cfg, axis, norm_fn=rms_norm)
+
+
 FAMILY = FamilySpec(name="llama", embed=embed, sublayer=sublayer,
                     finalize=finalize, cached_block_step=cached_block_step,
                     decode_embed=decode_embed,
-                    position_dependent_attention=True)
+                    position_dependent_attention=True,
+                    tp_cached_block_step=tp_cached_block_step,
+                    tp_finalize=tp_finalize)
 
 
 def _a(x, dtype):

@@ -47,6 +47,10 @@ class FamilySpec:
     # attention reads absolute positions (RoPE): chunk-local attention
     # overrides (sequence-parallel cores) would rotate at wrong offsets
     position_dependent_attention: bool = False
+    # tensor-parallel decode variants (per-device bodies under shard_map;
+    # families whose cached step differs from the GPT-2 shape supply them)
+    tp_cached_block_step: Any = None  # (+ axis=...) kwarg
+    tp_finalize: Any = None           # (pf, hidden, cfg, axis) vocab-sharded
 
 
 def _apply_slice(family: FamilySpec, block_params: Dict, data: ShardData,

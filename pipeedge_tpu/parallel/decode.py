@@ -324,6 +324,19 @@ def tp_param_specs(params: Dict, cfg: TransformerConfig, n: int,
     return specs
 
 
+def tp_vocab_head_finalize(pf: Dict, hidden, cfg: TransformerConfig,
+                           axis: str, norm_fn):
+    """Vocab-sharded LM head under tp — THE shared finalize for tp decode
+    stages: `norm_fn` (layer_norm for GPT-2, rms_norm for llama) runs
+    replicated, the head matmul produces local logit slices, one tiled
+    all_gather restores the full [B, S, V]."""
+    hidden = norm_fn(pf["ln"], hidden, cfg.layer_norm_eps)
+    y = jnp.dot(hidden, pf["head"]["w"].astype(hidden.dtype),
+                preferred_element_type=jnp.float32) + pf["head"]["b"]
+    return jax.lax.all_gather(y.astype(hidden.dtype), axis,
+                              axis=y.ndim - 1, tiled=True)
+
+
 def tp_cache_specs(cache: Cache, axis: str = "tp"):
     """Head-shard the K/V buffers (axis 3 of [L, B, T, H, Dh])."""
     from jax.sharding import PartitionSpec as P
@@ -342,34 +355,34 @@ def make_tp_stage_fns(family, cfg: TransformerConfig,
     from jax.sharding import PartitionSpec as P
 
     n = mesh.shape[axis]
-    if cfg.num_attention_heads % n:
+    if cfg.num_attention_heads % n or cfg.kv_heads % n:
         raise ValueError(f"tp={n} requires head count "
-                         f"({cfg.num_attention_heads}) divisible by tp")
+                         f"({cfg.num_attention_heads}) and kv head count "
+                         f"({cfg.kv_heads}) divisible by tp")
     if cfg.n_experts:
         raise NotImplementedError(
             "tensor-parallel decode does not cover MoE blocks (experts "
             "shard over 'ep', not 'tp') — use make_tp_ep_stage_fns / "
             "DecodePipeline(tp_ep_mesh=...) for the tp x ep composition")
-    if getattr(family, "cached_block_step", None) is not None:
+    fam_tp_step = getattr(family, "tp_cached_block_step", None)
+    if fam_tp_step is None \
+            and getattr(family, "cached_block_step", None) is not None:
         raise NotImplementedError(
             f"tensor-parallel decode pairs the default (GPT-2-shaped) "
             f"cached step with the Megatron body; the {family.name} "
-            "family's custom cached block step has no tp variant yet "
+            "family supplies a custom cached block step but no tp variant "
             "(forward TP — make_tp_block_fn / --spmd-tp — does cover it)")
 
-    def tp_finalize(pf, hidden, cfg):
-        # final LN replicated; LM head column-sharded over the vocab, local
-        # logit slices all-gathered back to the full [B, S, V]
-        hidden = layer_norm(pf["ln"], hidden, cfg.layer_norm_eps)
-        y = jnp.dot(hidden, pf["head"]["w"].astype(hidden.dtype),
-                    preferred_element_type=jnp.float32) + pf["head"]["b"]
-        return jax.lax.all_gather(y.astype(hidden.dtype), axis,
-                                  axis=y.ndim - 1, tiled=True)
-
+    fam_tp_fin = getattr(family, "tp_finalize", None)
+    fin = None
+    if _tp_shards_head(cfg, n):
+        fin = partial(fam_tp_fin, axis=axis) if fam_tp_fin \
+            else partial(tp_vocab_head_finalize, axis=axis,
+                         norm_fn=layer_norm)
     run = _make_stage_run(family, cfg, shard_config,
-                          block_fn=partial(_block_step_tp, axis=axis),
-                          finalize_fn=tp_finalize
-                          if _tp_shards_head(cfg, n) else None)
+                          block_fn=partial(fam_tp_step or _block_step_tp,
+                                           axis=axis),
+                          finalize_fn=fin)
     p_specs = tp_param_specs(params, cfg, n, axis)
     c_specs = tp_cache_specs(init_cache(cfg, 1, 1, 1), axis)
 

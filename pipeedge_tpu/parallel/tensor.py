@@ -197,7 +197,8 @@ def _tp_bert_block_local(p: Dict, x: jax.Array, cfg: TransformerConfig,
 
 
 def _tp_llama_block_local(p: Dict, x: jax.Array, cfg: TransformerConfig,
-                          axis: str) -> jax.Array:
+                          axis: str, qkv_to_ctx=None,
+                          pos_ids=None) -> jax.Array:
     """Per-device llama block body (pre-RMSNorm, RoPE, GQA, SwiGLU).
 
     Column-sharded q/k/v keep GQA grouping local: shard i holds query
@@ -205,7 +206,13 @@ def _tp_llama_block_local(p: Dict, x: jax.Array, cfg: TransformerConfig,
     head g's kv head g//(h/kv) lands on the same shard, so the local
     repeat-and-attend needs no collective. Requires heads, kv_heads, and
     intermediate_size divisible by the tp degree (reshapes fail loudly
-    otherwise). Two psums per block, like every Megatron body here."""
+    otherwise). Two psums per block, like every Megatron body here.
+
+    `qkv_to_ctx(q, k, v) -> ctx` overrides the attention core over the
+    local (RoPE'd) heads and `pos_ids` the rotation positions — how the
+    llama KV-cached tp decode step plugs its cache-attend into this same
+    projection/psum/SwiGLU body (models/llama.py tp_cached_block_step),
+    mirroring _tp_block_local's hook for GPT-2."""
     from ..models.layers import rms_norm, rope_rotate
     from ..models.llama import _gqa_attend
 
@@ -216,7 +223,7 @@ def _tp_llama_block_local(p: Dict, x: jax.Array, cfg: TransformerConfig,
     hd = cfg.head_dim
 
     normed = rms_norm(p["ln_before"], x, cfg.layer_norm_eps)
-    pos = jnp.arange(s)
+    pos = jnp.arange(s) if pos_ids is None else pos_ids
 
     def proj(name, n_heads):
         y = jnp.dot(normed, p[name]["w"].astype(x.dtype),
@@ -226,7 +233,8 @@ def _tp_llama_block_local(p: Dict, x: jax.Array, cfg: TransformerConfig,
     q = rope_rotate(proj("q", heads_local), pos, cfg.rope_theta)
     k = rope_rotate(proj("k", kv_local), pos, cfg.rope_theta)
     v = proj("v", kv_local)
-    ctx = _gqa_attend(q, k, v, cfg)          # local heads, causal
+    ctx = (qkv_to_ctx(q, k, v) if qkv_to_ctx is not None
+           else _gqa_attend(q, k, v, cfg))   # local heads, causal
     attn = jnp.dot(ctx, p["attn_out"]["w"].astype(x.dtype),
                    preferred_element_type=jnp.float32)
     attn = jax.lax.psum(attn, axis) + p["attn_out"]["b"]

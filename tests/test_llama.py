@@ -175,12 +175,20 @@ def test_tp_block_and_spmd_tp_pipeline(llama_setup):
                          for u in ids])
     np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-4)
 
-    # tp DECODE refuses: the Megatron cached step is GPT-2-shaped and has
-    # no llama (RoPE/GQA) variant yet
-    with pytest.raises(NotImplementedError, match="cached"):
-        decode.DecodePipeline(
-            llama_mod.FAMILY, cfg, partition,
-            _stage_params(cfg, partition, weights), max_len=32, mesh=mesh)
+    # tp DECODE: the family's tp cached step (RoPE on local heads, GQA
+    # cache slice, vocab-sharded RMS head) is token-identical to the
+    # single-device pipeline
+    plain = decode.DecodePipeline(llama_mod.FAMILY, cfg, partition,
+                                  _stage_params(cfg, partition, weights),
+                                  max_len=32)
+    tp_pipe = decode.DecodePipeline(llama_mod.FAMILY, cfg, partition,
+                                    _stage_params(cfg, partition, weights),
+                                    max_len=32, mesh=mesh)
+    dec_ids = np.random.default_rng(17).integers(0, cfg.vocab_size,
+                                                 size=(2, 6))
+    np.testing.assert_array_equal(
+        np.asarray(tp_pipe.generate(dec_ids, new_tokens=6)),
+        np.asarray(plain.generate(dec_ids, new_tokens=6)))
 
 
 def test_sp_refused(llama_setup):
