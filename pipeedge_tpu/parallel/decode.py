@@ -33,8 +33,9 @@ from ..models import ShardConfig, plan_shard
 from ..models.layers import (TransformerConfig, dense, gelu_new, layer_norm)
 
 Cache = Dict[str, jax.Array]   # {'k': [L, B, T, H, Dh], 'v': [L, B, T, H, Dh]}
-# int8 variant adds per-(block, batch, position) scale/shift rows:
-#   {'k': int8, 'v': int8, 'k_scale'/'k_shift'/'v_scale'/'v_shift': [L, B, T]}
+# int8 variant adds per-(block, batch, position, head) scale/shift rows —
+# the head axis shards over 'tp' with the K/V buffers:
+#   {'k': int8, 'v': int8, 'k_scale'/'k_shift'/'v_scale'/'v_shift': [L, B, T, H]}
 
 
 def init_cache(cfg: TransformerConfig, n_blocks: int, batch: int,
@@ -42,10 +43,14 @@ def init_cache(cfg: TransformerConfig, n_blocks: int, batch: int,
                cache_bits: int = 0) -> Cache:
     """Zeroed stacked KV cache for `n_blocks` blocks.
 
-    `cache_bits=8` stores K/V as int8 with per-position affine scales
-    (QuantPipe's activation-compression idea applied to the decode cache):
-    cache reads dominate decode-step HBM traffic, so int8 halves the
-    bandwidth bound vs bfloat16 at negligible logit error.
+    `cache_bits=8` stores K/V as int8 with per-(position, head) affine
+    scales (QuantPipe's activation-compression idea applied to the decode
+    cache): cache reads dominate decode-step HBM traffic, so int8 halves
+    the bandwidth bound vs bfloat16 at negligible logit error. Scales are
+    per HEAD (not per position only) so the scale rows carry a head axis
+    and shard over 'tp' exactly like the K/V buffers — int8 caches
+    compose with tensor-parallel decode, and the finer granularity also
+    tightens the quantization error.
 
     The head axis is `cfg.kv_heads` — equal to the query head count for
     every family except GQA decoders (llama), whose cache is kv_heads/
@@ -55,7 +60,7 @@ def init_cache(cfg: TransformerConfig, n_blocks: int, batch: int,
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
     if cache_bits != 8:
         raise ValueError(f"cache_bits must be 0 (off) or 8, got {cache_bits}")
-    rows = shape[:3]
+    rows = shape[:4]                       # [..., T, H] per-head scales
     cache = {"k": jnp.zeros(shape, jnp.int8),
              "v": jnp.zeros(shape, jnp.int8)}
     for t in ("k", "v"):
@@ -65,20 +70,20 @@ def init_cache(cfg: TransformerConfig, n_blocks: int, batch: int,
 
 
 def _quantize_rows(x: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Affine-quantize [B, S, H, Dh] to int8 per (batch, position) row."""
-    lo = jnp.min(x, axis=(2, 3)).astype(jnp.float32)        # [B, S]
-    hi = jnp.max(x, axis=(2, 3)).astype(jnp.float32)
+    """Affine-quantize [B, S, H, Dh] to int8 per (batch, position, head)."""
+    lo = jnp.min(x, axis=3).astype(jnp.float32)             # [B, S, H]
+    hi = jnp.max(x, axis=3).astype(jnp.float32)
     scale = jnp.maximum(hi - lo, 1e-8) / 255.0
-    q = jnp.round((x.astype(jnp.float32) - lo[..., None, None])
-                  / scale[..., None, None]) - 128.0
+    q = jnp.round((x.astype(jnp.float32) - lo[..., None])
+                  / scale[..., None]) - 128.0
     return q.astype(jnp.int8), scale, lo
 
 
 def _dequantize_rows(q: jax.Array, scale: jax.Array, shift: jax.Array,
                      dtype) -> jax.Array:
-    """Invert `_quantize_rows`: [B, T, H, Dh] int8 + [B, T] rows -> dtype."""
-    return ((q.astype(jnp.float32) + 128.0) * scale[..., None, None]
-            + shift[..., None, None]).astype(dtype)
+    """Invert `_quantize_rows`: [B, T, H, Dh] int8 + [B, T, H] -> dtype."""
+    return ((q.astype(jnp.float32) + 128.0) * scale[..., None]
+            + shift[..., None]).astype(dtype)
 
 
 def _qkv(p: Dict, normed: jax.Array, cfg: TransformerConfig):
@@ -119,9 +124,9 @@ def _cache_update_and_read(bcache: Cache, k_new: jax.Array, v_new: jax.Array,
             qv, scale, shift = _quantize_rows(new)
             bcache[t] = jax.lax.dynamic_update_slice(bcache[t], qv, start)
             bcache[f"{t}_scale"] = jax.lax.dynamic_update_slice(
-                bcache[f"{t}_scale"], scale, start[:2])
+                bcache[f"{t}_scale"], scale, start[:3])
             bcache[f"{t}_shift"] = jax.lax.dynamic_update_slice(
-                bcache[f"{t}_shift"], shift, start[:2])
+                bcache[f"{t}_shift"], shift, start[:3])
         k = _dequantize_rows(bcache["k"], bcache["k_scale"],
                              bcache["k_shift"], dtype)
         v = _dequantize_rows(bcache["v"], bcache["v_scale"],
@@ -338,20 +343,24 @@ def tp_vocab_head_finalize(pf: Dict, hidden, cfg: TransformerConfig,
 
 
 def tp_cache_specs(cache: Cache, axis: str = "tp"):
-    """Head-shard the K/V buffers (axis 3 of [L, B, T, H, Dh])."""
+    """Head-shard the cache leaves: axis 3 of the K/V buffers
+    [L, B, T, H, Dh] AND of the per-head scale/shift rows [L, B, T, H]
+    (the head axis on the scales is what lets int8 caches compose with
+    tp — each device quantizes/dequantizes its own head slice)."""
     from jax.sharding import PartitionSpec as P
-    return {k: P(None, None, None, axis, None) for k in cache}
+    return {k: P(*([None, None, None, axis]
+                   + [None] * (v.ndim - 4))) for k, v in cache.items()}
 
 
 def make_tp_stage_fns(family, cfg: TransformerConfig,
                       shard_config: ShardConfig, mesh, params: Dict,
-                      axis: str = "tp"):
+                      axis: str = "tp", cache_bits: int = 0):
     """Tensor-parallel variant of `make_stage_fns`: the stage executes under
     `shard_map` over `axis` with head-sharded KV cache and the 2-psum
     Megatron block body — decode-step latency scales with the tp degree.
     `params` (stacked-blocks layout) supplies the pytree structure for the
-    partition specs; int8 caches are not supported under tp (per-device
-    scale rows would diverge)."""
+    partition specs; `cache_bits=8` composes int8 caches with tp (the
+    per-head scale rows shard over `axis` with the K/V buffers)."""
     from jax.sharding import PartitionSpec as P
 
     n = mesh.shape[axis]
@@ -384,7 +393,8 @@ def make_tp_stage_fns(family, cfg: TransformerConfig,
                                            axis=axis),
                           finalize_fn=fin)
     p_specs = tp_param_specs(params, cfg, n, axis)
-    c_specs = tp_cache_specs(init_cache(cfg, 1, 1, 1), axis)
+    c_specs = tp_cache_specs(init_cache(cfg, 1, 1, 1,
+                                        cache_bits=cache_bits), axis)
 
     prefill_fn = jax.jit(jax.shard_map(
         partial(run, pos=0, prefill=True), mesh=mesh,
@@ -706,9 +716,6 @@ class DecodePipeline:
         total = 4 * cfg.num_hidden_layers
         validate_partition(partition, total)
         validate_capacity(cfg, max_len)
-        if mesh is not None and cache_bits:
-            raise ValueError("int8 KV cache is not supported under tensor "
-                             "parallelism (per-device scale rows diverge)")
         if mesh is not None and devices is not None:
             raise ValueError("pass either per-stage `devices` or a tp "
                              "`mesh`, not both")
@@ -757,8 +764,10 @@ class DecodePipeline:
             if sharded is not None:
                 from jax.sharding import NamedSharding
                 maker, m, ax = sharded
+                kw = ({"cache_bits": cache_bits}
+                      if maker is make_tp_stage_fns else {})
                 pre, dec, p_specs = maker(family, cfg, sc, m, params,
-                                          axis=ax)
+                                          axis=ax, **kw)
                 params = jax.tree_util.tree_map(
                     lambda x, s: jax.device_put(x, NamedSharding(m, s)),
                     params, p_specs)
