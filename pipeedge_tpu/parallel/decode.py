@@ -497,7 +497,7 @@ def make_token_picker(temperature: float = 0.0, top_k: int = 0):
 
 def make_ep_stage_fns(family, cfg: TransformerConfig,
                       shard_config: ShardConfig, mesh, params: Dict,
-                      axis: str = "ep"):
+                      axis: str = "ep", cache_bits: int = 0):
     """Expert-parallel variant of `make_stage_fns` for MoE stages: the
     routed FFN's experts shard over `axis` (each device computes its local
     experts' tokens, one psum combines — parallel/expert.py's layout inside
@@ -526,11 +526,14 @@ def make_ep_stage_fns(family, cfg: TransformerConfig,
 
     run = _make_stage_run(family, cfg, shard_config, block_fn=block_step_ep)
     # experts shard on their leading axis (under the stacked block axis);
-    # everything else — attention weights, cache — replicated
+    # everything else — attention weights, cache (incl. int8 scale rows:
+    # replicated cache means identical quantization on every device) —
+    # replicated
     p_specs = jax.tree_util.tree_map(lambda _: P(), params)
     p_specs["blocks"]["moe"]["experts"] = jax.tree_util.tree_map(
         lambda _: P(None, axis), params["blocks"]["moe"]["experts"])
-    c_specs = {"k": P(), "v": P()}
+    c_specs = {k: P() for k in init_cache(cfg, 1, 1, 1,
+                                          cache_bits=cache_bits)}
 
     prefill_fn = jax.jit(jax.shard_map(
         partial(run, pos=0, prefill=True), mesh=mesh,
@@ -724,10 +727,9 @@ class DecodePipeline:
             raise ValueError("sp_mesh (sequence-parallel prefill) does not "
                              "compose with tp mesh/int8 cache/devices")
         if ep_mesh is not None and (mesh is not None or sp_mesh is not None
-                                    or cache_bits or devices is not None):
+                                    or devices is not None):
             raise ValueError("ep_mesh (expert-parallel MoE decode) does not "
-                             "compose with tp/sp meshes, int8 cache, or "
-                             "devices")
+                             "compose with tp/sp meshes or devices")
         if tp_ep_mesh is not None and (mesh is not None or ep_mesh is not None
                                        or sp_mesh is not None or cache_bits
                                        or devices is not None):
@@ -765,7 +767,8 @@ class DecodePipeline:
                 from jax.sharding import NamedSharding
                 maker, m, ax = sharded
                 kw = ({"cache_bits": cache_bits}
-                      if maker is make_tp_stage_fns else {})
+                      if maker in (make_tp_stage_fns, make_ep_stage_fns)
+                      else {})
                 pre, dec, p_specs = maker(family, cfg, sc, m, params,
                                           axis=ax, **kw)
                 params = jax.tree_util.tree_map(

@@ -155,6 +155,18 @@ def test_moe_ep_decode_matches_plain(moe_setup):
                                   stage_params, max_len=16, ep_mesh=ep_mesh)
     got = np.asarray(piped.generate(ids, 6))
     np.testing.assert_array_equal(got, want)
+    # int8 KV composes with ep (cache + per-head scale rows replicated
+    # across the ep axis -> identical quantization on every device):
+    # tokens match the single-device int8 MoE pipeline
+    int8_plain = decode.DecodePipeline(gpt2_mod.FAMILY, cfg, partition,
+                                       stage_params, max_len=16,
+                                       cache_bits=8)
+    int8_ep = decode.DecodePipeline(gpt2_mod.FAMILY, cfg, partition,
+                                    stage_params, max_len=16,
+                                    cache_bits=8, ep_mesh=ep_mesh)
+    np.testing.assert_array_equal(
+        np.asarray(int8_ep.generate(ids, 6)),
+        np.asarray(int8_plain.generate(ids, 6)))
     with pytest.raises(ValueError, match="requires an MoE config"):
         decode.make_ep_stage_fns(
             gpt2_mod.FAMILY,
