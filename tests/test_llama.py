@@ -191,6 +191,35 @@ def test_tp_block_and_spmd_tp_pipeline(llama_setup):
         np.asarray(plain.generate(dec_ids, new_tokens=6)))
 
 
+@pytest.mark.slow
+def test_beam_chunked_prefill_and_int8_compose(llama_setup):
+    """The decode feature matrix is family-agnostic where it should be:
+    beam search (width 1 == greedy), chunked prefill (token-identical),
+    and the int8 GQA cache (close to exact) all run on llama unchanged."""
+    cfg, weights, _ = llama_setup
+    partition = [(1, 4), (5, 8)]
+    sp = _stage_params(cfg, partition, weights)
+    pipe = decode.DecodePipeline(llama_mod.FAMILY, cfg, partition, sp,
+                                 max_len=32)
+    ids = np.random.default_rng(19).integers(0, cfg.vocab_size, size=(4, 6))
+    want = np.asarray(pipe.generate(ids, 6))
+    np.testing.assert_array_equal(
+        np.asarray(pipe.generate_beam(ids, 6, beams=1)), want)
+    beam3 = np.asarray(pipe.generate_beam(ids, 4, beams=3))
+    assert beam3.shape == (4, 10)
+    np.testing.assert_array_equal(
+        np.asarray(pipe.generate(ids, 6, prefill_ubatch=2)), want)
+
+    int8 = decode.DecodePipeline(llama_mod.FAMILY, cfg, partition, sp,
+                                 max_len=32, cache_bits=8)
+    out8 = np.asarray(int8.generate(ids, 6))
+    assert out8.shape == want.shape
+    assert (out8[:, :6] == ids).all()
+    # int8 error may flip late greedy picks on a random tiny model; the
+    # first continuation token comes from exact (fresh-row) attention
+    np.testing.assert_array_equal(out8[:, 6], want[:, 6])
+
+
 def test_sp_refused(llama_setup):
     """RoPE makes chunk-local sp attention position-wrong; the family
     refuses the override instead of silently rotating at chunk offsets."""
