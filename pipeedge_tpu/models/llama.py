@@ -24,9 +24,11 @@ and each step rotates only the new token's q/k at its position.
 Weight format: HF `LlamaForCausalLM` state dict (`model.`-prefixed
 `nn.Linear` kernels, stored [out, in] -> transposed to [in, out] at load;
 no biases — zero vectors keep the {w, b} pytree shape shared with the
-other families). Sequence parallelism is refused for this family (ring /
-Ulysses cores compute projections chunk-locally without the global RoPE
-position offset).
+other families). The FORWARD-pipeline sequence-parallel attention
+override is refused (those cores compute projections chunk-locally with
+no global RoPE offset); the decode subsystem's sp PREFILL is supported
+via `sp_prefill_block_step`, which pre-rotates q/k at global chunk
+positions before the chunk-local core.
 """
 from __future__ import annotations
 
@@ -127,6 +129,17 @@ def decode_embed(pe: Dict, tok: jax.Array, pos) -> jax.Array:
     return jnp.take(pe["wte"], tok.reshape(-1), axis=0)[:, None]
 
 
+def _block_tail(p: Dict, x, ctx, cfg: TransformerConfig):
+    """Post-attention half of a llama block (output proj + residual,
+    RMSNorm, SwiGLU + residual) — ONE copy shared by the cached decode
+    step and the sp prefill so their numerics cannot diverge."""
+    h = dense(p["attn_out"], ctx) + x
+    normed = rms_norm(p["ln_after"], h, cfg.layer_norm_eps)
+    gated = jax.nn.silu(dense(p["mlp_gate"], normed).astype(
+        jnp.float32)).astype(normed.dtype)
+    return dense(p["mlp_down"], gated * dense(p["mlp_up"], normed)) + h
+
+
 def cached_block_step(p: Dict, x, bcache, pos, cfg: TransformerConfig,
                       prefill: bool):
     """KV-cached llama block (decode subsystem contract, parallel/decode.py
@@ -142,12 +155,7 @@ def cached_block_step(p: Dict, x, bcache, pos, cfg: TransformerConfig,
     k, v, keep, bcache = _cache_update_and_read(
         bcache, k_new, v_new, pos, prefill, s, q.dtype)
     ctx = _gqa_attend(q, k, v, cfg, keep=keep)
-    h = dense(p["attn_out"], ctx) + x
-    normed2 = rms_norm(p["ln_after"], h, cfg.layer_norm_eps)
-    gated = jax.nn.silu(dense(p["mlp_gate"], normed2).astype(
-        jnp.float32)).astype(normed2.dtype)
-    return dense(p["mlp_down"], gated * dense(p["mlp_up"], normed2)) + h, \
-        bcache
+    return _block_tail(p, x, ctx, cfg), bcache
 
 
 def tp_cached_block_step(p: Dict, x, bcache, pos, cfg: TransformerConfig,
@@ -180,12 +188,39 @@ def tp_finalize(pf: Dict, hidden, cfg: TransformerConfig, axis: str):
     return tp_vocab_head_finalize(pf, hidden, cfg, axis, norm_fn=rms_norm)
 
 
+def sp_prefill_block_step(p: Dict, x, bcache, cfg: TransformerConfig,
+                          axis: str, core, cache_gather):
+    """Sequence-parallel llama prefill block: RoPE is applied at GLOBAL
+    chunk positions (chunk_start + local offset) BEFORE the sp core, so
+    the rotation carries the position information and the chunk-local
+    ring/Ulysses core stays position-agnostic — exactly why the plain
+    attention-override path refuses RoPE families but this hook is sound.
+    K/V repeat to the full query head count before the core (GQA grouping
+    is sequence-invariant); the cache gathers the UNREPEATED post-RoPE
+    rows, matching what the per-token decode steps read. Known cost: the
+    repeated K/V ride the ring's ppermutes, so inter-chip bytes are
+    heads/kv_heads times the unrepeated rows — a GQA-aware ring core
+    (repeat inside the local block update) would reclaim that bandwidth;
+    correctness-first for now."""
+    normed = rms_norm(p["ln_before"], x, cfg.layer_norm_eps)
+    b, s_local, _ = x.shape
+    idx = jax.lax.axis_index(axis)
+    pos = idx * s_local + jnp.arange(s_local)
+    q, k_new, v_new = _qkv_rope(p, normed, cfg, pos)
+    rep = cfg.num_attention_heads // cfg.kv_heads
+    ctx = core(q, _repeat_kv(k_new, rep), _repeat_kv(v_new, rep), axis,
+               causal=True)
+    return (_block_tail(p, x, ctx.reshape(b, s_local, -1), cfg),
+            cache_gather(bcache, k_new, v_new))
+
+
 FAMILY = FamilySpec(name="llama", embed=embed, sublayer=sublayer,
                     finalize=finalize, cached_block_step=cached_block_step,
                     decode_embed=decode_embed,
                     position_dependent_attention=True,
                     tp_cached_block_step=tp_cached_block_step,
-                    tp_finalize=tp_finalize)
+                    tp_finalize=tp_finalize,
+                    sp_prefill_block_step=sp_prefill_block_step)
 
 
 def _a(x, dtype):

@@ -51,6 +51,9 @@ class FamilySpec:
     # families whose cached step differs from the GPT-2 shape supply them)
     tp_cached_block_step: Any = None  # (+ axis=...) kwarg
     tp_finalize: Any = None           # (pf, hidden, cfg, axis) vocab-sharded
+    # sequence-parallel prefill block for position-dependent families:
+    # (p, x, bcache, cfg, axis, core, cache_gather) -> (x, bcache)
+    sp_prefill_block_step: Any = None
 
 
 def _apply_slice(family: FamilySpec, block_params: Dict, data: ShardData,

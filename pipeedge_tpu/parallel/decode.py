@@ -652,37 +652,55 @@ def make_sp_prefill_fn(family, cfg: TransformerConfig,
         raise NotImplementedError(
             "sequence-parallel prefill does not cover MoE blocks "
             "(per-chunk routing would change capacity semantics)")
-    if getattr(family, "position_dependent_attention", False):
+    fam_sp_block = getattr(family, "sp_prefill_block_step", None)
+    if getattr(family, "position_dependent_attention", False) \
+            and fam_sp_block is None:
         raise NotImplementedError(
             f"sequence-parallel prefill does not cover the {family.name} "
-            "family (its attention is position-dependent — RoPE — and the "
-            "chunk-local sp cores have no global position offset)")
+            "family (its attention is position-dependent — RoPE — and it "
+            "supplies no sp_prefill_block_step hook to pre-rotate at "
+            "global chunk positions)")
     n = mesh.shape[axis]
     core = resolve_sp_core(sp_kind, cfg.num_attention_heads, n)
 
-    def block_prefill(p, x, bcache, pos, cfg_, prefill):
-        """One block over the local chunk [B, S/n, D]: causal ring/Ulysses
-        attention for the output, all-gathered K/V into the cache; the
-        post-attention half is the shared _block_tail."""
-        normed = layer_norm(p["ln_before"], x, cfg_.layer_norm_eps)
-        q, k_new, v_new = _qkv(p, normed, cfg_)
-        ctx = core(q, k_new, v_new, axis, causal=True)
-        b, s_local, h, hd = q.shape
-        x = _block_tail(p, x, ctx.reshape(b, s_local, h * hd), cfg_)
+    def cache_gather(bcache, k_new, v_new):
+        """All-gather this chunk's K/V rows into the (replicated) stage
+        cache — shared by the default and family sp block steps."""
         bcache = dict(bcache)
         for t, new in (("k", k_new), ("v", v_new)):
             full = jax.lax.all_gather(new, axis, axis=1, tiled=True)
             bcache[t] = jax.lax.dynamic_update_slice(
                 bcache[t], full.astype(bcache[t].dtype), (0, 0, 0, 0))
-        return x, bcache
+        return bcache
+
+    if fam_sp_block is not None:
+        def block_prefill(p, x, bcache, pos, cfg_, prefill):
+            return fam_sp_block(p, x, bcache, cfg_, axis, core,
+                                cache_gather)
+    else:
+        def block_prefill(p, x, bcache, pos, cfg_, prefill):
+            """One block over the local chunk [B, S/n, D]: causal ring/
+            Ulysses attention for the output, all-gathered K/V into the
+            cache; the post-attention half is the shared _block_tail."""
+            normed = layer_norm(p["ln_before"], x, cfg_.layer_norm_eps)
+            q, k_new, v_new = _qkv(p, normed, cfg_)
+            ctx = core(q, k_new, v_new, axis, causal=True)
+            b, s_local, h, hd = q.shape
+            x = _block_tail(p, x, ctx.reshape(b, s_local, h * hd), cfg_)
+            return x, cache_gather(bcache, k_new, v_new)
 
     def sp_embed(pe, ids):
-        """Embed this device's prompt chunk at its global positions."""
+        """Embed this device's prompt chunk at its global positions
+        (learned position table added only for families that have one —
+        RoPE families carry positions in the attention rotation)."""
         idx = jax.lax.axis_index(axis)
         chunk = ids.shape[1] // n
         local = jax.lax.dynamic_slice_in_dim(ids, idx * chunk, chunk, 1)
-        wpe = jax.lax.dynamic_slice_in_dim(pe["wpe"], idx * chunk, chunk)
-        return jnp.take(pe["wte"], local, axis=0) + wpe[None]
+        out = jnp.take(pe["wte"], local, axis=0)
+        if "wpe" in pe:
+            wpe = jax.lax.dynamic_slice_in_dim(pe["wpe"], idx * chunk, chunk)
+            out = out + wpe[None]
+        return out
 
     def sp_finalize(pf, hidden, cfg_):
         hidden = jax.lax.all_gather(hidden, axis, axis=1, tiled=True)

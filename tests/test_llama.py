@@ -221,12 +221,33 @@ def test_beam_chunked_prefill_and_int8_compose(llama_setup):
 
 
 def test_sp_refused(llama_setup):
-    """RoPE makes chunk-local sp attention position-wrong; the family
-    refuses the override instead of silently rotating at chunk offsets."""
+    """RoPE makes chunk-local sp attention position-wrong; the FORWARD
+    sp override refuses (the decode sp prefill instead pre-rotates at
+    global chunk positions via the family hook — tested below)."""
     cfg, weights, _ = llama_setup
     with pytest.raises(NotImplementedError, match="RoPE|sequence"):
         llama_mod.sublayer({}, 0, jnp.zeros((1, 4, 32)), cfg,
                            attention_fn=lambda *a, **k: None)
+
+
+@pytest.mark.slow
+def test_sp_prefill_matches_plain(llama_setup):
+    """Sequence-parallel llama prefill: RoPE at global chunk positions
+    before the causal ring core, unrepeated post-RoPE GQA rows gathered
+    into the cache — decode tokens match the single-device pipeline."""
+    from jax.sharding import Mesh
+    cfg, weights, _ = llama_setup
+    partition = [(1, 4), (5, 8)]
+    sp = _stage_params(cfg, partition, weights)
+    plain = decode.DecodePipeline(llama_mod.FAMILY, cfg, partition, sp,
+                                  max_len=32)
+    sp_mesh = Mesh(np.asarray(jax.devices()[:2]), ("sp",))
+    piped = decode.DecodePipeline(llama_mod.FAMILY, cfg, partition, sp,
+                                  max_len=32, sp_mesh=sp_mesh)
+    ids = np.random.default_rng(23).integers(0, cfg.vocab_size, size=(2, 6))
+    np.testing.assert_array_equal(
+        np.asarray(piped.generate(ids, 6)),
+        np.asarray(plain.generate(ids, 6)))
 
 
 @pytest.mark.fleet
