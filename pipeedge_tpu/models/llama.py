@@ -195,21 +195,17 @@ def sp_prefill_block_step(p: Dict, x, bcache, cfg: TransformerConfig,
     the rotation carries the position information and the chunk-local
     ring/Ulysses core stays position-agnostic — exactly why the plain
     attention-override path refuses RoPE families but this hook is sound.
-    K/V repeat to the full query head count before the core (GQA grouping
-    is sequence-invariant); the cache gathers the UNREPEATED post-RoPE
-    rows, matching what the per-token decode steps read. Known cost: the
-    repeated K/V ride the ring's ppermutes, so inter-chip bytes are
-    heads/kv_heads times the unrepeated rows — a GQA-aware ring core
-    (repeat inside the local block update) would reclaim that bandwidth;
-    correctness-first for now."""
+    The sp cores are GQA-aware (parallel/sequence.py): unrepeated K/V
+    ride the ring ppermutes / all-to-alls and repeat only inside the
+    local attend, so the inter-chip traffic keeps GQA's kv_heads/heads
+    size advantage; the cache likewise gathers the UNREPEATED post-RoPE
+    rows the per-token decode steps read."""
     normed = rms_norm(p["ln_before"], x, cfg.layer_norm_eps)
     b, s_local, _ = x.shape
     idx = jax.lax.axis_index(axis)
     pos = idx * s_local + jnp.arange(s_local)
     q, k_new, v_new = _qkv_rope(p, normed, cfg, pos)
-    rep = cfg.num_attention_heads // cfg.kv_heads
-    ctx = core(q, _repeat_kv(k_new, rep), _repeat_kv(v_new, rep), axis,
-               causal=True)
+    ctx = core(q, k_new, v_new, axis, causal=True)
     return (_block_tail(p, x, ctx.reshape(b, s_local, -1), cfg),
             cache_gather(bcache, k_new, v_new))
 

@@ -33,9 +33,16 @@ def _block_attention(q, k, v, m_prev, l_prev, acc_prev, q_offset, k_offset,
                      causal: bool, scale: float):
     """One streaming-softmax block update.
 
-    q: [B, Sq, H, D]; k/v: [B, Sk, H, D]; running (max, sum, acc) over the
-    key axis. Scores/stats in float32 regardless of input dtype.
+    q: [B, Sq, H, D]; k/v: [B, Sk, H, D] — or [B, Sk, KV, D] with KV < H
+    (GQA): the kv heads repeat LOCALLY here, so ring_attention's
+    ppermutes carry only the unrepeated rows (H/KV times fewer
+    inter-chip bytes). Running (max, sum, acc) over the key axis;
+    scores/stats in float32 regardless of input dtype.
     """
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
     if causal:
@@ -70,6 +77,9 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
     b, sq, h, d = q.shape
     scale = 1.0 / (d ** 0.5)
     chunk = k.shape[1]
+    # GQA: k/v may carry fewer heads than q — they rotate unrepeated
+    # (repeat happens inside the block update), so the ring traffic is
+    # sized by the kv heads, preserving GQA's bandwidth advantage
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     m0 = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
@@ -115,7 +125,18 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
                                   tiled=True)
 
+    kv = k.shape[2]
+    if kv != h and kv % n:
+        # GQA group count not divisible by the axis: pre-repeat to the
+        # full head count (correct for any kv since h % n == 0 holds) —
+        # the all-to-all then moves full-head bytes, like the pre-GQA
+        # behavior. The bandwidth-saving path below needs kv % n == 0.
+        k = jnp.repeat(k, h // kv, axis=2)
+        v = jnp.repeat(v, h // kv, axis=2)
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    if k.shape[2] != h:               # GQA: repeat AFTER the all-to-all
+        kh = jnp.repeat(kh, h // k.shape[2], axis=2)
+        vh = jnp.repeat(vh, h // k.shape[2], axis=2)
     scores = jnp.einsum("bqhd,bkhd->bhqk", qh, kh,
                         preferred_element_type=jnp.float32) * scale
     if causal:

@@ -64,3 +64,38 @@ def test_ring_bfloat16():
     assert out.dtype == jnp.bfloat16
     np.testing.assert_allclose(out.astype(np.float32),
                                _reference_attention(q, k, v), rtol=0.1, atol=0.05)
+
+
+@pytest.mark.parametrize("kind", ["ring", "ulysses"])
+def test_gqa_kv_heads_match_full_attention(kind):
+    """GQA-aware cores: k/v carry fewer heads than q, repeat only inside
+    the local attend (AFTER the ppermute/all-to-all, so inter-chip bytes
+    stay kv_heads-sized) — output matches full attention on the repeated
+    oracle."""
+    rng = np.random.default_rng(7)
+    b, s, h, kv, d = 2, 32, 8, 4, 16
+    q = rng.normal(size=(b, s, h, d)).astype(np.float32)
+    k = rng.normal(size=(b, s, kv, d)).astype(np.float32)
+    v = rng.normal(size=(b, s, kv, d)).astype(np.float32)
+    fn = make_sequence_parallel_attention(_mesh(4), kind=kind, causal=True)
+    out = np.asarray(fn(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
+    expected = _reference_attention(q, np.repeat(k, h // kv, 2),
+                                    np.repeat(v, h // kv, 2), causal=True)
+    np.testing.assert_allclose(out, expected, rtol=2e-4, atol=2e-5)
+
+
+def test_gqa_ulysses_indivisible_kv_falls_back():
+    """kv_heads not divisible by the axis size: ulysses pre-repeats to the
+    full head count (the pre-GQA behavior) instead of failing — correct
+    output, full-head all-to-all cost."""
+    rng = np.random.default_rng(9)
+    b, s, h, kv, d = 1, 32, 8, 2, 16
+    q = rng.normal(size=(b, s, h, d)).astype(np.float32)
+    k = rng.normal(size=(b, s, kv, d)).astype(np.float32)
+    v = rng.normal(size=(b, s, kv, d)).astype(np.float32)
+    fn = make_sequence_parallel_attention(_mesh(4), kind="ulysses",
+                                          causal=True)
+    out = np.asarray(fn(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
+    expected = _reference_attention(q, np.repeat(k, h // kv, 2),
+                                    np.repeat(v, h // kv, 2), causal=True)
+    np.testing.assert_allclose(out, expected, rtol=2e-4, atol=2e-5)
