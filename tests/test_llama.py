@@ -275,6 +275,9 @@ def test_registry_roundtrip_and_cli(tmp_path):
         timeout=300)
     assert proc.returncode == 0, proc.stderr
     assert "tok/s" in proc.stdout
+    # baseline continuation for the DCN comparison below (same args)
+    want = [l for l in proc.stdout.splitlines() if "continuation" in l]
+    assert want
     # the runtime drivers treat llama as any token model (host + spmd)
     for comm in ("host", "spmd"):
         proc = subprocess.run(
@@ -285,3 +288,17 @@ def test_registry_roundtrip_and_cli(tmp_path):
             timeout=300)
         assert proc.returncode == 0, proc.stderr
         assert "latency_sec=" in proc.stdout, (comm, proc.stdout)
+    # DCN decode fleet (2 OS processes over TCP) == the local 2-stage
+    # pipeline (the `want` baseline above), token for token — the family
+    # dispatch covers the wire mode
+    from test_dcn_runtime import _run_fleet
+    opts = ["-m", MODEL, "-M", "test-tiny-llama.npz", "-pt", "1,4,5,8",
+            "-b", "2", "--prompt-len", "6", "--new-tokens", "5"]
+    data, _, _ = _run_fleet(
+        tmp_path, opts, world=2,
+        env_extra={"JAX_PLATFORMS": "cpu", "DCN_CONNECT_TIMEOUT": "20"},
+        script="tools/generate.py",
+        rank_argv=lambda rank, world: ["--rank", str(rank)])
+    assert data.returncode == 0, data.stdout + data.stderr
+    got = [l for l in data.stdout.splitlines() if "continuation" in l]
+    assert got == want, (got, want)
