@@ -42,6 +42,9 @@ class TransformerConfig:
     num_kv_heads: int = 0
     # rotary position embedding base (llama family)
     rope_theta: float = 10000.0
+    # sliding-window attention (Mistral-style): each position attends to
+    # the last `sliding_window` positions (incl. itself); 0 = full causal
+    sliding_window: int = 0
 
     @property
     def head_dim(self) -> int:

@@ -60,11 +60,24 @@ def _repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
     return x if n_rep == 1 else jnp.repeat(x, n_rep, axis=2)
 
 
-def _gqa_attend(q, k, v, cfg: TransformerConfig, keep=None) -> jax.Array:
+def _window_keep(keep: jax.Array, q_pos, cfg: TransformerConfig):
+    """Intersect a keep mask [S_q, S_k] with the sliding window: position
+    q attends to k in (q - window, q] (Mistral semantics — the last
+    `sliding_window` positions including itself). `q_pos` gives each
+    query row's absolute position; no-op when the window is off."""
+    if not cfg.sliding_window:
+        return keep
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, keep.shape, 1)
+    return keep & (k_pos > q_pos - cfg.sliding_window)
+
+
+def _gqa_attend(q, k, v, cfg: TransformerConfig, keep=None,
+                q_pos=None) -> jax.Array:
     """softmax(QK^T)V with GQA head repetition; `keep` optionally masks
-    key positions ([S_q, S_k], decode path), else causal. Delegates the
-    masked-softmax body to the decode subsystem's `_attend` — ONE copy of
-    the attention numerics for both consumers."""
+    key positions ([S_q, S_k], decode path — pass `q_pos` [S_q, 1] so the
+    sliding window can anchor to absolute positions), else causal (+
+    window). Delegates the masked-softmax body to the decode subsystem's
+    `_attend` — ONE copy of the attention numerics for both consumers."""
     from ..parallel.decode import _attend
 
     h = q.shape[2]
@@ -75,6 +88,8 @@ def _gqa_attend(q, k, v, cfg: TransformerConfig, keep=None) -> jax.Array:
         q_pos = jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 0)
         k_pos = jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 1)
         keep = k_pos <= q_pos
+    if q_pos is not None:
+        keep = _window_keep(keep, q_pos, cfg)
     return _attend(q, k, v, keep, cfg)
 
 
@@ -123,6 +138,14 @@ def finalize(p: Dict, hidden: jax.Array, cfg: TransformerConfig) -> jax.Array:
     return dense(p["head"], rms_norm(p["ln"], hidden, cfg.layer_norm_eps))
 
 
+def _abs_q_pos(pos, s: int, prefill: bool):
+    """Absolute query positions [S_q, 1] for the cached attention's
+    sliding-window anchor: the prompt rows at prefill, the single traced
+    `pos` at a decode step."""
+    return (jnp.arange(s)[:, None] if prefill
+            else jnp.asarray(pos).reshape(1, 1))
+
+
 def decode_embed(pe: Dict, tok: jax.Array, pos) -> jax.Array:
     """Single decode-step token embed [B, 1, D]: wte row only (RoPE puts
     the position into the attention rotation, not the embedding)."""
@@ -154,7 +177,8 @@ def cached_block_step(p: Dict, x, bcache, pos, cfg: TransformerConfig,
     q, k_new, v_new = _qkv_rope(p, normed, cfg, pos_ids)
     k, v, keep, bcache = _cache_update_and_read(
         bcache, k_new, v_new, pos, prefill, s, q.dtype)
-    ctx = _gqa_attend(q, k, v, cfg, keep=keep)
+    ctx = _gqa_attend(q, k, v, cfg, keep=keep,
+                      q_pos=_abs_q_pos(pos, s, prefill))
     return _block_tail(p, x, ctx, cfg), bcache
 
 
@@ -174,7 +198,8 @@ def tp_cached_block_step(p: Dict, x, bcache, pos, cfg: TransformerConfig,
         k, v, keep, bc = _cache_update_and_read(
             bcache, k_new, v_new, pos, prefill, x.shape[1], q.dtype)
         new_cache.update(bc)
-        return _gqa_attend(q, k, v, cfg, keep=keep)
+        return _gqa_attend(q, k, v, cfg, keep=keep,
+                           q_pos=_abs_q_pos(pos, x.shape[1], prefill))
 
     pos_ids = jnp.arange(x.shape[1]) if prefill else jnp.asarray(pos)[None]
     y = _tp_llama_block_local(p, x, cfg, axis, qkv_to_ctx=cache_attend,
@@ -200,6 +225,11 @@ def sp_prefill_block_step(p: Dict, x, bcache, cfg: TransformerConfig,
     local attend, so the inter-chip traffic keeps GQA's kv_heads/heads
     size advantage; the cache likewise gathers the UNREPEATED post-RoPE
     rows the per-token decode steps read."""
+    if cfg.sliding_window:
+        raise NotImplementedError(
+            "sequence-parallel prefill has no sliding-window core yet "
+            "(the ring/Ulysses causal masks are full-causal); prefill "
+            "Mistral-style models without sp_mesh")
     normed = rms_norm(p["ln_before"], x, cfg.layer_norm_eps)
     b, s_local, _ = x.shape
     idx = jax.lax.axis_index(axis)

@@ -71,12 +71,13 @@ def _gpt2(name, layers, weights, hidden, blocks, heads, inter,
 
 
 def _llama(name, layers, weights, hidden, blocks, heads, kv_heads, inter,
-           vocab, max_pos, theta=10000.0):
+           vocab, max_pos, theta=10000.0, window=0):
     return ModelEntry(name, layers, weights, llama_mod, TransformerConfig(
         model_type="llama", hidden_size=hidden, num_hidden_layers=blocks,
         num_attention_heads=heads, num_kv_heads=kv_heads,
         intermediate_size=inter, layer_norm_eps=1e-5, vocab_size=vocab,
-        max_position_embeddings=max_pos, rope_theta=theta))
+        max_position_embeddings=max_pos, rope_theta=theta,
+        sliding_window=window))
 
 
 _MODELS: Dict[str, ModelEntry] = {e.name: e for e in [
@@ -104,6 +105,10 @@ _MODELS: Dict[str, ModelEntry] = {e.name: e for e in [
            32, 11008, vocab=32000, max_pos=4096),
     _llama("meta-llama/Meta-Llama-3-8B", 128, "Llama-3-8B.npz", 4096, 32,
            32, 8, 14336, vocab=128256, max_pos=8192, theta=500000.0),
+    # Mistral = the llama block with sliding-window attention (identical
+    # HF state-dict layout; the window is a mask, not a weight change)
+    _llama("mistralai/Mistral-7B-v0.1", 128, "Mistral-7B.npz", 4096, 32,
+           32, 8, 14336, vocab=32000, max_pos=32768, window=4096),
     # tiny synthetic models for fast tests / CI (not in the reference's list)
     _vit("pipeedge/test-tiny-vit", 8, "test-tiny-vit.npz", 32, 2, 4, 64, 5,
          patch=4, img=16),
@@ -112,6 +117,8 @@ _MODELS: Dict[str, ModelEntry] = {e.name: e for e in [
           vocab=100, max_pos=64),
     _llama("pipeedge/test-tiny-llama", 8, "test-tiny-llama.npz", 32, 2, 4,
            2, 64, vocab=100, max_pos=64),
+    _llama("pipeedge/test-tiny-mistral", 8, "test-tiny-mistral.npz", 32, 2,
+           4, 2, 64, vocab=100, max_pos=64, window=4),
     # capacity_factor = n_experts -> no capacity drops: routing is then a
     # pure per-token top-1 gate, which is causal and batch-size-invariant,
     # so cached decode and split pipelines match the full forward exactly

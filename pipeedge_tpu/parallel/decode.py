@@ -652,6 +652,13 @@ def make_sp_prefill_fn(family, cfg: TransformerConfig,
         raise NotImplementedError(
             "sequence-parallel prefill does not cover MoE blocks "
             "(per-chunk routing would change capacity semantics)")
+    if cfg.sliding_window:
+        # fail at construction, not at the first traced prefill: neither
+        # sp core supports windowed masks (full-causal only)
+        raise NotImplementedError(
+            "sequence-parallel prefill has no sliding-window core yet "
+            "(the ring/Ulysses causal masks are full-causal); prefill "
+            "Mistral-style models without sp_mesh")
     fam_sp_block = getattr(family, "sp_prefill_block_step", None)
     if getattr(family, "position_dependent_attention", False) \
             and fam_sp_block is None:

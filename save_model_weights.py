@@ -42,15 +42,18 @@ def _hf_config_for(cfg):
                           vocab_size=cfg.vocab_size,
                           n_positions=cfg.max_position_embeddings)
     if cfg.model_type == "llama":
+        common = dict(kwargs, num_key_value_heads=cfg.kv_heads,
+                      vocab_size=cfg.vocab_size,
+                      max_position_embeddings=cfg.max_position_embeddings,
+                      rms_norm_eps=cfg.layer_norm_eps,
+                      rope_theta=cfg.rope_theta,
+                      tie_word_embeddings=False)
+        if cfg.sliding_window:
+            from transformers import MistralConfig
+            return MistralConfig(**common,
+                                 sliding_window=cfg.sliding_window)
         from transformers import LlamaConfig
-        return LlamaConfig(**kwargs,
-                           num_key_value_heads=cfg.kv_heads,
-                           vocab_size=cfg.vocab_size,
-                           max_position_embeddings=cfg.max_position_embeddings,
-                           rms_norm_eps=cfg.layer_norm_eps,
-                           rope_theta=cfg.rope_theta,
-                           attention_bias=False, mlp_bias=False,
-                           tie_word_embeddings=False)
+        return LlamaConfig(**common, attention_bias=False, mlp_bias=False)
     from transformers import BertConfig
     return BertConfig(**kwargs, vocab_size=cfg.vocab_size,
                       max_position_embeddings=cfg.max_position_embeddings,
@@ -67,7 +70,10 @@ def _hf_model(model_name: str, cfg, random_init: bool):
     elif cfg.model_type == "gpt2":
         from transformers import GPT2LMHeadModel as Cls
     elif cfg.model_type == "llama":
-        from transformers import LlamaForCausalLM as Cls
+        if cfg.sliding_window:
+            from transformers import MistralForCausalLM as Cls
+        else:
+            from transformers import LlamaForCausalLM as Cls
     elif cfg.num_labels > 0:
         from transformers import BertForSequenceClassification as Cls
     else:
