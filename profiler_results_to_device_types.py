@@ -1,93 +1,25 @@
-"""Convert profiler results into a scheduler-compatible device types YAML file.
+"""CLI: merge a profiler results file into a scheduler device_types.yml.
 
-Parity with /root/reference/profiler_results_to_device_types.py: appends a
-(dtype, batch_size)-keyed model profile to a named device type, creating the
-type (with required memory/bandwidth args) if new.
+Thin shim over pipeedge_tpu.sched.profiles (role parity with the
+reference's profiler_results_to_device_types.py; same flags, same output
+format — the (dtype, batch_size) pair keys a device type's model profiles).
 """
 import argparse
 import sys
 
-import yaml
-
-from pipeedge_tpu import sched
 from pipeedge_tpu.models import registry
-from pipeedge_tpu.sched import yaml_files, yaml_types
-
-
-def is_dev_type_compatible(device_types, dev_type_name, mem, bwdth) -> bool:
-    """Existing device type properties must not silently change."""
-    if mem is not None and device_types[dev_type_name]["mem_MB"] != mem:
-        print(f"Device type memory mismatch: "
-              f"{device_types[dev_type_name]['mem_MB']} != {mem}")
-        return False
-    if bwdth is not None and device_types[dev_type_name]["bw_Mbps"] != bwdth:
-        print(f"Device type bandwidth mismatch: "
-              f"{device_types[dev_type_name]['bw_Mbps']} != {bwdth}")
-        return False
-    return True
-
-
-def is_model_profile_match(model_profile, dtype, batch_size) -> bool:
-    """dtype+batch_size is the unique profile key ('float32' and
-    'torch.float32' are the same key — both schedulers normalize)."""
-    return sched.normalize_dtype(model_profile["dtype"]) == \
-        sched.normalize_dtype(dtype) and \
-        model_profile["batch_size"] == batch_size
-
-
-def save_device_types_yml(file, dev_type_name, mem, bwdth, model_name, dtype,
-                          batch_size, time_s, overwrite_model=False) -> bool:
-    """Save/extend a device types YAML file."""
-    device_types = yaml_files.yaml_device_types_load(file)
-    if dev_type_name in device_types:
-        if not is_dev_type_compatible(device_types, dev_type_name, mem, bwdth):
-            return False
-    else:
-        if mem is None:
-            print("New device type: must specify memory argument")
-            return False
-        if bwdth is None:
-            print("New device type: must specify bandwidth argument")
-            return False
-        device_types[dev_type_name] = yaml_types.yaml_device_type(mem, bwdth, {})
-
-    if device_types[dev_type_name]["model_profiles"] is None:
-        device_types[dev_type_name]["model_profiles"] = {}
-    model_profiles = device_types[dev_type_name]["model_profiles"]
-
-    ymp = yaml_types.yaml_model_profile(dtype, batch_size, time_s)
-    if model_name not in model_profiles:
-        model_profiles[model_name] = []
-    updated_in_place = False
-    for idx, model_profile in enumerate(model_profiles[model_name]):
-        if is_model_profile_match(model_profile, dtype, batch_size):
-            if overwrite_model:
-                print(f"Overwriting existing model profile: {file}: "
-                      f"{dev_type_name}: {model_name}: {model_profile}")
-                model_profiles[model_name][idx] = ymp
-                updated_in_place = True
-            else:
-                print(f"Model profile already exists: {file}: {dev_type_name}: "
-                      f"{model_name}: {model_profile}")
-                return False
-    if not updated_in_place:
-        model_profiles[model_name].append(ymp)
-
-    yaml_files.yaml_save(device_types, file)
-    return True
+from pipeedge_tpu.sched import profiles
 
 
 def main():
     parser = argparse.ArgumentParser(
-        description="Produce scheduler-compatible device types YAML file from "
-                    "profiling results",
+        description="Produce scheduler-compatible device types YAML file "
+                    "from profiling results",
         formatter_class=argparse.ArgumentDefaultsHelpFormatter)
-    parser.add_argument("dev_type", type=str, help="device type name")
-    parser.add_argument("-i", "--results-yml", type=str,
-                        default="profiler_results.yml",
+    parser.add_argument("dev_type", help="device type name")
+    parser.add_argument("-i", "--results-yml", default="profiler_results.yml",
                         help="profiler results input YAML file")
-    parser.add_argument("-o", "--dev-types-yml", type=str,
-                        default="device_types.yml",
+    parser.add_argument("-o", "--dev-types-yml", default="device_types.yml",
                         help="device types output YAML file")
     parser.add_argument("-dtm", "--dev-type-mem", type=int,
                         help="memory in MB (required if not already in "
@@ -100,31 +32,15 @@ def main():
                              "profile entries")
     args = parser.parse_args()
 
-    with open(args.results_yml, "r", encoding="utf-8") as yfile:
-        results = yaml.safe_load(yfile)
-
-    batch_size = results["batch_size"]
-    dtype = results["dtype"]
-    layers = results["layers"]
-    model_name = results["model_name"]
-    profile_data = results["profile_data"]
-    if model_name in registry.get_model_names():
-        exp_layers = registry.get_model_layers(model_name)
-        if layers != exp_layers:
-            print(f"Warning: expected and actual layer counts differ: "
-                  f"{exp_layers} != {layers}")
-    else:
-        print(f"Warning: cannot verify layer count for unknown model: "
-              f"{model_name}: {layers}")
-    if layers != len(profile_data):
-        print(f"Declared layer count does not match profile data count: "
-              f"{layers} != {len(profile_data)}")
-        sys.exit(1)
-    time_s = [r["time"] for r in profile_data]
-    if not save_device_types_yml(args.dev_types_yml, args.dev_type,
-                                 args.dev_type_mem, args.dev_type_bw,
-                                 model_name, dtype, batch_size, time_s,
-                                 overwrite_model=args.overwrite):
+    try:
+        results = profiles.ProfilerResults.load(
+            args.results_yml, known_layer_counts=registry.get_model_layers)
+        profiles.upsert_device_type(
+            args.dev_types_yml, args.dev_type, results,
+            mem_MB=args.dev_type_mem, bw_Mbps=args.dev_type_bw,
+            overwrite=args.overwrite)
+    except profiles.ProfileError as exc:
+        print(exc)
         sys.exit(1)
 
 

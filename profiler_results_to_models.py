@@ -1,30 +1,13 @@
-"""Convert profiler results into a scheduler-compatible models YAML file.
+"""CLI: project a profiler results file into a scheduler models.yml.
 
-Parity with /root/reference/profiler_results_to_models.py: parameters_in/out
-derived from recorded payload shapes (sum over the tuple payload of per-item
-element counts), mem_MB straight from the profile.
+Thin shim over pipeedge_tpu.sched.profiles (role parity with the
+reference's profiler_results_to_models.py; same flags, same output format).
 """
 import argparse
 import sys
 
-import numpy as np
-import yaml
-
 from pipeedge_tpu.models import registry
-from pipeedge_tpu.sched import yaml_files, yaml_types
-
-
-def save_models_yml(file, model_name, num_layers, parameters_in,
-                    parameters_out, mem, overwrite_model=False) -> bool:
-    """Save/extend a models YAML file; refuses to overwrite unless asked."""
-    models = yaml_files.yaml_models_load(file)
-    if model_name in models and not overwrite_model:
-        print(f"Model already exists: {file}: {model_name}")
-        return False
-    models[model_name] = yaml_types.yaml_model(num_layers, parameters_in,
-                                               parameters_out, mem)
-    yaml_files.yaml_save(models, file)
-    return True
+from pipeedge_tpu.sched import profiles
 
 
 def main():
@@ -32,43 +15,21 @@ def main():
         description="Produce scheduler-compatible models YAML file from "
                     "profiling results",
         formatter_class=argparse.ArgumentDefaultsHelpFormatter)
-    parser.add_argument("-i", "--results-yml", type=str,
-                        default="profiler_results.yml",
+    parser.add_argument("-i", "--results-yml", default="profiler_results.yml",
                         help="profiler results input YAML file")
-    parser.add_argument("-o", "--models-yml", type=str, default="models.yml",
+    parser.add_argument("-o", "--models-yml", default="models.yml",
                         help="models output YAML file")
     parser.add_argument("-f", "--overwrite", action="store_true",
                         help="overwrite existing YAML model entries")
     args = parser.parse_args()
 
-    with open(args.results_yml, "r", encoding="utf-8") as yfile:
-        results = yaml.safe_load(yfile)
-
-    layers = results["layers"]
-    model_name = results["model_name"]
-    profile_data = results["profile_data"]
-    if model_name in registry.get_model_names():
-        exp_layers = registry.get_model_layers(model_name)
-        if layers != exp_layers:
-            print(f"Warning: expected and actual layer counts differ: "
-                  f"{exp_layers} != {layers}")
-    else:
-        print(f"Warning: cannot verify layer count for unknown model: "
-              f"{model_name}: {layers}")
-    if layers != len(profile_data):
-        print(f"Declared layer count does not match profile data count: "
-              f"{layers} != {len(profile_data)}")
-        sys.exit(1)
-    if not profile_data:
-        print("Empty profile data!")
-        sys.exit(1)
-
-    parameters_in = int(sum(np.prod(s) for s in profile_data[0]["shape_in"]))
-    parameters_out = [int(sum(np.prod(s) for s in r["shape_out"]))
-                      for r in profile_data]
-    mem = [r["memory"] for r in profile_data]
-    if not save_models_yml(args.models_yml, model_name, layers, parameters_in,
-                           parameters_out, mem, overwrite_model=args.overwrite):
+    try:
+        results = profiles.ProfilerResults.load(
+            args.results_yml, known_layer_counts=registry.get_model_layers)
+        profiles.upsert_model(args.models_yml, results,
+                              overwrite=args.overwrite)
+    except profiles.ProfileError as exc:
+        print(exc)
         sys.exit(1)
 
 
