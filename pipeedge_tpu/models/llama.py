@@ -224,12 +224,11 @@ def sp_prefill_block_step(p: Dict, x, bcache, cfg: TransformerConfig,
     ride the ring ppermutes / all-to-alls and repeat only inside the
     local attend, so the inter-chip traffic keeps GQA's kv_heads/heads
     size advantage; the cache likewise gathers the UNREPEATED post-RoPE
-    rows the per-token decode steps read."""
-    if cfg.sliding_window:
-        raise NotImplementedError(
-            "sequence-parallel prefill has no sliding-window core yet "
-            "(the ring/Ulysses causal masks are full-causal); prefill "
-            "Mistral-style models without sp_mesh")
+    rows the per-token decode steps read. Sliding-window (Mistral)
+    configs need no handling here: make_sp_prefill_fn binds
+    cfg.sliding_window into `core`, and the cache gathers the full
+    post-RoPE rows — the per-token decode steps apply their own window
+    mask over the cache (_window_keep)."""
     normed = rms_norm(p["ln_before"], x, cfg.layer_norm_eps)
     b, s_local, _ = x.shape
     idx = jax.lax.axis_index(axis)

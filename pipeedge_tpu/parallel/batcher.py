@@ -53,6 +53,7 @@ class _Request:
     rng: jax.Array
     prompt_len: int
     eos_token: Optional[int] = None  # stop early once every row emitted it
+    pad_token: Optional[int] = None  # fills rows past their own eos
     rows_done: Optional[np.ndarray] = None   # [B] eos seen per row
     caches: Optional[List] = None    # per-stage cache slots (admission)
     tokens: List = field(default_factory=list)
@@ -105,22 +106,29 @@ class ContinuousBatcher:
 
     def submit(self, rid, ids, new_tokens: int, temperature: float = 0.0,
                top_k: int = 0, seed: int = 0,
-               eos_token: Optional[int] = None) -> None:
+               eos_token: Optional[int] = None,
+               pad_token: Optional[int] = None) -> None:
         """Queue a request. `ids` [B, S] is a prompt batch decoded in
         lockstep (B=1 for a single sequence); each distinct (B, S) shape
         compiles its own prefill program, shared across requests.
 
         `eos_token`: finish this request early — freeing its cache slots
         for the ready queue — once EVERY row of its batch has emitted the
-        token (`new_tokens` stays the hard cap; rows that finished first
-        keep decoding until the whole request stops, like HF generate
-        without a pad-out). The continuous-batching payoff: short answers
-        release capacity immediately instead of padding to the cap."""
+        token (`new_tokens` stays the hard cap). Rows that finished first
+        keep DECODING until the whole request stops, but their post-eos
+        tokens are masked with `pad_token` (default: the eos token, HF
+        generate's pad-after-eos convention) in the returned array, so
+        callers never consume a finished row's garbage continuation. The
+        continuous-batching payoff: short answers release capacity
+        immediately instead of padding to the cap."""
         if rid in self.results or rid in self._live_rids:
             raise ValueError(f"duplicate request id {rid!r}")
         ids = jnp.asarray(ids, jnp.int32)
         if new_tokens < 1:
             raise ValueError(f"new_tokens must be >= 1, got {new_tokens}")
+        if pad_token is not None and eos_token is None:
+            raise ValueError("pad_token only applies with eos_token (rows "
+                             "are padded after their own eos)")
         validate_capacity(self.pipe.cfg, self.pipe.max_len, ids.shape[1],
                           new_tokens)
         self._live_rids.add(rid)
@@ -128,7 +136,8 @@ class ContinuousBatcher:
             rid=rid, ids=ids, new_tokens=new_tokens,
             pick=make_token_picker(temperature, top_k),
             rng=jax.random.PRNGKey(seed), prompt_len=ids.shape[1],
-            eos_token=eos_token))
+            eos_token=eos_token,
+            pad_token=eos_token if pad_token is None else pad_token))
 
     def _admit(self) -> None:
         while self.pending and self.active < self.max_active:
@@ -161,10 +170,17 @@ class ContinuousBatcher:
             reentries.append((req, token[:, None], False))
 
     def _complete(self, req: _Request) -> None:
+        toks = np.stack([np.asarray(t) for t in req.tokens], axis=1)  # [B, T]
+        if req.eos_token is not None:
+            # rows that hit eos before the request stopped kept decoding
+            # in lockstep; mask everything strictly after each row's
+            # first eos so no garbage continuation reaches the caller
+            seen = np.cumsum(toks == req.eos_token, axis=1) > 0
+            after = np.concatenate(
+                [np.zeros_like(seen[:, :1]), seen[:, :-1]], axis=1)
+            toks = np.where(after, req.pad_token, toks)
         self.results[req.rid] = np.concatenate(
-            [np.asarray(req.ids),
-             np.stack([np.asarray(t) for t in req.tokens], axis=1)],
-            axis=1)
+            [np.asarray(req.ids), toks], axis=1)
         req.caches = None            # free this request's cache slots
         self.active -= 1
         self._live_rids.discard(req.rid)

@@ -634,10 +634,13 @@ def make_sp_prefill_fn(family, cfg: TransformerConfig,
     the long-context bottleneck — runs with activations sequence-sharded
     over `axis` and an exact causal attention core per block chosen by
     `sp_kind` (parallel/sequence.py::resolve_sp_core — 'ring' streams K/V
-    chunks via ppermute with blockwise softmax, the long-context choice;
-    'ulysses' all-to-all reshards heads<->sequence but materializes full
-    [S, S] scores per local head group and requires heads divisible by the
-    sp degree). Each block's K/V rows are all-gathered into the stage
+    chunks via ppermute with blockwise softmax and skips ring steps
+    outside a sliding window, the long-context choice; 'ulysses'
+    all-to-all reshards heads<->sequence with blockwise local attention
+    and requires heads divisible by the sp degree). Sliding-window
+    families (Mistral) bind cfg.sliding_window into the core, so sp
+    prefill is windowed exactly like the non-sp path. Each block's K/V
+    rows are all-gathered into the stage
     cache, which comes back replicated so the per-token decode steps run
     unchanged. Stage edges carry only the local sequence chunk.
 
@@ -652,13 +655,6 @@ def make_sp_prefill_fn(family, cfg: TransformerConfig,
         raise NotImplementedError(
             "sequence-parallel prefill does not cover MoE blocks "
             "(per-chunk routing would change capacity semantics)")
-    if cfg.sliding_window:
-        # fail at construction, not at the first traced prefill: neither
-        # sp core supports windowed masks (full-causal only)
-        raise NotImplementedError(
-            "sequence-parallel prefill has no sliding-window core yet "
-            "(the ring/Ulysses causal masks are full-causal); prefill "
-            "Mistral-style models without sp_mesh")
     fam_sp_block = getattr(family, "sp_prefill_block_step", None)
     if getattr(family, "position_dependent_attention", False) \
             and fam_sp_block is None:
@@ -668,7 +664,11 @@ def make_sp_prefill_fn(family, cfg: TransformerConfig,
             "supplies no sp_prefill_block_step hook to pre-rotate at "
             "global chunk positions)")
     n = mesh.shape[axis]
-    core = resolve_sp_core(sp_kind, cfg.num_attention_heads, n)
+    # Mistral-style models bind their sliding window into the core: the
+    # ring schedule then SKIPS K/V blocks wholly behind every local
+    # query's window (sequence.py::ring_attention n_steps bound)
+    core = resolve_sp_core(sp_kind, cfg.num_attention_heads, n,
+                           window=cfg.sliding_window or None)
 
     def cache_gather(bcache, k_new, v_new):
         """All-gather this chunk's K/V rows into the (replicated) stage

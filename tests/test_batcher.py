@@ -177,6 +177,32 @@ def test_eos_early_stop_frees_slot_for_pending(tiny_pipe):
             results[i], np.asarray(tiny_pipe.generate(prompts[i], cap)))
 
 
+def test_eos_multirow_masks_post_eos_tokens(tiny_pipe):
+    """In a multi-row request, a row that hits eos early keeps decoding in
+    lockstep until the whole request stops — but its post-eos tokens come
+    back masked (default pad = eos; explicit pad_token honored), so
+    callers never see the lockstep rows' garbage continuations."""
+    rng = np.random.default_rng(53)
+    ids = rng.integers(0, 100, size=(2, 4))
+    cap = 8
+    solo = np.asarray(tiny_pipe.generate(ids, cap))
+    gen = solo[:, ids.shape[1]:]                       # [2, cap]
+    # choose row 0's 2nd token as eos; make sure row 1 emits it later (or
+    # never at the same step), so the request keeps running after row 0
+    eos = int(gen[0, 1])
+    first = [int(np.argmax(g == eos)) if eos in g else cap for g in gen]
+    assert first[0] < first[1], "fixture rows stopped in the same step"
+
+    batcher = ContinuousBatcher(tiny_pipe)
+    batcher.submit("r", ids, new_tokens=cap, eos_token=eos, pad_token=77)
+    out = batcher.run()["r"][:, ids.shape[1]:]
+    stop = min(max(first) + 1, cap)                    # request length
+    for b in range(2):
+        row_stop = min(first[b] + 1, stop)
+        np.testing.assert_array_equal(out[b, :row_stop], gen[b, :row_stop])
+        assert (out[b, row_stop:stop] == 77).all(), (b, out[b])
+
+
 def test_devices_placement_composes(tiny_pipe):
     """Stage-per-device placement (the host pipeline's deployment shape)
     composes with the batcher: results still solo-identical."""

@@ -369,9 +369,38 @@ def test_mistral_greedy_decode_matches_hf_generate(mistral_setup):
         mesh=Mesh(np.asarray(jax.devices()[:2]), ("tp",)))
     np.testing.assert_array_equal(
         np.asarray(tp_pipe.generate(ids, new_tokens=8)), got)
-    # sp prefill refuses the window (full-causal ring core)
-    with pytest.raises(NotImplementedError, match="sliding-window"):
+    # sp prefill binds the window into the ring core (global-position
+    # anchored masks; out-of-window K/V blocks skipped) — token-identical
+    # to the non-sp pipeline, which itself matched HF generate above
+    for kind in ("ring", "ulysses"):
         sp_pipe = decode.DecodePipeline(
             llama_mod.FAMILY, cfg, partition, sp, max_len=32,
-            sp_mesh=Mesh(np.asarray(jax.devices()[:2]), ("sp",)))
-        sp_pipe.generate(ids[:, :6], new_tokens=2)
+            sp_mesh=Mesh(np.asarray(jax.devices()[:2]), ("sp",)),
+            sp_kind=kind)
+        sp_got = np.asarray(sp_pipe.generate(ids[:, :6], new_tokens=8))
+        want6 = np.asarray(pipe.generate(ids[:, :6], new_tokens=8))
+        np.testing.assert_array_equal(sp_got, want6)
+
+
+@pytest.mark.slow
+def test_mistral_sp_prefill_long_prompt(mistral_setup):
+    """Long-prompt windowed sp prefill: prompt length (16) is 4x the
+    sliding window (4) over a 4-chip sp mesh (chunk=4), so whole K/V
+    blocks fall outside every window (_ring_steps(4, 4, 4) == 2 of 4)
+    and the ring must still be token-identical to the plain pipeline."""
+    from pipeedge_tpu.parallel.sequence import _ring_steps
+    cfg, weights, _ = mistral_setup
+    assert _ring_steps(4, 4, cfg.sliding_window) == 2
+    total = 4 * cfg.num_hidden_layers
+    sp = [llama_mod.load_params(
+        cfg, ShardConfig(1, total, is_first=True, is_last=True), weights)]
+    pipe = decode.DecodePipeline(llama_mod.FAMILY, cfg, [(1, total)], sp,
+                                 max_len=32)
+    ids = np.random.default_rng(37).integers(0, cfg.vocab_size, size=(2, 16))
+    want = np.asarray(pipe.generate(ids, new_tokens=6))
+    from jax.sharding import Mesh
+    sp_pipe = decode.DecodePipeline(
+        llama_mod.FAMILY, cfg, [(1, total)], sp, max_len=32,
+        sp_mesh=Mesh(np.asarray(jax.devices()[:4]), ("sp",)))
+    got = np.asarray(sp_pipe.generate(ids, new_tokens=6))
+    np.testing.assert_array_equal(got, want)

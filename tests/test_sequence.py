@@ -6,15 +6,20 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
-from pipeedge_tpu.parallel.sequence import make_sequence_parallel_attention
+from pipeedge_tpu.parallel.sequence import (
+    _ring_steps, make_sequence_parallel_attention)
 
 
-def _reference_attention(q, k, v, causal=False):
+def _reference_attention(q, k, v, causal=False, window=None):
     d = q.shape[-1]
     scores = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
-    if causal:
+    if causal or window is not None:
         s = q.shape[1]
         mask = np.tril(np.ones((s, s), bool))
+        if window is not None:
+            # Mistral semantics: q attends to k in (q - window, q]
+            pos = np.arange(s)
+            mask &= pos[None, :] > pos[:, None] - window
         scores = np.where(mask[None, None], scores, -np.inf)
     scores = scores - scores.max(axis=-1, keepdims=True)
     probs = np.exp(scores)
@@ -82,6 +87,100 @@ def test_gqa_kv_heads_match_full_attention(kind):
     expected = _reference_attention(q, np.repeat(k, h // kv, 2),
                                     np.repeat(v, h // kv, 2), causal=True)
     np.testing.assert_allclose(out, expected, rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("kind", ["ring", "ulysses"])
+@pytest.mark.parametrize("window", [1, 3, 8, 17, 64])
+def test_sliding_window_matches_full_attention(kind, window):
+    """Windowed causal attention (Mistral semantics: k in (q - window, q])
+    matches the masked reference for windows smaller than, equal to, and
+    larger than the per-chip chunk (s/n = 8) — so both the mask math and
+    the ring's step-skipping are exercised."""
+    rng = np.random.default_rng(11)
+    b, s, h, d = 2, 64, 8, 16
+    q = rng.normal(size=(b, s, h, d)).astype(np.float32)
+    k = rng.normal(size=(b, s, h, d)).astype(np.float32)
+    v = rng.normal(size=(b, s, h, d)).astype(np.float32)
+    fn = make_sequence_parallel_attention(_mesh(8), kind=kind, causal=True,
+                                          window=window)
+    out = np.asarray(fn(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
+    expected = _reference_attention(q, k, v, causal=True, window=window)
+    np.testing.assert_allclose(out, expected, rtol=2e-4, atol=2e-5)
+
+
+def test_sliding_window_gqa_ring():
+    """Window + GQA compose in the ring core (the Mistral sp-prefill
+    shape: fewer kv heads AND a window anchored to global positions)."""
+    rng = np.random.default_rng(13)
+    b, s, h, kv, d = 1, 64, 8, 4, 16
+    q = rng.normal(size=(b, s, h, d)).astype(np.float32)
+    k = rng.normal(size=(b, s, kv, d)).astype(np.float32)
+    v = rng.normal(size=(b, s, kv, d)).astype(np.float32)
+    fn = make_sequence_parallel_attention(_mesh(4), kind="ring", causal=True,
+                                          window=5)
+    out = np.asarray(fn(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
+    expected = _reference_attention(q, np.repeat(k, h // kv, 2),
+                                    np.repeat(v, h // kv, 2), causal=True,
+                                    window=5)
+    np.testing.assert_allclose(out, expected, rtol=2e-4, atol=2e-5)
+
+
+def test_ring_window_skips_out_of_window_blocks():
+    """The ring runs only the steps whose K/V block can intersect some
+    query's window: the skipped blocks are never computed or rotated."""
+    assert _ring_steps(8, 8, 1) == 1        # self-attention only
+    # window 8, chunk 8: a chunk-boundary query reaches 7 keys into the
+    # previous block -> resident + 1 predecessor
+    assert _ring_steps(8, 8, 8) == 2
+    assert _ring_steps(8, 8, 9) == 2        # max distance 8 still fits t=1
+    assert _ring_steps(8, 8, 10) == 3       # distance 9 first needs t=2
+    assert _ring_steps(8, 8, 17) == 3
+    assert _ring_steps(8, 8, None) == 8     # no window: full ring
+    assert _ring_steps(8, 8, 10**9) == 8    # huge window: capped at n
+    # the Mistral shape: 4k window, 128k prompt over 8 chips (16k chunks)
+    assert _ring_steps(8, 16384, 4096) == 2
+
+
+def test_window_requires_causal():
+    with pytest.raises(ValueError, match="requires causal"):
+        make_sequence_parallel_attention(_mesh(4), kind="ring", causal=False,
+                                         window=4)(
+            jnp.zeros((1, 16, 4, 8)), jnp.zeros((1, 16, 4, 8)),
+            jnp.zeros((1, 16, 4, 8)))
+
+
+@pytest.mark.slow
+def test_ulysses_blockwise_no_full_score_materialization():
+    """Ulysses runs blockwise local attention after the all-to-all: peak
+    temp memory must stay well under the full [S, S] float32 score
+    matrix a naive local softmax would materialize per head group."""
+    mesh = _mesh(8)
+    b, s, h, d = 1, 2048, 8, 16
+    fn_builder = make_sequence_parallel_attention(mesh, kind="ulysses",
+                                                  causal=True)
+    # reach the underlying jitted fn to lower without executing
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    from pipeedge_tpu.parallel.sequence import resolve_sp_core
+    spec = P(None, "sp")
+    inner = resolve_sp_core("ulysses")
+    f = jax.jit(jax.shard_map(partial(inner, axis_name="sp", causal=True),
+                              mesh=mesh, in_specs=(spec,) * 3,
+                              out_specs=spec, check_vma=False))
+    x = jnp.zeros((b, s, h, d), jnp.float32)
+    mem = f.lower(x, x, x).compile().memory_analysis()
+    full_scores_bytes = s * s * 4              # [1, h/n=1, S, S] f32
+    assert mem.temp_size_in_bytes < full_scores_bytes / 2, (
+        f"temp {mem.temp_size_in_bytes} vs full-score "
+        f"{full_scores_bytes} — blockwise regressed to [S,S]?")
+    # sanity: ring at the same shape has the same memory scale
+    ring = jax.jit(jax.shard_map(
+        partial(resolve_sp_core("ring"), axis_name="sp", causal=True),
+        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False))
+    ring_mem = ring.lower(x, x, x).compile().memory_analysis()
+    assert mem.temp_size_in_bytes < 4 * ring_mem.temp_size_in_bytes
 
 
 def test_gqa_ulysses_indivisible_kv_falls_back():

@@ -182,10 +182,10 @@ def run_spmd_wave(args, cfg, partition, stage_params, max_len, dtype):
     wave = SpmdDecodePipeline(registry.get_model_entry(
         args.model_name).family.FAMILY, cfg, partition, stage_params,
         mesh, max_len=max_len, dtype=dtype, edge_bits=args.edge_bits)
-    wave_ids = np.stack([
-        np.random.default_rng(r).integers(
-            0, cfg.vocab_size, size=(args.batch_size, args.prompt_len))
-        for r in range(n_stages)])
+    # same prompt convention as solo/--concurrent runs (one prompt_ids()
+    # prompt per request slot, per-slot sampling seeds seed+r), so wave
+    # throughput and continuations are comparable across demo modes
+    wave_ids = np.stack([prompt_ids(args, cfg)] * n_stages)
     kw = dict(temperature=args.temperature, top_k=args.top_k,
               seeds=[args.seed + r for r in range(n_stages)])
     # warm with the SAME token budget: new_tokens sizes the compiled
