@@ -156,9 +156,8 @@ def test_ulysses_blockwise_no_full_score_materialization():
     matrix a naive local softmax would materialize per head group."""
     mesh = _mesh(8)
     b, s, h, d = 1, 2048, 8, 16
-    fn_builder = make_sequence_parallel_attention(mesh, kind="ulysses",
-                                                  causal=True)
-    # reach the underlying jitted fn to lower without executing
+    # build the shard_map'd core directly so it can be lowered (compiled
+    # memory analysis) without executing
     from functools import partial
 
     from jax.sharding import PartitionSpec as P
