@@ -644,17 +644,24 @@ def make_sp_prefill_fn(family, cfg: TransformerConfig,
     cache, which comes back replicated so the per-token decode steps run
     unchanged. Stage edges carry only the local sequence chunk.
 
-    Requires a block-aligned dense stage (MoE refuses: routing a local
-    chunk changes capacity semantics) and prompt length divisible by the
-    sp degree."""
+    Requires a block-aligned stage and prompt length divisible by the sp
+    degree. MoE stages are covered when routing is droppless
+    (capacity_factor >= n_experts — then routing is a per-token gate and
+    chunk-local execution is exact); capacity-bounded MoE refuses."""
     from jax.sharding import PartitionSpec as P
 
     from .sequence import resolve_sp_core
 
-    if cfg.n_experts:
+    if cfg.n_experts and cfg.capacity_factor < cfg.n_experts:
+        # droppless MoE (capacity_factor >= n_experts) routes as a pure
+        # per-token gate, so chunk-local routing is exact and the default
+        # block path below covers it; a capacity-BOUNDED router competes
+        # tokens for expert slots across the whole sequence, which
+        # chunk-local capacity cannot reproduce
         raise NotImplementedError(
-            "sequence-parallel prefill does not cover MoE blocks "
-            "(per-chunk routing would change capacity semantics)")
+            "sequence-parallel prefill covers droppless MoE only "
+            "(capacity_factor >= n_experts); capacity-bounded routing "
+            "is sequence-global and would change drop semantics per chunk")
     fam_sp_block = getattr(family, "sp_prefill_block_step", None)
     if getattr(family, "position_dependent_attention", False) \
             and fam_sp_block is None:

@@ -256,3 +256,36 @@ def test_moe_save_weights_roundtrip(tmp_path, monkeypatch):
                       jnp.int32)
     out = np.asarray(fn(params, ids))
     assert out.shape == (2, 7, 100) and np.all(np.isfinite(out))
+
+
+@pytest.mark.slow
+def test_moe_sp_prefill_matches_plain(moe_setup):
+    """Droppless MoE (capacity_factor == n_experts, routing a pure
+    per-token gate) supports sequence-parallel prefill: chunk-local
+    routing is exact, so tokens match the plain pipeline. A
+    capacity-BOUNDED MoE config still refuses (chunk-local capacity
+    changes drop semantics)."""
+    import dataclasses
+
+    cfg, weights = moe_setup
+    assert cfg.capacity_factor >= cfg.n_experts
+    partition = [(1, 4), (5, 8)]
+    stage_params = [_shard(cfg, weights, l, r)[0] for l, r in partition]
+    plain = decode.DecodePipeline(gpt2_mod.FAMILY, cfg, partition,
+                                  stage_params, max_len=16)
+    ids = np.random.default_rng(21).integers(0, 100, size=(2, 6))
+    want = np.asarray(plain.generate(ids, 5))
+
+    sp_mesh = Mesh(np.asarray(jax.devices()[:2]), ("sp",))
+    sp_pipe = decode.DecodePipeline(gpt2_mod.FAMILY, cfg, partition,
+                                    stage_params, max_len=16,
+                                    sp_mesh=sp_mesh)
+    got = np.asarray(sp_pipe.generate(ids, 5))
+    np.testing.assert_array_equal(got, want)
+
+    bounded = dataclasses.replace(cfg, capacity_factor=1.25)
+    with pytest.raises(NotImplementedError, match="droppless"):
+        bounded_pipe = decode.DecodePipeline(
+            gpt2_mod.FAMILY, bounded, partition, stage_params, max_len=16,
+            sp_mesh=sp_mesh)
+        bounded_pipe.generate(ids, 2)

@@ -69,9 +69,15 @@ def run_config(n: int, platform: str, dtype: str) -> dict:
         cmd += ["--platform", platform]
     env = dict(os.environ, PYTHONPATH=REPO, **spec.get("env", {}))
     tik = time.monotonic()
+    # Explicit-CPU runs get a watchdog; anything else (including the
+    # implicit default, which resolves to the chip wherever the axon
+    # plugin is installed) gets NONE — killing or abandoning a mid-RPC
+    # TPU client wedges the single-tenant tunnel lease for hours
+    # (docs/PERF.md), so chip runs wait however long backend init takes.
     try:
-        proc = subprocess.run(cmd, capture_output=True, text=True, env=env,
-                              timeout=1800)
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, env=env,
+            timeout=1800 if platform == "cpu" else None)
     except subprocess.TimeoutExpired:
         return {"config": n, "desc": spec["desc"], "rc": "timeout",
                 "wall_s": round(time.monotonic() - tik, 1)}
