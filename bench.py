@@ -88,55 +88,12 @@ def _model_flops_per_image(cfg) -> float:
     return l * per_block + embed + head
 
 
-def _require_live_backend(timeout_s: float = 180.0) -> None:
-    """Fail fast (with a diagnosable JSON line) if the backend cannot run a
-    trivial computation within `timeout_s` — a wedged/held tunnel lease
-    otherwise hangs the whole bench with no output.
-
-    The probe runs in a SUBPROCESS, not a thread: on timeout the parent
-    prints the error record and exits without having initialized its own
-    backend, and the child is left alone (never signaled) so it remains a
-    well-behaved client that completes or fails cleanly whenever the backend
-    answers. Killing or abandoning a mid-RPC client is exactly what wedges
-    the single-tenant tunnel lease (docs/PERF.md round-2 addendum), so the
-    diagnostic must never do either."""
-    import subprocess
-    import sys
-
-    # Honor an explicit JAX_PLATFORMS in the child: the TPU plugin overrides
-    # the env var, so it must be forced via jax.config (utils.apply_env_platform
-    # semantics, inlined so the probe works from any cwd).
-    probe_src = (
-        "import os, jax\n"
-        "p = os.environ.get('JAX_PLATFORMS')\n"
-        "if p: jax.config.update('jax_platforms', p)\n"
-        "import jax.numpy as jnp\n"
-        "float(jnp.ones((2, 2)).sum())\n")
-    probe = subprocess.Popen(
-        [sys.executable, "-c", probe_src],
-        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
-    try:
-        _, err = probe.communicate(timeout=timeout_s)
-        if probe.returncode == 0:
-            return
-        tail = err.decode(errors="replace").strip().splitlines()
-        reason = tail[-1] if tail else f"probe exited {probe.returncode}"
-    except subprocess.TimeoutExpired:
-        # Deliberately do NOT kill the probe: it finishes on its own when
-        # the backend unwedges, keeping this diagnostic lease-neutral.
-        reason = (f"backend unresponsive after {timeout_s}s (TPU tunnel "
-                  "lease held/wedged?); probe left running, not signaled")
-    print(json.dumps({
-        "metric": "vit_large_images_per_sec_b8", "value": 0,
-        "unit": "images/sec", "vs_baseline": 0,
-        "error": reason}), flush=True)
-    raise SystemExit(1)
-
-
 def main():
     from pipeedge_tpu.models import registry
+    from pipeedge_tpu.utils import require_live_backend
 
-    _require_live_backend()
+    # lease-neutral wedge diagnostic (shared with bench_decode.py)
+    require_live_backend("vit_large_images_per_sec_b8", unit="images/sec")
     name = "google/vit-large-patch16-224"
     cfg = registry.get_model_entry(name).config
     fn, params, _ = registry.module_shard_factory(

@@ -53,13 +53,7 @@ def bench_pipe(pipe, ids, new_tokens, prefill_ubatch=None):
 
 
 def main():
-    from pipeedge_tpu.utils import apply_env_platform
-    apply_env_platform()
-    import jax.numpy as jnp
-    import numpy as np
-
-    from pipeedge_tpu.models import registry
-    from pipeedge_tpu.parallel import decode
+    from pipeedge_tpu.utils import apply_env_platform, require_live_backend
 
     p = argparse.ArgumentParser(
         description=__doc__.splitlines()[0],
@@ -73,13 +67,26 @@ def main():
     p.add_argument("-t", "--dtype", default="bfloat16",
                    choices=["float32", "bfloat16"])
     args = p.parse_args()
+    batches = sorted(int(b) for b in args.batches.split(","))
+
+    apply_env_platform()
+    # lease-neutral wedge diagnostic: fail fast with an attributable JSON
+    # record (same metric key the success record carries) instead of
+    # hanging when the TPU tunnel lease is held
+    require_live_backend(
+        f"{args.model_name}_decode_tokens_per_sec_b{batches[-1]}",
+        unit="tokens/sec")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pipeedge_tpu.models import registry
+    from pipeedge_tpu.parallel import decode
 
     cfg = registry.get_model_config(args.model_name)
     total = registry.get_model_layers(args.model_name)
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     max_len = args.prompt_len + args.new_tokens
     decode.validate_capacity(cfg, max_len, args.prompt_len, args.new_tokens)
-    batches = sorted(int(b) for b in args.batches.split(","))
 
     _, params, _ = registry.module_shard_factory(
         args.model_name, None, 1, total, dtype=dtype, unroll=False)

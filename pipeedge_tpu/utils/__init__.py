@@ -49,3 +49,50 @@ def force_host_cpu_devices(n: int) -> None:
             jax.config.update("jax_platforms", "cpu")
         except Exception:  # backend already initialized; use what we have
             pass
+
+
+def require_live_backend(metric: str, unit: str = None,
+                         timeout_s: float = 180.0) -> None:
+    """Fail fast (with a diagnosable JSON line) if the default backend
+    cannot run a trivial computation within `timeout_s` — a wedged/held
+    TPU tunnel lease otherwise hangs the caller with no output.
+
+    The probe runs in a SUBPROCESS, not a thread: on timeout the parent
+    prints an error record `{"metric": ..., "value": 0, ...}` and exits 1
+    WITHOUT having initialized its own backend, and the child is left
+    alone (never signaled) so it remains a well-behaved client that
+    completes or fails cleanly whenever the backend answers. Killing or
+    abandoning a mid-RPC client is exactly what wedges the single-tenant
+    tunnel lease (docs/PERF.md), so the diagnostic must never do either.
+    """
+    import json
+    import subprocess
+    import sys
+
+    # Honor an explicit JAX_PLATFORMS in the child: the TPU plugin
+    # overrides the env var, so it must be forced via jax.config
+    # (apply_env_platform semantics, inlined so the probe is cwd-free).
+    probe_src = (
+        "import os, jax\n"
+        "p = os.environ.get('JAX_PLATFORMS')\n"
+        "if p: jax.config.update('jax_platforms', p)\n"
+        "import jax.numpy as jnp\n"
+        "float(jnp.ones((2, 2)).sum())\n")
+    probe = subprocess.Popen(
+        [sys.executable, "-c", probe_src],
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+    try:
+        _, err = probe.communicate(timeout=timeout_s)
+        if probe.returncode == 0:
+            return
+        tail = err.decode(errors="replace").strip().splitlines()
+        reason = tail[-1] if tail else f"probe exited {probe.returncode}"
+    except subprocess.TimeoutExpired:
+        # Deliberately do NOT kill the probe: it finishes on its own when
+        # the backend unwedges, keeping this diagnostic lease-neutral.
+        reason = (f"backend unresponsive after {timeout_s}s (TPU tunnel "
+                  "lease held/wedged?); probe left running, not signaled")
+    print(json.dumps({
+        "metric": metric, "value": 0, "unit": unit, "vs_baseline": 0,
+        "error": reason}), flush=True)
+    raise SystemExit(1)
