@@ -243,6 +243,10 @@ class DistDcnContext(DistContext):
         self._dead: set = set()
         self._dead_lock = threading.Lock()
         self._peer_death_handler: Optional[Callable[[int], None]] = None
+        # peers whose listener answered at least once (dialed out or dialed
+        # us): a later connection-REFUSED from one of these is a death
+        # signal, not a still-starting listener (_ensure_conn fast path)
+        self._ever_connected: set = set()
         # send/recv measurement hooks (reference p2p:132-152): pre fires just
         # before the payload moves, post just after, so (post - pre) is the
         # actual wire transfer time — excluding idle waits for data to exist.
@@ -307,6 +311,9 @@ class DistDcnContext(DistContext):
         self._reader_threads = []
         self._recv_queues = {}
         self._dead = set()
+        # forget which peers were ever up: a relaunched fleet's listeners
+        # get the full rendezvous budget again, not the fast-refusal path
+        self._ever_connected = set()
         host, port = self._rank_addrs[self._rank]
         self._listener = socket.create_server((host, port), backlog=8,
                                               reuse_port=False)
@@ -376,6 +383,8 @@ class DistDcnContext(DistContext):
             if msg_type != _MSG_HELLO:
                 logger.error("peer spoke before HELLO; dropping connection")
                 return
+            with self._conns_lock:
+                self._ever_connected.add(src)
             while not self._stop.is_set():
                 msg_type, aux, channel, n_tensors = _recv_header(conn)
                 hooked = (msg_type == _MSG_TENSORS
@@ -428,7 +437,14 @@ class DistDcnContext(DistContext):
         connections until the deadline (CONNECT_TIMEOUT default) so
         simultaneously-launched ranks can dial peers whose listeners aren't
         up yet (the role of the reference's process-group rendezvous,
-        p2p:62)."""
+        p2p:62).
+
+        Fast peer-death path: once a peer has EVER been dialed
+        successfully, fresh connection-REFUSED errors mean its listener is
+        gone (the process died — restarts rebind within ~1 s), so the
+        retry loop gives up after a short grace instead of burning the
+        full startup budget. This is what bounds fleet abort latency when
+        a rank dies before data flows (test_peer_death_aborts_fleet)."""
         if conns is None:
             conns = self._conns
         conn = conns.get(dst)
@@ -437,6 +453,8 @@ class DistDcnContext(DistContext):
         host, port = self._rank_addrs[dst]
         deadline = time.monotonic() + (self.CONNECT_TIMEOUT
                                        if timeout is None else timeout)
+        was_up = dst in self._ever_connected
+        refused_since = None
         while True:
             try:
                 # per-attempt timeout clamped to the remaining budget, so a
@@ -444,15 +462,23 @@ class DistDcnContext(DistContext):
                 attempt = min(5.0, max(0.1, deadline - time.monotonic()))
                 conn = socket.create_connection((host, port), timeout=attempt)
                 break
-            except OSError:
+            except OSError as exc:
                 if self._stop.is_set() or time.monotonic() >= deadline:
                     raise
+                if was_up and isinstance(exc, ConnectionRefusedError):
+                    now = time.monotonic()
+                    refused_since = refused_since or now
+                    if now - refused_since > 2.0:
+                        raise   # listener stayed gone: the peer is dead
+                else:
+                    refused_since = None
                 time.sleep(0.2)
         conn.settimeout(None)
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         _send_frame(conn, _MSG_HELLO, self._rank, ())
         with self._conns_lock:
             conns[dst] = conn
+            self._ever_connected.add(dst)
         return conn
 
     def send_tensors(self, dst: int, tensors: Sequence[np.ndarray],

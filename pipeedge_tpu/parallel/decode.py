@@ -645,7 +645,7 @@ def make_sp_prefill_fn(family, cfg: TransformerConfig,
     unchanged. Stage edges carry only the local sequence chunk.
 
     Requires a block-aligned stage and prompt length divisible by the sp
-    degree. MoE stages are covered when routing is droppless
+    degree. MoE stages are covered when routing is dropless
     (capacity_factor >= n_experts — then routing is a per-token gate and
     chunk-local execution is exact); capacity-bounded MoE refuses."""
     from jax.sharding import PartitionSpec as P
@@ -653,13 +653,13 @@ def make_sp_prefill_fn(family, cfg: TransformerConfig,
     from .sequence import resolve_sp_core
 
     if cfg.n_experts and cfg.capacity_factor < cfg.n_experts:
-        # droppless MoE (capacity_factor >= n_experts) routes as a pure
+        # dropless MoE (capacity_factor >= n_experts) routes as a pure
         # per-token gate, so chunk-local routing is exact and the default
         # block path below covers it; a capacity-BOUNDED router competes
         # tokens for expert slots across the whole sequence, which
         # chunk-local capacity cannot reproduce
         raise NotImplementedError(
-            "sequence-parallel prefill covers droppless MoE only "
+            "sequence-parallel prefill covers dropless MoE only "
             "(capacity_factor >= n_experts); capacity-bounded routing "
             "is sequence-global and would change drop semantics per chunk")
     fam_sp_block = getattr(family, "sp_prefill_block_step", None)

@@ -29,6 +29,7 @@ semantics).
 from __future__ import annotations
 
 import logging
+import math
 from functools import partial
 from typing import Optional
 
@@ -195,19 +196,23 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     kv = k.shape[2]
     if kv != h and kv % n:
-        # GQA group count not divisible by the axis: pre-repeat to the
-        # full head count (correct for any kv since h % n == 0 holds) —
-        # the all-to-all then moves full-head bytes, like the pre-GQA
-        # behavior. The bandwidth-saving path below needs kv % n == 0.
+        # GQA group count not divisible by the axis: pre-repeat K/V to
+        # lcm(kv, n) — the SMALLEST head count the all-to-all can split
+        # evenly (kv and n both divide h, so their lcm does too). The
+        # remaining h/lcm repeat still happens locally per block, so only
+        # lcm/kv x of GQA's bandwidth advantage is forfeited (the old
+        # fallback repeated all the way to h).
+        target = math.lcm(kv, n)
         if (kv, n) not in _WARNED_GQA_FALLBACK:
             _WARNED_GQA_FALLBACK.add((kv, n))
             logger.warning(
                 "ulysses GQA fallback: kv_heads=%d not divisible by sp=%d; "
-                "K/V pre-repeat to %d heads, so the all-to-all moves "
-                "full-head bytes (GQA's bandwidth advantage is lost). Use "
-                "an sp degree dividing kv_heads to keep it.", kv, n, h)
-        k = jnp.repeat(k, h // kv, axis=2)
-        v = jnp.repeat(v, h // kv, axis=2)
+                "K/V pre-repeat to lcm=%d heads, so the all-to-all moves "
+                "%dx the GQA-ideal K/V bytes. Use an sp degree dividing "
+                "kv_heads to keep the full advantage.",
+                kv, n, target, target // kv)
+        k = jnp.repeat(k, target // kv, axis=2)
+        v = jnp.repeat(v, target // kv, axis=2)
     # kv heads ride the all-to-all unrepeated (kv/n per chip); the block
     # update repeats them locally per key block
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)

@@ -35,7 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..models import ShardConfig, block_slices
+from ..models import block_slices
 from ..models.layers import TransformerConfig
 from ..models.shard import FamilySpec, stack_blocks
 from ..ops import quant as quant_ops

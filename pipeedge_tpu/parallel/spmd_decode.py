@@ -44,7 +44,7 @@ batcher handles ragged arrivals). Token-identical to per-request
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp

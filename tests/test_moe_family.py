@@ -284,7 +284,7 @@ def test_moe_sp_prefill_matches_plain(moe_setup):
     np.testing.assert_array_equal(got, want)
 
     bounded = dataclasses.replace(cfg, capacity_factor=1.25)
-    with pytest.raises(NotImplementedError, match="droppless"):
+    with pytest.raises(NotImplementedError, match="dropless"):
         bounded_pipe = decode.DecodePipeline(
             gpt2_mod.FAMILY, bounded, partition, stage_params, max_len=16,
             sp_mesh=sp_mesh)

@@ -183,9 +183,10 @@ def test_ulysses_blockwise_no_full_score_materialization():
 
 
 def test_gqa_ulysses_indivisible_kv_falls_back():
-    """kv_heads not divisible by the axis size: ulysses pre-repeats to the
-    full head count (the pre-GQA behavior) instead of failing — correct
-    output, full-head all-to-all cost."""
+    """kv_heads not divisible by the axis size: ulysses pre-repeats K/V to
+    lcm(kv, n) — the smallest evenly-splittable head count (here
+    lcm(2,4)=4, not the full 8) — instead of failing: correct output,
+    lcm/kv x the GQA-ideal all-to-all bytes, one-time warning."""
     rng = np.random.default_rng(9)
     b, s, h, kv, d = 1, 32, 8, 2, 16
     q = rng.normal(size=(b, s, h, d)).astype(np.float32)
