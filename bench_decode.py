@@ -41,7 +41,10 @@ def bench_pipe(pipe, ids, new_tokens, prefill_ubatch=None):
     """(tokens/sec, steady step ms, prefill ms) for one pipeline+batch."""
     kw = dict(prefill_ubatch=prefill_ubatch)
     n0 = max(2, new_tokens // 8)
-    pipe.generate(ids, 2, **kw)            # compile prefill+step programs
+    # warm with the FULL token budget so every attend bucket the timed
+    # runs will cross is compiled up front (min-of-reps would drop a
+    # compile-laden first rep anyway, but keep all reps meaningful)
+    pipe.generate(ids, new_tokens, **kw)
     t_full = _time_generate(pipe, ids, new_tokens, **kw)
     t_n0 = _time_generate(pipe, ids, n0, **kw)
     step_s = (t_full - t_n0) / (new_tokens - n0)
@@ -92,10 +95,10 @@ def main():
         args.model_name, None, 1, total, dtype=dtype, unroll=False)
     family = registry.get_model_entry(args.model_name).family.FAMILY
 
-    def make_pipe(cache_bits=0):
+    def make_pipe(cache_bits=0, attend_floor=64):
         return decode.DecodePipeline(
             family, cfg, [(1, total)], [params], max_len=max_len,
-            dtype=dtype, cache_bits=cache_bits)
+            dtype=dtype, cache_bits=cache_bits, attend_floor=attend_floor)
 
     rng = np.random.default_rng(0)
     pipe = make_pipe()
@@ -117,6 +120,10 @@ def main():
     chunk = max(1, b_big // 4)
     _, _, prefill_chunked = bench_pipe(pipe, ids_big, args.new_tokens,
                                        prefill_ubatch=chunk)
+    # A/B: bucketed vs full-window decode-step attention (the default
+    # pipe buckets at floor 64; the full pipe always attends max_len)
+    tps_full, step_full, _ = bench_pipe(make_pipe(attend_floor=max_len),
+                                        ids_big, args.new_tokens)
 
     import jax
     print(json.dumps({
@@ -133,6 +140,8 @@ def main():
         "chunked_prefill_ms": round(prefill_chunked, 1),
         "whole_prefill_ms": per_batch[b_big]["prefill_ms"],
         "prefill_chunk": chunk,
+        "full_window_attend": {"tokens_per_sec": round(tps_full, 1),
+                               "decode_step_ms": round(step_full, 3)},
         "device_kind": jax.devices()[0].device_kind,
     }))
 

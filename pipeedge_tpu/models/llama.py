@@ -164,11 +164,13 @@ def _block_tail(p: Dict, x, ctx, cfg: TransformerConfig):
 
 
 def cached_block_step(p: Dict, x, bcache, pos, cfg: TransformerConfig,
-                      prefill: bool):
+                      prefill: bool, read_len=None):
     """KV-cached llama block (decode subsystem contract, parallel/decode.py
     `_block_step` shape): prefill writes the whole prompt's POST-RoPE K and
     V at [0, S); a decode step rotates the single new token at `pos` and
-    attends over the masked cache window."""
+    attends over the masked cache window (truncated to the static
+    `read_len` bucket when the pipeline passes one — cache positions are
+    absolute from 0, so the window mask anchors unchanged)."""
     from ..parallel.decode import _cache_update_and_read
 
     normed = rms_norm(p["ln_before"], x, cfg.layer_norm_eps)
@@ -176,7 +178,7 @@ def cached_block_step(p: Dict, x, bcache, pos, cfg: TransformerConfig,
     pos_ids = jnp.arange(s) if prefill else jnp.asarray(pos)[None]
     q, k_new, v_new = _qkv_rope(p, normed, cfg, pos_ids)
     k, v, keep, bcache = _cache_update_and_read(
-        bcache, k_new, v_new, pos, prefill, s, q.dtype)
+        bcache, k_new, v_new, pos, prefill, s, q.dtype, read_len=read_len)
     ctx = _gqa_attend(q, k, v, cfg, keep=keep,
                       q_pos=_abs_q_pos(pos, s, prefill))
     return _block_tail(p, x, ctx, cfg), bcache

@@ -229,8 +229,8 @@ class ContinuousBatcher:
                 out, req.caches[i] = st["prefill"](st["params"], data,
                                                    req.caches[i])
             else:
-                out, req.caches[i] = st["decode"](st["params"], data,
-                                                  req.caches[i], req.pos)
+                out, req.caches[i] = self.pipe._decode_step(
+                    st, data, req.caches[i], req.pos)
             self.stats["stage_steps"] += 1
             worked = True
             if i + 1 < self.n_stages:

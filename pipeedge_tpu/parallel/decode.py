@@ -110,12 +110,21 @@ def _attend(q: jax.Array, k: jax.Array, v: jax.Array, keep: jax.Array,
 
 
 def _cache_update_and_read(bcache: Cache, k_new: jax.Array, v_new: jax.Array,
-                           pos, prefill: bool, s: int,
-                           dtype) -> Tuple[jax.Array, jax.Array, jax.Array,
-                                           Cache]:
+                           pos, prefill: bool, s: int, dtype,
+                           read_len: Optional[int] = None) \
+        -> Tuple[jax.Array, jax.Array, jax.Array, Cache]:
     """Write the new K/V rows at [pos, pos+S) and return (k, v, keep, cache)
-    for attention over the whole (masked) cache window."""
+    for attention over the cache window.
+
+    `read_len` (STATIC) truncates the attend window to cache positions
+    [0, read_len): the caller guarantees pos < read_len, and positions
+    beyond it were fully masked anyway (their softmax columns are exact
+    zeros), so truncation is numerically identical while the attend
+    matmul and (for int8 caches) the dequantize shrink from max_len to
+    read_len — the bucketed decode-step optimization
+    (DecodePipeline::attend_bucket)."""
     t_max = bcache["k"].shape[1]
+    width = t_max if read_len is None else min(read_len, t_max)
     quantized = "k_scale" in bcache
     bcache = dict(bcache)
     start = (0, 0, 0, 0) if prefill else (0, pos, 0, 0)
@@ -127,10 +136,13 @@ def _cache_update_and_read(bcache: Cache, k_new: jax.Array, v_new: jax.Array,
                 bcache[f"{t}_scale"], scale, start[:3])
             bcache[f"{t}_shift"] = jax.lax.dynamic_update_slice(
                 bcache[f"{t}_shift"], shift, start[:3])
-        k = _dequantize_rows(bcache["k"], bcache["k_scale"],
-                             bcache["k_shift"], dtype)
-        v = _dequantize_rows(bcache["v"], bcache["v_scale"],
-                             bcache["v_shift"], dtype)
+        # dequantize only the attended window
+        k = _dequantize_rows(bcache["k"][:, :width],
+                             bcache["k_scale"][:, :width],
+                             bcache["k_shift"][:, :width], dtype)
+        v = _dequantize_rows(bcache["v"][:, :width],
+                             bcache["v_scale"][:, :width],
+                             bcache["v_shift"][:, :width], dtype)
         # the freshly computed rows are in hand — attend over them exactly;
         # quantization error applies only to genuinely cached positions
         k = jax.lax.dynamic_update_slice(k, k_new.astype(dtype), start)
@@ -139,14 +151,14 @@ def _cache_update_and_read(bcache: Cache, k_new: jax.Array, v_new: jax.Array,
         for t, new in (("k", k_new), ("v", v_new)):
             bcache[t] = jax.lax.dynamic_update_slice(
                 bcache[t], new.astype(bcache[t].dtype), start)
-        k = bcache["k"].astype(dtype)
-        v = bcache["v"].astype(dtype)
+        k = bcache["k"][:, :width].astype(dtype)
+        v = bcache["v"][:, :width].astype(dtype)
     if prefill:
-        q_pos = jax.lax.broadcasted_iota(jnp.int32, (s, t_max), 0)
-        k_pos = jax.lax.broadcasted_iota(jnp.int32, (s, t_max), 1)
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, (s, width), 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (s, width), 1)
         keep = k_pos <= q_pos          # causal within the prompt
     else:
-        k_pos = jax.lax.broadcasted_iota(jnp.int32, (1, t_max), 1)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (1, width), 1)
         keep = k_pos <= pos            # attend to [0, pos]
     return k, v, keep, bcache
 
@@ -175,26 +187,30 @@ def _block_tail(p: Dict, x: jax.Array, ctx: jax.Array,
 
 
 def _attention_core(p: Dict, x: jax.Array, bcache: Cache, pos,
-                    cfg: TransformerConfig,
-                    prefill: bool) -> Tuple[jax.Array, Cache]:
+                    cfg: TransformerConfig, prefill: bool,
+                    read_len: Optional[int] = None) \
+        -> Tuple[jax.Array, Cache]:
     """ln + qkv + cache update + masked attend: the cached attention half
     shared by the plain and expert-parallel decode steps."""
     normed = layer_norm(p["ln_before"], x, cfg.layer_norm_eps)
     q, k_new, v_new = _qkv(p, normed, cfg)
     k, v, keep, bcache = _cache_update_and_read(
-        bcache, k_new, v_new, pos, prefill, x.shape[1], q.dtype)
+        bcache, k_new, v_new, pos, prefill, x.shape[1], q.dtype,
+        read_len=read_len)
     return _attend(q, k, v, keep, cfg), bcache
 
 
 def _block_step(p: Dict, x: jax.Array, bcache: Cache, pos,
-                cfg: TransformerConfig,
-                prefill: bool) -> Tuple[jax.Array, Cache]:
+                cfg: TransformerConfig, prefill: bool,
+                read_len: Optional[int] = None) -> Tuple[jax.Array, Cache]:
     """One GPT-2 block over current token(s) with cache read/update.
 
     Prefill: x is the full prompt [B, S, D] written at positions [0, S);
     decode: x is one token [B, 1, D] written at position `pos`. `bcache`
-    is this block's cache slice {k, v[, *_scale, *_shift]}."""
-    ctx, bcache = _attention_core(p, x, bcache, pos, cfg, prefill)
+    is this block's cache slice {k, v[, *_scale, *_shift]}. `read_len`:
+    static attend-window truncation (see _cache_update_and_read)."""
+    ctx, bcache = _attention_core(p, x, bcache, pos, cfg, prefill,
+                                  read_len=read_len)
     return _block_tail(p, x, ctx, cfg), bcache
 
 
@@ -241,6 +257,21 @@ def stage_blocks(params: Dict) -> jax.Array:
     return blocks
 
 
+def attend_bucket(pos_next: int, max_len: int, floor: int = 64) -> int:
+    """Static attend-window size for a decode step with `pos_next` valid
+    cache rows: the smallest power-of-2 >= pos_next (>= floor), capped at
+    max_len. Powers of two bound the compiled-variant count to
+    log2(max_len/floor) + 1 while the attend matmul and int8 dequant
+    track the LIVE cache length instead of max_len — the longer the
+    max_len headroom, the bigger the decode-step saving."""
+    if pos_next > max_len:
+        raise ValueError(f"pos_next {pos_next} exceeds max_len {max_len}")
+    b = max(1, floor)
+    while b < pos_next:
+        b *= 2
+    return min(b, max_len)
+
+
 def _run_blocks(blocks, x, cache: Cache, pos, cfg: TransformerConfig,
                 prefill: bool, block_fn=_block_step) -> Tuple[jax.Array, Cache]:
     def body(carry, xs):
@@ -262,7 +293,11 @@ def make_stage_fns(family, cfg: TransformerConfig, shard_config: ShardConfig):
     """
     run = _make_stage_run(family, cfg, shard_config)
     prefill_fn = jax.jit(partial(run, pos=0, prefill=True))
-    decode_fn = jax.jit(partial(run, prefill=False))
+    # read_len is STATIC: each attend-window bucket compiles its own
+    # decode-step program (a handful of power-of-2 variants, the same
+    # compile-per-discrete-value pattern as the quantized edge bitwidths)
+    decode_fn = jax.jit(partial(run, prefill=False),
+                        static_argnames=("read_len",))
     return prefill_fn, decode_fn
 
 
@@ -279,7 +314,7 @@ def _make_stage_run(family, cfg: TransformerConfig,
         # the default is the GPT-2-shaped step
         block_fn = getattr(family, "cached_block_step", None) or _block_step
 
-    def run(params, data, cache, pos, prefill):
+    def run(params, data, cache, pos, prefill, read_len=None):
         if shard_config.is_first:
             if embed_fn is not None:
                 data = embed_fn(params["embeddings"], data)
@@ -289,8 +324,12 @@ def _make_stage_run(family, cfg: TransformerConfig,
                 tok_embed = getattr(family, "decode_embed", None) \
                     or single_token_embed
                 data = tok_embed(params["embeddings"], data, pos)
+        # bind the static attend window only when bucketing is active, so
+        # block steps without the kwarg (tp/ep variants) stay untouched
+        bf = block_fn if read_len is None \
+            else partial(block_fn, read_len=read_len)
         data, cache = _run_blocks(stage_blocks(params), data, cache, pos,
-                                  cfg, prefill, block_fn=block_fn)
+                                  cfg, prefill, block_fn=bf)
         if shard_config.is_last:
             data = (finalize_fn or family.finalize)(params["final"], data,
                                                     cfg)
@@ -747,7 +786,8 @@ class DecodePipeline:
                  devices: Optional[Sequence] = None, dtype=jnp.float32,
                  cache_bits: int = 0, mesh=None, tp_axis: str = "tp",
                  sp_mesh=None, sp_axis: str = "sp", sp_kind: str = "ring",
-                 ep_mesh=None, ep_axis: str = "ep", tp_ep_mesh=None):
+                 ep_mesh=None, ep_axis: str = "ep", tp_ep_mesh=None,
+                 attend_floor: int = 64):
         total = 4 * cfg.num_hidden_layers
         validate_partition(partition, total)
         validate_capacity(cfg, max_len)
@@ -821,6 +861,21 @@ class DecodePipeline:
         self.dtype = dtype
         self.cache_bits = cache_bits
         self.sp_degree = sp_mesh.shape[sp_axis] if sp_mesh is not None else 1
+        # bucketed decode-step attention rides the plain stage programs
+        # (static read_len arg); the mesh-sharded variants attend over the
+        # full window — their shard_map signatures don't take the bucket
+        self._bucketed = (mesh is None and ep_mesh is None
+                          and tp_ep_mesh is None)
+        if attend_floor < 1:
+            raise ValueError(f"attend_floor must be >= 1, got {attend_floor}")
+        self.attend_floor = attend_floor
+
+    def _read_len(self, pos: int):
+        """Static attend window for a decode step at host-known `pos`
+        (None when this pipeline's stage programs aren't bucketed)."""
+        if not self._bucketed:
+            return None
+        return attend_bucket(pos + 1, self.max_len, self.attend_floor)
 
     def _fresh_caches(self, batch: int) -> List[Cache]:
         caches = []
@@ -838,6 +893,15 @@ class DecodePipeline:
                 c = jax.device_put(c, st["device"])
             caches.append(c)
         return caches
+
+    def _decode_step(self, st, data, cache, pos: int):
+        """Dispatch one stage's decode program at host-known `pos`,
+        binding the static attend bucket when this pipeline is bucketed
+        (the batcher dispatches through here too)."""
+        rl = self._read_len(pos)
+        if rl is None:
+            return st["decode"](st["params"], data, cache, pos)
+        return st["decode"](st["params"], data, cache, pos, read_len=rl)
 
     def _prefill(self, ids, prefill_ubatch: Optional[int] = None):
         """Run the prompt through all stages; returns (last-stage output,
@@ -913,8 +977,8 @@ class DecodePipeline:
             for i, st in enumerate(self.stages):
                 if st["device"] is not None:
                     data = jax.device_put(data, st["device"])
-                data, caches[i] = st["decode"](st["params"], data, caches[i],
-                                               pos)
+                data, caches[i] = self._decode_step(st, data, caches[i],
+                                                    pos)
             rng, sub = jax.random.split(rng)
             tokens.append(pick(data[:, 0].astype(jnp.float32), sub))
             if step_callback is not None:
@@ -960,8 +1024,8 @@ class DecodePipeline:
             for i, st in enumerate(self.stages):
                 if st["device"] is not None:
                     data = jax.device_put(data, st["device"])
-                data, caches[i] = st["decode"](st["params"], data, caches[i],
-                                               pos)
+                data, caches[i] = self._decode_step(st, data, caches[i],
+                                                    pos)
             logp = jax.nn.log_softmax(
                 data[:, 0].astype(jnp.float32), axis=-1)  # [B*beams, V]
             vocab = logp.shape[-1]

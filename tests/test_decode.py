@@ -432,3 +432,48 @@ def test_decode_validation_errors(gpt2_setup):
     # new_tokens=0 honors the [B, S + new_tokens] contract
     ids = np.zeros((1, 4), np.int64)
     assert np.asarray(pipe.generate(ids, 0)).shape == (1, 4)
+
+
+@pytest.mark.slow
+def test_bucketed_attend_crosses_buckets(gpt2_setup):
+    """Bucketed decode-step attention (attend_bucket: static power-of-2
+    windows instead of max_len) is token-identical to the full-window
+    pipeline while the generation crosses several bucket boundaries
+    (floor 4 -> buckets 4, 8, 16, 32 over a 28-token run), for both the
+    f32 and the int8 cache, with HF generate as the external oracle."""
+    import torch
+
+    from pipeedge_tpu.parallel.decode import attend_bucket
+
+    assert [attend_bucket(p, 64, 4) for p in (1, 4, 5, 9, 17, 33)] == \
+        [4, 4, 8, 16, 32, 64]
+    with pytest.raises(ValueError, match="exceeds"):
+        attend_bucket(65, 64, 4)
+
+    cfg, weights, model = gpt2_setup
+    ids = np.asarray(
+        np.random.default_rng(71).integers(0, 100, size=(2, 5)), np.int64)
+    new = 28
+    partition = [(1, 8), (9, 12)]
+    sp = _stage_params(cfg, partition, weights)
+    full = decode.DecodePipeline(gpt2_mod.FAMILY, cfg, partition, sp,
+                                 max_len=64, attend_floor=64)
+    bucketed = decode.DecodePipeline(gpt2_mod.FAMILY, cfg, partition, sp,
+                                     max_len=64, attend_floor=4)
+    want = np.asarray(full.generate(ids, new))
+    np.testing.assert_array_equal(np.asarray(bucketed.generate(ids, new)),
+                                  want)
+    with torch.no_grad():
+        hf = model.generate(torch.from_numpy(ids), max_new_tokens=new,
+                            do_sample=False, pad_token_id=0).numpy()
+    np.testing.assert_array_equal(want, hf)
+
+    int8_full = decode.DecodePipeline(gpt2_mod.FAMILY, cfg, partition, sp,
+                                      max_len=64, cache_bits=8,
+                                      attend_floor=64)
+    int8_bucketed = decode.DecodePipeline(gpt2_mod.FAMILY, cfg, partition,
+                                          sp, max_len=64, cache_bits=8,
+                                          attend_floor=4)
+    np.testing.assert_array_equal(
+        np.asarray(int8_bucketed.generate(ids, new)),
+        np.asarray(int8_full.generate(ids, new)))

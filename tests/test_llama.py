@@ -404,3 +404,24 @@ def test_mistral_sp_prefill_long_prompt(mistral_setup):
         sp_mesh=Mesh(np.asarray(jax.devices()[:4]), ("sp",)))
     got = np.asarray(sp_pipe.generate(ids, new_tokens=6))
     np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.slow
+def test_mistral_bucketed_attend_matches_full(mistral_setup):
+    """Bucketed decode (static attend windows) composes with the llama
+    family's cached step AND the sliding-window mask: tokens match the
+    full-window pipeline across bucket boundaries."""
+    cfg, weights, _ = mistral_setup
+    partition = [(1, 4), (5, 8)]
+    total = 4 * cfg.num_hidden_layers
+    sp = [llama_mod.load_params(
+        cfg, ShardConfig(l, r, is_first=l == 1, is_last=r == total), weights)
+        for l, r in partition]
+    ids = np.random.default_rng(41).integers(0, cfg.vocab_size, size=(2, 5))
+    full = decode.DecodePipeline(llama_mod.FAMILY, cfg, partition, sp,
+                                 max_len=32, attend_floor=32)
+    bucketed = decode.DecodePipeline(llama_mod.FAMILY, cfg, partition, sp,
+                                     max_len=32, attend_floor=4)
+    np.testing.assert_array_equal(
+        np.asarray(bucketed.generate(ids, new_tokens=20)),
+        np.asarray(full.generate(ids, new_tokens=20)))

@@ -277,3 +277,63 @@ def test_cost_model_mem_bytes():
     want = 1 * 1024 * 1024 + (20 * 2 * 4) * 2 + (30 * 2 * 4) * 2 \
         + (20 * 2 * 4 + 30 * 2 * 4)
     assert got == want
+
+
+def test_profiles_upsert_semantics(tmp_path):
+    """sched/profiles.py merge rules: dup model refuses without overwrite;
+    device-type capacity must not silently change; (dtype, batch) keys a
+    device type's model profiles with normalized dtype comparison."""
+    import yaml as yaml_mod
+
+    from pipeedge_tpu.sched import profiles
+
+    results_yml = tmp_path / "r.yml"
+    rec = {"model_name": "m", "dtype": "torch.float32", "batch_size": 2,
+           "layers": 2,
+           "profile_data": [
+               {"layer": 1, "time": 0.1, "memory": 5.0,
+                "shape_in": [[3, 4]], "shape_out": [[3, 4], [3, 4]]},
+               {"layer": 2, "time": 0.2, "memory": 6.0,
+                "shape_in": [[3, 4], [3, 4]], "shape_out": [[7]]},
+           ]}
+    with open(results_yml, "w") as f:
+        yaml_mod.safe_dump(rec, f)
+    res = profiles.ProfilerResults.load(str(results_yml))
+
+    models_yml = str(tmp_path / "models.yml")
+    profiles.upsert_model(models_yml, res)
+    with pytest.raises(profiles.ProfileError, match="already exists"):
+        profiles.upsert_model(models_yml, res)
+    profiles.upsert_model(models_yml, res, overwrite=True)
+    entry = yaml_mod.safe_load(open(models_yml))["m"]
+    assert entry == {"layers": 2, "parameters_in": 12,
+                     "parameters_out": [24, 7], "mem_MB": [5.0, 6.0]}
+
+    types_yml = str(tmp_path / "types.yml")
+    with pytest.raises(profiles.ProfileError, match="required"):
+        profiles.upsert_device_type(types_yml, "dev", res)
+    profiles.upsert_device_type(types_yml, "dev", res, mem_MB=100, bw_Mbps=10)
+    with pytest.raises(profiles.ProfileError, match="mismatch"):
+        profiles.upsert_device_type(types_yml, "dev", res, mem_MB=999,
+                                    bw_Mbps=10)
+    # same (dtype, batch) under a different spelling is the SAME key
+    res2 = profiles.ProfilerResults(
+        model_name="m", dtype="float32", batch_size=2, layers=2,
+        profile_data=res.profile_data)
+    with pytest.raises(profiles.ProfileError, match="already exists"):
+        profiles.upsert_device_type(types_yml, "dev", res2)
+    profiles.upsert_device_type(types_yml, "dev", res2, overwrite=True)
+    # a different batch size appends a second profile
+    res3 = profiles.ProfilerResults(
+        model_name="m", dtype="float32", batch_size=8, layers=2,
+        profile_data=res.profile_data)
+    profiles.upsert_device_type(types_yml, "dev", res3)
+    out = yaml_mod.safe_load(open(types_yml))["dev"]
+    assert [p["batch_size"] for p in out["model_profiles"]["m"]] == [2, 8]
+
+    # inconsistent record counts refuse at load
+    bad = dict(rec, layers=3)
+    with open(results_yml, "w") as f:
+        yaml_mod.safe_dump(bad, f)
+    with pytest.raises(profiles.ProfileError, match="layer count"):
+        profiles.ProfilerResults.load(str(results_yml))

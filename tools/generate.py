@@ -230,6 +230,11 @@ def main():
     parser.add_argument("--kv-bits", default=0, type=int, choices=[0, 8],
                         help="int8-quantize the KV cache (halves decode "
                              "HBM traffic; 0 = full precision)")
+    parser.add_argument("--attend-floor", default=64, type=int,
+                        help="smallest bucketed attend window: decode "
+                             "steps attend over the least power-of-2 "
+                             "window >= the live cache length instead of "
+                             "max_len (one compiled variant per bucket)")
     parser.add_argument("--tp", default=1, type=int,
                         help="Megatron tensor-parallel degree per stage "
                              "(head-sharded KV cache, shard_map)")
@@ -383,7 +388,8 @@ def main():
     pipe = decode.DecodePipeline(registry.get_model_entry(
         args.model_name).family.FAMILY, cfg, partition, stage_params,
         max_len=max_len, dtype=dtype, cache_bits=args.kv_bits, mesh=mesh,
-        sp_mesh=sp_mesh, ep_mesh=ep_mesh, tp_ep_mesh=tp_ep_mesh)
+        sp_mesh=sp_mesh, ep_mesh=ep_mesh, tp_ep_mesh=tp_ep_mesh,
+        attend_floor=args.attend_floor)
 
     heartbeat = None
     if args.monitor:
