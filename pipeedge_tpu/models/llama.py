@@ -185,12 +185,13 @@ def cached_block_step(p: Dict, x, bcache, pos, cfg: TransformerConfig,
 
 
 def tp_cached_block_step(p: Dict, x, bcache, pos, cfg: TransformerConfig,
-                         prefill: bool, axis: str):
+                         prefill: bool, axis: str, read_len=None):
     """Tensor-parallel KV-cached llama block under `shard_map`: the
     forward Megatron body (parallel/tensor.py `_tp_llama_block_local` —
     ONE copy of the projection/psum/SwiGLU numerics) with the attention
     core swapped for a cache-attend over the head-sharded GQA cache
-    slice. Requires heads AND kv_heads divisible by the tp degree."""
+    slice. Requires heads AND kv_heads divisible by the tp degree.
+    `read_len`: static bucketed attend window (position axis unsharded)."""
     from ..parallel.decode import _cache_update_and_read
     from ..parallel.tensor import _tp_llama_block_local
 
@@ -198,7 +199,8 @@ def tp_cached_block_step(p: Dict, x, bcache, pos, cfg: TransformerConfig,
 
     def cache_attend(q, k_new, v_new):
         k, v, keep, bc = _cache_update_and_read(
-            bcache, k_new, v_new, pos, prefill, x.shape[1], q.dtype)
+            bcache, k_new, v_new, pos, prefill, x.shape[1], q.dtype,
+            read_len=read_len)
         new_cache.update(bc)
         return _gqa_attend(q, k, v, cfg, keep=keep,
                            q_pos=_abs_q_pos(pos, x.shape[1], prefill))

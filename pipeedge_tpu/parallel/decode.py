@@ -216,19 +216,22 @@ def _block_step(p: Dict, x: jax.Array, bcache: Cache, pos,
 
 def _block_step_tp(p: Dict, x: jax.Array, bcache: Cache, pos,
                    cfg: TransformerConfig, prefill: bool,
-                   axis: str, act=gelu_new,
-                   ffn_delta=None) -> Tuple[jax.Array, Cache]:
+                   axis: str, act=gelu_new, ffn_delta=None,
+                   read_len: Optional[int] = None) -> Tuple[jax.Array, Cache]:
     """Megatron tensor-parallel block step under `shard_map`: the shared
     projection/psum/MLP body from parallel/tensor.py with the attention
     core swapped for a cache-attend over the head-sharded KV cache.
-    `ffn_delta` replaces the dense MLP (the tp x ep MoE composition)."""
+    `ffn_delta` replaces the dense MLP (the tp x ep MoE composition);
+    `read_len` is the static bucketed attend window (the position axis is
+    unsharded, so truncation is per-shard local)."""
     from .tensor import _tp_block_local
 
     new_cache = {}
 
     def cache_attend(q, k_new, v_new):
         k, v, keep, bc = _cache_update_and_read(
-            bcache, k_new, v_new, pos, prefill, x.shape[1], q.dtype)
+            bcache, k_new, v_new, pos, prefill, x.shape[1], q.dtype,
+            read_len=read_len)
         new_cache.update(bc)
         return _attend(q, k, v, keep, cfg)      # [b, s, h_local * hd]
 
@@ -324,8 +327,9 @@ def _make_stage_run(family, cfg: TransformerConfig,
                 tok_embed = getattr(family, "decode_embed", None) \
                     or single_token_embed
                 data = tok_embed(params["embeddings"], data, pos)
-        # bind the static attend window only when bucketing is active, so
-        # block steps without the kwarg (tp/ep variants) stay untouched
+        # bind the static attend window only when bucketing is active —
+        # the ep block step is the one variant without the kwarg, and its
+        # path never binds a bucket (DecodePipeline._bucketed)
         bf = block_fn if read_len is None \
             else partial(block_fn, read_len=read_len)
         data, cache = _run_blocks(stage_blocks(params), data, cache, pos,
@@ -439,10 +443,18 @@ def make_tp_stage_fns(family, cfg: TransformerConfig,
         partial(run, pos=0, prefill=True), mesh=mesh,
         in_specs=(p_specs, P(), c_specs), out_specs=(P(), c_specs),
         check_vma=False))
-    decode_fn = jax.jit(jax.shard_map(
-        partial(run, prefill=False), mesh=mesh,
-        in_specs=(p_specs, P(), c_specs, P()), out_specs=(P(), c_specs),
-        check_vma=False))
+
+    # the bucketed attend window is bound into the shard_map closure per
+    # static read_len value — jit re-traces per bucket, same
+    # compile-per-discrete-value pattern as the plain path
+    @partial(jax.jit, static_argnames=("read_len",))
+    def decode_fn(params, data, cache, pos, read_len=None):
+        return jax.shard_map(
+            partial(run, prefill=False, read_len=read_len), mesh=mesh,
+            in_specs=(p_specs, P(), c_specs, P()),
+            out_specs=(P(), c_specs), check_vma=False)(
+                params, data, cache, pos)
+
     # p_specs is returned so callers place params with the SAME specs the
     # program compiled against (drift would silently reshard every call)
     return prefill_fn, decode_fn, p_specs
@@ -862,10 +874,10 @@ class DecodePipeline:
         self.cache_bits = cache_bits
         self.sp_degree = sp_mesh.shape[sp_axis] if sp_mesh is not None else 1
         # bucketed decode-step attention rides the plain stage programs
-        # (static read_len arg); the mesh-sharded variants attend over the
-        # full window — their shard_map signatures don't take the bucket
-        self._bucketed = (mesh is None and ep_mesh is None
-                          and tp_ep_mesh is None)
+        # AND the tp variant (static read_len arg; the tp shard_map
+        # closure re-binds per bucket); the ep/tp x ep variants attend
+        # over the full window — their signatures don't take the bucket
+        self._bucketed = ep_mesh is None and tp_ep_mesh is None
         if attend_floor < 1:
             raise ValueError(f"attend_floor must be >= 1, got {attend_floor}")
         self.attend_floor = attend_floor

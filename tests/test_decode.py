@@ -477,3 +477,21 @@ def test_bucketed_attend_crosses_buckets(gpt2_setup):
     np.testing.assert_array_equal(
         np.asarray(int8_bucketed.generate(ids, new)),
         np.asarray(int8_full.generate(ids, new)))
+
+    # tensor-parallel stages bucket too (shard_map closure re-bound per
+    # static window; the position axis is unsharded) — f32 AND int8,
+    # whose [B, T, H] scale rows truncate on the same position axis
+    import jax
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    tp_bucketed = decode.DecodePipeline(
+        gpt2_mod.FAMILY, cfg, partition, sp, max_len=64, attend_floor=4,
+        mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(tp_bucketed.generate(ids, new)),
+                                  want)
+    tp_int8_bucketed = decode.DecodePipeline(
+        gpt2_mod.FAMILY, cfg, partition, sp, max_len=64, attend_floor=4,
+        cache_bits=8, mesh=mesh)
+    np.testing.assert_array_equal(
+        np.asarray(tp_int8_bucketed.generate(ids, new)),
+        np.asarray(int8_full.generate(ids, new)))

@@ -422,6 +422,14 @@ def test_mistral_bucketed_attend_matches_full(mistral_setup):
                                  max_len=32, attend_floor=32)
     bucketed = decode.DecodePipeline(llama_mod.FAMILY, cfg, partition, sp,
                                      max_len=32, attend_floor=4)
+    want = np.asarray(full.generate(ids, new_tokens=20))
     np.testing.assert_array_equal(
-        np.asarray(bucketed.generate(ids, new_tokens=20)),
-        np.asarray(full.generate(ids, new_tokens=20)))
+        np.asarray(bucketed.generate(ids, new_tokens=20)), want)
+    # tp decode buckets through the family's tp_cached_block_step: the
+    # GQA cache slice + window mask anchor over the truncated window
+    from jax.sharding import Mesh
+    tp_bucketed = decode.DecodePipeline(
+        llama_mod.FAMILY, cfg, partition, sp, max_len=32, attend_floor=4,
+        mesh=Mesh(np.asarray(jax.devices()[:2]), ("tp",)))
+    np.testing.assert_array_equal(
+        np.asarray(tp_bucketed.generate(ids, new_tokens=20)), want)
