@@ -159,7 +159,8 @@ def get_pipeline_sched(world_size: int, hosts: Optional[List[str]],
                        rank_order: Optional[List[int]], model_name: str,
                        microbatch_size: int, s_models_file: Optional[str],
                        s_dev_types_file: Optional[str],
-                       s_dev_file: Optional[str]) -> \
+                       s_dev_file: Optional[str],
+                       dtype: str = 'float32') -> \
         Tuple[List[Tuple[int, int]], List[int], List[int]]:
     """Schedule resolution: manual partition > single-stage degenerate >
     native scheduler (reference runtime.py:291-355)."""
@@ -192,7 +193,11 @@ def get_pipeline_sched(world_size: int, hosts: Optional[List[str]],
         logger.info("Scheduling: using scheduler algorithm")
         if hosts and len(hosts) != world_size:
             raise RuntimeError("Specified hosts count != world size")
+        # dtype must match the profile records' dtype key (the scheduler
+        # selects the model profile by exact (dtype, batch_size) match,
+        # native/sched_pipeline_main.cpp:135) — chip profiles are bfloat16
         sched = sched_pipeline(model_name, 2, 2, microbatch_size,
+                               dtype=dtype,
                                models_file=s_models_file,
                                dev_types_file=s_dev_types_file,
                                dev_file=s_dev_file)
@@ -1070,7 +1075,8 @@ def main():
             schedules.append(get_pipeline_sched(
                 args.worldsize, hosts, partition, quant, rank_order,
                 args.model_name, args.ubatch_size, args.sched_models_file,
-                args.sched_dev_types_file, args.sched_dev_file))
+                args.sched_dev_types_file, args.sched_dev_file,
+                dtype=args.dtype))
         stage_layers, stage_quant, stage_ranks = schedules[0]
 
         dataset = load_dataset(

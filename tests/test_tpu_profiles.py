@@ -34,7 +34,8 @@ def test_sched_pipeline_on_tpu_profiles(native_sched, model, layers):
     """The DP scheduler produces a full-coverage 4-stage partition over four
     identical tpu-v5e devices from the committed chip profiles."""
     sched = native_sched.sched_pipeline(
-        model, 2, 2, 8, models_file=FILES["models.yml"],
+        model, 2, 2, 8, dtype="bfloat16",
+        models_file=FILES["models.yml"],
         dev_types_file=FILES["device_types.yml"],
         dev_file=FILES["devices.yml"])
     assert sched, "no viable schedule from the chip profiles"

@@ -316,7 +316,10 @@ def main():
         # decoding needs block-aligned stages, so round the cuts to the
         # nearest block boundary
         from pipeedge_tpu.sched.scheduler import sched_pipeline
+        # dtype must match the profile records' (dtype, batch_size) key
+        # (native/sched_pipeline_main.cpp:135) — chip profiles are bfloat16
         sched = sched_pipeline(args.model_name, 2, 2, args.batch_size,
+                               dtype=args.dtype,
                                models_file=args.sched_models_file,
                                dev_types_file=args.sched_dev_types_file,
                                dev_file=args.sched_dev_file)

@@ -67,7 +67,12 @@ def run_config(n: int, platform: str, dtype: str) -> dict:
         + ["-t", dtype]
     if platform:
         cmd += ["--platform", platform]
-    env = dict(os.environ, PYTHONPATH=REPO, **spec.get("env", {}))
+    # APPEND to PYTHONPATH, never replace: the TPU plugin registers via a
+    # sitecustomize on the inherited PYTHONPATH (clobbering it leaves
+    # JAX_PLATFORMS naming a backend no longer registered in the child)
+    pypath = os.pathsep.join(
+        p for p in (REPO, os.environ.get("PYTHONPATH")) if p)
+    env = dict(os.environ, PYTHONPATH=pypath, **spec.get("env", {}))
     tik = time.monotonic()
     # Explicit-CPU runs get a watchdog; anything else (including the
     # implicit default, which resolves to the chip wherever the axon
