@@ -26,32 +26,45 @@ import json
 import time
 
 
-def _time_generate(pipe, ids, new_tokens, reps=3, **kw):
+def _time_once(pipe, ids, new_tokens, **kw):
     import numpy as np
-    best = float("inf")
-    for _ in range(reps):
-        tik = time.monotonic()
-        out = pipe.generate(ids, new_tokens, **kw)
-        np.asarray(out)            # fence
-        best = min(best, time.monotonic() - tik)
-    return best
+    tik = time.monotonic()
+    out = pipe.generate(ids, new_tokens, **kw)
+    np.asarray(out)            # fence
+    return time.monotonic() - tik
 
 
-def bench_pipe(pipe, ids, new_tokens, prefill_ubatch=None):
-    """(tokens/sec, steady step ms, prefill ms) for one pipeline+batch."""
+def bench_pipe(pipe, ids, new_tokens, prefill_ubatch=None, reps=5):
+    """(tokens/sec, steady step ms, prefill ms) for one pipeline+batch.
+
+    Step time = median over `reps` of INTERLEAVED (t(N) - t(N/2)) pairs,
+    divided by the N/2 step difference. Both lengths are step-dominated
+    (so prefill + the fixed dispatch overhead cancel in each pair) and
+    back-to-back pairing + median kills the tunnel's slow drift and
+    multi-hundred-ms outliers — min-of-reps on each length separately
+    composed two different outlier floors and once produced a *negative*
+    step time on chip."""
+    if new_tokens < 2:
+        raise ValueError("steady-state step estimation needs "
+                         f"new_tokens >= 2, got {new_tokens}")
     kw = dict(prefill_ubatch=prefill_ubatch)
-    n0 = max(2, new_tokens // 8)
+    n_half = max(1, new_tokens // 2)
     # warm with the FULL token budget so every attend bucket the timed
-    # runs will cross is compiled up front (min-of-reps would drop a
-    # compile-laden first rep anyway, but keep all reps meaningful)
+    # runs will cross is compiled up front
     pipe.generate(ids, new_tokens, **kw)
-    t_full = _time_generate(pipe, ids, new_tokens, **kw)
-    t_n0 = _time_generate(pipe, ids, n0, **kw)
-    step_s = (t_full - t_n0) / (new_tokens - n0)
+    deltas, fulls, halves = [], [], []
+    for _ in range(reps):
+        t_full = _time_once(pipe, ids, new_tokens, **kw)
+        t_half = _time_once(pipe, ids, n_half, **kw)
+        fulls.append(t_full)
+        halves.append(t_half)
+        deltas.append(t_full - t_half)
+    import statistics
+    step_s = statistics.median(deltas) / (new_tokens - n_half)
     batch = ids.shape[0]
-    tok_per_sec = batch * new_tokens / t_full
-    # prefill latency ~= t_n0 minus its n0 decode steps
-    prefill_ms = max(0.0, (t_n0 - n0 * step_s)) * 1e3
+    tok_per_sec = batch * new_tokens / min(fulls)
+    # prefill latency ~= t_half minus its decode steps
+    prefill_ms = max(0.0, (min(halves) - n_half * step_s)) * 1e3
     return tok_per_sec, step_s * 1e3, prefill_ms
 
 
@@ -64,6 +77,10 @@ def main():
     p.add_argument("-m", "--model-name", default="gpt2")
     p.add_argument("--prompt-len", default=128, type=int)
     p.add_argument("--new-tokens", default=64, type=int)
+    p.add_argument("--max-len", default=1024, type=int,
+                   help="KV cache capacity; headroom past prompt+new is "
+                        "what bucketed attend saves (serving allocates "
+                        "for the longest request, not the current one)")
     p.add_argument("--batches", default="1,16",
                    help="comma-separated batch sizes; the largest carries "
                         "the headline metric and the A/Bs")
@@ -88,7 +105,9 @@ def main():
     cfg = registry.get_model_config(args.model_name)
     total = registry.get_model_layers(args.model_name)
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
-    max_len = args.prompt_len + args.new_tokens
+    max_len = max(args.max_len, args.prompt_len + args.new_tokens)
+    if cfg.max_position_embeddings:  # clamp headroom to model capacity
+        max_len = min(max_len, cfg.max_position_embeddings)
     decode.validate_capacity(cfg, max_len, args.prompt_len, args.new_tokens)
 
     _, params, _ = registry.module_shard_factory(
@@ -134,6 +153,7 @@ def main():
         "per_batch": {str(b): v for b, v in per_batch.items()},
         "prompt_len": args.prompt_len,
         "new_tokens": args.new_tokens,
+        "max_len": max_len,
         "dtype": args.dtype,
         "int8_kv": {"tokens_per_sec": round(tps_int8, 1),
                     "decode_step_ms": round(step_int8, 3)},
