@@ -140,16 +140,23 @@ def finalize(p: Dict, hidden: jax.Array, cfg: TransformerConfig) -> jax.Array:
 
 def _abs_q_pos(pos, s: int, prefill: bool):
     """Absolute query positions [S_q, 1] for the cached attention's
-    sliding-window anchor: the prompt rows at prefill, the single traced
-    `pos` at a decode step."""
-    return (jnp.arange(s)[:, None] if prefill
-            else jnp.asarray(pos).reshape(1, 1))
+    sliding-window anchor: query row i sits at pos + i — prefill binds
+    pos=0 (the prompt rows), a decode step has s=1 at the traced `pos`,
+    and a span (speculative verify) step covers [pos, pos+s)."""
+    del prefill  # pos + offset covers every mode (prefill binds pos=0)
+    return jnp.asarray(pos) + jnp.arange(s)[:, None]
 
 
 def decode_embed(pe: Dict, tok: jax.Array, pos) -> jax.Array:
     """Single decode-step token embed [B, 1, D]: wte row only (RoPE puts
     the position into the attention rotation, not the embedding)."""
     return jnp.take(pe["wte"], tok.reshape(-1), axis=0)[:, None]
+
+
+def span_embed(pe: Dict, tok: jax.Array, pos) -> jax.Array:
+    """K-token span embed [B, K] -> [B, K, D] (speculative verify):
+    wte rows only — positions enter via RoPE in the attention."""
+    return jnp.take(pe["wte"], tok, axis=0)
 
 
 def _block_tail(p: Dict, x, ctx, cfg: TransformerConfig):
@@ -175,7 +182,8 @@ def cached_block_step(p: Dict, x, bcache, pos, cfg: TransformerConfig,
 
     normed = rms_norm(p["ln_before"], x, cfg.layer_norm_eps)
     s = normed.shape[1]
-    pos_ids = jnp.arange(s) if prefill else jnp.asarray(pos)[None]
+    # pos + offset covers prefill (pos=0), decode (s=1), and span steps
+    pos_ids = jnp.asarray(pos) + jnp.arange(s)
     q, k_new, v_new = _qkv_rope(p, normed, cfg, pos_ids)
     k, v, keep, bcache = _cache_update_and_read(
         bcache, k_new, v_new, pos, prefill, s, q.dtype, read_len=read_len)
@@ -205,7 +213,7 @@ def tp_cached_block_step(p: Dict, x, bcache, pos, cfg: TransformerConfig,
         return _gqa_attend(q, k, v, cfg, keep=keep,
                            q_pos=_abs_q_pos(pos, x.shape[1], prefill))
 
-    pos_ids = jnp.arange(x.shape[1]) if prefill else jnp.asarray(pos)[None]
+    pos_ids = jnp.asarray(pos) + jnp.arange(x.shape[1])
     y = _tp_llama_block_local(p, x, cfg, axis, qkv_to_ctx=cache_attend,
                               pos_ids=pos_ids)
     return y, new_cache
@@ -245,7 +253,7 @@ def sp_prefill_block_step(p: Dict, x, bcache, cfg: TransformerConfig,
 
 FAMILY = FamilySpec(name="llama", embed=embed, sublayer=sublayer,
                     finalize=finalize, cached_block_step=cached_block_step,
-                    decode_embed=decode_embed,
+                    decode_embed=decode_embed, span_embed=span_embed,
                     position_dependent_attention=True,
                     tp_cached_block_step=tp_cached_block_step,
                     tp_finalize=tp_finalize,

@@ -44,6 +44,8 @@ class FamilySpec:
     finalize: Callable[[Dict, jax.Array, TransformerConfig], jax.Array]
     cached_block_step: Any = None    # (p, x, bcache, pos, cfg, prefill)
     decode_embed: Any = None         # (embed_params, tok, pos) -> [B, 1, D]
+    span_embed: Any = None           # (embed_params, tok [B,K], pos) ->
+    #                                  [B, K, D] (speculative verify span)
     # attention reads absolute positions (RoPE): chunk-local attention
     # overrides (sequence-parallel cores) would rotate at wrong offsets
     position_dependent_attention: bool = False

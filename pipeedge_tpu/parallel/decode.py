@@ -158,8 +158,13 @@ def _cache_update_and_read(bcache: Cache, k_new: jax.Array, v_new: jax.Array,
         k_pos = jax.lax.broadcasted_iota(jnp.int32, (s, width), 1)
         keep = k_pos <= q_pos          # causal within the prompt
     else:
-        k_pos = jax.lax.broadcasted_iota(jnp.int32, (1, width), 1)
-        keep = k_pos <= pos            # attend to [0, pos]
+        # s == 1: the classic decode step (attend [0, pos]); s > 1: a
+        # SPAN step (speculative-decoding verify) — query i sits at
+        # absolute position pos + i and attends [0, pos + i], causal
+        # within the span exactly like prefill but offset by pos
+        q_pos = pos + jax.lax.broadcasted_iota(jnp.int32, (s, width), 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (s, width), 1)
+        keep = k_pos <= q_pos
     return k, v, keep, bcache
 
 
@@ -249,6 +254,14 @@ def single_token_embed(pe: Dict, tok: jax.Array, pos) -> jax.Array:
     return jnp.take(pe["wte"], tok.reshape(-1), axis=0)[:, None] + wpe[None]
 
 
+def span_embed(pe: Dict, tok: jax.Array, pos) -> jax.Array:
+    """Embed a K-token span [B, K] at positions [pos, pos+K) ->
+    [B, K, D] (the speculative-decoding verify step's embedding;
+    K is static, `pos` traced)."""
+    wpe = jax.lax.dynamic_slice_in_dim(pe["wpe"], pos, tok.shape[1])
+    return jnp.take(pe["wte"], tok, axis=0) + wpe[None]
+
+
 def stage_blocks(params: Dict) -> jax.Array:
     """The stacked blocks pytree of a decode stage (block-aligned shard)."""
     blocks = params.get("blocks")
@@ -323,6 +336,10 @@ def _make_stage_run(family, cfg: TransformerConfig,
                 data = embed_fn(params["embeddings"], data)
             elif prefill:
                 data = family.embed(params["embeddings"], data, cfg)
+            elif data.ndim == 2 and data.shape[1] > 1:
+                # span step (speculative verify): K tokens at [pos, pos+K)
+                tok_embed = getattr(family, "span_embed", None) or span_embed
+                data = tok_embed(params["embeddings"], data, pos)
             else:
                 tok_embed = getattr(family, "decode_embed", None) \
                     or single_token_embed
@@ -882,12 +899,13 @@ class DecodePipeline:
             raise ValueError(f"attend_floor must be >= 1, got {attend_floor}")
         self.attend_floor = attend_floor
 
-    def _read_len(self, pos: int):
-        """Static attend window for a decode step at host-known `pos`
-        (None when this pipeline's stage programs aren't bucketed)."""
+    def _read_len(self, pos: int, span: int = 1):
+        """Static attend window for a decode/span step whose last query
+        row sits at host-known pos + span - 1 (None when this pipeline's
+        stage programs aren't bucketed)."""
         if not self._bucketed:
             return None
-        return attend_bucket(pos + 1, self.max_len, self.attend_floor)
+        return attend_bucket(pos + span, self.max_len, self.attend_floor)
 
     def _fresh_caches(self, batch: int) -> List[Cache]:
         caches = []
@@ -906,11 +924,13 @@ class DecodePipeline:
             caches.append(c)
         return caches
 
-    def _decode_step(self, st, data, cache, pos: int):
+    def _decode_step(self, st, data, cache, pos: int, span: int = 1):
         """Dispatch one stage's decode program at host-known `pos`,
         binding the static attend bucket when this pipeline is bucketed
-        (the batcher dispatches through here too)."""
-        rl = self._read_len(pos)
+        (the batcher dispatches through here too). `span` > 1 runs the
+        same program shape over a K-token span [pos, pos+K) — the
+        speculative-decoding verify step."""
+        rl = self._read_len(pos, span)
         if rl is None:
             return st["decode"](st["params"], data, cache, pos)
         return st["decode"](st["params"], data, cache, pos, read_len=rl)
@@ -954,6 +974,32 @@ class DecodePipeline:
                                                        chunk_caches])
             for i in range(len(self.stages))]
         return jnp.concatenate(outs, axis=0), merged
+
+    def extend(self, tokens, caches, pos: int):
+        """Run a K-token span [B, K] through every stage at cache offset
+        `pos`: K/V rows [pos, pos+K) are written and span row i attends
+        cache positions [0, pos+i] (causal within the span, full history
+        before it). Returns (last-stage output [B, K, ...], caches).
+
+        This is the speculative-decoding VERIFY primitive: one pipelined
+        forward scores K proposed tokens instead of K serial decode
+        steps. K is static per call site (one compiled program per
+        distinct span length x attend bucket). With an int8 cache the
+        in-span rows are attended unquantized (exactly like the current
+        row of a plain decode step), so span scoring of K tokens is not
+        bit-identical to K serial int8 steps — fp caches are exact."""
+        tokens = jnp.asarray(tokens, jnp.int32)
+        _, k = tokens.shape
+        if pos + k > self.max_len:
+            raise ValueError(f"span [{pos}, {pos + k}) exceeds max_len "
+                             f"{self.max_len}")
+        data = tokens
+        for i, st in enumerate(self.stages):
+            if st["device"] is not None:
+                data = jax.device_put(data, st["device"])
+            data, caches[i] = self._decode_step(st, data, caches[i], pos,
+                                                span=k)
+        return data, caches
 
     def generate(self, ids, new_tokens: int, temperature: float = 0.0,
                  top_k: int = 0, seed: int = 0, step_callback=None,

@@ -1,0 +1,142 @@
+"""Speculative decoding: a draft pipeline proposes, the target verifies.
+
+NEW capability beyond the reference (whose model list is encoder-only;
+SURVEY.md §2.4 — no decode subsystem at all). TPU-first design:
+
+- **Greedy-exact**: output is token-identical to `target.generate(...,
+  temperature=0)` for fp caches — verification accepts exactly the draft
+  tokens the target itself would have produced, and the first mismatch is
+  replaced by the target's own argmax. Acceptance only changes HOW MANY
+  target dispatches the sequence costs, never the tokens.
+- **Static shapes**: every round runs ONE target `extend()` over a fixed
+  (gamma+1)-token span — a single compiled program per attend bucket —
+  plus gamma-1 draft single steps and a 1-or-2-token draft catch-up
+  span. No data-dependent shapes; acceptance is host-side control flow
+  between dispatches, exactly like the pipeline's other host drivers.
+- **Batch-safe**: drafts are per-row; a round accepts the MINIMUM
+  accepted prefix across rows. Rows that matched deeper simply re-derive
+  those tokens next round — greedy is deterministic, so exactness is
+  unaffected (this trades a little wasted compute for scalar `pos`
+  bookkeeping and static shapes, the TPU-friendly end of the trade).
+- **Cache discipline**: rejected proposals leave K/V rows beyond the
+  committed position; every such row is overwritten by the next round's
+  span write before any query can attend it (the span mask keeps
+  k_pos <= q_pos), so rollback is free — the committed position IS the
+  rollback state.
+
+The draft can be any pipeline over the same vocabulary (typically a much
+smaller model). Speedup = (accepted+1 tokens per verify) vs (1 token per
+target step); acceptance depends on draft/target agreement, so the
+measured `acceptance_rate` is reported alongside tokens.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .decode import DecodePipeline, validate_capacity
+
+__all__ = ["SpeculativeDecoder"]
+
+
+class SpeculativeDecoder:
+    """Greedy speculative decoding over two `DecodePipeline`s.
+
+    `gamma` is the draft lookahead per round: the draft proposes gamma
+    tokens, one target `extend()` scores all of them plus a bonus
+    position. gamma is fixed for the whole generation so the verify span
+    compiles once per attend bucket.
+    """
+
+    def __init__(self, target: DecodePipeline, draft: DecodePipeline,
+                 gamma: int = 4):
+        if gamma < 1:
+            raise ValueError(f"gamma must be >= 1, got {gamma}")
+        if target.cfg.vocab_size != draft.cfg.vocab_size:
+            raise ValueError(
+                "draft and target must share a vocabulary: "
+                f"{draft.cfg.vocab_size} vs {target.cfg.vocab_size}")
+        for name, pipe in (("target", target), ("draft", draft)):
+            cfg = pipe.cfg
+            if cfg.n_experts and cfg.capacity_factor < cfg.n_experts:
+                # capacity routing is not per-token: a verify span routes
+                # its tokens jointly, which serial decode steps cannot
+                # reproduce — the greedy-exact guarantee would not hold
+                raise ValueError(
+                    f"capacity-bounded MoE {name} breaks the greedy-exact "
+                    "guarantee (span routing != per-step routing); use a "
+                    "dropless config (capacity_factor >= n_experts)")
+        self.target = target
+        self.draft = draft
+        self.gamma = gamma
+        self.last_acceptance_rate: Optional[float] = None
+
+    def generate(self, ids, new_tokens: int):
+        """Greedy-decode `new_tokens` continuations of prompt `ids`
+        [B, S]; returns [B, S + new_tokens] (prompt included), token-
+        identical to `target.generate(ids, new_tokens)` for fp caches.
+        Sets `last_acceptance_rate` (accepted drafts / proposed drafts)."""
+        ids = jnp.asarray(ids, jnp.int32)
+        batch, prompt_len = ids.shape
+        if new_tokens <= 0:
+            return ids
+        g = self.gamma
+        # worst case writes a full span past the last emitted token
+        validate_capacity(self.target.cfg, self.target.max_len,
+                          prompt_len, new_tokens + g)
+        validate_capacity(self.draft.cfg, self.draft.max_len,
+                          prompt_len, new_tokens + g)
+
+        t_out, t_caches = self.target._prefill(ids)
+        _, d_caches = self.draft._prefill(ids)
+        pending = np.asarray(
+            jnp.argmax(t_out[:, prompt_len - 1].astype(jnp.float32), -1),
+            np.int32)                       # [B] first continuation token
+        out = [pending]                     # committed tokens == ids ++ out
+        t_pos = prompt_len   # target cache rows [0, t_pos) are committed
+        d_pos = prompt_len   # ditto for the draft
+        proposed = accepted = 0
+
+        while len(out) < new_tokens:
+            # --- draft: catch up on committed tokens it hasn't seen
+            # (1 token normally, 2 after a fully-accepted round; d_pos
+            # never falls below prompt_len so the slice stays in `out`),
+            # then propose gamma tokens autoregressively
+            catch = np.stack(out[d_pos - prompt_len:], axis=1)  # [B, 1|2]
+            d_logits, d_caches = self.draft.extend(catch, d_caches, d_pos)
+            d_pos += catch.shape[1]
+            props = [np.asarray(
+                jnp.argmax(d_logits[:, -1].astype(jnp.float32), -1),
+                np.int32)]
+            for _ in range(g - 1):
+                d_logits, d_caches = self.draft.extend(
+                    props[-1][:, None], d_caches, d_pos)
+                props.append(np.asarray(
+                    jnp.argmax(d_logits[:, -1].astype(jnp.float32), -1),
+                    np.int32))
+                d_pos += 1
+
+            # --- target: one span forward scores pending + all proposals
+            span = np.stack([pending] + props, axis=1)      # [B, g+1]
+            t_logits, t_caches = self.target.extend(span, t_caches, t_pos)
+            targets = np.asarray(
+                jnp.argmax(t_logits.astype(jnp.float32), -1), np.int32)
+
+            # --- accept the minimum matching prefix across rows
+            a = 0
+            while a < g and bool(np.all(props[a] == targets[:, a])):
+                a += 1
+            proposed += g
+            accepted += a
+            out.extend(props[:a] + [targets[:, a]])  # drafts + correction
+            pending = targets[:, a]
+            t_pos += a + 1
+            # draft rows hold [pending, p1..p_{g-1}] from this round's
+            # catch-up+proposals; committed among them: pending..p_a
+            d_pos = t_pos - 1 if a == g else t_pos
+
+        self.last_acceptance_rate = accepted / proposed if proposed else None
+        gen = jnp.asarray(np.stack(out[:new_tokens], axis=1))
+        return jnp.concatenate([ids, gen], axis=1)

@@ -190,3 +190,19 @@ def test_runtime_spmd_sp_mesh(tmp_path):
         timeout=300)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "latency_sec=" in proc.stdout
+
+
+def test_measure_rounds_reports_cold_and_warm(tmp_path):
+    """--measure-rounds N re-runs the ubatch stream, printing a latency
+    line per round (round 0 pays the XLA compiles) ahead of the final
+    plain stats line; results/accuracy counting stays per-round exact."""
+    proc = _run(tmp_path, "0", "1", "-m", MODEL, "-b", "4", "-u", "2",
+                "--measure-rounds", "3")
+    assert proc.returncode == 0, proc.stderr
+    rounds = [line for line in proc.stdout.splitlines()
+              if line.startswith("round=")]
+    assert [line.split()[0] for line in rounds] == \
+        ["round=0", "round=1", "round=2"]
+    # the final plain line repeats the LAST round's numbers
+    assert _throughput(proc) == float(
+        rounds[-1].split("throughput_items_sec=")[1])

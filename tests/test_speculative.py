@@ -1,0 +1,158 @@
+"""Speculative decoding: greedy-exact vs the plain pipeline.
+
+The guarantee under test (parallel/speculative.py): for fp caches,
+SpeculativeDecoder.generate is token-identical to the target pipeline's
+own greedy generate, for ANY draft over the same vocabulary — acceptance
+only changes the dispatch count. Drafts here are independently-seeded
+(gpt2) or noise-perturbed (llama/mistral) models, so rounds exercise
+both accepted and rejected prefixes.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pipeedge_tpu.models import registry
+from pipeedge_tpu.parallel import decode
+from pipeedge_tpu.parallel.speculative import SpeculativeDecoder
+
+MAX_LEN = 48
+
+
+def _pipe(name, partition=None, seed_perturb=None, max_len=MAX_LEN,
+          **kw):
+    cfg = registry.get_model_config(name)
+    total = registry.get_model_layers(name)
+    partition = partition or [(1, total)]
+    family = registry.get_model_entry(name).family.FAMILY
+    params = []
+    for i, (l, r) in enumerate(partition):
+        _, p, _ = registry.module_shard_factory(name, None, l, r,
+                                                unroll=False)
+        if seed_perturb is not None:
+            rng = np.random.default_rng(seed_perturb + i)
+            p = jax.tree_util.tree_map(
+                lambda x: x + jnp.asarray(
+                    rng.normal(scale=0.02, size=x.shape), x.dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, p)
+        params.append(p)
+    return decode.DecodePipeline(family, cfg, partition, params,
+                                 max_len=max_len, **kw)
+
+
+@pytest.fixture(scope="module")
+def gpt2_pipes():
+    return _pipe("pipeedge/test-tiny-gpt2"), \
+        _pipe("pipeedge/test-tiny-gpt2", seed_perturb=11)
+
+
+def _ids(batch, prompt_len, vocab=100, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, vocab, size=(batch, prompt_len))
+
+
+@pytest.mark.parametrize("gamma", [1, 2, 4])
+@pytest.mark.parametrize("batch", [1, 3])
+def test_spec_greedy_exact_gpt2(gpt2_pipes, gamma, batch):
+    target, draft = gpt2_pipes
+    ids = _ids(batch, 8)
+    want = np.asarray(target.generate(ids, 12))
+    spec = SpeculativeDecoder(target, draft, gamma=gamma)
+    got = np.asarray(spec.generate(ids, 12))
+    np.testing.assert_array_equal(got, want)
+    assert 0.0 <= spec.last_acceptance_rate <= 1.0
+
+
+def test_spec_self_draft_accepts_everything(gpt2_pipes):
+    """Draft == target: every proposal matches, acceptance 1.0, each
+    round commits gamma+1 tokens."""
+    target, _ = gpt2_pipes
+    ids = _ids(2, 8)
+    want = np.asarray(target.generate(ids, 10))
+    spec = SpeculativeDecoder(target, target, gamma=3)
+    got = np.asarray(spec.generate(ids, 10))
+    np.testing.assert_array_equal(got, want)
+    assert spec.last_acceptance_rate == 1.0
+
+
+def test_spec_multistage_target(gpt2_pipes):
+    """The verify span rides the pipeline stages like any decode."""
+    _, draft = gpt2_pipes
+    target = _pipe("pipeedge/test-tiny-gpt2", partition=[(1, 4), (5, 8)])
+    ids = _ids(2, 8)
+    want = np.asarray(target.generate(ids, 12))
+    got = np.asarray(
+        SpeculativeDecoder(target, draft, gamma=3).generate(ids, 12))
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("name", ["pipeedge/test-tiny-llama",
+                                  "pipeedge/test-tiny-mistral"])
+def test_spec_greedy_exact_llama_family(name):
+    """RoPE/GQA (and mistral's sliding window) under span verification."""
+    target = _pipe(name)
+    draft = _pipe(name, seed_perturb=23)
+    ids = _ids(2, 8)
+    want = np.asarray(target.generate(ids, 12))
+    spec = SpeculativeDecoder(target, draft, gamma=3)
+    got = np.asarray(spec.generate(ids, 12))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_extend_matches_serial_steps(gpt2_pipes):
+    """The verify primitive itself: one K-token extend produces the same
+    last-stage logits and cache state as K serial decode steps."""
+    target, _ = gpt2_pipes
+    ids = jnp.asarray(_ids(2, 8), jnp.int32)
+    k_span = 4
+    rng = np.random.default_rng(3)
+    toks = jnp.asarray(rng.integers(0, 100, size=(2, k_span)), jnp.int32)
+
+    _, caches_a = target._prefill(ids)
+    span_logits, caches_a = target.extend(toks, caches_a, 8)
+
+    _, caches_b = target._prefill(ids)
+    serial = []
+    for j in range(k_span):
+        data = toks[:, j:j + 1]
+        for i, st in enumerate(target.stages):
+            data, caches_b[i] = target._decode_step(st, data, caches_b[i],
+                                                    8 + j)
+        serial.append(data[:, 0])
+    np.testing.assert_allclose(np.asarray(span_logits),
+                               np.asarray(jnp.stack(serial, axis=1)),
+                               rtol=2e-5, atol=2e-5)
+    for ca, cb in zip(caches_a, caches_b):
+        for key in ca:
+            np.testing.assert_allclose(np.asarray(ca[key][:, :, :12]),
+                                       np.asarray(cb[key][:, :, :12]),
+                                       rtol=2e-5, atol=2e-5)
+
+
+def test_spec_moe_dropless_ok_capacity_refused():
+    """Dropless MoE keeps the greedy-exact guarantee; capacity-bounded
+    routing is refused with the reason."""
+    target = _pipe("pipeedge/test-tiny-moe")
+    draft = _pipe("pipeedge/test-tiny-moe", seed_perturb=5)
+    ids = _ids(2, 8)
+    want = np.asarray(target.generate(ids, 10))
+    got = np.asarray(
+        SpeculativeDecoder(target, draft, gamma=2).generate(ids, 10))
+    np.testing.assert_array_equal(got, want)
+
+    import dataclasses
+    target.cfg = dataclasses.replace(
+        registry.get_model_config("pipeedge/test-tiny-moe"),
+        capacity_factor=1.0)
+    with pytest.raises(ValueError, match="capacity-bounded"):
+        SpeculativeDecoder(target, draft, gamma=2)
+
+
+def test_spec_vocab_mismatch_refused(gpt2_pipes):
+    import dataclasses
+    target, draft = gpt2_pipes
+    odd = _pipe("pipeedge/test-tiny-gpt2")
+    odd.cfg = dataclasses.replace(odd.cfg, vocab_size=101)
+    with pytest.raises(ValueError, match="vocabulary"):
+        SpeculativeDecoder(target, odd)

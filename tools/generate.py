@@ -255,6 +255,14 @@ def main():
                              "combine with --tp for the tp x ep serving "
                              "mesh (attention tp-sharded, experts "
                              "ep-sharded)")
+    parser.add_argument("--draft-model", default=None,
+                        help="speculative decoding: this (smaller, same-"
+                             "vocabulary) model proposes --gamma tokens "
+                             "per round, one target span forward verifies "
+                             "them; output is token-identical to plain "
+                             "greedy decoding of the main model")
+    parser.add_argument("--gamma", default=4, type=int,
+                        help="draft lookahead per speculative round")
     parser.add_argument("--temperature", default=0.0, type=float,
                         help="sampling temperature (0 = greedy)")
     parser.add_argument("--top-k", default=0, type=int,
@@ -334,6 +342,8 @@ def main():
     else:
         partition = [(1, total)]
     max_len = args.max_len or args.prompt_len + args.new_tokens
+    if args.draft_model and args.max_len is None:
+        max_len += args.gamma   # verify spans write past the last token
     if args.beams and args.temperature > 0:
         parser.error("--beams and --temperature are mutually exclusive")
     if args.beams and args.monitor:
@@ -418,6 +428,37 @@ def main():
                                  safe=False)
 
     ids = prompt_ids(args, cfg)
+    if args.draft_model:
+        if (args.temperature > 0 or args.top_k or args.beams
+                or args.concurrent or args.monitor or args.spmd_wave
+                or args.prefill_ubatch or args.dcn_addrs is not None
+                or args.kv_bits):
+            parser.error("--draft-model is greedy-exact speculative "
+                         "decoding; it does not compose with sampling/"
+                         "--beams/--concurrent/--monitor/--spmd-wave/"
+                         "--prefill-ubatch/--dcn-addrs, nor --kv-bits "
+                         "(int8 span verification is not bit-identical "
+                         "to serial int8 steps)")
+        from pipeedge_tpu.parallel.speculative import SpeculativeDecoder
+        d_total = registry.get_model_layers(args.draft_model)
+        _, d_params, _ = registry.module_shard_factory(
+            args.draft_model, None, 1, d_total, dtype=dtype, unroll=False)
+        d_pipe = decode.DecodePipeline(
+            registry.get_model_entry(args.draft_model).family.FAMILY,
+            registry.get_model_config(args.draft_model), [(1, d_total)],
+            [d_params], max_len=max_len, dtype=dtype,
+            attend_floor=args.attend_floor)
+        spec = SpeculativeDecoder(pipe, d_pipe, gamma=args.gamma)
+        spec.generate(ids, min(2, args.new_tokens))   # compile programs
+        tik = time.monotonic()
+        out = np.asarray(spec.generate(ids, args.new_tokens))
+        dt = time.monotonic() - tik
+        rate = spec.last_acceptance_rate
+        print_summary(args, dt, out,
+                      f"{len(partition)} stages, speculative gamma="
+                      f"{args.gamma} draft={args.draft_model} acceptance="
+                      + (f"{rate:.2f}" if rate is not None else "n/a"))
+        return
     if args.concurrent:
         if args.beams or args.monitor or args.prefill_ubatch:
             parser.error("--concurrent composes with greedy/sampled "

@@ -64,7 +64,7 @@ def run_config(n: int, platform: str, dtype: str) -> dict:
                 "hint": "generate the TPU profile fixtures first "
                         "(profiles/README.md)"}
     cmd = [sys.executable, os.path.join(REPO, "runtime.py")] + spec["args"] \
-        + ["-t", dtype]
+        + ["-t", dtype, "--measure-rounds", "2"]
     if platform:
         cmd += ["--platform", platform]
     # APPEND to PYTHONPATH, never replace: the TPU plugin registers via a
@@ -89,9 +89,18 @@ def run_config(n: int, platform: str, dtype: str) -> dict:
     wall = time.monotonic() - tik
     result = {"config": n, "desc": spec["desc"], "rc": proc.returncode,
               "wall_s": round(wall, 1)}
+    rounds = re.findall(r"round=(\d+) latency_sec=([0-9.]+) "
+                        r"throughput_items_sec=([0-9.]+)", proc.stdout)
     match = re.search(r"latency_sec=([0-9.]+) throughput_items_sec=([0-9.]+)",
                       proc.stdout)
-    if match:
+    if rounds:
+        # round 0 = cold (XLA compiles included, the reference's
+        # single-shot methodology); last round = warm steady state
+        result["cold_latency_sec"] = float(rounds[0][1])
+        result["cold_items_per_sec"] = float(rounds[0][2])
+        result["latency_sec"] = float(rounds[-1][1])
+        result["items_per_sec"] = float(rounds[-1][2])
+    elif match:
         result["latency_sec"] = float(match.group(1))
         result["items_per_sec"] = float(match.group(2))
     else:
