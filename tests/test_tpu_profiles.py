@@ -29,6 +29,9 @@ def native_sched():
 @pytest.mark.parametrize("model,layers", [
     ("google/vit-base-patch16-224", 48),
     ("google/vit-large-patch16-224", 96),
+    ("bert-base-uncased", 48),
+    ("facebook/deit-base-distilled-patch16-224", 48),
+    ("gpt2", 48),
 ])
 def test_sched_pipeline_on_tpu_profiles(native_sched, model, layers):
     """The DP scheduler produces a full-coverage 4-stage partition over four
