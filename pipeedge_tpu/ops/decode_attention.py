@@ -1,0 +1,156 @@
+"""Fused int8-KV decode-step attention as a Pallas TPU kernel.
+
+The XLA int8 decode path (parallel/decode.py `_cache_update_and_read`)
+dequantizes the attended cache window to a full-precision [B, T, H, Dh]
+copy before the attend matmuls — XLA does not fuse elementwise producers
+into dot operands, so the dequantized K AND V copies are materialized
+through HBM every decode step. This kernel streams the int8 cache
+blocks into VMEM, dequantizes in-register, and runs the online-softmax
+attend — HBM reads stay int8 (plus the tiny per-(position, head) scale
+rows), roughly halving the decode step's dominant traffic.
+
+Semantics match the XLA path exactly where it matters:
+- the FRESH row (the token written at `pos` this step) is substituted
+  unquantized inside the kernel, mirroring the XLA path's
+  "freshly computed rows are in hand — attend over them exactly";
+- masking keeps cache positions [0, pos]; K/V blocks wholly past `pos`
+  are skipped (the streaming loop stops at the last live block, which
+  is also what the bucketed attend window achieves statically).
+
+Scope: the classic single-token decode step of MHA families
+(kv_heads == num heads, no sliding window) — the hot serving path.
+Span (speculative verify), GQA, and windowed attention stay on the XLA
+path. `pos` reaches the kernel via scalar prefetch (it is traced; the
+window width is static).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _kernel(pos_ref, q_ref, kq_ref, ks_ref, kz_ref, vq_ref, vs_ref, vz_ref,
+            kn_ref, vn_ref, o_ref, *, kv_block: int, scale: float):
+    """One batch cell, ALL heads at once: stream int8 K/V row-blocks,
+    dequantize in VMEM, online softmax per head over positions [0, pos].
+
+    The head axis stays in the block (TPU lowering requires the last two
+    block dims be full or tile-aligned, so a per-head grid would need a
+    layout transpose — materializing the copy this kernel exists to
+    avoid). At S_q=1 the attend is bandwidth-bound elementwise+reduce
+    work; everything maps to the VPU, no MXU involvement."""
+    pos = pos_ref[0]
+    q = q_ref[0, 0].astype(jnp.float32)                  # [H, Dh]
+    width, h, d = kq_ref.shape[1], q.shape[0], q.shape[1]
+    n_kv = width // kv_block
+
+    k_new = kn_ref[0, 0].astype(jnp.float32)             # [H, Dh]
+    v_new = vn_ref[0, 0].astype(jnp.float32)
+
+    def dequant(qv, s_ref, z_ref, i):
+        s = s_ref[0, pl.ds(i * kv_block, kv_block), :]   # [kb, H]
+        z = z_ref[0, pl.ds(i * kv_block, kv_block), :]
+        return (qv.astype(jnp.float32) + 128.0) * s[..., None] + z[..., None]
+
+    def body(i, carry):
+        m_prev, l_prev, acc = carry                      # [H] [H] [H, Dh]
+        rows = i * kv_block + jax.lax.broadcasted_iota(
+            jnp.int32, (kv_block, h), 0)                 # [kb, H]
+        k = dequant(kq_ref[0, pl.ds(i * kv_block, kv_block)],
+                    ks_ref, kz_ref, i)                   # [kb, H, Dh]
+        v = dequant(vq_ref[0, pl.ds(i * kv_block, kv_block)],
+                    vs_ref, vz_ref, i)
+        # 3D iota, not rows[..., None]: Mosaic only supports minor-dim
+        # insertion for 32-bit types, and the mask is boolean
+        fresh = (i * kv_block + jax.lax.broadcasted_iota(
+            jnp.int32, (kv_block, h, 1), 0)) == pos      # [kb, H, 1]
+        k = jnp.where(fresh, k_new[None], k)
+        v = jnp.where(fresh, v_new[None], v)
+        # round K/V (and below, the probs) through the pipeline dtype at
+        # the same points the XLA path does (_dequantize_rows -> dtype,
+        # probs.astype(dtype)); f32 pipelines make these no-ops. The
+        # online softmax still differs from the full softmax at the
+        # rounding level — flash-style accumulation is mathematically,
+        # not bitwise, equal.
+        k = k.astype(o_ref.dtype).astype(jnp.float32)
+        v = v.astype(o_ref.dtype).astype(jnp.float32)
+        scores = jnp.sum(q[None] * k, axis=-1) * scale   # [kb, H]
+        scores = jnp.where(rows <= pos, scores, _NEG_INF)
+        m_blk = jnp.max(scores, axis=0)                  # [H]
+        m_new = jnp.maximum(m_prev, m_blk)
+        p = jnp.exp(scores - m_new[None])                # [kb, H]
+        p = p.astype(o_ref.dtype).astype(jnp.float32)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=0)
+        acc = acc * corr[:, None] + jnp.sum(p[..., None] * v, axis=0)
+        return m_new, l_new, acc
+
+    m0 = jnp.full((h,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((h,), jnp.float32)
+    acc0 = jnp.zeros((h, d), jnp.float32)
+    n_live = jnp.minimum(pos // kv_block + 1, n_kv)   # skip dead blocks
+    _, l, acc = jax.lax.fori_loop(0, n_live, body, (m0, l0, acc0))
+    o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def _pick_block(width: int, preferred: int = 128) -> int:
+    block = min(preferred, width) // 8 * 8
+    while block >= 8:
+        if width % block == 0:
+            return block
+        block -= 8
+    return width
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def int8_decode_attention(q, k_q, k_scale, k_shift, v_q, v_scale, v_shift,
+                          k_new, v_new, pos, interpret: bool = False):
+    """Fused decode-step attention over an int8 cache window.
+
+    q/k_new/v_new: [B, 1, H, Dh]; k_q/v_q: [B, T, H, Dh] int8;
+    scales/shifts: [B, T, H] float32; `pos` traced scalar. Returns
+    [B, 1, H*Dh] context, matching `_attend`'s output layout."""
+    b, _, h, d = q.shape
+    width = k_q.shape[1]
+    kv_block = _pick_block(width)
+    scale = 1.0 / (d ** 0.5)
+    kernel = functools.partial(_kernel, kv_block=kv_block, scale=scale)
+    batch_row = lambda b_, *_: (b_, 0, 0, 0)
+    batch_row3 = lambda b_, *_: (b_, 0, 0)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, 1, h, d), batch_row),        # q
+            pl.BlockSpec((1, width, h, d), batch_row),    # k_q
+            pl.BlockSpec((1, width, h), batch_row3),      # k_scale
+            pl.BlockSpec((1, width, h), batch_row3),      # k_shift
+            pl.BlockSpec((1, width, h, d), batch_row),    # v_q
+            pl.BlockSpec((1, width, h), batch_row3),      # v_scale
+            pl.BlockSpec((1, width, h), batch_row3),      # v_shift
+            pl.BlockSpec((1, 1, h, d), batch_row),        # k_new
+            pl.BlockSpec((1, 1, h, d), batch_row),        # v_new
+        ],
+        out_specs=pl.BlockSpec((1, 1, h, d), batch_row),
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, 1, h, d), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(jnp.asarray(pos, jnp.int32).reshape(1), q, k_q,
+      k_scale.astype(jnp.float32), k_shift.astype(jnp.float32), v_q,
+      v_scale.astype(jnp.float32), v_shift.astype(jnp.float32),
+      k_new, v_new)
+    return out.reshape(b, 1, h * d)
+
+
+def int8_decode_attention_supported() -> bool:
+    """Native lowering needs a TPU; elsewhere interpret mode (tests)."""
+    return jax.default_backend() == "tpu"

@@ -109,6 +109,67 @@ def _attend(q: jax.Array, k: jax.Array, v: jax.Array, keep: jax.Array,
     return ctx.reshape(b, s, h * hd)
 
 
+def _attend_width(bcache: Cache, read_len: Optional[int]) -> int:
+    """Static attend-window width: the full cache, truncated to the
+    bucketed `read_len` when one is bound — THE window formula, shared
+    by the XLA read path and the Pallas kernel route so they can never
+    attend different windows."""
+    t_max = bcache["k"].shape[1]
+    return t_max if read_len is None else min(read_len, t_max)
+
+
+def _cache_write_quantized(bcache: Cache, k_new: jax.Array,
+                           v_new: jax.Array, start) -> Cache:
+    """Quantize the new K/V rows and write them (plus their per-(position,
+    head) scale/shift rows) at `start` — the single int8 write path,
+    shared by the XLA read path and the fused Pallas decode kernel."""
+    bcache = dict(bcache)
+    for t, new in (("k", k_new), ("v", v_new)):
+        qv, scale, shift = _quantize_rows(new)
+        bcache[t] = jax.lax.dynamic_update_slice(bcache[t], qv, start)
+        bcache[f"{t}_scale"] = jax.lax.dynamic_update_slice(
+            bcache[f"{t}_scale"], scale, start[:3])
+        bcache[f"{t}_shift"] = jax.lax.dynamic_update_slice(
+            bcache[f"{t}_shift"], shift, start[:3])
+    return bcache
+
+
+# per-tensor int8 window bytes the kernel may stage in VMEM: the window
+# is loaded whole per batch cell (grid is (batch,)), so huge unbucketed
+# windows must stay on the XLA path instead of dying in Mosaic lowering
+_INT8_KERNEL_VMEM_CAP = 4 << 20
+
+
+def _use_int8_decode_kernel(bcache: Cache, s: int, cfg: TransformerConfig,
+                            width: int) -> Optional[bool]:
+    """Route the classic int8 single-token decode step through the fused
+    Pallas kernel (ops/decode_attention.py): MHA only (kv_heads == query
+    heads), no sliding window, attend window small enough for VMEM —
+    GQA/windowed/span/huge-window cases stay on the XLA
+    dequantize-then-attend path. Static (trace-time) decision.
+
+    Returns None (use the XLA path), False (use the kernel, native
+    lowering), or True (use the kernel in interpret mode — forcing it
+    on a non-TPU backend, for tests). OPT-IN via env
+    PIPEEDGE_INT8_DECODE_ATTEND=1 (empty/0 means off): an isolated
+    chip microbench measured the kernel at parity-to-slower vs XLA's
+    dequantize-then-attend (docs/DECODE.md), so the default stays on
+    the XLA path; the kernel is kept, exactness-tested, as the
+    experimental base for revisiting the fusion."""
+    import os
+    if s != 1 or "k_scale" not in bcache:
+        return None
+    if cfg.kv_heads != cfg.num_attention_heads or cfg.sliding_window:
+        return None
+    if width * cfg.kv_heads * cfg.head_dim > _INT8_KERNEL_VMEM_CAP:
+        return None
+    env = (os.getenv("PIPEEDGE_INT8_DECODE_ATTEND") or "").strip().lower()
+    if not env or env in ("0", "false", "no", "off"):
+        return None
+    from ..ops.decode_attention import int8_decode_attention_supported
+    return not int8_decode_attention_supported()
+
+
 def _cache_update_and_read(bcache: Cache, k_new: jax.Array, v_new: jax.Array,
                            pos, prefill: bool, s: int, dtype,
                            read_len: Optional[int] = None) \
@@ -123,19 +184,11 @@ def _cache_update_and_read(bcache: Cache, k_new: jax.Array, v_new: jax.Array,
     matmul and (for int8 caches) the dequantize shrink from max_len to
     read_len — the bucketed decode-step optimization
     (DecodePipeline::attend_bucket)."""
-    t_max = bcache["k"].shape[1]
-    width = t_max if read_len is None else min(read_len, t_max)
+    width = _attend_width(bcache, read_len)
     quantized = "k_scale" in bcache
-    bcache = dict(bcache)
     start = (0, 0, 0, 0) if prefill else (0, pos, 0, 0)
     if quantized:
-        for t, new in (("k", k_new), ("v", v_new)):
-            qv, scale, shift = _quantize_rows(new)
-            bcache[t] = jax.lax.dynamic_update_slice(bcache[t], qv, start)
-            bcache[f"{t}_scale"] = jax.lax.dynamic_update_slice(
-                bcache[f"{t}_scale"], scale, start[:3])
-            bcache[f"{t}_shift"] = jax.lax.dynamic_update_slice(
-                bcache[f"{t}_shift"], shift, start[:3])
+        bcache = _cache_write_quantized(bcache, k_new, v_new, start)
         # dequantize only the attended window
         k = _dequantize_rows(bcache["k"][:, :width],
                              bcache["k_scale"][:, :width],
@@ -148,6 +201,7 @@ def _cache_update_and_read(bcache: Cache, k_new: jax.Array, v_new: jax.Array,
         k = jax.lax.dynamic_update_slice(k, k_new.astype(dtype), start)
         v = jax.lax.dynamic_update_slice(v, v_new.astype(dtype), start)
     else:
+        bcache = dict(bcache)   # don't mutate the caller's dict
         for t, new in (("k", k_new), ("v", v_new)):
             bcache[t] = jax.lax.dynamic_update_slice(
                 bcache[t], new.astype(bcache[t].dtype), start)
@@ -199,6 +253,19 @@ def _attention_core(p: Dict, x: jax.Array, bcache: Cache, pos,
     shared by the plain and expert-parallel decode steps."""
     normed = layer_norm(p["ln_before"], x, cfg.layer_norm_eps)
     q, k_new, v_new = _qkv(p, normed, cfg)
+    w = _attend_width(bcache, read_len) if "k" in bcache else 0
+    interpret = (None if prefill
+                 else _use_int8_decode_kernel(bcache, x.shape[1], cfg, w))
+    if interpret is not None:
+        from ..ops.decode_attention import int8_decode_attention
+        bcache = _cache_write_quantized(bcache, k_new, v_new,
+                                        (0, pos, 0, 0))
+        ctx = int8_decode_attention(
+            q, bcache["k"][:, :w], bcache["k_scale"][:, :w],
+            bcache["k_shift"][:, :w], bcache["v"][:, :w],
+            bcache["v_scale"][:, :w], bcache["v_shift"][:, :w],
+            k_new, v_new, pos, interpret=interpret)
+        return ctx, bcache
     k, v, keep, bcache = _cache_update_and_read(
         bcache, k_new, v_new, pos, prefill, x.shape[1], q.dtype,
         read_len=read_len)

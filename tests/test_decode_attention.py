@@ -1,0 +1,129 @@
+"""Fused int8 decode-attention Pallas kernel vs the XLA dequantize path.
+
+The kernel (ops/decode_attention.py) must reproduce the XLA int8 decode
+step's semantics: dequantized cache reads, EXACT fresh-row substitution,
+[0, pos] masking. Interpret mode on CPU; the same code lowers natively
+on TPU (bench_decode's int8 rows exercise it there)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pipeedge_tpu.models import registry
+from pipeedge_tpu.ops import decode_attention
+from pipeedge_tpu.parallel import decode
+
+
+def test_kernel_matches_xla_dequant_attend():
+    """Direct kernel check against the reference computation."""
+    rng = np.random.default_rng(0)
+    b, t, h, d = 2, 24, 4, 16
+    pos = 13
+    k_rows = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+    v_rows = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+    q = jnp.asarray(rng.normal(size=(b, 1, h, d)), jnp.float32)
+    k_new = jnp.asarray(rng.normal(size=(b, 1, h, d)), jnp.float32)
+    v_new = jnp.asarray(rng.normal(size=(b, 1, h, d)), jnp.float32)
+
+    kq, ks, kz = decode._quantize_rows(k_rows)
+    vq, vs, vz = decode._quantize_rows(v_rows)
+
+    got = decode_attention.int8_decode_attention(
+        q, kq, ks, kz, vq, vs, vz, k_new, v_new, pos, interpret=True)
+
+    # reference: the XLA path's math
+    k = decode._dequantize_rows(kq, ks, kz, jnp.float32)
+    v = decode._dequantize_rows(vq, vs, vz, jnp.float32)
+    k = k.at[:, pos:pos + 1].set(k_new)
+    v = v.at[:, pos:pos + 1].set(v_new)
+    keep = (jnp.arange(t) <= pos)[None, :]
+    cfg = registry.get_model_config("pipeedge/test-tiny-gpt2")
+    want = decode._attend(q, k, v, keep, cfg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.slow
+def test_int8_pipeline_tokens_match_with_kernel(monkeypatch):
+    """End-to-end: the int8 pipeline generates the same tokens with the
+    fused kernel (interpret mode) as with the XLA dequantize path."""
+    name = "pipeedge/test-tiny-gpt2"
+    cfg = registry.get_model_config(name)
+    total = registry.get_model_layers(name)
+    _, params, _ = registry.module_shard_factory(name, None, 1, total,
+                                                 unroll=False)
+    fam = registry.get_model_entry(name).family.FAMILY
+    rng = np.random.default_rng(5)
+    ids = rng.integers(0, cfg.vocab_size, size=(2, 8))
+
+    def generate():
+        pipe = decode.DecodePipeline(fam, cfg, [(1, total)], [params],
+                                     max_len=32, cache_bits=8)
+        return np.asarray(pipe.generate(ids, 10))
+
+    monkeypatch.setenv("PIPEEDGE_INT8_DECODE_ATTEND", "0")
+    want = generate()
+    monkeypatch.setenv("PIPEEDGE_INT8_DECODE_ATTEND", "1")
+    got = generate()
+    np.testing.assert_array_equal(got, want)
+
+
+def test_kernel_gate_scope(monkeypatch):
+    """The kernel only takes the MHA single-token path: spans, GQA,
+    sliding-window, and VMEM-overflowing windows stay on the XLA path
+    (gate returns None); the env override forces interpret mode off-TPU
+    and empty means unset."""
+    import dataclasses
+    cfg = registry.get_model_config("pipeedge/test-tiny-gpt2")
+    cache8 = {"k_scale": None}
+    monkeypatch.delenv("PIPEEDGE_INT8_DECODE_ATTEND", raising=False)
+    # span / fp cache / GQA / window / huge window never route
+    assert decode._use_int8_decode_kernel(cache8, 2, cfg, 64) is None
+    assert decode._use_int8_decode_kernel({}, 1, cfg, 64) is None
+    gqa = dataclasses.replace(cfg, num_kv_heads=2, num_attention_heads=4)
+    assert decode._use_int8_decode_kernel(cache8, 1, gqa, 64) is None
+    windowed = dataclasses.replace(cfg, sliding_window=4)
+    assert decode._use_int8_decode_kernel(cache8, 1, windowed, 64) is None
+    huge = decode._INT8_KERNEL_VMEM_CAP // (cfg.kv_heads * cfg.head_dim) + 8
+    monkeypatch.setenv("PIPEEDGE_INT8_DECODE_ATTEND", "1")
+    assert decode._use_int8_decode_kernel(cache8, 1, cfg, huge) is None
+    # opt-in: default (unset/empty/0) stays on the XLA path; =1 enables
+    # (interpret mode on this TPU-less host)
+    monkeypatch.delenv("PIPEEDGE_INT8_DECODE_ATTEND", raising=False)
+    assert decode._use_int8_decode_kernel(cache8, 1, cfg, 64) is None
+    monkeypatch.setenv("PIPEEDGE_INT8_DECODE_ATTEND", "1")
+    assert decode._use_int8_decode_kernel(cache8, 1, cfg, 64) is True
+    monkeypatch.setenv("PIPEEDGE_INT8_DECODE_ATTEND", "0")
+    assert decode._use_int8_decode_kernel(cache8, 1, cfg, 64) is None
+    monkeypatch.setenv("PIPEEDGE_INT8_DECODE_ATTEND", "")
+    assert decode._use_int8_decode_kernel(cache8, 1, cfg, 64) is None
+
+
+@pytest.mark.slow
+def test_int8_pipeline_bf16_tokens_match_with_kernel(monkeypatch):
+    """bf16 pipeline (the realistic serving dtype): kernel and XLA paths
+    share cast points (dequant -> dtype, probs -> dtype), so tokens
+    match on the tiny model; the flash-style softmax ordering is the
+    only remaining numeric difference."""
+    name = "pipeedge/test-tiny-gpt2"
+    cfg = registry.get_model_config(name)
+    total = registry.get_model_layers(name)
+    _, params, _ = registry.module_shard_factory(name, None, 1, total,
+                                                 dtype=jnp.bfloat16,
+                                                 unroll=False)
+    fam = registry.get_model_entry(name).family.FAMILY
+    rng = np.random.default_rng(9)
+    ids = rng.integers(0, cfg.vocab_size, size=(2, 8))
+
+    def generate():
+        pipe = decode.DecodePipeline(fam, cfg, [(1, total)], [params],
+                                     max_len=32, cache_bits=8,
+                                     dtype=jnp.bfloat16)
+        return np.asarray(pipe.generate(ids, 10))
+
+    monkeypatch.setenv("PIPEEDGE_INT8_DECODE_ATTEND", "0")
+    want = generate()
+    monkeypatch.setenv("PIPEEDGE_INT8_DECODE_ATTEND", "1")
+    got = generate()
+    np.testing.assert_array_equal(got, want)

@@ -156,3 +156,18 @@ def test_spec_vocab_mismatch_refused(gpt2_pipes):
     odd.cfg = dataclasses.replace(odd.cfg, vocab_size=101)
     with pytest.raises(ValueError, match="vocabulary"):
         SpeculativeDecoder(target, odd)
+
+
+def test_spec_tp_target():
+    """A tensor-parallel target (head-sharded cache under shard_map)
+    verifies spans like the plain pipeline: spec == plain greedy."""
+    from jax.sharding import Mesh
+    target_plain = _pipe("pipeedge/test-tiny-gpt2")
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    target_tp = _pipe("pipeedge/test-tiny-gpt2", mesh=mesh)
+    draft = _pipe("pipeedge/test-tiny-gpt2", seed_perturb=11)
+    ids = _ids(2, 8)
+    want = np.asarray(target_plain.generate(ids, 12))
+    got = np.asarray(
+        SpeculativeDecoder(target_tp, draft, gamma=3).generate(ids, 12))
+    np.testing.assert_array_equal(got, want)
