@@ -1068,9 +1068,32 @@ class DecodePipeline:
                                                 span=k)
         return data, caches
 
+    def precompute_prefix(self, prefix_ids) -> Dict:
+        """Prefill a shared prompt PREFIX once, for reuse across requests
+        (prompt caching): returns an opaque handle for `generate(...,
+        prefix=)`. `prefix_ids` is [P] or [1, P]; the cached K/V rows are
+        broadcast to each request batch at use. Exact for fp caches
+        (suffix tokens attend prefix K/V exactly as a monolithic prefill
+        would); with int8 caches the monolithic prefill attends its own
+        prompt rows unquantized, so prefix reuse introduces the cached
+        rows' quantization error — same caveat class as chunked
+        prefill's routing note."""
+        ids = jnp.asarray(prefix_ids, jnp.int32)
+        if ids.ndim == 1:
+            ids = ids[None]
+        if ids.shape[0] != 1:
+            raise ValueError("a shared prefix is one sequence; got batch "
+                             f"{ids.shape[0]}")
+        if ids.shape[1] % self.sp_degree:
+            raise ValueError(f"prefix length {ids.shape[1]} not divisible "
+                             f"by the sp prefill degree {self.sp_degree}")
+        _, caches = self._prefill(ids)
+        return {"caches": caches, "len": ids.shape[1]}
+
     def generate(self, ids, new_tokens: int, temperature: float = 0.0,
                  top_k: int = 0, seed: int = 0, step_callback=None,
-                 prefill_ubatch: Optional[int] = None):
+                 prefill_ubatch: Optional[int] = None,
+                 prefix: Optional[Dict] = None):
         """Decode `new_tokens` continuations of prompt `ids` [B, S].
 
         `temperature=0` (default) is greedy argmax; otherwise tokens are
@@ -1078,22 +1101,36 @@ class DecodePipeline:
         `top_k` most likely. `step_callback(step, tokens)` fires after each
         decode step (e.g. for monitoring heartbeats). `prefill_ubatch`
         pipelines the prompt pass across stages in batch chunks (see
-        `_prefill`). Returns [B, S + new_tokens] token ids (prompt
-        included)."""
+        `_prefill`). `prefix` (from `precompute_prefix`) seeds the caches
+        with a shared prompt prefix; `ids` is then each request's SUFFIX,
+        run as one span at the prefix offset instead of a fresh prefill.
+        Returns [B, S + new_tokens] token ids (the prefix is not
+        included in the returned array)."""
         ids = jnp.asarray(ids, jnp.int32)
-        batch, prompt_len = ids.shape
+        batch, suffix_len = ids.shape
+        prompt_len = suffix_len + (prefix["len"] if prefix else 0)
         if new_tokens <= 0:
             return ids
         validate_capacity(self.cfg, self.max_len, prompt_len, new_tokens)
-        if prompt_len % self.sp_degree:
+        if prefix is None and prompt_len % self.sp_degree:
             raise ValueError(f"prompt length {prompt_len} not divisible by "
                              f"the sp prefill degree {self.sp_degree}")
         rng = jax.random.PRNGKey(seed)
         pick = make_token_picker(temperature, top_k)
 
-        data, caches = self._prefill(ids, prefill_ubatch)
+        if prefix is not None:
+            if prefill_ubatch is not None:
+                raise ValueError("prefix reuse runs the suffix as one "
+                                 "span; --prefill-ubatch does not apply")
+            # broadcast the prefix's B=1 cache rows to this batch (the
+            # beam-search batch-tiling rule), then run the whole suffix
+            # as one span at the prefix offset
+            caches = [_repeat_batch(c, batch) for c in prefix["caches"]]
+            data, caches = self.extend(ids, caches, prefix["len"])
+        else:
+            data, caches = self._prefill(ids, prefill_ubatch)
         rng, sub = jax.random.split(rng)
-        tokens = [pick(data[:, prompt_len - 1].astype(jnp.float32), sub)]
+        tokens = [pick(data[:, -1].astype(jnp.float32), sub)]
         if step_callback is not None:
             step_callback(0, tokens[-1])
         for step in range(1, new_tokens):

@@ -171,3 +171,44 @@ def test_spec_tp_target():
     got = np.asarray(
         SpeculativeDecoder(target_tp, draft, gamma=3).generate(ids, 12))
     np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("name", ["pipeedge/test-tiny-gpt2",
+                                  "pipeedge/test-tiny-llama",
+                                  "pipeedge/test-tiny-mistral"])
+def test_prefix_cache_matches_full_prefill(name):
+    """Prompt caching: precompute_prefix + suffix-span generate ==
+    monolithic-prompt generate, token for token (fp caches), for every
+    decode family incl. RoPE at global offsets and sliding windows."""
+    pipe = _pipe(name)
+    rng = np.random.default_rng(17)
+    prefix = rng.integers(0, 100, size=(1, 6))
+    suffix = rng.integers(0, 100, size=(3, 5))
+    full = np.concatenate([np.repeat(prefix, 3, axis=0), suffix], axis=1)
+    want = np.asarray(pipe.generate(full, 10))
+    handle = pipe.precompute_prefix(prefix)
+    got = np.asarray(pipe.generate(suffix, 10, prefix=handle))
+    # the returned array omits the prefix; compare suffix + continuation
+    np.testing.assert_array_equal(got, want[:, 6:])
+    # the handle is reusable (a second batch, sampled decode)
+    suffix2 = rng.integers(0, 100, size=(2, 5))
+    full2 = np.concatenate([np.repeat(prefix, 2, axis=0), suffix2], axis=1)
+    want2 = np.asarray(pipe.generate(full2, 8, temperature=0.8, seed=3))
+    got2 = np.asarray(pipe.generate(suffix2, 8, temperature=0.8, seed=3,
+                                    prefix=handle))
+    np.testing.assert_array_equal(got2, want2[:, 6:])
+
+
+def test_prefix_cache_multistage_and_spec(gpt2_pipes):
+    """Prefix reuse rides multi-stage pipelines, and a prefix-seeded
+    request still matches the full-prompt run under a multi-stage
+    partition."""
+    target = _pipe("pipeedge/test-tiny-gpt2", partition=[(1, 4), (5, 8)])
+    rng = np.random.default_rng(21)
+    prefix = rng.integers(0, 100, size=(1, 4))
+    suffix = rng.integers(0, 100, size=(2, 4))
+    full = np.concatenate([np.repeat(prefix, 2, axis=0), suffix], axis=1)
+    want = np.asarray(target.generate(full, 8))
+    got = np.asarray(target.generate(
+        suffix, 8, prefix=target.precompute_prefix(prefix)))
+    np.testing.assert_array_equal(got, want[:, 4:])

@@ -276,6 +276,12 @@ def main():
     parser.add_argument("--prefill-ubatch", default=None, type=int,
                         help="pipeline the prompt pass across stages in "
                              "batch chunks of this size")
+    parser.add_argument("--shared-prefix", default=0, type=int,
+                        help="prompt caching: treat the first N prompt "
+                             "tokens as a prefix shared by every batch "
+                             "row — prefilled ONCE (precompute_prefix) "
+                             "and reused; the per-row suffixes run as "
+                             "one span at the prefix offset")
     parser.add_argument("--concurrent", default=0, type=int,
                         help="continuous batching: decode this many "
                              "concurrent requests (each of -b sequences) "
@@ -355,6 +361,17 @@ def main():
     if args.edge_bits and args.dcn_addrs is None and not args.spmd_wave:
         parser.error("--edge-bits applies to DCN stage edges or the SPMD "
                      "wave prefill hops; pass --dcn-addrs or --spmd-wave")
+    if args.shared_prefix and (
+            args.beams or args.concurrent or args.spmd_wave
+            or args.prefill_ubatch or args.draft_model
+            or args.dcn_addrs is not None):
+        # checked BEFORE mode dispatch: every one of these modes branches
+        # away earlier than the prefix path, which would otherwise
+        # silently ignore --shared-prefix
+        parser.error("--shared-prefix composes with plain greedy/sampled "
+                     "generation only (not --beams/--concurrent/"
+                     "--spmd-wave/--prefill-ubatch/--draft-model/"
+                     "--dcn-addrs)")
     if args.spmd_wave and (
             args.concurrent or args.beams or args.monitor
             or args.prefill_ubatch
@@ -491,6 +508,21 @@ def main():
         run = lambda n, cb=None: np.asarray(
             pipe.generate_beam(ids, n, beams=args.beams))
         label = f"{len(partition)} stages, beam {args.beams}"
+    elif args.shared_prefix:
+        if not 0 < args.shared_prefix < args.prompt_len:
+            parser.error(f"--shared-prefix must be in (0, "
+                         f"{args.prompt_len})")
+        p_len = args.shared_prefix
+        ids[:, :p_len] = ids[0, :p_len]   # rows share the prefix
+        handle = pipe.precompute_prefix(ids[:1, :p_len])
+        sample_kw = dict(temperature=args.temperature, top_k=args.top_k,
+                         seed=args.seed)
+        run = lambda n, cb=None: np.concatenate(
+            [ids[:, :p_len], np.asarray(pipe.generate(
+                ids[:, p_len:], n, step_callback=cb, prefix=handle,
+                **sample_kw))], axis=1)
+        label = (f"{len(partition)} stages, shared prefix {p_len} "
+                 "(prefilled once)")
     else:
         sample_kw = dict(temperature=args.temperature, top_k=args.top_k,
                          seed=args.seed, prefill_ubatch=args.prefill_ubatch)
