@@ -1122,6 +1122,11 @@ class DecodePipeline:
             if prefill_ubatch is not None:
                 raise ValueError("prefix reuse runs the suffix as one "
                                  "span; --prefill-ubatch does not apply")
+            if suffix_len == 0:
+                raise ValueError(
+                    "prefix reuse needs a non-empty suffix (the span "
+                    "produces the first token's logits); keep at least "
+                    "the last prompt token out of the prefix")
             # broadcast the prefix's B=1 cache rows to this batch (the
             # beam-search batch-tiling rule), then run the whole suffix
             # as one span at the prefix offset

@@ -199,10 +199,9 @@ def test_prefix_cache_matches_full_prefill(name):
     np.testing.assert_array_equal(got2, want2[:, 6:])
 
 
-def test_prefix_cache_multistage_and_spec(gpt2_pipes):
-    """Prefix reuse rides multi-stage pipelines, and a prefix-seeded
-    request still matches the full-prompt run under a multi-stage
-    partition."""
+def test_prefix_cache_multistage():
+    """Prefix reuse rides multi-stage pipelines: a prefix-seeded request
+    matches the full-prompt run under a multi-stage partition."""
     target = _pipe("pipeedge/test-tiny-gpt2", partition=[(1, 4), (5, 8)])
     rng = np.random.default_rng(21)
     prefix = rng.integers(0, 100, size=(1, 4))

@@ -372,6 +372,10 @@ def main():
                      "generation only (not --beams/--concurrent/"
                      "--spmd-wave/--prefill-ubatch/--draft-model/"
                      "--dcn-addrs)")
+    if args.shared_prefix and args.sp > 1 and args.shared_prefix % args.sp:
+        parser.error(f"--shared-prefix {args.shared_prefix} must divide "
+                     f"by --sp {args.sp} (the prefix is what the sp "
+                     "prefill runs on)")
     if args.spmd_wave and (
             args.concurrent or args.beams or args.monitor
             or args.prefill_ubatch
