@@ -73,13 +73,33 @@ class SpeculativeDecoder:
         self.gamma = gamma
         self.last_acceptance_rate: Optional[float] = None
 
-    def generate(self, ids, new_tokens: int):
+    def precompute_prefix(self, prefix_ids) -> dict:
+        """Prompt caching for speculative decoding: prefill the shared
+        prefix through BOTH pipelines (each model needs its own K/V) and
+        return one handle for `generate(..., prefix=)`."""
+        return {"target": self.target.precompute_prefix(prefix_ids),
+                "draft": self.draft.precompute_prefix(prefix_ids)}
+
+    def generate(self, ids, new_tokens: int, prefix: Optional[dict] = None):
         """Greedy-decode `new_tokens` continuations of prompt `ids`
         [B, S]; returns [B, S + new_tokens] (prompt included), token-
         identical to `target.generate(ids, new_tokens)` for fp caches.
-        Sets `last_acceptance_rate` (accepted drafts / proposed drafts)."""
+        Sets `last_acceptance_rate` (accepted drafts / proposed drafts).
+
+        `prefix` (from this decoder's `precompute_prefix`) seeds both
+        pipelines with a shared prompt prefix; `ids` is then each
+        request's SUFFIX (non-empty), and the returned array omits the
+        prefix — matching `DecodePipeline.generate`'s prefix contract."""
         ids = jnp.asarray(ids, jnp.int32)
-        batch, prompt_len = ids.shape
+        batch, suffix_len = ids.shape
+        base = prefix["target"]["len"] if prefix else 0
+        prompt_len = suffix_len + base
+        if prefix is not None:
+            if prefix["draft"]["len"] != base:
+                raise ValueError("target/draft prefix lengths differ: "
+                                 f"{base} vs {prefix['draft']['len']}")
+            if suffix_len == 0:
+                raise ValueError("prefix reuse needs a non-empty suffix")
         if new_tokens <= 0:
             return ids
         g = self.gamma
@@ -89,22 +109,39 @@ class SpeculativeDecoder:
         validate_capacity(self.draft.cfg, self.draft.max_len,
                           prompt_len, new_tokens + g)
 
-        t_out, t_caches = self.target._prefill(ids)
-        _, d_caches = self.draft._prefill(ids)
+        if prefix is None:
+            t_out, t_caches = self.target._prefill(ids)
+            _, d_caches = self.draft._prefill(ids)
+            # the draft has seen the whole prompt; catch-up tokens are
+            # all emitted ones
+            known = []
+        else:
+            from .decode import _repeat_batch
+            t_caches = [_repeat_batch(c, batch)
+                        for c in prefix["target"]["caches"]]
+            t_out, t_caches = self.target.extend(ids, t_caches, base)
+            d_caches = [_repeat_batch(c, batch)
+                        for c in prefix["draft"]["caches"]]
+            # the draft has seen only the prefix: its first catch-up
+            # span covers the whole suffix too (one transfer, [B] rows)
+            known = list(np.asarray(ids, np.int32).T)
         pending = np.asarray(
-            jnp.argmax(t_out[:, prompt_len - 1].astype(jnp.float32), -1),
+            jnp.argmax(t_out[:, -1].astype(jnp.float32), -1),
             np.int32)                       # [B] first continuation token
-        out = [pending]                     # committed tokens == ids ++ out
+        n_suffix = len(known)    # known = suffix tokens ++ emissions,
+        known.append(pending)    # sitting at positions [d_floor, ...)
+        d_floor = base if prefix else prompt_len
+        n_emitted = 1
         t_pos = prompt_len   # target cache rows [0, t_pos) are committed
-        d_pos = prompt_len   # ditto for the draft
+        d_pos = d_floor      # draft cache rows [0, d_pos) are committed
         proposed = accepted = 0
 
-        while len(out) < new_tokens:
+        while n_emitted < new_tokens:
             # --- draft: catch up on committed tokens it hasn't seen
-            # (1 token normally, 2 after a fully-accepted round; d_pos
-            # never falls below prompt_len so the slice stays in `out`),
-            # then propose gamma tokens autoregressively
-            catch = np.stack(out[d_pos - prompt_len:], axis=1)  # [B, 1|2]
+            # (suffix+pending on the first prefix-seeded round; then 1
+            # token normally, 2 after a fully-accepted round), then
+            # propose gamma tokens autoregressively
+            catch = np.stack(known[d_pos - d_floor:], axis=1)
             d_logits, d_caches = self.draft.extend(catch, d_caches, d_pos)
             d_pos += catch.shape[1]
             props = [np.asarray(
@@ -130,7 +167,8 @@ class SpeculativeDecoder:
                 a += 1
             proposed += g
             accepted += a
-            out.extend(props[:a] + [targets[:, a]])  # drafts + correction
+            known.extend(props[:a] + [targets[:, a]])  # drafts + correction
+            n_emitted += a + 1
             pending = targets[:, a]
             t_pos += a + 1
             # draft rows hold [pending, p1..p_{g-1}] from this round's
@@ -138,5 +176,6 @@ class SpeculativeDecoder:
             d_pos = t_pos - 1 if a == g else t_pos
 
         self.last_acceptance_rate = accepted / proposed if proposed else None
-        gen = jnp.asarray(np.stack(out[:new_tokens], axis=1))
+        gen = jnp.asarray(np.stack(known[n_suffix:n_suffix + new_tokens],
+                                   axis=1))
         return jnp.concatenate([ids, gen], axis=1)

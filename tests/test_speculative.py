@@ -211,3 +211,29 @@ def test_prefix_cache_multistage():
     got = np.asarray(target.generate(
         suffix, 8, prefix=target.precompute_prefix(prefix)))
     np.testing.assert_array_equal(got, want[:, 4:])
+
+
+@pytest.mark.parametrize("name", ["pipeedge/test-tiny-gpt2",
+                                  "pipeedge/test-tiny-llama"])
+def test_spec_with_prefix_cache(name):
+    """Speculative decoding composes with prompt caching: both pipelines
+    seed from the shared prefix (each with its own K/V), the first draft
+    catch-up span covers the whole suffix, and the output still equals
+    the target's plain full-prompt greedy decode."""
+    target = _pipe(name)
+    draft = _pipe(name, seed_perturb=31)
+    spec = SpeculativeDecoder(target, draft, gamma=3)
+    rng = np.random.default_rng(41)
+    prefix = rng.integers(0, 100, size=(1, 6))
+    suffix = rng.integers(0, 100, size=(2, 4))
+    full = np.concatenate([np.repeat(prefix, 2, axis=0), suffix], axis=1)
+    want = np.asarray(target.generate(full, 11))
+    handle = spec.precompute_prefix(prefix)
+    got = np.asarray(spec.generate(suffix, 11, prefix=handle))
+    np.testing.assert_array_equal(got, want[:, 6:])
+    # full acceptance path too (self-draft) with the same handle shape
+    spec2 = SpeculativeDecoder(target, target, gamma=2)
+    got2 = np.asarray(spec2.generate(
+        suffix, 11, prefix=spec2.precompute_prefix(prefix)))
+    np.testing.assert_array_equal(got2, want[:, 6:])
+    assert spec2.last_acceptance_rate == 1.0

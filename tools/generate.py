@@ -363,14 +363,14 @@ def main():
                      "wave prefill hops; pass --dcn-addrs or --spmd-wave")
     if args.shared_prefix and (
             args.beams or args.concurrent or args.spmd_wave
-            or args.prefill_ubatch or args.draft_model
-            or args.dcn_addrs is not None):
+            or args.prefill_ubatch or args.dcn_addrs is not None):
         # checked BEFORE mode dispatch: every one of these modes branches
         # away earlier than the prefix path, which would otherwise
-        # silently ignore --shared-prefix
-        parser.error("--shared-prefix composes with plain greedy/sampled "
-                     "generation only (not --beams/--concurrent/"
-                     "--spmd-wave/--prefill-ubatch/--draft-model/"
+        # silently ignore --shared-prefix (--draft-model composes: the
+        # speculative decoder takes its own prefix handle)
+        parser.error("--shared-prefix composes with plain or speculative "
+                     "greedy/sampled generation only (not --beams/"
+                     "--concurrent/--spmd-wave/--prefill-ubatch/"
                      "--dcn-addrs)")
     if args.shared_prefix and args.sp > 1 and args.shared_prefix % args.sp:
         parser.error(f"--shared-prefix {args.shared_prefix} must divide "
@@ -449,6 +449,17 @@ def main():
                                  safe=False)
 
     ids = prompt_ids(args, cfg)
+    p_len = args.shared_prefix
+    if p_len:
+        # ONE prefix setup for both the plain and speculative modes:
+        # validate, make every batch row share the prefix, and prepend
+        # it back onto generate()'s prefix-omitting output
+        if not 0 < p_len < args.prompt_len:
+            parser.error(f"--shared-prefix must be in (0, "
+                         f"{args.prompt_len})")
+        ids[:, :p_len] = ids[0, :p_len]
+        with_prefix = lambda out: np.concatenate([ids[:, :p_len], out],
+                                                 axis=1)
     if args.draft_model:
         if (args.temperature > 0 or args.top_k or args.beams
                 or args.concurrent or args.monitor or args.spmd_wave
@@ -470,14 +481,21 @@ def main():
             [d_params], max_len=max_len, dtype=dtype,
             attend_floor=args.attend_floor)
         spec = SpeculativeDecoder(pipe, d_pipe, gamma=args.gamma)
-        spec.generate(ids, min(2, args.new_tokens))   # compile programs
+        label = (f"{len(partition)} stages, speculative gamma="
+                 f"{args.gamma} draft={args.draft_model}")
+        if p_len:
+            handle = spec.precompute_prefix(ids[:1, :p_len])
+            gen = lambda n: with_prefix(np.asarray(spec.generate(
+                ids[:, p_len:], n, prefix=handle)))
+            label += f", shared prefix {p_len}"
+        else:
+            gen = lambda n: np.asarray(spec.generate(ids, n))
+        gen(min(2, args.new_tokens))          # compile programs
         tik = time.monotonic()
-        out = np.asarray(spec.generate(ids, args.new_tokens))
+        out = gen(args.new_tokens)
         dt = time.monotonic() - tik
         rate = spec.last_acceptance_rate
-        print_summary(args, dt, out,
-                      f"{len(partition)} stages, speculative gamma="
-                      f"{args.gamma} draft={args.draft_model} acceptance="
+        print_summary(args, dt, out, label + " acceptance="
                       + (f"{rate:.2f}" if rate is not None else "n/a"))
         return
     if args.concurrent:
@@ -512,19 +530,13 @@ def main():
         run = lambda n, cb=None: np.asarray(
             pipe.generate_beam(ids, n, beams=args.beams))
         label = f"{len(partition)} stages, beam {args.beams}"
-    elif args.shared_prefix:
-        if not 0 < args.shared_prefix < args.prompt_len:
-            parser.error(f"--shared-prefix must be in (0, "
-                         f"{args.prompt_len})")
-        p_len = args.shared_prefix
-        ids[:, :p_len] = ids[0, :p_len]   # rows share the prefix
+    elif p_len:
         handle = pipe.precompute_prefix(ids[:1, :p_len])
         sample_kw = dict(temperature=args.temperature, top_k=args.top_k,
                          seed=args.seed)
-        run = lambda n, cb=None: np.concatenate(
-            [ids[:, :p_len], np.asarray(pipe.generate(
-                ids[:, p_len:], n, step_callback=cb, prefix=handle,
-                **sample_kw))], axis=1)
+        run = lambda n, cb=None: with_prefix(np.asarray(pipe.generate(
+            ids[:, p_len:], n, step_callback=cb, prefix=handle,
+            **sample_kw)))
         label = (f"{len(partition)} stages, shared prefix {p_len} "
                  "(prefilled once)")
     else:
