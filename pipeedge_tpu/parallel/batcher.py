@@ -41,17 +41,19 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .decode import DecodePipeline, make_token_picker, validate_capacity
+from .decode import (DecodePipeline, _repeat_batch, make_token_picker,
+                     validate_capacity)
 
 
 @dataclass
 class _Request:
     rid: object
-    ids: jnp.ndarray                 # [B, S] prompt (prompt included in result)
-    new_tokens: int
-    pick: object                     # jitted token picker
+    ids: jnp.ndarray                 # [B, S] prompt (prompt included in
+    new_tokens: int                  # the result; the SUFFIX when a
+    pick: object                     # prefix handle seeds the caches)
     rng: jax.Array
-    prompt_len: int
+    prompt_len: int                  # prefix + suffix
+    prefix: Optional[Dict] = None    # precompute_prefix handle
     eos_token: Optional[int] = None  # stop early once every row emitted it
     pad_token: Optional[int] = None  # fills rows past their own eos
     rows_done: Optional[np.ndarray] = None   # [B] eos seen per row
@@ -98,8 +100,10 @@ class ContinuousBatcher:
         self.pending: deque = deque()
         self.active = 0
         self._live_rids = set()      # pending + admitted (not yet completed)
-        # stage i's input queue: (request, data, prefill?) tuples; `data`
-        # is token ids at stage 0, the previous stage's hidden state after
+        # stage i's input queue: (request, data, kind) tuples with kind in
+        # {"prefill", "span", "step"} ("span" = a prefix-seeded request's
+        # suffix prompt pass); `data` is token ids at stage 0, the
+        # previous stage's hidden state after
         self._stage_q: List[deque] = [deque() for _ in range(self.n_stages)]
         self.results: Dict = {}
         self.stats = {"ticks": 0, "stage_steps": 0, "tokens": 0}
@@ -107,10 +111,18 @@ class ContinuousBatcher:
     def submit(self, rid, ids, new_tokens: int, temperature: float = 0.0,
                top_k: int = 0, seed: int = 0,
                eos_token: Optional[int] = None,
-               pad_token: Optional[int] = None) -> None:
+               pad_token: Optional[int] = None,
+               prefix: Optional[Dict] = None) -> None:
         """Queue a request. `ids` [B, S] is a prompt batch decoded in
         lockstep (B=1 for a single sequence); each distinct (B, S) shape
         compiles its own prefill program, shared across requests.
+
+        `prefix` (from the pipeline's `precompute_prefix`) seeds this
+        request's cache slots with a shared prompt prefix; `ids` is then
+        the request's SUFFIX, its prompt pass runs as one span at the
+        prefix offset, and — matching `generate`'s prefix contract — the
+        returned array omits the prefix. Many queued requests can share
+        one handle: that is the point (1 prefix prefill for the fleet).
 
         `eos_token`: finish this request early — freeing its cache slots
         for the ready queue — once EVERY row of its batch has emitted the
@@ -129,24 +141,35 @@ class ContinuousBatcher:
         if pad_token is not None and eos_token is None:
             raise ValueError("pad_token only applies with eos_token (rows "
                              "are padded after their own eos)")
-        validate_capacity(self.pipe.cfg, self.pipe.max_len, ids.shape[1],
+        if prefix is not None and ids.shape[1] == 0:
+            raise ValueError("prefix reuse needs a non-empty suffix")
+        prompt_len = ids.shape[1] + (prefix["len"] if prefix else 0)
+        validate_capacity(self.pipe.cfg, self.pipe.max_len, prompt_len,
                           new_tokens)
         self._live_rids.add(rid)
         self.pending.append(_Request(
             rid=rid, ids=ids, new_tokens=new_tokens,
             pick=make_token_picker(temperature, top_k),
-            rng=jax.random.PRNGKey(seed), prompt_len=ids.shape[1],
-            eos_token=eos_token,
+            rng=jax.random.PRNGKey(seed), prompt_len=prompt_len,
+            prefix=prefix, eos_token=eos_token,
             pad_token=eos_token if pad_token is None else pad_token))
 
     def _admit(self) -> None:
         while self.pending and self.active < self.max_active:
             req = self.pending.popleft()
-            req.caches = self.pipe._fresh_caches(req.ids.shape[0])
+            if req.prefix is not None:
+                # seed this request's cache slots from the shared prefix
+                # (prompt caching); its prompt pass is a suffix SPAN
+                req.caches = [_repeat_batch(c, req.ids.shape[0])
+                              for c in req.prefix["caches"]]
+                kind = "span"
+            else:
+                req.caches = self.pipe._fresh_caches(req.ids.shape[0])
+                kind = "prefill"
             self.active += 1
-            self._stage_q[0].append((req, req.ids, True))
+            self._stage_q[0].append((req, req.ids, kind))
 
-    def _finish_wave(self, req: _Request, out, prefill: bool,
+    def _finish_wave(self, req: _Request, out, kind: str,
                      reentries: list, eos_pending: list) -> None:
         """Last stage done: pick the next token, then complete or re-enter
         stage 0 (same split-per-pick rng discipline as generate()).
@@ -156,7 +179,8 @@ class ContinuousBatcher:
         readback of the token, and blocking here — the loop's first
         iteration — would serialize every other stage's dispatch behind
         this request's compute."""
-        logits = out[:, req.prompt_len - 1] if prefill else out[:, 0]
+        del kind  # the last position's logits, for every wave kind:
+        logits = out[:, -1]  # prefill [B,S], span [B,S_s], step [B,1]
         req.rng, sub = jax.random.split(req.rng)
         token = req.pick(logits.astype(jnp.float32), sub)
         req.tokens.append(token)
@@ -167,7 +191,7 @@ class ContinuousBatcher:
         if len(req.tokens) >= req.new_tokens:
             self._complete(req)
         else:
-            reentries.append((req, token[:, None], False))
+            reentries.append((req, token[:, None], "step"))
 
     def _complete(self, req: _Request) -> None:
         toks = np.stack([np.asarray(t) for t in req.tokens], axis=1)  # [B, T]
@@ -199,7 +223,7 @@ class ContinuousBatcher:
         if done:
             self._complete(req)
         else:
-            self._stage_q[0].append((req, token[:, None], False))
+            self._stage_q[0].append((req, token[:, None], "step"))
 
     def tick(self) -> bool:
         """Advance every stage by at most one stage-step; returns whether
@@ -221,22 +245,28 @@ class ContinuousBatcher:
         for i in reversed(range(self.n_stages)):
             if not self._stage_q[i]:
                 continue
-            req, data, prefill = self._stage_q[i].popleft()
+            req, data, kind = self._stage_q[i].popleft()
             st = self.pipe.stages[i]
             if st["device"] is not None:
                 data = jax.device_put(data, st["device"])
-            if prefill:
+            if kind == "prefill":
                 out, req.caches[i] = st["prefill"](st["params"], data,
                                                    req.caches[i])
+            elif kind == "span":
+                # prefix-seeded prompt pass: the suffix runs as one span
+                # at the prefix offset (DecodePipeline.extend's rule)
+                out, req.caches[i] = self.pipe._decode_step(
+                    st, data, req.caches[i], req.prefix["len"],
+                    span=data.shape[1])
             else:
                 out, req.caches[i] = self.pipe._decode_step(
                     st, data, req.caches[i], req.pos)
             self.stats["stage_steps"] += 1
             worked = True
             if i + 1 < self.n_stages:
-                self._stage_q[i + 1].append((req, out, prefill))
+                self._stage_q[i + 1].append((req, out, kind))
             else:
-                self._finish_wave(req, out, prefill, reentries, eos_pending)
+                self._finish_wave(req, out, kind, reentries, eos_pending)
         self._stage_q[0].extend(reentries)
         for req in eos_pending:
             self._decide_eos(req)

@@ -222,3 +222,36 @@ def test_devices_placement_composes(tiny_pipe):
     for i, ids in enumerate(prompts):
         np.testing.assert_array_equal(
             results[i], np.asarray(tiny_pipe.generate(ids, new_tokens=5)))
+
+
+def test_prefix_cached_requests_match_solo_and_full(tiny_pipe):
+    """Prompt caching in the batcher: requests seeded from one shared
+    prefix handle produce the same tokens as (a) a solo prefix-seeded
+    generate and (b) a solo FULL-prompt generate, while interleaving
+    with a plain (non-prefix) request."""
+    rng = np.random.default_rng(29)
+    prefix = rng.integers(0, 100, size=(1, 6))
+    handle = tiny_pipe.precompute_prefix(prefix)
+    suffixes = [rng.integers(0, 100, size=(1, 4)) for _ in range(2)]
+    plain = rng.integers(0, 100, size=(1, 7))
+
+    batcher = ContinuousBatcher(tiny_pipe)
+    for i, suf in enumerate(suffixes):
+        batcher.submit(i, suf, new_tokens=6, prefix=handle)
+    batcher.submit("plain", plain, new_tokens=6)
+    batcher.submit("sampled", suffixes[0], new_tokens=5, temperature=0.9,
+                   seed=4, prefix=handle)
+    results = batcher.run()
+
+    for i, suf in enumerate(suffixes):
+        want_solo = np.asarray(tiny_pipe.generate(suf, 6, prefix=handle))
+        np.testing.assert_array_equal(results[i], want_solo)
+        full = np.concatenate([prefix, suf], axis=1)
+        want_full = np.asarray(tiny_pipe.generate(full, 6))
+        np.testing.assert_array_equal(results[i], want_full[:, 6:])
+    np.testing.assert_array_equal(
+        results["plain"], np.asarray(tiny_pipe.generate(plain, 6)))
+    np.testing.assert_array_equal(
+        results["sampled"],
+        np.asarray(tiny_pipe.generate(suffixes[0], 5, temperature=0.9,
+                                      seed=4, prefix=handle)))

@@ -362,15 +362,16 @@ def main():
         parser.error("--edge-bits applies to DCN stage edges or the SPMD "
                      "wave prefill hops; pass --dcn-addrs or --spmd-wave")
     if args.shared_prefix and (
-            args.beams or args.concurrent or args.spmd_wave
+            args.beams or args.spmd_wave
             or args.prefill_ubatch or args.dcn_addrs is not None):
         # checked BEFORE mode dispatch: every one of these modes branches
         # away earlier than the prefix path, which would otherwise
-        # silently ignore --shared-prefix (--draft-model composes: the
-        # speculative decoder takes its own prefix handle)
-        parser.error("--shared-prefix composes with plain or speculative "
-                     "greedy/sampled generation only (not --beams/"
-                     "--concurrent/--spmd-wave/--prefill-ubatch/"
+        # silently ignore --shared-prefix (--draft-model and --concurrent
+        # compose: the speculative decoder and the batcher both take
+        # prefix handles)
+        parser.error("--shared-prefix composes with plain, speculative, "
+                     "or --concurrent greedy/sampled generation only "
+                     "(not --beams/--spmd-wave/--prefill-ubatch/"
                      "--dcn-addrs)")
     if args.shared_prefix and args.sp > 1 and args.shared_prefix % args.sp:
         parser.error(f"--shared-prefix {args.shared_prefix} must divide "
@@ -504,13 +505,16 @@ def main():
                          "generation only (not --beams/--monitor/"
                          "--prefill-ubatch)")
         from pipeedge_tpu.parallel.batcher import ContinuousBatcher
+        handle = pipe.precompute_prefix(ids[:1, :p_len]) if p_len else None
+        req_ids = ids[:, p_len:] if p_len else ids
 
         def run_batch():
             batcher = ContinuousBatcher(pipe)
             for req in range(args.concurrent):
-                batcher.submit(req, ids, args.new_tokens,
+                batcher.submit(req, req_ids, args.new_tokens,
                                temperature=args.temperature,
-                               top_k=args.top_k, seed=args.seed + req)
+                               top_k=args.top_k, seed=args.seed + req,
+                               prefix=handle)
             return batcher, batcher.run()
 
         run_batch()                      # compile programs
@@ -518,13 +522,15 @@ def main():
         batcher, results = run_batch()
         dt = time.monotonic() - tik
         n_tok = args.concurrent * args.batch_size * args.new_tokens
+        shared = f", shared prefix {p_len}" if p_len else ""
         print(f"generated {args.concurrent}x{args.batch_size}x"
               f"{args.new_tokens} tokens in {dt:.3f}s = {n_tok / dt:.1f} "
-              f"tok/s ({len(partition)} stages, continuous batching; "
-              f"{batcher.stats['ticks']} ticks, "
+              f"tok/s ({len(partition)} stages, continuous batching"
+              f"{shared}; {batcher.stats['ticks']} ticks, "
               f"{batcher.stats['stage_steps']} stage-steps)")
+        out0 = with_prefix(results[0]) if p_len else results[0]
         print("sample continuation ids:",
-              results[0][0, args.prompt_len:].tolist())
+              out0[0, args.prompt_len:].tolist())
         return
     if args.beams:
         run = lambda n, cb=None: np.asarray(
