@@ -136,13 +136,14 @@ class ContinuousBatcher:
         if rid in self.results or rid in self._live_rids:
             raise ValueError(f"duplicate request id {rid!r}")
         ids = jnp.asarray(ids, jnp.int32)
+        if ids.ndim != 2 or ids.shape[1] == 0:
+            raise ValueError("prompt must be [B, S] with S >= 1, got "
+                             f"shape {ids.shape}")
         if new_tokens < 1:
             raise ValueError(f"new_tokens must be >= 1, got {new_tokens}")
         if pad_token is not None and eos_token is None:
             raise ValueError("pad_token only applies with eos_token (rows "
                              "are padded after their own eos)")
-        if prefix is not None and ids.shape[1] == 0:
-            raise ValueError("prefix reuse needs a non-empty suffix")
         prompt_len = ids.shape[1] + (prefix["len"] if prefix else 0)
         validate_capacity(self.pipe.cfg, self.pipe.max_len, prompt_len,
                           new_tokens)

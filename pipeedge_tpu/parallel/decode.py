@@ -865,6 +865,37 @@ def make_sp_prefill_fn(family, cfg: TransformerConfig,
         check_vma=False))
 
 
+def build_decode_pipeline(model_name: str,
+                          partition: Optional[Sequence] = None,
+                          max_len: int = 1024, dtype=jnp.float32,
+                          cache_bits: int = 0, attend_floor: int = 64,
+                          model_file: Optional[str] = None,
+                          stage_params: Optional[Sequence] = None,
+                          **pipe_kw) -> "DecodePipeline":
+    """Registry-driven `DecodePipeline` construction — THE shared build
+    path for the CLIs (tools/generate.py, tools/serve.py, bench_decode),
+    so model lookup, per-stage weight loading, and the position-capacity
+    clamp cannot drift between tools. `stage_params` supplies already-
+    loaded per-stage pytrees (callers that also need them for other
+    drivers); extra kwargs (mesh=/sp_mesh=/ep_mesh=/tp_ep_mesh=/devices=)
+    pass through."""
+    from ..models import registry
+    cfg = registry.get_model_config(model_name)
+    total = registry.get_model_layers(model_name)
+    partition = list(partition) if partition else [(1, total)]
+    if cfg.max_position_embeddings:
+        max_len = min(max_len, cfg.max_position_embeddings)
+    if stage_params is None:
+        stage_params = [registry.module_shard_factory(
+            model_name, model_file, l, r, stage=i, dtype=dtype,
+            unroll=False)[1] for i, (l, r) in enumerate(partition)]
+    family = registry.get_model_entry(model_name).family.FAMILY
+    return DecodePipeline(family, cfg, partition, stage_params,
+                          max_len=max_len, dtype=dtype,
+                          cache_bits=cache_bits,
+                          attend_floor=attend_floor, **pipe_kw)
+
+
 class DecodePipeline:
     """Host-driven pipelined greedy decoding over block-aligned stages.
 

@@ -427,11 +427,14 @@ def main():
             sp_mesh = Mesh(np.array(jax.devices()[:args.sp]), ("sp",))
         else:
             ep_mesh = Mesh(np.array(jax.devices()[:args.ep]), ("ep",))
-    pipe = decode.DecodePipeline(registry.get_model_entry(
-        args.model_name).family.FAMILY, cfg, partition, stage_params,
-        max_len=max_len, dtype=dtype, cache_bits=args.kv_bits, mesh=mesh,
-        sp_mesh=sp_mesh, ep_mesh=ep_mesh, tp_ep_mesh=tp_ep_mesh,
-        attend_floor=args.attend_floor)
+    # shared construction path with tools/serve.py (model lookup /
+    # capacity clamp live in one place); params pre-loaded above because
+    # the spmd-wave branch needs them directly
+    pipe = decode.build_decode_pipeline(
+        args.model_name, partition, max_len=max_len, dtype=dtype,
+        cache_bits=args.kv_bits, attend_floor=args.attend_floor,
+        stage_params=stage_params, mesh=mesh, sp_mesh=sp_mesh,
+        ep_mesh=ep_mesh, tp_ep_mesh=tp_ep_mesh)
 
     heartbeat = None
     if args.monitor:

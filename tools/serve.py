@@ -1,0 +1,209 @@
+"""Minimal HTTP serving front end over the continuous batcher.
+
+Beyond-reference serving surface (the reference runtime is single-shot
+batch inference; SURVEY.md §2.4): a stdlib-only JSON/HTTP server that
+drives `ContinuousBatcher` continuously — requests admit as they
+arrive, share the pipeline via wave scheduling, and prompt prefixes
+registered once via /prefix are reused by any number of /generate
+requests (prompt caching).
+
+Endpoints (all JSON):
+- GET  /healthz            -> {"ok": true, "model": ..., "stages": N}
+- POST /prefix   {"ids": [t0, t1, ...]}
+                           -> {"prefix_id": "p0", "len": N}
+- POST /generate {"ids": [[...], ...] | [...], "new_tokens": N,
+                  "temperature"?: f, "top_k"?: n, "seed"?: n,
+                  "eos_token"?: n, "prefix_id"?: "p0"}
+                           -> {"ids": [[prompt+continuation], ...]}
+                              (suffix+continuation when prefix_id given)
+
+Single worker thread owns the batcher (JAX dispatch is asynchronous, so
+one thread keeps every stage busy); HTTP handler threads submit under a
+condition variable and wait for their request id to complete. Tokens
+are identical to solo `DecodePipeline.generate` runs with the same
+settings — the batcher's contract (tests/test_serve.py).
+
+Usage: python tools/serve.py -m gpt2 [--port 8321] [--platform cpu] ...
+"""
+import argparse
+import json
+import os
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class _Service:
+    """Owns the pipeline + batcher; one worker thread ticks continuously."""
+
+    def __init__(self, pipe, max_active=None, max_prefixes=8):
+        from collections import OrderedDict
+
+        from pipeedge_tpu.parallel.batcher import ContinuousBatcher
+        self.pipe = pipe
+        self.batcher = ContinuousBatcher(pipe, max_active=max_active)
+        self.cond = threading.Condition()
+        self.prefixes = OrderedDict()   # LRU-bounded: handles hold full
+        self.max_prefixes = max_prefixes   # max_len KV buffers
+        self._next_rid = 0
+        self._next_pid = 0
+        self._stop = False
+        self._dead: Optional[BaseException] = None
+        self.worker = threading.Thread(target=self._loop, daemon=True)
+        self.worker.start()
+
+    def _loop(self):
+        while True:
+            with self.cond:
+                while not self._stop and not (
+                        self.batcher.pending or self.batcher.active):
+                    self.cond.wait()
+                if self._stop:
+                    return
+                try:
+                    self.batcher.tick()
+                except BaseException as exc:   # noqa: BLE001 — a wedged
+                    # worker would hang every waiter forever; record the
+                    # failure so they raise instead
+                    self._dead = exc
+                    self.cond.notify_all()
+                    raise
+                if self.batcher.results:
+                    self.cond.notify_all()
+
+    def add_prefix(self, ids):
+        with self.cond:
+            pid = f"p{self._next_pid}"
+            self._next_pid += 1
+            # precompute outside the tick loop is fine: the worker only
+            # runs under this same condition lock
+            self.prefixes[pid] = self.pipe.precompute_prefix(ids)
+            while len(self.prefixes) > self.max_prefixes:
+                self.prefixes.popitem(last=False)   # evict oldest
+            return pid, self.prefixes[pid]["len"]
+
+    def generate(self, ids, new_tokens, **kw):
+        pid = kw.pop("prefix_id", None)
+        with self.cond:
+            if self._dead is not None:
+                raise RuntimeError(f"serving worker died: {self._dead!r}")
+            if pid is not None:
+                if pid not in self.prefixes:
+                    raise KeyError(f"unknown prefix_id {pid!r} (evicted "
+                                   "or never registered)")
+                self.prefixes.move_to_end(pid)     # LRU touch
+                kw["prefix"] = self.prefixes[pid]
+            rid = self._next_rid
+            self._next_rid += 1
+            self.batcher.submit(rid, ids, new_tokens, **kw)
+            self.cond.notify_all()
+            while rid not in self.batcher.results:
+                if self._dead is not None:
+                    raise RuntimeError(
+                        f"serving worker died: {self._dead!r}")
+                self.cond.wait()
+            return self.batcher.results.pop(rid)
+
+    def stop(self):
+        with self.cond:
+            self._stop = True
+            self.cond.notify_all()
+
+
+def make_handler(service, model_name):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):      # quiet server
+            pass
+
+        def _send(self, code, obj):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._send(200, {"ok": True, "model": model_name,
+                                 "stages": len(service.pipe.stages)})
+            else:
+                self._send(404, {"error": "unknown path"})
+
+        def do_POST(self):
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                if self.path == "/prefix":
+                    pid, plen = service.add_prefix(req["ids"])
+                    self._send(200, {"prefix_id": pid, "len": plen})
+                elif self.path == "/generate":
+                    ids = req["ids"]
+                    if ids and not isinstance(ids[0], list):
+                        ids = [ids]
+                    out = service.generate(
+                        ids, int(req["new_tokens"]),
+                        temperature=float(req.get("temperature", 0.0)),
+                        top_k=int(req.get("top_k", 0)),
+                        seed=int(req.get("seed", 0)),
+                        eos_token=req.get("eos_token"),
+                        prefix_id=req.get("prefix_id"))
+                    self._send(200, {"ids": out.tolist()})
+                else:
+                    self._send(404, {"error": "unknown path"})
+            except (KeyError, ValueError, TypeError, IndexError) as exc:
+                self._send(400, {"error": str(exc)})
+            except RuntimeError as exc:
+                self._send(503, {"error": str(exc)})
+
+    return Handler
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("-m", "--model-name", default="gpt2")
+    p.add_argument("-pt", "--partition", default=None)
+    p.add_argument("--max-len", default=1024, type=int)
+    p.add_argument("-t", "--dtype", default="bfloat16",
+                   choices=["float32", "bfloat16"])
+    p.add_argument("--kv-bits", default=0, type=int, choices=[0, 8])
+    p.add_argument("--attend-floor", default=64, type=int)
+    p.add_argument("--max-active", default=None, type=int)
+    p.add_argument("--max-prefixes", default=8, type=int,
+                   help="LRU bound on registered prompt prefixes (each "
+                        "handle retains full max_len KV buffers)")
+    p.add_argument("--port", default=8321, type=int)
+    args = p.parse_args()
+
+    from pipeedge_tpu.utils import apply_env_platform
+    apply_env_platform()
+    import jax.numpy as jnp
+
+    from pipeedge_tpu.parallel.decode import build_decode_pipeline
+
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    partition = None
+    if args.partition:
+        nums = [int(x) for x in args.partition.split(",")]
+        partition = list(zip(nums[::2], nums[1::2]))
+    pipe = build_decode_pipeline(
+        args.model_name, partition, max_len=args.max_len, dtype=dtype,
+        cache_bits=args.kv_bits, attend_floor=args.attend_floor)
+
+    service = _Service(pipe, max_active=args.max_active,
+                       max_prefixes=args.max_prefixes)
+    server = ThreadingHTTPServer(("127.0.0.1", args.port),
+                                 make_handler(service, args.model_name))
+    print(f"serving {args.model_name} ({len(partition)} stages) on "
+          f"127.0.0.1:{args.port}", flush=True)
+    try:
+        server.serve_forever()
+    finally:
+        service.stop()
+
+
+if __name__ == "__main__":
+    main()
