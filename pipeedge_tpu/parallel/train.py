@@ -59,14 +59,7 @@ def make_train_step(pipe: SpmdPipeline, optimizer, example_inputs,
     import optax
 
     example_inputs = jnp.asarray(example_inputs)
-    # share SpmdPipeline.run's compiled-forward cache (same key): a
-    # pipeline already compiled for this shape costs no second compile
-    key = (example_inputs.shape, str(example_inputs.dtype),
-           pipe.stage_bits)
-    fwd = pipe._compiled.get(key)
-    if fwd is None:
-        fwd = pipe._build(example_inputs)
-        pipe._compiled[key] = fwd
+    fwd = pipe.compiled_for(example_inputs)   # shares run()'s cache
     n_blocks = pipe.params["n_blocks"]
 
     def compute_loss(trainable, inputs, labels):
@@ -74,13 +67,25 @@ def make_train_step(pipe: SpmdPipeline, optimizer, example_inputs,
         return loss_fn(logits, labels)
 
     @jax.jit
-    def train_step(params, opt_state, inputs, labels):
+    def _step(params, opt_state, inputs, labels):
         trainable = {k: v for k, v in params.items() if k != "n_blocks"}
         loss, grads = jax.value_and_grad(compute_loss)(
-            trainable, inputs, jnp.asarray(labels))
+            trainable, inputs, labels)
         updates, opt_state = optimizer.update(grads, opt_state, trainable)
         new_params = optax.apply_updates(trainable, updates)
         return {**new_params, "n_blocks": n_blocks}, opt_state, loss
+
+    def train_step(params, opt_state, inputs, labels):
+        inputs = jnp.asarray(inputs)
+        if inputs.shape != example_inputs.shape:
+            # the pipelined program bakes the microbatch schedule into
+            # its tick count; a mismatched shape would die deep inside
+            # the traced scan instead of here
+            raise ValueError(
+                f"inputs shape {inputs.shape} != the compiled step's "
+                f"{example_inputs.shape}; build a train step per "
+                "input shape (make_train_step(pipe, opt, inputs))")
+        return _step(params, opt_state, inputs, jnp.asarray(labels))
 
     trainable = {k: v for k, v in pipe.params.items() if k != "n_blocks"}
     opt_state = jax.jit(optimizer.init)(trainable)
