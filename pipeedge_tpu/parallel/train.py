@@ -26,7 +26,8 @@ import jax.numpy as jnp
 
 from .spmd import SpmdPipeline
 
-__all__ = ["make_train_step", "softmax_xent"]
+__all__ = ["make_train_step", "softmax_xent", "save_train_state",
+           "restore_train_state"]
 
 
 def softmax_xent(logits: jax.Array, labels: jax.Array) -> jax.Array:
@@ -89,4 +90,41 @@ def make_train_step(pipe: SpmdPipeline, optimizer, example_inputs,
 
     trainable = {k: v for k, v in pipe.params.items() if k != "n_blocks"}
     opt_state = jax.jit(optimizer.init)(trainable)
+    # momenta propagate the params' mesh shardings through jit, but
+    # SCALAR optimizer leaves (adam's count) come out single-device —
+    # mixing those with mesh-sharded params in one jitted step is a
+    # device-mismatch error; replicate them over the pipeline's mesh
+    from jax.sharding import NamedSharding, PartitionSpec, SingleDeviceSharding
+    replicated = NamedSharding(pipe.mesh, PartitionSpec())
+
+    def place(x):
+        if isinstance(getattr(x, "sharding", None), SingleDeviceSharding) \
+                and len(pipe.mesh.devices.flat) > 1:
+            return jax.device_put(x, replicated)
+        return x
+
+    opt_state = jax.tree_util.tree_map(place, opt_state)
     return train_step, opt_state
+
+
+def save_train_state(path: str, params, opt_state, step: int) -> None:
+    """Checkpoint a training run (params + optimizer state + step count)
+    as one Orbax pytree — the training extension of the per-stage
+    checkpoint/resume axis (SURVEY.md §5.4; utils/checkpoint.py holds
+    the inference-side per-stage npz/Orbax machinery)."""
+    from ..utils.checkpoint import save_params
+    save_params(path, {"params": params, "opt_state": opt_state,
+                       "step": jnp.asarray(step, jnp.int32)})
+
+
+def restore_train_state(path: str, like_params, like_opt_state):
+    """Restore `save_train_state`'s pytree into the structures (and
+    SHARDINGS — leaves restore straight onto their mesh placement) of a
+    freshly initialized run: `like_params`/`like_opt_state` from
+    `pipe.params` and `make_train_step`'s opt_state. Returns
+    (params, opt_state, step)."""
+    from ..utils.checkpoint import load_params
+    state = load_params(path, like={
+        "params": like_params, "opt_state": like_opt_state,
+        "step": jnp.asarray(0, jnp.int32)})
+    return state["params"], state["opt_state"], int(state["step"])

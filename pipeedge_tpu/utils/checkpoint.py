@@ -41,15 +41,28 @@ def save_params(path: str, params: Dict) -> None:
     ckptr.wait_until_finished()
 
 
-def load_params(path: str, shardings: Optional[Any] = None) -> Dict:
+def load_params(path: str, shardings: Optional[Any] = None,
+                like: Optional[Any] = None) -> Dict:
     """Restore a pytree saved by `save_params`.
 
     With `shardings` (a pytree of jax.sharding.Sharding congruent with the
     saved tree, or a single Sharding applied to every leaf), leaves restore
     directly into the requested placement.
+
+    With `like` (a congruent pytree of arrays, e.g. a freshly initialized
+    training state), the restore target takes ITS structure and per-leaf
+    shardings — container types (optax NamedTuples etc.) survive, and
+    every leaf lands on its mesh placement. Metadata-derived targets
+    (the other modes) flatten containers to plain dicts/lists.
     """
     ckptr = _checkpointer()
     path = os.path.abspath(path)
+    if like is not None:
+        target = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(
+                jax.numpy.asarray(x).shape, jax.numpy.asarray(x).dtype,
+                sharding=jax.numpy.asarray(x).sharding), like)
+        return ckptr.restore(path, target)
     if shardings is None:
         # Don't trust saved sharding metadata: a checkpoint written on one
         # topology (e.g. a TPU host) must restore on another (e.g. a CPU
