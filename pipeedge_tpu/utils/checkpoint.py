@@ -58,11 +58,13 @@ def load_params(path: str, shardings: Optional[Any] = None,
     ckptr = _checkpointer()
     path = os.path.abspath(path)
     if like is not None:
-        target = jax.tree_util.tree_map(
-            lambda x: jax.ShapeDtypeStruct(
-                jax.numpy.asarray(x).shape, jax.numpy.asarray(x).dtype,
-                sharding=jax.numpy.asarray(x).sharding), like)
-        return ckptr.restore(path, target)
+        def abstract(x):
+            a = jax.numpy.asarray(x)
+            return jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                        sharding=a.sharding)
+
+        return ckptr.restore(path,
+                             jax.tree_util.tree_map(abstract, like))
     if shardings is None:
         # Don't trust saved sharding metadata: a checkpoint written on one
         # topology (e.g. a TPU host) must restore on another (e.g. a CPU

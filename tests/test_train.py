@@ -26,6 +26,13 @@ TINY4 = dict(hidden_size=32, num_hidden_layers=4, num_attention_heads=4,
 PARTITION = [(1, 8), (9, 16)]
 
 
+def _stage_params(cfg, weights):
+    total = 4 * cfg.num_hidden_layers
+    return [vit_mod.load_params(
+        cfg, ShardConfig(l, r, is_first=l == 1, is_last=r == total), weights)
+        for l, r in PARTITION]
+
+
 @pytest.fixture(scope="module")
 def setup():
     from jax.sharding import Mesh
@@ -36,10 +43,7 @@ def setup():
     cfg = TransformerConfig(model_type="vit", **TINY4, num_labels=5,
                             image_size=16, patch_size=4)
     weights = vit_mod.hf_to_npz_weights(model.state_dict(), cfg)
-    total = 4 * cfg.num_hidden_layers
-    stage_params = [vit_mod.load_params(
-        cfg, ShardConfig(l, r, is_first=l == 1, is_last=r == total), weights)
-        for l, r in PARTITION]
+    stage_params = _stage_params(cfg, weights)
     mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(2), ("stage",))
     pipe = spmd.build_spmd_pipeline(vit_mod.FAMILY, cfg, PARTITION,
                                     stage_params, mesh)
@@ -118,13 +122,9 @@ def test_pipeline_grads_match_single_device(setup):
 
     # remat (per-block jax.checkpoint) recomputes instead of saving —
     # gradients must be identical
-    total = 4 * cfg.num_hidden_layers
-    rpipe = spmd.build_spmd_pipeline(
-        vit_mod.FAMILY, cfg, PARTITION,
-        [vit_mod.load_params(cfg, ShardConfig(l, r, is_first=l == 1,
-                                              is_last=r == total), weights)
-         for l, r in PARTITION],
-        pipe.mesh, remat=True)
+    rpipe = spmd.build_spmd_pipeline(vit_mod.FAMILY, cfg, PARTITION,
+                                     _stage_params(cfg, weights),
+                                     pipe.mesh, remat=True)
     rfwd = rpipe.compiled_for(x)
 
     def rloss(trainable):
@@ -154,11 +154,8 @@ def test_train_step_learns_and_shards(setup):
 
     from jax.sharding import Mesh
     qmesh = Mesh(np.asarray(jax.devices()[:2]).reshape(2), ("stage",))
-    total = 4 * cfg.num_hidden_layers
-    sp = [vit_mod.load_params(
-        cfg, ShardConfig(l, r, is_first=l == 1, is_last=r == total), weights)
-        for l, r in PARTITION]
-    qpipe = spmd.build_spmd_pipeline(vit_mod.FAMILY, cfg, PARTITION, sp,
+    qpipe = spmd.build_spmd_pipeline(vit_mod.FAMILY, cfg, PARTITION,
+                                     _stage_params(cfg, weights),
                                      qmesh, quant_bit=8)
     with pytest.raises(ValueError, match="not differentiable"):
         train.make_train_step(qpipe, optax.sgd(0.05), x)
@@ -226,3 +223,38 @@ def test_train_state_checkpoint_resume(setup, tmp_path):
         lambda a, b: np.testing.assert_array_equal(np.asarray(a),
                                                    np.asarray(b)),
         r_params2, params_cont)
+
+
+def test_dp_stage_training_grads_match(setup):
+    """Data parallelism composes with pipeline training: a ('dp','stage')
+    2x2 mesh produces the same gradients as the single-device oracle
+    (the dp batch shard's gradient mean rides the program's transposes)."""
+    from jax.sharding import Mesh
+    cfg, weights, pipe, x, y = setup
+    stage_params = _stage_params(cfg, weights)
+    mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(2, 2),
+                ("dp", "stage"))
+    dpipe = spmd.build_spmd_pipeline(vit_mod.FAMILY, cfg, PARTITION,
+                                     stage_params, mesh)
+    fwd = dpipe.compiled_for(x)
+    n_blocks = dpipe.params["n_blocks"]
+
+    def dloss(trainable):
+        return train.softmax_xent(
+            fwd({**trainable, "n_blocks": n_blocks}, x), y)
+
+    trainable = {k: v for k, v in dpipe.params.items() if k != "n_blocks"}
+    dval, dgrads = jax.value_and_grad(dloss)(trainable)
+
+    ref_params, ref_loss = _single_device_loss(cfg, weights)
+    rval, rgrads = jax.value_and_grad(ref_loss)(ref_params, x, y)
+    np.testing.assert_allclose(float(dval), float(rval),
+                               rtol=1e-5, atol=1e-6)
+    got = np.asarray(dgrads["blocks"]["mlp_up"]["w"])
+    want = np.asarray(rgrads["blocks"]["mlp_up"]["w"])
+    for s in range(2):
+        np.testing.assert_allclose(got[s], want[2 * s:2 * s + 2],
+                                   rtol=2e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(dgrads["final"]["head"]["w"]),
+        np.asarray(rgrads["final"]["head"]["w"]), rtol=2e-4, atol=1e-5)

@@ -33,3 +33,4 @@ python -c "import yaml; yaml.safe_dump(
 
 run python bench.py
 run python bench_decode.py
+run python tools/bench_train.py
