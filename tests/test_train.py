@@ -5,6 +5,8 @@ The claim under test: jax.grad through the ONE-program pipelined forward
 same gradients as a plain single-device forward of the same model — and
 an optimizer loop on the pipeline actually learns.
 """
+import json
+
 import numpy as np
 import pytest
 
@@ -258,3 +260,70 @@ def test_dp_stage_training_grads_match(setup):
     np.testing.assert_allclose(
         np.asarray(dgrads["final"]["head"]["w"]),
         np.asarray(rgrads["final"]["head"]["w"]), rtol=2e-4, atol=1e-5)
+
+
+def test_lm_training_llama_pipeline():
+    """LLaMA-family training through the pipeline (RoPE/RMSNorm/SwiGLU/
+    GQA sublayers are differentiable as-is): loss decreases under SGD."""
+    import optax
+    from jax.sharding import Mesh
+
+    from pipeedge_tpu.models import llama as llama_mod
+    cfg = TransformerConfig(model_type="llama", hidden_size=32,
+                            num_hidden_layers=2, num_attention_heads=4,
+                            num_kv_heads=2, intermediate_size=64,
+                            layer_norm_eps=1e-5, vocab_size=50,
+                            max_position_embeddings=32)
+    partition = [(1, 4), (5, 8)]
+    sp = [llama_mod.init_params(
+        cfg, ShardConfig(l, r, is_first=l == 1, is_last=r == 8), seed=0)
+        for l, r in partition]
+    mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(2), ("stage",))
+    pipe = spmd.build_spmd_pipeline(llama_mod.FAMILY, cfg, partition, sp,
+                                    mesh)
+    rng = np.random.default_rng(4)
+    ids = jnp.asarray(rng.integers(0, 50, size=(3, 2, 9)), jnp.int32)
+    inputs, labels = ids[..., :-1], ids[..., 1:]
+    step, opt_state = train.make_train_step(pipe, optax.sgd(0.1), inputs)
+    params, losses = pipe.params, []
+    for _ in range(5):
+        params, opt_state, loss = step(params, opt_state, inputs, labels)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.95, losses
+
+
+@pytest.mark.fleet
+def test_train_cli_multistage_dp_resume(tmp_path):
+    """tools/train.py end-to-end in a subprocess: 2 stages x dp 2 mesh,
+    adam, checkpoint at the end, then a second invocation resumes from
+    the saved step and continues."""
+    import os
+    import re
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=repo)
+    ck = str(tmp_path / "ck")
+    cmd = [sys.executable, os.path.join(repo, "tools", "train.py"),
+           "-m", "pipeedge/test-tiny-gpt2", "-pt", "1,4,5,8", "--dp", "2",
+           "-b", "2", "-u", "2", "--seq-len", "8", "--optimizer", "adam",
+           "--ckpt-dir", ck, "--log-every", "1"]
+    first = subprocess.run(cmd + ["--steps", "3"], capture_output=True,
+                           text=True, env=env, timeout=600)
+    assert first.returncode == 0, first.stdout + first.stderr
+    losses = [float(m) for m in
+              re.findall(r"loss=([0-9.]+)", first.stdout)]
+    assert len(losses) == 3 and losses[-1] < losses[0]
+
+    second = subprocess.run(cmd + ["--steps", "5"], capture_output=True,
+                            text=True, env=env, timeout=600)
+    assert second.returncode == 0, second.stdout + second.stderr
+    assert "resumed from" in second.stdout and "step 3" in second.stdout
+    more = [float(m) for m in re.findall(r"loss=([0-9.]+)", second.stdout)]
+    assert len(more) == 2 and more[-1] < losses[0]
+    summary = json.loads(second.stdout.strip().splitlines()[-1])
+    assert summary["steps"] == 2 and summary["mesh"] == {"dp": 2,
+                                                         "stage": 2}
