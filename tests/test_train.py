@@ -327,3 +327,118 @@ def test_train_cli_multistage_dp_resume(tmp_path):
     summary = json.loads(second.stdout.strip().splitlines()[-1])
     assert summary["steps"] == 2 and summary["mesh"] == {"dp": 2,
                                                          "stage": 2}
+
+
+def test_bert_and_moe_training_learn():
+    """The remaining families train through the pipeline too: BERT
+    sequence classification (tanh pooler + head) and switch-MoE blocks
+    (the top-1 gate probability scales the expert output, so routing
+    passes gradients); loss decreases under SGD for both."""
+    import optax
+    from jax.sharding import Mesh
+
+    from pipeedge_tpu.models import bert as bert_mod
+    from pipeedge_tpu.models import gpt2 as gpt2_mod
+    mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(2), ("stage",))
+    rng = np.random.default_rng(6)
+
+    bert_cfg = TransformerConfig(model_type="bert", hidden_size=32,
+                                 num_hidden_layers=2, num_attention_heads=4,
+                                 intermediate_size=64, layer_norm_eps=1e-12,
+                                 vocab_size=60, max_position_embeddings=32,
+                                 num_labels=2)
+    moe_cfg = TransformerConfig(model_type="gpt2", hidden_size=32,
+                                num_hidden_layers=2, num_attention_heads=4,
+                                intermediate_size=64, layer_norm_eps=1e-5,
+                                vocab_size=50, max_position_embeddings=32,
+                                n_experts=4, capacity_factor=4.0)
+    for name, (mod, cfg) in {"bert": (bert_mod, bert_cfg),
+                             "moe": (gpt2_mod, moe_cfg)}.items():
+        partition = [(1, 4), (5, 8)]
+        sp = [mod.init_params(
+            cfg, ShardConfig(l, r, is_first=l == 1, is_last=r == 8), seed=0)
+            for l, r in partition]
+        pipe = spmd.build_spmd_pipeline(mod.FAMILY, cfg, partition, sp,
+                                        mesh)
+        ids = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(3, 2, 8)),
+                          jnp.int32)
+        if name == "bert":
+            inputs = ids
+            labels = jnp.asarray(rng.integers(0, 2, size=(3, 2)), jnp.int32)
+        else:
+            inputs, labels = ids[..., :-1], ids[..., 1:]
+        step, opt_state = train.make_train_step(pipe, optax.sgd(0.1),
+                                                inputs)
+        params, losses = pipe.params, []
+        for _ in range(5):
+            params, opt_state, loss = step(params, opt_state, inputs,
+                                           labels)
+            losses.append(float(loss))
+        assert np.isfinite(losses).all(), (name, losses)
+        # every step improves (bert's near-chance binary loss moves
+        # slowly in absolute terms; monotonic descent is the real claim)
+        assert all(b < a for a, b in zip(losses, losses[1:])), (name,
+                                                                losses)
+
+
+def test_sp_ring_attention_training_grads():
+    """Long-context training: a ('stage','sp') pipeline with
+    sequence-sharded activations and ring attention per block is
+    differentiable — JAX transposes the ring ppermutes — and its
+    gradients match the single-device oracle."""
+    from jax.sharding import Mesh
+    from transformers import BertConfig, BertForSequenceClassification
+
+    from pipeedge_tpu.models import bert as bert_mod
+    hf_cfg = BertConfig(hidden_size=32, num_hidden_layers=2,
+                        num_attention_heads=4, intermediate_size=64,
+                        vocab_size=60, max_position_embeddings=32,
+                        num_labels=2)
+    torch.manual_seed(1)
+    model = BertForSequenceClassification(hf_cfg).eval()
+    cfg = TransformerConfig(model_type="bert", hidden_size=32,
+                            num_hidden_layers=2, num_attention_heads=4,
+                            intermediate_size=64, layer_norm_eps=1e-12,
+                            vocab_size=60, max_position_embeddings=32,
+                            num_labels=2)
+    weights = {k: v.numpy() for k, v in model.state_dict().items()}
+    partition = [(1, 4), (5, 8)]
+    sp_params = [bert_mod.load_params(
+        cfg, ShardConfig(l, r, is_first=l == 1, is_last=r == 8), weights)
+        for l, r in partition]
+    mesh = spmd.make_pipeline_mesh(2, sp=2)
+    pipe = spmd.build_spmd_pipeline(bert_mod.FAMILY, cfg, partition,
+                                    sp_params, mesh)
+    rng = np.random.default_rng(8)
+    x = jnp.asarray(rng.integers(0, 60, size=(3, 2, 8)), jnp.int32)
+    y = jnp.asarray(rng.integers(0, 2, size=(3, 2)), jnp.int32)
+    fwd = pipe.compiled_for(x)
+    n_blocks = pipe.params["n_blocks"]
+
+    def sp_loss(trainable):
+        return train.softmax_xent(
+            fwd({**trainable, "n_blocks": n_blocks}, x), y)
+
+    trainable = {k: v for k, v in pipe.params.items() if k != "n_blocks"}
+    sp_val, sp_grads = jax.value_and_grad(sp_loss)(trainable)
+
+    total = 8
+    sc = ShardConfig(1, total, is_first=True, is_last=True)
+    ref_params = bert_mod.load_params(cfg, sc, weights)
+    fn = make_shard_fn(bert_mod.FAMILY, cfg, sc)
+
+    def ref_loss(params):
+        return train.softmax_xent(
+            jnp.stack([fn(params, u) for u in x]), y)
+
+    ref_val, ref_grads = jax.value_and_grad(ref_loss)(ref_params)
+    np.testing.assert_allclose(float(sp_val), float(ref_val),
+                               rtol=1e-5, atol=1e-6)
+    got = np.asarray(sp_grads["blocks"]["q"]["w"])
+    want = np.asarray(ref_grads["blocks"]["q"]["w"])
+    for s in range(2):
+        np.testing.assert_allclose(got[s], want[s:s + 1], rtol=2e-4,
+                                   atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(sp_grads["final"]["head"]["w"]),
+        np.asarray(ref_grads["final"]["head"]["w"]), rtol=2e-4, atol=1e-5)
