@@ -10,21 +10,28 @@ mkdir -p profiles/tpu
 
 run() { echo "=== $*" >&2; stdbuf -oL -eL "$@"; }
 
+# refresh the per-model results files this script owns: the profiler
+# MERGES into an existing file and (reference semantics) refuses to
+# re-profile a layer already present, so a second agenda run would
+# otherwise die on its first step
+rm -f profiles/tpu/profiler_results_vitb.yml \
+      profiles/tpu/profiler_results_vitl.yml
 run python profiler.py -m google/vit-base-patch16-224 -b 8 -t bfloat16 \
     -o profiles/tpu/profiler_results_vitb.yml
 run python profiler.py -m google/vit-large-patch16-224 -b 8 -t bfloat16 \
     -o profiles/tpu/profiler_results_vitl.yml
 
-run python profiler_results_to_models.py \
+# -f: refresh runs overwrite the previous session's entries
+run python profiler_results_to_models.py -f \
     -i profiles/tpu/profiler_results_vitb.yml -o profiles/tpu/models.yml
-run python profiler_results_to_models.py \
+run python profiler_results_to_models.py -f \
     -i profiles/tpu/profiler_results_vitl.yml -o profiles/tpu/models.yml
 # -dtm 16384: v5e HBM MB; -dtb 100000: ~100 Gbps per-link planning number
 # for the scheduler's min(src,dst) bandwidth model.
-run python profiler_results_to_device_types.py tpu-v5e \
+run python profiler_results_to_device_types.py tpu-v5e -f \
     -i profiles/tpu/profiler_results_vitb.yml -o profiles/tpu/device_types.yml \
     -dtm 16384 -dtb 100000
-run python profiler_results_to_device_types.py tpu-v5e \
+run python profiler_results_to_device_types.py tpu-v5e -f \
     -i profiles/tpu/profiler_results_vitl.yml -o profiles/tpu/device_types.yml \
     -dtm 16384 -dtb 100000
 python -c "import yaml; yaml.safe_dump(
