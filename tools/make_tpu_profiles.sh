@@ -10,16 +10,18 @@ mkdir -p profiles/tpu
 
 run() { echo "=== $*" >&2; stdbuf -oL -eL "$@"; }
 
-# refresh the per-model results files this script owns: the profiler
-# MERGES into an existing file and (reference semantics) refuses to
-# re-profile a layer already present, so a second agenda run would
-# otherwise die on its first step
-rm -f profiles/tpu/profiler_results_vitb.yml \
-      profiles/tpu/profiler_results_vitl.yml
+# Profile into temp files and move into place ONLY on success: the
+# profiler MERGES into an existing file and (reference semantics)
+# refuses to re-profile a layer already present, so refresh runs need a
+# fresh output — but deleting the committed fixtures up front would
+# strand the tree with tracked files gone if an early step fails.
+rm -f profiles/tpu/.tmp_vitb.yml profiles/tpu/.tmp_vitl.yml
 run python profiler.py -m google/vit-base-patch16-224 -b 8 -t bfloat16 \
-    -o profiles/tpu/profiler_results_vitb.yml
+    -o profiles/tpu/.tmp_vitb.yml
+mv profiles/tpu/.tmp_vitb.yml profiles/tpu/profiler_results_vitb.yml
 run python profiler.py -m google/vit-large-patch16-224 -b 8 -t bfloat16 \
-    -o profiles/tpu/profiler_results_vitl.yml
+    -o profiles/tpu/.tmp_vitl.yml
+mv profiles/tpu/.tmp_vitl.yml profiles/tpu/profiler_results_vitl.yml
 
 # -f: refresh runs overwrite the previous session's entries
 run python profiler_results_to_models.py -f \
