@@ -15,6 +15,9 @@ from pipeedge_tpu.ops import decode_attention
 from pipeedge_tpu.parallel import decode
 
 
+pytestmark = pytest.mark.slow   # compile-heavy decode programs
+
+
 def test_kernel_matches_xla_dequant_attend():
     """Direct kernel check against the reference computation."""
     rng = np.random.default_rng(0)

@@ -17,6 +17,8 @@ from pipeedge_tpu.models import registry
 from pipeedge_tpu.parallel import decode
 from pipeedge_tpu.parallel.speculative import SpeculativeDecoder
 
+pytestmark = pytest.mark.slow   # compile-heavy decode programs
+
 MAX_LEN = 48
 
 
