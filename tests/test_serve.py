@@ -31,14 +31,15 @@ def _post(port, path, obj, timeout=120):
         return json.loads(resp.read())
 
 
-@pytest.fixture(scope="module")
-def server():
+def _spawn_server(extra_args=()):
+    """Start tools/serve.py on a free port; yield the port, then stop it
+    (one copy of the spawn/readiness/teardown logic for every fixture)."""
     port = _free_port()
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
     proc = subprocess.Popen(
         [sys.executable, os.path.join(REPO, "tools", "serve.py"),
          "-m", MODEL, "-pt", "1,4,5,8", "--max-len", "48",
-         "-t", "float32", "--port", str(port)],
+         "-t", "float32", "--port", str(port), *extra_args],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
     try:
         deadline = time.monotonic() + 120
@@ -54,6 +55,11 @@ def server():
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+@pytest.fixture(scope="module")
+def server():
+    yield from _spawn_server()
 
 
 @pytest.fixture(scope="module")
@@ -137,3 +143,53 @@ def test_malformed_requests_clean_400(server):
     # still alive and serving afterwards
     got = _post(port, "/generate", {"ids": [[5, 6, 7]], "new_tokens": 2})
     assert len(got["ids"][0]) == 5
+
+
+@pytest.fixture(scope="module")
+def spec_server():
+    # the shared -pt matches solo_pipe: per-stage random init is seeded
+    # per shard, so weights only match the oracle when partitions match
+    yield from _spawn_server(("--draft-model", MODEL, "--gamma", "3"))
+
+
+def test_speculative_serving_matches_plain(spec_server, solo_pipe):
+    """--draft-model: requests with "speculative": true return tokens
+    identical to plain greedy (here the draft IS the target, so every
+    proposal is accepted); prefix registration feeds both models; the
+    sampling composition is refused cleanly."""
+    port = spec_server
+    rng = np.random.default_rng(13)
+    ids = rng.integers(0, 100, size=(2, 8)).tolist()
+    plain = _post(port, "/generate", {"ids": ids, "new_tokens": 6})["ids"]
+    spec = _post(port, "/generate", {"ids": ids, "new_tokens": 6,
+                                     "speculative": True})["ids"]
+    np.testing.assert_array_equal(np.asarray(spec), np.asarray(plain))
+
+    prefix = rng.integers(0, 100, size=(6,)).tolist()
+    reg = _post(port, "/prefix", {"ids": prefix})
+    suffix = rng.integers(0, 100, size=(1, 4)).tolist()
+    got = _post(port, "/generate",
+                {"ids": suffix, "new_tokens": 5, "speculative": True,
+                 "prefix_id": reg["prefix_id"]})["ids"]
+    handle = solo_pipe.precompute_prefix(np.asarray([prefix]))
+    want = np.asarray(solo_pipe.generate(np.asarray(suffix), 5,
+                                         prefix=handle))
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+    try:
+        _post(port, "/generate", {"ids": ids, "new_tokens": 2,
+                                  "speculative": True, "temperature": 0.7})
+        raise AssertionError("expected HTTP 400")
+    except urllib.error.HTTPError as exc:
+        assert exc.code == 400
+
+
+def test_speculative_unavailable_without_draft(server):
+    """The plain server (no --draft-model) refuses speculative requests
+    with a clean 400."""
+    try:
+        _post(server, "/generate", {"ids": [[1, 2, 3]], "new_tokens": 2,
+                                    "speculative": True})
+        raise AssertionError("expected HTTP 400")
+    except urllib.error.HTTPError as exc:
+        assert exc.code == 400

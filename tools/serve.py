@@ -37,17 +37,23 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 class _Service:
-    """Owns the pipeline + batcher; one worker thread ticks continuously."""
+    """Owns the pipeline + batcher; one worker thread ticks continuously.
 
-    def __init__(self, pipe, max_active=None, max_prefixes=8):
+    With a `spec` (SpeculativeDecoder), greedy requests that ask for it
+    (`"speculative": true`) run draft/verify rounds instead of joining
+    the wave — same lock, so they serialize with batcher ticks."""
+
+    def __init__(self, pipe, max_active=None, max_prefixes=8, spec=None):
         from collections import OrderedDict
 
         from pipeedge_tpu.parallel.batcher import ContinuousBatcher
         self.pipe = pipe
+        self.spec = spec
         self.batcher = ContinuousBatcher(pipe, max_active=max_active)
         self.cond = threading.Condition()
         self.prefixes = OrderedDict()   # LRU-bounded: handles hold full
-        self.max_prefixes = max_prefixes   # max_len KV buffers
+        self.spec_prefixes = OrderedDict()   # max_len KV buffers
+        self.max_prefixes = max_prefixes
         self._next_rid = 0
         self._next_pid = 0
         self._stop = False
@@ -76,14 +82,48 @@ class _Service:
 
     def add_prefix(self, ids):
         with self.cond:
+            # precompute BOTH handles before registering either, so a
+            # draft-side failure cannot leave a half-registered prefix
+            # (usable plainly, 400ing speculatively). The target handle
+            # is shared — the draft model's K/V is the only extra state.
+            target = self.pipe.precompute_prefix(ids)
+            draft = (self.spec.draft.precompute_prefix(ids)
+                     if self.spec is not None else None)
             pid = f"p{self._next_pid}"
             self._next_pid += 1
-            # precompute outside the tick loop is fine: the worker only
-            # runs under this same condition lock
-            self.prefixes[pid] = self.pipe.precompute_prefix(ids)
+            self.prefixes[pid] = target
+            if draft is not None:
+                self.spec_prefixes[pid] = {"target": target,
+                                           "draft": draft}
             while len(self.prefixes) > self.max_prefixes:
-                self.prefixes.popitem(last=False)   # evict oldest
-            return pid, self.prefixes[pid]["len"]
+                old, _ = self.prefixes.popitem(last=False)  # evict oldest
+                self.spec_prefixes.pop(old, None)
+            return pid, target["len"]
+
+    def generate_speculative(self, ids, new_tokens, prefix_id=None):
+        """Greedy speculative decoding (token-identical to plain greedy;
+        the draft only changes the dispatch count). Holds the service
+        lock for the whole generation: a speculative request owns the
+        pipeline while it runs and plain requests queue behind it —
+        speculation trades concurrency for per-request latency here."""
+        import numpy as np
+        if self.spec is None:
+            raise KeyError("server started without --draft-model; "
+                           "speculative generation unavailable")
+        with self.cond:
+            if self._dead is not None:
+                raise RuntimeError(f"serving worker died: {self._dead!r}")
+            prefix = None
+            if prefix_id is not None:
+                if prefix_id not in self.spec_prefixes:
+                    raise KeyError(
+                        f"unknown prefix_id {prefix_id!r} for speculative "
+                        "generation (register via /prefix while the "
+                        "draft model is configured)")
+                self.prefixes.move_to_end(prefix_id)   # LRU touch
+                prefix = self.spec_prefixes[prefix_id]
+            return np.asarray(self.spec.generate(ids, new_tokens,
+                                                 prefix=prefix))
 
     def generate(self, ids, new_tokens, **kw):
         pid = kw.pop("prefix_id", None)
@@ -144,13 +184,23 @@ def make_handler(service, model_name):
                     ids = req["ids"]
                     if ids and not isinstance(ids[0], list):
                         ids = [ids]
-                    out = service.generate(
-                        ids, int(req["new_tokens"]),
-                        temperature=float(req.get("temperature", 0.0)),
-                        top_k=int(req.get("top_k", 0)),
-                        seed=int(req.get("seed", 0)),
-                        eos_token=req.get("eos_token"),
-                        prefix_id=req.get("prefix_id"))
+                    if req.get("speculative"):
+                        if req.get("temperature") or req.get("top_k") \
+                                or req.get("eos_token") is not None:
+                            raise ValueError(
+                                "speculative generation is greedy-exact; "
+                                "it does not compose with sampling/eos")
+                        out = service.generate_speculative(
+                            ids, int(req["new_tokens"]),
+                            prefix_id=req.get("prefix_id"))
+                    else:
+                        out = service.generate(
+                            ids, int(req["new_tokens"]),
+                            temperature=float(req.get("temperature", 0.0)),
+                            top_k=int(req.get("top_k", 0)),
+                            seed=int(req.get("seed", 0)),
+                            eos_token=req.get("eos_token"),
+                            prefix_id=req.get("prefix_id"))
                     self._send(200, {"ids": out.tolist()})
                 else:
                     self._send(404, {"error": "unknown path"})
@@ -171,6 +221,13 @@ def main():
                    choices=["float32", "bfloat16"])
     p.add_argument("--kv-bits", default=0, type=int, choices=[0, 8])
     p.add_argument("--attend-floor", default=64, type=int)
+    p.add_argument("--draft-model", default=None,
+                   help="enable speculative generation: requests with "
+                        '"speculative": true run greedy draft/verify '
+                        "rounds against this (smaller, same-vocabulary) "
+                        "model — token-identical to plain greedy")
+    p.add_argument("--gamma", default=4, type=int,
+                   help="speculative draft lookahead per round")
     p.add_argument("--max-active", default=None, type=int)
     p.add_argument("--max-prefixes", default=8, type=int,
                    help="LRU bound on registered prompt prefixes (each "
@@ -192,12 +249,23 @@ def main():
     pipe = build_decode_pipeline(
         args.model_name, partition, max_len=args.max_len, dtype=dtype,
         cache_bits=args.kv_bits, attend_floor=args.attend_floor)
+    spec = None
+    if args.draft_model:
+        if args.kv_bits:
+            p.error("--draft-model does not compose with --kv-bits (int8 "
+                    "span verification is not bit-identical to serial "
+                    "int8 steps)")
+        from pipeedge_tpu.parallel.speculative import SpeculativeDecoder
+        d_pipe = build_decode_pipeline(
+            args.draft_model, None, max_len=args.max_len, dtype=dtype,
+            attend_floor=args.attend_floor)
+        spec = SpeculativeDecoder(pipe, d_pipe, gamma=args.gamma)
 
     service = _Service(pipe, max_active=args.max_active,
-                       max_prefixes=args.max_prefixes)
+                       max_prefixes=args.max_prefixes, spec=spec)
     server = ThreadingHTTPServer(("127.0.0.1", args.port),
                                  make_handler(service, args.model_name))
-    print(f"serving {args.model_name} ({len(partition)} stages) on "
+    print(f"serving {args.model_name} ({len(pipe.stages)} stages) on "
           f"127.0.0.1:{args.port}", flush=True)
     try:
         server.serve_forever()
