@@ -87,12 +87,20 @@ def test_healthz_and_generate_matches_solo(server, solo_pipe):
             f"http://127.0.0.1:{port}/healthz", timeout=30) as resp:
         health = json.loads(resp.read())
     assert health["ok"] and health["stages"] == 2
+    assert health["speculative"] is False
 
     rng = np.random.default_rng(3)
     ids = rng.integers(0, 100, size=(2, 8)).tolist()
     got = _post(port, "/generate", {"ids": ids, "new_tokens": 6})["ids"]
     want = np.asarray(solo_pipe.generate(np.asarray(ids), 6))
     np.testing.assert_array_equal(np.asarray(got), want)
+
+    # stats surface in /healthz after work has flowed
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=30) as resp:
+        stats = json.loads(resp.read())["stats"]
+    assert stats["tokens"] >= 6 and stats["stage_steps"] > 0
+    assert stats["active"] == 0 and stats["pending"] == 0
 
     # sampled request with a seed reproduces the solo rng discipline
     got_s = _post(port, "/generate", {"ids": ids, "new_tokens": 5,

@@ -8,7 +8,10 @@ registered once via /prefix are reused by any number of /generate
 requests (prompt caching).
 
 Endpoints (all JSON):
-- GET  /healthz            -> {"ok": true, "model": ..., "stages": N}
+- GET  /healthz            -> {"ok", "model", "stages", "speculative",
+                               "stats": {ticks, stage_steps, tokens,
+                               active, pending, prefixes}}; HTTP 503
+                               once the serving worker has died
 - POST /prefix   {"ids": [t0, t1, ...]}
                            -> {"prefix_id": "p0", "len": N}
 - POST /generate {"ids": [[...], ...] | [...], "new_tokens": N,
@@ -168,8 +171,20 @@ def make_handler(service, model_name):
 
         def do_GET(self):
             if self.path == "/healthz":
-                self._send(200, {"ok": True, "model": model_name,
-                                 "stages": len(service.pipe.stages)})
+                # LOCK-FREE best-effort snapshot: a probe must answer
+                # even while a speculative generation or prefix
+                # registration holds the service lock (GIL-atomic int/
+                # len reads; momentary inconsistency is fine for health)
+                dead = service._dead is not None
+                stats = dict(service.batcher.stats,
+                             active=service.batcher.active,
+                             pending=len(service.batcher.pending),
+                             prefixes=len(service.prefixes))
+                self._send(503 if dead else 200,
+                           {"ok": not dead, "model": model_name,
+                            "stages": len(service.pipe.stages),
+                            "speculative": service.spec is not None,
+                            "stats": stats})
             else:
                 self._send(404, {"error": "unknown path"})
 
