@@ -166,6 +166,9 @@ def test_speculative_serving_matches_plain(spec_server, solo_pipe):
     proposal is accepted); prefix registration feeds both models; the
     sampling composition is refused cleanly."""
     port = spec_server
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=30) as resp:
+        assert json.loads(resp.read())["speculative"] is True
     rng = np.random.default_rng(13)
     ids = rng.integers(0, 100, size=(2, 8)).tolist()
     plain = _post(port, "/generate", {"ids": ids, "new_tokens": 6})["ids"]
