@@ -56,6 +56,8 @@ class _Request:
     prefix: Optional[Dict] = None    # precompute_prefix handle
     eos_token: Optional[int] = None  # stop early once every row emitted it
     pad_token: Optional[int] = None  # fills rows past their own eos
+    # streaming hook: fires (step, [B] device tokens) as each pick lands
+    on_token: Optional[object] = None
     rows_done: Optional[np.ndarray] = None   # [B] eos seen per row
     caches: Optional[List] = None    # per-stage cache slots (admission)
     tokens: List = field(default_factory=list)
@@ -66,6 +68,91 @@ class _Request:
         token len(tokens)+1 attends through position prompt_len +
         len(tokens) - 1 (mirrors DecodePipeline.generate's pos)."""
         return self.prompt_len + len(self.tokens) - 1
+
+
+def _build_request(pipe: DecodePipeline, rid, ids, new_tokens: int,
+                   temperature: float, top_k: int, seed: int,
+                   eos_token: Optional[int], pad_token: Optional[int],
+                   prefix: Optional[Dict],
+                   on_token=None) -> _Request:
+    """Validate one request's arguments against `pipe` and build its
+    `_Request` — the shared admission contract of the wave batcher and
+    the stage-worker executor (identical errors, identical rng/pick
+    discipline, so token streams match across executors)."""
+    ids = jnp.asarray(ids, jnp.int32)
+    if ids.ndim != 2 or ids.shape[1] == 0:
+        raise ValueError("prompt must be [B, S] with S >= 1, got "
+                         f"shape {ids.shape}")
+    if new_tokens < 1:
+        raise ValueError(f"new_tokens must be >= 1, got {new_tokens}")
+    if pad_token is not None and eos_token is None:
+        raise ValueError("pad_token only applies with eos_token (rows "
+                         "are padded after their own eos)")
+    if prefix is not None:
+        # reject handles built by an incompatible pipeline up front
+        # (a mismatch would otherwise surface as an opaque jit shape
+        # error mid-tick, or corrupt attend windows)
+        pipe.check_prefix(prefix)
+    prompt_len = ids.shape[1] + (prefix["len"] if prefix else 0)
+    validate_capacity(pipe.cfg, pipe.max_len, prompt_len, new_tokens)
+    return _Request(
+        rid=rid, ids=ids, new_tokens=new_tokens,
+        pick=make_token_picker(temperature, top_k),
+        rng=jax.random.PRNGKey(seed), prompt_len=prompt_len,
+        prefix=prefix, eos_token=eos_token,
+        pad_token=eos_token if pad_token is None else pad_token,
+        on_token=on_token)
+
+
+def _seed_caches(pipe: DecodePipeline, req: _Request) -> str:
+    """Create the request's per-stage cache slots and return its prompt
+    pass kind: a prefix-seeded request's suffix runs as one SPAN at the
+    prefix offset (prompt caching); otherwise a fresh prefill. Shared by
+    the wave batcher's admission and the stage workers' submit."""
+    if req.prefix is not None:
+        req.caches = [_repeat_batch(c, req.ids.shape[0])
+                      for c in req.prefix["caches"]]
+        return "span"
+    req.caches = pipe._fresh_caches(req.ids.shape[0])
+    return "prefill"
+
+
+def _run_stage(pipe: DecodePipeline, i: int, req: _Request, data,
+               kind: str):
+    """One stage-step dispatch for request `req` at stage `i` — THE
+    per-stage semantics (device placement, prefill vs span vs step),
+    shared by ContinuousBatcher.tick and StageWorkerExecutor's workers
+    so the two executors can never drift apart."""
+    st = pipe.stages[i]
+    if st["device"] is not None:
+        data = jax.device_put(data, st["device"])
+    if kind == "prefill":
+        out, req.caches[i] = st["prefill"](st["params"], data,
+                                           req.caches[i])
+    elif kind == "span":
+        # prefix-seeded prompt pass: the suffix runs as one span at the
+        # prefix offset (DecodePipeline.extend's rule)
+        out, req.caches[i] = pipe._decode_step(
+            st, data, req.caches[i], req.prefix["len"],
+            span=data.shape[1])
+    else:
+        out, req.caches[i] = pipe._decode_step(st, data, req.caches[i],
+                                               req.pos)
+    return out
+
+
+def _finalize_tokens(req: _Request) -> np.ndarray:
+    """[B, S + T] result array: prompt + picked tokens, with everything
+    strictly after each row's first eos masked to its pad token (rows
+    that hit eos early kept decoding in lockstep; no garbage
+    continuation reaches the caller)."""
+    toks = np.stack([np.asarray(t) for t in req.tokens], axis=1)  # [B, T]
+    if req.eos_token is not None:
+        seen = np.cumsum(toks == req.eos_token, axis=1) > 0
+        after = np.concatenate(
+            [np.zeros_like(seen[:, :1]), seen[:, :-1]], axis=1)
+        toks = np.where(after, req.pad_token, toks)
+    return np.concatenate([np.asarray(req.ids), toks], axis=1)
 
 
 class ContinuousBatcher:
@@ -112,7 +199,8 @@ class ContinuousBatcher:
                top_k: int = 0, seed: int = 0,
                eos_token: Optional[int] = None,
                pad_token: Optional[int] = None,
-               prefix: Optional[Dict] = None) -> None:
+               prefix: Optional[Dict] = None,
+               on_token=None) -> None:
         """Queue a request. `ids` [B, S] is a prompt batch decoded in
         lockstep (B=1 for a single sequence); each distinct (B, S) shape
         compiles its own prefill program, shared across requests.
@@ -132,41 +220,24 @@ class ContinuousBatcher:
         generate's pad-after-eos convention) in the returned array, so
         callers never consume a finished row's garbage continuation. The
         continuous-batching payoff: short answers release capacity
-        immediately instead of padding to the cap."""
+        immediately instead of padding to the cap.
+
+        `on_token(step, tokens)` fires as each step's pick lands (tokens
+        is the [B] device array — the callback decides when to block on
+        readback), the streaming hook `tools/serve.py` chains to chunked
+        HTTP responses."""
         if rid in self.results or rid in self._live_rids:
             raise ValueError(f"duplicate request id {rid!r}")
-        ids = jnp.asarray(ids, jnp.int32)
-        if ids.ndim != 2 or ids.shape[1] == 0:
-            raise ValueError("prompt must be [B, S] with S >= 1, got "
-                             f"shape {ids.shape}")
-        if new_tokens < 1:
-            raise ValueError(f"new_tokens must be >= 1, got {new_tokens}")
-        if pad_token is not None and eos_token is None:
-            raise ValueError("pad_token only applies with eos_token (rows "
-                             "are padded after their own eos)")
-        prompt_len = ids.shape[1] + (prefix["len"] if prefix else 0)
-        validate_capacity(self.pipe.cfg, self.pipe.max_len, prompt_len,
-                          new_tokens)
+        req = _build_request(self.pipe, rid, ids, new_tokens, temperature,
+                             top_k, seed, eos_token, pad_token, prefix,
+                             on_token=on_token)
         self._live_rids.add(rid)
-        self.pending.append(_Request(
-            rid=rid, ids=ids, new_tokens=new_tokens,
-            pick=make_token_picker(temperature, top_k),
-            rng=jax.random.PRNGKey(seed), prompt_len=prompt_len,
-            prefix=prefix, eos_token=eos_token,
-            pad_token=eos_token if pad_token is None else pad_token))
+        self.pending.append(req)
 
     def _admit(self) -> None:
         while self.pending and self.active < self.max_active:
             req = self.pending.popleft()
-            if req.prefix is not None:
-                # seed this request's cache slots from the shared prefix
-                # (prompt caching); its prompt pass is a suffix SPAN
-                req.caches = [_repeat_batch(c, req.ids.shape[0])
-                              for c in req.prefix["caches"]]
-                kind = "span"
-            else:
-                req.caches = self.pipe._fresh_caches(req.ids.shape[0])
-                kind = "prefill"
+            kind = _seed_caches(self.pipe, req)
             self.active += 1
             self._stage_q[0].append((req, req.ids, kind))
 
@@ -186,6 +257,8 @@ class ContinuousBatcher:
         token = req.pick(logits.astype(jnp.float32), sub)
         req.tokens.append(token)
         self.stats["tokens"] += int(token.shape[0])
+        if req.on_token is not None:
+            req.on_token(len(req.tokens) - 1, token)
         if req.eos_token is not None:
             eos_pending.append(req)
             return
@@ -195,17 +268,7 @@ class ContinuousBatcher:
             reentries.append((req, token[:, None], "step"))
 
     def _complete(self, req: _Request) -> None:
-        toks = np.stack([np.asarray(t) for t in req.tokens], axis=1)  # [B, T]
-        if req.eos_token is not None:
-            # rows that hit eos before the request stopped kept decoding
-            # in lockstep; mask everything strictly after each row's
-            # first eos so no garbage continuation reaches the caller
-            seen = np.cumsum(toks == req.eos_token, axis=1) > 0
-            after = np.concatenate(
-                [np.zeros_like(seen[:, :1]), seen[:, :-1]], axis=1)
-            toks = np.where(after, req.pad_token, toks)
-        self.results[req.rid] = np.concatenate(
-            [np.asarray(req.ids), toks], axis=1)
+        self.results[req.rid] = _finalize_tokens(req)
         req.caches = None            # free this request's cache slots
         self.active -= 1
         self._live_rids.discard(req.rid)
@@ -247,21 +310,7 @@ class ContinuousBatcher:
             if not self._stage_q[i]:
                 continue
             req, data, kind = self._stage_q[i].popleft()
-            st = self.pipe.stages[i]
-            if st["device"] is not None:
-                data = jax.device_put(data, st["device"])
-            if kind == "prefill":
-                out, req.caches[i] = st["prefill"](st["params"], data,
-                                                   req.caches[i])
-            elif kind == "span":
-                # prefix-seeded prompt pass: the suffix runs as one span
-                # at the prefix offset (DecodePipeline.extend's rule)
-                out, req.caches[i] = self.pipe._decode_step(
-                    st, data, req.caches[i], req.prefix["len"],
-                    span=data.shape[1])
-            else:
-                out, req.caches[i] = self.pipe._decode_step(
-                    st, data, req.caches[i], req.pos)
+            out = _run_stage(self.pipe, i, req, data, kind)
             self.stats["stage_steps"] += 1
             worked = True
             if i + 1 < self.n_stages:
@@ -281,3 +330,217 @@ class ContinuousBatcher:
         while self.tick():
             pass
         return self.results
+
+
+class StageWorkerExecutor:
+    """Stage-pinned multi-worker executor: one thread per pipeline stage.
+
+    Where `ContinuousBatcher.tick` serializes the HOST side of every
+    stage's dispatch through one Python loop (the device work is async,
+    but tracing/argument handling/dispatch are not), this executor pins a
+    worker thread to each stage: worker `i` blocks on stage `i`'s input
+    queue, dispatches exactly its own stage's compiled programs, and
+    hands the wave to stage `i+1`'s queue. Host-side dispatch of
+    different stages genuinely overlaps, and the last stage's token
+    picks (plus eos readbacks) never stall the other stages' dispatch.
+
+    The per-request computation is exactly the wave batcher's — the same
+    `_build_request` admission contract, the same stage programs, the
+    same pick/rng discipline — so token streams are identical to solo
+    `DecodePipeline.generate` runs and to `ContinuousBatcher` results
+    (tests/test_batcher.py). Request lifecycle:
+
+    >>> ex = StageWorkerExecutor(pipe)
+    >>> ex.submit("a", ids, new_tokens=8)       # returns immediately
+    >>> out = ex.wait("a")                      # [B, S+8]
+    >>> ex.stop()
+
+    `max_active` bounds concurrently admitted requests (KV-cache memory)
+    with a semaphore: `submit` blocks while the pipeline is full —
+    callers ARE the queue (one HTTP handler thread per request in
+    tools/serve.py), so admission backpressure lands on them directly.
+    A worker that raises marks the executor dead; every current and
+    future waiter raises instead of hanging (the serve.py healthz
+    contract)."""
+
+    _DONE = object()
+
+    def __init__(self, pipe: DecodePipeline,
+                 max_active: Optional[int] = None):
+        import queue as queue_mod
+        import threading
+
+        if pipe.sp_degree != 1:
+            raise ValueError("stage workers drive per-request decode "
+                             "waves; sp prefill is a whole-pipeline pass")
+        self.pipe = pipe
+        self.n_stages = len(pipe.stages)
+        self.max_active = (self.n_stages + 1 if max_active is None
+                           else max_active)
+        if self.max_active < 1:
+            raise ValueError(f"max_active must be >= 1, got {self.max_active}")
+        self._q = [queue_mod.Queue() for _ in range(self.n_stages)]
+        # plain (not Bounded) semaphore: _die() over-releases on purpose
+        # so submitters blocked on admission wake up and see the failure
+        self._slots = threading.Semaphore(self.max_active)
+        self._lock = threading.Condition()
+        self.results: Dict = {}
+        self._live = set()
+        self._dead: Optional[BaseException] = None
+        self.active = 0
+        self.stats = {"stage_steps": [0] * self.n_stages,
+                      "busy": [False] * self.n_stages, "tokens": 0}
+        self._workers = [
+            threading.Thread(target=self._stage_loop, args=(i,),
+                             daemon=True, name=f"stage-worker-{i}")
+            for i in range(self.n_stages)]
+        for w in self._workers:
+            w.start()
+
+    # -- client side ------------------------------------------------------
+
+    def submit(self, rid, ids, new_tokens: int, temperature: float = 0.0,
+               top_k: int = 0, seed: int = 0,
+               eos_token: Optional[int] = None,
+               pad_token: Optional[int] = None,
+               prefix: Optional[Dict] = None,
+               on_token=None) -> None:
+        """Admit one request (same argument contract as
+        `ContinuousBatcher.submit`, including prefix-handle validation
+        and the `on_token` streaming hook). BLOCKS while `max_active`
+        requests are in flight — admission backpressure is the caller's
+        thread, not an internal queue."""
+        req = _build_request(self.pipe, rid, ids, new_tokens, temperature,
+                             top_k, seed, eos_token, pad_token, prefix,
+                             on_token=on_token)
+        with self._lock:
+            self._check_dead()
+            if rid in self.results or rid in self._live:
+                raise ValueError(f"duplicate request id {rid!r}")
+            self._live.add(rid)
+        self._slots.acquire()
+        try:
+            with self._lock:
+                if self._dead is not None:   # woken by _die's over-release
+                    self._check_dead()
+                self.active += 1
+            try:
+                kind = _seed_caches(self.pipe, req)
+                self._q[0].put((req, req.ids, kind))
+            except BaseException:
+                # roll the admission back (e.g. cache allocation OOM):
+                # leaking the slot would eventually wedge every submit
+                # while healthz still reports ok
+                with self._lock:
+                    self.active -= 1
+                raise
+        except BaseException:
+            with self._lock:
+                self._live.discard(rid)
+            self._slots.release()
+            raise
+
+    def wait(self, rid, timeout: Optional[float] = None) -> np.ndarray:
+        """Block until request `rid` completes; returns its [B, S + T]
+        ids (the same array `ContinuousBatcher.run` would record)."""
+        with self._lock:
+            while rid not in self.results:
+                self._check_dead()
+                if not self._lock.wait(timeout):
+                    raise TimeoutError(f"request {rid!r} not done after "
+                                       f"{timeout}s")
+            return self.results.pop(rid)
+
+    def snapshot(self) -> Dict:
+        """Point-in-time per-worker stats for health reporting: stage
+        steps and busy flag per worker, queue depths, tokens, active."""
+        with self._lock:
+            return {"stage_steps": list(self.stats["stage_steps"]),
+                    "busy": list(self.stats["busy"]),
+                    "queued": [q.qsize() for q in self._q],
+                    "tokens": self.stats["tokens"],
+                    "active": self.active}
+
+    def stop(self) -> None:
+        """Shut the workers down. Queued work ahead of the sentinels is
+        processed, but a multi-step request cannot finish once worker 0
+        exits (its re-entering waves have no one to run them) — after
+        the join, every still-live request's waiter is FAILED rather
+        than left hanging. Drain with `wait` before stopping if results
+        matter."""
+        for q in self._q:
+            q.put(self._DONE)
+        for w in self._workers:
+            w.join()
+        with self._lock:
+            if self._live and self._dead is None:
+                self._dead = RuntimeError(
+                    f"executor stopped with {len(self._live)} request(s) "
+                    "in flight")
+            self._lock.notify_all()
+
+    def _check_dead(self) -> None:
+        if self._dead is not None:
+            raise RuntimeError(f"stage worker died: {self._dead!r}")
+
+    # -- worker side ------------------------------------------------------
+
+    def _stage_loop(self, i: int) -> None:
+        while True:
+            item = self._q[i].get()
+            if item is self._DONE:
+                return
+            req, data, kind = item
+            self.stats["busy"][i] = True
+            try:
+                out = _run_stage(self.pipe, i, req, data, kind)
+                self.stats["stage_steps"][i] += 1
+                if i + 1 < self.n_stages:
+                    self._q[i + 1].put((req, out, kind))
+                else:
+                    self._finish(req, out)
+            except BaseException as exc:   # noqa: BLE001 — a dead worker
+                self._die(exc)             # must fail waiters, not hang them
+                raise
+            finally:
+                self.stats["busy"][i] = False
+
+    def _finish(self, req: _Request, out) -> None:
+        """Last stage done (runs in the last stage's worker): pick the
+        next token, stream it, then complete or re-enter stage 0. The
+        eos readback blocks only THIS worker; earlier stages keep
+        dispatching other requests."""
+        logits = out[:, -1]
+        req.rng, sub = jax.random.split(req.rng)
+        token = req.pick(logits.astype(jnp.float32), sub)
+        req.tokens.append(token)
+        with self._lock:
+            self.stats["tokens"] += int(token.shape[0])
+        if req.on_token is not None:
+            req.on_token(len(req.tokens) - 1, token)
+        done = len(req.tokens) >= req.new_tokens
+        if not done and req.eos_token is not None:
+            hit = np.asarray(token) == req.eos_token
+            req.rows_done = hit if req.rows_done is None \
+                else req.rows_done | hit
+            done = bool(req.rows_done.all())
+        if done:
+            arr = _finalize_tokens(req)
+            req.caches = None        # free this request's cache slots
+            with self._lock:
+                self.results[req.rid] = arr
+                self._live.discard(req.rid)
+                self.active -= 1
+                self._lock.notify_all()
+            self._slots.release()
+        else:
+            self._q[0].put((req, token[:, None], "step"))
+
+    def _die(self, exc: BaseException) -> None:
+        with self._lock:
+            if self._dead is None:
+                self._dead = exc
+            self._lock.notify_all()
+        # wake submitters blocked on admission so they observe the death
+        for _ in range(self.max_active):
+            self._slots.release()

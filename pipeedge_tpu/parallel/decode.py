@@ -140,8 +140,19 @@ def _cache_write_quantized(bcache: Cache, k_new: jax.Array,
 _INT8_KERNEL_VMEM_CAP = 4 << 20
 
 
+def _int8_kernel_env() -> bool:
+    """Resolve the PIPEEDGE_INT8_DECODE_ATTEND opt-in (empty/0/false/no/off
+    all mean off). Callers resolve this ONCE at pipeline construction and
+    bind the answer into the stage programs — compiled decode steps are
+    cached per shape/read_len, so a trace-time env read would silently
+    ignore later toggles for already-compiled shapes (round-4 advice)."""
+    import os
+    env = (os.getenv("PIPEEDGE_INT8_DECODE_ATTEND") or "").strip().lower()
+    return bool(env) and env not in ("0", "false", "no", "off")
+
+
 def _use_int8_decode_kernel(bcache: Cache, s: int, cfg: TransformerConfig,
-                            width: int) -> Optional[bool]:
+                            width: int, optin: bool) -> Optional[bool]:
     """Route the classic int8 single-token decode step through the fused
     Pallas kernel (ops/decode_attention.py): MHA only (kv_heads == query
     heads), no sliding window, attend window small enough for VMEM —
@@ -150,21 +161,19 @@ def _use_int8_decode_kernel(bcache: Cache, s: int, cfg: TransformerConfig,
 
     Returns None (use the XLA path), False (use the kernel, native
     lowering), or True (use the kernel in interpret mode — forcing it
-    on a non-TPU backend, for tests). OPT-IN via env
-    PIPEEDGE_INT8_DECODE_ATTEND=1 (empty/0 means off): an isolated
-    chip microbench measured the kernel at parity-to-slower vs XLA's
-    dequantize-then-attend (docs/DECODE.md), so the default stays on
-    the XLA path; the kernel is kept, exactness-tested, as the
+    on a non-TPU backend, for tests). `optin` is the construction-time
+    resolution of PIPEEDGE_INT8_DECODE_ATTEND (`_int8_kernel_env`): an
+    isolated chip microbench measured the kernel at parity-to-slower vs
+    XLA's dequantize-then-attend (docs/DECODE.md), so the default stays
+    on the XLA path; the kernel is kept, exactness-tested, as the
     experimental base for revisiting the fusion."""
-    import os
+    if not optin:
+        return None
     if s != 1 or "k_scale" not in bcache:
         return None
     if cfg.kv_heads != cfg.num_attention_heads or cfg.sliding_window:
         return None
     if width * cfg.kv_heads * cfg.head_dim > _INT8_KERNEL_VMEM_CAP:
-        return None
-    env = (os.getenv("PIPEEDGE_INT8_DECODE_ATTEND") or "").strip().lower()
-    if not env or env in ("0", "false", "no", "off"):
         return None
     from ..ops.decode_attention import int8_decode_attention_supported
     return not int8_decode_attention_supported()
@@ -247,15 +256,19 @@ def _block_tail(p: Dict, x: jax.Array, ctx: jax.Array,
 
 def _attention_core(p: Dict, x: jax.Array, bcache: Cache, pos,
                     cfg: TransformerConfig, prefill: bool,
-                    read_len: Optional[int] = None) \
+                    read_len: Optional[int] = None,
+                    int8_optin: bool = False) \
         -> Tuple[jax.Array, Cache]:
     """ln + qkv + cache update + masked attend: the cached attention half
-    shared by the plain and expert-parallel decode steps."""
+    shared by the plain and expert-parallel decode steps. `int8_optin` is
+    the construction-time PIPEEDGE_INT8_DECODE_ATTEND resolution (bound
+    into the stage programs by _make_stage_run)."""
     normed = layer_norm(p["ln_before"], x, cfg.layer_norm_eps)
     q, k_new, v_new = _qkv(p, normed, cfg)
     w = _attend_width(bcache, read_len) if "k" in bcache else 0
     interpret = (None if prefill
-                 else _use_int8_decode_kernel(bcache, x.shape[1], cfg, w))
+                 else _use_int8_decode_kernel(bcache, x.shape[1], cfg, w,
+                                              int8_optin))
     if interpret is not None:
         from ..ops.decode_attention import int8_decode_attention
         bcache = _cache_write_quantized(bcache, k_new, v_new,
@@ -274,7 +287,8 @@ def _attention_core(p: Dict, x: jax.Array, bcache: Cache, pos,
 
 def _block_step(p: Dict, x: jax.Array, bcache: Cache, pos,
                 cfg: TransformerConfig, prefill: bool,
-                read_len: Optional[int] = None) -> Tuple[jax.Array, Cache]:
+                read_len: Optional[int] = None,
+                int8_optin: bool = False) -> Tuple[jax.Array, Cache]:
     """One GPT-2 block over current token(s) with cache read/update.
 
     Prefill: x is the full prompt [B, S, D] written at positions [0, S);
@@ -282,7 +296,7 @@ def _block_step(p: Dict, x: jax.Array, bcache: Cache, pos,
     is this block's cache slice {k, v[, *_scale, *_shift]}. `read_len`:
     static attend-window truncation (see _cache_update_and_read)."""
     ctx, bcache = _attention_core(p, x, bcache, pos, cfg, prefill,
-                                  read_len=read_len)
+                                  read_len=read_len, int8_optin=int8_optin)
     return _block_tail(p, x, ctx, cfg), bcache
 
 
@@ -394,8 +408,13 @@ def _make_stage_run(family, cfg: TransformerConfig,
                          f"{shard_config.layer_end}] cut mid-block)")
     if block_fn is None:
         # family-dispatched cached block (llama supplies RoPE/GQA/SwiGLU);
-        # the default is the GPT-2-shaped step
-        block_fn = getattr(family, "cached_block_step", None) or _block_step
+        # the default is the GPT-2-shaped step, with the int8-kernel
+        # opt-in resolved HERE — at stage-program construction
+        # (DecodePipeline.__init__) — so toggling the env var after
+        # programs compile cannot leave stale shapes on the old setting
+        block_fn = getattr(family, "cached_block_step", None)
+        if block_fn is None:
+            block_fn = partial(_block_step, int8_optin=_int8_kernel_env())
 
     def run(params, data, cache, pos, prefill, read_len=None):
         if shard_config.is_first:
@@ -655,8 +674,13 @@ def make_ep_stage_fns(family, cfg: TransformerConfig,
         return ep_ffn_delta(p["moe"], normed, cfg.n_experts,
                             cfg.capacity_factor, axis, act=gelu_new)
 
+    # kernel opt-in resolved at stage-fn construction, same rule as
+    # _make_stage_run (the int8-cache MHA ep composition routes too)
+    int8_optin = _int8_kernel_env()
+
     def block_step_ep(p, x, bcache, pos, cfg_, prefill):
-        ctx, bcache = _attention_core(p, x, bcache, pos, cfg_, prefill)
+        ctx, bcache = _attention_core(p, x, bcache, pos, cfg_, prefill,
+                                      int8_optin=int8_optin)
         return _block_tail(p, x, ctx, cfg_, ffn_delta=ffn_delta), bcache
 
     run = _make_stage_run(family, cfg, shard_config, block_fn=block_step_ep)
@@ -987,6 +1011,11 @@ class DecodePipeline:
                                 mesh is not None else devices[i]})
         self.dtype = dtype
         self.cache_bits = cache_bits
+        # construction-time resolution of the int8 decode-kernel opt-in
+        # (the same value _make_stage_run bound into the stage programs),
+        # exposed for introspection — later env toggles don't affect this
+        # pipeline (round-4 advice)
+        self.int8_decode_optin = _int8_kernel_env()
         self.sp_degree = sp_mesh.shape[sp_axis] if sp_mesh is not None else 1
         # bucketed decode-step attention rides the plain stage programs
         # AND the tp variant (static read_len arg; the tp shard_map
@@ -1119,7 +1148,37 @@ class DecodePipeline:
             raise ValueError(f"prefix length {ids.shape[1]} not divisible "
                              f"by the sp prefill degree {self.sp_degree}")
         _, caches = self._prefill(ids)
-        return {"caches": caches, "len": ids.shape[1]}
+        return {"caches": caches, "len": ids.shape[1],
+                "sig": self._prefix_sig()}
+
+    def _prefix_sig(self) -> Tuple:
+        """Cache-compatibility signature stamped into prefix handles: a
+        handle built by one pipeline is only valid on a pipeline whose
+        per-stage cache layout (block split, max_len, quantization,
+        dtype, KV geometry) matches — a mismatched handle would otherwise
+        die deep inside jit with an opaque shape error or silently
+        corrupt attend windows (round-4 advice)."""
+        return ("decode-prefix-v1",
+                tuple(st["n_blocks"] for st in self.stages),
+                self.max_len, self.cache_bits,
+                jax.dtypes.canonicalize_dtype(self.dtype).name,
+                self.cfg.kv_heads, self.cfg.head_dim)
+
+    def check_prefix(self, prefix: Dict) -> None:
+        """Validate a `precompute_prefix` handle against THIS pipeline's
+        cache layout (see `_prefix_sig`); raises ValueError with the two
+        signatures on mismatch."""
+        sig = prefix.get("sig") if isinstance(prefix, dict) else None
+        if sig is None:
+            raise ValueError(
+                "prefix is not a precompute_prefix handle (no 'sig' "
+                "stamp); build it with this pipeline's precompute_prefix")
+        if sig != self._prefix_sig():
+            raise ValueError(
+                "prefix handle was built by an incompatible pipeline: "
+                f"handle sig {sig} vs this pipeline {self._prefix_sig()} "
+                "(fields: version, per-stage block counts, max_len, "
+                "cache_bits, dtype, kv_heads, head_dim)")
 
     def generate(self, ids, new_tokens: int, temperature: float = 0.0,
                  top_k: int = 0, seed: int = 0, step_callback=None,
@@ -1150,6 +1209,7 @@ class DecodePipeline:
         pick = make_token_picker(temperature, top_k)
 
         if prefix is not None:
+            self.check_prefix(prefix)
             if prefill_ubatch is not None:
                 raise ValueError("prefix reuse runs the suffix as one "
                                  "span; --prefill-ubatch does not apply")

@@ -95,6 +95,10 @@ class SpeculativeDecoder:
         base = prefix["target"]["len"] if prefix else 0
         prompt_len = suffix_len + base
         if prefix is not None:
+            # each sub-handle must match ITS pipeline's cache layout
+            # (round-4 advice: reject foreign handles before jit)
+            self.target.check_prefix(prefix["target"])
+            self.draft.check_prefix(prefix["draft"])
             if prefix["draft"]["len"] != base:
                 raise ValueError("target/draft prefix lengths differ: "
                                  f"{base} vs {prefix['draft']['len']}")

@@ -255,3 +255,107 @@ def test_prefix_cached_requests_match_solo_and_full(tiny_pipe):
         results["sampled"],
         np.asarray(tiny_pipe.generate(suffixes[0], 5, temperature=0.9,
                                       seed=4, prefix=handle)))
+
+
+def test_prefix_handle_validated_at_submit(tiny_pipe):
+    """A prefix handle built by an INCOMPATIBLE pipeline (different
+    max_len here) is rejected up front with the two signatures named —
+    not deep inside jit as an opaque shape error (round-4 advice). Same
+    check guards solo generate(prefix=)."""
+    rng = np.random.default_rng(31)
+    prefix = rng.integers(0, 100, size=(1, 6))
+    suffix = rng.integers(0, 100, size=(1, 4))
+    handle = tiny_pipe.precompute_prefix(prefix)
+
+    # strip the stamp -> rejected as not-a-handle
+    batcher = ContinuousBatcher(tiny_pipe)
+    bad = {k: v for k, v in handle.items() if k != "sig"}
+    with pytest.raises(ValueError, match="precompute_prefix handle"):
+        batcher.submit(0, suffix, new_tokens=4, prefix=bad)
+
+    # forge an incompatible signature -> rejected with both sigs shown
+    sig = list(handle["sig"])
+    sig[2] = handle["sig"][2] + 16       # max_len field
+    forged = dict(handle, sig=tuple(sig))
+    with pytest.raises(ValueError, match="incompatible pipeline"):
+        batcher.submit(1, suffix, new_tokens=4, prefix=forged)
+    with pytest.raises(ValueError, match="incompatible pipeline"):
+        tiny_pipe.generate(suffix, 4, prefix=forged)
+
+    # the genuine handle still passes end-to-end
+    batcher.submit(2, suffix, new_tokens=4, prefix=handle)
+    results = batcher.run()
+    np.testing.assert_array_equal(
+        results[2], np.asarray(tiny_pipe.generate(suffix, 4, prefix=handle)))
+
+
+def test_stage_worker_executor_matches_solo(tiny_pipe):
+    """StageWorkerExecutor (one thread pinned per stage) is token-
+    identical to solo generate() for mixed plain/sampled/prefix/eos
+    requests submitted concurrently, streams per-step tokens via
+    on_token, and reports per-worker stats."""
+    import threading
+
+    from pipeedge_tpu.parallel.batcher import StageWorkerExecutor
+
+    rng = np.random.default_rng(41)
+    prefix = rng.integers(0, 100, size=(1, 6))
+    handle = tiny_pipe.precompute_prefix(prefix)
+    ex = StageWorkerExecutor(tiny_pipe)
+    try:
+        plain = rng.integers(0, 100, size=(2, 7))
+        sampled = rng.integers(0, 100, size=(1, 5))
+        suffix = rng.integers(0, 100, size=(1, 4))
+        streamed = []
+        outs = {}
+
+        def client(rid, ids, n, **kw):
+            ex.submit(rid, ids, n, **kw)
+            outs[rid] = ex.wait(rid, timeout=300)
+
+        threads = [
+            threading.Thread(target=client, args=("plain", plain, 6),
+                             kwargs={"on_token": lambda s, t:
+                                     streamed.append((s, np.asarray(t)))}),
+            threading.Thread(target=client, args=("sampled", sampled, 5),
+                             kwargs={"temperature": 0.8, "seed": 3}),
+            threading.Thread(target=client, args=("pfx", suffix, 5),
+                             kwargs={"prefix": handle}),
+            threading.Thread(target=client, args=("eos", plain, 6),
+                             kwargs={"eos_token": 11}),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+            assert not t.is_alive()
+
+        np.testing.assert_array_equal(
+            outs["plain"], np.asarray(tiny_pipe.generate(plain, 6)))
+        np.testing.assert_array_equal(
+            outs["sampled"], np.asarray(tiny_pipe.generate(
+                sampled, 5, temperature=0.8, seed=3)))
+        np.testing.assert_array_equal(
+            outs["pfx"], np.asarray(tiny_pipe.generate(suffix, 5,
+                                                       prefix=handle)))
+        want_eos = ContinuousBatcher(tiny_pipe)
+        want_eos.submit("eos", plain, 6, eos_token=11)
+        np.testing.assert_array_equal(outs["eos"], want_eos.run()["eos"])
+
+        # the stream delivered every step's token in order, matching the
+        # result's continuation columns
+        steps = sorted(streamed, key=lambda x: x[0])
+        assert [s for s, _ in steps] == list(range(6))
+        got = np.stack([t for _, t in steps], axis=1)
+        np.testing.assert_array_equal(got, outs["plain"][:, 7:])
+
+        snap = ex.snapshot()
+        assert len(snap["stage_steps"]) == len(PARTITION)
+        assert all(s > 0 for s in snap["stage_steps"])
+        assert snap["active"] == 0 and snap["tokens"] >= 22
+
+        with pytest.raises(ValueError, match="duplicate"):
+            ex.submit("plain2", plain, 2)  # rid free, fine
+            ex.submit("plain2", plain, 2)  # duplicate while live/result
+    finally:
+        ex.stop()

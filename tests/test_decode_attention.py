@@ -75,32 +75,56 @@ def test_int8_pipeline_tokens_match_with_kernel(monkeypatch):
 def test_kernel_gate_scope(monkeypatch):
     """The kernel only takes the MHA single-token path: spans, GQA,
     sliding-window, and VMEM-overflowing windows stay on the XLA path
-    (gate returns None); the env override forces interpret mode off-TPU
-    and empty means unset."""
+    (gate returns None). The opt-in is resolved ONCE at pipeline
+    construction (`_int8_kernel_env`) and passed in, so env toggles after
+    stage programs compile cannot desynchronize cached shapes."""
     import dataclasses
     cfg = registry.get_model_config("pipeedge/test-tiny-gpt2")
     cache8 = {"k_scale": None}
-    monkeypatch.delenv("PIPEEDGE_INT8_DECODE_ATTEND", raising=False)
-    # span / fp cache / GQA / window / huge window never route
-    assert decode._use_int8_decode_kernel(cache8, 2, cfg, 64) is None
-    assert decode._use_int8_decode_kernel({}, 1, cfg, 64) is None
+    # span / fp cache / GQA / window / huge window never route, even
+    # when opted in
+    assert decode._use_int8_decode_kernel(cache8, 2, cfg, 64, True) is None
+    assert decode._use_int8_decode_kernel({}, 1, cfg, 64, True) is None
     gqa = dataclasses.replace(cfg, num_kv_heads=2, num_attention_heads=4)
-    assert decode._use_int8_decode_kernel(cache8, 1, gqa, 64) is None
+    assert decode._use_int8_decode_kernel(cache8, 1, gqa, 64, True) is None
     windowed = dataclasses.replace(cfg, sliding_window=4)
-    assert decode._use_int8_decode_kernel(cache8, 1, windowed, 64) is None
+    assert decode._use_int8_decode_kernel(cache8, 1, windowed, 64,
+                                          True) is None
     huge = decode._INT8_KERNEL_VMEM_CAP // (cfg.kv_heads * cfg.head_dim) + 8
-    monkeypatch.setenv("PIPEEDGE_INT8_DECODE_ATTEND", "1")
-    assert decode._use_int8_decode_kernel(cache8, 1, cfg, huge) is None
-    # opt-in: default (unset/empty/0) stays on the XLA path; =1 enables
-    # (interpret mode on this TPU-less host)
+    assert decode._use_int8_decode_kernel(cache8, 1, cfg, huge, True) is None
+    # opt-in off: the eligible shape stays on the XLA path; on: interpret
+    # mode on this TPU-less host
+    assert decode._use_int8_decode_kernel(cache8, 1, cfg, 64, False) is None
+    assert decode._use_int8_decode_kernel(cache8, 1, cfg, 64, True) is True
+    # env resolution: unset/empty/0/off mean off, anything else means on
     monkeypatch.delenv("PIPEEDGE_INT8_DECODE_ATTEND", raising=False)
-    assert decode._use_int8_decode_kernel(cache8, 1, cfg, 64) is None
+    assert decode._int8_kernel_env() is False
+    for off in ("", "0", "false", "no", "off"):
+        monkeypatch.setenv("PIPEEDGE_INT8_DECODE_ATTEND", off)
+        assert decode._int8_kernel_env() is False
     monkeypatch.setenv("PIPEEDGE_INT8_DECODE_ATTEND", "1")
-    assert decode._use_int8_decode_kernel(cache8, 1, cfg, 64) is True
+    assert decode._int8_kernel_env() is True
+
+
+def test_kernel_optin_bound_at_construction(monkeypatch):
+    """Toggling the env var AFTER a pipeline is built must not change its
+    routing: the flag is captured at construction (round-4 advice)."""
+    monkeypatch.setenv("PIPEEDGE_INT8_DECODE_ATTEND", "1")
+    name = "pipeedge/test-tiny-gpt2"
+    cfg = registry.get_model_config(name)
+    total = registry.get_model_layers(name)
+    _, params, _ = registry.module_shard_factory(name, None, 1, total,
+                                                 unroll=False)
+    fam = registry.get_model_entry(name).family.FAMILY
+    pipe = decode.DecodePipeline(fam, cfg, [(1, total)], [params],
+                                 max_len=32, cache_bits=8)
+    assert pipe.int8_decode_optin is True
     monkeypatch.setenv("PIPEEDGE_INT8_DECODE_ATTEND", "0")
-    assert decode._use_int8_decode_kernel(cache8, 1, cfg, 64) is None
-    monkeypatch.setenv("PIPEEDGE_INT8_DECODE_ATTEND", "")
-    assert decode._use_int8_decode_kernel(cache8, 1, cfg, 64) is None
+    assert pipe.int8_decode_optin is True   # captured, not re-read
+    monkeypatch.delenv("PIPEEDGE_INT8_DECODE_ATTEND", raising=False)
+    pipe2 = decode.DecodePipeline(fam, cfg, [(1, total)], [params],
+                                  max_len=32, cache_bits=8)
+    assert pipe2.int8_decode_optin is False
 
 
 @pytest.mark.slow

@@ -1,38 +1,64 @@
-"""Minimal HTTP serving front end over the continuous batcher.
+"""HTTP serving front end over the pipelined decode executors.
 
 Beyond-reference serving surface (the reference runtime is single-shot
 batch inference; SURVEY.md §2.4): a stdlib-only JSON/HTTP server that
-drives `ContinuousBatcher` continuously — requests admit as they
-arrive, share the pipeline via wave scheduling, and prompt prefixes
+drives a `ContinuousBatcher` (wave executor) or a `StageWorkerExecutor`
+(one worker thread pinned per pipeline stage) continuously — requests
+admit as they arrive, share the pipeline, and prompt prefixes
 registered once via /prefix are reused by any number of /generate
 requests (prompt caching).
 
 Endpoints (all JSON):
 - GET  /healthz            -> {"ok", "model", "stages", "speculative",
-                               "stats": {ticks, stage_steps, tokens,
-                               active, pending, prefixes}}; HTTP 503
-                               once the serving worker has died
+                               "executor", "stats": {tokens, active,
+                               pending, prefixes, ...; stage mode adds
+                               per-worker stage_steps/busy/queued}};
+                               HTTP 503 once a serving worker has died
 - POST /prefix   {"ids": [t0, t1, ...]}
                            -> {"prefix_id": "p0", "len": N}
 - POST /generate {"ids": [[...], ...] | [...], "new_tokens": N,
                   "temperature"?: f, "top_k"?: n, "seed"?: n,
-                  "eos_token"?: n, "prefix_id"?: "p0"}
+                  "eos_token"?: n, "prefix_id"?: "p0",
+                  "stream"?: true, "speculative"?: true}
                            -> {"ids": [[prompt+continuation], ...]}
                               (suffix+continuation when prefix_id given)
 
-Single worker thread owns the batcher (JAX dispatch is asynchronous, so
-one thread keeps every stage busy); HTTP handler threads submit under a
-condition variable and wait for their request id to complete. Tokens
-are identical to solo `DecodePipeline.generate` runs with the same
-settings — the batcher's contract (tests/test_serve.py).
+With `"stream": true` the response is chunked `application/x-ndjson`:
+one line per decode step `{"step": i, "tokens": [[...]]}` as the token
+lands (raw picked tokens — post-eos rows are NOT yet masked), then a
+final line `{"ids": ..., "first_token_ms": t, "steps": n}` carrying the
+authoritative (eos-masked) result, identical to the non-streaming
+response. First-token latency is measured server-side from request
+receipt to the first step's readback.
 
-Usage: python tools/serve.py -m gpt2 [--port 8321] [--platform cpu] ...
+Executors (`--executor`):
+- `wave` (default): one worker thread ticks the batcher
+  (`ContinuousBatcher`) — strict wave semantics, JAX async dispatch
+  keeps every stage busy from a single host thread.
+- `stage`: one worker thread PER pipeline stage
+  (`StageWorkerExecutor`) — host-side dispatch of different stages
+  overlaps, and the last stage's token picks / eos readbacks never
+  stall earlier stages' dispatch. healthz reports per-worker stats.
+
+Speculative requests (`"speculative": true`, needs --draft-model) run
+greedy draft/verify rounds under a DEDICATED lock: they serialize with
+each other (bounding draft+verify cache memory at one in-flight
+speculative generation) but NOT with plain requests or result waits —
+JAX dispatch is thread-safe, so the batcher keeps serving while a
+speculative generation runs (round-4 advice).
+
+Tokens are identical to solo `DecodePipeline.generate` runs with the
+same settings — the executors' shared contract (tests/test_serve.py).
+
+Usage: python tools/serve.py -m gpt2 [--port 8321] [--executor stage] ...
 """
 import argparse
 import json
 import os
+import queue as queue_mod
 import sys
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -40,20 +66,24 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 class _Service:
-    """Owns the pipeline + batcher; one worker thread ticks continuously.
+    """Owns the pipeline + executor; HTTP handler threads submit requests
+    and wait for (or stream) their results."""
 
-    With a `spec` (SpeculativeDecoder), greedy requests that ask for it
-    (`"speculative": true`) run draft/verify rounds instead of joining
-    the wave — same lock, so they serialize with batcher ticks."""
-
-    def __init__(self, pipe, max_active=None, max_prefixes=8, spec=None):
+    def __init__(self, pipe, max_active=None, max_prefixes=8, spec=None,
+                 executor="wave"):
         from collections import OrderedDict
 
-        from pipeedge_tpu.parallel.batcher import ContinuousBatcher
+        from pipeedge_tpu.parallel.batcher import (ContinuousBatcher,
+                                                   StageWorkerExecutor)
         self.pipe = pipe
         self.spec = spec
-        self.batcher = ContinuousBatcher(pipe, max_active=max_active)
+        self.executor = executor
         self.cond = threading.Condition()
+        # speculative generations hold THIS lock, not self.cond: plain
+        # requests and result waits proceed concurrently (the pipeline's
+        # jitted programs are thread-safe; serializing speculative
+        # requests with each other bounds their cache memory)
+        self.spec_lock = threading.Lock()
         self.prefixes = OrderedDict()   # LRU-bounded: handles hold full
         self.spec_prefixes = OrderedDict()   # max_len KV buffers
         self.max_prefixes = max_prefixes
@@ -61,8 +91,18 @@ class _Service:
         self._next_pid = 0
         self._stop = False
         self._dead: Optional[BaseException] = None
-        self.worker = threading.Thread(target=self._loop, daemon=True)
-        self.worker.start()
+        if executor == "stage":
+            self.exec = StageWorkerExecutor(pipe, max_active=max_active)
+            self.batcher = None
+            self.worker = None
+        elif executor == "wave":
+            self.exec = None
+            self.batcher = ContinuousBatcher(pipe, max_active=max_active)
+            self.worker = threading.Thread(target=self._loop, daemon=True)
+            self.worker.start()
+        else:
+            raise ValueError(f"unknown executor {executor!r} "
+                             "(expected 'wave' or 'stage')")
 
     def _loop(self):
         while True:
@@ -82,6 +122,12 @@ class _Service:
                     raise
                 if self.batcher.results:
                     self.cond.notify_all()
+
+    @property
+    def dead(self) -> Optional[BaseException]:
+        if self._dead is not None:
+            return self._dead
+        return self.exec._dead if self.exec is not None else None
 
     def add_prefix(self, ids):
         with self.cond:
@@ -103,19 +149,22 @@ class _Service:
                 self.spec_prefixes.pop(old, None)
             return pid, target["len"]
 
+    def _check_dead(self):
+        dead = self.dead
+        if dead is not None:
+            raise RuntimeError(f"serving worker died: {dead!r}")
+
     def generate_speculative(self, ids, new_tokens, prefix_id=None):
         """Greedy speculative decoding (token-identical to plain greedy;
-        the draft only changes the dispatch count). Holds the service
-        lock for the whole generation: a speculative request owns the
-        pipeline while it runs and plain requests queue behind it —
-        speculation trades concurrency for per-request latency here."""
+        the draft only changes the dispatch count). Holds only the
+        dedicated spec lock during the generation — concurrent plain
+        requests keep flowing through the executor."""
         import numpy as np
         if self.spec is None:
             raise KeyError("server started without --draft-model; "
                            "speculative generation unavailable")
-        with self.cond:
-            if self._dead is not None:
-                raise RuntimeError(f"serving worker died: {self._dead!r}")
+        with self.cond:                     # resolve prefix briefly
+            self._check_dead()
             prefix = None
             if prefix_id is not None:
                 if prefix_id not in self.spec_prefixes:
@@ -125,39 +174,83 @@ class _Service:
                         "draft model is configured)")
                 self.prefixes.move_to_end(prefix_id)   # LRU touch
                 prefix = self.spec_prefixes[prefix_id]
+        with self.spec_lock:
             return np.asarray(self.spec.generate(ids, new_tokens,
                                                  prefix=prefix))
 
-    def generate(self, ids, new_tokens, **kw):
-        pid = kw.pop("prefix_id", None)
+    def prevalidate(self, ids, new_tokens, kw) -> dict:
+        """Resolve prefix_id and run the full admission validation WITHOUT
+        submitting — the streaming path needs errors raised BEFORE the
+        200/chunked headers commit (a status-checking client must see
+        400, not a 200 whose body is an error line). Returns `kw` with
+        the prefix handle resolved in place of prefix_id."""
+        from pipeedge_tpu.parallel.batcher import _build_request
+        kw = dict(kw)
         with self.cond:
-            if self._dead is not None:
-                raise RuntimeError(f"serving worker died: {self._dead!r}")
-            if pid is not None:
-                if pid not in self.prefixes:
-                    raise KeyError(f"unknown prefix_id {pid!r} (evicted "
-                                   "or never registered)")
-                self.prefixes.move_to_end(pid)     # LRU touch
-                kw["prefix"] = self.prefixes[pid]
+            self._check_dead()
+            self._resolve_prefix(kw)
+        _build_request(self.pipe, "__prevalidate__", ids, new_tokens,
+                       kw.get("temperature", 0.0), kw.get("top_k", 0),
+                       kw.get("seed", 0), kw.get("eos_token"),
+                       kw.get("pad_token"), kw.get("prefix"))
+        return kw
+
+    def _resolve_prefix(self, kw):
+        pid = kw.pop("prefix_id", None)
+        if pid is not None:
+            if pid not in self.prefixes:
+                raise KeyError(f"unknown prefix_id {pid!r} (evicted "
+                               "or never registered)")
+            self.prefixes.move_to_end(pid)     # LRU touch
+            kw["prefix"] = self.prefixes[pid]
+
+    def generate(self, ids, new_tokens, on_token=None, **kw):
+        if self.exec is not None:
+            with self.cond:
+                self._check_dead()
+                self._resolve_prefix(kw)
+                rid = self._next_rid
+                self._next_rid += 1
+            self.exec.submit(rid, ids, new_tokens, on_token=on_token, **kw)
+            return self.exec.wait(rid)
+        with self.cond:
+            self._check_dead()
+            self._resolve_prefix(kw)
             rid = self._next_rid
             self._next_rid += 1
-            self.batcher.submit(rid, ids, new_tokens, **kw)
+            self.batcher.submit(rid, ids, new_tokens, on_token=on_token,
+                                **kw)
             self.cond.notify_all()
             while rid not in self.batcher.results:
-                if self._dead is not None:
-                    raise RuntimeError(
-                        f"serving worker died: {self._dead!r}")
+                self._check_dead()
                 self.cond.wait()
             return self.batcher.results.pop(rid)
+
+    def stats(self):
+        """Lock-free best-effort snapshot for /healthz (GIL-atomic reads;
+        momentary inconsistency is fine for health)."""
+        if self.exec is not None:
+            s = self.exec.snapshot()
+            s["pending"] = 0          # admission blocks in submit threads
+            s["prefixes"] = len(self.prefixes)
+            return s
+        return dict(self.batcher.stats,
+                    active=self.batcher.active,
+                    pending=len(self.batcher.pending),
+                    prefixes=len(self.prefixes))
 
     def stop(self):
         with self.cond:
             self._stop = True
             self.cond.notify_all()
+        if self.exec is not None:
+            self.exec.stop()
 
 
 def make_handler(service, model_name):
     class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"      # chunked transfer needs 1.1
+
         def log_message(self, *a):      # quiet server
             pass
 
@@ -169,22 +262,75 @@ def make_handler(service, model_name):
             self.end_headers()
             self.wfile.write(body)
 
+        def _chunk(self, obj):
+            data = json.dumps(obj).encode() + b"\n"
+            self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+            self.wfile.flush()
+
+        def _stream_generate(self, ids, new_tokens, kw):
+            """Chunked x-ndjson response: one line per decode step as the
+            token lands, then the authoritative final line. The worker
+            pushes DEVICE token arrays into a queue; the readback (the
+            blocking part) happens here in the handler thread, so
+            streaming never stalls the executor."""
+            import numpy as np
+            t0 = time.monotonic()
+            # validate BEFORE headers commit: bad requests still 400
+            # (raises into do_POST's error mapping); after this point
+            # failures surface as a terminal {"error": ...} stream line
+            kw = service.prevalidate(ids, new_tokens, kw)
+            q = queue_mod.Queue()
+            worker = threading.Thread(
+                target=self._run_generate,
+                args=(ids, new_tokens, kw, q), daemon=True)
+            worker.start()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            steps = 0
+            first_ms = None
+            while True:
+                kind, payload = q.get()
+                if kind == "error":
+                    self._chunk({"error": str(payload)})
+                    break
+                if kind == "result":
+                    self._chunk({"ids": payload.tolist(),
+                                 "first_token_ms": first_ms,
+                                 "steps": steps})
+                    break
+                step, token = payload
+                # the blocking device readback happens HERE, in the
+                # handler thread — the executor worker only enqueued the
+                # device array and moved on
+                tok = np.asarray(token).tolist()
+                if first_ms is None:
+                    first_ms = round((time.monotonic() - t0) * 1e3, 3)
+                self._chunk({"step": step, "tokens": tok})
+                steps += 1
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+
+        def _run_generate(self, ids, new_tokens, kw, q):
+            try:
+                out = service.generate(
+                    ids, new_tokens,
+                    on_token=lambda step, tok: q.put(("token", (step, tok))),
+                    **kw)
+                q.put(("result", out))
+            except BaseException as exc:   # noqa: BLE001 — surfaced as a
+                q.put(("error", exc))      # terminal stream line
+
         def do_GET(self):
             if self.path == "/healthz":
-                # LOCK-FREE best-effort snapshot: a probe must answer
-                # even while a speculative generation or prefix
-                # registration holds the service lock (GIL-atomic int/
-                # len reads; momentary inconsistency is fine for health)
-                dead = service._dead is not None
-                stats = dict(service.batcher.stats,
-                             active=service.batcher.active,
-                             pending=len(service.batcher.pending),
-                             prefixes=len(service.prefixes))
+                dead = service.dead is not None
                 self._send(503 if dead else 200,
                            {"ok": not dead, "model": model_name,
                             "stages": len(service.pipe.stages),
                             "speculative": service.spec is not None,
-                            "stats": stats})
+                            "executor": service.executor,
+                            "stats": service.stats()})
             else:
                 self._send(404, {"error": "unknown path"})
 
@@ -201,22 +347,30 @@ def make_handler(service, model_name):
                         ids = [ids]
                     if req.get("speculative"):
                         if req.get("temperature") or req.get("top_k") \
-                                or req.get("eos_token") is not None:
+                                or req.get("eos_token") is not None \
+                                or req.get("stream"):
                             raise ValueError(
-                                "speculative generation is greedy-exact; "
-                                "it does not compose with sampling/eos")
+                                "speculative generation is greedy-exact "
+                                "whole-rounds; it does not compose with "
+                                "sampling/eos/stream")
                         out = service.generate_speculative(
                             ids, int(req["new_tokens"]),
                             prefix_id=req.get("prefix_id"))
+                        self._send(200, {"ids": out.tolist()})
                     else:
-                        out = service.generate(
-                            ids, int(req["new_tokens"]),
+                        kw = dict(
                             temperature=float(req.get("temperature", 0.0)),
                             top_k=int(req.get("top_k", 0)),
                             seed=int(req.get("seed", 0)),
                             eos_token=req.get("eos_token"),
                             prefix_id=req.get("prefix_id"))
-                    self._send(200, {"ids": out.tolist()})
+                        if req.get("stream"):
+                            self._stream_generate(
+                                ids, int(req["new_tokens"]), kw)
+                        else:
+                            out = service.generate(
+                                ids, int(req["new_tokens"]), **kw)
+                            self._send(200, {"ids": out.tolist()})
                 else:
                     self._send(404, {"error": "unknown path"})
             except (KeyError, ValueError, TypeError, IndexError) as exc:
@@ -236,6 +390,10 @@ def main():
                    choices=["float32", "bfloat16"])
     p.add_argument("--kv-bits", default=0, type=int, choices=[0, 8])
     p.add_argument("--attend-floor", default=64, type=int)
+    p.add_argument("--executor", default="wave", choices=["wave", "stage"],
+                   help="wave: one thread ticks the batcher; stage: one "
+                        "worker thread pinned per pipeline stage "
+                        "(healthz reports per-worker stats)")
     p.add_argument("--draft-model", default=None,
                    help="enable speculative generation: requests with "
                         '"speculative": true run greedy draft/verify '
@@ -277,11 +435,12 @@ def main():
         spec = SpeculativeDecoder(pipe, d_pipe, gamma=args.gamma)
 
     service = _Service(pipe, max_active=args.max_active,
-                       max_prefixes=args.max_prefixes, spec=spec)
+                       max_prefixes=args.max_prefixes, spec=spec,
+                       executor=args.executor)
     server = ThreadingHTTPServer(("127.0.0.1", args.port),
                                  make_handler(service, args.model_name))
-    print(f"serving {args.model_name} ({len(pipe.stages)} stages) on "
-          f"127.0.0.1:{args.port}", flush=True)
+    print(f"serving {args.model_name} ({len(pipe.stages)} stages, "
+          f"{args.executor} executor) on 127.0.0.1:{args.port}", flush=True)
     try:
         server.serve_forever()
     finally:

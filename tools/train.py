@@ -4,7 +4,8 @@ The training counterpart of tools/generate.py (beyond-reference: the
 upstream framework is inference-only). Builds the one-program pipelined
 forward over a ('dp', 'stage') mesh, differentiates through it
 (parallel/train.py), and runs an optimizer loop on synthetic data —
-classification (ViT/DeiT: images + labels) or causal-LM (GPT-2/LLaMA/
+classification (ViT/DeiT: images + labels), BERT sequence
+classification (token ids + class labels), or causal-LM (GPT-2/LLaMA/
 Mistral families: next-token targets). Checkpoints the full training
 state (params + optimizer + step) via Orbax and resumes from it.
 
@@ -86,9 +87,11 @@ def main():
     entry = registry.get_model_entry(args.model_name)
     family_mod = entry.family
     is_lm = cfg.model_type in ("gpt2", "llama")
-    if not is_lm and cfg.model_type not in ("vit", "deit"):
-        p.error(f"training CLI covers classification (vit/deit) and LM "
-                f"(gpt2/llama) families; got {cfg.model_type}")
+    is_bert = cfg.model_type == "bert"
+    if not is_lm and not is_bert and cfg.model_type not in ("vit", "deit"):
+        p.error(f"training CLI covers classification (vit/deit), BERT "
+                f"sequence classification, and LM (gpt2/llama) families; "
+                f"got {cfg.model_type}")
 
     stage_params = [family_mod.init_params(
         cfg, ShardConfig(l, r, is_first=l == 1, is_last=r == total),
@@ -109,6 +112,14 @@ def main():
             0, cfg.vocab_size, size=(args.ubatches, args.batch, seq)),
             jnp.int32)
         inputs, labels = ids[..., :-1], ids[..., 1:]
+    elif is_bert:
+        seq = min(args.seq_len, cfg.max_position_embeddings)
+        inputs = jnp.asarray(rng.integers(
+            0, cfg.vocab_size, size=(args.ubatches, args.batch, seq)),
+            jnp.int32)
+        labels = jnp.asarray(rng.integers(
+            0, max(cfg.num_labels, 1), size=(args.ubatches, args.batch)),
+            jnp.int32)
     else:
         inputs = jnp.asarray(rng.normal(size=(
             args.ubatches, args.batch, 3, cfg.image_size, cfg.image_size)),
