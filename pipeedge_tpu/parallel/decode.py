@@ -963,6 +963,7 @@ class DecodePipeline:
         self.max_len = max_len
         self.mesh, self.tp_axis = mesh, tp_axis
         self.tp_ep_mesh = tp_ep_mesh
+        self.ep_mesh = ep_mesh
         self.stages = []
         for i, (l, r) in enumerate(partition):
             sc = ShardConfig(l, r, is_first=l == 1, is_last=r == total)

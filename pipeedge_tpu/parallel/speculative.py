@@ -33,12 +33,31 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .decode import DecodePipeline, validate_capacity
 
 __all__ = ["SpeculativeDecoder"]
+
+
+def _device_rounds_eligible(pipe: DecodePipeline) -> Optional[str]:
+    """None if `pipe`'s stage programs can be inlined into ONE jitted
+    round program, else the reason they cannot: explicit per-stage device
+    placement inserts host-driven transfers between stages (a single XLA
+    program is single-(mesh-)device), and tp / tp x ep meshes place
+    params+caches with shardings the fused program would have to
+    re-specify."""
+    if any(st["device"] is not None for st in pipe.stages):
+        return "per-stage device placement"
+    if pipe.mesh is not None:
+        return "tensor-parallel mesh"
+    if pipe.ep_mesh is not None:
+        return "expert-parallel mesh"
+    if pipe.tp_ep_mesh is not None:
+        return "tp x ep mesh"
+    return None
 
 
 class SpeculativeDecoder:
@@ -48,10 +67,31 @@ class SpeculativeDecoder:
     tokens, one target `extend()` scores all of them plus a bonus
     position. gamma is fixed for the whole generation so the verify span
     compiles once per attend bucket.
+
+    `sync` picks where acceptance is decided:
+
+    - ``"host"``: every draft argmax and the verify comparison read back
+      to the host — g+1 device round trips per round. On a remote/
+      tunneled chip each readback costs a full RTT, which can eat the
+      verify-span win.
+    - ``"device"``: the WHOLE round — draft catch-up span, gamma-1 draft
+      steps, the target verify span, and the accepted-prefix count — is
+      one compiled program; the host reads back a single packed [B,
+      2*gamma+2] array per round (ONE sync), then does pure-Python
+      position bookkeeping. Token-identical to "host" by construction:
+      the same stage programs run on the same values, argmax feeds
+      argmax inside the program instead of via the host.
+    - ``"auto"`` (default): "device" when both pipelines' stage programs
+      can legally inline into one jitted program (no per-stage device
+      placement, no tp/tp x ep mesh — `_device_rounds_eligible`), else
+      "host".
+
+    `last_sync_count` records the host round trips of the latest
+    generate() (the chip A/B's measured quantity: docs/DECODE.md).
     """
 
     def __init__(self, target: DecodePipeline, draft: DecodePipeline,
-                 gamma: int = 4):
+                 gamma: int = 4, sync: str = "auto"):
         if gamma < 1:
             raise ValueError(f"gamma must be >= 1, got {gamma}")
         if target.cfg.vocab_size != draft.cfg.vocab_size:
@@ -68,10 +108,84 @@ class SpeculativeDecoder:
                     f"capacity-bounded MoE {name} breaks the greedy-exact "
                     "guarantee (span routing != per-step routing); use a "
                     "dropless config (capacity_factor >= n_experts)")
+        if sync not in ("auto", "host", "device"):
+            raise ValueError(f"sync must be auto/host/device, got {sync!r}")
+        blockers = {name: why for name, pipe in
+                    (("target", target), ("draft", draft))
+                    if (why := _device_rounds_eligible(pipe)) is not None}
+        if sync == "device" and blockers:
+            raise ValueError(
+                f"sync='device' unavailable: {blockers} (the round must "
+                "compile into one program); use sync='auto' or 'host'")
         self.target = target
         self.draft = draft
         self.gamma = gamma
+        self.sync = "host" if sync == "auto" and blockers else \
+            ("device" if sync == "auto" else sync)
         self.last_acceptance_rate: Optional[float] = None
+        self.last_sync_count: Optional[int] = None
+        self._round_cache: dict = {}
+
+    def _round_fn(self, batch: int, catch_len: int, t_read, d_read):
+        """The compiled device-side round (sync='device'): cached per
+        (batch, catch span length, attend buckets) — a handful of
+        variants per generation, the same compile-per-discrete-value
+        pattern as the attend buckets themselves."""
+        key = (batch, catch_len, t_read, d_read)
+        fn = self._round_cache.get(key)
+        if fn is not None:
+            return fn
+        g = self.gamma
+        target_stages = self.target.stages
+        draft_stages = self.draft.stages
+
+        def run_stages(stages, data, caches, pos, read_len):
+            out = []
+            for st, c in zip(stages, caches):
+                if read_len is None:
+                    data, c = st["decode"](st["params"], data, c, pos)
+                else:
+                    data, c = st["decode"](st["params"], data, c, pos,
+                                           read_len=read_len)
+                out.append(c)
+            return data, out
+
+        def greedy(logits):     # [B, V] -> [B] int32, the host rule
+            return jnp.argmax(logits.astype(jnp.float32), -1) \
+                .astype(jnp.int32)
+
+        @jax.jit
+        def round_fn(t_caches, d_caches, pending, catch, t_pos, d_pos):
+            # draft: catch-up span over committed-but-unseen tokens ...
+            x, d_caches = run_stages(draft_stages, catch, d_caches,
+                                     d_pos, d_read)
+            props = [greedy(x[:, -1])]
+            # ... then gamma-1 proposals, argmax feeding argmax ON DEVICE
+            for k in range(g - 1):
+                x, d_caches = run_stages(draft_stages, props[-1][:, None],
+                                         d_caches, d_pos + catch_len + k,
+                                         d_read)
+                props.append(greedy(x[:, -1]))
+            # target: ONE span scores pending + all proposals
+            span = jnp.stack([pending] + props, axis=1)        # [B, g+1]
+            t_out, t_caches = run_stages(target_stages, span, t_caches,
+                                         t_pos, t_read)
+            targets = jnp.argmax(t_out.astype(jnp.float32), -1) \
+                .astype(jnp.int32)                             # [B, g+1]
+            # accepted prefix length (min across rows) — the host loop's
+            # `while np.all(props[a] == targets[:, a])` as a cumprod
+            props_arr = jnp.stack(props, axis=1)               # [B, g]
+            match = jnp.all(props_arr == targets[:, :g], axis=0)    # [g]
+            a = jnp.cumprod(match.astype(jnp.int32)).sum() \
+                .astype(jnp.int32)
+            # ONE packed array -> one host fetch: [a | props | targets]
+            packed = jnp.concatenate(
+                [jnp.broadcast_to(a[None, None], (span.shape[0], 1)),
+                 props_arr, targets], axis=1)         # [B, 1 + g + g+1]
+            return packed, t_caches, d_caches
+
+        self._round_cache[key] = round_fn
+        return round_fn
 
     def precompute_prefix(self, prefix_ids) -> dict:
         """Prompt caching for speculative decoding: prefill the shared
@@ -132,6 +246,7 @@ class SpeculativeDecoder:
         pending = np.asarray(
             jnp.argmax(t_out[:, -1].astype(jnp.float32), -1),
             np.int32)                       # [B] first continuation token
+        syncs = 1                           # the first-token readback
         n_suffix = len(known)    # known = suffix tokens ++ emissions,
         known.append(pending)    # sitting at positions [d_floor, ...)
         d_floor = base if prefix else prompt_len
@@ -139,6 +254,7 @@ class SpeculativeDecoder:
         t_pos = prompt_len   # target cache rows [0, t_pos) are committed
         d_pos = d_floor      # draft cache rows [0, d_pos) are committed
         proposed = accepted = 0
+        device_rounds = self.sync == "device"
 
         while n_emitted < new_tokens:
             # --- draft: catch up on committed tokens it hasn't seen
@@ -146,29 +262,56 @@ class SpeculativeDecoder:
             # token normally, 2 after a fully-accepted round), then
             # propose gamma tokens autoregressively
             catch = np.stack(known[d_pos - d_floor:], axis=1)
-            d_logits, d_caches = self.draft.extend(catch, d_caches, d_pos)
-            d_pos += catch.shape[1]
-            props = [np.asarray(
-                jnp.argmax(d_logits[:, -1].astype(jnp.float32), -1),
-                np.int32)]
-            for _ in range(g - 1):
-                d_logits, d_caches = self.draft.extend(
-                    props[-1][:, None], d_caches, d_pos)
-                props.append(np.asarray(
+            if device_rounds:
+                # the whole round in one program, ONE readback: attend
+                # buckets for the round's deepest positions are chosen
+                # host-side (positions are host bookkeeping, never read
+                # back) and bound statically; earlier in-round steps
+                # attending through the wider bucket is numerically
+                # identical (the extra positions are masked)
+                c_len = catch.shape[1]
+                round_fn = self._round_fn(
+                    batch, c_len,
+                    self.target._read_len(t_pos, g + 1),
+                    self.draft._read_len(d_pos, c_len + g - 1))
+                packed, t_caches, d_caches = round_fn(
+                    t_caches, d_caches, jnp.asarray(pending),
+                    jnp.asarray(catch), t_pos, d_pos)
+                packed = np.asarray(packed)            # the round's ONE sync
+                syncs += 1
+                a = int(packed[0, 0])
+                props = [packed[:, 1 + k] for k in range(g)]
+                targets = packed[:, 1 + g:]
+                # (d_pos is reconciled below from `a`, like the host path)
+            else:
+                d_logits, d_caches = self.draft.extend(catch, d_caches,
+                                                       d_pos)
+                d_pos += catch.shape[1]
+                props = [np.asarray(
                     jnp.argmax(d_logits[:, -1].astype(jnp.float32), -1),
-                    np.int32))
-                d_pos += 1
+                    np.int32)]
+                syncs += 1
+                for _ in range(g - 1):
+                    d_logits, d_caches = self.draft.extend(
+                        props[-1][:, None], d_caches, d_pos)
+                    props.append(np.asarray(
+                        jnp.argmax(d_logits[:, -1].astype(jnp.float32), -1),
+                        np.int32))
+                    syncs += 1
+                    d_pos += 1
 
-            # --- target: one span forward scores pending + all proposals
-            span = np.stack([pending] + props, axis=1)      # [B, g+1]
-            t_logits, t_caches = self.target.extend(span, t_caches, t_pos)
-            targets = np.asarray(
-                jnp.argmax(t_logits.astype(jnp.float32), -1), np.int32)
+                # --- target: one span forward scores pending + proposals
+                span = np.stack([pending] + props, axis=1)    # [B, g+1]
+                t_logits, t_caches = self.target.extend(span, t_caches,
+                                                        t_pos)
+                targets = np.asarray(
+                    jnp.argmax(t_logits.astype(jnp.float32), -1), np.int32)
+                syncs += 1
 
-            # --- accept the minimum matching prefix across rows
-            a = 0
-            while a < g and bool(np.all(props[a] == targets[:, a])):
-                a += 1
+                # --- accept the minimum matching prefix across rows
+                a = 0
+                while a < g and bool(np.all(props[a] == targets[:, a])):
+                    a += 1
             proposed += g
             accepted += a
             known.extend(props[:a] + [targets[:, a]])  # drafts + correction
@@ -180,6 +323,7 @@ class SpeculativeDecoder:
             d_pos = t_pos - 1 if a == g else t_pos
 
         self.last_acceptance_rate = accepted / proposed if proposed else None
+        self.last_sync_count = syncs
         gen = jnp.asarray(np.stack(known[n_suffix:n_suffix + new_tokens],
                                    axis=1))
         return jnp.concatenate([ids, gen], axis=1)

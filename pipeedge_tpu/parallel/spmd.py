@@ -274,9 +274,9 @@ class SpmdPipeline:
                     return e
                 return quant_ops.tensor_decode_outerdim(e)
 
-            def zero_carry():
+            def zero_carry(dt=None):
                 return encode(jnp.zeros(hidden_local.shape,
-                                        hidden_local.dtype), 0)
+                                        dt or hidden_local.dtype), 0)
         else:
             n_vals = int(np.prod(hidden_local.shape[1:]))
             itemsize = jnp.dtype(hidden_local.dtype).itemsize
@@ -332,7 +332,8 @@ class SpmdPipeline:
             def decode(payload, stage):
                 return jax.lax.switch(in_branch[stage], dec_branches, payload)
 
-            def zero_carry():
+            def zero_carry(dt=None):
+                del dt   # the mixed-bits wire buffer is dtype-fixed
                 return (jnp.zeros((b_local, max_words), jnp.uint32),
                         jnp.zeros((b_local,), jnp.float32),
                         jnp.zeros((b_local,), jnp.float32))
@@ -351,6 +352,15 @@ class SpmdPipeline:
             stage = jax.lax.axis_index("stage")
             is_first = stage == 0
             is_last = stage == n_stages - 1
+
+            # activation dtype follows THIS call's params/inputs, not the
+            # build-time pipeline params: the training step's mixed-
+            # precision mode runs this same program on a bfloat16 cast of
+            # the float32 masters, so the zeros branches and the scan
+            # carry must match the cast, not the masters
+            act_dtype = jax.eval_shape(
+                partial(family.embed, cfg=cfg), params["embed"],
+                stacked_inputs[0]).dtype
 
             # Embeddings for all microbatches — computed only on the first
             # stage (runtime branch on the device-local stage index); other
@@ -379,7 +389,7 @@ class SpmdPipeline:
                         is_first,
                         lambda u: embed_chunk(u),
                         lambda u: jnp.zeros(hidden_local.shape,
-                                            embed_shape.dtype),
+                                            act_dtype),
                         stacked_inputs[t])
             else:
                 embedded = jax.lax.cond(
@@ -387,7 +397,7 @@ class SpmdPipeline:
                     lambda si: jnp.zeros(
                         (n_ubatch, b_local, seq_total)
                         + embed_shape.shape[2:],
-                        embed_shape.dtype), stacked_inputs)
+                        act_dtype), stacked_inputs)
 
                 def embed_at(t):
                     return embedded[t]
@@ -431,7 +441,7 @@ class SpmdPipeline:
                 return (encode(h, stage), outputs), None
 
             (_, outputs), _ = jax.lax.scan(
-                tick, (zero_carry(), outputs0), jnp.arange(n_ticks))
+                tick, (zero_carry(act_dtype), outputs0), jnp.arange(n_ticks))
             # only the last stage wrote real outputs; fan them back out
             return jax.lax.psum(outputs, "stage")
 

@@ -40,7 +40,7 @@ def softmax_xent(logits: jax.Array, labels: jax.Array) -> jax.Array:
 
 
 def make_train_step(pipe: SpmdPipeline, optimizer, example_inputs,
-                    loss_fn=softmax_xent):
+                    loss_fn=softmax_xent, mixed_precision: bool = False):
     """Build (train_step, opt_state) for an SPMD pipeline.
 
     `train_step(params, opt_state, inputs, labels) -> (params, opt_state,
@@ -48,6 +48,18 @@ def make_train_step(pipe: SpmdPipeline, optimizer, example_inputs,
     the ppermute edges, optimizer update — all over the pipeline's mesh.
     `example_inputs` fixes the compiled microbatch shape ([M, B, ...raw
     input dims], the same stacked layout `SpmdPipeline.run` takes).
+
+    `mixed_precision=True` is the TPU bf16-compute/f32-master recipe:
+    the float32 params passed to `train_step` stay the optimizer's
+    MASTER weights, but each step's forward (and therefore the MXU
+    matmuls and the activations/ppermute edges of the backward) runs on
+    a bfloat16 cast of them. Gradients flow back through the cast —
+    XLA's transpose accumulates them into float32 — and the optimizer
+    update applies at full precision, so tiny updates are never lost to
+    bf16 rounding (the failure mode of pure-bf16 training). bfloat16
+    keeps float32's exponent range, so no loss scaling is needed (the
+    fp16 complication this recipe avoids). The pipeline must be built
+    with float32 params — they ARE the masters.
 
     Returns opt_state initialized against the pipeline's (sharded)
     params. The integer block-count leaf is held static: it selects
@@ -63,8 +75,29 @@ def make_train_step(pipe: SpmdPipeline, optimizer, example_inputs,
     fwd = pipe.compiled_for(example_inputs)   # shares run()'s cache
     n_blocks = pipe.params["n_blocks"]
 
+    if mixed_precision:
+        bad = [jnp.dtype(leaf.dtype).name
+               for leaf in jax.tree_util.tree_leaves(pipe.params)
+               if jnp.issubdtype(leaf.dtype, jnp.floating)
+               and leaf.dtype != jnp.float32]
+        if bad:
+            raise ValueError(
+                "mixed_precision keeps float32 MASTER weights and casts "
+                "to bfloat16 per step; build the pipeline with float32 "
+                f"params (found {sorted(set(bad))})")
+
+    def _compute_cast(tree):
+        """bf16 working copy for the forward/backward; inside jit, so
+        XLA fuses the casts into the first consuming matmuls."""
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
     def compute_loss(trainable, inputs, labels):
-        logits = fwd({**trainable, "n_blocks": n_blocks}, inputs)
+        compute = _compute_cast(trainable) if mixed_precision else trainable
+        if mixed_precision:
+            inputs = _compute_cast(inputs)
+        logits = fwd({**compute, "n_blocks": n_blocks}, inputs)
         return loss_fn(logits, labels)
 
     @jax.jit

@@ -89,8 +89,39 @@ def main() -> None:
         jax.block_until_ready(g_out)
         assert g_out.shape == (2, 8), g_out.shape
 
+        # TRAINING across the process boundary (round-4 verdict item 6):
+        # the same ('dp','stage') process-spanning mesh, differentiated —
+        # grads ride the reversed ppermute edges over the host boundary,
+        # the optimizer updates the process-sharded params in place. The
+        # quantized-edge pipeline above is inference-only, so build a
+        # clean-edge pipeline for the gradient path. Deterministic seeds
+        # make every rank print the same loss sequence; the parent test
+        # compares them across ranks AND against its own single-process
+        # oracle run.
+        import optax
+        from pipeedge_tpu.parallel import train as train_mod
+        t_pipe = spmd.build_spmd_pipeline(vit_mod.FAMILY, cfg, partition,
+                                          stage_params, mesh)
+        t_inputs = jnp.asarray(
+            np.random.default_rng(7).normal(
+                size=(n_stages + 1, batch, 3, 16, 16)), jnp.float32)
+        t_labels = jnp.asarray(
+            np.random.default_rng(8).integers(
+                0, cfg.num_labels, size=(n_stages + 1, batch)), jnp.int32)
+        step_fn, opt_state = train_mod.make_train_step(
+            t_pipe, optax.sgd(0.05), t_inputs)
+        t_params, losses = t_pipe.params, []
+        for _ in range(3):
+            t_params, opt_state, loss = step_fn(t_params, opt_state,
+                                                t_inputs, t_labels)
+            losses.append(float(loss))
+        assert all(np.isfinite(losses)), losses
+        assert losses[-1] < losses[0], losses
+        loss_str = ",".join(f"{v:.6f}" for v in losses)
+
         print(f"MULTIHOST-OK rank={rank} local={n_local} global={n_global} "
-              f"out={out.shape} decode={g_out.shape}", flush=True)
+              f"out={out.shape} decode={g_out.shape} "
+              f"train_losses=[{loss_str}]", flush=True)
 
 
 if __name__ == "__main__":

@@ -239,3 +239,75 @@ def test_spec_with_prefix_cache(name):
         suffix, 11, prefix=spec2.precompute_prefix(prefix)))
     np.testing.assert_array_equal(got2, want[:, 6:])
     assert spec2.last_acceptance_rate == 1.0
+
+
+@pytest.mark.parametrize("gamma", [2, 4])
+def test_device_rounds_token_identical_one_sync_per_round(gpt2_pipes,
+                                                          gamma):
+    """sync='device' (the default here via 'auto') fuses each round into
+    one program: tokens identical to sync='host', and the host round
+    trips drop from ~(gamma+1)/round to exactly rounds+1 (one packed
+    readback per round plus the first-token argmax)."""
+    target, draft = gpt2_pipes
+    ids = _ids(2, 8, seed=5)
+    host = SpeculativeDecoder(target, draft, gamma=gamma, sync="host")
+    dev = SpeculativeDecoder(target, draft, gamma=gamma, sync="device")
+    want = np.asarray(host.generate(ids, 12))
+    got = np.asarray(dev.generate(ids, 12))
+    np.testing.assert_array_equal(got, want)
+    assert dev.last_acceptance_rate == host.last_acceptance_rate
+    # host pays 1 + rounds*(gamma+1); device pays 1 + rounds
+    n_rounds = (host.last_sync_count - 1) // (gamma + 1)
+    assert host.last_sync_count == 1 + n_rounds * (gamma + 1)
+    assert dev.last_sync_count == 1 + n_rounds
+    assert dev.last_sync_count < host.last_sync_count
+
+
+def test_device_rounds_with_prefix_and_auto_fallback(gpt2_pipes):
+    """Device rounds compose with prompt caching (the catch-up span is
+    just longer on round 1); 'auto' falls back to host rounds when a
+    pipeline pins stages to devices, and sync='device' refuses with the
+    reason."""
+    target, draft = gpt2_pipes
+    rng = np.random.default_rng(77)
+    prefix = rng.integers(0, 100, size=(1, 6))
+    suffix = rng.integers(0, 100, size=(2, 4))
+    spec = SpeculativeDecoder(target, draft, gamma=3)
+    assert spec.sync == "device"     # auto picked the fused rounds
+    handle = spec.precompute_prefix(prefix)
+    got = np.asarray(spec.generate(suffix, 9, prefix=handle))
+    want = np.asarray(
+        SpeculativeDecoder(target, draft, gamma=3, sync="host")
+        .generate(suffix, 9, prefix=handle))
+    np.testing.assert_array_equal(got, want)
+
+    placed = _pipe("pipeedge/test-tiny-gpt2",
+                   devices=[jax.devices()[0]])
+    auto = SpeculativeDecoder(placed, draft, gamma=2)
+    assert auto.sync == "host"       # fell back, still works
+    with pytest.raises(ValueError, match="device placement"):
+        SpeculativeDecoder(placed, draft, gamma=2, sync="device")
+
+
+def test_device_rounds_eligibility_gate():
+    """The fused-round gate names every blocker: per-stage placement and
+    tp/ep/tp x ep meshes all refuse (their programs carry shardings or
+    host-driven transfers a single jitted round must not inline)."""
+    from types import SimpleNamespace as NS
+
+    from pipeedge_tpu.parallel.speculative import _device_rounds_eligible
+
+    def pipe(**kw):
+        base = dict(stages=[{"device": None}], mesh=None, ep_mesh=None,
+                    tp_ep_mesh=None)
+        base.update(kw)
+        return NS(**base)
+
+    assert _device_rounds_eligible(pipe()) is None
+    assert "device placement" in _device_rounds_eligible(
+        pipe(stages=[{"device": object()}]))
+    assert "tensor-parallel" in _device_rounds_eligible(
+        pipe(mesh=object()))
+    assert "expert-parallel" in _device_rounds_eligible(
+        pipe(ep_mesh=object()))
+    assert "tp x ep" in _device_rounds_eligible(pipe(tp_ep_mesh=object()))

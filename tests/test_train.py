@@ -465,3 +465,59 @@ def test_train_cli_bert(tmp_path):
     assert run.returncode == 0, run.stdout + run.stderr
     losses = [float(m) for m in re.findall(r"loss=([0-9.]+)", run.stdout)]
     assert len(losses) == 3 and losses[-1] < losses[0]
+
+
+def test_mixed_precision_training():
+    """bf16-compute/f32-master mixed precision: params stay float32
+    masters across updates, loss tracks the full-f32 run closely, and
+    descends; bf16-param pipelines are refused (they have no masters)."""
+    import optax
+    from jax.sharding import Mesh
+
+    from pipeedge_tpu.models import vit as vit_mod
+    mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(2), ("stage",))
+    cfg = TransformerConfig(model_type="vit", hidden_size=32,
+                            num_hidden_layers=2, num_attention_heads=4,
+                            intermediate_size=64, num_labels=5,
+                            image_size=16, patch_size=4)
+    partition = [(1, 4), (5, 8)]
+    rng = np.random.default_rng(11)
+    inputs = jnp.asarray(rng.normal(size=(3, 2, 3, 16, 16)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 5, size=(3, 2)), jnp.int32)
+
+    def run(mixed):
+        sp = [vit_mod.init_params(
+            cfg, ShardConfig(l, r, is_first=l == 1, is_last=r == 8),
+            seed=0) for l, r in partition]
+        pipe = spmd.build_spmd_pipeline(vit_mod.FAMILY, cfg, partition,
+                                        sp, mesh)
+        step, opt_state = train.make_train_step(
+            pipe, optax.sgd(0.1), inputs, mixed_precision=mixed)
+        params, losses = pipe.params, []
+        for _ in range(5):
+            params, opt_state, loss = step(params, opt_state, inputs,
+                                           labels)
+            losses.append(float(loss))
+        return params, losses
+
+    params_mp, losses_mp = run(True)
+    _, losses_fp = run(False)
+    assert all(np.isfinite(losses_mp)), losses_mp
+    assert losses_mp[-1] < losses_mp[0], losses_mp
+    # master weights never degrade to bf16 across updates
+    for leaf in jax.tree_util.tree_leaves(
+            {k: v for k, v in params_mp.items() if k != "n_blocks"}):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.float32, leaf.dtype
+    # the bf16 compute path tracks full precision closely on this scale
+    np.testing.assert_allclose(losses_mp, losses_fp, rtol=0.05)
+
+    # a bf16-param pipeline has no f32 masters: refused with guidance
+    sp16 = [vit_mod.init_params(
+        cfg, ShardConfig(l, r, is_first=l == 1, is_last=r == 8),
+        seed=0, dtype=jnp.bfloat16) for l, r in partition]
+    pipe16 = spmd.build_spmd_pipeline(vit_mod.FAMILY, cfg, partition,
+                                      sp16, mesh)
+    with pytest.raises(ValueError, match="float32"):
+        train.make_train_step(pipe16, optax.sgd(0.1), inputs,
+                              mixed_precision=True)

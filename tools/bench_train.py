@@ -25,6 +25,9 @@ def main():
     p.add_argument("-b", "--batch", default=8, type=int)
     p.add_argument("-u", "--ubatches", default=4, type=int)
     p.add_argument("--steps", default=8, type=int)
+    p.add_argument("--mixed-precision", action="store_true",
+                   help="f32 master weights + per-step bf16 compute cast "
+                        "(parallel/train.py) instead of pure-bf16 params")
     args = p.parse_args()
 
     from pipeedge_tpu.utils import apply_env_platform, require_live_backend
@@ -44,9 +47,10 @@ def main():
     total = registry.get_model_layers(args.model_name)
     entry = registry.get_model_entry(args.model_name)
     family_mod = entry.family
+    param_dtype = jnp.float32 if args.mixed_precision else jnp.bfloat16
     stage_params = [family_mod.init_params(
         cfg, ShardConfig(1, total, is_first=True, is_last=True),
-        dtype=jnp.bfloat16)]
+        dtype=param_dtype)]
     mesh = spmd.make_pipeline_mesh(1)
     # remat: per-block checkpointing — without it the backward's saved
     # tick activations need ~40 GB HBM on ViT-L (measured OOM vs 15.75G)
@@ -56,14 +60,15 @@ def main():
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.normal(
         size=(args.ubatches, args.batch, 3, cfg.image_size, cfg.image_size)),
-        jnp.bfloat16)
+        param_dtype)   # mixed mode casts to bf16 inside the step
     y = jnp.asarray(rng.integers(0, max(cfg.num_labels, 1),
                                  size=(args.ubatches, args.batch)), jnp.int32)
 
     on_tpu = jax.devices()[0].platform != "cpu"
     peak = _calibrate_peak_flops() if on_tpu else None   # 32x 8192^3
     #                       matmuls — pointless (and minutes) on CPU
-    step, opt_state = train.make_train_step(pipe, optax.sgd(1e-3), x)
+    step, opt_state = train.make_train_step(
+        pipe, optax.sgd(1e-3), x, mixed_precision=args.mixed_precision)
     params = pipe.params
     params, opt_state, loss = step(params, opt_state, x, y)   # compile
     float(loss)                                               # fence
@@ -94,7 +99,9 @@ def main():
         "peak_calibrated_tflops": round(peak / 1e12, 1) if peak else None,
         "mfu_nominal": round(achieved / nominal, 3) if nominal else None,
         "peak_nominal_tflops": round(nominal / 1e12, 1) if nominal else None,
-        "dtype": "bfloat16",
+        "dtype": ("f32-master/bf16-compute" if args.mixed_precision
+                  else "bfloat16"),
+        "mixed_precision": args.mixed_precision,
         "device_kind": device_kind,
     }))
 

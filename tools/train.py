@@ -46,6 +46,9 @@ def main():
     p.add_argument("--remat", action="store_true",
                    help="per-block jax.checkpoint (trades a forward "
                         "recompute for ~model-depth less activation HBM)")
+    p.add_argument("--mixed-precision", action="store_true",
+                   help="bf16 compute over f32 master weights (requires "
+                        "-t float32: those params ARE the masters)")
     p.add_argument("--ckpt-dir", default=None,
                    help="save the training state here every --ckpt-every "
                         "steps and resume from it when present")
@@ -57,6 +60,9 @@ def main():
                    help="force a jax platform (e.g. cpu)")
     args = p.parse_args()
 
+    if args.mixed_precision and args.dtype != "float32":
+        p.error("--mixed-precision keeps f32 master weights; use -t "
+                "float32 (the bf16 cast is per-step, inside the program)")
     if args.platform:
         os.environ["JAX_PLATFORMS"] = args.platform
     from pipeedge_tpu.utils import apply_env_platform
@@ -130,7 +136,8 @@ def main():
 
     opt = (optax.adam(args.lr) if args.optimizer == "adam"
            else optax.sgd(args.lr))
-    step_fn, opt_state = train.make_train_step(pipe, opt, inputs)
+    step_fn, opt_state = train.make_train_step(
+        pipe, opt, inputs, mixed_precision=args.mixed_precision)
     params, start = pipe.params, 0
     if args.ckpt_dir and os.path.isdir(args.ckpt_dir) \
             and os.listdir(args.ckpt_dir):   # a real checkpoint, not just
@@ -162,6 +169,7 @@ def main():
         "wall_s": round(wall, 2),
         "steps_per_sec": round(done / wall, 3) if wall > 0 and done else None,
         "mesh": dict(mesh.shape), "remat": args.remat,
+        "mixed_precision": args.mixed_precision,
         "ckpt": args.ckpt_dir}), flush=True)
 
 
