@@ -145,3 +145,122 @@ def test_spmd_wave_decode_single_token_and_validation(setup):
     with pytest.raises(NotImplementedError, match="MoE"):
         SpmdDecodePipeline(gpt2_mod.FAMILY, moe_cfg, partition,
                            stage_params, mesh, max_len=32)
+
+
+def test_spmd_wave_prefix_caching_matches_host(setup):
+    """Wave prompt caching: precompute_prefix + suffix-span-wave generate
+    == (a) monolithic wave generate and (b) each slot's solo host
+    prefix-seeded generate — the host prefix contract through the wave
+    programs, greedy AND sampled."""
+    cfg, weights = setup
+    partition = [(1, 4), (5, 8), (9, 12)]
+    mesh = Mesh(np.asarray(jax.devices()[:3]), ("stage",))
+    stage_params = _stage_params(cfg, partition, weights)
+    wave = SpmdDecodePipeline(gpt2_mod.FAMILY, cfg, partition, stage_params,
+                              mesh, max_len=32)
+    host = decode.DecodePipeline(gpt2_mod.FAMILY, cfg, partition,
+                                 stage_params, max_len=32)
+    rng = np.random.default_rng(41)
+    prefix = rng.integers(0, 100, size=(1, 5))
+    suffix = rng.integers(0, 100, size=(3, 2, 4))
+
+    handle = wave.precompute_prefix(prefix)
+    got = np.asarray(wave.generate(suffix, 6, prefix=handle))
+    assert got.shape == (3, 2, 10)          # prefix omitted
+    # (a) == monolithic wave run on prefix+suffix
+    full = np.concatenate(
+        [np.broadcast_to(prefix[None], (3, 2, 5)), suffix], axis=2)
+    want_full = np.asarray(wave.generate(full, 6))
+    np.testing.assert_array_equal(got, want_full[:, :, 5:])
+    # (b) == per-slot host prefix-seeded generate
+    h_handle = host.precompute_prefix(prefix)
+    for r in range(3):
+        want = np.asarray(host.generate(suffix[r], 6, prefix=h_handle))
+        np.testing.assert_array_equal(got[r], want, err_msg=f"slot {r}")
+
+    # sampled: per-slot rng discipline holds through the suffix span
+    got_s = np.asarray(wave.generate(suffix, 5, temperature=0.8,
+                                     seeds=[3, 4, 5], prefix=handle))
+    for r in range(3):
+        want = np.asarray(host.generate(suffix[r], 5, temperature=0.8,
+                                        seed=3 + r, prefix=h_handle))
+        np.testing.assert_array_equal(got_s[r], want, err_msg=f"slot {r}")
+
+    # foreign/stripped handles are rejected up front
+    bad = {k: v for k, v in handle.items() if k != "sig"}
+    with pytest.raises(ValueError, match="precompute_prefix handle"):
+        wave.generate(suffix, 4, prefix=bad)
+    sig = list(handle["sig"])
+    sig[2] = handle["sig"][2] + 16          # max_len field
+    with pytest.raises(ValueError, match="incompatible"):
+        wave.generate(suffix, 4, prefix=dict(handle, sig=tuple(sig)))
+
+
+def test_spmd_wave_speculative_matches_wave_greedy(setup):
+    """SpmdSpeculativeDecoder: verify spans ride ONE span-wave program
+    per round; output is token-identical to the wave pipeline's plain
+    greedy generate (and hence to per-slot host runs) with both a
+    perturbed draft (accept AND reject rounds) and a self-draft
+    (acceptance 1.0)."""
+    from pipeedge_tpu.parallel.spmd_decode import SpmdSpeculativeDecoder
+    cfg, weights = setup
+    partition = [(1, 4), (5, 8), (9, 12)]
+    mesh = Mesh(np.asarray(jax.devices()[:3]), ("stage",))
+    stage_params = _stage_params(cfg, partition, weights)
+    wave = SpmdDecodePipeline(gpt2_mod.FAMILY, cfg, partition, stage_params,
+                              mesh, max_len=40)
+    total = 4 * cfg.num_hidden_layers
+    full_params = _stage_params(cfg, [(1, total)], weights)
+    draft = decode.DecodePipeline(gpt2_mod.FAMILY, cfg, [(1, total)],
+                                  full_params, max_len=40)
+    rng = np.random.default_rng(43)
+    ids = rng.integers(0, 100, size=(3, 2, 6))
+    want = np.asarray(wave.generate(ids, 8))
+
+    # self-draft: every proposal accepted, maximal spans
+    spec = SpmdSpeculativeDecoder(wave, draft, gamma=3)
+    got = np.asarray(spec.generate(ids, 8))
+    np.testing.assert_array_equal(got, want)
+    assert spec.last_acceptance_rate == 1.0
+
+    # perturbed draft: rounds exercise accept AND reject paths
+    pert = jax.tree_util.tree_map(
+        lambda x: x + jnp.asarray(
+            np.random.default_rng(7).normal(scale=0.05, size=x.shape),
+            x.dtype)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x, full_params[0])
+    draft2 = decode.DecodePipeline(gpt2_mod.FAMILY, cfg, [(1, total)],
+                                   [pert], max_len=40)
+    spec2 = SpmdSpeculativeDecoder(wave, draft2, gamma=2)
+    got2 = np.asarray(spec2.generate(ids, 8))
+    np.testing.assert_array_equal(got2, want)
+    assert 0.0 <= spec2.last_acceptance_rate <= 1.0
+
+
+def test_spmd_wave_prefix_with_quantized_edges(setup):
+    """Prefix caching on a quantized-edge wave pipeline: the suffix span
+    wave rides the SAME edge codec as the prefill wave, so the prefix
+    path stays token-identical to the monolithic quantized-edge run
+    (code-review finding: the span hops initially crossed raw)."""
+    cfg, weights = setup
+    partition = [(1, 4), (5, 8), (9, 12)]
+    mesh = Mesh(np.asarray(jax.devices()[:3]), ("stage",))
+    stage_params = _stage_params(cfg, partition, weights)
+    wave = SpmdDecodePipeline(gpt2_mod.FAMILY, cfg, partition, stage_params,
+                              mesh, max_len=32, edge_bits=8)
+    rng = np.random.default_rng(47)
+    prefix = rng.integers(0, 100, size=(1, 5))
+    suffix = rng.integers(0, 100, size=(3, 2, 4))
+    handle = wave.precompute_prefix(prefix)
+    got = np.asarray(wave.generate(suffix, 6, prefix=handle))
+    full = np.concatenate(
+        [np.broadcast_to(prefix[None], (3, 2, 5)), suffix], axis=2)
+    want = np.asarray(wave.generate(full, 6))
+    np.testing.assert_array_equal(got, want[:, :, 5:])
+
+    # a raw-edge pipeline rejects the quantized-edge handle (numerics
+    # differ): edge_bits is part of the signature
+    raw = SpmdDecodePipeline(gpt2_mod.FAMILY, cfg, partition, stage_params,
+                             mesh, max_len=32)
+    with pytest.raises(ValueError, match="incompatible"):
+        raw.generate(suffix, 4, prefix=handle)
