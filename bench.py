@@ -53,10 +53,30 @@ NOMINAL_BF16_PEAK = {
 }
 
 
-def _calibrate_peak_flops() -> float:
-    """Peak bf16 FLOP/s (2*M*N*K) from chained big matmuls; the chain
-    amortizes dispatch/tunnel latency out of the measurement."""
-    m, k_iters = 8192, 32
+# The PINNED peak-TFLOP calibration recipe (round-5 verdict item 7).
+# Version it; never change a field without bumping `version` — the MFU
+# denominators of different BENCH records are only comparable within one
+# recipe version. Per-session spread is recorded alongside every result
+# so the ±% error bars on calibrated MFU are explicit in the record.
+CALIBRATION_RECIPE = {
+    "version": "cal-v1",
+    "matmul_mnk": [8192, 8192, 8192],
+    "chain_length": 32,
+    "dtype": "bfloat16",
+    "accumulate": "float32",
+    "protocol": "one jitted lax.scan chain; 1 compile+warm call, then "
+                "3 timed reps fenced by scalar readback; peak = best "
+                "rep, spread = all reps",
+}
+
+
+def _calibrate_peak_samples() -> list:
+    """Per-rep implied bf16 FLOP/s (2*M*N*K) under CALIBRATION_RECIPE;
+    the chain amortizes dispatch/tunnel latency out of the measurement.
+    max(samples) is the session peak; the spread IS the error bar on
+    every calibrated-MFU number this session."""
+    m = CALIBRATION_RECIPE["matmul_mnk"][0]
+    k_iters = CALIBRATION_RECIPE["chain_length"]
     a = jnp.ones((m, m), jnp.bfloat16)
     b = jnp.ones((m, m), jnp.bfloat16)
 
@@ -70,12 +90,16 @@ def _calibrate_peak_flops() -> float:
         return jnp.sum(out.astype(jnp.float32))
 
     float(mm(a, b))  # compile + warm
-    best = float("inf")
+    samples = []
     for _ in range(3):
         tik = time.monotonic()
         float(mm(a, b))
-        best = min(best, time.monotonic() - tik)
-    return 2 * k_iters * m**3 / best
+        samples.append(2 * k_iters * m**3 / (time.monotonic() - tik))
+    return samples
+
+
+def _calibrate_peak_flops() -> float:
+    return max(_calibrate_peak_samples())
 
 
 def _model_flops_per_image(cfg) -> float:
@@ -108,16 +132,29 @@ def main():
         rng.normal(size=(n_ubatch, batch, 3, 224, 224)), dtype=jnp.bfloat16))
     params = jax.device_put(params)
 
-    peak_flops = _calibrate_peak_flops()
+    cal_samples = _calibrate_peak_samples()
+    peak_flops = max(cal_samples)
 
-    @jax.jit
-    def run_all(p, xs):
-        def step(carry, x):
-            logits = fn(p, x)
-            return carry + jnp.sum(logits.astype(jnp.float32)), None
+    # the UN-jitted shard apply: the factory's fn is jitted, and jit
+    # caches by function identity — a numerics-mode change (trace-time
+    # flag) only binds through a fresh trace of the raw callable
+    raw_fn = fn.__wrapped__
 
-        total, _ = jax.lax.scan(step, jnp.float32(0), xs)
-        return total
+    def make_run_all():
+        # a FRESH jit wrapper (and fresh inner trace via raw_fn) per
+        # numerics mode
+        @jax.jit
+        def run_all(p, xs):
+            def step(carry, x):
+                logits = raw_fn(p, x)
+                return carry + jnp.sum(logits.astype(jnp.float32)), None
+
+            total, _ = jax.lax.scan(step, jnp.float32(0), xs)
+            return total
+
+        return run_all
+
+    run_all = make_run_all()
 
     # Host-side energy (reference's energy-first monitoring demo,
     # monitoring/__init__.py:110-114 there): RAPL powercap when readable,
@@ -171,6 +208,58 @@ def main():
     device_kind = jax.devices()[0].device_kind
     nominal_peak = NOMINAL_BF16_PEAK.get(device_kind)
 
+    # fast-numerics headline (round-5 verdict item 1): the SAME streamed
+    # loop with model-dtype LayerNorm/softmax and tanh GeLU — the
+    # measured buy-back of the f32-numerics parity bucket, plus the
+    # measured accuracy delta vs the exact mode on this input set
+    from pipeedge_tpu.models.layers import set_fast_numerics
+    # fresh lambdas over raw_fn per mode: jit caches by function
+    # identity, so the trace-time numerics flag needs a new function
+    # object (and no stale inner jit) to rebind
+    logits_exact = np.asarray(
+        jax.jit(lambda p, x: raw_fn(p, x))(params,
+                                           xs[0]).astype(jnp.float32))
+    set_fast_numerics(True)
+    try:
+        run_all_fast = make_run_all()
+        float(run_all_fast(params, xs))          # compile + warm
+        # INTERLEAVED exact/fast rounds (the docs/PERF.md A/B timing
+        # discipline): session drift hits both modes equally, so the
+        # reported speedup is a same-moment quotient, not early-session
+        # exact vs late-session fast
+        fast_times, exact_times = [], []
+        for _ in range(3):
+            tik = time.monotonic()
+            float(run_all(params, xs))
+            exact_times.append(time.monotonic() - tik)
+            tik = time.monotonic()
+            float(run_all_fast(params, xs))
+            fast_times.append(time.monotonic() - tik)
+        fast_img_per_sec = statistics.median(
+            n_ubatch * batch / t for t in fast_times)
+        exact_adjacent = statistics.median(
+            n_ubatch * batch / t for t in exact_times)
+        logits_fast = np.asarray(
+            jax.jit(lambda p, x: raw_fn(p, x))(params,
+                                               xs[0]).astype(jnp.float32))
+    finally:
+        set_fast_numerics(False)
+    fast_achieved = fast_img_per_sec * flops_img
+    top1_agree = float(np.mean(np.argmax(logits_exact, -1)
+                               == np.argmax(logits_fast, -1)))
+    fast_fields = {
+        "images_per_sec": round(fast_img_per_sec, 3),
+        "exact_interleaved_images_per_sec": round(exact_adjacent, 3),
+        "speedup_vs_exact": round(fast_img_per_sec / exact_adjacent, 3),
+        "mfu_calibrated": round(fast_achieved / peak_flops, 3),
+        "mfu_nominal": (round(fast_achieved / nominal_peak, 3)
+                        if nominal_peak else None),
+        "achieved_tflops": round(fast_achieved / 1e12, 1),
+        "top1_agreement_vs_exact": round(top1_agree, 4),
+        "max_abs_logit_delta": round(
+            float(np.max(np.abs(logits_exact - logits_fast))), 4),
+    }
+
     print(json.dumps({
         "metric": "vit_large_images_per_sec_b8",
         "value": round(img_per_sec, 3),
@@ -192,6 +281,18 @@ def main():
         "peak_calibrated_tflops": round(peak_flops / 1e12, 1),
         "peak_nominal_tflops": (round(nominal_peak / 1e12, 1)
                                 if nominal_peak else None),
+        # pinned calibration recipe + per-session spread (verdict item
+        # 7): calibrated MFU carries explicit error bars
+        "calibration": dict(
+            CALIBRATION_RECIPE,
+            session_samples_tflops=[round(s / 1e12, 1)
+                                    for s in cal_samples],
+            calibration_spread=[round(min(cal_samples) / 1e12, 1),
+                                round(max(cal_samples) / 1e12, 1)]),
+        "mfu_calibrated_range": [
+            round(achieved / max(cal_samples), 3),
+            round(achieved / min(cal_samples), 3)],
+        "fast_numerics": fast_fields,
         "device_kind": device_kind,
         **energy_fields,
     }))

@@ -60,8 +60,48 @@ class TransformerConfig:
         return (self.image_size // self.patch_size) ** 2
 
 
+_FAST_NUMERICS = None      # None = unset (consult the env var)
+
+
+def set_fast_numerics(enabled: bool) -> None:
+    """Opt-in fast-numerics mode (also env PIPEEDGE_FAST_NUMERICS=1 when
+    this setter was never called — the programmatic toggle WINS so
+    exact-vs-fast A/Bs can't be silently poisoned by an inherited env):
+    LayerNorm statistics and attention softmax run in the model dtype
+    instead of float32, and exact-erf GeLU becomes the tanh
+    approximation. Trades exact HF/reference numerics parity for fewer
+    f32 intermediates (less VPU/HBM traffic between the MXU matmuls) —
+    the measured cost of the parity default is the 'f32 numerics'
+    bucket in docs/PERF.md's MFU attribution.
+
+    TRACE-TIME flag: programs compiled while the mode is on keep it
+    (jit caches by shape/dtype, not by this flag) — enable it BEFORE
+    building/first-calling a model, as bench.py's fast-numerics pass and
+    tools/bench_mfu_buckets.py do. Accuracy delta vs the exact mode is
+    measured and recorded (tests/test_models.py, docs/PERF.md)."""
+    global _FAST_NUMERICS
+    _FAST_NUMERICS = bool(enabled)
+
+
+def fast_numerics_enabled() -> bool:
+    if _FAST_NUMERICS is not None:
+        return _FAST_NUMERICS
+    import os
+    env = os.getenv("PIPEEDGE_FAST_NUMERICS")
+    if env is not None:
+        return env.strip().lower() not in ("", "0", "false", "no", "off")
+    return False
+
+
 def layer_norm(p, x: jax.Array, eps: float) -> jax.Array:
-    """LayerNorm with scale/bias, computed in float32 for stability."""
+    """LayerNorm with scale/bias, computed in float32 for stability
+    (model-dtype statistics under fast-numerics)."""
+    if fast_numerics_enabled():
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        normed = (x - mean) * jax.lax.rsqrt(var + jnp.asarray(eps, x.dtype))
+        return normed * p["scale"].astype(x.dtype) \
+            + p["bias"].astype(x.dtype)
     xf = x.astype(jnp.float32)
     mean = jnp.mean(xf, axis=-1, keepdims=True)
     var = jnp.var(xf, axis=-1, keepdims=True)
@@ -166,15 +206,21 @@ def self_attention(p, x: jax.Array, num_heads: int,
         # mask: [B, S] with 1 = attend, 0 = ignore
         bias = jnp.where(mask[:, None, None, :] > 0, 0.0, -1e9).astype(jnp.float32)
         scores = scores + bias
-    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    if fast_numerics_enabled():
+        # model-dtype softmax: the MXU accumulation above stays f32
+        # (free); only the VPU softmax intermediates narrow
+        probs = jax.nn.softmax(scores.astype(x.dtype), axis=-1)
+    else:
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
     ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v,
                      preferred_element_type=jnp.float32).astype(x.dtype)
     return ctx.reshape(b, s, d)
 
 
 def gelu(x: jax.Array) -> jax.Array:
-    """Exact (erf) GeLU, matching torch `nn.GELU()` default used by HF."""
-    return jax.nn.gelu(x, approximate=False)
+    """Exact (erf) GeLU, matching torch `nn.GELU()` default used by HF
+    (tanh approximation under fast-numerics)."""
+    return jax.nn.gelu(x, approximate=fast_numerics_enabled())
 
 
 def gelu_new(x: jax.Array) -> jax.Array:

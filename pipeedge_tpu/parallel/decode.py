@@ -136,37 +136,44 @@ def _cache_write_quantized(bcache: Cache, k_new: jax.Array,
 
 # per-tensor int8 window bytes the kernel may stage in VMEM: the window
 # is loaded whole per batch cell (grid is (batch,)), so huge unbucketed
-# windows must stay on the XLA path instead of dying in Mosaic lowering
-_INT8_KERNEL_VMEM_CAP = 4 << 20
+# windows must stay on the XLA path instead of dying in Mosaic lowering.
+# 1 MB = the measured-good regime (width 1024 at 16x64 heads ran on
+# chip; width 4096 hit a 36 MB scoped-vmem stack vs the 16 MB limit)
+_INT8_KERNEL_VMEM_CAP = 1 << 20
 
 
-def _int8_kernel_env() -> bool:
+def _int8_kernel_env() -> int:
     """Resolve the PIPEEDGE_INT8_DECODE_ATTEND opt-in (empty/0/false/no/off
-    all mean off). Callers resolve this ONCE at pipeline construction and
-    bind the answer into the stage programs — compiled decode steps are
-    cached per shape/read_len, so a trace-time env read would silently
-    ignore later toggles for already-compiled shapes (round-4 advice)."""
+    all mean off; '2' selects the batch-as-sublane kernel variant, any
+    other truthy value variant 1). Callers resolve this ONCE at pipeline
+    construction and bind the answer into the stage programs — compiled
+    decode steps are cached per shape/read_len, so a trace-time env read
+    would silently ignore later toggles for already-compiled shapes
+    (round-4 advice)."""
     import os
     env = (os.getenv("PIPEEDGE_INT8_DECODE_ATTEND") or "").strip().lower()
-    return bool(env) and env not in ("0", "false", "no", "off")
+    if not env or env in ("0", "false", "no", "off"):
+        return 0
+    return 2 if env == "2" else 1
 
 
 def _use_int8_decode_kernel(bcache: Cache, s: int, cfg: TransformerConfig,
-                            width: int, optin: bool) -> Optional[bool]:
+                            width: int, optin: int, batch: int = 1) \
+        -> Optional[Tuple[bool, int]]:
     """Route the classic int8 single-token decode step through the fused
     Pallas kernel (ops/decode_attention.py): MHA only (kv_heads == query
     heads), no sliding window, attend window small enough for VMEM —
     GQA/windowed/span/huge-window cases stay on the XLA
     dequantize-then-attend path. Static (trace-time) decision.
 
-    Returns None (use the XLA path), False (use the kernel, native
-    lowering), or True (use the kernel in interpret mode — forcing it
-    on a non-TPU backend, for tests). `optin` is the construction-time
-    resolution of PIPEEDGE_INT8_DECODE_ATTEND (`_int8_kernel_env`): an
-    isolated chip microbench measured the kernel at parity-to-slower vs
-    XLA's dequantize-then-attend (docs/DECODE.md), so the default stays
-    on the XLA path; the kernel is kept, exactness-tested, as the
-    experimental base for revisiting the fusion."""
+    Returns None (use the XLA path) or (interpret, variant): interpret
+    True forces interpret mode on a non-TPU backend (tests); variant 1
+    is the per-cell grid, 2 the batch-as-sublane grid. `optin` is the
+    construction-time resolution of PIPEEDGE_INT8_DECODE_ATTEND
+    (`_int8_kernel_env`): an isolated chip microbench measured variant 1
+    at parity-to-slower vs XLA's dequantize-then-attend (docs/DECODE.md),
+    so the default stays on the XLA path; the kernels are kept,
+    exactness-tested, as the experimental base for the fusion."""
     if not optin:
         return None
     if s != 1 or "k_scale" not in bcache:
@@ -175,8 +182,13 @@ def _use_int8_decode_kernel(bcache: Cache, s: int, cfg: TransformerConfig,
         return None
     if width * cfg.kv_heads * cfg.head_dim > _INT8_KERNEL_VMEM_CAP:
         return None
-    from ..ops.decode_attention import int8_decode_attention_supported
-    return not int8_decode_attention_supported()
+    from ..ops.decode_attention import (int8_decode_attention_supported,
+                                        int8_v2_fits)
+    variant = int(optin)
+    if variant == 2 and not int8_v2_fits(width, batch, cfg.kv_heads,
+                                         cfg.head_dim):
+        variant = 1      # v2's whole-batch block can't fit VMEM here
+    return (not int8_decode_attention_supported(), variant)
 
 
 def _cache_update_and_read(bcache: Cache, k_new: jax.Array, v_new: jax.Array,
@@ -257,27 +269,29 @@ def _block_tail(p: Dict, x: jax.Array, ctx: jax.Array,
 def _attention_core(p: Dict, x: jax.Array, bcache: Cache, pos,
                     cfg: TransformerConfig, prefill: bool,
                     read_len: Optional[int] = None,
-                    int8_optin: bool = False) \
+                    int8_optin: int = 0) \
         -> Tuple[jax.Array, Cache]:
     """ln + qkv + cache update + masked attend: the cached attention half
     shared by the plain and expert-parallel decode steps. `int8_optin` is
     the construction-time PIPEEDGE_INT8_DECODE_ATTEND resolution (bound
-    into the stage programs by _make_stage_run)."""
+    into the stage programs by _make_stage_run): 0 off, 1/2 = kernel
+    variant."""
     normed = layer_norm(p["ln_before"], x, cfg.layer_norm_eps)
     q, k_new, v_new = _qkv(p, normed, cfg)
     w = _attend_width(bcache, read_len) if "k" in bcache else 0
-    interpret = (None if prefill
-                 else _use_int8_decode_kernel(bcache, x.shape[1], cfg, w,
-                                              int8_optin))
-    if interpret is not None:
+    route = (None if prefill
+             else _use_int8_decode_kernel(bcache, x.shape[1], cfg, w,
+                                          int8_optin, batch=x.shape[0]))
+    if route is not None:
         from ..ops.decode_attention import int8_decode_attention
+        interpret, variant = route
         bcache = _cache_write_quantized(bcache, k_new, v_new,
                                         (0, pos, 0, 0))
         ctx = int8_decode_attention(
             q, bcache["k"][:, :w], bcache["k_scale"][:, :w],
             bcache["k_shift"][:, :w], bcache["v"][:, :w],
             bcache["v_scale"][:, :w], bcache["v_shift"][:, :w],
-            k_new, v_new, pos, interpret=interpret)
+            k_new, v_new, pos, interpret=interpret, variant=variant)
         return ctx, bcache
     k, v, keep, bcache = _cache_update_and_read(
         bcache, k_new, v_new, pos, prefill, x.shape[1], q.dtype,
@@ -288,7 +302,7 @@ def _attention_core(p: Dict, x: jax.Array, bcache: Cache, pos,
 def _block_step(p: Dict, x: jax.Array, bcache: Cache, pos,
                 cfg: TransformerConfig, prefill: bool,
                 read_len: Optional[int] = None,
-                int8_optin: bool = False) -> Tuple[jax.Array, Cache]:
+                int8_optin: int = 0) -> Tuple[jax.Array, Cache]:
     """One GPT-2 block over current token(s) with cache read/update.
 
     Prefill: x is the full prompt [B, S, D] written at positions [0, S);

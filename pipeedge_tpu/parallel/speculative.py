@@ -68,23 +68,31 @@ class SpeculativeDecoder:
     position. gamma is fixed for the whole generation so the verify span
     compiles once per attend bucket.
 
-    `sync` picks where acceptance is decided:
+    `sync` picks how many host round trips a round costs:
 
-    - ``"host"``: every draft argmax and the verify comparison read back
-      to the host — g+1 device round trips per round. On a remote/
-      tunneled chip each readback costs a full RTT, which can eat the
-      verify-span win.
-    - ``"device"``: the WHOLE round — draft catch-up span, gamma-1 draft
-      steps, the target verify span, and the accepted-prefix count — is
-      one compiled program; the host reads back a single packed [B,
-      2*gamma+2] array per round (ONE sync), then does pure-Python
-      position bookkeeping. Token-identical to "host" by construction:
-      the same stage programs run on the same values, argmax feeds
-      argmax inside the program instead of via the host.
-    - ``"auto"`` (default): "device" when both pipelines' stage programs
-      can legally inline into one jitted program (no per-stage device
-      placement, no tp/tp x ep mesh — `_device_rounds_eligible`), else
-      "host".
+    - ``"host"``: every draft argmax reads back to the host — g+1
+      device round trips per round. On a remote/tunneled chip each
+      readback costs a full RTT, which can eat the verify-span win.
+    - ``"device"``: the DRAFT side of the round — catch-up span plus
+      gamma-1 draft steps, argmax feeding argmax on device — is one
+      compiled program returning one packed [B, gamma] proposal array
+      (ONE readback); the target verify then runs through the SAME
+      compiled stage programs the host mode uses (one more readback for
+      its argmax row). TWO syncs per round vs g+1. Token-identical to
+      "host": committed tokens are always the target program's own
+      greedy continuations (the standard speculative exactness
+      argument), and the target program is literally the same compiled
+      object in both modes. (A fully-fused round — verify + acceptance
+      in the same program, ONE sync — was built and measured on chip:
+      inlining the target stages changes XLA fusion, and at bf16 the
+      fused verify's argmax flips on near-ties, 16% token divergence on
+      random-init logits. Reverted to the draft-only fusion, which is
+      numerics-robust by construction; docs/DECODE.md records the
+      negative.)
+    - ``"auto"`` (default): "device" when the draft pipeline's stage
+      programs can legally inline into one jitted program (no per-stage
+      device placement, no tp/ep/tp x ep mesh —
+      `_device_rounds_eligible`), else "host".
 
     `last_sync_count` records the host round trips of the latest
     generate() (the chip A/B's measured quantity: docs/DECODE.md).
@@ -110,13 +118,15 @@ class SpeculativeDecoder:
                     "dropless config (capacity_factor >= n_experts)")
         if sync not in ("auto", "host", "device"):
             raise ValueError(f"sync must be auto/host/device, got {sync!r}")
-        blockers = {name: why for name, pipe in
-                    (("target", target), ("draft", draft))
+        # only the DRAFT is fused into one program; the target verify
+        # rides its normal stage programs in both modes
+        blockers = {name: why for name, pipe in (("draft", draft),)
                     if (why := _device_rounds_eligible(pipe)) is not None}
         if sync == "device" and blockers:
             raise ValueError(
-                f"sync='device' unavailable: {blockers} (the round must "
-                "compile into one program); use sync='auto' or 'host'")
+                f"sync='device' unavailable: {blockers} (the draft round "
+                "must compile into one program); use sync='auto' or "
+                "'host'")
         self.target = target
         self.draft = draft
         self.gamma = gamma
@@ -126,27 +136,27 @@ class SpeculativeDecoder:
         self.last_sync_count: Optional[int] = None
         self._round_cache: dict = {}
 
-    def _round_fn(self, batch: int, catch_len: int, t_read, d_read):
-        """The compiled device-side round (sync='device'): cached per
-        (batch, catch span length, attend buckets) — a handful of
+    def _draft_round_fn(self, batch: int, catch_len: int, d_read):
+        """The compiled device-side DRAFT round (sync='device'): catch-up
+        span + gamma-1 proposal steps with argmax feeding argmax on
+        device, returning one packed [B, gamma] proposal array. Cached
+        per (batch, catch span length, attend bucket) — a handful of
         variants per generation, the same compile-per-discrete-value
         pattern as the attend buckets themselves."""
-        key = (batch, catch_len, t_read, d_read)
+        key = (batch, catch_len, d_read)
         fn = self._round_cache.get(key)
         if fn is not None:
             return fn
         g = self.gamma
-        target_stages = self.target.stages
-        draft_stages = self.draft.stages
+        draft_fns = [st["decode"] for st in self.draft.stages]
 
-        def run_stages(stages, data, caches, pos, read_len):
+        def run_stages(params_list, data, caches, pos):
             out = []
-            for st, c in zip(stages, caches):
-                if read_len is None:
-                    data, c = st["decode"](st["params"], data, c, pos)
+            for fn, p, c in zip(draft_fns, params_list, caches):
+                if d_read is None:
+                    data, c = fn(p, data, c, pos)
                 else:
-                    data, c = st["decode"](st["params"], data, c, pos,
-                                           read_len=read_len)
+                    data, c = fn(p, data, c, pos, read_len=d_read)
                 out.append(c)
             return data, out
 
@@ -154,38 +164,26 @@ class SpeculativeDecoder:
             return jnp.argmax(logits.astype(jnp.float32), -1) \
                 .astype(jnp.int32)
 
+        # params enter as ARGUMENTS, never closures: a closed-over param
+        # pytree would bake the full model weights into the program as
+        # constants — the serialized HLO then carries them to the
+        # compiler (hundreds of MB; the tunneled compile endpoint
+        # rejects it outright)
         @jax.jit
-        def round_fn(t_caches, d_caches, pending, catch, t_pos, d_pos):
-            # draft: catch-up span over committed-but-unseen tokens ...
-            x, d_caches = run_stages(draft_stages, catch, d_caches,
-                                     d_pos, d_read)
+        def draft_round(d_params, d_caches, catch, d_pos):
+            # catch-up span over committed-but-unseen tokens ...
+            x, d_caches = run_stages(d_params, catch, d_caches, d_pos)
             props = [greedy(x[:, -1])]
             # ... then gamma-1 proposals, argmax feeding argmax ON DEVICE
             for k in range(g - 1):
-                x, d_caches = run_stages(draft_stages, props[-1][:, None],
-                                         d_caches, d_pos + catch_len + k,
-                                         d_read)
+                x, d_caches = run_stages(d_params, props[-1][:, None],
+                                         d_caches,
+                                         d_pos + catch_len + k)
                 props.append(greedy(x[:, -1]))
-            # target: ONE span scores pending + all proposals
-            span = jnp.stack([pending] + props, axis=1)        # [B, g+1]
-            t_out, t_caches = run_stages(target_stages, span, t_caches,
-                                         t_pos, t_read)
-            targets = jnp.argmax(t_out.astype(jnp.float32), -1) \
-                .astype(jnp.int32)                             # [B, g+1]
-            # accepted prefix length (min across rows) — the host loop's
-            # `while np.all(props[a] == targets[:, a])` as a cumprod
-            props_arr = jnp.stack(props, axis=1)               # [B, g]
-            match = jnp.all(props_arr == targets[:, :g], axis=0)    # [g]
-            a = jnp.cumprod(match.astype(jnp.int32)).sum() \
-                .astype(jnp.int32)
-            # ONE packed array -> one host fetch: [a | props | targets]
-            packed = jnp.concatenate(
-                [jnp.broadcast_to(a[None, None], (span.shape[0], 1)),
-                 props_arr, targets], axis=1)         # [B, 1 + g + g+1]
-            return packed, t_caches, d_caches
+            return jnp.stack(props, axis=1), d_caches      # [B, g]
 
-        self._round_cache[key] = round_fn
-        return round_fn
+        self._round_cache[key] = draft_round
+        return draft_round
 
     def precompute_prefix(self, prefix_ids) -> dict:
         """Prompt caching for speculative decoding: prefill the shared
@@ -263,26 +261,26 @@ class SpeculativeDecoder:
             # propose gamma tokens autoregressively
             catch = np.stack(known[d_pos - d_floor:], axis=1)
             if device_rounds:
-                # the whole round in one program, ONE readback: attend
-                # buckets for the round's deepest positions are chosen
-                # host-side (positions are host bookkeeping, never read
-                # back) and bound statically; earlier in-round steps
-                # attending through the wider bucket is numerically
-                # identical (the extra positions are masked)
+                # the draft side in ONE program, one packed readback:
+                # the attend bucket for the round's deepest draft
+                # position is chosen host-side (positions are host
+                # bookkeeping, never read back) and bound statically;
+                # earlier in-round steps attending through the wider
+                # bucket is numerically identical (extra positions are
+                # masked). The target verify below uses the SAME
+                # compiled stage programs as sync='host', so tokens
+                # cannot diverge between modes.
                 c_len = catch.shape[1]
-                round_fn = self._round_fn(
+                draft_round = self._draft_round_fn(
                     batch, c_len,
-                    self.target._read_len(t_pos, g + 1),
                     self.draft._read_len(d_pos, c_len + g - 1))
-                packed, t_caches, d_caches = round_fn(
-                    t_caches, d_caches, jnp.asarray(pending),
-                    jnp.asarray(catch), t_pos, d_pos)
-                packed = np.asarray(packed)            # the round's ONE sync
+                props_arr, d_caches = draft_round(
+                    [st["params"] for st in self.draft.stages],
+                    d_caches, jnp.asarray(catch), d_pos)
+                props_arr = np.asarray(props_arr, np.int32)    # sync 1
                 syncs += 1
-                a = int(packed[0, 0])
-                props = [packed[:, 1 + k] for k in range(g)]
-                targets = packed[:, 1 + g:]
-                # (d_pos is reconciled below from `a`, like the host path)
+                props = [props_arr[:, k] for k in range(g)]
+                # (d_pos is reconciled from `a` at the end of the loop)
             else:
                 d_logits, d_caches = self.draft.extend(catch, d_caches,
                                                        d_pos)
@@ -300,18 +298,20 @@ class SpeculativeDecoder:
                     syncs += 1
                     d_pos += 1
 
-                # --- target: one span forward scores pending + proposals
-                span = np.stack([pending] + props, axis=1)    # [B, g+1]
-                t_logits, t_caches = self.target.extend(span, t_caches,
-                                                        t_pos)
-                targets = np.asarray(
-                    jnp.argmax(t_logits.astype(jnp.float32), -1), np.int32)
-                syncs += 1
+            # --- target: one span forward scores pending + proposals —
+            # THE SAME compiled stage programs in both sync modes, the
+            # token-identity anchor
+            span = np.stack([pending] + props, axis=1)        # [B, g+1]
+            t_logits, t_caches = self.target.extend(span, t_caches,
+                                                    t_pos)
+            targets = np.asarray(
+                jnp.argmax(t_logits.astype(jnp.float32), -1), np.int32)
+            syncs += 1
 
-                # --- accept the minimum matching prefix across rows
-                a = 0
-                while a < g and bool(np.all(props[a] == targets[:, a])):
-                    a += 1
+            # --- accept the minimum matching prefix across rows
+            a = 0
+            while a < g and bool(np.all(props[a] == targets[:, a])):
+                a += 1
             proposed += g
             accepted += a
             known.extend(props[:a] + [targets[:, a]])  # drafts + correction

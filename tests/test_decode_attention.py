@@ -18,8 +18,10 @@ from pipeedge_tpu.parallel import decode
 pytestmark = pytest.mark.slow   # compile-heavy decode programs
 
 
-def test_kernel_matches_xla_dequant_attend():
-    """Direct kernel check against the reference computation."""
+@pytest.mark.parametrize("variant", [1, 2])
+def test_kernel_matches_xla_dequant_attend(variant):
+    """Direct kernel check against the reference computation — both the
+    per-cell grid (v1) and the batch-as-sublane grid (v2)."""
     rng = np.random.default_rng(0)
     b, t, h, d = 2, 24, 4, 16
     pos = 13
@@ -33,7 +35,8 @@ def test_kernel_matches_xla_dequant_attend():
     vq, vs, vz = decode._quantize_rows(v_rows)
 
     got = decode_attention.int8_decode_attention(
-        q, kq, ks, kz, vq, vs, vz, k_new, v_new, pos, interpret=True)
+        q, kq, ks, kz, vq, vs, vz, k_new, v_new, pos, interpret=True,
+        variant=variant)
 
     # reference: the XLA path's math
     k = decode._dequantize_rows(kq, ks, kz, jnp.float32)
@@ -70,6 +73,9 @@ def test_int8_pipeline_tokens_match_with_kernel(monkeypatch):
     monkeypatch.setenv("PIPEEDGE_INT8_DECODE_ATTEND", "1")
     got = generate()
     np.testing.assert_array_equal(got, want)
+    monkeypatch.setenv("PIPEEDGE_INT8_DECODE_ATTEND", "2")
+    got_v2 = generate()                  # batch-as-sublane variant
+    np.testing.assert_array_equal(got_v2, want)
 
 
 def test_kernel_gate_scope(monkeypatch):
@@ -83,27 +89,31 @@ def test_kernel_gate_scope(monkeypatch):
     cache8 = {"k_scale": None}
     # span / fp cache / GQA / window / huge window never route, even
     # when opted in
-    assert decode._use_int8_decode_kernel(cache8, 2, cfg, 64, True) is None
-    assert decode._use_int8_decode_kernel({}, 1, cfg, 64, True) is None
+    assert decode._use_int8_decode_kernel(cache8, 2, cfg, 64, 1) is None
+    assert decode._use_int8_decode_kernel({}, 1, cfg, 64, 1) is None
     gqa = dataclasses.replace(cfg, num_kv_heads=2, num_attention_heads=4)
-    assert decode._use_int8_decode_kernel(cache8, 1, gqa, 64, True) is None
+    assert decode._use_int8_decode_kernel(cache8, 1, gqa, 64, 1) is None
     windowed = dataclasses.replace(cfg, sliding_window=4)
     assert decode._use_int8_decode_kernel(cache8, 1, windowed, 64,
-                                          True) is None
+                                          1) is None
     huge = decode._INT8_KERNEL_VMEM_CAP // (cfg.kv_heads * cfg.head_dim) + 8
-    assert decode._use_int8_decode_kernel(cache8, 1, cfg, huge, True) is None
+    assert decode._use_int8_decode_kernel(cache8, 1, cfg, huge, 1) is None
     # opt-in off: the eligible shape stays on the XLA path; on: interpret
-    # mode on this TPU-less host
-    assert decode._use_int8_decode_kernel(cache8, 1, cfg, 64, False) is None
-    assert decode._use_int8_decode_kernel(cache8, 1, cfg, 64, True) is True
-    # env resolution: unset/empty/0/off mean off, anything else means on
+    # mode on this TPU-less host, kernel variant passed through
+    assert decode._use_int8_decode_kernel(cache8, 1, cfg, 64, 0) is None
+    assert decode._use_int8_decode_kernel(cache8, 1, cfg, 64, 1) == (True, 1)
+    assert decode._use_int8_decode_kernel(cache8, 1, cfg, 64, 2) == (True, 2)
+    # env resolution: unset/empty/0/off mean off; '2' selects variant 2;
+    # anything else truthy means variant 1
     monkeypatch.delenv("PIPEEDGE_INT8_DECODE_ATTEND", raising=False)
-    assert decode._int8_kernel_env() is False
+    assert decode._int8_kernel_env() == 0
     for off in ("", "0", "false", "no", "off"):
         monkeypatch.setenv("PIPEEDGE_INT8_DECODE_ATTEND", off)
-        assert decode._int8_kernel_env() is False
+        assert decode._int8_kernel_env() == 0
     monkeypatch.setenv("PIPEEDGE_INT8_DECODE_ATTEND", "1")
-    assert decode._int8_kernel_env() is True
+    assert decode._int8_kernel_env() == 1
+    monkeypatch.setenv("PIPEEDGE_INT8_DECODE_ATTEND", "2")
+    assert decode._int8_kernel_env() == 2
 
 
 def test_kernel_optin_bound_at_construction(monkeypatch):
@@ -118,13 +128,13 @@ def test_kernel_optin_bound_at_construction(monkeypatch):
     fam = registry.get_model_entry(name).family.FAMILY
     pipe = decode.DecodePipeline(fam, cfg, [(1, total)], [params],
                                  max_len=32, cache_bits=8)
-    assert pipe.int8_decode_optin is True
+    assert pipe.int8_decode_optin == 1
     monkeypatch.setenv("PIPEEDGE_INT8_DECODE_ATTEND", "0")
-    assert pipe.int8_decode_optin is True   # captured, not re-read
+    assert pipe.int8_decode_optin == 1   # captured, not re-read
     monkeypatch.delenv("PIPEEDGE_INT8_DECODE_ATTEND", raising=False)
     pipe2 = decode.DecodePipeline(fam, cfg, [(1, total)], [params],
                                   max_len=32, cache_bits=8)
-    assert pipe2.int8_decode_optin is False
+    assert pipe2.int8_decode_optin == 0
 
 
 @pytest.mark.slow

@@ -227,3 +227,54 @@ def test_edge_arity():
     assert edge_arity(4) == 1
     assert edge_arity(24) == 1
     assert edge_arity(47) == 2
+
+
+def test_fast_numerics_mode_close_and_restorable(monkeypatch):
+    """Opt-in fast numerics (model-dtype LN/softmax, tanh GeLU): logits
+    stay close to the exact mode on the tiny ViT (top-1 agreement on
+    random inputs), turning the mode off restores bit-exactness with a
+    freshly traced program, and the programmatic toggle OVERRIDES an
+    inherited env var (an env-poisoned exact baseline would silently
+    void every A/B — code-review finding)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pipeedge_tpu.models import layers as layers_mod
+    from pipeedge_tpu.models import registry
+    from pipeedge_tpu.models.layers import (fast_numerics_enabled,
+                                            set_fast_numerics)
+
+    monkeypatch.setenv("PIPEEDGE_FAST_NUMERICS", "1")
+    set_fast_numerics(False)
+    try:
+        assert fast_numerics_enabled() is False   # setter wins over env
+    finally:
+        monkeypatch.setattr(layers_mod, "_FAST_NUMERICS", None)
+    assert fast_numerics_enabled() is True        # unset -> env applies
+    monkeypatch.delenv("PIPEEDGE_FAST_NUMERICS")
+    assert fast_numerics_enabled() is False
+
+    name = "pipeedge/test-tiny-vit"
+    total = registry.get_model_layers(name)
+    fn, params, _ = registry.module_shard_factory(name, None, 1, total)
+    rng = np.random.default_rng(3)
+    cfg = registry.get_model_config(name)
+    x = jnp.asarray(rng.normal(size=(4, 3, cfg.image_size,
+                                     cfg.image_size)), jnp.float32)
+
+    # NB: jit caches by function identity — a fresh lambda over the
+    # UN-jitted shard apply per mode forces the retrace that binds the
+    # trace-time flag (the factory's fn is jitted and would go stale)
+    raw = fn.__wrapped__
+    exact = np.asarray(jax.jit(lambda p, xx: raw(p, xx))(params, x))
+    set_fast_numerics(True)
+    try:
+        fast = np.asarray(jax.jit(lambda p, xx: raw(p, xx))(params, x))
+    finally:
+        set_fast_numerics(False)
+    again = np.asarray(jax.jit(lambda p, xx: raw(p, xx))(params, x))
+
+    np.testing.assert_array_equal(again, exact)      # mode fully restored
+    assert not np.array_equal(fast, exact)           # mode really changed
+    np.testing.assert_allclose(fast, exact, rtol=0.05, atol=0.05)
+    assert (np.argmax(fast, -1) == np.argmax(exact, -1)).mean() >= 0.75

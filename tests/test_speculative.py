@@ -242,12 +242,13 @@ def test_spec_with_prefix_cache(name):
 
 
 @pytest.mark.parametrize("gamma", [2, 4])
-def test_device_rounds_token_identical_one_sync_per_round(gpt2_pipes,
-                                                          gamma):
-    """sync='device' (the default here via 'auto') fuses each round into
-    one program: tokens identical to sync='host', and the host round
-    trips drop from ~(gamma+1)/round to exactly rounds+1 (one packed
-    readback per round plus the first-token argmax)."""
+def test_device_rounds_token_identical_two_syncs_per_round(gpt2_pipes,
+                                                           gamma):
+    """sync='device' (the default here via 'auto') fuses each round's
+    DRAFT side into one program: tokens identical to sync='host' (the
+    target verify runs the same compiled stage programs in both modes),
+    and the host round trips drop from (gamma+1)/round to 2/round (one
+    packed proposal readback + the verify argmax)."""
     target, draft = gpt2_pipes
     ids = _ids(2, 8, seed=5)
     host = SpeculativeDecoder(target, draft, gamma=gamma, sync="host")
@@ -256,10 +257,10 @@ def test_device_rounds_token_identical_one_sync_per_round(gpt2_pipes,
     got = np.asarray(dev.generate(ids, 12))
     np.testing.assert_array_equal(got, want)
     assert dev.last_acceptance_rate == host.last_acceptance_rate
-    # host pays 1 + rounds*(gamma+1); device pays 1 + rounds
+    # host pays 1 + rounds*(gamma+1); device pays 1 + 2*rounds
     n_rounds = (host.last_sync_count - 1) // (gamma + 1)
     assert host.last_sync_count == 1 + n_rounds * (gamma + 1)
-    assert dev.last_sync_count == 1 + n_rounds
+    assert dev.last_sync_count == 1 + 2 * n_rounds
     assert dev.last_sync_count < host.last_sync_count
 
 
@@ -283,10 +284,13 @@ def test_device_rounds_with_prefix_and_auto_fallback(gpt2_pipes):
 
     placed = _pipe("pipeedge/test-tiny-gpt2",
                    devices=[jax.devices()[0]])
-    auto = SpeculativeDecoder(placed, draft, gamma=2)
+    # a placed TARGET is fine (its verify rides the normal stage
+    # programs either way); a placed DRAFT forces the host fallback
+    assert SpeculativeDecoder(placed, draft, gamma=2).sync == "device"
+    auto = SpeculativeDecoder(target, placed, gamma=2)
     assert auto.sync == "host"       # fell back, still works
     with pytest.raises(ValueError, match="device placement"):
-        SpeculativeDecoder(placed, draft, gamma=2, sync="device")
+        SpeculativeDecoder(target, placed, gamma=2, sync="device")
 
 
 def test_device_rounds_eligibility_gate():

@@ -101,9 +101,17 @@ def main():
         dev = SpeculativeDecoder(target, draft, gamma=g, sync="device")
         host_out, host_s = timed(lambda: host.generate(ids, n))
         dev_out, dev_s = timed(lambda: dev.generate(ids, n))
-        np.testing.assert_array_equal(dev_out, host_out)  # token-identical
-        np.testing.assert_array_equal(dev_out, plain_out)  # greedy-exact
+        # the round-5 mechanism claim: device and host sync modes are
+        # token-identical (same target programs)
+        np.testing.assert_array_equal(dev_out, host_out)
+        # speculative-vs-plain is bitwise-exact for f32 caches (the
+        # tests); at bf16 the K-token verify span's reduction order
+        # differs from serial steps, so argmax can flip on near-ties —
+        # pervasive on random-init (near-uniform) logits, rare at real
+        # logit margins. MEASURED here, not asserted:
+        agree = float(np.mean(np.asarray(dev_out) == np.asarray(plain_out)))
         gammas[g] = {
+            "plain_token_agreement": round(agree, 4),
             "host": {"tokens_per_sec": round(args.batch * n / host_s, 1),
                      "syncs": host.last_sync_count,
                      "syncs_per_token": round(host.last_sync_count / n, 3)},

@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Round-5 serialized chip agenda: the verdict's measured A/Bs in one
+# single-tenant chip session (docs/PERF.md lease protocol — never run
+# two chip processes at once, never signal a running one).
+#   1. bench.py            — headline + pinned calibration spread +
+#                            fast-numerics buy-back (verdict #1, #7)
+#   2. bench_mfu_buckets   — f32/pad/head_dim bucket sizing (verdict #1)
+#   3. bench_int8_attend   — XLA vs kernel v1 vs v2 + roofline (verdict #3)
+#   4. bench_speculative   — host-sync vs device-sync rounds (verdict #2)
+#   5. bench_train x2      — pure-bf16 vs mixed-precision (verdict #6)
+#   6. bench_decode        — decode record refresh
+set -uo pipefail
+cd "$(dirname "$0")/.."
+mkdir -p docs/evidence
+LOG=docs/evidence/round5_agenda.log
+run() { echo "=== $(date -u +%H:%M:%S) $*" | tee -a "$LOG" >&2;
+        stdbuf -oL -eL "$@" 2>&1 | tee -a "$LOG"; }
+
+run python bench.py
+run python tools/bench_mfu_buckets.py
+run python tools/bench_int8_attend.py
+run python tools/bench_speculative.py -m gpt2 -b 8 --prompt-len 64 \
+    --new-tokens 64 --gammas 2,4
+run python tools/bench_train.py
+run python tools/bench_train.py --mixed-precision
+run python bench_decode.py
+echo "=== agenda done $(date -u +%H:%M:%S)" | tee -a "$LOG"
