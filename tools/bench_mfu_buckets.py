@@ -44,7 +44,18 @@ def main():
                    help="full-stack passes chained per timing (one fence)")
     p.add_argument("--rounds", default=3, type=int,
                    help="interleaved timing rounds per variant")
+    p.add_argument("--extra-seqs", default="",
+                   help="comma-separated extra sequence lengths to probe "
+                        "as exact-numerics variants (e.g. 200,208 — sizes "
+                        "the small-pad end of the S=197 padding bucket)")
     args = p.parse_args()
+    try:
+        # fail BEFORE any chip compile: a malformed list after five warm
+        # builds would waste the whole leased session
+        extra_seqs = [int(s) for s in args.extra_seqs.split(",") if s]
+    except ValueError:
+        p.error(f"--extra-seqs must be comma-separated integers, got "
+                f"{args.extra_seqs!r}")
 
     from pipeedge_tpu.utils import apply_env_platform, require_live_backend
     apply_env_platform()
@@ -131,6 +142,11 @@ def main():
     }
     seqs = {"base": 197, "fast_numerics": 197, "pad256": 256,
             "hd128": 197, "stacked": 256}
+    for s_extra in extra_seqs:
+        if f"pad{s_extra}" in variants or s_extra == 197:
+            continue     # already a built-in variant; skip the recompile
+        variants[f"pad{s_extra}"] = build(s_extra, 16, False)
+        seqs[f"pad{s_extra}"] = s_extra
 
     cal = _calibrate_peak_samples()
     device_kind = jax.devices()[0].device_kind
