@@ -144,8 +144,11 @@ _INT8_KERNEL_VMEM_CAP = 1 << 20
 
 def _int8_kernel_env() -> int:
     """Resolve the PIPEEDGE_INT8_DECODE_ATTEND opt-in (empty/0/false/no/off
-    all mean off; '2' selects the batch-as-sublane kernel variant, any
-    other truthy value variant 1). Callers resolve this ONCE at pipeline
+    all mean off; '2' forces the batch-as-sublane kernel variant, 'auto'
+    applies the measured routing policy — kernel v2 only for attend
+    windows <= 256 where it beat XLA in three separate chip sessions,
+    XLA everywhere else (docs/DECODE.md) — and any other truthy value
+    forces variant 1). Callers resolve this ONCE at pipeline
     construction and bind the answer into the stage programs — compiled
     decode steps are cached per shape/read_len, so a trace-time env read
     would silently ignore later toggles for already-compiled shapes
@@ -154,7 +157,15 @@ def _int8_kernel_env() -> int:
     env = (os.getenv("PIPEEDGE_INT8_DECODE_ATTEND") or "").strip().lower()
     if not env or env in ("0", "false", "no", "off"):
         return 0
+    if env == "auto":
+        return 3
     return 2 if env == "2" else 1
+
+
+# the measured crossover: kernel v2 beat XLA at attend widths <= 256 in
+# every chip session (3/3); XLA won at 1024 in every session. 'auto'
+# routes the kernel only below this width.
+_INT8_AUTO_MAX_WIDTH = 256
 
 
 def _use_int8_decode_kernel(bcache: Cache, s: int, cfg: TransformerConfig,
@@ -185,8 +196,13 @@ def _use_int8_decode_kernel(bcache: Cache, s: int, cfg: TransformerConfig,
     from ..ops.decode_attention import (int8_decode_attention_supported,
                                         int8_v2_fits)
     variant = int(optin)
-    if variant == 2 and not int8_v2_fits(width, batch, cfg.kv_heads,
-                                         cfg.head_dim):
+    if variant == 3:     # 'auto': the measured width-crossover policy
+        if width > _INT8_AUTO_MAX_WIDTH or not int8_v2_fits(
+                width, batch, cfg.kv_heads, cfg.head_dim):
+            return None  # XLA wins at wide windows (3/3 chip sessions)
+        variant = 2
+    elif variant == 2 and not int8_v2_fits(width, batch, cfg.kv_heads,
+                                           cfg.head_dim):
         variant = 1      # v2's whole-batch block can't fit VMEM here
     return (not int8_decode_attention_supported(), variant)
 
@@ -274,8 +290,9 @@ def _attention_core(p: Dict, x: jax.Array, bcache: Cache, pos,
     """ln + qkv + cache update + masked attend: the cached attention half
     shared by the plain and expert-parallel decode steps. `int8_optin` is
     the construction-time PIPEEDGE_INT8_DECODE_ATTEND resolution (bound
-    into the stage programs by _make_stage_run): 0 off, 1/2 = kernel
-    variant."""
+    into the stage programs by _make_stage_run): 0 off, 1/2 = forced
+    kernel variant, 3 = 'auto' (the measured width-crossover policy —
+    see _use_int8_decode_kernel)."""
     normed = layer_norm(p["ln_before"], x, cfg.layer_norm_eps)
     q, k_new, v_new = _qkv(p, normed, cfg)
     w = _attend_width(bcache, read_len) if "k" in bcache else 0

@@ -164,3 +164,41 @@ def test_int8_pipeline_bf16_tokens_match_with_kernel(monkeypatch):
     monkeypatch.setenv("PIPEEDGE_INT8_DECODE_ATTEND", "1")
     got = generate()
     np.testing.assert_array_equal(got, want)
+
+
+def test_kernel_auto_policy(monkeypatch):
+    """PIPEEDGE_INT8_DECODE_ATTEND=auto routes kernel v2 ONLY at attend
+    widths <= 256 (the 3/3-session measured crossover); wider windows
+    stay on the XLA path, and shapes whose whole-batch block can't fit
+    VMEM fall back to XLA too."""
+    cfg = registry.get_model_config("pipeedge/test-tiny-gpt2")
+    cache8 = {"k_scale": None}
+    monkeypatch.setenv("PIPEEDGE_INT8_DECODE_ATTEND", "auto")
+    assert decode._int8_kernel_env() == 3
+    # small window -> v2; wide window -> XLA (None)
+    assert decode._use_int8_decode_kernel(cache8, 1, cfg, 256, 3,
+                                          batch=2) == (True, 2)
+    assert decode._use_int8_decode_kernel(cache8, 1, cfg, 512, 3,
+                                          batch=2) is None
+    # whole-batch block can't fit -> XLA rather than dying in Mosaic
+    assert decode._use_int8_decode_kernel(cache8, 1, cfg, 256, 3,
+                                          batch=100000) is None
+
+    # end-to-end: auto tokens == XLA-path tokens on the tiny model
+    name = "pipeedge/test-tiny-gpt2"
+    total = registry.get_model_layers(name)
+    _, params, _ = registry.module_shard_factory(name, None, 1, total,
+                                                 unroll=False)
+    fam = registry.get_model_entry(name).family.FAMILY
+    rng = np.random.default_rng(7)
+    ids = rng.integers(0, cfg.vocab_size, size=(2, 8))
+
+    def generate():
+        pipe = decode.DecodePipeline(fam, cfg, [(1, total)], [params],
+                                     max_len=32, cache_bits=8)
+        return np.asarray(pipe.generate(ids, 10))
+
+    monkeypatch.setenv("PIPEEDGE_INT8_DECODE_ATTEND", "0")
+    want = generate()
+    monkeypatch.setenv("PIPEEDGE_INT8_DECODE_ATTEND", "auto")
+    np.testing.assert_array_equal(generate(), want)

@@ -73,10 +73,13 @@ def main():
         float(jnp.sum(y))
         return time.monotonic() - tik
 
-    n_bw = 16
+    # long chains: each leg must dwarf the tunnel's RTT jitter or the
+    # delta can go negative (one session measured -6600 GB/s at n=16)
+    n_bw = 64
     deltas = [chain_copies(2 * n_bw) - chain_copies(n_bw)
               for _ in range(3)]
-    bw = 2 * n_bw * big.nbytes / statistics.median(deltas)   # rd + wr
+    med = statistics.median(deltas)
+    bw = 2 * n_bw * big.nbytes / med if med > 0 else None
 
     results = {}
     for width in (int(w) for w in args.widths.split(",")):
@@ -143,26 +146,38 @@ def main():
             for name, fn in routes.items():
                 # paired-delta estimator: (t(2N) - t(N)) / N cancels the
                 # fixed dispatch/tunnel round trip that would otherwise
-                # dominate these sub-ms ops (docs/PERF.md discipline)
+                # dominate these sub-ms ops (docs/PERF.md discipline).
+                # A negative delta means RTT jitter swamped the sample —
+                # record it as INVALID (None), never clamp to a fake 0
+                # that could win the comparison
                 delta = timed_chain(fn, 2 * args.chain) \
                     - timed_chain(fn, args.chain)
-                times[name].append(max(delta, 0.0) / args.chain)
+                times[name].append(delta / args.chain
+                                   if delta > 0 else None)
         int8_bytes = 2 * b * width * h * d          # K + V int8 reads
         fp_bytes = int8_bytes * jnp.dtype(dtype).itemsize
-        results[str(width)] = {
-            name: {"ms": round(statistics.median(ts) * 1e3, 3)}
-            for name, ts in times.items()
-        }
+        results[str(width)] = {}
+        for name, ts in times.items():
+            valid = [t for t in ts if t is not None]
+            results[str(width)][name] = {
+                "ms": (round(statistics.median(valid) * 1e3, 3)
+                       if valid else None),
+                "invalid_samples": len(ts) - len(valid),
+            }
         results[str(width)]["roofline_ms"] = {
             # pure-traffic lower bounds at the measured copy bandwidth
-            "kernel_int8_read": round(int8_bytes / bw * 1e3, 3),
-            "xla_int8_read_fp_write_fp_read": round(
-                (int8_bytes + 2 * fp_bytes) / bw * 1e3, 3),
+            # (None when the bandwidth calibration was jitter-swamped)
+            "kernel_int8_read": (round(int8_bytes / bw * 1e3, 3)
+                                 if bw else None),
+            "xla_int8_read_fp_write_fp_read": (round(
+                (int8_bytes + 2 * fp_bytes) / bw * 1e3, 3)
+                if bw else None),
         }
 
     widest = str(max(int(w) for w in args.widths.split(",")))
-    best = min((v["ms"], k) for k, v in results[widest].items()
-               if k != "roofline_ms")
+    candidates = [(v["ms"], k) for k, v in results[widest].items()
+                  if k != "roofline_ms" and v["ms"] is not None]
+    best = min(candidates) if candidates else (None, "no-valid-sample")
     print(json.dumps({
         "metric": "int8_attend_best_route_ms",
         "value": best[0],
@@ -170,7 +185,7 @@ def main():
         "vs_baseline": None,
         "best_route": best[1],
         "widths": results,
-        "copy_bandwidth_gbs": round(bw / 1e9, 1),
+        "copy_bandwidth_gbs": round(bw / 1e9, 1) if bw else None,
         "config": {"batch": b, "heads": h, "head_dim": d,
                    "dtype": args.dtype, "chain": args.chain,
                    "rounds": args.rounds, "interpret": interpret},
