@@ -114,7 +114,14 @@ def _model_flops_per_image(cfg) -> float:
 
 def main():
     from pipeedge_tpu.models import registry
+    from pipeedge_tpu.models.layers import set_fast_numerics
     from pipeedge_tpu.utils import require_live_backend
+
+    # Pin exact numerics for the headline/calibration passes BEFORE any
+    # trace: an inherited PIPEEDGE_FAST_NUMERICS=1 would otherwise compile
+    # the "exact" side of the A/B in fast mode too, reporting a ~1.0
+    # speedup while claiming exact-parity numerics (ADVICE.md r5).
+    set_fast_numerics(False)
 
     # lease-neutral wedge diagnostic (shared with bench_decode.py)
     require_live_backend("vit_large_images_per_sec_b8", unit="images/sec")
@@ -212,7 +219,6 @@ def main():
     # loop with model-dtype LayerNorm/softmax and tanh GeLU — the
     # measured buy-back of the f32-numerics parity bucket, plus the
     # measured accuracy delta vs the exact mode on this input set
-    from pipeedge_tpu.models.layers import set_fast_numerics
     # fresh lambdas over raw_fn per mode: jit caches by function
     # identity, so the trace-time numerics flag needs a new function
     # object (and no stale inner jit) to rebind
@@ -243,6 +249,8 @@ def main():
             jax.jit(lambda p, x: raw_fn(p, x))(params,
                                                xs[0]).astype(jnp.float32))
     finally:
+        # None would re-defer to the env var — this bench's records must
+        # stay exact-mode regardless of the inherited environment
         set_fast_numerics(False)
     fast_achieved = fast_img_per_sec * flops_img
     top1_agree = float(np.mean(np.argmax(logits_exact, -1)

@@ -132,6 +132,18 @@ def iteration_start(key: str) -> None:
             _monitor_ctx.iteration_start(iter_ctx=_iter_ctx_push(key))
 
 
+def iteration_reset(key: str) -> None:
+    """Forget `key`'s last shared beat: the next start-less
+    `iteration(..., safe=False)` stamps a fresh baseline instead of
+    recording the idle gap since the previous beat (e.g. a DCN
+    re-schedule round boundary) as one giant iteration."""
+    with _monitor_ctx_lock.lock_read():
+        if _monitor_ctx is None:
+            return
+        with _locks[key]:
+            _monitor_ctx.iteration_reset(key=key)
+
+
 def iteration_abort(key: str) -> None:
     """Discard a started iteration without emitting a heartbeat (e.g. a
     transfer that failed mid-way); no-op if none was started."""

@@ -28,7 +28,10 @@ Capability mapping from the reference's two transports
 
 The command plane preserves the reference's CMD_STOP / CMD_SCHED semantics
 (runtime.py:36-37, 404-415): a schedule can be published to a live pipeline
-(consumed at the next run boundary) and a stop can be requested.
+(consumed at the next run boundary) and a stop can be requested. The DCN
+transport additionally answers per-edge bitwidth-negotiation frames on the
+same control connections (`DistDcnContext.negotiate_edge_bits`) — the
+handshake behind the quantized wire-v2 edges (docs/DCN_WIRE.md).
 """
 from __future__ import annotations
 

@@ -46,6 +46,11 @@ try:  # bfloat16 on the wire (JAX's native TPU dtype)
     _BFLOAT16 = np.dtype(ml_dtypes.bfloat16)
 except ImportError:  # pragma: no cover - ml_dtypes ships with jax
     _BFLOAT16 = None
+try:  # sub-byte quantized payloads (stored 1 byte/value in memory,
+    _INT4 = np.dtype(ml_dtypes.int4)     # nibble-packed 2/byte on the wire)
+    _UINT4 = np.dtype(ml_dtypes.uint4)
+except (NameError, AttributeError, TypeError):  # pragma: no cover
+    _INT4 = _UINT4 = None
 
 logger = logging.getLogger(__name__)
 
@@ -53,11 +58,24 @@ logger = logging.getLogger(__name__)
 _DTYPES: List[Optional[np.dtype]] = [np.dtype(d) for d in (
     'float16', 'float32', 'float64', 'uint8', 'int8', 'int16', 'int32',
     'int64', 'bool', 'complex64', 'complex128', 'uint16', 'uint32',
-    'uint64')] + [_BFLOAT16]
+    'uint64')] + [_BFLOAT16, _INT4, _UINT4]
+# 4-bit codes travel nibble-packed: ceil(n/2) wire bytes for n values
+# (their in-memory representation burns a full byte per value)
+_NIBBLE_CODES = frozenset(
+    i for i, d in enumerate(_DTYPES) if d is not None and d in (_INT4, _UINT4))
 
 _MSG_TENSORS = 1
 _MSG_CMD = 2
 _MSG_HELLO = 3
+# per-edge bitwidth negotiation on the control channel (aux = bitwidth):
+# answered directly by the receiving reader thread, no app wiring needed
+_MSG_NEG = 4
+_MSG_NEG_ACK = 5
+
+# wire bitwidths a context accepts by default for its inbound quantized
+# edges (ops/quant.py SUPPORTED_BITS, restatable per context so a peer
+# without e.g. the sub-byte decode path can cap its producers)
+DEFAULT_EDGE_BITS = (0, 1, 2, 3, 4, 5, 6, 8, 16, 32)
 
 # msg_type, aux (cmd / sender rank), channel, n_tensors. The channel byte
 # demultiplexes logically-distinct streams on the same rank pair (e.g. a
@@ -116,6 +134,57 @@ def _dtype_code(dtype: np.dtype) -> int:
     raise TypeError(f"unsupported wire dtype: {dtype}")
 
 
+def _socket_buf_bytes() -> int:
+    """Requested SO_SNDBUF/SO_RCVBUF size. Default 4 MiB: inter-stage
+    activation frames are megabytes, and deeper kernel buffers keep the
+    sender's `sendmsg` from stalling on the default (often ~200 KiB)
+    window while the stage could be computing. DCN_SOCKET_BUF overrides;
+    0 keeps the kernel default."""
+    env = os.getenv("DCN_SOCKET_BUF")
+    if env:
+        try:
+            return int(env)
+        except ValueError:
+            raise ValueError(
+                f"DCN_SOCKET_BUF={env!r} is not a byte count") from None
+    return 4 << 20
+
+
+def _tune_socket(sock: socket.socket) -> None:
+    """Apply the transport socket options: TCP_NODELAY (frames are whole
+    messages; never wait on Nagle) and enlarged send/recv buffers."""
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    buf = _socket_buf_bytes()
+    if buf > 0:
+        for opt in (socket.SO_SNDBUF, socket.SO_RCVBUF):
+            try:
+                sock.setsockopt(socket.SOL_SOCKET, opt, buf)
+            except OSError:  # pragma: no cover - kernel policy caps apply
+                pass
+
+
+def _pack_nibbles(t: np.ndarray) -> np.ndarray:
+    """4-bit array -> wire bytes, two values per byte (value i in byte
+    i//2, low nibble first). int4 is stored as its two's-complement low
+    nibble; the receiver sign-extends."""
+    vals = t.reshape(-1).astype(np.int8).view(np.uint8) & np.uint8(0xF)
+    if vals.size % 2:
+        vals = np.concatenate([vals, np.zeros(1, np.uint8)])
+    return (vals[0::2] | (vals[1::2] << np.uint8(4))).astype(np.uint8)
+
+
+def _unpack_nibbles(payload: bytes, n: int, dtype: np.dtype) -> np.ndarray:
+    """Inverse of `_pack_nibbles` for `n` values of 4-bit `dtype`."""
+    b = np.frombuffer(payload, np.uint8)
+    nib = np.empty(b.size * 2, np.uint8)
+    nib[0::2] = b & np.uint8(0xF)
+    nib[1::2] = b >> np.uint8(4)
+    nib = nib[:n]
+    if dtype == _INT4:  # sign-extend the two's-complement nibble
+        return (((nib.astype(np.int8)) ^ 8) - 8).astype(dtype)
+    return nib.astype(dtype)
+
+
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray(n)
     view = memoryview(buf)
@@ -155,11 +224,15 @@ def _send_frame(sock: socket.socket, msg_type: int, aux: int,
         t = np.asarray(t)
         if not t.flags.c_contiguous:  # ascontiguousarray would promote 0-d to 1-d
             t = np.ascontiguousarray(t)
-        parts.append(_TENSOR_HEADER.pack(_dtype_code(t.dtype), t.ndim))
+        code = _dtype_code(t.dtype)
+        parts.append(_TENSOR_HEADER.pack(code, t.ndim))
         for d in t.shape:
             parts.append(_DIM.pack(d))
-        # raw bytes view of the payload: zero-copy into sendmsg
-        parts.append(t.reshape(-1).view(np.uint8))
+        if code in _NIBBLE_CODES:
+            parts.append(_pack_nibbles(t))
+        else:
+            # raw bytes view of the payload: zero-copy into sendmsg
+            parts.append(t.reshape(-1).view(np.uint8))
     _sendmsg_all(sock, parts)
 
 
@@ -174,12 +247,18 @@ def _recv_body(sock: socket.socket, n: int) -> List[np.ndarray]:
             _recv_exact(sock, _TENSOR_HEADER.size))
         dtype = _DTYPES[code]
         if dtype is None:
-            raise TypeError("peer sent bfloat16 but ml_dtypes is unavailable")
+            raise TypeError("peer sent an ml_dtypes wire dtype (bfloat16/"
+                            "int4/uint4) this build cannot represent")
         shape = tuple(_DIM.unpack(_recv_exact(sock, _DIM.size))[0]
                       for _ in range(ndim))
-        nbytes = dtype.itemsize * int(np.prod(shape, dtype=np.int64))
-        payload = _recv_exact(sock, nbytes)
-        tensors.append(np.frombuffer(payload, dtype=dtype).reshape(shape))
+        n_values = int(np.prod(shape, dtype=np.int64))
+        if code in _NIBBLE_CODES:
+            payload = _recv_exact(sock, (n_values + 1) // 2)
+            tensors.append(_unpack_nibbles(payload, n_values,
+                                           dtype).reshape(shape))
+        else:
+            payload = _recv_exact(sock, dtype.itemsize * n_values)
+            tensors.append(np.frombuffer(payload, dtype=dtype).reshape(shape))
     return tensors
 
 
@@ -203,11 +282,20 @@ class DistDcnContext(DistContext):
 
     def __init__(self, world_size: int, rank: int,
                  rank_addrs: Sequence[Tuple[str, int]],
-                 cmd_handler: Optional[Callable] = None):
+                 cmd_handler: Optional[Callable] = None,
+                 edge_bits_supported: Optional[Sequence[int]] = None):
         super().__init__(world_size=world_size, rank=rank)
         assert len(rank_addrs) == world_size
         self._rank_addrs = list(rank_addrs)
         self._cmd_handler = cmd_handler
+        # wire bitwidths this context accepts on its inbound quantized
+        # edges; producers cap their proposals via negotiate_edge_bits
+        self._edge_bits = tuple(sorted(set(
+            edge_bits_supported if edge_bits_supported is not None
+            else DEFAULT_EDGE_BITS)))
+        # bitwidth-negotiation replies, keyed by the answering peer
+        self._neg_replies: Dict[int, "queue.Queue"] = {}
+        self._neg_lock = threading.Lock()
         # env override so small test fleets / fast-failing deployments don't
         # wait the full minute for a peer that will never come up
         env_timeout = os.getenv("DCN_CONNECT_TIMEOUT")
@@ -310,6 +398,7 @@ class DistDcnContext(DistContext):
         self._stop = threading.Event()
         self._reader_threads = []
         self._recv_queues = {}
+        self._neg_replies = {}
         self._dead = set()
         # forget which peers were ever up: a relaunched fleet's listeners
         # get the full rendezvous budget again, not the fast-refusal path
@@ -359,7 +448,7 @@ class DistDcnContext(DistContext):
                 continue
             except OSError:
                 return
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            _tune_socket(conn)
             with self._conns_lock:
                 self._accepted.append(conn)
             t = threading.Thread(target=self._reader_loop, args=(conn,),
@@ -417,6 +506,18 @@ class DistDcnContext(DistContext):
                 elif msg_type == _MSG_CMD:
                     if self._cmd_handler is not None:
                         self._cmd_handler(aux, tuple(tensors))
+                elif msg_type == _MSG_NEG:
+                    # answer the bitwidth proposal inline: transport-level
+                    # handshake, no app handler required
+                    try:
+                        self._send_neg(src, _MSG_NEG_ACK,
+                                       self._accept_edge_bit(aux))
+                    except OSError as exc:
+                        logger.warning("rank %d: bitwidth-handshake reply to "
+                                       "rank %d failed: %s", self._rank, src,
+                                       exc)
+                elif msg_type == _MSG_NEG_ACK:
+                    self._neg_queue(src).put(aux)
                 else:
                     logger.error("unknown frame type %d from rank %d",
                                  msg_type, src)
@@ -474,7 +575,7 @@ class DistDcnContext(DistContext):
                     refused_since = None
                 time.sleep(0.2)
         conn.settimeout(None)
-        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _tune_socket(conn)
         _send_frame(conn, _MSG_HELLO, self._rank, ())
         with self._conns_lock:
             conns[dst] = conn
@@ -577,46 +678,134 @@ class DistDcnContext(DistContext):
                 f"cmd_broadcast(cmd={cmd}): undeliverable to rank(s) "
                 + ", ".join(f"{d} ({e})" for d, e in failures))
 
+    # -- per-edge bitwidth negotiation ---------------------------------
+
+    def _neg_queue(self, peer: int) -> "queue.Queue":
+        with self._neg_lock:
+            q = self._neg_replies.get(peer)
+            if q is None:
+                q = queue.Queue()
+                self._neg_replies[peer] = q
+            return q
+
+    def _accept_edge_bit(self, proposed: int) -> int:
+        """Receiver policy: the proposal when supported, else the widest
+        supported bitwidth below it (0 = uncompressed, always legal)."""
+        if proposed in self._edge_bits:
+            return proposed
+        lower = [b for b in self._edge_bits if 0 < b < proposed]
+        return max(lower) if lower else 0
+
+    def _send_neg(self, dst: int, msg_type: int, bit: int) -> None:
+        # rides the dedicated command connections: a proposal must never
+        # queue behind a backpressured data send to the same peer
+        with self._cmd_conn_locks[dst]:
+            conn = self._ensure_conn(dst, conns=self._cmd_conns)
+            try:
+                _send_frame(conn, msg_type, bit, ())
+            except OSError:
+                with self._conns_lock:
+                    if self._cmd_conns.get(dst) is conn:
+                        del self._cmd_conns[dst]
+                raise
+
+    def negotiate_edge_bits(self, dst: int, proposed: int,
+                            timeout: Optional[float] = 30.0) -> int:
+        """Agree an edge bitwidth with the consuming rank over the control
+        channel: propose `proposed`, get back what `dst` accepts (its
+        `edge_bits_supported` policy — the proposal itself, or the widest
+        supported bitwidth below it, or 0 for uncompressed). Run once per
+        edge before streaming; the per-frame wire header still carries the
+        actual bitwidth, so adaptive policies may later move WITHIN the
+        agreed capability. Raises queue.Empty on timeout and OSError when
+        `dst` is unreachable. One in-flight negotiation per peer."""
+        q = self._neg_queue(dst)
+        while True:  # drop stale replies from an abandoned negotiation
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+        self._send_neg(dst, _MSG_NEG, int(proposed))
+        return int(q.get(timeout=timeout))
+
 
 class DcnPipelineStage:
     """One pipeline stage over the DCN transport: recv -> work -> send on
-    background threads with single-slot hand-off queues (the reference's
+    background threads with bounded hand-off queues (the reference's
     `DistP2pPipelineStage` role, p2p:334-450).
 
-    `work_cb` maps a tensor list to a tensor list (typically: device_put,
-    jitted shard forward, readback). Ranks outside the schedule pass
-    rank_src=rank_dst=None and idle (reference model_cfg.py:154-159).
+    Two work contracts:
+
+    - `work_cb(tensors) -> tensors`: the legacy single-phase form — the
+      whole recv->compute->readback runs on the work thread.
+    - `dispatch_cb(tensors) -> handle` + `readback_cb(handle) -> tensors`:
+      the overlapped form. `dispatch_cb` runs on the work thread and must
+      NOT block on device results (enqueue the jitted shard step, start
+      the async device->host copies — wire.wire_encode_device — and
+      return a handle immediately); `readback_cb` runs on the SEND thread
+      and completes the handle into the wire tensor list. With `depth` >=
+      2 the work thread dispatches microbatch i+1's compute while the
+      send thread drains microbatch i's readback — compute, D2H copy and
+      socket send overlap instead of serializing. FIFO order is preserved
+      (single work thread, single send thread, FIFO queues).
+
+    `depth` sizes both hand-off queues (default env DCN_STAGE_DEPTH or 2;
+    the pre-overlap behavior was a hardcoded 1). Ranks outside the
+    schedule pass rank_src=rank_dst=None with no callback and idle
+    (reference model_cfg.py:154-159).
     """
 
     _SENTINEL = object()
 
     def __init__(self, ctx: DistDcnContext, rank_src: Optional[int],
                  rank_dst: Optional[int],
-                 work_cb: Callable[[List[np.ndarray]], List[np.ndarray]],
+                 work_cb: Optional[
+                     Callable[[List[np.ndarray]], List[np.ndarray]]] = None,
                  results_cb: Optional[Callable] = None,
                  recv_channel: int = CHANNEL_DATA,
-                 send_channel: int = CHANNEL_DATA):
+                 send_channel: int = CHANNEL_DATA,
+                 dispatch_cb: Optional[Callable] = None,
+                 readback_cb: Optional[Callable] = None,
+                 depth: Optional[int] = None):
+        if depth is None:
+            depth = int(os.getenv("DCN_STAGE_DEPTH", "2"))
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        if dispatch_cb is not None and work_cb is not None:
+            raise ValueError("pass work_cb OR dispatch_cb/readback_cb, "
+                             "not both")
+        if readback_cb is not None and dispatch_cb is None:
+            raise ValueError("readback_cb requires dispatch_cb")
+        if work_cb is None and dispatch_cb is None \
+                and not (rank_src is None and rank_dst is None):
+            # only the not-in-schedule idle stage may omit the callback;
+            # a wired stage without one would die silently on its first
+            # frame in a daemon thread
+            raise ValueError("a stage with rank_src/rank_dst needs a "
+                             "work_cb or dispatch_cb")
         self._ctx = ctx
         self._rank_src = rank_src
         self._rank_dst = rank_dst
-        self._work_cb = work_cb
+        self._dispatch_cb = dispatch_cb if dispatch_cb is not None else work_cb
+        self._readback_cb = readback_cb
         self._results_cb = results_cb
         self._recv_channel = recv_channel
         self._send_channel = send_channel
-        self._queue_work: "queue.Queue" = queue.Queue(maxsize=1)
-        self._queue_out: "queue.Queue" = queue.Queue(maxsize=1)
+        self._depth = depth
+        self._queue_work: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._queue_out: "queue.Queue" = queue.Queue(maxsize=depth)
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
 
     def start(self) -> None:
         if self._rank_src is None and self._rank_dst is None \
-                and self._work_cb is None:
+                and self._dispatch_cb is None:
             return  # not in the schedule: idle (reference runtime.py:456-460)
         # fresh session state: a stopped stage can be restarted (stop()
         # joined all threads, which hold the old event/queues)
         self._stop = threading.Event()
-        self._queue_work = queue.Queue(maxsize=1)
-        self._queue_out = queue.Queue(maxsize=1)
+        self._queue_work = queue.Queue(maxsize=self._depth)
+        self._queue_out = queue.Queue(maxsize=self._depth)
         for target, name in ((self._recv_loop, "recv"),
                              (self._work_loop, "work"),
                              (self._send_loop, "send")):
@@ -676,13 +865,17 @@ class DcnPipelineStage:
             item = self._queue_work.get()
             if item is self._SENTINEL or self._stop.is_set():
                 return
-            self._queue_out.put(self._work_cb(item))
+            self._queue_out.put(self._dispatch_cb(item))
 
     def _send_loop(self) -> None:
         while True:
             item = self._queue_out.get()
             if item is self._SENTINEL or self._stop.is_set():
                 return
+            if self._readback_cb is not None:
+                # drain the async readback HERE, after the work thread is
+                # already free to dispatch the next microbatch
+                item = self._readback_cb(item)
             if self._rank_dst is not None:
                 try:
                     self._ctx.send_tensors(self._rank_dst, item,

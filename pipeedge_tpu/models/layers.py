@@ -63,16 +63,21 @@ class TransformerConfig:
 _FAST_NUMERICS = None      # None = unset (consult the env var)
 
 
-def set_fast_numerics(enabled: bool) -> None:
+def set_fast_numerics(enabled) -> None:
     """Opt-in fast-numerics mode (also env PIPEEDGE_FAST_NUMERICS=1 when
-    this setter was never called — the programmatic toggle WINS so
-    exact-vs-fast A/Bs can't be silently poisoned by an inherited env):
-    LayerNorm statistics and attention softmax run in the model dtype
-    instead of float32, and exact-erf GeLU becomes the tanh
+    this setter was never called or was reset — the programmatic toggle
+    WINS so exact-vs-fast A/Bs can't be silently poisoned by an inherited
+    env): LayerNorm statistics and attention softmax run in the model
+    dtype instead of float32, and exact-erf GeLU becomes the tanh
     approximation. Trades exact HF/reference numerics parity for fewer
     f32 intermediates (less VPU/HBM traffic between the MXU matmuls) —
     the measured cost of the parity default is the 'f32 numerics'
     bucket in docs/PERF.md's MFU attribution.
+
+    `enabled` is True/False, or None to RESET: discard any programmatic
+    choice and defer to PIPEEDGE_FAST_NUMERICS again (without None the
+    env opt-in would be permanently dead for the rest of the process
+    after any caller touched the toggle — ADVICE.md r5).
 
     TRACE-TIME flag: programs compiled while the mode is on keep it
     (jit caches by shape/dtype, not by this flag) — enable it BEFORE
@@ -80,7 +85,7 @@ def set_fast_numerics(enabled: bool) -> None:
     tools/bench_mfu_buckets.py do. Accuracy delta vs the exact mode is
     measured and recorded (tests/test_models.py, docs/PERF.md)."""
     global _FAST_NUMERICS
-    _FAST_NUMERICS = bool(enabled)
+    _FAST_NUMERICS = None if enabled is None else bool(enabled)
 
 
 def fast_numerics_enabled() -> bool:

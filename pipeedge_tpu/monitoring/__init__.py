@@ -206,6 +206,17 @@ class MonitorContext:
         iter_ctx.t_ns_last = time.monotonic_ns()
         iter_ctx.e_uj_last = 0 if self._em is None else self._em.get_uj()
 
+    def iteration_reset(self, key: Any = None) -> None:
+        """Forget the key's shared last-beat baseline: the next
+        start-less `iteration` becomes a fresh first beat instead of
+        recording the idle gap since the previous beat as one giant
+        iteration (beat-to-beat consumers crossing an idle boundary,
+        e.g. a DCN re-schedule round)."""
+        self._check_init()
+        iter_ctx = self._states[key].iter_ctx
+        iter_ctx.t_ns_last = None
+        iter_ctx.e_uj_last = None
+
     def iteration(self, key: Any = None, work: int = 1,
                   accuracy: Union[int, float] = 1,
                   iter_ctx: Optional[MonitorIterationContext] = None) -> None:

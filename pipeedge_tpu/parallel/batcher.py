@@ -58,6 +58,11 @@ class _Request:
     pad_token: Optional[int] = None  # fills rows past their own eos
     # streaming hook: fires (step, [B] device tokens) as each pick lands
     on_token: Optional[object] = None
+    # cooperative cancellation: an is_set()-style flag (threading.Event)
+    # checked after each pick — a cancelled request completes with the
+    # tokens decoded so far, freeing its cache slots/admission slot early
+    # (dead streaming clients must not hold capacity, tools/serve.py)
+    cancel: Optional[object] = None
     rows_done: Optional[np.ndarray] = None   # [B] eos seen per row
     caches: Optional[List] = None    # per-stage cache slots (admission)
     tokens: List = field(default_factory=list)
@@ -74,7 +79,7 @@ def _build_request(pipe: DecodePipeline, rid, ids, new_tokens: int,
                    temperature: float, top_k: int, seed: int,
                    eos_token: Optional[int], pad_token: Optional[int],
                    prefix: Optional[Dict],
-                   on_token=None) -> _Request:
+                   on_token=None, cancel=None) -> _Request:
     """Validate one request's arguments against `pipe` and build its
     `_Request` — the shared admission contract of the wave batcher and
     the stage-worker executor (identical errors, identical rng/pick
@@ -101,7 +106,7 @@ def _build_request(pipe: DecodePipeline, rid, ids, new_tokens: int,
         rng=jax.random.PRNGKey(seed), prompt_len=prompt_len,
         prefix=prefix, eos_token=eos_token,
         pad_token=eos_token if pad_token is None else pad_token,
-        on_token=on_token)
+        on_token=on_token, cancel=cancel)
 
 
 def _seed_caches(pipe: DecodePipeline, req: _Request) -> str:
@@ -200,7 +205,7 @@ class ContinuousBatcher:
                eos_token: Optional[int] = None,
                pad_token: Optional[int] = None,
                prefix: Optional[Dict] = None,
-               on_token=None) -> None:
+               on_token=None, cancel=None) -> None:
         """Queue a request. `ids` [B, S] is a prompt batch decoded in
         lockstep (B=1 for a single sequence); each distinct (B, S) shape
         compiles its own prefill program, shared across requests.
@@ -225,12 +230,18 @@ class ContinuousBatcher:
         `on_token(step, tokens)` fires as each step's pick lands (tokens
         is the [B] device array — the callback decides when to block on
         readback), the streaming hook `tools/serve.py` chains to chunked
-        HTTP responses."""
+        HTTP responses.
+
+        `cancel` (an is_set()-style flag, e.g. threading.Event) requests
+        cooperative cancellation: once set, the request completes at its
+        next pick with the tokens decoded so far — freeing its cache
+        slots for pending requests instead of decoding to the cap for a
+        caller that stopped listening."""
         if rid in self.results or rid in self._live_rids:
             raise ValueError(f"duplicate request id {rid!r}")
         req = _build_request(self.pipe, rid, ids, new_tokens, temperature,
                              top_k, seed, eos_token, pad_token, prefix,
-                             on_token=on_token)
+                             on_token=on_token, cancel=cancel)
         self._live_rids.add(rid)
         self.pending.append(req)
 
@@ -259,6 +270,9 @@ class ContinuousBatcher:
         self.stats["tokens"] += int(token.shape[0])
         if req.on_token is not None:
             req.on_token(len(req.tokens) - 1, token)
+        if req.cancel is not None and req.cancel.is_set():
+            self._complete(req)     # caller gone: free the slots early
+            return
         if req.eos_token is not None:
             eos_pending.append(req)
             return
@@ -404,15 +418,15 @@ class StageWorkerExecutor:
                eos_token: Optional[int] = None,
                pad_token: Optional[int] = None,
                prefix: Optional[Dict] = None,
-               on_token=None) -> None:
+               on_token=None, cancel=None) -> None:
         """Admit one request (same argument contract as
-        `ContinuousBatcher.submit`, including prefix-handle validation
-        and the `on_token` streaming hook). BLOCKS while `max_active`
-        requests are in flight — admission backpressure is the caller's
-        thread, not an internal queue."""
+        `ContinuousBatcher.submit`, including prefix-handle validation,
+        the `on_token` streaming hook and the `cancel` flag). BLOCKS
+        while `max_active` requests are in flight — admission
+        backpressure is the caller's thread, not an internal queue."""
         req = _build_request(self.pipe, rid, ids, new_tokens, temperature,
                              top_k, seed, eos_token, pad_token, prefix,
-                             on_token=on_token)
+                             on_token=on_token, cancel=cancel)
         with self._lock:
             self._check_dead()
             if rid in self.results or rid in self._live:
@@ -478,6 +492,14 @@ class StageWorkerExecutor:
                     f"executor stopped with {len(self._live)} request(s) "
                     "in flight")
             self._lock.notify_all()
+            dead = self._dead is not None
+        if dead:
+            # mirror _die(): in-flight requests will never release their
+            # admission slots now, so over-release the semaphore to wake
+            # submitters blocked in acquire — they re-check _dead and
+            # raise instead of hanging forever (ADVICE.md r5)
+            for _ in range(self.max_active):
+                self._slots.release()
 
     def _check_dead(self) -> None:
         if self._dead is not None:
@@ -519,6 +541,8 @@ class StageWorkerExecutor:
         if req.on_token is not None:
             req.on_token(len(req.tokens) - 1, token)
         done = len(req.tokens) >= req.new_tokens
+        if not done and req.cancel is not None and req.cancel.is_set():
+            done = True             # caller gone: free the slot early
         if not done and req.eos_token is not None:
             hit = np.asarray(token) == req.eos_token
             req.rows_done = hit if req.rows_done is None \

@@ -29,6 +29,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..utils import jax_compat
+
 from ..models import ShardConfig, plan_shard
 from ..models.layers import (TransformerConfig, dense, gelu_new, layer_norm)
 
@@ -573,20 +575,19 @@ def make_tp_stage_fns(family, cfg: TransformerConfig,
     c_specs = tp_cache_specs(init_cache(cfg, 1, 1, 1,
                                         cache_bits=cache_bits), axis)
 
-    prefill_fn = jax.jit(jax.shard_map(
+    prefill_fn = jax.jit(jax_compat.shard_map(
         partial(run, pos=0, prefill=True), mesh=mesh,
-        in_specs=(p_specs, P(), c_specs), out_specs=(P(), c_specs),
-        check_vma=False))
+        in_specs=(p_specs, P(), c_specs), out_specs=(P(), c_specs)))
 
     # the bucketed attend window is bound into the shard_map closure per
     # static read_len value — jit re-traces per bucket, same
     # compile-per-discrete-value pattern as the plain path
     @partial(jax.jit, static_argnames=("read_len",))
     def decode_fn(params, data, cache, pos, read_len=None):
-        return jax.shard_map(
+        return jax_compat.shard_map(
             partial(run, prefill=False, read_len=read_len), mesh=mesh,
             in_specs=(p_specs, P(), c_specs, P()),
-            out_specs=(P(), c_specs), check_vma=False)(
+            out_specs=(P(), c_specs))(
                 params, data, cache, pos)
 
     # p_specs is returned so callers place params with the SAME specs the
@@ -725,14 +726,12 @@ def make_ep_stage_fns(family, cfg: TransformerConfig,
     c_specs = {k: P() for k in init_cache(cfg, 1, 1, 1,
                                           cache_bits=cache_bits)}
 
-    prefill_fn = jax.jit(jax.shard_map(
+    prefill_fn = jax.jit(jax_compat.shard_map(
         partial(run, pos=0, prefill=True), mesh=mesh,
-        in_specs=(p_specs, P(), c_specs), out_specs=(P(), c_specs),
-        check_vma=False))
-    decode_fn = jax.jit(jax.shard_map(
+        in_specs=(p_specs, P(), c_specs), out_specs=(P(), c_specs)))
+    decode_fn = jax.jit(jax_compat.shard_map(
         partial(run, prefill=False), mesh=mesh,
-        in_specs=(p_specs, P(), c_specs, P()), out_specs=(P(), c_specs),
-        check_vma=False))
+        in_specs=(p_specs, P(), c_specs, P()), out_specs=(P(), c_specs)))
     return prefill_fn, decode_fn, p_specs
 
 
@@ -806,14 +805,12 @@ def make_tp_ep_stage_fns(family, cfg: TransformerConfig,
     # same head-axis convention _fresh_caches places with (tp_cache_specs)
     c_specs = tp_cache_specs(init_cache(cfg, 1, 1, 1), tp_axis)
 
-    prefill_fn = jax.jit(jax.shard_map(
+    prefill_fn = jax.jit(jax_compat.shard_map(
         partial(run, pos=0, prefill=True), mesh=mesh,
-        in_specs=(p_specs, P(), c_specs), out_specs=(P(), c_specs),
-        check_vma=False))
-    decode_fn = jax.jit(jax.shard_map(
+        in_specs=(p_specs, P(), c_specs), out_specs=(P(), c_specs)))
+    decode_fn = jax.jit(jax_compat.shard_map(
         partial(run, prefill=False), mesh=mesh,
-        in_specs=(p_specs, P(), c_specs, P()), out_specs=(P(), c_specs),
-        check_vma=False))
+        in_specs=(p_specs, P(), c_specs, P()), out_specs=(P(), c_specs)))
     return prefill_fn, decode_fn, p_specs
 
 
@@ -914,10 +911,9 @@ def make_sp_prefill_fn(family, cfg: TransformerConfig,
                           finalize_fn=sp_finalize, embed_fn=sp_embed)
     edge_in = P() if shard_config.is_first else P(None, axis)
     edge_out = P() if shard_config.is_last else P(None, axis)
-    return jax.jit(jax.shard_map(
+    return jax.jit(jax_compat.shard_map(
         partial(run, pos=0, prefill=True), mesh=mesh,
-        in_specs=(P(), edge_in, P()), out_specs=(edge_out, P()),
-        check_vma=False))
+        in_specs=(P(), edge_in, P()), out_specs=(edge_out, P())))
 
 
 def build_decode_pipeline(model_name: str,

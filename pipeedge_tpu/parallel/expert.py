@@ -36,9 +36,11 @@ from typing import Dict
 
 import jax
 import jax.numpy as jnp
+
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..utils import jax_compat
 from ..models.layers import TransformerConfig, gelu
 
 
@@ -169,7 +171,7 @@ def _ep_delta_from_routing(params: Dict, tokens: jax.Array, gate, keep,
     """This device's expert rows of the global routing tables -> local
     deltas (shared core) -> psum combine across `axis`. Used by the
     standalone ep FFN and the expert-parallel decode step."""
-    n = jax.lax.axis_size(axis)
+    n = jax_compat.axis_size(axis)
     idx = jax.lax.axis_index(axis)
     e_local = n_experts // n
     first = idx * e_local
@@ -218,11 +220,10 @@ def make_ep_ffn_fn(cfg: TransformerConfig, mesh: Mesh, n_experts: int,
     def fn(params, x):
         b, s, _ = x.shape
         capacity = moe_capacity(b * s, n_experts, capacity_factor)
-        body = jax.shard_map(
+        body = jax_compat.shard_map(
             partial(_ep_local, n_experts=n_experts, capacity=capacity,
                     axis=axis, act=act),
-            mesh=mesh, in_specs=(param_specs, P()), out_specs=P(),
-            check_vma=False)
+            mesh=mesh, in_specs=(param_specs, P()), out_specs=P())
         return body(params, x)
 
     return jax.jit(fn)

@@ -85,6 +85,14 @@ class PipelineStage:
     quant_bit: int = 0
     clamp: bool = True
     name: str = ""
+    # Donate the (device_put-copied) payload buffers to XLA: the output
+    # reuses the input's allocation instead of growing the arena each
+    # microbatch. Only safe when the caller does not reuse the payload it
+    # passes in — true for interior pipeline edges (each stage's input is
+    # the previous stage's otherwise-unreferenced output), NOT for the
+    # head stage, whose input is caller-owned (e.g. replayed across
+    # --measure-rounds). build_pipeline sets it for stages > 0.
+    donate_payload: bool = False
 
     def __post_init__(self):
         self.params = jax.device_put(self.params, self.device)
@@ -100,7 +108,8 @@ class PipelineStage:
                 out = shard_fn(params, data)
                 return _encode_payload(out, bit, do_clamp)
 
-            fn = jax.jit(step)
+            fn = jax.jit(step, donate_argnums=(
+                (1,) if self.donate_payload else ()))
             self._compiled[bit] = fn
         return fn
 
@@ -250,5 +259,6 @@ def build_pipeline(model_name: str, partition: Sequence[Tuple[int, int]],
         if i == len(partition) - 1:
             bit = 0
         stages.append(PipelineStage(shard_fn=fn, params=params, device=dev,
-                                    quant_bit=bit, name=f"stage{i}"))
+                                    quant_bit=bit, name=f"stage{i}",
+                                    donate_payload=i > 0))
     return HostPipeline(stages, max_inflight=max_inflight)

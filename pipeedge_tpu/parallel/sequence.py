@@ -35,6 +35,8 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from ..utils import jax_compat
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 logger = logging.getLogger(__name__)
@@ -125,7 +127,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
     every window are skipped — never computed, never rotated in.
     """
     _check_window(causal, window)
-    n = jax.lax.axis_size(axis_name)
+    n = jax_compat.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, sq, h, d = q.shape
     scale = 1.0 / (d ** 0.5)
@@ -181,7 +183,7 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     key block intersects someone's window — the window is mask-only.
     """
     _check_window(causal, window)
-    n = jax.lax.axis_size(axis_name)
+    n = jax_compat.axis_size(axis_name)
     b, s_local, h, d = q.shape
     assert h % n == 0, "ulysses requires head count divisible by axis size"
     scale = 1.0 / (d ** 0.5)
@@ -277,10 +279,9 @@ def make_sequence_parallel_attention(mesh: Mesh, axis_name: str = "sp",
 
     @jax.jit
     def fn(q, k, v):
-        return jax.shard_map(
+        return jax_compat.shard_map(
             partial(inner, axis_name=axis_name, causal=causal),
-            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            check_vma=False)(q, k, v)
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
 
     def place(x):
         return jax.device_put(x, NamedSharding(mesh, spec))

@@ -32,6 +32,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from ..utils import jax_compat
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -457,8 +459,8 @@ class SpmdPipeline:
             P(None, dp_spec),
         )
         out_spec = P(None, dp_spec)
-        fn = jax.jit(jax.shard_map(spmd_body, mesh=mesh, in_specs=in_specs,
-                                   out_specs=out_spec, check_vma=False))
+        fn = jax.jit(jax_compat.shard_map(spmd_body, mesh=mesh, in_specs=in_specs,
+                                   out_specs=out_spec))
         return fn
 
 

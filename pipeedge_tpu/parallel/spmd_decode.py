@@ -48,6 +48,8 @@ from typing import Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from ..utils import jax_compat
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -348,10 +350,10 @@ class SpmdDecodePipeline:
                     jax.lax.psum(tokens, "stage"), rngs)
 
         p_spec, c_spec = self._specs()
-        return jax.jit(jax.shard_map(
+        return jax.jit(jax_compat.shard_map(
             prefill_body, mesh=self.mesh,
             in_specs=(p_spec, P(), c_spec, P()),
-            out_specs=(c_spec, P(), P()), check_vma=False))
+            out_specs=(c_spec, P(), P())))
 
     def _build_decode(self, r_slots: int, batch: int, prompt_len: int,
                       new_tokens: int, temperature: float, top_k: int):
@@ -432,10 +434,10 @@ class SpmdDecodePipeline:
             return outputs
 
         p_spec, c_spec = self._specs()
-        return jax.jit(jax.shard_map(
+        return jax.jit(jax_compat.shard_map(
             decode_body, mesh=self.mesh,
             in_specs=(p_spec, P(), c_spec, P()),
-            out_specs=P(), check_vma=False))
+            out_specs=P()))
 
     def _build_span(self, r_slots: int, batch: int, span_k: int,
                     emit: str, temperature: float = 0.0, top_k: int = 0):
@@ -531,10 +533,10 @@ class SpmdDecodePipeline:
                     jax.lax.psum(outputs, "stage"), rngs)
 
         p_spec, c_spec = self._specs()
-        return jax.jit(jax.shard_map(
+        return jax.jit(jax_compat.shard_map(
             span_body, mesh=self.mesh,
             in_specs=(p_spec, P(), c_spec, P(), P()),
-            out_specs=(c_spec, P(), P()), check_vma=False))
+            out_specs=(c_spec, P(), P())))
 
     def _prefix_sig(self) -> Tuple:
         """Cache-compatibility signature for wave prefix handles (the

@@ -17,8 +17,10 @@ from typing import Dict
 
 import jax
 import jax.numpy as jnp
+
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..utils import jax_compat
 from ..models.layers import (TransformerConfig, apply_causal_mask, gelu,
                              layer_norm)
 
@@ -57,7 +59,7 @@ def _tp_block_local(p: Dict, x: jax.Array, cfg: TransformerConfig,
     dense Megatron MLP entirely — how the tp x ep MoE decode plugs the
     ep-sharded routed FFN under the tp-sharded attention
     (decode.make_tp_ep_stage_fns)."""
-    n = jax.lax.axis_size(axis)
+    n = jax_compat.axis_size(axis)
     heads_local = cfg.num_attention_heads // n
     b, s, d = x.shape
     hd = cfg.head_dim
@@ -161,7 +163,7 @@ def _tp_bert_block_local(p: Dict, x: jax.Array, cfg: TransformerConfig,
                          axis: str) -> jax.Array:
     """Per-device BERT block body (post-LN residuals, bert.py sublayer
     semantics 0-3): attention on raw x, LayerNorm AFTER each residual."""
-    n = jax.lax.axis_size(axis)
+    n = jax_compat.axis_size(axis)
     heads_local = cfg.num_attention_heads // n
     b, s, _ = x.shape
     hd = cfg.head_dim
@@ -216,7 +218,7 @@ def _tp_llama_block_local(p: Dict, x: jax.Array, cfg: TransformerConfig,
     from ..models.layers import rms_norm, rope_rotate
     from ..models.llama import _gqa_attend
 
-    n = jax.lax.axis_size(axis)
+    n = jax_compat.axis_size(axis)
     heads_local = cfg.num_attention_heads // n
     kv_local = cfg.kv_heads // n
     b, s, _ = x.shape
@@ -301,7 +303,7 @@ def make_tp_block_fn(cfg: TransformerConfig, mesh: Mesh, axis: str = "tp"):
     the family: ViT/DeiT pre-LN blocks or BERT post-LN blocks."""
     specs, local = family_tp_plan(cfg)
     param_specs = _rename_axis(specs, axis)
-    body = jax.shard_map(partial(local, cfg=cfg, axis=axis),
+    body = jax_compat.shard_map(partial(local, cfg=cfg, axis=axis),
                          mesh=mesh, in_specs=(param_specs, P()),
-                         out_specs=P(), check_vma=False)
+                         out_specs=P())
     return jax.jit(body)

@@ -524,7 +524,8 @@ def run_pipeline_spmd(args, stage_layers, stage_quant, stage_ranks,
 # (pipeedge_tpu/comm/wire.py) so the DCN decode mode shares it; aliased here
 # for the runtime call sites and existing tests.
 from pipeedge_tpu.comm.wire import (wire_decode as _wire_decode,
-                                    wire_encode as _wire_encode)
+                                    wire_encode as _wire_encode,
+                                    wire_encode_device as _wire_encode_device)
 
 
 def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
@@ -695,7 +696,6 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
     """One schedule round on a live DCN fleet: (data rank) broadcast the
     schedule, build this rank's stage if it is in the schedule, stream the
     batch, stop; (worker) build, run until this round's CMD_STOP."""
-    import jax
     import jax.numpy as jnp
 
     from pipeedge_tpu.comm import dcn
@@ -755,28 +755,6 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                 [edge], get_window_size())
             ubatch_idx = [0]
 
-            def work_cb(tensors):
-                if is_first:
-                    payload = jnp.asarray(tensors[0], dtype=dtype
-                                          if tensors[0].dtype.kind == 'f'
-                                          else None)
-                else:
-                    payload = _wire_decode(tensors, dtype)
-                monitoring.iteration_start(MONITORING_KEY_MODEL)
-                out = fn(params, payload)
-                out = jax.block_until_ready(out)
-                n_items = get_microbatch_size(np.asarray(
-                    out[0] if isinstance(out, tuple) else out))
-                monitoring.iteration(MONITORING_KEY_MODEL, work=n_items,
-                                     accuracy=r - l + 1)
-                wire = _wire_encode(
-                    out, edge.quant_bit if edge is not None else 0)
-                if adaptive is not None:
-                    adaptive(ubatch_idx[0],
-                             out[0] if isinstance(out, tuple) else out)
-                    ubatch_idx[0] += 1
-                return wire
-
             # head stage is fed over the wire from the data rank
             # (self-connection over loopback when colocated) on the FEED
             # channel; the last stage's results ride the RESULTS channel.
@@ -785,12 +763,97 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
             # of the adaptive policies' edge telemetry.
             rank_src = stage_ranks[i - 1] if not is_first else data_rank
             rank_dst = stage_ranks[i + 1] if not is_last else data_rank
+
+            # per-edge bitwidth handshake (control channel): ask the
+            # consuming rank what it accepts BEFORE streaming. The frame
+            # header still carries the actual bitwidth; `negotiate`
+            # below also re-caps any bitwidth the adaptive policy later
+            # selects, so the stream never leaves the agreed capability.
+            # On timeout keep the proposal (any consumer in this tree
+            # can decode any supported bitwidth from the header alone).
+            agreed_bits: dict = {0: 0}
+
+            def negotiate(proposed: int, timeout: float = 5.0) -> int:
+                agreed = agreed_bits.get(proposed)
+                if agreed is None:
+                    try:
+                        agreed = ctx.negotiate_edge_bits(rank_dst, proposed,
+                                                         timeout=timeout)
+                        if agreed != proposed:
+                            logger.info("edge rank %d->%d: bitwidth "
+                                        "negotiated %d -> %d", rank,
+                                        rank_dst, proposed, agreed)
+                    except queue.Empty:
+                        logger.warning(
+                            "edge rank %d->%d: bitwidth handshake timed "
+                            "out; keeping bit=%d", rank, rank_dst, proposed)
+                        agreed = proposed
+                    agreed_bits[proposed] = agreed
+                return agreed
+
+            if edge is not None and edge.quant_bit:
+                edge.quant_bit = negotiate(edge.quant_bit,
+                                           timeout=min(30.0,
+                                                       args.sched_timeout))
+
+            # Overlapped work contract (DcnPipelineStage dispatch/readback
+            # split): dispatch decodes the inbound frame ON device, runs
+            # the shard step, and quantizes the output edge ON device
+            # (wire v2) — returning with only async D2H copies of the
+            # packed payload in flight. Readback (the send thread) drains
+            # those copies while THIS thread dispatches the next
+            # microbatch: compute, device->host copy, and socket send
+            # overlap instead of serializing.
+            def dispatch_cb(tensors):
+                if is_first:
+                    payload = jnp.asarray(tensors[0], dtype=dtype
+                                          if tensors[0].dtype.kind == 'f'
+                                          else None)
+                else:
+                    payload = _wire_decode(tensors, dtype)
+                out = fn(params, payload)
+                pending = _wire_encode_device(
+                    out, edge.quant_bit if edge is not None else 0)
+                first = out[0] if isinstance(out, tuple) else out
+                # keep the raw device output alive through the hand-off
+                # queue ONLY when the adaptive policy will read it — at
+                # depth N it would otherwise pin N extra microbatches of
+                # unquantized activations in device memory
+                return (pending, out if adaptive is not None else None,
+                        int(first.shape[0]))
+
+            def readback_cb(item):
+                pending, out, n_items = item
+                wire = pending.finalize()   # completes the async copies
+                # beat-to-beat measurement (no iteration_start: dispatch
+                # runs on another thread): in steady state the interval
+                # between retiring microbatches IS the per-ubatch time.
+                # The round build reset the key's beat baseline, so the
+                # first beat never swallows the inter-round gap.
+                monitoring.iteration(MONITORING_KEY_MODEL, work=n_items,
+                                     accuracy=r - l + 1, safe=False)
+                if adaptive is not None:
+                    adaptive(ubatch_idx[0], out)
+                    ubatch_idx[0] += 1
+                    # re-cap an adaptive move to what the consumer agreed
+                    # to accept (the handshake's promise); answers are
+                    # cached, so steady-state windows cost no extra RTT
+                    if edge.quant_bit:
+                        edge.quant_bit = negotiate(edge.quant_bit)
+                return wire
+
             stage = dcn.DcnPipelineStage(
-                ctx, rank_src, rank_dst, work_cb,
+                ctx, rank_src, rank_dst,
+                dispatch_cb=dispatch_cb, readback_cb=readback_cb,
+                depth=args.stage_depth or None,
                 recv_channel=(dcn.CHANNEL_FEED if is_first
                               else dcn.CHANNEL_DATA) + parity,
                 send_channel=(dcn.CHANNEL_RESULTS if is_last
                               else dcn.CHANNEL_DATA) + parity)
+            # fresh beat baseline per round: the beat-to-beat 'shard'
+            # measurement must not record the inter-round gap (model
+            # build, restore, handshake) as its first iteration
+            monitoring.iteration_reset(MONITORING_KEY_MODEL)
             stage.start()
         else:
             logger.info("rank %d not in schedule; idling", rank)
@@ -976,6 +1039,13 @@ def main():
                              "over N local devices (block-aligned stages): "
                              "pipeline across hosts over DCN, tensor "
                              "parallelism within each host")
+    parser.add_argument("--stage-depth", type=int, default=0,
+                        help="dcn stage pipelining depth: microbatches "
+                             "buffered per hand-off queue, letting the next "
+                             "microbatch's compute overlap the previous "
+                             "one's device->host readback and socket send "
+                             "(0 = env DCN_STAGE_DEPTH or 2; 1 restores the "
+                             "serialized pre-overlap behavior)")
     parser.add_argument("--sched-timeout", type=float, default=300,
                         help="seconds a worker waits for the schedule / "
                              "results / stop (dcn mode)")

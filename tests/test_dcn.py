@@ -66,6 +66,51 @@ def test_frame_roundtrip_edge_shapes():
     a.close(), b.close()
 
 
+@pytest.mark.parametrize("dtype", [ml_dtypes.int4, ml_dtypes.uint4])
+def test_frame_roundtrip_4bit_nibble_packed(dtype):
+    """4-bit dtypes ride the wire nibble-packed (2 values/byte): values,
+    dtype, and shape survive, including odd element counts (pad nibble)
+    and signed two's-complement values."""
+    lo = -8 if dtype == ml_dtypes.int4 else 0
+    a, b = socket.socketpair()
+    tensors = [np.arange(lo, lo + 15, dtype=np.int8).astype(dtype),  # odd n
+               np.arange(lo, lo + 8, dtype=np.int8).astype(dtype).reshape(2, 4),
+               np.asarray(5, np.int8).astype(dtype).reshape(())]      # 0-d
+    dcn._send_frame(a, dcn._MSG_TENSORS, 3, tensors, channel=2)
+    msg_type, aux, channel, out = dcn._recv_frame(b)
+    assert (msg_type, aux, channel) == (dcn._MSG_TENSORS, 3, 2)
+    for sent, got in zip(tensors, out):
+        assert got.dtype == sent.dtype and got.shape == sent.shape
+        np.testing.assert_array_equal(got.astype(np.int8),
+                                      sent.astype(np.int8))
+    a.close(), b.close()
+
+
+def test_frame_4bit_wire_bytes_are_halved():
+    """The nibble packing actually shrinks the on-wire payload: a 4-bit
+    frame's socket bytes are ~half an int8 frame's (the whole point of
+    sub-byte wire dtypes — their in-memory form burns a byte per value)."""
+    n = 64
+    u4 = np.zeros(n, np.int8).astype(ml_dtypes.uint4)
+    i8 = np.zeros(n, np.int8)
+    sizes = {}
+    for name, arr in (("u4", u4), ("i8", i8)):
+        a, b = socket.socketpair()
+        dcn._send_frame(a, dcn._MSG_TENSORS, 0, [arr])
+        a.close()
+        data = bytearray()
+        while True:
+            chunk = b.recv(1 << 16)
+            if not chunk:
+                break
+            data += chunk
+        b.close()
+        sizes[name] = len(data)
+    overhead = dcn._HEADER.size + dcn._TENSOR_HEADER.size + dcn._DIM.size
+    assert sizes["i8"] - overhead == n
+    assert sizes["u4"] - overhead == n // 2
+
+
 def test_frame_rejects_unknown_dtype():
     a, b = socket.socketpair()
     with pytest.raises(TypeError):
@@ -272,6 +317,166 @@ def test_stage_stop_while_blocked():
         assert all(not t.is_alive() for t in stage._threads)
     finally:
         ctxs[0].shutdown()
+
+
+def test_context_int8_uint4_frames_roundtrip():
+    """Quantized wire payloads (int8 values, nibble-packed uint4) survive
+    the full send_tensors/recv_tensors path between two contexts."""
+    ctxs = _make_contexts(2)
+    try:
+        rng = np.random.default_rng(3)
+        i8 = rng.integers(-128, 128, size=(4, 33), dtype=np.int64).astype(np.int8)
+        u4 = rng.integers(0, 16, size=(3, 7)).astype(np.uint8).astype(
+            ml_dtypes.uint4)                      # odd inner dim: pad nibble
+        scale = rng.normal(size=(4,)).astype(np.float32)
+        ctxs[0].send_tensors(1, [i8, u4, scale])
+        got = ctxs[1].recv_tensors(0, timeout=10)
+        np.testing.assert_array_equal(got[0], i8)
+        assert got[1].dtype == u4.dtype
+        np.testing.assert_array_equal(got[1].astype(np.uint8),
+                                      u4.astype(np.uint8))
+        np.testing.assert_array_equal(got[2], scale)
+    finally:
+        for c in ctxs:
+            c.shutdown()
+
+
+# -- edge bitwidth negotiation -----------------------------------------
+
+def test_edge_bit_negotiation_caps_to_receiver():
+    """The control-channel handshake returns what the CONSUMER accepts:
+    the proposal when supported, else the widest supported bitwidth below
+    it, else 0 (uncompressed)."""
+    ports = _free_ports(2)
+    addrs = [("127.0.0.1", p) for p in ports]
+    ctxs = [dcn.DistDcnContext(2, 0, addrs),
+            dcn.DistDcnContext(2, 1, addrs, edge_bits_supported=(0, 4, 8))]
+    for c in ctxs:
+        c.init()
+    try:
+        assert ctxs[0].negotiate_edge_bits(1, 8) == 8     # supported as-is
+        assert ctxs[0].negotiate_edge_bits(1, 16) == 8    # capped down
+        assert ctxs[0].negotiate_edge_bits(1, 6) == 4     # next lower
+        assert ctxs[0].negotiate_edge_bits(1, 2) == 0     # nothing below
+        assert ctxs[1].negotiate_edge_bits(0, 16) == 16   # default set
+        # colocated producer/consumer: the self-loopback edge negotiates too
+        assert ctxs[0].negotiate_edge_bits(0, 8) == 8
+    finally:
+        for c in ctxs:
+            c.shutdown()
+
+
+# -- overlapped stage (dispatch/readback split, depth >= 2) ------------
+
+def test_stage_contract_validation():
+    ctxs = _make_contexts(1)
+    try:
+        with pytest.raises(ValueError, match="not both"):
+            dcn.DcnPipelineStage(ctxs[0], None, None, work_cb=lambda t: t,
+                                 dispatch_cb=lambda t: t)
+        with pytest.raises(ValueError, match="requires dispatch_cb"):
+            dcn.DcnPipelineStage(ctxs[0], None, None,
+                                 readback_cb=lambda t: t)
+        with pytest.raises(ValueError, match="depth"):
+            dcn.DcnPipelineStage(ctxs[0], None, None, work_cb=lambda t: t,
+                                 depth=0)
+        with pytest.raises(ValueError, match="needs a"):
+            dcn.DcnPipelineStage(ctxs[0], 0, 1)   # wired but no callback
+        # idle (not-in-schedule) stage: no ranks, no callback — fine
+        dcn.DcnPipelineStage(ctxs[0], None, None).start()
+    finally:
+        ctxs[0].shutdown()
+
+
+def test_stage_depth2_preserves_fifo_under_jitter():
+    """With depth 2 and the dispatch/readback split, microbatches retire
+    in exactly the order they entered even when per-item phase costs
+    vary (the acceptance-criteria FIFO guarantee for depth >= 2)."""
+    ctxs = _make_contexts(1)
+    rng = np.random.default_rng(0)
+    delays = rng.uniform(0.0, 0.01, size=(16, 2))
+    results = queue.Queue()
+    idx = [0, 0]
+
+    def dispatch(tensors):
+        time.sleep(delays[idx[0] % len(delays)][0])
+        idx[0] += 1
+        return tensors
+
+    def readback(tensors):
+        time.sleep(delays[idx[1] % len(delays)][1])
+        idx[1] += 1
+        return tensors
+
+    stage = dcn.DcnPipelineStage(ctxs[0], None, None, dispatch_cb=dispatch,
+                                 readback_cb=readback, depth=2,
+                                 results_cb=results.put)
+    try:
+        stage.start()
+        n = 16
+        for i in range(n):
+            stage.enqueue_tensors([np.full((2,), i, np.int32)])
+        outs = [results.get(timeout=30) for _ in range(n)]
+    finally:
+        stage.stop()
+        ctxs[0].shutdown()
+    assert [int(o[0][0]) for o in outs] == list(range(n))
+
+
+def test_stage_overlap_beats_serialized_depth1():
+    """Overlapped configuration (dispatch/readback split, depth 2) has
+    lower steady-state microbatch latency than the pre-overlap one
+    (single-phase work_cb, depth 1 — compute and readback serialize on
+    the work thread). Phase costs are fixed sleeps, so serialized costs
+    work+drain per microbatch and perfect overlap costs max(work, drain):
+    generous margins keep this robust on a loaded machine."""
+    ctxs = _make_contexts(1)
+    work_s = drain_s = 0.02
+    n = 12
+
+    def run(depth, split):
+        results = queue.Queue()
+
+        def dispatch(tensors):
+            time.sleep(work_s)
+            return tensors
+
+        def readback(tensors):
+            time.sleep(drain_s)
+            return tensors
+
+        if split:
+            stage = dcn.DcnPipelineStage(
+                ctxs[0], None, None, dispatch_cb=dispatch,
+                readback_cb=readback, depth=depth, results_cb=results.put)
+        else:
+            stage = dcn.DcnPipelineStage(
+                ctxs[0], None, None,
+                work_cb=lambda t: readback(dispatch(t)),
+                depth=depth, results_cb=results.put)
+        stage.start()
+        try:
+            tik = time.monotonic()
+            for i in range(n):
+                stage.enqueue_tensors([np.full((1,), i, np.int32)])
+            outs = [results.get(timeout=60) for _ in range(n)]
+            elapsed = time.monotonic() - tik
+        finally:
+            stage.stop()
+        assert [int(o[0][0]) for o in outs] == list(range(n))
+        return elapsed
+
+    try:
+        serialized = run(depth=1, split=False)
+        overlapped = run(depth=2, split=True)
+    finally:
+        ctxs[0].shutdown()
+    # ideal: 2x (work == drain); require a solid 1.33x so scheduler noise
+    # can't flake the assertion while a regression to serialization
+    # (ratio ~1.0) is still caught
+    assert overlapped < serialized * 0.75, (
+        f"overlap ineffective: serialized {serialized:.3f}s vs "
+        f"overlapped {overlapped:.3f}s")
 
 
 def test_cmd_broadcast_bypasses_backpressured_data_send():

@@ -163,11 +163,12 @@ def test_ulysses_blockwise_no_full_score_materialization():
     from jax.sharding import PartitionSpec as P
 
     from pipeedge_tpu.parallel.sequence import resolve_sp_core
+    from pipeedge_tpu.utils import jax_compat
     spec = P(None, "sp")
     inner = resolve_sp_core("ulysses")
-    f = jax.jit(jax.shard_map(partial(inner, axis_name="sp", causal=True),
-                              mesh=mesh, in_specs=(spec,) * 3,
-                              out_specs=spec, check_vma=False))
+    f = jax.jit(jax_compat.shard_map(
+        partial(inner, axis_name="sp", causal=True),
+        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec))
     x = jnp.zeros((b, s, h, d), jnp.float32)
     mem = f.lower(x, x, x).compile().memory_analysis()
     full_scores_bytes = s * s * 4              # [1, h/n=1, S, S] f32
@@ -175,9 +176,9 @@ def test_ulysses_blockwise_no_full_score_materialization():
         f"temp {mem.temp_size_in_bytes} vs full-score "
         f"{full_scores_bytes} — blockwise regressed to [S,S]?")
     # sanity: ring at the same shape has the same memory scale
-    ring = jax.jit(jax.shard_map(
+    ring = jax.jit(jax_compat.shard_map(
         partial(resolve_sp_core("ring"), axis_name="sp", causal=True),
-        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False))
+        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec))
     ring_mem = ring.lower(x, x, x).compile().memory_analysis()
     assert mem.temp_size_in_bytes < 4 * ring_mem.temp_size_in_bytes
 

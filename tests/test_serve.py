@@ -3,6 +3,7 @@ solo DecodePipeline runs; prefix registration is reused across requests."""
 import json
 import os
 import socket
+import struct
 import subprocess
 import sys
 import time
@@ -413,6 +414,155 @@ def test_streaming_bad_request_still_400(server):
             raise AssertionError(f"expected HTTP 400 for {bad}")
         except urllib.error.HTTPError as exc:
             assert exc.code == 400
+
+
+def _tiny_pipe(partition=None, max_len=64):
+    from pipeedge_tpu.models import registry
+    from pipeedge_tpu.parallel import decode
+    total = registry.get_model_layers(MODEL)
+    partition = partition or [(1, total)]
+    params = []
+    for i, (l, r) in enumerate(partition):
+        _, p, _ = registry.module_shard_factory(MODEL, None, l, r, stage=i,
+                                                unroll=False)
+        params.append(p)
+    return decode.DecodePipeline(
+        registry.get_model_entry(MODEL).family.FAMILY,
+        registry.get_model_config(MODEL), partition, params, max_len=max_len)
+
+
+def test_stage_executor_stop_wakes_blocked_submitter():
+    """stop() must over-release the admission semaphore like _die() does:
+    a submitter blocked in _slots.acquire() (pipeline full) wakes and
+    raises instead of hanging forever (ADVICE.md r5)."""
+    import threading
+
+    import jax.numpy as jnp
+
+    from pipeedge_tpu.parallel.batcher import StageWorkerExecutor
+
+    ex = StageWorkerExecutor(_tiny_pipe(), max_active=1)
+    errs = {}
+
+    def client(rid, tokens):
+        try:
+            ex.submit(rid, jnp.zeros((1, 4), jnp.int32), tokens)
+            ex.wait(rid, timeout=120)
+        except RuntimeError as exc:
+            errs[rid] = str(exc)
+
+    # "a" holds the only admission slot with a long generation
+    t_a = threading.Thread(target=client, args=("a", 40), daemon=True)
+    t_a.start()
+    time.sleep(0.5)             # let "a" admit and enter the pipeline
+    # "b" blocks in _slots.acquire (admission backpressure)
+    t_b = threading.Thread(target=client, args=("b", 2), daemon=True)
+    t_b.start()
+    time.sleep(0.5)
+    ex.stop()
+    t_a.join(timeout=120)
+    t_b.join(timeout=120)
+    assert not t_a.is_alive() and not t_b.is_alive(), \
+        "stop() left a submitter/waiter hanging"
+    assert "in flight" in errs.get("a", "")
+    # "b" raises either from the admission wake or from wait()
+    assert "b" in errs
+
+
+@pytest.mark.parametrize("executor", ["wave", "stage"])
+def test_cancel_flag_completes_request_early(executor):
+    """A set `cancel` flag finishes the request at its next pick with the
+    tokens decoded so far, freeing executor capacity for live requests
+    (the serve.py streaming-disconnect contract)."""
+    import threading
+
+    import jax.numpy as jnp
+
+    from pipeedge_tpu.parallel.batcher import (ContinuousBatcher,
+                                               StageWorkerExecutor)
+
+    pipe = _tiny_pipe()
+    cancel = threading.Event()
+    stop_after = 3
+    seen = []
+
+    def on_token(step, tok):
+        seen.append(step)
+        if step + 1 >= stop_after:
+            cancel.set()
+
+    ids = jnp.zeros((1, 4), jnp.int32)
+    if executor == "stage":
+        ex = StageWorkerExecutor(pipe, max_active=1)
+        try:
+            ex.submit("r", ids, 40, on_token=on_token, cancel=cancel)
+            out = ex.wait("r", timeout=120)
+        finally:
+            ex.stop()
+    else:
+        batcher = ContinuousBatcher(pipe, max_active=1)
+        batcher.submit("r", ids, 40, on_token=on_token, cancel=cancel)
+        out = batcher.run()["r"]
+    # prompt (4) + the tokens decoded before the cancel took effect —
+    # far short of the 40-token cap
+    assert out.shape[1] == 4 + stop_after
+    assert len(seen) == stop_after
+
+
+@pytest.fixture(scope="module")
+def tight_server():
+    """Stage executor with a SINGLE admission slot: a dead request that
+    failed to free its slot would block every later request."""
+    yield from _spawn_server(("--executor", "stage", "--max-active", "1"))
+
+
+def test_streaming_disconnect_cancels_generation(tight_server):
+    """A streaming client that disconnects mid-response must not keep
+    decoding to the cap on a dead socket: the handler's write failure
+    sets the request's cancel flag, the executor completes it early, and
+    the admission slot frees (ADVICE.md r5). Verified via the server's
+    cumulative token counter: the aborted 40-token request generates only
+    a handful of tokens."""
+    port = tight_server
+
+    def healthz():
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=30) as resp:
+            return json.loads(resp.read())["stats"]
+
+    tokens_before = healthz()["tokens"]
+    new_tokens = 40
+    body = json.dumps({"ids": [[1, 2, 3]], "new_tokens": new_tokens,
+                       "stream": True}).encode()
+    with socket.create_connection(("127.0.0.1", port), timeout=60) as sock:
+        sock.sendall(
+            b"POST /generate HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: " + str(len(body)).encode() + b"\r\n\r\n"
+            + body)
+        # read until two step lines arrived (the stream is live), then
+        # vanish with an RST so the server's next chunk write fails fast
+        buf = b""
+        deadline = time.monotonic() + 120
+        while buf.count(b'"step"') < 2:
+            assert time.monotonic() < deadline, f"no stream lines: {buf!r}"
+            chunk = sock.recv(4096)
+            assert chunk, f"server closed early: {buf!r}"
+            buf += chunk
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                        struct.pack("ii", 1, 0))
+    # the executor must finish the cancelled request and free its slot
+    deadline = time.monotonic() + 120
+    while healthz()["active"] > 0:
+        assert time.monotonic() < deadline, \
+            "cancelled request still holds its executor slot"
+        time.sleep(0.1)
+    generated = healthz()["tokens"] - tokens_before
+    assert generated < new_tokens, (
+        f"disconnected request decoded all {generated} tokens to the cap")
+    # ... and the freed slot serves new requests normally
+    out = _post(port, "/generate", {"ids": [[1, 2, 3]], "new_tokens": 2})
+    assert len(out["ids"][0]) == 5
 
 
 def test_stage_executor_stop_fails_live_waiters():

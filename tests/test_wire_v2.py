@@ -1,0 +1,135 @@
+"""Wire protocol v2 (device-encoded quantized DCN edges): header format,
+round-trip numerics, byte accounting, and v1 compatibility.
+
+The v2 frame is [int32 header vector (magic, version, bit, flags, n)] +
+per payload tensor [packed, scale, shift, shape]; encoding runs on the
+producing device with async D2H readback (`wire_encode_device` ->
+`PendingWire.finalize`), decoding on the receiving device. These tests pin
+the acceptance criteria: >= 4x activation wire-byte reduction at int8 vs
+fp32, a bounded dequantization error, and the bitwidth traveling ON the
+wire (no consumer coordination when adaptive policies move it)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pipeedge_tpu.comm import wire
+from pipeedge_tpu.ops import quant as quant_ops
+
+
+def _ubatch(shape=(4, 32, 16), seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=shape).astype(np.float32)
+
+
+@pytest.mark.parametrize("bit", [8, 4, 16])
+def test_v2_roundtrip_bounds_dequant_error(bit):
+    """encode_device -> finalize -> decode recovers the activation to
+    within one quantization step per item (q = round(x01 * (2^b - 1)),
+    so |err| <= 0.5 * range / (2^b - 1) plus f32 rounding)."""
+    x = _ubatch()
+    parts = wire.wire_encode_device(jnp.asarray(x), bit).finalize()
+    assert all(isinstance(p, np.ndarray) for p in parts)
+    dec = np.asarray(wire.wire_decode(parts, jnp.float32))
+    per_item_range = (x.reshape(len(x), -1).max(1)
+                      - x.reshape(len(x), -1).min(1))
+    bound = 0.5 * per_item_range / ((1 << bit) - 1) + 1e-5
+    err = np.abs(dec - x).reshape(len(x), -1).max(1)
+    assert (err <= bound).all(), (err, bound)
+
+
+def test_v2_fp32_passthrough_exact():
+    x = _ubatch()
+    parts = wire.wire_encode_device(jnp.asarray(x), 0).finalize()
+    np.testing.assert_array_equal(
+        np.asarray(wire.wire_decode(parts, jnp.float32)), x)
+
+
+def test_v2_tuple_payload():
+    pair = (jnp.asarray(_ubatch(seed=1)), jnp.asarray(_ubatch(seed=2)) * 3)
+    parts = wire.wire_encode_device(pair, 8).finalize()
+    dec = wire.wire_decode(parts, jnp.float32)
+    assert isinstance(dec, tuple) and len(dec) == 2
+    for d, orig in zip(dec, pair):
+        assert np.abs(np.asarray(d) - np.asarray(orig)).max() < 0.2
+
+
+def test_v2_bitwidth_travels_on_wire():
+    """The consumer never needs to be told the bitwidth: frames encoded at
+    different bits (an adaptive policy moving mid-run) decode back to back
+    from their headers alone."""
+    x = _ubatch()
+    for bit in (0, 8, 4, 2):
+        parts = wire.wire_encode_device(jnp.asarray(x), bit).finalize()
+        header = parts[0]
+        assert header.ndim == 1 and int(header[0]) == wire.WIRE_V2_MAGIC
+        assert int(header[2]) == bit
+        dec = np.asarray(wire.wire_decode(parts, jnp.float32))
+        assert dec.shape == x.shape
+
+
+def test_v2_int8_payload_reduction_at_least_4x():
+    """THE acceptance counter: the bytes replacing the raw fp32
+    activations on the wire shrink >= 4x at int8 (exactly 32/bit when the
+    per-item element count packs evenly), >= 8x at 4-bit; total frame
+    bytes (payload + O(ubatch) scale/shift/shape metadata) are within 1%
+    of the same ratio."""
+    x = jnp.asarray(_ubatch(shape=(8, 197, 1024)))   # ViT-Large edge shape
+    fp32 = wire.wire_encode_device(x, 0).finalize()
+    p_fp32 = wire.frame_payload_bytes(fp32)
+    t_fp32 = wire.frame_wire_bytes(fp32)
+    for bit, factor in ((8, 4.0), (4, 8.0)):
+        q = wire.wire_encode_device(x, bit).finalize()
+        assert p_fp32 / wire.frame_payload_bytes(q) >= factor
+        assert t_fp32 / wire.frame_wire_bytes(q) >= factor * 0.99
+
+
+def test_v2_packing_bit_identical_to_v1():
+    """v1 (host XLA encode) and v2 (device encode) produce the same packed
+    words/scale/shift for the same input — any producer generation pairs
+    with any consumer generation."""
+    x = _ubatch()
+    import os
+    env = os.environ.get("PIPEEDGE_NATIVE_QUANT")
+    os.environ["PIPEEDGE_NATIVE_QUANT"] = "0"   # force v1 through the XLA ops
+    try:
+        v1 = wire.wire_encode(jnp.asarray(x), 8)
+    finally:
+        if env is None:
+            os.environ.pop("PIPEEDGE_NATIVE_QUANT")
+        else:
+            os.environ["PIPEEDGE_NATIVE_QUANT"] = env
+    v2 = wire.wire_encode_device(jnp.asarray(x), 8).finalize()
+    # v1: [bit, packed, scale, shift, shape]; v2: [header, packed, scale,
+    # shift, shape]
+    for a, b in zip(v1[1:], v2[1:]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_v1_frames_still_decode():
+    """wire_decode keeps accepting v1 frames (scalar bitwidth header) —
+    mixed producer generations decode through one consumer path."""
+    x = _ubatch()
+    dec = np.asarray(wire.wire_decode(wire.wire_encode(x, 8), jnp.float32))
+    assert np.abs(dec - x).max() < 0.1
+    dec0 = np.asarray(wire.wire_decode(wire.wire_encode(x, 0), jnp.float32))
+    np.testing.assert_array_equal(dec0, x)
+
+
+def test_v2_malformed_frame_raises():
+    x = jnp.asarray(_ubatch())
+    parts = wire.wire_encode_device(x, 8).finalize()
+    with pytest.raises(ValueError, match="malformed"):
+        wire.wire_decode(parts[:-1], jnp.float32)   # dropped shape tensor
+
+
+def test_v2_decode_matches_ops_decode():
+    """The v2 consumer path is exactly ops/quant decode: reconstructing the
+    QuantizedTensor from the wire quads and decoding equals decoding the
+    original encoder output directly."""
+    x = jnp.asarray(_ubatch())
+    enc = quant_ops.tensor_encode_outerdim(x, 8)
+    direct = np.asarray(quant_ops.tensor_decode_outerdim(enc))
+    parts = wire.wire_encode_device(x, 8).finalize()
+    via_wire = np.asarray(wire.wire_decode(parts, jnp.float32))
+    np.testing.assert_allclose(via_wire, direct, rtol=1e-6, atol=1e-6)

@@ -1,0 +1,186 @@
+"""DCN edge microbenchmark: wire-byte compression and overlap efficiency.
+
+Measures the two claims of the overlapped int8 wire path (the DCN data-path
+rebuild) so they are recorded, not asserted:
+
+1. **Wire bytes per microbatch** — a ViT-Large-shaped activation
+   (ubatch 8 x 197 x 1024, fp32) encoded as a v2 wire frame at bit 0 / 8 /
+   4: activation-payload bytes (what replaces the raw fp32 tensors on the
+   socket; exactly 32/bit smaller) and total frame bytes (payload + the
+   O(ubatch) scale/shift/shape metadata — the number the transport monitor
+   hooks see). Also pushes both frame kinds through a real loopback
+   `DistDcnContext` edge and reports edge bytes/sec and frames/sec, so the
+   byte reduction is visible as wall-clock transfer gain.
+
+2. **Overlap efficiency** — steady-state microbatch latency of a loopback
+   `DcnPipelineStage` in the pre-overlap configuration (single-phase
+   `work_cb`, queue depth 1: compute, device->host readback and send
+   serialize) vs the overlapped configuration (dispatch/readback split,
+   depth 2: readback drains on the send thread while the next microbatch's
+   compute dispatches). Phase costs are modeled with fixed sleeps
+   (dispatch ~= readback), so the ideal speedup is ~2x and the measured
+   number is the threading machinery's real overlap efficiency; the
+   depth-1 split variant is reported alongside to separate the split's
+   contribution from the buffering's.
+
+CPU-safe (JAX_PLATFORMS=cpu) — nothing here needs a TPU. Prints ONE JSON
+line, BENCH-record style.
+
+Usage: JAX_PLATFORMS=cpu python tools/bench_dcn_edge.py
+"""
+import json
+import os
+import queue
+import socket
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+UBATCH_SHAPE = (8, 197, 1024)   # ViT-Large hidden-state microbatch (b=8)
+N_FRAMES = 8                    # loopback transfer reps per frame kind
+N_UBATCH = 24                   # stage-overlap stream length
+WORK_MS = 20.0                  # modeled dispatch (compute) cost
+DRAIN_MS = 20.0                 # modeled readback (D2H + encode) cost
+
+
+def _free_port() -> int:
+    with socket.create_server(("127.0.0.1", 0)) as s:
+        return s.getsockname()[1]
+
+
+def bench_wire_bytes():
+    """Frame sizes + loopback transfer rate for fp32 vs int8 vs 4-bit."""
+    import jax.numpy as jnp
+
+    from pipeedge_tpu.comm import dcn, wire
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=UBATCH_SHAPE).astype(np.float32))
+    frames = {}
+    for bit in (0, 8, 4):
+        parts = wire.wire_encode_device(x, bit).finalize()
+        frames[bit] = {
+            "parts": parts,
+            "payload_bytes": wire.frame_payload_bytes(parts),
+            "total_bytes": wire.frame_wire_bytes(parts),
+        }
+
+    # real loopback edge: bytes/sec at each bitwidth
+    ctx = dcn.DistDcnContext(1, 0, [("127.0.0.1", _free_port())])
+    ctx.init()
+    rates = {}
+    try:
+        for bit, f in frames.items():
+            ctx.send_tensors(0, f["parts"])     # warm the self-connection
+            ctx.recv_tensors(0, timeout=30)
+
+            def feed(parts=f["parts"]):         # stream, don't ping-pong:
+                for _ in range(N_FRAMES):       # throughput, not latency
+                    ctx.send_tensors(0, parts)
+
+            import threading
+            feeder = threading.Thread(target=feed, daemon=True)
+            tik = time.monotonic()
+            feeder.start()
+            got = 0
+            for _ in range(N_FRAMES):
+                got += wire.frame_wire_bytes(ctx.recv_tensors(0, timeout=30))
+            dt = time.monotonic() - tik
+            feeder.join()
+            rates[bit] = {"mbytes_per_sec": round(got / dt / 1e6, 1),
+                          "frames_per_sec": round(N_FRAMES / dt, 1)}
+    finally:
+        ctx.shutdown()
+
+    fp32 = frames[0]
+    out = {"fp32_payload_bytes_per_ubatch": fp32["payload_bytes"],
+           "fp32_total_bytes_per_ubatch": fp32["total_bytes"]}
+    for bit in (8, 4):
+        f = frames[bit]
+        out[f"int{bit}_payload_bytes_per_ubatch"] = f["payload_bytes"]
+        out[f"int{bit}_total_bytes_per_ubatch"] = f["total_bytes"]
+        out[f"int{bit}_payload_reduction"] = round(
+            fp32["payload_bytes"] / f["payload_bytes"], 3)
+        out[f"int{bit}_total_reduction"] = round(
+            fp32["total_bytes"] / f["total_bytes"], 3)
+    out["loopback_edge"] = {f"bit{b}": r for b, r in rates.items()}
+    return out
+
+
+def bench_overlap():
+    """Steady-state ubatch latency: serialized (pre-overlap) vs overlapped."""
+    from pipeedge_tpu.comm import dcn
+
+    ctx = dcn.DistDcnContext(1, 0, [("127.0.0.1", _free_port())])
+    ctx.init()
+
+    def run(depth, split):
+        results = queue.Queue()
+
+        def dispatch(ts):
+            time.sleep(WORK_MS / 1e3)
+            return ts
+
+        def readback(ts):
+            time.sleep(DRAIN_MS / 1e3)
+            return ts
+
+        if split:
+            stage = dcn.DcnPipelineStage(
+                ctx, None, None, dispatch_cb=dispatch, readback_cb=readback,
+                depth=depth, results_cb=results.put)
+        else:       # the pre-overlap contract: both phases on one thread
+            stage = dcn.DcnPipelineStage(
+                ctx, None, None, work_cb=lambda ts: readback(dispatch(ts)),
+                depth=depth, results_cb=results.put)
+        stage.start()
+        try:
+            tik = time.monotonic()
+            for i in range(N_UBATCH):
+                stage.enqueue_tensors([np.full((1,), i, np.int32)])
+            outs = [results.get(timeout=120) for _ in range(N_UBATCH)]
+            dt = time.monotonic() - tik
+        finally:
+            stage.stop()
+        assert [int(o[0][0]) for o in outs] == list(range(N_UBATCH)), \
+            "FIFO order violated"
+        return dt / N_UBATCH * 1e3
+
+    try:
+        serialized = run(depth=1, split=False)
+        split_d1 = run(depth=1, split=True)
+        overlapped = run(depth=2, split=True)
+    finally:
+        ctx.shutdown()
+    return {
+        "modeled_work_ms": WORK_MS,
+        "modeled_drain_ms": DRAIN_MS,
+        "depth1_serialized_ubatch_ms": round(serialized, 2),
+        "depth1_split_ubatch_ms": round(split_d1, 2),
+        "depth2_overlapped_ubatch_ms": round(overlapped, 2),
+        # serialized costs work+drain per ubatch; perfect overlap costs
+        # max(work, drain) — efficiency 1.0 means the full phase overlap
+        # was realized by the dispatch/readback split + depth-2 buffering
+        "overlap_speedup": round(serialized / overlapped, 3),
+        "overlap_efficiency": round(
+            (serialized - overlapped) /
+            (serialized - max(WORK_MS, DRAIN_MS)), 3),
+    }
+
+
+def main():
+    record = {"metric": "dcn_edge_wire_and_overlap",
+              "ubatch_shape": list(UBATCH_SHAPE)}
+    record.update(bench_wire_bytes())
+    record["overlap"] = bench_overlap()
+    # headline: the two acceptance numbers
+    record["value"] = record["int8_payload_reduction"]
+    record["unit"] = "x fewer activation wire bytes at int8 vs fp32"
+    print(json.dumps(record))
+
+
+if __name__ == "__main__":
+    main()

@@ -272,13 +272,22 @@ def make_handler(service, model_name):
             token lands, then the authoritative final line. The worker
             pushes DEVICE token arrays into a queue; the readback (the
             blocking part) happens here in the handler thread, so
-            streaming never stalls the executor."""
+            streaming never stalls the executor.
+
+            A client that disconnects mid-stream (write fails) sets the
+            request's `cancel` flag: the executor completes the request
+            at its next pick instead of decoding to the cap, so dead
+            requests free their admission slot / cache memory early
+            (repeated disconnects could otherwise occupy every
+            max_active slot with vanished clients)."""
             import numpy as np
             t0 = time.monotonic()
             # validate BEFORE headers commit: bad requests still 400
             # (raises into do_POST's error mapping); after this point
             # failures surface as a terminal {"error": ...} stream line
             kw = service.prevalidate(ids, new_tokens, kw)
+            cancel = threading.Event()
+            kw["cancel"] = cancel
             q = queue_mod.Queue()
             worker = threading.Thread(
                 target=self._run_generate,
@@ -292,13 +301,16 @@ def make_handler(service, model_name):
             first_ms = None
             while True:
                 kind, payload = q.get()
-                if kind == "error":
-                    self._chunk({"error": str(payload)})
-                    break
-                if kind == "result":
-                    self._chunk({"ids": payload.tolist(),
-                                 "first_token_ms": first_ms,
-                                 "steps": steps})
+                if kind in ("error", "result"):
+                    final = ({"error": str(payload)} if kind == "error"
+                             else {"ids": payload.tolist(),
+                                   "first_token_ms": first_ms,
+                                   "steps": steps})
+                    if not cancel.is_set():
+                        try:
+                            self._chunk(final)
+                        except OSError:
+                            cancel.set()
                     break
                 step, token = payload
                 # the blocking device readback happens HERE, in the
@@ -307,10 +319,22 @@ def make_handler(service, model_name):
                 tok = np.asarray(token).tolist()
                 if first_ms is None:
                     first_ms = round((time.monotonic() - t0) * 1e3, 3)
-                self._chunk({"step": step, "tokens": tok})
+                if not cancel.is_set():
+                    try:
+                        self._chunk({"step": step, "tokens": tok})
+                    except OSError:
+                        # client went away: cancel the generation but keep
+                        # draining the queue until the worker's terminal
+                        # result/error (it completes early at its next
+                        # pick, releasing the executor slot)
+                        cancel.set()
                 steps += 1
-            self.wfile.write(b"0\r\n\r\n")
-            self.wfile.flush()
+            if not cancel.is_set():
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                    self.wfile.flush()
+                except OSError:
+                    pass    # disconnect after the final line: nothing owed
 
         def _run_generate(self, ids, new_tokens, kw, q):
             try:
