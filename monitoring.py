@@ -1,22 +1,32 @@
-"""Global monitoring utilities (facade over `pipeedge_tpu.monitoring`).
+"""Process-wide monitoring (facade over `pipeedge_tpu.monitoring`).
 
-Parity with /root/reference/monitoring.py: a module-global `MonitorContext`
-behind an RWLock, per-thread iteration contexts keyed by thread ident (so
-concurrent threads can measure the same key), per-key CSV files named
-`<key>.csv` with mode from env `CSV_FILE_MODE`, instant metrics logged every
-iteration and window metrics at each window boundary.
+The application-facing surface the reference facade defines — one shared
+`MonitorContext` per process, keys addable at runtime, iterations that may
+start and finish on different calls or different threads — with the state
+held by a single `_Session` object instead of a spread of module globals.
+Module-level functions stay the API (every runtime/CLI imports them); they
+delegate to the live session under a readers-writer lock, and every call is
+a safe no-op when no session is open, so late worker threads can keep
+reporting through a teardown.
+
+Crash posture: `flush()` pushes all CSV logs to disk (called by the DCN
+runtime on fleet abort and failover, before anything else happens), and
+`finish()` is registered atexit so an exception exit still closes the logs
+— post-mortem records survive the crash they are needed for.
 """
 from contextlib import contextmanager
+import atexit
 import logging
 import os
 import threading
-from typing import Union
+from typing import Optional, Union
 
 from pipeedge_tpu.monitoring import MonitorContext, MonitorIterationContext
 from pipeedge_tpu.utils.threads import RWLock
 
 ENV_CSV_FILE_MODE: str = "CSV_FILE_MODE"
-_CSV_FILE_MODE = 'w'  # NOTE: will overwrite existing files!
+_DEFAULT_CSV_MODE = 'w'  # fresh logs each run; CSV_FILE_MODE=x refuses to
+# clobber an existing file, =a appends across runs
 
 PRINT_FIELDS_INSTANT = True
 PRINT_FIELDS_WINDOW = True
@@ -24,112 +34,131 @@ PRINT_FIELDS_GLOBAL = True
 
 logger = logging.getLogger(__name__)
 
-_monitor_ctx = None  # pylint: disable=invalid-name
-_monitor_ctx_lock = RWLock()
+# metric name -> (context getter suffix, unit template); '{work}'/'{acc}'
+# expand to the key's registered display units
+_SCOPE_METRICS = (
+    ("Time", "time_s", "sec"),
+    ("Rate", "heartrate", "microbatches/sec"),
+    ("Work", "work", "{work}"),
+    ("Perf", "perf", "{work}/sec"),
+    ("Energy", "energy_j", "Joules"),
+    ("Power", "power_w", "Watts"),
+    ("Acc", "accuracy", "{acc}"),
+    ("Acc Rate", "accuracy_rate", "{acc}/sec"),
+)
 
-# key: thread ident, value: dict (key: key, value: MonitorIterationContext)
-_thr_ctx = {}
-# per-key locks, only for reporting iterations
-_locks = {}
-# user-friendly field names
-_work_types = {}
-_acc_types = {}
+
+class _Session:
+    """Everything one init()..finish() span owns: the shared context, the
+    per-key report locks and display units, and the in-flight iteration
+    contexts of every (thread, key) pair."""
+
+    def __init__(self, ctx: MonitorContext):
+        self.ctx = ctx
+        self.key_locks = {}
+        self.units = {}      # key -> (work unit, acc unit)
+        self.inflight = {}   # (thread ident, key) -> MonitorIterationContext
+
+    def register(self, key: str, work_type: str, acc_type: str) -> None:
+        self.key_locks[key] = threading.Lock()
+        self.units[key] = (work_type, acc_type)
+
+    def begin(self, key: str) -> MonitorIterationContext:
+        slot = (threading.get_ident(), key)
+        if slot in self.inflight:
+            raise KeyError(f"{key}: this thread already has an open "
+                           "iteration")
+        ictx = MonitorIterationContext()
+        self.inflight[slot] = ictx
+        return ictx
+
+    def take(self, key: str) -> MonitorIterationContext:
+        slot = (threading.get_ident(), key)
+        try:
+            return self.inflight.pop(slot)
+        except KeyError:
+            raise KeyError(f"{key}: no open iteration on this thread") \
+                from None
+
+    def log_scope(self, key: str, scope: str) -> None:
+        work_u, acc_u = self.units[key]
+        title = scope.capitalize()
+        for name, getter, unit in _SCOPE_METRICS:
+            value = getattr(self.ctx, f"get_{scope}_{getter}")(key=key)
+            unit = unit.format(work=work_u, acc=acc_u)
+            logger.info("%s: %s %s: %s %s", key, title, name, value, unit)
 
 
-def _log_scope(key, scope):
-    ctx = _monitor_ctx
-    get = lambda metric: getattr(ctx, f"get_{scope}_{metric}")(key=key)  # noqa: E731
-    name = scope.capitalize()
-    logger.info("%s: %s Time:     %s sec", key, name, get("time_s"))
-    logger.info("%s: %s Rate:     %s microbatches/sec", key, name, get("heartrate"))
-    logger.info("%s: %s Work:     %s %s", key, name, get("work"), _work_types[key])
-    logger.info("%s: %s Perf:     %s %s/sec", key, name, get("perf"), _work_types[key])
-    logger.info("%s: %s Energy:   %s Joules", key, name, get("energy_j"))
-    logger.info("%s: %s Power:    %s Watts", key, name, get("power_w"))
-    logger.info("%s: %s Acc:      %s %s", key, name, get("accuracy"), _acc_types[key])
-    logger.info("%s: %s Acc Rate: %s %s/sec", key, name, get("accuracy_rate"),
-                _acc_types[key])
+_session: Optional[_Session] = None
+_session_lock = RWLock()
 
 
 def init(key: str, window_size: int, work_type: str = 'items',
          acc_type: str = 'acc') -> None:
-    """Create the global monitoring context."""
-    global _monitor_ctx  # pylint: disable=global-statement
-    log_name = key + '.csv'
-    log_mode = os.getenv(ENV_CSV_FILE_MODE, _CSV_FILE_MODE)
+    """Open the process-wide monitoring session with its first key."""
+    global _session  # pylint: disable=global-statement
     from pipeedge_tpu.monitoring.energy import default_energy_source
-    with _monitor_ctx_lock.lock_write():
-        _monitor_ctx = MonitorContext(key=key, window_size=window_size,
-                                      log_name=log_name, log_mode=log_mode,
-                                      energy_source=default_energy_source())
-        logger.info("Monitoring energy source: %s", _monitor_ctx.energy_source)
-        _monitor_ctx.open()
-        _locks[key] = threading.Lock()
-        _work_types[key] = work_type
-        _acc_types[key] = acc_type
+    mode = os.getenv(ENV_CSV_FILE_MODE, _DEFAULT_CSV_MODE)
+    with _session_lock.lock_write():
+        ctx = MonitorContext(key=key, window_size=window_size,
+                             log_name=f"{key}.csv", log_mode=mode,
+                             energy_source=default_energy_source())
+        logger.info("Monitoring energy source: %s", ctx.energy_source)
+        ctx.open()
+        _session = _Session(ctx)
+        _session.register(key, work_type, acc_type)
 
 
 def finish() -> None:
-    """Log global stats and destroy the monitoring context."""
-    global _monitor_ctx  # pylint: disable=global-statement
-    with _monitor_ctx_lock.lock_write():
-        if _monitor_ctx is None:
+    """Log global stats, close the CSV logs, end the session."""
+    global _session  # pylint: disable=global-statement
+    with _session_lock.lock_write():
+        if _session is None:
             return
         if PRINT_FIELDS_GLOBAL:
-            for key in _monitor_ctx.keys():
-                _log_scope(key, "global")
-        _monitor_ctx.close()
-        _monitor_ctx = None
-        _thr_ctx.clear()
-        _locks.clear()
-        _work_types.clear()
-        _acc_types.clear()
+            for key in _session.ctx.keys():
+                _session.log_scope(key, "global")
+        _session.ctx.close()
+        _session = None
+
+
+def flush() -> None:
+    """Force every key's buffered CSV rows to disk. The fleet-abort /
+    failover hook: whatever happens next, the beat records up to this
+    moment are on disk for the post-mortem."""
+    with _session_lock.lock_read():
+        if _session is not None:
+            _session.ctx.flush()
 
 
 def add_key(key: str, work_type: str = 'items', acc_type: str = 'acc') -> None:
-    """Add a new monitored key."""
-    with _monitor_ctx_lock.lock_write():
-        if _monitor_ctx is None:
+    """Register another monitored key on the open session."""
+    with _session_lock.lock_write():
+        if _session is None:
             return
-        _monitor_ctx.add_heartbeat(key=key, log_name=key + '.csv')
-        _locks[key] = threading.Lock()
-        _work_types[key] = work_type
-        _acc_types[key] = acc_type
+        _session.ctx.add_heartbeat(key=key, log_name=f"{key}.csv")
+        _session.register(key, work_type, acc_type)
 
 
 @contextmanager
 def get_locked_context(key: str):
-    """Yields the `MonitorContext` with a lock on `key` (use to synchronize
-    retrieving metrics)."""
-    with _monitor_ctx_lock.lock_read():
-        with _locks[key]:
-            yield _monitor_ctx
-
-
-def _iter_ctx_push(key):
-    ident = threading.get_ident()
-    keymap = _thr_ctx.setdefault(ident, {})
-    if key in keymap:
-        raise KeyError(f"Thread iteration context already exists for key: {key}")
-    keymap[key] = MonitorIterationContext()
-    return keymap[key]
-
-
-def _iter_ctx_pop(key):
-    ident = threading.get_ident()
-    iter_ctx = _thr_ctx[ident].pop(key)
-    if len(_thr_ctx[ident]) == 0:
-        del _thr_ctx[ident]
-    return iter_ctx
+    """Yield the session's `MonitorContext` with `key`'s report lock held
+    (synchronized metric reads); yields None when no session is open."""
+    with _session_lock.lock_read():
+        if _session is None or key not in _session.key_locks:
+            yield None
+            return
+        with _session.key_locks[key]:
+            yield _session.ctx
 
 
 def iteration_start(key: str) -> None:
-    """Start an iteration."""
-    with _monitor_ctx_lock.lock_read():
-        if _monitor_ctx is None:
+    """Open an iteration for this thread on `key`."""
+    with _session_lock.lock_read():
+        if _session is None:
             return
-        with _locks[key]:
-            _monitor_ctx.iteration_start(iter_ctx=_iter_ctx_push(key))
+        with _session.key_locks[key]:
+            _session.ctx.iteration_start(iter_ctx=_session.begin(key))
 
 
 def iteration_reset(key: str) -> None:
@@ -137,47 +166,51 @@ def iteration_reset(key: str) -> None:
     `iteration(..., safe=False)` stamps a fresh baseline instead of
     recording the idle gap since the previous beat (e.g. a DCN
     re-schedule round boundary) as one giant iteration."""
-    with _monitor_ctx_lock.lock_read():
-        if _monitor_ctx is None:
+    with _session_lock.lock_read():
+        if _session is None:
             return
-        with _locks[key]:
-            _monitor_ctx.iteration_reset(key=key)
+        with _session.key_locks[key]:
+            _session.ctx.iteration_reset(key=key)
 
 
 def iteration_abort(key: str) -> None:
-    """Discard a started iteration without emitting a heartbeat (e.g. a
-    transfer that failed mid-way); no-op if none was started."""
-    with _monitor_ctx_lock.lock_read():
-        if _monitor_ctx is None:
+    """Discard this thread's open iteration without emitting a heartbeat
+    (e.g. a transfer that failed mid-way); no-op if none was started."""
+    with _session_lock.lock_read():
+        if _session is None:
             return
-        with _locks[key]:
-            try:
-                _iter_ctx_pop(key)
-            except KeyError:
-                pass
+        with _session.key_locks[key]:
+            _session.inflight.pop((threading.get_ident(), key), None)
 
 
 def iteration(key: str, work: int = 1, accuracy: Union[int, float] = 0,
               safe: bool = True) -> None:
-    """Complete an iteration; logs instant metrics each beat and window
-    metrics each window period."""
-    with _monitor_ctx_lock.lock_read():
-        if _monitor_ctx is None:
+    """Complete an iteration: emit the heartbeat + CSV row, log instant
+    fields each beat and window fields at each window boundary. With
+    `safe=False` a missing start is tolerated — the shared per-key beat
+    baseline turns the call into a beat-to-beat measurement."""
+    with _session_lock.lock_read():
+        if _session is None:
             return
-        with _locks[key]:
+        with _session.key_locks[key]:
+            ctx = _session.ctx
             try:
-                iter_ctx = _iter_ctx_pop(key)
+                ictx = _session.take(key)
             except KeyError:
                 if safe:
-                    raise KeyError(
-                        f"No thread iteration context for key: {key}") from None
-                iter_ctx = None
-            _monitor_ctx.iteration(key=key, work=work, accuracy=accuracy,
-                                   iter_ctx=iter_ctx)
-            tag = _monitor_ctx.get_tag(key=key)
+                    raise
+                ictx = None
+            ctx.iteration(key=key, work=work, accuracy=accuracy,
+                          iter_ctx=ictx)
+            tag = ctx.get_tag(key=key)
             if tag > 0:
                 if PRINT_FIELDS_INSTANT:
-                    _log_scope(key, "instant")
+                    _session.log_scope(key, "instant")
                 if PRINT_FIELDS_WINDOW and \
-                        (tag + 1) % _monitor_ctx.get_window_size(key=key) == 0:
-                    _log_scope(key, "window")
+                        (tag + 1) % ctx.get_window_size(key=key) == 0:
+                    _session.log_scope(key, "window")
+
+
+# an exception exit (fleet abort) must still close the logs; finish() is
+# idempotent, so an orderly main() calling it first costs nothing
+atexit.register(finish)

@@ -49,6 +49,11 @@ CMD_SCHED = 1
 # revauct.py:168-174; over DCN it is a command frame answered on the
 # transport's BIDS channel)
 CMD_BID = 2
+# peer-death announcement (failover mode, beyond the reference): payload is
+# the dead rank id. Unlike a death-carrying CMD_STOP — which aborts the
+# fleet — CMD_DEAD only records the death; the data rank reacts by ending
+# the round and re-scheduling over the survivors (runtime.py failover path)
+CMD_DEAD = 3
 
 DistCmdHandler = Callable[[int, Tuple[Any, ...]], None]
 

@@ -1,0 +1,121 @@
+"""Deterministic fault injection for the DCN transport (chaos harness).
+
+The fault-tolerance layer (liveness plane, mid-run failover, replay —
+docs/FAULT_TOLERANCE.md) is only trustworthy if its failure modes can be
+reproduced on demand. This module injects faults at exact, countable
+points in a rank's send stream, so a chaos run is bit-for-bit repeatable:
+"kill rank 1 at microbatch 3" means the same thing on every run.
+
+Faults are configured per PROCESS through the `DCN_CHAOS` env var — the
+launcher (tests, `tools/chaos_dcn.py`) targets a rank by setting the
+variable only in that rank's environment. Grammar (`;`-separated actions):
+
+    kill@K          exit the process (os._exit, status 137) immediately
+                    before its K-th tensor-frame send (1-based)
+    hang@K          SIGSTOP the whole process before its K-th send —
+                    sockets stay open, heartbeats stop: the hung-rank
+                    case only the liveness plane can catch
+    drop@K          silently swallow the K-th tensor-frame send
+    delay@K:MS      sleep MS milliseconds before every tensor-frame send
+                    from the K-th on (slow-link / straggler simulation)
+
+Counting is over `send_tensors` calls on the wrapped context (command and
+heartbeat frames are not counted — they are the recovery machinery under
+test). For a pipeline stage, one send == one microbatch, so `@K` indexes
+microbatches directly.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+ENV_CHAOS = "DCN_CHAOS"
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class ChaosAction:
+    kind: str            # kill | hang | drop | delay
+    at_send: int         # 1-based send index the action arms at
+    delay_ms: float = 0.0
+
+
+@dataclass
+class ChaosSpec:
+    actions: List[ChaosAction] = field(default_factory=list)
+
+    @classmethod
+    def parse(cls, spec: str) -> "ChaosSpec":
+        actions = []
+        for part in spec.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            try:
+                kind, _, where = part.partition("@")
+                kind = kind.strip().lower()
+                if kind == "delay":
+                    at, _, ms = where.partition(":")
+                    actions.append(ChaosAction("delay", int(at),
+                                               delay_ms=float(ms or 0)))
+                elif kind in ("kill", "hang", "drop"):
+                    actions.append(ChaosAction(kind, int(where)))
+                else:
+                    raise ValueError(f"unknown chaos action {kind!r}")
+            except ValueError as exc:
+                raise ValueError(
+                    f"bad {ENV_CHAOS} clause {part!r}: {exc} (grammar: "
+                    "kill@K | hang@K | drop@K | delay@K:MS)") from None
+        return cls(actions)
+
+
+class _ChaosSender:
+    """Wraps a context's `send_tensors`, applying the spec's actions at
+    their exact send indices. Thread-safe: a stage's send thread and the
+    data rank's feed thread may share one context."""
+
+    def __init__(self, ctx, spec: ChaosSpec):
+        self._inner = ctx.send_tensors
+        self._spec = spec
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def __call__(self, dst, tensors, channel=0):
+        with self._lock:
+            self._count += 1
+            n = self._count
+        for act in self._spec.actions:
+            if act.kind == "delay" and n >= act.at_send:
+                time.sleep(act.delay_ms / 1e3)
+            elif n == act.at_send:
+                if act.kind == "kill":
+                    logger.error("chaos: killing this process before "
+                                 "send %d", n)
+                    os._exit(137)
+                if act.kind == "hang":
+                    logger.error("chaos: SIGSTOPping this process before "
+                                 "send %d", n)
+                    os.kill(os.getpid(), signal.SIGSTOP)
+                if act.kind == "drop":
+                    logger.warning("chaos: dropping send %d", n)
+                    return
+        return self._inner(dst, tensors, channel=channel)
+
+
+def maybe_install(ctx) -> Optional[ChaosSpec]:
+    """Install the `DCN_CHAOS` spec (if any) onto `ctx` by wrapping its
+    `send_tensors`. Returns the parsed spec, or None when the env var is
+    unset. Call once, after the context is constructed."""
+    raw = os.getenv(ENV_CHAOS)
+    if not raw:
+        return None
+    spec = ChaosSpec.parse(raw)
+    ctx.send_tensors = _ChaosSender(ctx, spec)
+    logger.warning("chaos: installed %s", raw)
+    return spec

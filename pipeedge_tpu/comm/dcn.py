@@ -71,11 +71,35 @@ _MSG_HELLO = 3
 # answered directly by the receiving reader thread, no app wiring needed
 _MSG_NEG = 4
 _MSG_NEG_ACK = 5
+# liveness plane (aux = sender rank): periodic no-payload frames on the
+# dedicated command connections. A closed socket already raises on its
+# reader; heartbeats additionally catch a HUNG peer — process frozen,
+# sockets still open — which no amount of stream-error handling can see.
+_MSG_HEARTBEAT = 6
 
 # wire bitwidths a context accepts by default for its inbound quantized
 # edges (ops/quant.py SUPPORTED_BITS, restatable per context so a peer
 # without e.g. the sub-byte decode path can cap its producers)
 DEFAULT_EDGE_BITS = (0, 1, 2, 3, 4, 5, 6, 8, 16, 32)
+
+# Liveness / transient-fault knobs (env defaults; constructor args and the
+# runtime CLI override). Interval 0 disables the heartbeat plane entirely.
+ENV_HEARTBEAT_INTERVAL = "DCN_HEARTBEAT_INTERVAL"   # seconds between beats
+ENV_HEARTBEAT_MISS = "DCN_HEARTBEAT_MISS"           # missed-beat threshold
+ENV_RECONNECT_GRACE = "DCN_RECONNECT_GRACE"         # seconds a dropped peer
+# may reconnect before its death is confirmed (0 = declare immediately)
+ENV_SEND_RETRIES = "DCN_SEND_RETRIES"               # redial+resend attempts
+DEFAULT_HEARTBEAT_MISS = 3
+
+
+def _env_number(name: str, default, cast):
+    val = os.getenv(name)
+    if not val:
+        return default
+    try:
+        return cast(val)
+    except ValueError:
+        raise ValueError(f"{name}={val!r} is not a number") from None
 
 # msg_type, aux (cmd / sender rank), channel, n_tensors. The channel byte
 # demultiplexes logically-distinct streams on the same rank pair (e.g. a
@@ -283,7 +307,9 @@ class DistDcnContext(DistContext):
     def __init__(self, world_size: int, rank: int,
                  rank_addrs: Sequence[Tuple[str, int]],
                  cmd_handler: Optional[Callable] = None,
-                 edge_bits_supported: Optional[Sequence[int]] = None):
+                 edge_bits_supported: Optional[Sequence[int]] = None,
+                 reconnect_grace: Optional[float] = None,
+                 send_retries: Optional[int] = None):
         super().__init__(world_size=world_size, rank=rank)
         assert len(rank_addrs) == world_size
         self._rank_addrs = list(rank_addrs)
@@ -335,6 +361,31 @@ class DistDcnContext(DistContext):
         # us): a later connection-REFUSED from one of these is a death
         # signal, not a still-starting listener (_ensure_conn fast path)
         self._ever_connected: set = set()
+        # transient-fault policy: a dropped connection opens a grace window
+        # (seconds) before the death is confirmed — a RESTARTING rank that
+        # rebinds its listener and HELLOs again within it is revived, a dead
+        # one is not. 0 preserves the declare-immediately behavior.
+        self._reconnect_grace = (reconnect_grace if reconnect_grace is not None
+                                 else _env_number(ENV_RECONNECT_GRACE, 0.0,
+                                                  float))
+        # bounded redial+resend attempts for a data send that hits a broken
+        # pipe (transient network fault / peer restart); 0 = fail fast
+        self.send_retries = (send_retries if send_retries is not None
+                             else _env_number(ENV_SEND_RETRIES, 0, int))
+        # monotonic stamp of the last life sign per peer (any inbound frame,
+        # or a successful outbound dial): what a grace window checks against
+        self._alive_at: Dict[int, float] = {}
+        # ranks inside an open grace window, mapped to their pending timer
+        self._pending_death: Dict[int, threading.Timer] = {}
+        # liveness plane state (start_heartbeat)
+        self._hb_thread: Optional[threading.Thread] = None
+        self._hb_stop = threading.Event()
+        self._hb_interval = 0.0
+        self._hb_miss = DEFAULT_HEARTBEAT_MISS
+        self._hb_peers: Tuple[int, ...] = ()
+        self._hb_last_rx: Dict[int, float] = {}
+        self._hb_lock = threading.Lock()
+        self._hb_hook: Optional[Callable[[int], None]] = None
         # send/recv measurement hooks (reference p2p:132-152): pre fires just
         # before the payload moves, post just after, so (post - pre) is the
         # actual wire transfer time — excluding idle waits for data to exist.
@@ -377,17 +428,164 @@ class DistDcnContext(DistContext):
         gate on their own stop flag inside the handler."""
         self._peer_death_handler = handler
 
-    def _mark_dead(self, rank: int) -> None:
+    def _mark_dead(self, rank: int, reason: str = "connection lost") -> None:
         if rank < 0 or self._stop.is_set():
+            return
+        if self._reconnect_grace > 0:
+            # open a grace window instead of declaring death: a RESTARTING
+            # peer (rebinds + HELLOs within the window) is revived by
+            # _confirm_dead finding a newer life sign
+            with self._dead_lock:
+                if rank in self._dead or rank in self._pending_death:
+                    return
+                timer = threading.Timer(
+                    self._reconnect_grace, self._confirm_dead,
+                    args=(rank, time.monotonic(), reason))
+                timer.daemon = True
+                self._pending_death[rank] = timer
+            logger.warning("rank %d: peer rank %d %s; reconnect grace %.1fs",
+                           self._rank, rank, reason, self._reconnect_grace)
+            timer.start()
+            return
+        self._declare_dead(rank, reason)
+
+    def _confirm_dead(self, rank: int, marked_at: float, reason: str) -> None:
+        """Grace expiry: the peer is dead unless it showed a life sign
+        (inbound frame / fresh HELLO / successful dial) after the mark."""
+        with self._dead_lock:
+            self._pending_death.pop(rank, None)
+            revived = self._alive_at.get(rank, 0.0) > marked_at
+        if revived:
+            logger.info("rank %d: peer rank %d reconnected within grace",
+                        self._rank, rank)
+            return
+        self._declare_dead(rank, reason + " (grace expired)")
+
+    def _declare_dead(self, rank: int, reason: str) -> None:
+        if self._stop.is_set():
             return
         with self._dead_lock:
             if rank in self._dead:
                 return
             self._dead.add(rank)
-        logger.warning("rank %d: peer rank %d connection lost (peer death?)",
-                       self._rank, rank)
+        logger.warning("rank %d: peer rank %d %s (peer death?)",
+                       self._rank, rank, reason)
         if self._peer_death_handler is not None:
             self._peer_death_handler(rank)
+
+    def _alive_sign(self, rank: int) -> None:
+        """Record a life sign from `rank` (called from reader threads and
+        successful dials); what an open grace window is checked against."""
+        with self._dead_lock:
+            self._alive_at[rank] = time.monotonic()
+
+    def dead_ranks(self) -> frozenset:
+        """Ranks this context has confirmed dead (post-grace)."""
+        with self._dead_lock:
+            return frozenset(self._dead)
+
+    # -- liveness plane ------------------------------------------------
+
+    def register_heartbeat_hook(self, hook: Optional[Callable[[int], None]]) \
+            -> None:
+        """`hook(src)` fires on the reader thread for every heartbeat frame
+        received — the feed for monitoring's heartbeat windows."""
+        self._hb_hook = hook
+
+    def start_heartbeat(self, peers: Optional[Sequence[int]] = None,
+                        interval: Optional[float] = None,
+                        miss_threshold: Optional[int] = None) -> None:
+        """Start the liveness plane: every `interval` seconds beat each peer
+        over the command connections, and declare any peer dead whose own
+        beats stop for `interval * miss_threshold` seconds. A beat-silent
+        peer with an OPEN socket is exactly the hung-rank case the stream
+        errors cannot catch. Defaults: env DCN_HEARTBEAT_INTERVAL (0 =
+        disabled, the default) and DCN_HEARTBEAT_MISS (3). Watching starts
+        at a peer's FIRST received beat, so ranks coming up at different
+        times are never declared dead by a launch skew."""
+        interval = (interval if interval is not None
+                    else _env_number(ENV_HEARTBEAT_INTERVAL, 0.0, float))
+        if interval <= 0 or self._hb_thread is not None:
+            return
+        self._hb_interval = float(interval)
+        self._hb_miss = int(miss_threshold if miss_threshold is not None
+                            else _env_number(ENV_HEARTBEAT_MISS,
+                                             DEFAULT_HEARTBEAT_MISS, int))
+        self._hb_peers = tuple(p for p in (peers if peers is not None
+                                           else range(self._world_size))
+                               if p != self._rank)
+        self._hb_stop = threading.Event()
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True,
+            name=f"dcn-heartbeat-{self._rank}")
+        self._hb_thread.start()
+
+    def stop_heartbeat(self) -> None:
+        """Stop beating and watching (the context stays usable)."""
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5)
+            self._hb_thread = None
+
+    def _heartbeat_loop(self) -> None:
+        interval = self._hb_interval
+        # a peer that failed to dial is not re-dialed every cycle: serial
+        # blocking dials to (say) a SYN-blackholed host would stretch THIS
+        # rank's own beat period past other ranks' silence thresholds and
+        # get healthy ranks declared dead. One attempt per miss-window.
+        dial_backoff: Dict[int, float] = {}
+        while not self._stop.is_set() and not self._hb_stop.is_set():
+            for dst in self._hb_peers:
+                if dst in self._dead or self._hb_stop.is_set():
+                    continue
+                if self._cmd_conns.get(dst) is None \
+                        and time.monotonic() < dial_backoff.get(dst, 0.0):
+                    continue
+                # bounded lock acquire: a broadcast stuck dialing THIS
+                # peer must not stall the beats to every other peer
+                lock = self._cmd_conn_locks[dst]
+                if not lock.acquire(timeout=min(2.0, interval)):
+                    continue
+                try:
+                    # short per-beat dial budget: a peer that is not up yet
+                    # just misses this beat, it does not stall the plane
+                    conn = self._ensure_conn(
+                        dst, timeout=min(0.5, interval),
+                        conns=self._cmd_conns)
+                    _send_frame(conn, _MSG_HEARTBEAT, self._rank, ())
+                    dial_backoff.pop(dst, None)
+                except OSError:
+                    dial_backoff[dst] = (time.monotonic()
+                                         + interval * self._hb_miss)
+                    with self._conns_lock:
+                        self._cmd_conns.pop(dst, None)
+                finally:
+                    lock.release()
+            now = time.monotonic()
+            with self._hb_lock:
+                rx = dict(self._hb_last_rx)
+            with self._dead_lock:
+                alive = dict(self._alive_at)
+                dead = set(self._dead)
+            # ANY inbound frame counts as life, not only beats: a rank
+            # whose beat thread is starved while it streams data is busy,
+            # not hung. Size interval*miss above the worst single-threaded
+            # stall a rank can take (model build / jit compile) — see
+            # docs/FAULT_TOLERANCE.md.
+            silent = [(p, now - max(last, alive.get(p, 0.0)))
+                      for p, last in rx.items()
+                      if now - max(last, alive.get(p, 0.0))
+                      > interval * self._hb_miss and p not in dead]
+            for peer, gap in silent:
+                # dispatch off-thread: the death handler may block (grace
+                # waits, command broadcasts) and beats must keep flowing
+                threading.Thread(
+                    target=self._mark_dead,
+                    args=(peer, f"missed {self._hb_miss} heartbeats "
+                                f"(silent {gap:.1f}s, interval "
+                                f"{interval}s)"),
+                    daemon=True).start()
+            self._hb_stop.wait(interval)
 
     # -- lifecycle -----------------------------------------------------
 
@@ -400,6 +598,9 @@ class DistDcnContext(DistContext):
         self._recv_queues = {}
         self._neg_replies = {}
         self._dead = set()
+        self._alive_at = {}
+        self._pending_death = {}
+        self._hb_last_rx = {}
         # forget which peers were ever up: a relaunched fleet's listeners
         # get the full rendezvous budget again, not the fast-refusal path
         self._ever_connected = set()
@@ -415,6 +616,12 @@ class DistDcnContext(DistContext):
 
     def shutdown(self) -> None:
         self._stop.set()
+        self.stop_heartbeat()
+        with self._dead_lock:
+            timers = list(self._pending_death.values())
+            self._pending_death.clear()
+        for t in timers:
+            t.cancel()
         if self._accept_thread is not None:
             self._accept_thread.join()
         with self._conns_lock:
@@ -474,8 +681,10 @@ class DistDcnContext(DistContext):
                 return
             with self._conns_lock:
                 self._ever_connected.add(src)
+            self._alive_sign(src)
             while not self._stop.is_set():
                 msg_type, aux, channel, n_tensors = _recv_header(conn)
+                self._alive_sign(src)
                 hooked = (msg_type == _MSG_TENSORS
                           and self._recv_pre_hook is not None)
                 if hooked:
@@ -518,6 +727,11 @@ class DistDcnContext(DistContext):
                                        exc)
                 elif msg_type == _MSG_NEG_ACK:
                     self._neg_queue(src).put(aux)
+                elif msg_type == _MSG_HEARTBEAT:
+                    with self._hb_lock:
+                        self._hb_last_rx[aux] = time.monotonic()
+                    if self._hb_hook is not None:
+                        self._hb_hook(aux)
                 else:
                     logger.error("unknown frame type %d from rank %d",
                                  msg_type, src)
@@ -580,37 +794,61 @@ class DistDcnContext(DistContext):
         with self._conns_lock:
             conns[dst] = conn
             self._ever_connected.add(dst)
+        self._alive_sign(dst)   # a successful dial revives a grace window
         return conn
 
     def send_tensors(self, dst: int, tensors: Sequence[np.ndarray],
                      channel: int = CHANNEL_DATA) -> None:
-        """Send a tensor list to `dst` (reference _send_tensor, p2p:96-108)."""
-        try:
-            with self._conn_locks[dst]:
-                conn = self._ensure_conn(dst)
-                if self._send_pre_hook is not None:
-                    self._send_pre_hook(dst, channel)
-                try:
-                    _send_frame(conn, _MSG_TENSORS, self._rank, tensors,
-                                channel)
-                except Exception as exc:
-                    if self._send_pre_hook is not None \
-                            and self._send_post_hook is not None:
-                        self._send_post_hook(dst, channel, None)  # abort
-                    if isinstance(exc, OSError):
-                        # broken pipe / reset: the peer is gone; drop the
-                        # conn so state stays clean
-                        with self._conns_lock:
-                            if self._conns.get(dst) is conn:
-                                del self._conns[dst]
+        """Send a tensor list to `dst` (reference _send_tensor, p2p:96-108).
+
+        With `send_retries` > 0 (env DCN_SEND_RETRIES), a broken connection
+        is redialed and the WHOLE frame resent, with exponential backoff —
+        transient network faults and in-grace peer restarts heal instead of
+        killing the edge. The receiver discards a torn partial frame with
+        its dropped connection, so a resend can duplicate a frame but never
+        corrupt one; consumers that must be exactly-once dedupe at the
+        application layer (runtime.py's microbatch-id ledger)."""
+        attempts = 1 + max(0, self.send_retries)
+        for attempt in range(attempts):
+            try:
+                self._send_tensors_once(dst, tensors, channel)
+                return
+            except OSError as exc:
+                if attempt + 1 >= attempts or self._stop.is_set():
+                    # notify AFTER releasing the conn lock: the death
+                    # handler may broadcast commands, which needs these
+                    # locks (deadlock otherwise)
+                    self._mark_dead(dst)
                     raise
-                if self._send_post_hook is not None:
-                    self._send_post_hook(dst, channel, tensors)
-        except OSError:
-            # notify AFTER releasing the conn lock: the death handler may
-            # broadcast commands, which needs these locks (deadlock otherwise)
-            self._mark_dead(dst)
-            raise
+                backoff = min(2.0, 0.2 * (2 ** attempt))
+                logger.warning(
+                    "rank %d: send to rank %d failed (%s); retry %d/%d "
+                    "in %.1fs", self._rank, dst, exc, attempt + 1,
+                    attempts - 1, backoff)
+                time.sleep(backoff)
+
+    def _send_tensors_once(self, dst: int, tensors: Sequence[np.ndarray],
+                           channel: int) -> None:
+        with self._conn_locks[dst]:
+            conn = self._ensure_conn(dst)
+            if self._send_pre_hook is not None:
+                self._send_pre_hook(dst, channel)
+            try:
+                _send_frame(conn, _MSG_TENSORS, self._rank, tensors,
+                            channel)
+            except Exception as exc:
+                if self._send_pre_hook is not None \
+                        and self._send_post_hook is not None:
+                    self._send_post_hook(dst, channel, None)  # abort
+                if isinstance(exc, OSError):
+                    # broken pipe / reset: the peer is gone; drop the
+                    # conn so state stays clean
+                    with self._conns_lock:
+                        if self._conns.get(dst) is conn:
+                            del self._conns[dst]
+                raise
+            if self._send_post_hook is not None:
+                self._send_post_hook(dst, channel, tensors)
 
     def recv_tensors(self, src: int, timeout: Optional[float] = None,
                      channel: int = CHANNEL_DATA) -> List[np.ndarray]:
@@ -634,7 +872,8 @@ class DistDcnContext(DistContext):
                     raise
 
     def cmd_broadcast(self, cmd: int, tensors: Sequence[np.ndarray] = (),
-                      best_effort: Optional[bool] = None) -> None:
+                      best_effort: Optional[bool] = None,
+                      exclude: Optional[Sequence[int]] = None) -> None:
         """Send a command frame to every other rank (p2p:72-85).
 
         Delivery policy: commands the fleet can survive missing (CMD_STOP —
@@ -644,9 +883,17 @@ class DistDcnContext(DistContext):
         the full CONNECT_TIMEOUT: a worker whose listener comes up seconds
         after the data rank broadcasts must still receive the schedule — the
         delivery guarantee the reference gets for free from its
-        init_process_group rendezvous (p2p:62)."""
+        init_process_group rendezvous (p2p:62).
+
+        Peers in `exclude` and peers this context has CONFIRMED dead are
+        skipped outright (never counted as failures): a failover CMD_SCHED
+        must reach every survivor without stalling on — or aborting over —
+        the rank whose death triggered it."""
         if best_effort is None:
             best_effort = cmd == CMD_STOP
+        skip = set(exclude or ())
+        with self._dead_lock:
+            skip |= self._dead
         # One deadline shared across the whole broadcast: several dead peers
         # cost at most ~CONNECT_TIMEOUT total, not CONNECT_TIMEOUT each
         # (already-connected and live peers dial in milliseconds regardless
@@ -656,6 +903,10 @@ class DistDcnContext(DistContext):
         failures = []
         for dst in range(self._world_size):
             if dst == self._rank:
+                continue
+            if dst in skip:
+                logger.debug("cmd_broadcast: skipping rank %d (dead/"
+                             "excluded)", dst)
                 continue
             try:
                 # dedicated command connections: never blocked behind a

@@ -16,6 +16,10 @@ Semantics preserved: the (instant | window | global) x (time | heartrate |
 work | perf | energy | power | accuracy | accuracy-rate) getter matrix
 (monitoring/__init__.py:228-330), per-beat CSV rows with rates normalized to
 /s and W (216-224), reusable-context-manager behavior, and a pickling block.
+
+CSV logs are held-open file handles (one per key), with every row flushed
+and an explicit `flush()` hook, so a rank that dies or fails over
+mid-run leaves complete post-mortem records (docs/FAULT_TOLERANCE.md).
 """
 from __future__ import annotations
 
@@ -138,6 +142,10 @@ class _KeyedState:
     iter_ctx: MonitorIterationContext = dataclasses.field(
         default_factory=MonitorIterationContext)
     tag: int = 0
+    # held-open CSV handle (opened by MonitorContext.open/add_heartbeat):
+    # rows append to it without a reopen per beat, and every row is flushed
+    # so a crashed process's post-mortem log never loses its tail
+    log_file: Optional[Any] = None
 
 
 class MonitorContext:
@@ -175,9 +183,11 @@ class MonitorContext:
 
     def _log_header(self, state: _KeyedState) -> None:
         if state.log_name is not None:
-            with open(state.log_name, mode=state.log_mode, encoding="utf8") as f:
-                csv.writer(f, delimiter=",",
-                           quoting=csv.QUOTE_MINIMAL).writerow(_CSV_HEADER)
+            state.log_file = open(state.log_name, mode=state.log_mode,
+                                  encoding="utf8")
+            csv.writer(state.log_file, delimiter=",",
+                       quoting=csv.QUOTE_MINIMAL).writerow(_CSV_HEADER)
+            state.log_file.flush()
 
     def open(self) -> None:
         if self._initialized:
@@ -188,8 +198,19 @@ class MonitorContext:
         for state in self._states.values():
             self._log_header(state)
 
+    def flush(self) -> None:
+        """Push buffered CSV rows to the OS — the fleet-abort / failover
+        hook that makes post-mortem records survive whatever comes next."""
+        for state in self._states.values():
+            if state.log_file is not None and not state.log_file.closed:
+                state.log_file.flush()
+
     def close(self) -> None:
         self._initialized = False
+        for state in self._states.values():
+            if state.log_file is not None and not state.log_file.closed:
+                state.log_file.close()
+            state.log_file = None
         if self._em is not None:
             self._em.finish()
 
@@ -233,16 +254,17 @@ class MonitorContext:
             state.hbt.beat(t_ns - iter_ctx.t_ns_last, work,
                            e_uj - iter_ctx.e_uj_last, accuracy)
             state.tag += 1
-            if state.log_name is not None:
+            if state.log_file is not None and not state.log_file.closed:
                 hbt = state.hbt
                 rec = [state.tag - 1, hbt.time_ns("instant"),
                        hbt.heartrate("instant"), hbt.work("instant"),
                        hbt.perf("instant"), hbt.energy_uj("instant"),
                        hbt.power_w("instant"), hbt.accuracy("instant"),
                        hbt.accuracy_rate("instant")]
-                with open(state.log_name, mode="a", encoding="utf8") as f:
-                    csv.writer(f, delimiter=",", quoting=csv.QUOTE_MINIMAL
-                               ).writerow(_format_record(rec))
+                csv.writer(state.log_file, delimiter=",",
+                           quoting=csv.QUOTE_MINIMAL
+                           ).writerow(_format_record(rec))
+                state.log_file.flush()
         iter_ctx.t_ns_last = t_ns
         iter_ctx.e_uj_last = e_uj
 

@@ -1,0 +1,111 @@
+"""Failover re-scheduling: rebuild a pipeline schedule over the survivors
+of a mid-run stage death.
+
+The runtime's failover path (runtime.py, `--on-peer-death failover`) calls
+`plan_failover` when a rank carrying a stage dies. The planner cascades
+through three strategies, most-informed first:
+
+1. **Native scheduler** (`sched/scheduler.py` `sched_pipeline`), when the
+   caller passes profile files: re-solve the partition over the surviving
+   ranks' device profiles. Produces the best schedule but may CHANGE the
+   cut points, so the recovered run is numerically equivalent, not
+   necessarily bit-identical, to the original partition.
+2. **Reverse-auction bids** (`sched/revauct.py`), when the caller passes a
+   `bid_fn` that can collect fresh bids from the survivors (the runtime's
+   CMD_BID round over the DCN BIDS channel). Same caveat as (1).
+3. **Spare substitution**: keep the stage_layers/stage_quant exactly as
+   scheduled and move each dead rank's stage onto an idle survivor (a rank
+   in the fleet but not in the schedule). Because the partition is
+   unchanged and every stage runs the same jitted program, replayed
+   microbatches are bit-identical to a no-fault run — the property the
+   chaos acceptance test asserts.
+
+Returns None when no strategy yields a schedule the survivors can run —
+the caller then aborts, naming the dead rank (the pre-failover semantics).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, Optional, Sequence, Set, Tuple
+
+logger = logging.getLogger(__name__)
+
+Schedule = Tuple[List[Tuple[int, int]], List[int], List[int]]
+
+
+def plan_failover(stage_layers: Sequence[Tuple[int, int]],
+                  stage_quant: Sequence[int],
+                  stage_ranks: Sequence[int],
+                  world_size: int,
+                  dead_ranks: Set[int],
+                  scheduler_fn: Optional[Callable[[int], Schedule]] = None,
+                  bid_fn: Optional[Callable[[List[int]], Schedule]] = None) \
+        -> Optional[Schedule]:
+    """Plan a schedule for the surviving ranks after `dead_ranks` died.
+
+    `scheduler_fn(n_survivors)` re-runs the native scheduler for a fleet of
+    that size and returns (stage_layers, stage_quant, stage_ranks) with
+    ranks as indices 0..n-1 INTO the survivor list (remapped here);
+    `bid_fn(survivors)` does the same from fresh reverse-auction bids.
+    Either may raise or return None to fall through to spare substitution.
+    """
+    dead_ranks = set(dead_ranks)
+    survivors = [r for r in range(world_size) if r not in dead_ranks]
+    lost = [i for i, r in enumerate(stage_ranks) if r in dead_ranks]
+    if not lost:
+        # the dead rank carried no stage (an idle spare died): the running
+        # schedule is untouched
+        return list(stage_layers), list(stage_quant), list(stage_ranks)
+    if not survivors:
+        return None
+
+    for name, attempt in (("scheduler", scheduler_fn), ("revauct", bid_fn)):
+        if attempt is None:
+            continue
+        try:
+            arg = len(survivors) if name == "scheduler" else survivors
+            planned = attempt(arg)
+        except Exception as exc:  # noqa: BLE001 — every strategy may fail;
+            logger.warning("failover: %s re-schedule failed (%s); falling "
+                           "through", name, exc)   # the cascade continues
+            continue
+        if planned is None:
+            continue
+        layers, quant, ranks = planned
+        if len(layers) > len(survivors):
+            logger.warning("failover: %s produced %d stages for %d "
+                           "survivors; falling through", name, len(layers),
+                           len(survivors))
+            continue
+        # scheduler ranks are indices into the survivor list; remap them
+        # onto the fleet's real rank ids
+        remapped = [survivors[r] for r in ranks]
+        logger.info("failover: %s re-schedule: layers=%s ranks=%s",
+                    name, layers, remapped)
+        return list(layers), list(quant), remapped
+
+    return substitute_spares(stage_layers, stage_quant, stage_ranks,
+                             survivors)
+
+
+def substitute_spares(stage_layers: Sequence[Tuple[int, int]],
+                      stage_quant: Sequence[int],
+                      stage_ranks: Sequence[int],
+                      survivors: Sequence[int]) -> Optional[Schedule]:
+    """Move each lost stage onto an idle survivor, keeping the partition
+    (and therefore the numerics) exactly as scheduled. Returns None when
+    there are fewer spares than lost stages — no capacity to fail over."""
+    alive = set(survivors)
+    lost = [i for i, r in enumerate(stage_ranks) if r not in alive]
+    assigned = {r for r in stage_ranks if r in alive}
+    spares = sorted(alive - assigned)
+    if len(spares) < len(lost):
+        logger.warning("failover: %d stage(s) lost but only %d spare "
+                       "rank(s) idle; no capacity", len(lost), len(spares))
+        return None
+    new_ranks = list(stage_ranks)
+    for i, spare in zip(lost, spares):
+        logger.info("failover: stage %d (layers %s) moves rank %d -> %d",
+                    i, tuple(stage_layers[i]), stage_ranks[i], spare)
+        new_ranks[i] = spare
+    return list(stage_layers), list(stage_quant), new_ranks

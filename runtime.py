@@ -32,7 +32,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 import monitoring
-from pipeedge_tpu.comm import CMD_SCHED, CMD_STOP
+from pipeedge_tpu.comm import CMD_DEAD, CMD_SCHED, CMD_STOP
 from pipeedge_tpu.models import get_microbatch_size, registry
 from pipeedge_tpu.parallel import pipeline as host_pipeline
 from pipeedge_tpu.parallel import spmd
@@ -57,6 +57,10 @@ MONITORING_KEY_QUANT_ENCODE = 'quant_encode'
 MONITORING_KEY_QUANT_DECODE = 'quant_decode'
 MONITORING_KEY_SEND = 'send'
 MONITORING_KEY_RECV = 'recv'
+# liveness plane: one beat per received DCN heartbeat frame (accuracy
+# column = sender rank), so the post-mortem CSV shows exactly when each
+# peer's beats stopped
+MONITORING_KEY_LIVENESS = 'liveness'
 
 results_counter = ThreadSafeCounter()
 label_queue = queue.Queue()
@@ -74,6 +78,16 @@ stop_counter = ThreadSafeCounter()
 # set once the fleet is tearing down cleanly (empty CMD_SCHED sent/received):
 # from then on, dropped connections are expected, not peer deaths
 fleet_shutdown = threading.Event()
+# failover mode state (--on-peer-death failover): ranks announced dead via
+# CMD_DEAD or observed locally; deaths accumulate for the whole run
+dead_ranks: set = set()
+dead_lock = threading.Lock()
+# a death landed mid-round: the data rank ends the round, re-schedules over
+# the survivors, and replays the unacknowledged microbatches
+failover_event = threading.Event()
+# optional result capture (--save-results): handle_results appends every
+# delivered output here so runs can be compared bit-for-bit
+_results_sink: Optional[list] = None
 
 
 def handle_cmd(cmd: int, tensors: Tuple) -> None:
@@ -82,11 +96,19 @@ def handle_cmd(cmd: int, tensors: Tuple) -> None:
         logger.info("handle_cmd: stop")
         if tensors:
             stop_info[0] = int(np.asarray(tensors[0]))
+            monitoring.flush()   # post-mortem CSVs must survive the abort
         stop_counter.add(1)
         stop_event.set()
     elif cmd == CMD_SCHED:
         logger.info("handle_cmd: sched")
         sched_q.put(tensors)
+    elif cmd == CMD_DEAD:
+        dead = int(np.asarray(tensors[0]))
+        logger.warning("handle_cmd: rank %d announced dead (failover)", dead)
+        with dead_lock:
+            dead_ranks.add(dead)
+        failover_event.set()
+        monitoring.flush()
     else:
         logger.warning("handle_cmd: Unknown command: %s", cmd)
 
@@ -118,6 +140,8 @@ def handle_results(tensors) -> None:
     monitoring.iteration(MONITORING_KEY_OUTPUT, work=n_items, accuracy=acc,
                          safe=False)
     logger.debug("outputs is %s", outputs)
+    if _results_sink is not None:
+        _results_sink.append(outputs)
     results_counter.add(n_items)
 
 
@@ -528,6 +552,84 @@ from pipeedge_tpu.comm.wire import (wire_decode as _wire_decode,
                                     wire_encode_device as _wire_encode_device)
 
 
+class _MicrobatchLedger:
+    """Bounded in-flight ledger for the data rank (failover mode): every
+    microbatch is registered with its id before dispatch, acknowledged when
+    its result frame lands, and REPLAYED (same id) after a failover if no
+    acknowledgment arrived. Duplicate results — a replay overlapping a
+    frame that was already in flight when the stage died, or a transient
+    resend — are dropped by id, and delivery to `handle_results` is held
+    until contiguous, so the result stream at the data rank is exactly-once
+    and in microbatch order regardless of arrival order."""
+
+    def __init__(self, ubatches, labels):
+        self._ubatches = list(ubatches)
+        self._labels = (list(labels) if labels
+                        else [None] * len(self._ubatches))
+        self._acked: set = set()
+        self._held: dict = {}       # acked but not yet contiguous
+        self._next_deliver = 0
+        self._lock = threading.Lock()
+        self.done = threading.Event()
+        if not self._ubatches:
+            self.done.set()
+
+    @property
+    def acked_count(self) -> int:
+        with self._lock:
+            return len(self._acked)
+
+    def pending(self) -> List[Tuple[int, np.ndarray]]:
+        """(microbatch id, ubatch) pairs not yet acknowledged — what the
+        feed loop sends, and after a failover, exactly the replay set."""
+        with self._lock:
+            return [(i, u) for i, u in enumerate(self._ubatches)
+                    if i not in self._acked]
+
+    def ack(self, mbid: int, out: np.ndarray) -> bool:
+        """Acknowledge microbatch `mbid`'s result; False for a duplicate
+        (dropped). Results are surfaced through `handle_results` in id
+        order so the label queue and accuracy bookkeeping stay aligned."""
+        deliver = []
+        with self._lock:
+            if mbid in self._acked or not 0 <= mbid < len(self._ubatches):
+                return False
+            self._acked.add(mbid)
+            self._held[mbid] = out
+            while self._next_deliver in self._held:
+                i = self._next_deliver
+                deliver.append((self._labels[i], self._held.pop(i)))
+                self._next_deliver += 1
+            complete = len(self._acked) == len(self._ubatches)
+        for label, result in deliver:
+            if label is not None:
+                label_queue.put(label)
+            handle_results(result)
+        if complete:
+            self.done.set()
+        return True
+
+
+def _plan_failover(args, sched, world_size: int, dead_now: set):
+    """Re-schedule over the survivors (sched/failover.py cascade). The
+    native scheduler re-solve is attempted only when profile files were
+    given; spare substitution — which preserves the partition and thus
+    bit-identical replay — is the fallback. None = no capacity: abort."""
+    from pipeedge_tpu.sched import failover as failover_sched
+
+    scheduler_fn = None
+    if args.sched_models_file or args.sched_dev_types_file \
+            or args.sched_dev_file:
+        def scheduler_fn(n_survivors):
+            return get_pipeline_sched(
+                n_survivors, None, None, None, None, args.model_name,
+                args.ubatch_size, args.sched_models_file,
+                args.sched_dev_types_file, args.sched_dev_file,
+                dtype=args.dtype)
+    return failover_sched.plan_failover(*sched, world_size, dead_now,
+                                        scheduler_fn=scheduler_fn)
+
+
 def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
     """Multi-process pipeline over the DCN transport: this process is ONE
     rank (reference `runtime.py RANK WORLDSIZE` semantics, run_pipeline_p2p
@@ -543,16 +645,26 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
     exit their schedule loop."""
     import jax.numpy as jnp
 
-    from pipeedge_tpu.comm import dcn
+    from pipeedge_tpu.comm import chaos, dcn
 
     rank, world_size = args.rank, args.worldsize
     data_rank = args.data_rank
+    failover_mode = args.on_peer_death == "failover"
     addrs = dcn.parse_rank_addrs(args.dcn_addrs, world_size, args.port)
     dtype = jnp.bfloat16 if args.dtype == 'bfloat16' else jnp.float32
 
     with dcn.DistDcnContext(world_size, rank, addrs,
                             cmd_handler=handle_cmd) as ctx:
         _register_dcn_monitor_hooks(ctx)
+        chaos.maybe_install(ctx)   # deterministic fault injection, env-gated
+        if ctx.send_retries > 0 and not failover_mode:
+            # a resent frame can DUPLICATE or reorder a microbatch; only
+            # the failover ledger dedupes by id. Without it, the FIFO
+            # label/result pairing can silently misalign.
+            logger.warning(
+                "DCN_SEND_RETRIES=%d without --on-peer-death failover: "
+                "resends are not deduplicated; result/label alignment is "
+                "not guaranteed after a transient fault", ctx.send_retries)
 
         def on_peer_death(dead: int) -> None:
             if stop_info[0] is not None:
@@ -564,6 +676,29 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
             # cleanly, so anything else is a death.
             if fleet_shutdown.wait(timeout=2.0):
                 return
+            monitoring.flush()   # the beat CSVs are about to matter
+            if failover_mode and dead != data_rank:
+                with dead_lock:
+                    announced = dead in dead_ranks
+                    dead_ranks.add(dead)
+                if announced:
+                    return
+                logger.error("rank %d: peer rank %d died; entering failover",
+                             rank, dead)
+                failover_event.set()
+                # every rank may detect independently; the announcement is
+                # idempotent at the receivers (dead_ranks is a set) and the
+                # data rank alone orchestrates the recovery
+                try:
+                    ctx.cmd_broadcast(CMD_DEAD,
+                                      [np.asarray(dead, np.int32)],
+                                      best_effort=True)
+                except OSError:  # pragma: no cover - best_effort guards
+                    pass
+                return
+            # the DATA rank's death is never survivable — it alone holds
+            # the ledger, the inputs, and the orchestration — so even in
+            # failover mode it takes the abort path below
             logger.error("rank %d: peer rank %d died; stopping the pipeline",
                          rank, dead)
             stop_info[0] = dead
@@ -578,21 +713,79 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
             stop_event.set()
 
         ctx.register_peer_death_handler(on_peer_death)
+        # liveness plane: beat every peer, watch every peer's beats, and
+        # feed each received beat into the monitoring heartbeat windows
+        # (the 'liveness' CSV is the post-mortem timeline of peer health)
+        def liveness_beat(src: int) -> None:
+            # raw context call: CSV row + window accounting WITHOUT the
+            # facade's per-beat instant log lines — world_size beats per
+            # interval would bury the very lines failover forensics greps
+            with monitoring.get_locked_context(MONITORING_KEY_LIVENESS) \
+                    as mctx:
+                if mctx is not None:
+                    mctx.iteration(key=MONITORING_KEY_LIVENESS, work=1,
+                                   accuracy=src)
+
+        ctx.register_heartbeat_hook(liveness_beat)
+        ctx.start_heartbeat(
+            interval=args.heartbeat_interval if args.heartbeat_interval > 0
+            else None,
+            miss_threshold=args.heartbeat_miss if args.heartbeat_miss > 0
+            else None)
         results_target = [0]
         if rank == data_rank:
-            for rnd, (stage_layers, stage_quant, stage_ranks) in \
-                    enumerate(schedules):
-                if rnd:
-                    logger.info("re-schedule: broadcasting round %d "
-                                "(partition %s)", rnd, stage_layers)
-                _dcn_round(args, ctx, rnd, stage_layers, stage_quant,
-                           stage_ranks, ubatches, labels, dtype,
-                           results_target)
+            rnd = 0
+            for stage_layers, stage_quant, stage_ranks in schedules:
+                sched = (stage_layers, stage_quant, stage_ranks)
+                ledger = None
+                if failover_mode:
+                    # clear BEFORE snapshotting: a death landing in between
+                    # is caught by the snapshot (its rank is added to
+                    # dead_ranks before the event is set), and a death
+                    # landing after re-sets the event and fails the round
+                    # over normally — never both missed
+                    failover_event.clear()
+                    with dead_lock:
+                        dead_now = set(dead_ranks)
+                    if dead_now:
+                        # a LATER schedule round may still name a rank that
+                        # died earlier in the run; remap before broadcasting
+                        sched = _plan_failover(args, sched, world_size,
+                                               dead_now)
+                        if sched is None:
+                            _abort_no_capacity(ctx, dead_now)
+                    ledger = _MicrobatchLedger(ubatches, labels)
+                while True:
+                    if rnd:
+                        logger.info("re-schedule: broadcasting round %d "
+                                    "(partition %s)", rnd, sched[0])
+                    status = _dcn_round(args, ctx, rnd, *sched, ubatches,
+                                        labels, dtype, results_target,
+                                        ledger=ledger)
+                    rnd += 1
+                    if status != "failover":
+                        break
+                    # clear-then-snapshot, same ordering argument as above
+                    failover_event.clear()
+                    with dead_lock:
+                        dead_now = set(dead_ranks)
+                    replay = ledger.pending()
+                    planned = _plan_failover(args, sched, world_size,
+                                             dead_now)
+                    if planned is None:
+                        _abort_no_capacity(ctx, dead_now)
+                    logger.warning(
+                        "failover: rank(s) %s dead; re-scheduling over "
+                        "survivors and replaying %d unacknowledged "
+                        "microbatch(es)", sorted(dead_now), len(replay))
+                    sched = planned
             # no more rounds: an empty schedule releases the workers.
             # fleet_shutdown first, so peers closing in response are not
             # taken for deaths.
             fleet_shutdown.set()
-            ctx.cmd_broadcast(CMD_SCHED, [])
+            with dead_lock:
+                gone = set(dead_ranks)
+            ctx.cmd_broadcast(CMD_SCHED, [], exclude=gone)
         else:
             rnd = 0
             while True:
@@ -625,6 +818,25 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
                 _dcn_round(args, ctx, rnd, stage_layers, stage_quant,
                            stage_ranks, [], [], dtype, results_target)
                 rnd += 1
+
+
+def _abort_no_capacity(ctx, dead_now: set) -> None:
+    """Failover found no schedule the survivors can run: fall back to the
+    abort semantics, naming the dead rank fleet-wide (death-carrying
+    CMD_STOP) so every worker raises instead of waiting for a schedule."""
+    dead = sorted(dead_now)[0]
+    stop_info[0] = dead
+    monitoring.flush()
+    try:
+        ctx.cmd_broadcast(CMD_STOP, [np.asarray(dead, np.int32)],
+                          best_effort=True)
+    except OSError:  # pragma: no cover - best_effort already guards
+        pass
+    stop_event.set()
+    raise RuntimeError(
+        f"pipeline aborted: rank {dead} died and no spare capacity "
+        "remains to fail over (set --on-peer-death abort to skip the "
+        "re-schedule attempt)")
 
 
 def _make_tp_stage(args, l, r, stage, dtype, restored):
@@ -692,19 +904,29 @@ def _make_tp_stage(args, l, r, stage, dtype, restored):
 
 
 def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
-               ubatches, labels, dtype, results_target) -> None:
+               ubatches, labels, dtype, results_target,
+               ledger: Optional[_MicrobatchLedger] = None) -> Optional[str]:
     """One schedule round on a live DCN fleet: (data rank) broadcast the
     schedule, build this rank's stage if it is in the schedule, stream the
-    batch, stop; (worker) build, run until this round's CMD_STOP."""
+    batch, stop; (worker) build, run until this round's CMD_STOP.
+
+    With a `ledger` (failover mode at the data rank) every frame carries a
+    leading microbatch-id tensor, only unacknowledged microbatches are fed,
+    and a mid-round stage death ends the round with status "failover"
+    (survivor results drained) instead of raising — the caller re-schedules
+    and replays. Returns "ok" on completion, "failover" on a survivable
+    death, None on worker ranks."""
     import jax.numpy as jnp
 
     from pipeedge_tpu.comm import dcn
 
     rank, data_rank = args.rank, args.data_rank
+    failover_mode = args.on_peer_death == "failover"
     # cross-round frame isolation (see dcn.CHANNEL_ROUND_PARITY)
     parity = dcn.CHANNEL_ROUND_PARITY * (rnd % 2)
-    # a peer death is terminal for the whole run — stop_info is never reset,
-    # so a death notification landing between rounds cannot be erased
+    # an ABORTING death is terminal for the whole run — stop_info is never
+    # reset, so a death notification landing between rounds cannot be
+    # erased (failover-mode deaths live in dead_ranks instead)
     if stop_info[0] is not None:
         raise RuntimeError(f"rank {rank}: pipeline aborted: rank "
                            f"{stop_info[0]} died")
@@ -713,11 +935,14 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
     stop_event.clear()
     if rank == data_rank:
         # schedule resolved by the caller; broadcast it (CMD_SCHED,
-        # reference runtime.py:441-445)
+        # reference runtime.py:441-445), skipping confirmed-dead ranks so
+        # a failover schedule reaches every survivor without stalling
+        with dead_lock:
+            gone = set(dead_ranks)
         ctx.cmd_broadcast(CMD_SCHED, [
             np.asarray(stage_layers, np.int32),
             np.asarray(stage_quant, np.int32),
-            np.asarray(stage_ranks, np.int32)])
+            np.asarray(stage_ranks, np.int32)], exclude=gone)
 
     try:
         my_stages = [i for i, r in enumerate(stage_ranks) if r == rank]
@@ -805,6 +1030,12 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
             # microbatch: compute, device->host copy, and socket send
             # overlap instead of serializing.
             def dispatch_cb(tensors):
+                mbid = None
+                if failover_mode:
+                    # failover frames lead with the microbatch id: strip it
+                    # host-side here, re-attach in readback — the id never
+                    # enters the jitted stage step
+                    mbid, tensors = tensors[0], tensors[1:]
                 if is_first:
                     payload = jnp.asarray(tensors[0], dtype=dtype
                                           if tensors[0].dtype.kind == 'f'
@@ -820,10 +1051,10 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                 # depth N it would otherwise pin N extra microbatches of
                 # unquantized activations in device memory
                 return (pending, out if adaptive is not None else None,
-                        int(first.shape[0]))
+                        int(first.shape[0]), mbid)
 
             def readback_cb(item):
-                pending, out, n_items = item
+                pending, out, n_items, mbid = item
                 wire = pending.finalize()   # completes the async copies
                 # beat-to-beat measurement (no iteration_start: dispatch
                 # runs on another thread): in steady state the interval
@@ -840,6 +1071,10 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                     # cached, so steady-state windows cost no extra RTT
                     if edge.quant_bit:
                         edge.quant_bit = negotiate(edge.quant_bit)
+                if mbid is not None:
+                    # NOT ascontiguousarray: it would promote the 0-d id
+                    # to 1-d (recv-side arrays are already contiguous)
+                    wire = [np.asarray(mbid)] + list(wire)
                 return wire
 
             stage = dcn.DcnPipelineStage(
@@ -859,15 +1094,49 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
             logger.info("rank %d not in schedule; idling", rank)
 
         if rank == data_rank:
-            for lb in labels:
-                label_queue.put(lb)
+            if ledger is None:
+                for lb in labels:
+                    label_queue.put(lb)
+                feed_items = None
+            else:
+                # only unacknowledged microbatches are (re)fed; labels are
+                # delivered by the ledger in microbatch order
+                feed_items = ledger.pending()
             first_rank = stage_ranks[0]
             last_rank = stage_ranks[-1]
+
+            def death_hits_schedule() -> bool:
+                # a dead IDLE spare is recorded but must not tear down a
+                # healthy round (the rebuild + replay cost is real); only
+                # a death among this round's stage ranks fails it over
+                with dead_lock:
+                    return bool(set(dead_ranks) & set(stage_ranks))
 
             def results_loop():
                 # wire Mbits/time are measured by the transport recv
                 # hooks (_register_dcn_monitor_hooks) on the reader
                 # thread; this loop only consumes decoded results
+                if ledger is not None:
+                    # failover mode: keep acking until the ledger is full
+                    # or the round is torn down — including the drain
+                    # window after a death, when survivors' in-flight
+                    # results are still arriving
+                    while not stop_event.is_set() \
+                            and not ledger.done.is_set():
+                        try:
+                            tensors = ctx.recv_tensors(
+                                last_rank, timeout=0.5,
+                                channel=dcn.CHANNEL_RESULTS + parity)
+                        except queue.Empty:
+                            continue
+                        except ConnectionError:
+                            return
+                        mbid = int(np.asarray(tensors[0]).reshape(-1)[0])
+                        out = _wire_decode(tensors[1:], dtype)
+                        if not ledger.ack(mbid, np.asarray(out)):
+                            logger.info("failover: duplicate result for "
+                                        "microbatch %d dropped", mbid)
+                    return
                 for _ in range(len(ubatches)):
                     if stop_event.is_set():
                         return
@@ -892,6 +1161,17 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                 # broadcast CMD_STOP. On send failure the transport's
                 # peer-death handler aborts the run; just stop feeding.
                 try:
+                    if ledger is not None:
+                        for mbid, u in feed_items:
+                            if stop_event.is_set() or (
+                                    failover_event.is_set()
+                                    and death_hits_schedule()):
+                                return
+                            ctx.send_tensors(
+                                first_rank,
+                                [np.asarray(mbid, np.int64), np.asarray(u)],
+                                channel=dcn.CHANNEL_FEED + parity)
+                        return
                     for u in ubatches:
                         if stop_event.is_set():
                             return
@@ -901,6 +1181,7 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                     logger.error("feeding stage rank %d failed (%s)",
                                  first_rank, exc)
 
+            failed_over = False
             try:
                 tik = time.monotonic()
                 batch_total = sum(len(u) for u in ubatches)
@@ -918,10 +1199,34 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                 while not complete and time.monotonic() < deadline \
                         and not stop_event.is_set() \
                         and stop_info[0] is None:
-                    complete = results_counter.wait_gte(target, timeout=0.5)
-                # last results can land concurrently with an abort
-                complete = complete or results_counter.wait_gte(target,
-                                                                timeout=0)
+                    if ledger is not None and failover_event.is_set() \
+                            and death_hits_schedule():
+                        break
+                    if ledger is not None:
+                        complete = ledger.done.wait(timeout=0.5)
+                    else:
+                        complete = results_counter.wait_gte(target,
+                                                            timeout=0.5)
+                if ledger is not None:
+                    if not complete and failover_event.is_set() \
+                            and death_hits_schedule():
+                        # drain the survivors: in-flight results keep
+                        # landing for a moment after the death; wait until
+                        # the ack stream goes quiet before tearing down
+                        quiet_at = ledger.acked_count
+                        drain_deadline = time.monotonic() + 5.0
+                        while time.monotonic() < drain_deadline:
+                            time.sleep(0.4)
+                            now_acked = ledger.acked_count
+                            if now_acked == quiet_at:
+                                break
+                            quiet_at = now_acked
+                        failed_over = not ledger.done.is_set()
+                    complete = ledger.done.is_set()
+                else:
+                    # last results can land concurrently with an abort
+                    complete = complete or results_counter.wait_gte(
+                        target, timeout=0)
                 tok = time.monotonic()
             finally:
                 # CMD_STOP must go out even on failure, or the workers
@@ -930,9 +1235,17 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                 stop_event.set()
             results_thread.join(timeout=10)
             feed_thread.join(timeout=10)
+            if failed_over:
+                monitoring.flush()
+                return "failover"
             if not complete:
+                if ledger is not None and failover_event.is_set() \
+                        and death_hits_schedule():
+                    monitoring.flush()
+                    return "failover"
                 # results_counter is cumulative; report this round's share
-                delivered = results_counter.value - (target - batch_total)
+                delivered = (ledger.acked_count if ledger is not None else
+                             results_counter.value - (target - batch_total))
                 if stop_info[0] is not None:
                     raise RuntimeError(
                         f"pipeline aborted: rank {stop_info[0]} died "
@@ -942,6 +1255,7 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                     f"pipeline delivered {delivered}/"
                     f"{batch_total} results within {args.sched_timeout}s")
             _report(tik, tok, ubatches)
+            return "ok"
         else:
             # wait on the stop COUNT, not the event: round rnd ends at the
             # (rnd+1)-th CMD_STOP, which may already have landed while this
@@ -1049,6 +1363,30 @@ def main():
     parser.add_argument("--sched-timeout", type=float, default=300,
                         help="seconds a worker waits for the schedule / "
                              "results / stop (dcn mode)")
+    parser.add_argument("--on-peer-death", default="abort",
+                        choices=["abort", "failover"],
+                        help="dcn mode reaction to a stage rank dying "
+                             "mid-run: abort the fleet (default, the "
+                             "pre-failover semantics) or re-schedule over "
+                             "the survivors and replay unacknowledged "
+                             "microbatches (must be uniform across the "
+                             "fleet; results are exactly-once by "
+                             "microbatch id)")
+    parser.add_argument("--heartbeat-interval", type=float, default=0.0,
+                        help="dcn liveness plane: seconds between heartbeat "
+                             "frames to every peer (0 = env "
+                             "DCN_HEARTBEAT_INTERVAL or disabled); catches "
+                             "HUNG ranks whose sockets stay open")
+    parser.add_argument("--heartbeat-miss", type=int, default=0,
+                        help="missed-beat threshold before a silent peer "
+                             "is declared dead (0 = env DCN_HEARTBEAT_MISS "
+                             "or 3)")
+    parser.add_argument("--save-results", type=str, default=None,
+                        metavar="NPZ",
+                        help="save every delivered result microbatch (in "
+                             "delivery order) to this .npz — lets chaos "
+                             "runs be compared bit-for-bit against "
+                             "no-fault runs")
     parser.add_argument("--platform", type=str, default="auto",
                         choices=["auto", "cpu"],
                         help="force the JAX CPU backend (testing multi-"
@@ -1186,6 +1524,12 @@ def main():
     monitoring.add_key(MONITORING_KEY_RECV, work_type='Mbits')
     monitoring.add_key(MONITORING_KEY_QUANT_ENCODE, acc_type='bits')
     monitoring.add_key(MONITORING_KEY_QUANT_DECODE, acc_type='bits')
+    monitoring.add_key(MONITORING_KEY_LIVENESS, work_type='beats',
+                       acc_type='rank')
+
+    global _results_sink
+    if args.save_results and not is_dcn_worker:
+        _results_sink = []
 
     try:
         comm = args.comm
@@ -1216,6 +1560,11 @@ def main():
         if comm != "dcn":
             assert results_counter.wait_gte(
                 sum(len(u) for u in ubatches), timeout=300)
+        if _results_sink is not None:
+            np.savez(args.save_results,
+                     *[np.asarray(o) for o in _results_sink])
+            logger.info("saved %d result microbatch(es) to %s",
+                        len(_results_sink), args.save_results)
     finally:
         monitoring.finish()
 

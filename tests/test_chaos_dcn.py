@@ -1,0 +1,174 @@
+"""Chaos acceptance for the fault-tolerance layer: loopback DCN fleets with
+deterministic fault injection (DCN_CHAOS, pipeedge_tpu/comm/chaos.py).
+
+The quick (not-slow) pair is the CI chaos smoke: kill a stage rank
+mid-round and recover via failover; kill with no spare capacity and abort
+naming the dead rank. The full kill/delay/hang matrix — including the
+bit-identical replay comparison against a no-fault run — is `slow`."""
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.fleet   # every test spawns OS-process fleets
+
+_MODEL = "pipeedge/test-tiny-vit"
+
+
+def _free_ports(n):
+    socks = [socket.create_server(("127.0.0.1", 0)) for _ in range(n)]
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _run_chaos_fleet(tmp_path, world, chaos=None, victim=1, extra=(),
+                     batch=24, timeout=240):
+    """Launch a `world`-rank failover-mode fleet, arming `chaos` in the
+    victim's env. Returns (data rc, data output, [worker outputs])."""
+    addrs = ",".join(f"127.0.0.1:{p}" for p in _free_ports(world))
+    common = [sys.executable, os.path.join(REPO, "runtime.py")]
+    opts = ["-c", "dcn", "--platform", "cpu", "-m", _MODEL,
+            "-b", str(batch), "-u", "4", "-pt", "1,4,5,8", "-q", "0,0",
+            "-r", "0,1", "--dcn-addrs", addrs, "--sched-timeout", "120",
+            "--on-peer-death", "failover", *extra]
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               DCN_CONNECT_TIMEOUT="30")
+    dirs = []
+    for r in range(world):
+        d = tmp_path / f"rank{r}"
+        d.mkdir(parents=True, exist_ok=True)
+        dirs.append(d)
+    workers = []
+    for r in range(1, world):
+        wenv = dict(env, DCN_CHAOS=chaos) if (chaos and r == victim) \
+            else env
+        workers.append(subprocess.Popen(
+            common + [str(r), str(world)] + opts, cwd=dirs[r], env=wenv,
+            text=True, stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    try:
+        data = subprocess.run(common + ["0", str(world)] + opts,
+                              cwd=dirs[0], env=env, capture_output=True,
+                              text=True, timeout=timeout)
+        wouts = []
+        for w in workers:
+            try:
+                wouts.append(w.communicate(timeout=60)[0])
+            except subprocess.TimeoutExpired:
+                wouts.append("<no output: killed>")
+    finally:
+        for w in workers:
+            try:
+                # SIGKILL, not terminate: a hang-chaos victim is SIGSTOPped
+                # and ignores everything else
+                os.kill(w.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            w.wait()
+    return data, wouts, dirs
+
+
+def test_chaos_smoke_kill_stage_failover(tmp_path):
+    """CI chaos smoke: kill the last stage at its 3rd result send; the
+    spare rank takes the stage over, unacknowledged microbatches replay,
+    and the run completes with every result delivered exactly once."""
+    data, wouts, dirs = _run_chaos_fleet(
+        tmp_path, world=3, chaos="kill@3",
+        extra=["--save-results", "results.npz"])
+    assert data.returncode == 0, data.stdout + data.stderr
+    out = data.stdout + data.stderr
+    assert "entering failover" in out
+    assert "moves rank 1 -> 2" in out
+    assert "replaying" in out and "unacknowledged" in out
+    assert "latency_sec=" in data.stdout
+    # the victim died to the chaos kill; the spare rebuilt stage 1
+    assert "chaos: killing this process" in wouts[0]
+    assert "stage 1: layers [5, 8]" in wouts[1]
+    # all 6 microbatches delivered exactly once
+    results = np.load(dirs[0] / "results.npz")
+    assert len(results.files) == 6
+
+
+def test_chaos_no_spare_capacity_aborts_naming_rank(tmp_path):
+    """Failover mode with nothing to fail over TO: the fleet must still
+    abort cleanly, naming the dead rank (the pre-failover semantics)."""
+    data, wouts, _ = _run_chaos_fleet(tmp_path, world=2, chaos="kill@2",
+                                      batch=16)
+    assert data.returncode not in (None, 0)
+    out = data.stdout + data.stderr
+    assert "no spare capacity" in out and "rank 1 died" in out
+
+
+@pytest.mark.slow
+def test_chaos_kill_replay_bit_identical(tmp_path):
+    """The exactly-once guarantee, bitwise: a killed-and-failed-over run's
+    results are identical to a no-fault run's (same partition on the
+    substituted rank, dedupe by microbatch id, in-order delivery)."""
+    fault, _, fdirs = _run_chaos_fleet(
+        tmp_path / "fault", world=3, chaos="kill@3",
+        extra=["--save-results", "results.npz"])
+    clean, _, cdirs = _run_chaos_fleet(
+        tmp_path / "clean", world=3, chaos=None,
+        extra=["--save-results", "results.npz"])
+    assert fault.returncode == 0, fault.stdout + fault.stderr
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    got = np.load(fdirs[0] / "results.npz")
+    want = np.load(cdirs[0] / "results.npz")
+    assert sorted(got.files) == sorted(want.files)
+    for k in got.files:
+        assert got[k].dtype == want[k].dtype
+        np.testing.assert_array_equal(got[k], want[k])
+
+
+@pytest.mark.slow
+def test_chaos_hang_detected_by_heartbeat(tmp_path):
+    """SIGSTOP a stage rank: its sockets stay open, so only the liveness
+    plane (missed heartbeats) can detect it — then failover proceeds as
+    for a closed-socket death."""
+    data, wouts, _ = _run_chaos_fleet(
+        tmp_path, world=3, chaos="hang@3",
+        extra=["--heartbeat-interval", "0.5", "--heartbeat-miss", "8"])
+    assert data.returncode == 0, data.stdout + data.stderr
+    out = data.stdout + data.stderr
+    assert "latency_sec=" in data.stdout
+    assert "moves rank 1 -> 2" in out
+    # SOME survivor detected the hang via missed beats (the hung process
+    # never closed a socket)
+    fleet_out = out + "".join(wouts)
+    assert "missed" in fleet_out and "heartbeats" in fleet_out
+
+
+@pytest.mark.slow
+def test_chaos_delay_is_survived_without_failover(tmp_path):
+    """A slow link (every send delayed) is degradation, not death: the
+    run completes with no failover."""
+    data, _, _ = _run_chaos_fleet(tmp_path, world=3, chaos="delay@1:150",
+                                  batch=16)
+    assert data.returncode == 0, data.stdout + data.stderr
+    assert "latency_sec=" in data.stdout
+    assert "entering failover" not in data.stdout + data.stderr
+
+
+@pytest.mark.slow
+def test_chaos_tool_records_latencies(tmp_path):
+    """tools/chaos_dcn.py end to end: runs the kill experiment, asserts
+    recovery, and emits the detection/recovery-latency JSON record."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_dcn.py"),
+         "--world", "3", "--victim", "1", "--chaos", "kill@3"],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, PYTHONPATH=REPO), cwd=tmp_path)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    record = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert record["completed"] and not record["timed_out"]
+    assert record["detect_s"] is not None and record["detect_s"] > 0
+    assert record["recover_s"] is not None and record["recover_s"] > 0
+    assert record["replayed"] >= 1

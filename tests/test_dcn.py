@@ -341,6 +341,164 @@ def test_context_int8_uint4_frames_roundtrip():
             c.shutdown()
 
 
+# -- peer death on the receive path ------------------------------------
+
+def test_truncated_frame_mid_payload_raises():
+    """A peer dying mid-payload must surface as ConnectionError("peer
+    closed") — never as a short or garbage tensor (comm/dcn.py:195)."""
+    a, b = socket.socketpair()
+    # header promises one float32[100] tensor (400 payload bytes)...
+    parts = [dcn._HEADER.pack(dcn._MSG_TENSORS, 0, 0, 1),
+             dcn._TENSOR_HEADER.pack(dcn._dtype_code(np.dtype(np.float32)),
+                                     1),
+             dcn._DIM.pack(100)]
+    a.sendall(b"".join(parts))
+    a.sendall(b"\x00" * 40)    # ...but delivers only 40 before dying
+    a.close()
+    with pytest.raises(ConnectionError, match="peer closed"):
+        dcn._recv_frame(b)
+    b.close()
+
+
+def test_context_mid_payload_death_never_yields_garbage():
+    """Context-level version: a raw peer HELLOs, starts a tensor frame,
+    and dies mid-payload. recv_tensors must raise the peer's death, not
+    deliver a partial tensor."""
+    ctxs = _make_contexts(2)
+    deaths = queue.Queue()
+    ctxs[0].register_peer_death_handler(deaths.put)
+    try:
+        host, port = ctxs[0]._rank_addrs[0]
+        raw = socket.create_connection((host, port))
+        dcn._send_frame(raw, dcn._MSG_HELLO, 1, ())
+        raw.sendall(dcn._HEADER.pack(dcn._MSG_TENSORS, 1, 0, 1))
+        raw.sendall(dcn._TENSOR_HEADER.pack(
+            dcn._dtype_code(np.dtype(np.float32)), 1))
+        raw.sendall(dcn._DIM.pack(64))
+        raw.sendall(b"\x00" * 16)          # 16 of 256 payload bytes
+        raw.close()
+        assert deaths.get(timeout=10) == 1
+        with pytest.raises(ConnectionError, match="died"):
+            ctxs[0].recv_tensors(1, timeout=10)
+    finally:
+        for c in ctxs:
+            c.shutdown()
+
+
+def test_peer_restart_within_grace_reconnects():
+    """With a reconnect grace window, a RESTARTING peer (listener rebinds
+    and HELLOs again before the window expires) is revived: the death
+    handler never fires and traffic flows to the new incarnation."""
+    ports = _free_ports(2)
+    addrs = [("127.0.0.1", p) for p in ports]
+    watcher = dcn.DistDcnContext(2, 0, addrs, reconnect_grace=1.5)
+    watcher.init()
+    deaths = queue.Queue()
+    watcher.register_peer_death_handler(deaths.put)
+    peer = dcn.DistDcnContext(2, 1, addrs)
+    peer.init()
+    try:
+        peer.send_tensors(0, [np.arange(3, dtype=np.int32)])
+        watcher.recv_tensors(1, timeout=10)
+        peer.shutdown()                      # connections drop...
+        peer = dcn.DistDcnContext(2, 1, addrs)
+        peer.init()                          # ...and the rank restarts
+        peer.send_tensors(0, [np.full((2,), 7, np.int32)])
+        got = watcher.recv_tensors(1, timeout=10)
+        np.testing.assert_array_equal(got[0], np.full((2,), 7, np.int32))
+        # outlive the grace window: the pending death must have been
+        # revived by the reconnect, not merely delayed
+        time.sleep(2.0)
+        assert deaths.empty(), f"death fired for rank {deaths.get()}"
+        assert not watcher.dead_ranks()
+    finally:
+        peer.shutdown()
+        watcher.shutdown()
+
+
+def test_grace_window_expires_to_death():
+    """A peer that drops and does NOT return within the grace window is
+    declared dead when the window expires."""
+    ports = _free_ports(2)
+    addrs = [("127.0.0.1", p) for p in ports]
+    watcher = dcn.DistDcnContext(2, 0, addrs, reconnect_grace=0.5)
+    watcher.init()
+    deaths = queue.Queue()
+    watcher.register_peer_death_handler(deaths.put)
+    peer = dcn.DistDcnContext(2, 1, addrs)
+    peer.init()
+    try:
+        peer.send_tensors(0, [np.zeros(1, np.float32)])
+        watcher.recv_tensors(1, timeout=10)
+        peer.shutdown()                      # gone for good
+        assert deaths.get(timeout=10) == 1
+        assert 1 in watcher.dead_ranks()
+    finally:
+        watcher.shutdown()
+
+
+# -- liveness plane ----------------------------------------------------
+
+def test_heartbeat_detects_beat_silent_peer():
+    """The hung-rank case: a peer whose sockets stay OPEN but whose beats
+    stop is declared dead after interval * miss_threshold — the failure
+    mode stream errors can never catch."""
+    ctxs = _make_contexts(2)
+    deaths = queue.Queue()
+    beats = queue.Queue()
+    ctxs[0].register_peer_death_handler(deaths.put)
+    ctxs[0].register_heartbeat_hook(beats.put)
+    try:
+        ctxs[0].start_heartbeat([1], interval=0.2, miss_threshold=3)
+        ctxs[1].start_heartbeat([0], interval=0.2, miss_threshold=3)
+        assert beats.get(timeout=10) == 1    # rank 1's beats are flowing
+        # rank 1 "hangs": beats stop, every socket stays open
+        ctxs[1].stop_heartbeat()
+        assert deaths.get(timeout=10) == 1
+        assert 1 in ctxs[0].dead_ranks()
+    finally:
+        for c in ctxs:
+            c.shutdown()
+
+
+def test_heartbeat_disabled_by_default():
+    ctxs = _make_contexts(1)
+    try:
+        ctxs[0].start_heartbeat([0])   # env interval unset -> no thread
+        assert ctxs[0]._hb_thread is None
+    finally:
+        ctxs[0].shutdown()
+
+
+def test_send_retries_heal_transient_break(monkeypatch):
+    """DCN_SEND_RETRIES: a send hitting a broken connection redials and
+    resends instead of failing — paired with a receiver-side grace window
+    so the torn frame's drop is not declared a death."""
+    ports = _free_ports(2)
+    addrs = [("127.0.0.1", p) for p in ports]
+    rx = dcn.DistDcnContext(2, 0, addrs, reconnect_grace=5.0)
+    tx = dcn.DistDcnContext(2, 1, addrs, send_retries=2)
+    rx.init()
+    tx.init()
+    deaths = queue.Queue()
+    rx.register_peer_death_handler(deaths.put)
+    try:
+        tx.send_tensors(0, [np.zeros(4, np.float32)])
+        rx.recv_tensors(1, timeout=10)
+        # sever the established data connection from UNDER the sender
+        with tx._conns_lock:
+            conn = tx._conns[0]
+        conn.shutdown(socket.SHUT_RDWR)
+        conn.close()
+        tx.send_tensors(0, [np.full((4,), 9, np.float32)])   # heals
+        got = rx.recv_tensors(1, timeout=10)
+        np.testing.assert_array_equal(got[0], np.full((4,), 9, np.float32))
+        assert deaths.empty()
+    finally:
+        tx.shutdown()
+        rx.shutdown()
+
+
 # -- edge bitwidth negotiation -----------------------------------------
 
 def test_edge_bit_negotiation_caps_to_receiver():

@@ -1,0 +1,159 @@
+"""Failover building blocks, in-process: the data rank's microbatch ledger
+(exactly-once, in-order delivery, replay set), the survivor re-scheduling
+cascade (sched/failover.py), and the chaos spec grammar."""
+import numpy as np
+import pytest
+
+from pipeedge_tpu.comm import chaos
+from pipeedge_tpu.sched import failover
+
+
+# -- microbatch ledger -------------------------------------------------
+
+def _make_ledger(n=4):
+    import runtime as rt
+    ubatches = [np.full((2, 3), i, np.float32) for i in range(n)]
+    labels = [np.asarray([i, i]) for i in range(n)]
+    return rt, rt._MicrobatchLedger(ubatches, labels)
+
+
+def test_ledger_in_order_delivery_and_replay_set():
+    rt, ledger = _make_ledger(4)
+    delivered = []
+    orig = rt.handle_results
+    rt.handle_results = lambda out: delivered.append(np.asarray(out))
+    try:
+        assert [i for i, _ in ledger.pending()] == [0, 1, 2, 3]
+        # out-of-order arrival: 1 before 0 — delivery holds until contiguous
+        assert ledger.ack(1, np.full((2,), 1.0))
+        assert delivered == [] and not ledger.done.is_set()
+        assert ledger.ack(0, np.full((2,), 0.0))
+        assert [float(d[0]) for d in delivered] == [0.0, 1.0]
+        # the replay set is exactly the unacknowledged tail
+        assert [i for i, _ in ledger.pending()] == [2, 3]
+        assert ledger.ack(3, np.full((2,), 3.0))
+        assert ledger.ack(2, np.full((2,), 2.0))
+        assert [float(d[0]) for d in delivered] == [0.0, 1.0, 2.0, 3.0]
+        assert ledger.done.is_set()
+    finally:
+        rt.handle_results = orig
+        while not rt.label_queue.empty():
+            rt.label_queue.get()
+
+
+def test_ledger_dedupes_replay_overlap():
+    rt, ledger = _make_ledger(2)
+    delivered = []
+    orig = rt.handle_results
+    rt.handle_results = lambda out: delivered.append(np.asarray(out))
+    try:
+        assert ledger.ack(0, np.zeros(2))
+        # a replayed microbatch whose original result was in flight: dropped
+        assert not ledger.ack(0, np.ones(2))
+        assert not ledger.ack(99, np.ones(2))   # out-of-range id: dropped
+        assert ledger.ack(1, np.ones(2))
+        assert len(delivered) == 2 and ledger.done.is_set()
+        assert ledger.acked_count == 2
+    finally:
+        rt.handle_results = orig
+        while not rt.label_queue.empty():
+            rt.label_queue.get()
+
+
+def test_ledger_empty_batch_is_done():
+    import runtime as rt
+    assert rt._MicrobatchLedger([], []).done.is_set()
+
+
+# -- survivor re-scheduling --------------------------------------------
+
+_LAYERS = [(1, 4), (5, 8)]
+
+
+def test_failover_substitutes_spare_rank():
+    planned = failover.plan_failover(_LAYERS, [8, 0], [0, 1],
+                                     world_size=4, dead_ranks={1})
+    assert planned is not None
+    layers, quant, ranks = planned
+    assert layers == _LAYERS and quant == [8, 0]
+    assert ranks == [0, 2]           # stage 1 moved to the lowest spare
+
+
+def test_failover_no_spare_returns_none():
+    assert failover.plan_failover(_LAYERS, [0, 0], [0, 1],
+                                  world_size=2, dead_ranks={1}) is None
+
+
+def test_failover_dead_idle_rank_keeps_schedule():
+    planned = failover.plan_failover(_LAYERS, [0, 0], [0, 1],
+                                     world_size=4, dead_ranks={3})
+    assert planned == (_LAYERS, [0, 0], [0, 1])
+
+
+def test_failover_multiple_deaths_multiple_spares():
+    planned = failover.plan_failover(_LAYERS, [0, 0], [0, 1],
+                                     world_size=5, dead_ranks={0, 1})
+    assert planned is not None
+    assert planned[2] == [2, 3]
+
+
+def test_failover_scheduler_fn_ranks_remap_to_survivors():
+    def scheduler_fn(n_survivors):
+        assert n_survivors == 3
+        return [(1, 8)], [0], [2]    # index 2 INTO the survivor list
+    planned = failover.plan_failover(_LAYERS, [0, 0], [0, 1],
+                                     world_size=4, dead_ranks={1},
+                                     scheduler_fn=scheduler_fn)
+    # survivors are [0, 2, 3]; survivor index 2 is fleet rank 3
+    assert planned == ([(1, 8)], [0], [3])
+
+
+def test_failover_scheduler_fn_failure_falls_through_to_spares():
+    def scheduler_fn(_n):
+        raise RuntimeError("no profiles")
+    planned = failover.plan_failover(_LAYERS, [0, 0], [0, 1],
+                                     world_size=3, dead_ranks={1},
+                                     scheduler_fn=scheduler_fn)
+    assert planned is not None and planned[2] == [0, 2]
+
+
+# -- chaos spec grammar ------------------------------------------------
+
+def test_chaos_spec_parse():
+    spec = chaos.ChaosSpec.parse("kill@3; delay@1:250; drop@7")
+    kinds = [(a.kind, a.at_send) for a in spec.actions]
+    assert kinds == [("kill", 3), ("delay", 1), ("drop", 7)]
+    assert spec.actions[1].delay_ms == 250.0
+
+
+@pytest.mark.parametrize("bad", ["explode@3", "kill@x", "delay@2:abc"])
+def test_chaos_spec_rejects_bad_clauses(bad):
+    with pytest.raises(ValueError, match="DCN_CHAOS"):
+        chaos.ChaosSpec.parse(bad)
+
+
+def test_chaos_drop_and_delay_wrap(monkeypatch):
+    """The wrapper swallows exactly the dropped send and delays from the
+    armed index on, forwarding everything else untouched."""
+    sent = []
+
+    class _Ctx:
+        def send_tensors(self, dst, tensors, channel=0):
+            sent.append((dst, channel))
+
+    ctx = _Ctx()
+    monkeypatch.setenv(chaos.ENV_CHAOS, "drop@2")
+    spec = chaos.maybe_install(ctx)
+    assert spec is not None
+    for i in range(4):
+        ctx.send_tensors(1, [np.zeros(1)], channel=i)
+    assert [c for _, c in sent] == [0, 2, 3]      # send #2 swallowed
+
+
+def test_chaos_env_unset_is_noop(monkeypatch):
+    monkeypatch.delenv(chaos.ENV_CHAOS, raising=False)
+
+    class _Ctx:
+        send_tensors = staticmethod(lambda *a, **k: None)
+
+    assert chaos.maybe_install(_Ctx()) is None

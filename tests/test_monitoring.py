@@ -127,3 +127,32 @@ def test_rwlock_and_counter():
     assert counter.wait_gte(5, timeout=2)
     t.join()
     assert counter.value == 5
+
+
+def test_monitor_rows_on_disk_before_close(tmp_path):
+    """Crash posture: every CSV row is flushed as it is written (and
+    `flush()` is explicit), so a rank that dies mid-run leaves a complete
+    post-mortem log — no buffered tail to lose."""
+    log = tmp_path / "k.csv"
+    ctx = MonitorContext(key="k", window_size=2, log_name=str(log))
+    with ctx:
+        for _ in range(3):
+            ctx.iteration_start(key="k")
+            ctx.iteration(key="k", work=1)
+        ctx.flush()
+        # read WHILE the context is still open: rows must already be there
+        rows = list(csv.reader(open(log)))
+        assert len(rows) == 4          # header + 3 beats
+
+
+def test_facade_flush_safe_anytime(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    monitoring_facade.flush()          # no session: no-op
+    monitoring_facade.init("k", 2)
+    monitoring_facade.iteration_start("k")
+    monitoring_facade.iteration("k", work=1)
+    monitoring_facade.flush()
+    rows = list(csv.reader(open(tmp_path / "k.csv")))
+    assert len(rows) == 2
+    monitoring_facade.finish()
+    monitoring_facade.flush()          # after finish: no-op

@@ -600,3 +600,75 @@ def test_stage_executor_stop_fails_live_waiters():
     t.join(timeout=120)
     assert not t.is_alive()
     assert "in flight" in errs.get("r", "")
+
+
+def test_degraded_window_503_retry_after_and_healthz(server):
+    """The failover window (POST /degraded): /healthz names the dead rank,
+    new work is answered 503 with a Retry-After header, and clearing the
+    window restores normal service."""
+    port = server
+    try:
+        assert _post(port, "/degraded", {"degraded": True, "dead_rank": 1,
+                                         "retry_after": 2})["degraded"]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=30) as resp:
+            health = json.loads(resp.read())
+        assert health["ok"]                      # degraded, not dead
+        assert health["degraded"]["dead_rank"] == 1
+        assert health["degraded"]["retry_after"] == 2
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(port, "/generate", {"ids": [[1, 2, 3]], "new_tokens": 2})
+        assert err.value.code == 503
+        assert err.value.headers["Retry-After"] == "2"
+        body = json.loads(err.value.read())
+        assert body["degraded"] and body["dead_rank"] == 1
+        # prefix registration is admission too
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(port, "/prefix", {"ids": [1, 2, 3]})
+        assert err.value.code == 503
+    finally:
+        _post(port, "/degraded", {"degraded": False})
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=30) as resp:
+        assert json.loads(resp.read())["degraded"] is False
+    out = _post(port, "/generate", {"ids": [[1, 2, 3]], "new_tokens": 2})
+    assert len(out["ids"][0]) == 5
+
+
+def test_degraded_in_flight_request_replayed(solo_pipe):
+    """A request that was IN FLIGHT when the failover window opened and
+    whose executor fails during it is replayed once after recovery — the
+    client sees one clean result, not the transient."""
+    import threading
+
+    from tools import serve as serve_mod
+
+    svc = serve_mod._Service(solo_pipe, executor="wave")
+    try:
+        calls = []
+        orig = svc._generate_once
+
+        def flaky(ids, new_tokens, on_token, kw):
+            if not calls:
+                calls.append(1)
+                # the stage dies under this request: the service degrades
+                # and the executor surfaces a transient failure
+                svc.enter_degraded(dead_rank=1, retry_after=5.0)
+                raise RuntimeError("stage died under this request")
+            return orig(ids, new_tokens, on_token, kw)
+
+        svc._generate_once = flaky
+        recover = threading.Timer(0.5, svc.exit_degraded)
+        recover.start()
+        out = np.asarray(svc.generate([[5, 6, 7]], 3))
+        recover.join()
+        assert calls == [1]              # failed once, replayed once
+        want = np.asarray(solo_pipe.generate(np.asarray([[5, 6, 7]]), 3))
+        np.testing.assert_array_equal(out, want)
+        # admission during a (re-entered) window still refuses new work
+        svc.enter_degraded(dead_rank=2, retry_after=1.0)
+        with pytest.raises(serve_mod.ServiceDegraded):
+            svc.generate([[5, 6, 7]], 2)
+        svc.exit_degraded()
+    finally:
+        svc.stop()

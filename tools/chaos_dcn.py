@@ -1,0 +1,205 @@
+"""Chaos harness for the DCN fleet: inject one deterministic fault, record
+detection and recovery latency.
+
+Launches a loopback fleet of `runtime.py` ranks (one OS process each, like
+tests/test_dcn_runtime.py), arms `DCN_CHAOS` (pipeedge_tpu/comm/chaos.py)
+in the victim rank's environment only, and timestamps every rank's output
+lines to measure the fault-tolerance layer end to end:
+
+- detect_s:  victim fault observed (process death / chaos log line) ->
+             the data rank's death line ("entering failover" / "died")
+- recover_s: detection -> run completion (`latency_sec=` from the data
+             rank) — failover mode only; in abort mode the fleet stops
+- replayed:  microbatches replayed after the failover re-schedule
+
+Emits one JSON line (plus pass-through logs with --verbose). Examples:
+
+  # kill the last stage at its 3rd send; spare rank 2 takes over
+  python tools/chaos_dcn.py --world 3 --victim 1 --chaos kill@3
+
+  # no spare capacity: the fleet must abort naming the dead rank
+  python tools/chaos_dcn.py --world 2 --victim 1 --chaos kill@2 \
+      --expect abort
+
+  # hang (SIGSTOP) a stage: only the heartbeat liveness plane can see it
+  python tools/chaos_dcn.py --world 3 --victim 1 --chaos hang@3 \
+      --heartbeat-interval 0.5
+"""
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_ports(n):
+    socks = [socket.create_server(("127.0.0.1", 0)) for _ in range(n)]
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+class _TimedReader:
+    """Drain a process's stdout, stamping each line's arrival time."""
+
+    def __init__(self, proc):
+        self.proc = proc
+        self.lines = []          # (monotonic, line)
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        for line in self.proc.stdout:
+            self.lines.append((time.monotonic(), line.rstrip("\n")))
+
+    def first(self, needle):
+        for t, line in self.lines:
+            if needle in line:
+                return t, line
+        return None
+
+    def last(self, needle):
+        hit = None
+        for t, line in self.lines:
+            if needle in line:
+                hit = (t, line)
+        return hit
+
+    def join(self):
+        self._thread.join(timeout=5)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--world", type=int, default=3)
+    p.add_argument("--victim", type=int, default=1,
+                   help="rank DCN_CHAOS is armed in (must not be the "
+                        "data rank)")
+    p.add_argument("--chaos", default="kill@3",
+                   help="DCN_CHAOS spec: kill@K | hang@K | drop@K | "
+                        "delay@K:MS")
+    p.add_argument("--expect", default="recover",
+                   choices=["recover", "abort"],
+                   help="recover: the run must complete; abort: the fleet "
+                        "must stop naming the victim")
+    p.add_argument("--on-peer-death", default="failover",
+                   choices=["abort", "failover"])
+    p.add_argument("-m", "--model-name", default="pipeedge/test-tiny-vit")
+    p.add_argument("-pt", "--partition", default="1,4,5,8")
+    p.add_argument("-r", "--rank-order", default="0,1")
+    p.add_argument("-b", "--batch-size", type=int, default=24)
+    p.add_argument("-u", "--ubatch-size", type=int, default=4)
+    # interval*miss must exceed the worst GIL stall a BUSY rank can take
+    # (stage build / jit compile can starve its beat thread for seconds)
+    p.add_argument("--heartbeat-interval", type=float, default=1.0)
+    p.add_argument("--heartbeat-miss", type=int, default=5)
+    p.add_argument("--sched-timeout", type=float, default=120)
+    p.add_argument("--timeout", type=float, default=300,
+                   help="harness deadline for the whole experiment")
+    p.add_argument("--verbose", action="store_true",
+                   help="replay every rank's output lines to stderr")
+    args = p.parse_args()
+    if args.victim == 0:
+        p.error("--victim 0 is the data rank (the driver; killing it "
+                "kills the experiment, not the pipeline)")
+
+    addrs = ",".join(f"127.0.0.1:{port}"
+                     for port in _free_ports(args.world))
+    quant = ",".join("0" for _ in args.partition.split(",")[::2])
+    common = ["-c", "dcn", "--platform", "cpu", "-m", args.model_name,
+              "-b", str(args.batch_size), "-u", str(args.ubatch_size),
+              "-pt", args.partition, "-q", quant, "-r", args.rank_order,
+              "--dcn-addrs", addrs,
+              "--sched-timeout", str(args.sched_timeout),
+              "--on-peer-death", args.on_peer_death,
+              "--heartbeat-interval", str(args.heartbeat_interval),
+              "--heartbeat-miss", str(args.heartbeat_miss)]
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    env.setdefault("DCN_CONNECT_TIMEOUT", "30")
+
+    def launch(rank, extra_env=None):
+        return subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "runtime.py"),
+             str(rank), str(args.world)] + common,
+            env=dict(env, **(extra_env or {})), text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+    procs, readers = {}, {}
+    t0 = time.monotonic()
+    try:
+        for rank in range(args.world):
+            extra = ({"DCN_CHAOS": args.chaos} if rank == args.victim
+                     else None)
+            procs[rank] = launch(rank, extra)
+            readers[rank] = _TimedReader(procs[rank])
+        deadline = t0 + args.timeout
+        data = procs[0]
+        while time.monotonic() < deadline and data.poll() is None:
+            time.sleep(0.25)
+        timed_out = data.poll() is None
+    finally:
+        for rank, proc in procs.items():
+            if proc.poll() is None:
+                try:
+                    # a SIGSTOPped (hang-chaos) victim still dies to KILL
+                    os.kill(proc.pid, signal.SIGKILL)
+                except OSError:
+                    pass
+                proc.wait()
+    for r in readers.values():
+        r.join()
+
+    # the fault instant: the chaos module logs right before acting
+    fault = readers[args.victim].first("chaos:")
+    # the data rank may detect the death itself ("entering failover") or
+    # learn it from a survivor's CMD_DEAD ("announced dead")
+    detect = (readers[0].first("entering failover")
+              or readers[0].first("announced dead")
+              or readers[0].first("died"))
+    recover = readers[0].last("latency_sec=")
+    replayed_line = readers[0].first("unacknowledged microbatch")
+    replayed = None
+    if replayed_line:
+        for tok in replayed_line[1].split():
+            if tok.isdigit():
+                replayed = int(tok)
+    completed = (not timed_out and data.returncode == 0
+                 and recover is not None)
+    aborted = (not timed_out and data.returncode not in (None, 0)
+               and readers[0].first("died") is not None)
+    record = {
+        "chaos": args.chaos,
+        "victim": args.victim,
+        "world": args.world,
+        "mode": args.on_peer_death,
+        "expect": args.expect,
+        "completed": completed,
+        "aborted": aborted,
+        "timed_out": timed_out,
+        "data_rc": data.returncode,
+        "detect_s": (round(detect[0] - fault[0], 3)
+                     if detect and fault else None),
+        "recover_s": (round(recover[0] - detect[0], 3)
+                      if recover and detect and completed else None),
+        "total_s": round(time.monotonic() - t0, 3),
+        "replayed": replayed,
+    }
+    print(json.dumps(record))
+    if args.verbose:
+        for rank, reader in readers.items():
+            for t, line in reader.lines:
+                print(f"[rank{rank} +{t - t0:7.3f}] {line}",
+                      file=sys.stderr)
+    ok = completed if args.expect == "recover" else aborted
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,10 +10,19 @@ requests (prompt caching).
 
 Endpoints (all JSON):
 - GET  /healthz            -> {"ok", "model", "stages", "speculative",
-                               "executor", "stats": {tokens, active,
+                               "executor", "degraded": false | {"dead_rank",
+                               "since_s", "retry_after"},
+                               "stats": {tokens, active,
                                pending, prefixes, ...; stage mode adds
                                per-worker stage_steps/busy/queued}};
                                HTTP 503 once a serving worker has died
+- POST /degraded {"degraded": bool, "dead_rank"?: n, "retry_after"?: s}
+                           -> {"degraded": bool} — the failover
+                              orchestrator's hook: while degraded, new
+                              work is answered 503 + Retry-After and
+                              /healthz names the dead rank; an in-flight
+                              request whose executor fails during the
+                              window is replayed once after recovery
 - POST /prefix   {"ids": [t0, t1, ...]}
                            -> {"prefix_id": "p0", "len": N}
 - POST /generate {"ids": [[...], ...] | [...], "new_tokens": N,
@@ -65,6 +74,19 @@ from typing import Optional
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+class ServiceDegraded(RuntimeError):
+    """The service is in a failover window (a backing stage died): new
+    work should come back later instead of queueing into the hole."""
+
+    def __init__(self, dead_rank, retry_after: float):
+        where = f" (rank {dead_rank} dead)" if dead_rank is not None else ""
+        super().__init__(
+            f"service degraded during failover{where}; retry after "
+            f"{retry_after:g}s")
+        self.dead_rank = dead_rank
+        self.retry_after = retry_after
+
+
 class _Service:
     """Owns the pipeline + executor; HTTP handler threads submit requests
     and wait for (or stream) their results."""
@@ -91,6 +113,10 @@ class _Service:
         self._next_pid = 0
         self._stop = False
         self._dead: Optional[BaseException] = None
+        # failover window (enter_degraded/exit_degraded): while set, new
+        # work is refused with 503 + Retry-After and healthz reports the
+        # dead rank; unlike `_dead` it is expected to clear
+        self.degraded_info: Optional[dict] = None
         if executor == "stage":
             self.exec = StageWorkerExecutor(pipe, max_active=max_active)
             self.batcher = None
@@ -131,6 +157,7 @@ class _Service:
 
     def add_prefix(self, ids):
         with self.cond:
+            self._check_admittable()
             # precompute BOTH handles before registering either, so a
             # draft-side failure cannot leave a half-registered prefix
             # (usable plainly, 400ing speculatively). The target handle
@@ -154,6 +181,44 @@ class _Service:
         if dead is not None:
             raise RuntimeError(f"serving worker died: {dead!r}")
 
+    # -- failover window ------------------------------------------------
+
+    def enter_degraded(self, dead_rank=None, retry_after: float = 5.0):
+        """Open a failover window: admission refuses new work with
+        503 + Retry-After until `exit_degraded` (the orchestrator's signal
+        that the backing pipeline recovered)."""
+        with self.cond:
+            self.degraded_info = {"dead_rank": dead_rank,
+                                  "since": time.monotonic(),
+                                  "retry_after": float(retry_after)}
+            self.cond.notify_all()
+
+    def exit_degraded(self):
+        with self.cond:
+            self.degraded_info = None
+            self.cond.notify_all()
+
+    def _check_admittable(self):
+        deg = self.degraded_info
+        if deg is not None:
+            raise ServiceDegraded(deg["dead_rank"], deg["retry_after"])
+
+    def _await_recovery(self) -> bool:
+        """Block until the degraded window closes (True) or its retry
+        budget runs out / the worker is truly dead (False). The replay
+        gate for a request that was in flight when the failover began."""
+        with self.cond:
+            deg = self.degraded_info
+            if deg is None:
+                return False   # the failure was not a failover window
+            deadline = time.monotonic() + 2 * deg["retry_after"]
+            while self.degraded_info is not None:
+                left = deadline - time.monotonic()
+                if left <= 0 or self.dead is not None:
+                    return False
+                self.cond.wait(timeout=min(0.5, left))
+            return True
+
     def generate_speculative(self, ids, new_tokens, prefix_id=None):
         """Greedy speculative decoding (token-identical to plain greedy;
         the draft only changes the dispatch count). Holds only the
@@ -165,6 +230,7 @@ class _Service:
                            "speculative generation unavailable")
         with self.cond:                     # resolve prefix briefly
             self._check_dead()
+            self._check_admittable()
             prefix = None
             if prefix_id is not None:
                 if prefix_id not in self.spec_prefixes:
@@ -188,6 +254,7 @@ class _Service:
         kw = dict(kw)
         with self.cond:
             self._check_dead()
+            self._check_admittable()
             self._resolve_prefix(kw)
         _build_request(self.pipe, "__prevalidate__", ids, new_tokens,
                        kw.get("temperature", 0.0), kw.get("top_k", 0),
@@ -205,6 +272,23 @@ class _Service:
             kw["prefix"] = self.prefixes[pid]
 
     def generate(self, ids, new_tokens, on_token=None, **kw):
+        with self.cond:
+            self._check_dead()
+            self._check_admittable()   # degraded: 503 + Retry-After
+        try:
+            return self._generate_once(ids, new_tokens, on_token, kw)
+        except ServiceDegraded:
+            raise
+        except RuntimeError:
+            # the executor failed while a failover window was open: the
+            # request was in flight when the stage died. Replay it once
+            # after recovery instead of surfacing the transient — except
+            # streamed requests, whose partial output cannot be unsent.
+            if on_token is not None or not self._await_recovery():
+                raise
+            return self._generate_once(ids, new_tokens, on_token, kw)
+
+    def _generate_once(self, ids, new_tokens, on_token, kw):
         if self.exec is not None:
             with self.cond:
                 self._check_dead()
@@ -254,11 +338,13 @@ def make_handler(service, model_name):
         def log_message(self, *a):      # quiet server
             pass
 
-        def _send(self, code, obj):
+        def _send(self, code, obj, headers=()):
             body = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for name, value in headers:
+                self.send_header(name, value)
             self.end_headers()
             self.wfile.write(body)
 
@@ -349,11 +435,19 @@ def make_handler(service, model_name):
         def do_GET(self):
             if self.path == "/healthz":
                 dead = service.dead is not None
+                deg = service.degraded_info
+                degraded = False
+                if deg is not None:
+                    degraded = {"dead_rank": deg["dead_rank"],
+                                "since_s": round(time.monotonic()
+                                                 - deg["since"], 3),
+                                "retry_after": deg["retry_after"]}
                 self._send(503 if dead else 200,
                            {"ok": not dead, "model": model_name,
                             "stages": len(service.pipe.stages),
                             "speculative": service.spec is not None,
                             "executor": service.executor,
+                            "degraded": degraded,
                             "stats": service.stats()})
             else:
                 self._send(404, {"error": "unknown path"})
@@ -362,7 +456,17 @@ def make_handler(service, model_name):
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n) or b"{}")
-                if self.path == "/prefix":
+                if self.path == "/degraded":
+                    # the failover orchestrator's switch (see module doc)
+                    if req.get("degraded", True):
+                        service.enter_degraded(
+                            dead_rank=req.get("dead_rank"),
+                            retry_after=float(req.get("retry_after", 5)))
+                    else:
+                        service.exit_degraded()
+                    self._send(200, {"degraded":
+                                     service.degraded_info is not None})
+                elif self.path == "/prefix":
                     pid, plen = service.add_prefix(req["ids"])
                     self._send(200, {"prefix_id": pid, "len": plen})
                 elif self.path == "/generate":
@@ -399,6 +503,14 @@ def make_handler(service, model_name):
                     self._send(404, {"error": "unknown path"})
             except (KeyError, ValueError, TypeError, IndexError) as exc:
                 self._send(400, {"error": str(exc)})
+            except ServiceDegraded as exc:
+                # a degraded window is transient by contract: tell the
+                # client exactly when to come back instead of hanging it
+                self._send(503, {"error": str(exc),
+                                 "degraded": True,
+                                 "dead_rank": exc.dead_rank},
+                           headers=(("Retry-After",
+                                     f"{exc.retry_after:g}"),))
             except RuntimeError as exc:
                 self._send(503, {"error": str(exc)})
 
