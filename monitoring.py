@@ -14,7 +14,7 @@ runtime on fleet abort and failover, before anything else happens), and
 `finish()` is registered atexit so an exception exit still closes the logs
 — post-mortem records survive the crash they are needed for.
 """
-from contextlib import contextmanager
+from contextlib import ExitStack, contextmanager
 import atexit
 import logging
 import os
@@ -138,6 +138,23 @@ def add_key(key: str, work_type: str = 'items', acc_type: str = 'acc') -> None:
             return
         _session.ctx.add_heartbeat(key=key, log_name=f"{key}.csv")
         _session.register(key, work_type, acc_type)
+
+
+def snapshot() -> dict:
+    """The full (instant|window|global) getter matrix for every registered
+    key as one dict (`MonitorContext.snapshot`), with each key's report
+    lock held for its read so concurrent beats never tear a row; `{}` when
+    no session is open. The one-call read telemetry/metrics export uses
+    instead of the per-key getters."""
+    with _session_lock.lock_read():
+        if _session is None:
+            return {}
+        # hold every report lock (deterministic order; every other path
+        # takes at most one, so no deadlock) for one consistent read
+        with ExitStack() as stack:
+            for key in sorted(_session.key_locks, key=str):
+                stack.enter_context(_session.key_locks[key])
+            return _session.ctx.snapshot()
 
 
 @contextmanager

@@ -40,6 +40,8 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from . import CMD_STOP, DistContext
+from .. import telemetry
+from ..telemetry import metrics as prom
 
 try:  # bfloat16 on the wire (JAX's native TPU dtype)
     import ml_dtypes
@@ -76,6 +78,15 @@ _MSG_NEG_ACK = 5
 # reader; heartbeats additionally catch a HUNG peer — process frozen,
 # sockets still open — which no amount of stream-error handling can see.
 _MSG_HEARTBEAT = 6
+# telemetry collection (aux = probe flag): a `_MSG_SPANS` request is
+# answered inline by the receiving reader thread with a `_MSG_SPANS_ACK`
+# carrying [t_rx, t_tx] receiver timestamps + the receiver's span ring as a
+# uint8 JSON blob (empty when span recording is off). The same exchange
+# doubles as the NTP-style clock probe `collect_spans` aligns ranks with.
+_MSG_SPANS = 7
+_MSG_SPANS_ACK = 8
+_SPANS_PROBE = 1    # aux: timestamps only (clock probe)
+_SPANS_REQUEST = 0  # aux: timestamps + span ring
 
 # wire bitwidths a context accepts by default for its inbound quantized
 # edges (ops/quant.py SUPPORTED_BITS, restatable per context so a peer
@@ -90,6 +101,13 @@ ENV_RECONNECT_GRACE = "DCN_RECONNECT_GRACE"         # seconds a dropped peer
 # may reconnect before its death is confirmed (0 = declare immediately)
 ENV_SEND_RETRIES = "DCN_SEND_RETRIES"               # redial+resend attempts
 DEFAULT_HEARTBEAT_MISS = 3
+
+
+# /metrics plane: exceeded-silence events the liveness watcher saw (the
+# healthz/metrics "is the fleet flapping" signal; docs/OBSERVABILITY.md)
+_HEARTBEAT_MISSES = prom.REGISTRY.counter(
+    "pipeedge_heartbeat_miss_total",
+    "peers whose heartbeat silence exceeded interval*miss (per event)")
 
 
 def _env_number(name: str, default, cast):
@@ -322,6 +340,10 @@ class DistDcnContext(DistContext):
         # bitwidth-negotiation replies, keyed by the answering peer
         self._neg_replies: Dict[int, "queue.Queue"] = {}
         self._neg_lock = threading.Lock()
+        # span-collection replies, keyed by the answering peer (one
+        # in-flight collect_spans per peer, like negotiation)
+        self._span_replies: Dict[int, "queue.Queue"] = {}
+        self._span_lock = threading.Lock()
         # env override so small test fleets / fast-failing deployments don't
         # wait the full minute for a peer that will never come up
         env_timeout = os.getenv("DCN_CONNECT_TIMEOUT")
@@ -566,7 +588,10 @@ class DistDcnContext(DistContext):
                 rx = dict(self._hb_last_rx)
             with self._dead_lock:
                 alive = dict(self._alive_at)
-                dead = set(self._dead)
+                # peers in an open grace window are already being handled:
+                # re-flagging them every tick would spam death threads and
+                # inflate the miss counter (one event, not one per tick)
+                dead = set(self._dead) | set(self._pending_death)
             # ANY inbound frame counts as life, not only beats: a rank
             # whose beat thread is starved while it streams data is busy,
             # not hung. Size interval*miss above the worst single-threaded
@@ -579,6 +604,7 @@ class DistDcnContext(DistContext):
             for peer, gap in silent:
                 # dispatch off-thread: the death handler may block (grace
                 # waits, command broadcasts) and beats must keep flowing
+                _HEARTBEAT_MISSES.inc(peer=str(peer))
                 threading.Thread(
                     target=self._mark_dead,
                     args=(peer, f"missed {self._hb_miss} heartbeats "
@@ -597,6 +623,7 @@ class DistDcnContext(DistContext):
         self._reader_threads = []
         self._recv_queues = {}
         self._neg_replies = {}
+        self._span_replies = {}
         self._dead = set()
         self._alive_at = {}
         self._pending_death = {}
@@ -689,6 +716,12 @@ class DistDcnContext(DistContext):
                           and self._recv_pre_hook is not None)
                 if hooked:
                     self._recv_pre_hook(src, channel)
+                # wire-recv span: header seen -> payload fully read, i.e.
+                # actual transfer time, not idle time (zero-cost when span
+                # recording is off)
+                t_rx0 = (time.monotonic_ns()
+                         if msg_type == _MSG_TENSORS and telemetry.enabled()
+                         else 0)
                 try:
                     tensors = _recv_body(conn, n_tensors)
                 except Exception:
@@ -698,6 +731,9 @@ class DistDcnContext(DistContext):
                     if hooked and self._recv_post_hook is not None:
                         self._recv_post_hook(src, channel, None)
                     raise
+                if t_rx0:
+                    telemetry.record("wire", f"recv<-r{src}", t_rx0,
+                                     time.monotonic_ns())
                 if msg_type == _MSG_TENSORS and self._recv_post_hook is not None:
                     self._recv_post_hook(src, channel, tensors)
                 if msg_type == _MSG_TENSORS:
@@ -727,6 +763,17 @@ class DistDcnContext(DistContext):
                                        exc)
                 elif msg_type == _MSG_NEG_ACK:
                     self._neg_queue(src).put(aux)
+                elif msg_type == _MSG_SPANS:
+                    # answer inline (transport-level, like _MSG_NEG): the
+                    # requester's clock probe needs t_rx stamped NOW
+                    try:
+                        self._reply_spans(src, aux, time.monotonic_ns())
+                    except OSError as exc:
+                        logger.warning("rank %d: span-collection reply to "
+                                       "rank %d failed: %s", self._rank,
+                                       src, exc)
+                elif msg_type == _MSG_SPANS_ACK:
+                    self._span_queue(src).put((aux, tensors))
                 elif msg_type == _MSG_HEARTBEAT:
                     with self._hb_lock:
                         self._hb_last_rx[aux] = time.monotonic()
@@ -833,6 +880,7 @@ class DistDcnContext(DistContext):
             conn = self._ensure_conn(dst)
             if self._send_pre_hook is not None:
                 self._send_pre_hook(dst, channel)
+            t_tx0 = time.monotonic_ns() if telemetry.enabled() else 0
             try:
                 _send_frame(conn, _MSG_TENSORS, self._rank, tensors,
                             channel)
@@ -847,6 +895,9 @@ class DistDcnContext(DistContext):
                         if self._conns.get(dst) is conn:
                             del self._conns[dst]
                 raise
+            if t_tx0:
+                telemetry.record("wire", f"send->r{dst}", t_tx0,
+                                 time.monotonic_ns())
             if self._send_post_hook is not None:
                 self._send_post_hook(dst, channel, tensors)
 
@@ -979,6 +1030,72 @@ class DistDcnContext(DistContext):
         self._send_neg(dst, _MSG_NEG, int(proposed))
         return int(q.get(timeout=timeout))
 
+    # -- fleet span collection (telemetry) -----------------------------
+
+    def _span_queue(self, peer: int) -> "queue.Queue":
+        with self._span_lock:
+            q = self._span_replies.get(peer)
+            if q is None:
+                q = queue.Queue()
+                self._span_replies[peer] = q
+            return q
+
+    def _reply_spans(self, dst: int, aux: int, t_rx_ns: int) -> None:
+        """Answer a `_MSG_SPANS` request from `dst`: [t_rx, t_tx] receiver
+        timestamps plus (full requests only) this rank's span ring as a
+        uint8 JSON blob. Runs on the reader thread; the blob is built
+        BEFORE t_tx is stamped so serialization time never skews the
+        clock-probe math."""
+        blob = np.zeros(0, np.uint8)
+        if aux != _SPANS_PROBE:
+            rec = telemetry.recorder()
+            if rec is not None:
+                blob = telemetry.spans_to_wire(rec.snapshot())
+        with self._cmd_conn_locks[dst]:
+            conn = self._ensure_conn(dst, conns=self._cmd_conns)
+            stamp = np.asarray([t_rx_ns, time.monotonic_ns()], np.int64)
+            try:
+                _send_frame(conn, _MSG_SPANS_ACK, aux, (stamp, blob))
+            except OSError:
+                with self._conns_lock:
+                    if self._cmd_conns.get(dst) is conn:
+                        del self._cmd_conns[dst]
+                raise
+
+    def collect_spans(self, dst: int, probes: int = 3,
+                      timeout: float = 5.0):
+        """Fetch `dst`'s span ring over the command channel and estimate
+        its clock offset NTP-style from the same exchanges.
+
+        Runs `probes` timestamp-only round trips plus one full request;
+        the minimum-RTT sample gives the offset (telemetry.
+        estimate_clock_offset). Returns `(spans, offset_ns)` with
+        `offset_ns = peer_clock - local_clock` — shift the peer's spans
+        onto this rank's timeline with `telemetry.align_spans`. Raises
+        queue.Empty on timeout and OSError when `dst` is unreachable; one
+        in-flight collection per peer (same discipline as
+        `negotiate_edge_bits`)."""
+        q = self._span_queue(dst)
+        while True:  # drop stale replies from an abandoned collection
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+        samples = []
+        blob = None
+        for i in range(max(0, probes) + 1):
+            aux = _SPANS_PROBE if i < probes else _SPANS_REQUEST
+            t0 = time.monotonic_ns()
+            self._send_neg(dst, _MSG_SPANS, aux)
+            _, tensors = q.get(timeout=timeout)
+            t3 = time.monotonic_ns()
+            stamp = np.asarray(tensors[0], np.int64).reshape(-1)
+            samples.append((t0, int(stamp[0]), int(stamp[1]), t3))
+            if aux == _SPANS_REQUEST:
+                blob = tensors[1]
+        offset = telemetry.estimate_clock_offset(samples)
+        return telemetry.spans_from_wire(blob), offset
+
 
 class DcnPipelineStage:
     """One pipeline stage over the DCN transport: recv -> work -> send on
@@ -1017,7 +1134,8 @@ class DcnPipelineStage:
                  send_channel: int = CHANNEL_DATA,
                  dispatch_cb: Optional[Callable] = None,
                  readback_cb: Optional[Callable] = None,
-                 depth: Optional[int] = None):
+                 depth: Optional[int] = None,
+                 mb_of: Optional[Callable] = None):
         if depth is None:
             depth = int(os.getenv("DCN_STAGE_DEPTH", "2"))
         if depth < 1:
@@ -1042,6 +1160,12 @@ class DcnPipelineStage:
         self._results_cb = results_cb
         self._recv_channel = recv_channel
         self._send_channel = send_channel
+        # telemetry: extracts the GLOBAL microbatch id from an inbound
+        # tensor list (failover frames carry it as the leading tensor);
+        # without it spans tag the stage-local dispatch sequence, which a
+        # failover replay would renumber from 0 — miscorrelating exactly
+        # the traces failover forensics needs
+        self._mb_of = mb_of
         self._depth = depth
         self._queue_work: "queue.Queue" = queue.Queue(maxsize=depth)
         self._queue_out: "queue.Queue" = queue.Queue(maxsize=depth)
@@ -1112,21 +1236,36 @@ class DcnPipelineStage:
             self._queue_work.put(tensors)
 
     def _work_loop(self) -> None:
+        # span mb tag: the global id when the frame carries one (mb_of),
+        # else the stage-local dispatch sequence (equal to the global id
+        # on a FIFO run)
+        seq = 0
         while True:
             item = self._queue_work.get()
             if item is self._SENTINEL or self._stop.is_set():
                 return
-            self._queue_out.put(self._dispatch_cb(item))
+            mb = seq
+            if self._mb_of is not None:
+                try:
+                    mb = self._mb_of(item)
+                except Exception:  # malformed frame: keep the sequence tag
+                    pass
+            with telemetry.span("stage", "dispatch", mb=mb):
+                out = self._dispatch_cb(item)
+            self._queue_out.put((mb, out))
+            seq += 1
 
     def _send_loop(self) -> None:
         while True:
             item = self._queue_out.get()
             if item is self._SENTINEL or self._stop.is_set():
                 return
+            mb, item = item
             if self._readback_cb is not None:
                 # drain the async readback HERE, after the work thread is
                 # already free to dispatch the next microbatch
-                item = self._readback_cb(item)
+                with telemetry.span("stage", "readback", mb=mb):
+                    item = self._readback_cb(item)
             if self._rank_dst is not None:
                 try:
                     self._ctx.send_tensors(self._rank_dst, item,

@@ -39,6 +39,8 @@ from typing import List, Sequence
 
 import numpy as np
 
+from .. import telemetry
+
 # v2 header magic: v1's header is a 0-d int32 whose value is a bitwidth
 # (>= 0), so a 1-D header opening with a negative sentinel is unambiguous.
 WIRE_V2_MAGIC = -2
@@ -60,6 +62,11 @@ def native_wire_codec(bit: int):
 
 def wire_encode(out, bit: int) -> List[np.ndarray]:
     """Stage output (tensor or tuple) -> v1 wire tensor list (host encode)."""
+    with telemetry.span("quant", f"encode{bit}"):
+        return _wire_encode_timed(out, bit)
+
+
+def _wire_encode_timed(out, bit: int) -> List[np.ndarray]:
     import jax.numpy as jnp
 
     from ..ops import quant as quant_ops
@@ -113,7 +120,13 @@ def wire_encode_device(out, bit: int) -> PendingWire:
     Quantizes ON the producing device (jitted `tensor_encode_outerdim`,
     cached per bitwidth) and starts the async readback of only the wire
     payload — packed words + per-item scale/shift at bit>0, the raw
-    arrays at bit=0. Never blocks."""
+    arrays at bit=0. Never blocks (so the telemetry span covers host
+    dispatch only; the device time lands in the readback span)."""
+    with telemetry.span("quant", f"encode_device{bit}"):
+        return _wire_encode_device_timed(out, bit)
+
+
+def _wire_encode_device_timed(out, bit: int) -> PendingWire:
     import jax.numpy as jnp
 
     from ..ops import quant as quant_ops
@@ -177,6 +190,11 @@ def wire_decode(tensors: List[np.ndarray], dtype):
     read from the wire header); returns the stage payload (tensor/tuple).
     v2 frames dequantize on the receiving device; v1 frames through the
     native host codec when available."""
+    with telemetry.span("quant", "decode"):
+        return _wire_decode_timed(tensors, dtype)
+
+
+def _wire_decode_timed(tensors: List[np.ndarray], dtype):
     import jax.numpy as jnp
 
     from ..ops import quant as quant_ops

@@ -296,6 +296,36 @@ class MonitorContext:
     def get_global_accuracy(self, key=None): return self._states[key].hbt.accuracy("global")
     def get_global_accuracy_rate(self, key=None): return self._states[key].hbt.accuracy_rate("global")
 
+    # the 8 metrics of the getter matrix, as (name, per-scope accessor)
+    _SNAPSHOT_METRICS = (
+        ("time_s", lambda h, s: h.time_ns(s) / _NS_PER_S),
+        ("heartrate", lambda h, s: h.heartrate(s)),
+        ("work", lambda h, s: h.work(s)),
+        ("perf", lambda h, s: h.perf(s)),
+        ("energy_j", lambda h, s: h.energy_uj(s) / 1e6),
+        ("power_w", lambda h, s: h.power_w(s)),
+        ("accuracy", lambda h, s: h.accuracy(s)),
+        ("accuracy_rate", lambda h, s: h.accuracy_rate(s)),
+    )
+
+    def snapshot(self) -> dict:
+        """The whole (instant | window | global) x metric getter matrix for
+        every key as ONE dict — `{key: {scope: {metric: value}, "tag": n,
+        "window_size": n}}` — so telemetry/metrics exporters read the
+        monitoring state in one call instead of reaching into the per-key
+        getters one at a time."""
+        out = {}
+        for key, state in self._states.items():
+            hbt = state.hbt
+            entry: dict = {
+                scope: {name: fn(hbt, scope)
+                        for name, fn in self._SNAPSHOT_METRICS}
+                for scope in ("instant", "window", "global")}
+            entry["tag"] = state.tag
+            entry["window_size"] = hbt.window_size
+            out[key] = entry
+        return out
+
     def get_tag(self, key: Any = None) -> int:
         """The next tag (== completed heartbeat count)."""
         return self._states[key].tag

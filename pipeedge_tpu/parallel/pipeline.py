@@ -36,6 +36,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 
+from .. import telemetry
 from ..ops import clamp as clamp_ops
 from ..ops import quant as quant_ops
 from ..utils import tracing
@@ -143,16 +144,23 @@ class HostPipeline:
         # monitoring hooks (p2p:132-152, runtime.py:219-230)
         self.edge_bytes_callback = edge_bytes_callback
 
-    def enqueue(self, ubatch, edge_bytes: Optional[List[int]] = None):
+    def enqueue(self, ubatch, edge_bytes: Optional[List[int]] = None,
+                mb: Optional[int] = None):
         """Dispatch one microbatch through all stages; returns the (device-
         resident, not yet materialized) final payload. When `edge_bytes` is a
-        list, it receives the wire byte count of each inter-stage edge."""
+        list, it receives the wire byte count of each inter-stage edge.
+        `mb` tags the telemetry spans with the microbatch id (flow events
+        on the merged trace)."""
         data = ubatch
         last = len(self.stages) - 1
         for i, stage in enumerate(self.stages):
             # named profiler region: stage dispatch shows up on the trace
-            # timeline (see utils/tracing.py; no-op cost when not tracing)
-            with tracing.annotate(stage.name or f"stage{i}"):
+            # timeline (see utils/tracing.py; no-op cost when not tracing).
+            # The telemetry span measures HOST dispatch time (device work
+            # is async); the retire span is where device time surfaces.
+            with tracing.annotate(stage.name or f"stage{i}"), \
+                    telemetry.span("stage", stage.name or f"stage{i}",
+                                   stage=i, mb=mb):
                 data = stage(data)
             if edge_bytes is not None and i < last:
                 edge_bytes.append(payload_wire_bytes(data))
@@ -172,7 +180,7 @@ class HostPipeline:
         tik = time.monotonic()
         for i, ubatch in enumerate(ubatches):
             edge_bytes: Optional[List[int]] = [] if track_edges else None
-            out = self.enqueue(ubatch, edge_bytes)
+            out = self.enqueue(ubatch, edge_bytes, mb=i)
             inflight.append((i, out, edge_bytes))
             while len(inflight) >= self.max_inflight:
                 self._retire(inflight.pop(0), results)
@@ -188,7 +196,8 @@ class HostPipeline:
 
     def _retire(self, item, results):
         i, out, edge_bytes = item
-        out = jax.block_until_ready(out)
+        with telemetry.span("results", "retire", mb=i):
+            out = jax.block_until_ready(out)
         if self.edge_bytes_callback is not None:
             self.edge_bytes_callback(i, edge_bytes)
         if self.ubatch_callback is not None:

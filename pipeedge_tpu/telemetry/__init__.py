@@ -1,0 +1,258 @@
+"""Fleet-wide microbatch tracing: span recorder, clock alignment, wire codec.
+
+The reference ships only wall-clock offline profiling and per-rank heartbeat
+CSVs (SURVEY.md §5.1) — nothing answers *why* a pipeline round was slow:
+which stage bubbled, which edge's wire time dominated, where a failover
+stalled the fleet. This subsystem is the missing correlation layer:
+
+- `SpanRecorder`: a fixed-size per-rank ring buffer of
+  `(category, name, rank, stage, mb, t_start_ns, t_end_ns)` records,
+  `time.monotonic_ns()`-stamped, drop-oldest under pressure — a `record()`
+  NEVER blocks the hot send/dispatch threads it instruments.
+- module-level `configure()` / `span()` / `record()`: the instrumentation
+  surface. Recording is OFF by default; when off, `span()` returns a shared
+  no-op context manager, so the hot-path cost of a disabled probe is one
+  global read and one attribute call (see `tools/trace_report.py`'s
+  `span_overhead_pct` self-measurement for the enabled cost).
+- `spans_to_wire` / `spans_from_wire`: span buffers as a single uint8
+  ndarray (UTF-8 JSON), the only payload type the DCN command channel
+  carries — how a peer's buffer travels in a `_MSG_SPANS` reply
+  (comm/dcn.py `collect_spans`).
+- `estimate_clock_offset`: NTP-style offset from request/reply timestamp
+  quadruples, so every rank's `monotonic_ns` spans merge onto the
+  collector's timeline (chrome_trace.py).
+
+Span categories in use (docs/OBSERVABILITY.md has the full reference):
+`wire` (socket send/recv), `stage` (DCN stage dispatch/readback; host
+pipeline per-stage dispatch/retire), `compute` (the jitted shard step),
+`quant` (wire encode/decode), `feed`/`results` (data-rank microbatch
+lifecycle), `runtime` (schedule rounds), `failover` (detection→recovery),
+`serve` (HTTP request lifecycle).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+ENV_SPAN_CAPACITY = "PIPEEDGE_SPAN_CAPACITY"
+DEFAULT_SPAN_CAPACITY = 32768
+
+# dict-record field order (also the ring tuple layout)
+_FIELDS = ("cat", "name", "rank", "stage", "mb", "t0", "t1")
+
+
+class SpanRecorder:
+    """Fixed-size ring of completed spans (drop-oldest under pressure).
+
+    `record()` is the only hot-path entry: two clock reads happen in the
+    caller (`_Span`), so the recorder itself is one short lock + one deque
+    append — it never blocks on I/O, never allocates beyond the tuple, and
+    overflow silently drops the OLDEST span (the ring keeps the most recent
+    window, which is the one a post-mortem wants) while counting drops.
+    """
+
+    def __init__(self, rank: int = 0, capacity: Optional[int] = None):
+        if capacity is None:
+            capacity = int(os.getenv(ENV_SPAN_CAPACITY,
+                                     str(DEFAULT_SPAN_CAPACITY)))
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.rank = rank
+        self.capacity = capacity
+        self.dropped = 0
+        self._ring: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def record(self, cat: str, name: str, t0: int, t1: int,
+               stage: Optional[int] = None, mb: Optional[int] = None) -> None:
+        with self._lock:
+            if len(self._ring) == self.capacity:
+                self.dropped += 1
+            self._ring.append((cat, name, self.rank, stage, mb, t0, t1))
+
+    def span(self, cat: str, name: str, stage: Optional[int] = None,
+             mb: Optional[int] = None) -> "_Span":
+        """Context manager recording [enter, exit] as one span."""
+        return _Span(self, cat, name, stage, mb)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def snapshot(self) -> List[dict]:
+        """Copy the ring as a list of span dicts (oldest first)."""
+        with self._lock:
+            rows = list(self._ring)
+        return [dict(zip(_FIELDS, r)) for r in rows]
+
+    def drain(self) -> List[dict]:
+        """Snapshot AND clear the ring (per-round collection)."""
+        with self._lock:
+            rows = list(self._ring)
+            self._ring.clear()
+        return [dict(zip(_FIELDS, r)) for r in rows]
+
+
+class _Span:
+    """Live span: stamps monotonic_ns on enter/exit, records on exit."""
+
+    __slots__ = ("_rec", "_cat", "_name", "_stage", "_mb", "_t0")
+
+    def __init__(self, rec, cat, name, stage, mb):
+        self._rec = rec
+        self._cat = cat
+        self._name = name
+        self._stage = stage
+        self._mb = mb
+
+    def __enter__(self):
+        self._t0 = time.monotonic_ns()
+        return self
+
+    def __exit__(self, *exc):
+        self._rec.record(self._cat, self._name, self._t0,
+                         time.monotonic_ns(), self._stage, self._mb)
+        return False
+
+
+class _NullSpan:
+    """Shared no-op context manager: the disabled-probe fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+_recorder: Optional[SpanRecorder] = None
+
+
+def configure(rank: int = 0, capacity: Optional[int] = None) -> SpanRecorder:
+    """Enable span recording process-wide (idempotent per process: a second
+    call replaces the recorder — fresh ring, same instrumentation)."""
+    global _recorder  # pylint: disable=global-statement
+    _recorder = SpanRecorder(rank=rank, capacity=capacity)
+    return _recorder
+
+
+def disable() -> None:
+    """Drop the recorder: probes revert to the no-op fast path."""
+    global _recorder  # pylint: disable=global-statement
+    _recorder = None
+
+
+def recorder() -> Optional[SpanRecorder]:
+    return _recorder
+
+
+def enabled() -> bool:
+    return _recorder is not None
+
+
+def span(cat: str, name: str, stage: Optional[int] = None,
+         mb: Optional[int] = None):
+    """Instrumentation probe: a recording span when configured, the shared
+    no-op otherwise. Safe on any thread."""
+    rec = _recorder
+    if rec is None:
+        return _NULL_SPAN
+    return _Span(rec, cat, name, stage, mb)
+
+
+def record(cat: str, name: str, t0: int, t1: int,
+           stage: Optional[int] = None, mb: Optional[int] = None) -> None:
+    """Record a pre-timed span (e.g. failover detection→recovery, whose
+    endpoints live on different threads); no-op when disabled."""
+    rec = _recorder
+    if rec is not None:
+        rec.record(cat, name, t0, t1, stage=stage, mb=mb)
+
+
+# -- wire codec (DCN command-channel payloads are ndarrays only) ---------
+
+def spans_to_wire(spans: Sequence[dict]) -> np.ndarray:
+    """Span dicts -> one uint8 ndarray (UTF-8 JSON) for a command frame."""
+    blob = json.dumps([[s.get(f) for f in _FIELDS] for s in spans],
+                      separators=(",", ":")).encode()
+    return np.frombuffer(blob, np.uint8)
+
+
+def spans_from_wire(arr: np.ndarray) -> List[dict]:
+    """Inverse of `spans_to_wire`; tolerates an empty reply (no recorder
+    on the peer)."""
+    blob = bytes(np.asarray(arr, np.uint8))
+    if not blob:
+        return []
+    return [dict(zip(_FIELDS, row)) for row in json.loads(blob)]
+
+
+# -- clock alignment -----------------------------------------------------
+
+def estimate_clock_offset(samples: Sequence[Tuple[int, int, int, int]]) -> int:
+    """NTP-style peer-clock offset from `(t0, t1, t2, t3)` quadruples:
+    local send, peer receive, peer reply, local receive (all ns, each on
+    its own monotonic clock).
+
+    Returns theta = peer_clock - local_clock (ns), taken from the
+    minimum-round-trip sample — the one whose network legs were most
+    symmetric, hence the tightest bound (classic NTP filter). Map a peer
+    timestamp onto the local timeline with `t_local = t_peer - theta`;
+    the residual error is bounded by half that sample's RTT.
+    """
+    if not samples:
+        raise ValueError("need at least one timestamp sample")
+    best = min(samples, key=lambda s: (s[3] - s[0]) - (s[2] - s[1]))
+    t0, t1, t2, t3 = best
+    return ((t1 - t0) + (t2 - t3)) // 2
+
+
+def round_segments(spans: Sequence[dict]) -> List[Tuple[int, int]]:
+    """Merged [t0, t1] interval per named `runtime` round span, sorted by
+    start. Microbatch ids restart at 0 every schedule round (re-schedule
+    rounds replay the same batch; --measure-rounds reruns it), so any
+    consumer correlating spans BY mb id must segment the timeline by these
+    intervals first — every rank records its own round span, hence the
+    per-name merge."""
+    by_name = {}
+    for s in spans:
+        if s.get("cat") != "runtime":
+            continue
+        t0, t1 = int(s["t0"]), int(s["t1"])
+        cur = by_name.get(s["name"])
+        by_name[s["name"]] = ((t0, t1) if cur is None
+                              else (min(cur[0], t0), max(cur[1], t1)))
+    return sorted(by_name.values())
+
+
+def segment_index(segments: Sequence[Tuple[int, int]], t: int) -> int:
+    """Index of the last segment starting at or before `t` (-1 if none):
+    which round a span belongs to."""
+    idx = -1
+    for i, (t0, _) in enumerate(segments):
+        if t0 <= t:
+            idx = i
+        else:
+            break
+    return idx
+
+
+def align_spans(spans: Sequence[dict], offset_ns: int) -> List[dict]:
+    """Shift a peer's spans onto the collector's timeline
+    (`t_local = t_peer - offset_ns`, see `estimate_clock_offset`)."""
+    out = []
+    for s in spans:
+        s = dict(s)
+        s["t0"] = int(s["t0"]) - offset_ns
+        s["t1"] = int(s["t1"]) - offset_ns
+        out.append(s)
+    return out

@@ -1,0 +1,255 @@
+"""Minimal Prometheus-text-format metrics registry (stdlib only).
+
+The /metrics plane of the serving surface (tools/serve.py) and anything
+else that wants scrapeable counters: no client library ships in the
+container, and the text exposition format is simple enough to emit
+directly (https://prometheus.io/docs/instrumenting/exposition_formats/).
+
+Supported instrument types: Counter (monotonic), Gauge (set), Histogram
+(cumulative buckets + _sum/_count). All are label-aware — a label-set is a
+frozen sorted tuple of (key, value) pairs — and thread-safe under one
+registry lock (instrument updates are a dict update + float add; the lock
+is never held across I/O).
+
+`REGISTRY` is the process default; `get_or_create` makes module-level
+instrument declaration idempotent (serve restarts its service object
+without restarting the process in tests).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+DEFAULT_LATENCY_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                           1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+def _fmt(v: float) -> str:
+    """Prometheus number formatting: integers bare, floats compact."""
+    if v == float("inf"):
+        return "+Inf"
+    if float(v).is_integer():
+        return str(int(v))
+    return f"{v:.10g}"
+
+
+def _escape(v: str) -> str:
+    return (v.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _label_str(labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape(str(v))}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+class _Instrument:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_text: str):
+        self.name = name
+        self.help = help_text
+        self._lock = threading.Lock()
+
+    def _key(self, labels: dict) -> Tuple[Tuple[str, str], ...]:
+        return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+    def render(self) -> List[str]:
+        raise NotImplementedError
+
+
+class Counter(_Instrument):
+    kind = "counter"
+
+    def __init__(self, name: str, help_text: str):
+        super().__init__(name, help_text)
+        self._values: Dict[Tuple, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def declare(self, **labels) -> None:
+        """Pre-register a label set at 0 so the series renders before its
+        first increment (scrapers see the full per-edge matrix up front)."""
+        key = self._key(labels)
+        with self._lock:
+            self._values.setdefault(key, 0.0)
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+    def render(self) -> List[str]:
+        with self._lock:
+            items = sorted(self._values.items())
+        return [f"{self.name}{_label_str(k)} {_fmt(v)}" for k, v in items]
+
+
+class Gauge(_Instrument):
+    kind = "gauge"
+
+    def __init__(self, name: str, help_text: str):
+        super().__init__(name, help_text)
+        self._values: Dict[Tuple, float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[self._key(labels)] = float(value)
+
+    def value(self, **labels) -> Optional[float]:
+        with self._lock:
+            return self._values.get(self._key(labels))
+
+    def render(self) -> List[str]:
+        with self._lock:
+            items = sorted(self._values.items())
+        return [f"{self.name}{_label_str(k)} {_fmt(v)}" for k, v in items]
+
+
+class Histogram(_Instrument):
+    kind = "histogram"
+
+    def __init__(self, name: str, help_text: str,
+                 buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS):
+        super().__init__(name, help_text)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise ValueError("histogram needs at least one bucket bound")
+        # label key -> (per-bucket counts, sum, count)
+        self._series: Dict[Tuple, list] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = [[0] * len(self.buckets), 0.0, 0]
+                self._series[key] = s
+            counts, _, _ = s
+            # per-bucket (non-cumulative) storage; render() cumulates
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    counts[i] += 1
+                    break
+            s[1] += float(value)
+            s[2] += 1
+
+    def count(self, **labels) -> int:
+        with self._lock:
+            s = self._series.get(self._key(labels))
+            return s[2] if s else 0
+
+    def render(self) -> List[str]:
+        with self._lock:
+            items = sorted((k, (list(s[0]), s[1], s[2]))
+                           for k, s in self._series.items())
+        lines = []
+        for key, (counts, total, n) in items:
+            cum = 0
+            for bound, c in zip(self.buckets, counts):
+                cum += c
+                lk = key + (("le", _fmt(bound)),)
+                lines.append(f"{self.name}_bucket{_label_str(lk)} {cum}")
+            lk = key + (("le", "+Inf"),)
+            lines.append(f"{self.name}_bucket{_label_str(lk)} {n}")
+            lines.append(f"{self.name}_sum{_label_str(key)} {_fmt(total)}")
+            lines.append(f"{self.name}_count{_label_str(key)} {n}")
+        return lines
+
+    def _key(self, labels: dict):
+        if "le" in labels:
+            raise ValueError("'le' is reserved for histogram buckets")
+        return super()._key(labels)
+
+
+class Registry:
+    """Named instrument collection rendering to Prometheus text format."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._instruments: Dict[str, _Instrument] = {}
+
+    def register(self, inst: _Instrument) -> _Instrument:
+        with self._lock:
+            cur = self._instruments.get(inst.name)
+            if cur is not None:
+                raise ValueError(f"metric already registered: {inst.name}")
+            self._instruments[inst.name] = inst
+        return inst
+
+    def get_or_create(self, cls, name: str, help_text: str, **kwargs):
+        """Idempotent declaration: the existing instrument when the name is
+        taken (must be the same type), else a fresh registration."""
+        with self._lock:
+            cur = self._instruments.get(name)
+            if cur is not None:
+                if not isinstance(cur, cls):
+                    raise ValueError(
+                        f"metric {name} already registered as {cur.kind}")
+                return cur
+            inst = cls(name, help_text, **kwargs)
+            self._instruments[name] = inst
+            return inst
+
+    def counter(self, name: str, help_text: str) -> Counter:
+        return self.get_or_create(Counter, name, help_text)
+
+    def gauge(self, name: str, help_text: str) -> Gauge:
+        return self.get_or_create(Gauge, name, help_text)
+
+    def histogram(self, name: str, help_text: str,
+                  buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS
+                  ) -> Histogram:
+        return self.get_or_create(Histogram, name, help_text,
+                                  buckets=buckets)
+
+    def render(self, extra: Iterable[str] = ()) -> str:
+        """The full exposition document (trailing newline included, as the
+        format requires). `extra` lines (already formatted) append at the
+        end — e.g. the monitoring-snapshot gauges."""
+        with self._lock:
+            insts = [self._instruments[k]
+                     for k in sorted(self._instruments)]
+        out: List[str] = []
+        for inst in insts:
+            out.append(f"# HELP {inst.name} {inst.help}")
+            out.append(f"# TYPE {inst.name} {inst.kind}")
+            out.extend(inst.render())
+        out.extend(extra)
+        return "\n".join(out) + "\n"
+
+
+REGISTRY = Registry()
+
+
+def render_monitoring_snapshot(snapshot: dict,
+                               prefix: str = "pipeedge_monitor") -> List[str]:
+    """Monitoring's `snapshot()` matrix (key -> scope -> metric -> value)
+    as gauge lines — the bridge that lets /metrics expose every monitoring
+    key without reaching into the per-key getter matrix one call at a time
+    (monitoring.snapshot() is the one synchronized read)."""
+    lines = []
+    names = set()
+    rows = []
+    for key in sorted(snapshot):
+        scopes = snapshot[key]
+        for scope in ("instant", "window", "global"):
+            for metric, value in sorted(scopes.get(scope, {}).items()):
+                name = f"{prefix}_{metric}"
+                names.add(name)
+                rows.append((name, key, scope, value))
+    for name in sorted(names):
+        lines.append(f"# HELP {name} monitoring snapshot metric")
+        lines.append(f"# TYPE {name} gauge")
+        for n, key, scope, value in rows:
+            if n == name:
+                lines.append(
+                    f'{name}{{key="{key}",scope="{scope}"}} '
+                    f"{_fmt(float(value))}")
+    return lines

@@ -1,0 +1,180 @@
+"""Bubble attribution and latency analysis over a merged span timeline.
+
+The analysis the MPMD pipeline-parallelism literature does by hand
+(PAPERS.md: arxiv 2412.14374 attributes throughput loss to pipeline
+bubbles; arxiv 2110.14895 to inter-stage transfer skew), computed from the
+span stream this repo's runtime emits:
+
+- pipeline bubble %: per stage, idle time inside the active window (union
+  of that stage's compute/dispatch intervals vs the fleet-wide window);
+  the headline number is the mean across stages — 0% is a perfectly
+  packed pipeline, (S-1)/S-ish is a fill/drain-dominated one.
+- per-edge wire-time share: each wire track's busy time over the window
+  (how much of the round each edge spent moving bytes).
+- per-microbatch end-to-end latency: for every mb id, last span end minus
+  first span start across ALL ranks (the timeline is already aligned), so
+  p50/p95/p99 reflect the true hop-to-hop path including queueing.
+- failover breakdown: the detection and recovery spans the runtime records
+  around a mid-run death (docs/FAULT_TOLERANCE.md).
+- span_overhead_pct: the recorder's own cost — per-record cost measured
+  live on this host times the span count, over the window — the number
+  that keeps the observability plane honest about its hot-path tax.
+
+Consumed by `tools/trace_report.py` (one JSON line, chaos_dcn idiom) and
+the tests' hand-built timelines.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import SpanRecorder, round_segments, segment_index
+
+# categories that represent a stage doing useful work (bubble accounting)
+BUSY_CATEGORIES = frozenset(("stage", "compute"))
+WIRE_CATEGORY = "wire"
+FAILOVER_CATEGORY = "failover"
+
+
+def _union_ns(intervals: Sequence[Tuple[int, int]]) -> int:
+    """Total length of the union of [t0, t1) intervals."""
+    total = 0
+    end = None
+    for t0, t1 in sorted(intervals):
+        if end is None or t0 > end:
+            total += max(0, t1 - t0)
+            end = t1
+        elif t1 > end:
+            total += t1 - end
+            end = t1
+    return total
+
+
+def measure_span_cost_ns(n: int = 2000) -> float:
+    """Per-record cost of the span recorder on THIS host (ns), measured on
+    a throwaway ring — the basis of `span_overhead_pct`."""
+    rec = SpanRecorder(rank=0, capacity=min(n, 4096))
+    t0 = time.monotonic_ns()
+    for i in range(n):
+        with rec.span("bench", "record", mb=i):
+            pass
+    return (time.monotonic_ns() - t0) / n
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile (no numpy needed for a report tool)."""
+    if not sorted_vals:
+        return 0.0
+    idx = max(0, min(len(sorted_vals) - 1,
+                     int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def analyze_spans(spans: Sequence[dict],
+                  span_cost_ns: Optional[float] = None) -> dict:
+    """One merged-timeline span list -> the report record (plain dict,
+    json-serializable)."""
+    spans = [s for s in spans if s.get("t1") is not None]
+    if not spans:
+        return {"spans": 0}
+    t_min = min(int(s["t0"]) for s in spans)
+    t_max = max(int(s["t1"]) for s in spans)
+    window_ns = max(1, t_max - t_min)
+
+    # -- per-stage busy/idle + bubble % --------------------------------
+    stage_busy: Dict[str, List[Tuple[int, int]]] = {}
+    for s in spans:
+        if s.get("cat") in BUSY_CATEGORIES:
+            stage = s.get("stage")
+            key = (f"stage{stage}" if stage is not None
+                   else f"rank{s.get('rank', 0)}")
+            stage_busy.setdefault(key, []).append(
+                (int(s["t0"]), int(s["t1"])))
+    stages = {}
+    bubble_by_key = {}
+    for key in sorted(stage_busy):
+        busy_ns = _union_ns(stage_busy[key])
+        idle_ns = max(0, window_ns - busy_ns)
+        pct = 100.0 * idle_ns / window_ns
+        stages[key] = {"busy_s": round(busy_ns / 1e9, 6),
+                       "idle_s": round(idle_ns / 1e9, 6),
+                       "bubble_pct": round(pct, 3)}
+        bubble_by_key[key] = pct
+    # headline bubble: mean over stage-indexed tracks when any span carried
+    # a stage id (the rankN fallback tracks shadow the same work on DCN
+    # ranks and would double-count), else over the rank tracks
+    staged = [v for k, v in bubble_by_key.items() if k.startswith("stage")]
+    pool = staged if staged else list(bubble_by_key.values())
+    bubble_pct = round(sum(pool) / len(pool), 3) if pool else None
+
+    # -- per-edge wire share -------------------------------------------
+    edge_busy: Dict[str, List[Tuple[int, int]]] = {}
+    for s in spans:
+        if s.get("cat") == WIRE_CATEGORY:
+            key = f"r{s.get('rank', 0)}:{s.get('name', '')}"
+            edge_busy.setdefault(key, []).append(
+                (int(s["t0"]), int(s["t1"])))
+    edges = {}
+    for key in sorted(edge_busy):
+        busy_ns = _union_ns(edge_busy[key])
+        edges[key] = {"busy_s": round(busy_ns / 1e9, 6),
+                      "share_pct": round(100.0 * busy_ns / window_ns, 3)}
+
+    # -- per-microbatch end-to-end latency -----------------------------
+    # mb ids restart every schedule round (replays, --measure-rounds):
+    # bound each (round, mb) pair separately or a two-round trace would
+    # report whole-run "latencies"
+    segments = round_segments(spans)
+    mb_bounds: Dict[tuple, Tuple[int, int]] = {}
+    for s in spans:
+        mb = s.get("mb")
+        if mb is None or s.get("cat") == "serve":
+            continue
+        t0, t1 = int(s["t0"]), int(s["t1"])
+        key = (segment_index(segments, t0), int(mb))
+        cur = mb_bounds.get(key)
+        mb_bounds[key] = ((t0, t1) if cur is None
+                          else (min(cur[0], t0), max(cur[1], t1)))
+    lat_ms = sorted((t1 - t0) / 1e6 for t0, t1 in mb_bounds.values())
+    mb_latency = {
+        "n": len(lat_ms),
+        "p50_ms": round(_percentile(lat_ms, 50), 3),
+        "p95_ms": round(_percentile(lat_ms, 95), 3),
+        "p99_ms": round(_percentile(lat_ms, 99), 3),
+    }
+
+    # -- failover detection -> recovery breakdown ----------------------
+    failover = {}
+    fo = [s for s in spans if s.get("cat") == FAILOVER_CATEGORY]
+    if fo:
+        by_name: Dict[str, int] = {}
+        for s in fo:
+            by_name[str(s["name"])] = (by_name.get(str(s["name"]), 0)
+                                       + int(s["t1"]) - int(s["t0"]))
+        failover = {name: round(ns / 1e9, 6)
+                    for name, ns in sorted(by_name.items())}
+        # each recover span already runs detection -> replay completion,
+        # so per-event recovery is its own duration (summing or pairing
+        # across events would count healthy time between two failovers)
+        recov = sorted((int(s["t1"]) - int(s["t0"])) / 1e9
+                       for s in fo if s["name"] == "recover")
+        if recov:
+            failover["recoveries_s"] = [round(v, 6) for v in recov]
+            failover["detect_to_recover_s"] = round(max(recov), 6)
+
+    if span_cost_ns is None:
+        span_cost_ns = measure_span_cost_ns()
+    overhead_pct = 100.0 * len(spans) * span_cost_ns / window_ns
+
+    return {
+        "spans": len(spans),
+        "ranks": sorted({int(s.get("rank", 0)) for s in spans}),
+        "window_s": round(window_ns / 1e9, 6),
+        "bubble_pct": bubble_pct,
+        "stages": stages,
+        "edges": edges,
+        "mb_latency": mb_latency,
+        "failover": failover,
+        "span_cost_ns": round(span_cost_ns, 1),
+        "span_overhead_pct": round(overhead_pct, 4),
+    }

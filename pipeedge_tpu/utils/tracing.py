@@ -52,7 +52,44 @@ def trace(out_dir: Optional[str]) -> Iterator[None]:
             logger.warning("trace stop failed: %s", exc)
 
 
+class _SafeAnnotation:
+    """TraceAnnotation wrapper that degrades to a no-op if the profiler
+    backend rejects entry (e.g. a second concurrent session) — the same
+    graceful fallback `trace()` applies, honoring the module contract."""
+
+    __slots__ = ("_inner", "_entered")
+
+    def __init__(self, inner):
+        self._inner = inner
+        self._entered = False
+
+    def __enter__(self):
+        try:
+            self._inner.__enter__()
+            self._entered = True
+        except Exception as exc:  # profiler busy/unavailable: no-op region
+            logger.warning("annotate unavailable (%s); continuing without",
+                           exc)
+        return self
+
+    def __exit__(self, *exc):
+        if not self._entered:
+            return False
+        self._entered = False
+        try:
+            return self._inner.__exit__(*exc)
+        except Exception as err:
+            logger.warning("annotate exit failed: %s", err)
+            return False
+
+
 def annotate(name: str):
-    """Named region on the profiler timeline (host + linked device ops)."""
-    import jax
-    return jax.profiler.TraceAnnotation(name)
+    """Named region on the profiler timeline (host + linked device ops);
+    degrades to a no-op context manager when the profiler backend is
+    unavailable, like `trace()`."""
+    try:
+        import jax
+        return _SafeAnnotation(jax.profiler.TraceAnnotation(name))
+    except Exception as exc:  # import/constructor failure: degrade
+        logger.warning("annotate unavailable (%s); continuing without", exc)
+        return contextlib.nullcontext()

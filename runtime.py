@@ -32,7 +32,9 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 import monitoring
+from pipeedge_tpu import telemetry
 from pipeedge_tpu.comm import CMD_DEAD, CMD_SCHED, CMD_STOP
+from pipeedge_tpu.telemetry import metrics as prom
 from pipeedge_tpu.models import get_microbatch_size, registry
 from pipeedge_tpu.parallel import pipeline as host_pipeline
 from pipeedge_tpu.parallel import spmd
@@ -88,6 +90,26 @@ failover_event = threading.Event()
 # optional result capture (--save-results): handle_results appends every
 # delivered output here so runs can be compared bit-for-bit
 _results_sink: Optional[list] = None
+# failover telemetry: monotonic_ns stamps of each death detection, consumed
+# by the data rank's recovery span (detection -> replay-round completion)
+_failover_detect_ns: List[int] = []
+
+# /metrics plane (pipeedge_tpu/telemetry/metrics.py): the DCN transport
+# hooks feed these; tools/serve.py renders the same registry
+_WIRE_BYTES = prom.REGISTRY.counter(
+    "pipeedge_edge_wire_bytes_total",
+    "bytes moved over DCN pipeline edges, by direction and peer rank")
+_EDGE_BITS = prom.REGISTRY.gauge(
+    "pipeedge_edge_bits",
+    "negotiated wire bitwidth per DCN edge (0 = uncompressed)")
+_HEARTBEATS_RX = prom.REGISTRY.counter(
+    "pipeedge_heartbeats_received_total",
+    "liveness-plane heartbeat frames received, by sender rank")
+_FAILOVER_EVENTS = prom.REGISTRY.counter(
+    "pipeedge_failover_events_total",
+    "mid-run peer deaths entering the failover path")
+_PEER_DEATHS = prom.REGISTRY.counter(
+    "pipeedge_peer_deaths_total", "peer deaths observed (any mode)")
 
 
 def handle_cmd(cmd: int, tensors: Tuple) -> None:
@@ -106,11 +128,30 @@ def handle_cmd(cmd: int, tensors: Tuple) -> None:
         dead = int(np.asarray(tensors[0]))
         logger.warning("handle_cmd: rank %d announced dead (failover)", dead)
         with dead_lock:
+            known = dead in dead_ranks
             dead_ranks.add(dead)
+        if not known:
+            # every survivor may broadcast the same death; count the EVENT
+            # once and stamp detection once, or the failover metrics/spans
+            # multiply by the fleet size
+            _record_failover_detect(dead)
         failover_event.set()
         monitoring.flush()
     else:
         logger.warning("handle_cmd: Unknown command: %s", cmd)
+
+
+def _record_failover_detect(dead: int, failover: bool = True) -> None:
+    """First-observation bookkeeping for a peer death: one detect span,
+    one detection stamp (the recovery span's start), one death count —
+    callers dedupe against dead_ranks (or stop_info) before calling.
+    `failover=False` (abort path) skips the failover-event counter."""
+    now = time.monotonic_ns()
+    telemetry.record("failover", "detect", now, now)
+    _failover_detect_ns.append(now)
+    _PEER_DEATHS.inc(peer=str(dead))
+    if failover:
+        _FAILOVER_EVENTS.inc()
 
 
 def get_window_size() -> int:
@@ -405,8 +446,9 @@ def _register_dcn_monitor_hooks(ctx) -> None:
             if tensors is None:  # transfer aborted mid-frame
                 monitoring.iteration_abort(key)
                 return
-            mbits = sum(int(t.nbytes) for t in tensors) * 8 / 1e6
-            monitoring.iteration(key, work=mbits)
+            nbytes = sum(int(t.nbytes) for t in tensors)
+            _WIRE_BYTES.inc(nbytes, direction=key, peer=str(peer))
+            monitoring.iteration(key, work=nbytes * 8 / 1e6)
 
         return pre, post
 
@@ -468,8 +510,13 @@ def run_pipeline_host(args, stage_layers, stage_quant, stage_ranks,
             for lb in labels:
                 label_queue.put(lb)
         tik = time.monotonic()
+        t_span0 = time.monotonic_ns()
         _, stats = pipe.run(inputs)
         tok = time.monotonic()
+        # round track: mb ids restart each measure round; the segmenting
+        # consumers (report/flows) key on these intervals
+        telemetry.record("runtime", f"round{rnd}", t_span0,
+                         time.monotonic_ns())
         if args.measure_rounds > 1:
             batch_total = sum(len(u) for u in ubatches)
             print(f"round={rnd} latency_sec={tok - tik:.6f} "
@@ -683,6 +730,7 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
                     dead_ranks.add(dead)
                 if announced:
                     return
+                _record_failover_detect(dead)
                 logger.error("rank %d: peer rank %d died; entering failover",
                              rank, dead)
                 failover_event.set()
@@ -699,6 +747,7 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
             # the DATA rank's death is never survivable — it alone holds
             # the ledger, the inputs, and the orchestration — so even in
             # failover mode it takes the abort path below
+            _record_failover_detect(dead, failover=False)
             logger.error("rank %d: peer rank %d died; stopping the pipeline",
                          rank, dead)
             stop_info[0] = dead
@@ -720,6 +769,7 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
             # raw context call: CSV row + window accounting WITHOUT the
             # facade's per-beat instant log lines — world_size beats per
             # interval would bury the very lines failover forensics greps
+            _HEARTBEATS_RX.inc(src=str(src))
             with monitoring.get_locked_context(MONITORING_KEY_LIVENESS) \
                     as mctx:
                 if mctx is not None:
@@ -734,51 +784,76 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
             else None)
         results_target = [0]
         if rank == data_rank:
-            rnd = 0
-            for stage_layers, stage_quant, stage_ranks in schedules:
-                sched = (stage_layers, stage_quant, stage_ranks)
-                ledger = None
-                if failover_mode:
-                    # clear BEFORE snapshotting: a death landing in between
-                    # is caught by the snapshot (its rank is added to
-                    # dead_ranks before the event is set), and a death
-                    # landing after re-sets the event and fails the round
-                    # over normally — never both missed
-                    failover_event.clear()
-                    with dead_lock:
-                        dead_now = set(dead_ranks)
-                    if dead_now:
-                        # a LATER schedule round may still name a rank that
-                        # died earlier in the run; remap before broadcasting
-                        sched = _plan_failover(args, sched, world_size,
-                                               dead_now)
-                        if sched is None:
+            # span collection runs in the finally so round end, abort, AND
+            # failover all leave a merged trace (best-effort, like
+            # CMD_STOP): on the clean path it runs BEFORE the empty
+            # CMD_SCHED below, while every worker is still serving frames
+            try:
+                rnd = 0
+                fo_t0 = None   # recovery span: detection stamp, if any
+                for stage_layers, stage_quant, stage_ranks in schedules:
+                    sched = (stage_layers, stage_quant, stage_ranks)
+                    ledger = None
+                    if failover_mode:
+                        # clear BEFORE snapshotting: a death landing in
+                        # between is caught by the snapshot (its rank is
+                        # added to dead_ranks before the event is set),
+                        # and a death landing after re-sets the event and
+                        # fails the round over normally — never both missed
+                        failover_event.clear()
+                        with dead_lock:
+                            dead_now = set(dead_ranks)
+                        if dead_now:
+                            # a LATER schedule round may still name a rank
+                            # that died earlier; remap before broadcasting
+                            sched = _plan_failover(args, sched, world_size,
+                                                   dead_now)
+                            if sched is None:
+                                _abort_no_capacity(ctx, dead_now)
+                        ledger = _MicrobatchLedger(ubatches, labels)
+                    while True:
+                        if rnd:
+                            logger.info("re-schedule: broadcasting round %d "
+                                        "(partition %s)", rnd, sched[0])
+                        status = _dcn_round(args, ctx, rnd, *sched, ubatches,
+                                            labels, dtype, results_target,
+                                            ledger=ledger)
+                        rnd += 1
+                        if status != "failover":
+                            if fo_t0 is not None:
+                                # detection -> replay-round completion: the
+                                # trace_report failover breakdown; consume
+                                # this episode's stamps so the next episode
+                                # starts from its own first detection
+                                telemetry.record("failover", "recover",
+                                                 fo_t0, time.monotonic_ns())
+                                fo_t0 = None
+                                del _failover_detect_ns[:]
+                            break
+                        if fo_t0 is None:
+                            # FIRST detection of this episode (appends are
+                            # deduped per dead rank)
+                            fo_t0 = (_failover_detect_ns[0]
+                                     if _failover_detect_ns
+                                     else time.monotonic_ns())
+                        # clear-then-snapshot, same ordering as above
+                        failover_event.clear()
+                        with dead_lock:
+                            dead_now = set(dead_ranks)
+                        replay = ledger.pending()
+                        with telemetry.span("failover", "reschedule"):
+                            planned = _plan_failover(args, sched, world_size,
+                                                     dead_now)
+                        if planned is None:
                             _abort_no_capacity(ctx, dead_now)
-                    ledger = _MicrobatchLedger(ubatches, labels)
-                while True:
-                    if rnd:
-                        logger.info("re-schedule: broadcasting round %d "
-                                    "(partition %s)", rnd, sched[0])
-                    status = _dcn_round(args, ctx, rnd, *sched, ubatches,
-                                        labels, dtype, results_target,
-                                        ledger=ledger)
-                    rnd += 1
-                    if status != "failover":
-                        break
-                    # clear-then-snapshot, same ordering argument as above
-                    failover_event.clear()
-                    with dead_lock:
-                        dead_now = set(dead_ranks)
-                    replay = ledger.pending()
-                    planned = _plan_failover(args, sched, world_size,
-                                             dead_now)
-                    if planned is None:
-                        _abort_no_capacity(ctx, dead_now)
-                    logger.warning(
-                        "failover: rank(s) %s dead; re-scheduling over "
-                        "survivors and replaying %d unacknowledged "
-                        "microbatch(es)", sorted(dead_now), len(replay))
-                    sched = planned
+                        logger.warning(
+                            "failover: rank(s) %s dead; re-scheduling over "
+                            "survivors and replaying %d unacknowledged "
+                            "microbatch(es)", sorted(dead_now), len(replay))
+                        sched = planned
+            finally:
+                if getattr(args, "trace_spans", None):
+                    _collect_write_spans(ctx, args)
             # no more rounds: an empty schedule releases the workers.
             # fleet_shutdown first, so peers closing in response are not
             # taken for deaths.
@@ -818,6 +893,37 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
                 _dcn_round(args, ctx, rnd, stage_layers, stage_quant,
                            stage_ranks, [], [], dtype, results_target)
                 rnd += 1
+
+
+def _collect_write_spans(ctx, args) -> None:
+    """Gather every live peer's span ring over the command channel (clock-
+    aligned NTP-style, dcn.collect_spans), merge with the local ring, and
+    write the Perfetto-loadable trace to `--trace-spans`. Best-effort like
+    CMD_STOP: an unreachable or span-less peer is skipped, never fatal —
+    this runs on abort paths where peers may already be gone."""
+    from pipeedge_tpu.telemetry import chrome_trace
+
+    rec = telemetry.recorder()
+    if rec is None:
+        return
+    merged = rec.snapshot()
+    ranks_seen = 1
+    dead = ctx.dead_ranks()
+    for dst in range(args.worldsize):
+        if dst == args.rank or dst in dead:
+            continue
+        try:
+            spans, offset = ctx.collect_spans(dst, timeout=5.0)
+        except Exception as exc:  # noqa: BLE001 - skip unreachable peers
+            logger.warning("trace-spans: collection from rank %d failed "
+                           "(%s); the trace will omit it", dst, exc)
+            continue
+        merged.extend(telemetry.align_spans(spans, offset))
+        ranks_seen += 1
+    chrome_trace.dump_trace(merged, args.trace_spans)
+    logger.info("trace-spans: %d span(s) from %d rank(s) -> %s (load in "
+                "ui.perfetto.dev; report: python tools/trace_report.py %s)",
+                len(merged), ranks_seen, args.trace_spans, args.trace_spans)
 
 
 def _abort_no_capacity(ctx, dead_now: set) -> None:
@@ -932,6 +1038,7 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                            f"{stop_info[0]} died")
     # fresh round state BEFORE the schedule goes out: once peers have the
     # schedule they may finish the round (CMD_STOP) at any time
+    t_round0 = time.monotonic_ns()
     stop_event.clear()
     if rank == data_rank:
         # schedule resolved by the caller; broadcast it (CMD_SCHED,
@@ -979,6 +1086,8 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
             adaptive = None if edge is None else _make_adaptive_callback(
                 [edge], get_window_size())
             ubatch_idx = [0]
+            mb_seq = [0]   # dispatch-order fallback mb id (non-failover
+            # frames carry no microbatch id on the wire)
 
             # head stage is fed over the wire from the data rank
             # (self-connection over loopback when colocated) on the FEED
@@ -1014,6 +1123,7 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                             "out; keeping bit=%d", rank, rank_dst, proposed)
                         agreed = proposed
                     agreed_bits[proposed] = agreed
+                _EDGE_BITS.set(agreed, edge=f"{rank}->{rank_dst}")
                 return agreed
 
             if edge is not None and edge.quant_bit:
@@ -1042,9 +1152,16 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                                           else None)
                 else:
                     payload = _wire_decode(tensors, dtype)
-                out = fn(params, payload)
-                pending = _wire_encode_device(
-                    out, edge.quant_bit if edge is not None else 0)
+                mb = (int(np.asarray(mbid).reshape(-1)[0])
+                      if mbid is not None else mb_seq[0])
+                mb_seq[0] += 1
+                # compute span: host dispatch of the jitted shard step
+                # (async under jit — device completion lands in the stage
+                # readback span, where the wire payload materializes)
+                with telemetry.span("compute", f"stage{i}", stage=i, mb=mb):
+                    out = fn(params, payload)
+                    pending = _wire_encode_device(
+                        out, edge.quant_bit if edge is not None else 0)
                 first = out[0] if isinstance(out, tuple) else out
                 # keep the raw device output alive through the hand-off
                 # queue ONLY when the adaptive policy will read it — at
@@ -1080,6 +1197,10 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
             stage = dcn.DcnPipelineStage(
                 ctx, rank_src, rank_dst,
                 dispatch_cb=dispatch_cb, readback_cb=readback_cb,
+                # failover frames lead with the global microbatch id: tag
+                # the stage spans with it so replays trace correctly
+                mb_of=((lambda ts: int(np.asarray(ts[0]).reshape(-1)[0]))
+                       if failover_mode else None),
                 depth=args.stage_depth or None,
                 recv_channel=(dcn.CHANNEL_FEED if is_first
                               else dcn.CHANNEL_DATA) + parity,
@@ -1132,12 +1253,14 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                         except ConnectionError:
                             return
                         mbid = int(np.asarray(tensors[0]).reshape(-1)[0])
-                        out = _wire_decode(tensors[1:], dtype)
-                        if not ledger.ack(mbid, np.asarray(out)):
-                            logger.info("failover: duplicate result for "
-                                        "microbatch %d dropped", mbid)
+                        with telemetry.span("results", "deliver", mb=mbid):
+                            out = _wire_decode(tensors[1:], dtype)
+                            if not ledger.ack(mbid, np.asarray(out)):
+                                logger.info("failover: duplicate result "
+                                            "for microbatch %d dropped",
+                                            mbid)
                     return
-                for _ in range(len(ubatches)):
+                for mbid in range(len(ubatches)):
                     if stop_event.is_set():
                         return
                     try:
@@ -1148,8 +1271,9 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                         # timeout, or the last stage died: the peer-death
                         # handler aborts the run; just stop consuming
                         return
-                    out = _wire_decode(tensors, dtype)
-                    handle_results(np.asarray(out))
+                    with telemetry.span("results", "deliver", mb=mbid):
+                        out = _wire_decode(tensors, dtype)
+                        handle_results(np.asarray(out))
 
             results_thread = threading.Thread(target=results_loop,
                                               daemon=True)
@@ -1167,16 +1291,21 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                                     failover_event.is_set()
                                     and death_hits_schedule()):
                                 return
-                            ctx.send_tensors(
-                                first_rank,
-                                [np.asarray(mbid, np.int64), np.asarray(u)],
-                                channel=dcn.CHANNEL_FEED + parity)
+                            with telemetry.span("feed", f"mb{mbid}",
+                                                mb=mbid):
+                                ctx.send_tensors(
+                                    first_rank,
+                                    [np.asarray(mbid, np.int64),
+                                     np.asarray(u)],
+                                    channel=dcn.CHANNEL_FEED + parity)
                         return
-                    for u in ubatches:
+                    for mbid, u in enumerate(ubatches):
                         if stop_event.is_set():
                             return
-                        ctx.send_tensors(first_rank, [np.asarray(u)],
-                                         channel=dcn.CHANNEL_FEED + parity)
+                        with telemetry.span("feed", f"mb{mbid}", mb=mbid):
+                            ctx.send_tensors(first_rank, [np.asarray(u)],
+                                             channel=dcn.CHANNEL_FEED
+                                             + parity)
                 except OSError as exc:
                     logger.error("feeding stage rank %d failed (%s)",
                                  first_rank, exc)
@@ -1276,6 +1405,10 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                     f"rank {rank}: no CMD_STOP within "
                     f"{args.sched_timeout}s; aborting")
     finally:
+        # the round track frames every other span of this round on the
+        # merged timeline (trace_report's window)
+        telemetry.record("runtime", f"round{rnd}", t_round0,
+                         time.monotonic_ns())
         if stage is not None:
             stage.stop()
 
@@ -1394,6 +1527,16 @@ def main():
     parser.add_argument("--trace", type=str, default=None, metavar="DIR",
                         help="capture a JAX profiler trace of the run into "
                              "DIR (view with tensorboard/perfetto)")
+    parser.add_argument("--trace-spans", type=str, default=None,
+                        metavar="OUT",
+                        help="record runtime spans (dispatch/compute/"
+                             "readback/wire/feed/results/failover) and "
+                             "write a merged Perfetto-loadable trace JSON "
+                             "to OUT. In dcn mode the data rank gathers "
+                             "every rank's spans over the command channel "
+                             "with NTP-style clock alignment (pass the "
+                             "flag to every rank); analyze with "
+                             "tools/trace_report.py")
     parser.add_argument("--measure-rounds", type=int, default=1,
                         help="host driver: run the ubatch stream this many "
                              "times, printing a latency line per round "
@@ -1531,6 +1674,12 @@ def main():
     if args.save_results and not is_dcn_worker:
         _results_sink = []
 
+    if args.trace_spans:
+        # every rank records; in dcn mode the data rank merges the fleet
+        # (workers serve their rings over _MSG_SPANS), single-controller
+        # drivers write their own single-rank timeline below
+        telemetry.configure(rank=args.rank if args.comm == "dcn" else 0)
+
     try:
         comm = args.comm
         if comm in ("p2p", "rpc"):
@@ -1560,6 +1709,13 @@ def main():
         if comm != "dcn":
             assert results_counter.wait_gte(
                 sum(len(u) for u in ubatches), timeout=300)
+            if args.trace_spans and telemetry.recorder() is not None:
+                # single-controller drivers: one rank, no collection pass
+                from pipeedge_tpu.telemetry import chrome_trace
+                spans = telemetry.recorder().snapshot()
+                chrome_trace.dump_trace(spans, args.trace_spans)
+                logger.info("trace-spans: %d span(s) -> %s", len(spans),
+                            args.trace_spans)
         if _results_sink is not None:
             np.savez(args.save_results,
                      *[np.asarray(o) for o in _results_sink])

@@ -156,3 +156,52 @@ def test_facade_flush_safe_anytime(tmp_path, monkeypatch):
     assert len(rows) == 2
     monitoring_facade.finish()
     monitoring_facade.flush()          # after finish: no-op
+
+
+def test_snapshot_matrix_all_keys(tmp_path, monkeypatch):
+    """`snapshot()` returns the whole (instant|window|global) getter matrix
+    for every key in ONE dict, matching the individual getters — the
+    single synchronized read the telemetry/metrics export consumes."""
+    monkeypatch.chdir(tmp_path)
+    log = tmp_path / "a.csv"
+    with MonitorContext(key="a", window_size=2, log_name=str(log)) as ctx:
+        ctx.add_heartbeat(key="b", log_name=None)
+        for i in range(5):
+            ctx.iteration_start(key="a")
+            time.sleep(0.001)
+            ctx.iteration(key="a", work=3, accuracy=i)
+        ctx.iteration_start(key="b")
+        ctx.iteration(key="b", work=7)
+        snap = ctx.snapshot()
+        assert set(snap) == {"a", "b"}
+        for key in ("a", "b"):
+            assert set(snap[key]) == {"instant", "window", "global",
+                                      "tag", "window_size"}
+            for scope in ("instant", "window", "global"):
+                assert set(snap[key][scope]) == {
+                    "time_s", "heartrate", "work", "perf", "energy_j",
+                    "power_w", "accuracy", "accuracy_rate"}
+        # values agree with the per-key getter matrix
+        assert snap["a"]["global"]["work"] == ctx.get_global_work(key="a")
+        assert snap["a"]["window"]["work"] == ctx.get_window_work(key="a")
+        assert snap["a"]["instant"]["work"] == 3
+        assert snap["a"]["global"]["perf"] == ctx.get_global_perf(key="a")
+        assert snap["a"]["tag"] == 5 and snap["a"]["window_size"] == 2
+        assert snap["b"]["global"]["work"] == 7
+
+
+def test_facade_snapshot(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    assert monitoring_facade.snapshot() == {}      # no session
+    monitoring_facade.init("k", 2)
+    try:
+        monitoring_facade.add_key("j", work_type="Mbits")
+        monitoring_facade.iteration_start("k")
+        monitoring_facade.iteration("k", work=4)
+        snap = monitoring_facade.snapshot()
+        assert set(snap) == {"k", "j"}
+        assert snap["k"]["global"]["work"] == 4
+        assert snap["j"]["tag"] == 0
+    finally:
+        monitoring_facade.finish()
+    assert monitoring_facade.snapshot() == {}      # after finish

@@ -672,3 +672,59 @@ def test_degraded_in_flight_request_replayed(solo_pipe):
         svc.exit_degraded()
     finally:
         svc.stop()
+
+
+def _get_text(port, path, timeout=30):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=timeout) as resp:
+        return resp.headers.get("Content-Type", ""), resp.read().decode()
+
+
+_PROM_LINE_RE = __import__("re").compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})?'
+    r" [-+]?([0-9]*\.?[0-9]+([eE][-+]?[0-9]+)?|Inf|NaN)$")
+
+
+def test_metrics_endpoint_prometheus(server):
+    """GET /metrics: Prometheus text format with the request-latency
+    histogram, per-edge wire-byte counters, and the degraded/failover
+    history — and /healthz's stats agree with it (one source of truth)."""
+    port = server
+    _post(port, "/generate", {"ids": [[1, 2, 3]], "new_tokens": 2})
+    ctype, text = _get_text(port, "/metrics")
+    assert ctype.startswith("text/plain")
+    for line in text.splitlines():
+        if line and not line.startswith("#"):
+            assert _PROM_LINE_RE.match(line), f"bad line: {line!r}"
+    # request metrics present and live
+    assert "# TYPE pipeedge_serve_request_latency_seconds histogram" in text
+    assert "pipeedge_serve_request_latency_seconds_count" in text
+    assert 'pipeedge_serve_requests_total{endpoint="/generate",' \
+           'status="200"}' in text
+    # per-edge wire-byte counters: the 2-stage server has one edge,
+    # pre-declared so it renders even before traffic, nonzero after
+    assert 'pipeedge_serve_edge_wire_bytes_total{edge="0->1"}' in text
+    edge_val = [line for line in text.splitlines()
+                if line.startswith('pipeedge_serve_edge_wire_bytes_total')]
+    assert any(float(line.rsplit(" ", 1)[1]) > 0 for line in edge_val)
+    # degraded/failover history starts clean and matches healthz
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=30) as resp:
+        stats = json.loads(resp.read())["stats"]
+    assert "pipeedge_serve_degraded_entered_total" in text
+    assert {"degraded_entered_total", "failover_replays_total",
+            "last_dead_rank"} <= set(stats)
+    # open+close a degraded window: both surfaces move together
+    _post(port, "/degraded", {"degraded": True, "dead_rank": 3,
+                              "retry_after": 1})
+    _post(port, "/degraded", {"degraded": False})
+    _, text2 = _get_text(port, "/metrics")
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=30) as resp:
+        stats2 = json.loads(resp.read())["stats"]
+    assert stats2["degraded_entered_total"] == \
+        stats["degraded_entered_total"] + 1
+    assert stats2["last_dead_rank"] == 3
+    assert "pipeedge_serve_last_dead_rank 3" in text2

@@ -8,14 +8,27 @@ admit as they arrive, share the pipeline, and prompt prefixes
 registered once via /prefix are reused by any number of /generate
 requests (prompt caching).
 
-Endpoints (all JSON):
+Endpoints (all JSON unless noted):
 - GET  /healthz            -> {"ok", "model", "stages", "speculative",
                                "executor", "degraded": false | {"dead_rank",
                                "since_s", "retry_after"},
                                "stats": {tokens, active,
-                               pending, prefixes, ...; stage mode adds
-                               per-worker stage_steps/busy/queued}};
+                               pending, prefixes,
+                               degraded_entered_total,
+                               failover_replays_total, last_dead_rank, ...;
+                               stage mode adds per-worker
+                               stage_steps/busy/queued}};
                                HTTP 503 once a serving worker has died
+- GET  /metrics            -> Prometheus text format (the observability
+                              plane, docs/OBSERVABILITY.md): request count/
+                              latency histogram, tokens served, per-edge
+                              activation wire-byte counters, degraded/
+                              failover counters — plus every monitoring
+                              key's (instant|window|global) matrix as
+                              gauges when a monitoring session is open,
+                              and whatever the runtime's DCN hooks fed
+                              into the shared registry (wire bytes,
+                              negotiated edge bitwidths, heartbeats)
 - POST /degraded {"degraded": bool, "dead_rank"?: n, "retry_after"?: s}
                            -> {"degraded": bool} — the failover
                               orchestrator's hook: while degraded, new
@@ -73,6 +86,9 @@ from typing import Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from pipeedge_tpu import telemetry  # noqa: E402
+from pipeedge_tpu.telemetry import metrics as prom  # noqa: E402
+
 
 class ServiceDegraded(RuntimeError):
     """The service is in a failover window (a backing stage died): new
@@ -92,7 +108,7 @@ class _Service:
     and wait for (or stream) their results."""
 
     def __init__(self, pipe, max_active=None, max_prefixes=8, spec=None,
-                 executor="wave"):
+                 executor="wave", edge_itemsize=2):
         from collections import OrderedDict
 
         from pipeedge_tpu.parallel.batcher import (ContinuousBatcher,
@@ -101,6 +117,42 @@ class _Service:
         self.spec = spec
         self.executor = executor
         self.cond = threading.Condition()
+        # -- /metrics + healthz counters (one source of truth) ----------
+        # the registry instruments below ARE the state: healthz's stats
+        # read them back (stats()), so both surfaces always agree — even
+        # across a _Service rebuild in the same process (get_or_create
+        # returns the surviving instruments)
+        self._edge_itemsize = int(edge_itemsize)
+        self.m_requests = prom.REGISTRY.counter(
+            "pipeedge_serve_requests_total",
+            "generate requests by endpoint and outcome status")
+        self.m_tokens = prom.REGISTRY.counter(
+            "pipeedge_serve_tokens_total", "tokens generated (rows x steps)")
+        self.m_latency = prom.REGISTRY.histogram(
+            "pipeedge_serve_request_latency_seconds",
+            "end-to-end generate latency (request receipt -> result)")
+        self.m_degraded = prom.REGISTRY.counter(
+            "pipeedge_serve_degraded_entered_total",
+            "failover windows opened via POST /degraded")
+        self.m_replays = prom.REGISTRY.counter(
+            "pipeedge_serve_failover_replays_total",
+            "in-flight requests replayed after a degraded window closed")
+        self.m_last_dead = prom.REGISTRY.gauge(
+            "pipeedge_serve_last_dead_rank",
+            "rank named by the most recent degraded window (-1 = none)")
+        self.m_last_dead.set(-1)
+        # distinct name from runtime.py's pipeedge_edge_wire_bytes_total
+        # (measured DCN socket bytes, direction/peer labels): these are
+        # estimated device-edge activation bytes — merging the two under
+        # one family would let sum() silently add different quantities
+        self.m_edge_bytes = prom.REGISTRY.counter(
+            "pipeedge_serve_edge_wire_bytes_total",
+            "per-edge activation bytes moved by completed requests "
+            "(prefill + decode steps, estimated from shapes)")
+        # the full per-edge matrix renders from the first scrape, not the
+        # first request
+        for i in range(len(pipe.stages) - 1):
+            self.m_edge_bytes.declare(edge=f"{i}->{i + 1}")
         # speculative generations hold THIS lock, not self.cond: plain
         # requests and result waits proceed concurrently (the pipeline's
         # jitted programs are thread-safe; serializing speculative
@@ -192,6 +244,9 @@ class _Service:
                                   "since": time.monotonic(),
                                   "retry_after": float(retry_after)}
             self.cond.notify_all()
+        self.m_degraded.inc()
+        if dead_rank is not None:
+            self.m_last_dead.set(int(dead_rank))
 
     def exit_degraded(self):
         with self.cond:
@@ -224,6 +279,25 @@ class _Service:
         the draft only changes the dispatch count). Holds only the
         dedicated spec lock during the generation — concurrent plain
         requests keep flowing through the executor."""
+        t0 = time.monotonic()
+        try:
+            out = self._generate_speculative_once(ids, new_tokens,
+                                                  prefix_id)
+        except ServiceDegraded:
+            self.m_requests.inc(endpoint="/generate-speculative",
+                                status="503")
+            raise
+        except BaseException:
+            self.m_requests.inc(endpoint="/generate-speculative",
+                                status="error")
+            raise
+        self.m_latency.observe(time.monotonic() - t0)
+        self.m_requests.inc(endpoint="/generate-speculative", status="200")
+        self.m_tokens.inc(len(ids) * int(new_tokens))
+        self._account_edge_bytes(ids, int(new_tokens))
+        return out
+
+    def _generate_speculative_once(self, ids, new_tokens, prefix_id):
         import numpy as np
         if self.spec is None:
             raise KeyError("server started without --draft-model; "
@@ -240,7 +314,7 @@ class _Service:
                         "draft model is configured)")
                 self.prefixes.move_to_end(prefix_id)   # LRU touch
                 prefix = self.spec_prefixes[prefix_id]
-        with self.spec_lock:
+        with self.spec_lock, telemetry.span("serve", "speculative"):
             return np.asarray(self.spec.generate(ids, new_tokens,
                                                  prefix=prefix))
 
@@ -272,6 +346,23 @@ class _Service:
             kw["prefix"] = self.prefixes[pid]
 
     def generate(self, ids, new_tokens, on_token=None, **kw):
+        t0 = time.monotonic()
+        try:
+            with telemetry.span("serve", "generate"):
+                out = self._generate_policied(ids, new_tokens, on_token, kw)
+        except ServiceDegraded:
+            self.m_requests.inc(endpoint="/generate", status="503")
+            raise
+        except BaseException:
+            self.m_requests.inc(endpoint="/generate", status="error")
+            raise
+        self.m_latency.observe(time.monotonic() - t0)
+        self.m_requests.inc(endpoint="/generate", status="200")
+        self.m_tokens.inc(len(ids) * int(new_tokens))
+        self._account_edge_bytes(ids, int(new_tokens))
+        return out
+
+    def _generate_policied(self, ids, new_tokens, on_token, kw):
         with self.cond:
             self._check_dead()
             self._check_admittable()   # degraded: 503 + Retry-After
@@ -286,7 +377,23 @@ class _Service:
             # streamed requests, whose partial output cannot be unsent.
             if on_token is not None or not self._await_recovery():
                 raise
+            self.m_replays.inc()
             return self._generate_once(ids, new_tokens, on_token, kw)
+
+    def _account_edge_bytes(self, ids, new_tokens: int) -> None:
+        """Per-edge activation traffic of one completed request: every
+        inter-stage boundary moves a [B, S, H] prefill payload plus a
+        [B, 1, H] payload per decode step (host-driven device edges — the
+        serving analogue of the DCN wire counters)."""
+        n_edges = len(self.pipe.stages) - 1
+        if n_edges <= 0:
+            return
+        hidden = getattr(self.pipe.cfg, "hidden_size", 0)
+        prompt_len = max(len(r) for r in ids) if ids else 0
+        per_edge = (len(ids) * (prompt_len + max(0, new_tokens - 1))
+                    * hidden * self._edge_itemsize)
+        for i in range(n_edges):
+            self.m_edge_bytes.inc(per_edge, edge=f"{i}->{i + 1}")
 
     def _generate_once(self, ids, new_tokens, on_token, kw):
         if self.exec is not None:
@@ -317,11 +424,19 @@ class _Service:
             s = self.exec.snapshot()
             s["pending"] = 0          # admission blocks in submit threads
             s["prefixes"] = len(self.prefixes)
-            return s
-        return dict(self.batcher.stats,
-                    active=self.batcher.active,
-                    pending=len(self.batcher.pending),
-                    prefixes=len(self.prefixes))
+        else:
+            s = dict(self.batcher.stats,
+                     active=self.batcher.active,
+                     pending=len(self.batcher.pending),
+                     prefixes=len(self.prefixes))
+        # degraded/failover history: read back from the SAME registry
+        # instruments /metrics renders, so the two surfaces cannot diverge
+        s["degraded_entered_total"] = int(self.m_degraded.value())
+        s["failover_replays_total"] = int(self.m_replays.value())
+        last = self.m_last_dead.value()
+        s["last_dead_rank"] = (None if last is None or last < 0
+                               else int(last))
+        return s
 
     def stop(self):
         with self.cond:
@@ -433,7 +548,18 @@ def make_handler(service, model_name):
                 q.put(("error", exc))      # terminal stream line
 
         def do_GET(self):
-            if self.path == "/healthz":
+            if self.path == "/metrics":
+                import monitoring
+                extra = prom.render_monitoring_snapshot(
+                    monitoring.snapshot())
+                body = prom.REGISTRY.render(extra=extra).encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif self.path == "/healthz":
                 dead = service.dead is not None
                 deg = service.degraded_info
                 degraded = False
@@ -542,6 +668,10 @@ def main():
                    help="LRU bound on registered prompt prefixes (each "
                         "handle retains full max_len KV buffers)")
     p.add_argument("--port", default=8321, type=int)
+    p.add_argument("--trace-spans", default=None, metavar="OUT",
+                   help="record request/stage spans and write a Perfetto-"
+                        "loadable trace JSON to OUT on shutdown "
+                        "(tools/trace_report.py analyzes it)")
     args = p.parse_args()
 
     from pipeedge_tpu.utils import apply_env_platform
@@ -570,9 +700,16 @@ def main():
             attend_floor=args.attend_floor)
         spec = SpeculativeDecoder(pipe, d_pipe, gamma=args.gamma)
 
+    if args.trace_spans:
+        telemetry.configure(rank=0)
+        # SIGTERM must unwind through the finally below (the default
+        # handler would kill the process before the trace is written)
+        import signal
+        signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))
     service = _Service(pipe, max_active=args.max_active,
                        max_prefixes=args.max_prefixes, spec=spec,
-                       executor=args.executor)
+                       executor=args.executor,
+                       edge_itemsize=2 if args.dtype == "bfloat16" else 4)
     server = ThreadingHTTPServer(("127.0.0.1", args.port),
                                  make_handler(service, args.model_name))
     print(f"serving {args.model_name} ({len(pipe.stages)} stages, "
@@ -581,6 +718,10 @@ def main():
         server.serve_forever()
     finally:
         service.stop()
+        if args.trace_spans and telemetry.recorder() is not None:
+            from pipeedge_tpu.telemetry import chrome_trace
+            chrome_trace.dump_trace(telemetry.recorder().snapshot(),
+                                    args.trace_spans)
 
 
 if __name__ == "__main__":

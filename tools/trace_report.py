@@ -1,0 +1,61 @@
+"""Bubble attribution + latency report over a merged DCN trace.
+
+Reads the Perfetto-loadable trace JSON a `--trace-spans OUT` run wrote
+(runtime.py's merged fleet timeline) and emits ONE JSON line — the
+chaos_dcn.py idiom — with:
+
+- `bubble_pct`: mean per-stage idle share of the active window, plus the
+  per-stage busy/idle split under `stages`
+- `edges`: per-edge wire-time busy seconds + share of the window
+- `mb_latency`: per-microbatch end-to-end p50/p95/p99 (ms) across ranks
+- `failover`: detection -> recovery breakdown when a failover happened
+- `span_overhead_pct`: the recorder's own measured hot-path tax (per-span
+  cost measured live on this host x span count / window)
+
+Examples:
+
+  # trace a loopback fleet, then report on it
+  python runtime.py 0 2 -c dcn ... --trace-spans /tmp/trace.json
+  python tools/trace_report.py /tmp/trace.json
+
+  # machine-checkable gate (CI smoke): fail unless spans were recorded
+  python tools/trace_report.py /tmp/trace.json --require-spans
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pipeedge_tpu.telemetry import chrome_trace, report  # noqa: E402
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("trace", help="merged trace JSON from --trace-spans "
+                                 "(Chrome trace-event format)")
+    p.add_argument("--require-spans", action="store_true",
+                   help="exit nonzero when the trace holds no spans or "
+                        "no bubble/latency fields (the CI smoke gate)")
+    p.add_argument("--indent", action="store_true",
+                   help="pretty-print instead of the one-line record")
+    args = p.parse_args()
+
+    with open(args.trace, encoding="utf8") as f:
+        doc = json.load(f)
+    spans = chrome_trace.trace_to_spans(doc)
+    record = report.analyze_spans(spans)
+    record["trace"] = args.trace
+    print(json.dumps(record, indent=2 if args.indent else None,
+                     sort_keys=True))
+    if args.require_spans:
+        ok = (record.get("spans", 0) > 0
+              and record.get("bubble_pct") is not None
+              and record.get("mb_latency", {}).get("n", 0) > 0)
+        return 0 if ok else 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
