@@ -87,6 +87,9 @@ _MSG_SPANS = 7
 _MSG_SPANS_ACK = 8
 _SPANS_PROBE = 1    # aux: timestamps only (clock probe)
 _SPANS_REQUEST = 0  # aux: timestamps + span ring
+_SPANS_DIGEST = 2   # aux: timestamps + cumulative duration digest — the
+# per-round rebalance collection (kilobytes of (cat,name,stage) rollups;
+# durations only, so no clock alignment and no full trace required)
 
 # wire bitwidths a context accepts by default for its inbound quantized
 # edges (ops/quant.py SUPPORTED_BITS, restatable per context so a peer
@@ -1050,7 +1053,9 @@ class DistDcnContext(DistContext):
         if aux != _SPANS_PROBE:
             rec = telemetry.recorder()
             if rec is not None:
-                blob = telemetry.spans_to_wire(rec.snapshot())
+                blob = (telemetry.digest_to_wire(rec.digest())
+                        if aux == _SPANS_DIGEST
+                        else telemetry.spans_to_wire(rec.snapshot()))
         with self._cmd_conn_locks[dst]:
             conn = self._ensure_conn(dst, conns=self._cmd_conns)
             stamp = np.asarray([t_rx_ns, time.monotonic_ns()], np.int64)
@@ -1096,6 +1101,29 @@ class DistDcnContext(DistContext):
         offset = telemetry.estimate_clock_offset(samples)
         return telemetry.spans_from_wire(blob), offset
 
+    def collect_digest(self, dst: int, timeout: float = 5.0):
+        """Fetch `dst`'s cumulative span digest over the command channel:
+        the lightweight per-round rebalance collection (telemetry.Digest,
+        durations only — no clock probes, no full trace). Empty dict when
+        the peer records no spans. Raises queue.Empty on timeout and
+        OSError when `dst` is unreachable; one in-flight collection per
+        peer (shared reply queue with `collect_spans`)."""
+        q = self._span_queue(dst)
+        while True:  # drop stale replies from an abandoned collection
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+        self._send_neg(dst, _MSG_SPANS, _SPANS_DIGEST)
+        deadline = time.monotonic() + timeout
+        while True:
+            aux, tensors = q.get(timeout=max(0.0, deadline
+                                             - time.monotonic()))
+            if aux == _SPANS_DIGEST:
+                return telemetry.digest_from_wire(tensors[1])
+            # a late reply from a previously timed-out collect_spans probe
+            # (different aux): discard, keep waiting for OUR reply
+
 
 class DcnPipelineStage:
     """One pipeline stage over the DCN transport: recv -> work -> send on
@@ -1135,7 +1163,8 @@ class DcnPipelineStage:
                  dispatch_cb: Optional[Callable] = None,
                  readback_cb: Optional[Callable] = None,
                  depth: Optional[int] = None,
-                 mb_of: Optional[Callable] = None):
+                 mb_of: Optional[Callable] = None,
+                 stage: Optional[int] = None):
         if depth is None:
             depth = int(os.getenv("DCN_STAGE_DEPTH", "2"))
         if depth < 1:
@@ -1166,6 +1195,10 @@ class DcnPipelineStage:
         # failover replay would renumber from 0 — miscorrelating exactly
         # the traces failover forensics needs
         self._mb_of = mb_of
+        # pipeline-stage index for span tagging: with it, this stage's
+        # dispatch/readback/emit spans land on the report's per-stage
+        # tracks AND in the digest the rebalancer differences per round
+        self._stage = stage
         self._depth = depth
         self._queue_work: "queue.Queue" = queue.Queue(maxsize=depth)
         self._queue_out: "queue.Queue" = queue.Queue(maxsize=depth)
@@ -1250,7 +1283,8 @@ class DcnPipelineStage:
                     mb = self._mb_of(item)
                 except Exception:  # malformed frame: keep the sequence tag
                     pass
-            with telemetry.span("stage", "dispatch", mb=mb):
+            with telemetry.span("stage", "dispatch", stage=self._stage,
+                                mb=mb):
                 out = self._dispatch_cb(item)
             self._queue_out.put((mb, out))
             seq += 1
@@ -1264,12 +1298,20 @@ class DcnPipelineStage:
             if self._readback_cb is not None:
                 # drain the async readback HERE, after the work thread is
                 # already free to dispatch the next microbatch
-                with telemetry.span("stage", "readback", mb=mb):
+                with telemetry.span("stage", "readback", stage=self._stage,
+                                    mb=mb):
                     item = self._readback_cb(item)
             if self._rank_dst is not None:
                 try:
-                    self._ctx.send_tensors(self._rank_dst, item,
-                                           channel=self._send_channel)
+                    # emit span: the downstream hand-off — socket transfer
+                    # plus any slow-link stall or backpressure. A cost the
+                    # stage pays per microbatch REGARDLESS of its layer
+                    # range, which is exactly how the rebalance solver
+                    # treats it (feedback.StageEstimate.fixed_s)
+                    with telemetry.span("stage", "emit", stage=self._stage,
+                                        mb=mb):
+                        self._ctx.send_tensors(self._rank_dst, item,
+                                               channel=self._send_channel)
                 except OSError:
                     return  # downstream died: peer-death handler notified
             elif self._results_cb is not None:

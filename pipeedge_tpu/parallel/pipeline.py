@@ -171,33 +171,68 @@ class HostPipeline:
 
         Stats mirror the reference's end-of-run measurement: latency =
         t(last result) - t(first enqueue); throughput = total items / latency
-        (reference runtime.py:493-505).
+        (reference runtime.py:493-505). `steady_state_throughput_items_sec`
+        additionally excludes the FIRST microbatch — its latency carries
+        the XLA compiles, and decisions fed by these stats (adaptive
+        microbatching, benches) must not chase JIT noise.
+
+        Retirement is opportunistic: after each dispatch, any already-
+        finished microbatches at the head of the window retire without
+        blocking, so a full window (or a slow result callback) only stalls
+        dispatch when the oldest result genuinely isn't ready yet — not on
+        every oldest microbatch's full host readback.
         """
         ubatches = list(ubatches)  # single pass: generators welcome
         results: List[Any] = []
         inflight: List[Any] = []
+        # (items, t_retired) per microbatch, stamped as each result becomes
+        # host-visible — the steady-state measurement's raw series
+        retired: List[Tuple[int, float]] = []
         track_edges = self.edge_bytes_callback is not None
         tik = time.monotonic()
+        dispatch_s: List[float] = []  # per-mb host enqueue cost (t_fixed)
         for i, ubatch in enumerate(ubatches):
             edge_bytes: Optional[List[int]] = [] if track_edges else None
+            t_d0 = time.monotonic()
             out = self.enqueue(ubatch, edge_bytes, mb=i)
+            dispatch_s.append(time.monotonic() - t_d0)
             inflight.append((i, out, edge_bytes))
+            while inflight and payload_ready(inflight[0][1]):
+                self._retire(inflight.pop(0), results, retired)
             while len(inflight) >= self.max_inflight:
-                self._retire(inflight.pop(0), results)
+                self._retire(inflight.pop(0), results, retired)
         while inflight:
-            self._retire(inflight.pop(0), results)
+            self._retire(inflight.pop(0), results, retired)
         tok = time.monotonic()
         items = sum(_leading_dim(u) for u in ubatches)
         latency = tok - tik
         stats = {"latency_sec": latency,
                  "throughput_items_sec": items / latency if latency > 0 else 0.0,
-                 "microbatches": len(ubatches)}
+                 "microbatches": len(ubatches),
+                 # first dispatch carries the XLA compiles: average the rest
+                 # when there is a rest (the planner's fixed-cost input)
+                 "host_dispatch_s_per_ubatch":
+                     (sum(dispatch_s[1:]) / (len(dispatch_s) - 1))
+                     if len(dispatch_s) > 1
+                     else (dispatch_s[0] if dispatch_s else 0.0)}
+        if len(retired) >= 2:
+            # window: first retirement -> last retirement, so the first
+            # (compile-tainted) microbatch's latency is excluded while the
+            # remaining M-1 retirements still measure the warm cadence
+            steady_s = retired[-1][1] - retired[0][1]
+            steady_items = sum(n for n, _ in retired[1:])
+            if steady_s > 0:
+                stats["steady_state_throughput_items_sec"] = \
+                    steady_items / steady_s
+                stats["steady_mb_interval_s"] = steady_s / (len(retired) - 1)
         return results, stats
 
-    def _retire(self, item, results):
+    def _retire(self, item, results, retired: Optional[list] = None):
         i, out, edge_bytes = item
         with telemetry.span("results", "retire", mb=i):
             out = jax.block_until_ready(out)
+        if retired is not None:
+            retired.append((_leading_dim(out), time.monotonic()))
         if self.edge_bytes_callback is not None:
             self.edge_bytes_callback(i, edge_bytes)
         if self.ubatch_callback is not None:
@@ -208,6 +243,58 @@ class HostPipeline:
 def _leading_dim(ubatch) -> int:
     t = ubatch[0] if isinstance(ubatch, tuple) else ubatch
     return int(t.shape[0])
+
+
+def payload_ready(payload) -> bool:
+    """Whether every array in a stage payload has finished computing
+    (jax.Array.is_ready — no fence, no transfer). Conservative False for
+    anything that cannot answer, so callers fall back to the blocking
+    retirement path rather than fencing early."""
+    tensors = payload if isinstance(payload, tuple) else (payload,)
+    for t in tensors:
+        is_ready = getattr(t, "is_ready", None)
+        try:
+            if is_ready is None or not is_ready():
+                return False
+        except Exception:  # noqa: BLE001 - deleted/donated buffer etc.
+            return False
+    return True
+
+
+def plan_microbatches(n_items: int, n_stages: int, t_item_s: float,
+                      t_fixed_s: float,
+                      max_ubatch: Optional[int] = None) -> Tuple[int, int, float]:
+    """Pick the microbatch size from MEASURED timings instead of a fixed
+    `--ubatch`: minimize the modeled round latency
+
+        T(M) = (M + S - 1) * (t_fixed + t_item * ceil(B/M))
+
+    — the classic fill/drain tradeoff. More microbatches shrink the
+    pipeline bubble ((S-1)/(M+S-1) of the round), fewer amortize the
+    per-microbatch fixed overhead `t_fixed_s` (host dispatch, framing);
+    `t_item_s` is the bottleneck stage's measured per-ITEM time. Returns
+    `(ubatch_size, n_microbatches, predicted_latency_s)`; exhaustive over
+    the distinct sizes (batches are small), deterministic."""
+    if n_items < 1 or n_stages < 1:
+        raise ValueError(f"need n_items >= 1 and n_stages >= 1, got "
+                         f"{n_items}, {n_stages}")
+    t_item = max(0.0, float(t_item_s))
+    t_fixed = max(0.0, float(t_fixed_s))
+    best = None
+    seen = set()
+    for m in range(1, n_items + 1):
+        u = -(-n_items // m)
+        if u in seen or (max_ubatch is not None and u > max_ubatch):
+            continue
+        seen.add(u)
+        m_eff = -(-n_items // u)
+        t = (m_eff + n_stages - 1) * (t_fixed + t_item * u)
+        if best is None or t < best[2]:
+            best = (u, m_eff, t)
+    if best is None:
+        raise ValueError(f"max_ubatch={max_ubatch} admits no microbatch "
+                         f"size for {n_items} items")
+    return best
 
 
 def payload_wire_bytes(payload) -> int:

@@ -94,6 +94,51 @@ class ProfilerResults:
 
 
 # ---------------------------------------------------------------------------
+# Live-measurement ingestion: runtime span timings -> profiler_results.yml
+
+def results_from_measured(model_name: str, dtype: str, batch_size: int,
+                          total_layers: int,
+                          partition: Sequence[Sequence[int]],
+                          stage_times_s: Sequence[float]) -> dict:
+    """A profiler_results.yml-shaped record built from MEASURED per-stage
+    runtime timings (tools/trace_report.py --emit-profiles) instead of the
+    offline profiler: stage i's per-microbatch seconds spread uniformly
+    over its `[l, r]` layer range — the per-layer resolution a per-stage
+    measurement supports.
+
+    Only the `time` series carries live data; `shape_in`/`shape_out`/
+    `memory` are zeroed placeholders, so the record feeds
+    `upsert_device_type` (timing profiles, what offline re-scheduling
+    needs) but NOT `upsert_model` (structure comes from the static
+    profiler's models.yml). `ProfilerResults.load` reads the file back.
+    """
+    from . import rebalance
+
+    partition = [tuple(map(int, lr)) for lr in partition]
+    try:
+        # one owner for the partition contract + uniform spreading: the
+        # runtime rebalancer and this offline path must always agree on
+        # what a valid partition is
+        per_layer = rebalance.spread_layer_costs(partition, stage_times_s)
+    except ValueError as exc:
+        raise ProfileError(str(exc)) from exc
+    if len(per_layer) != total_layers:
+        raise ProfileError(f"partition {partition} covers {len(per_layer)} "
+                           f"layers, model has {total_layers}")
+    profile_data = [{"time": t, "shape_in": [[0]], "shape_out": [[0]],
+                     "memory": 0.0} for t in per_layer]
+    return {"model_name": model_name, "dtype": dtype,
+            "batch_size": int(batch_size), "layers": int(total_layers),
+            "profile_data": profile_data}
+
+
+def save_measured_profiles(path: str, record: dict) -> None:
+    """Write a `results_from_measured` record as profiler_results.yml."""
+    with open(path, "w", encoding="utf-8") as f:
+        yaml.safe_dump(record, f, default_flow_style=None)
+
+
+# ---------------------------------------------------------------------------
 # Merge operations (each loads, upserts one record, saves)
 
 def upsert_model(path: str, results: ProfilerResults,

@@ -36,7 +36,7 @@ import os
 import threading
 import time
 from collections import deque
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -45,6 +45,17 @@ DEFAULT_SPAN_CAPACITY = 32768
 
 # dict-record field order (also the ring tuple layout)
 _FIELDS = ("cat", "name", "rank", "stage", "mb", "t0", "t1")
+
+# categories folded into the cumulative digest (sched/rebalance.py's
+# sensor): bounded name sets only — feed/results names embed microbatch
+# ids and would grow the digest without bound
+DIGEST_CATEGORIES = frozenset(("stage", "compute", "wire", "quant"))
+
+# a digest maps (cat, name, stage) -> (count, total_ns), CUMULATIVE since
+# the recorder was configured — consumers difference two digests to get a
+# per-round window (feedback.diff_digests), so the fixed-size ring's
+# drop-oldest behavior never corrupts the numbers
+Digest = Dict[Tuple[str, str, Optional[int]], Tuple[int, int]]
 
 
 class SpanRecorder:
@@ -67,6 +78,10 @@ class SpanRecorder:
         self.capacity = capacity
         self.dropped = 0
         self._ring: deque = deque(maxlen=capacity)
+        # cumulative (cat, name, stage) -> [count, total_ns] rollup for
+        # DIGEST_CATEGORIES spans; what a lightweight per-round collection
+        # (dcn.collect_digest) ships instead of the full ring
+        self._digest: Dict[Tuple[str, str, Optional[int]], List[int]] = {}
         self._lock = threading.Lock()
 
     def record(self, cat: str, name: str, t0: int, t1: int,
@@ -75,6 +90,13 @@ class SpanRecorder:
             if len(self._ring) == self.capacity:
                 self.dropped += 1
             self._ring.append((cat, name, self.rank, stage, mb, t0, t1))
+            if cat in DIGEST_CATEGORIES:
+                cell = self._digest.get((cat, name, stage))
+                if cell is None:
+                    self._digest[(cat, name, stage)] = [1, t1 - t0]
+                else:
+                    cell[0] += 1
+                    cell[1] += t1 - t0
 
     def span(self, cat: str, name: str, stage: Optional[int] = None,
              mb: Optional[int] = None) -> "_Span":
@@ -97,6 +119,14 @@ class SpanRecorder:
             rows = list(self._ring)
             self._ring.clear()
         return [dict(zip(_FIELDS, r)) for r in rows]
+
+    def digest(self) -> "Digest":
+        """Cumulative duration rollup of every DIGEST_CATEGORIES span this
+        recorder ever saw: (cat, name, stage) -> (count, total_ns). Unlike
+        the ring it never drops, so two digests difference cleanly into a
+        per-round window (telemetry/feedback.py)."""
+        with self._lock:
+            return {k: (v[0], v[1]) for k, v in self._digest.items()}
 
 
 class _Span:
@@ -194,6 +224,30 @@ def spans_from_wire(arr: np.ndarray) -> List[dict]:
     if not blob:
         return []
     return [dict(zip(_FIELDS, row)) for row in json.loads(blob)]
+
+
+def digest_to_wire(digest: "Digest") -> np.ndarray:
+    """Digest -> one uint8 ndarray (UTF-8 JSON rows
+    [cat, name, stage, count, total_ns]) for a command frame — the
+    kilobyte-scale payload a per-round rebalance collection ships instead
+    of the megabyte-scale full ring."""
+    rows = [[cat, name, stage, int(n), int(ns)]
+            for (cat, name, stage), (n, ns) in sorted(
+                digest.items(), key=lambda kv: (kv[0][0], kv[0][1],
+                                                -1 if kv[0][2] is None
+                                                else kv[0][2]))]
+    blob = json.dumps(rows, separators=(",", ":")).encode()
+    return np.frombuffer(blob, np.uint8)
+
+
+def digest_from_wire(arr: np.ndarray) -> "Digest":
+    """Inverse of `digest_to_wire`; tolerates an empty reply (no recorder
+    on the peer)."""
+    blob = bytes(np.asarray(arr, np.uint8))
+    if not blob:
+        return {}
+    return {(cat, name, stage): (int(n), int(ns))
+            for cat, name, stage, n, ns in json.loads(blob)}
 
 
 # -- clock alignment -----------------------------------------------------

@@ -24,7 +24,7 @@ from . import round_segments, segment_index
 # stable track order within each rank's process (unknown categories sort
 # after these, alphabetically)
 _CATEGORY_ORDER = ("runtime", "feed", "stage", "compute", "quant", "wire",
-                   "results", "failover", "serve", "monitor")
+                   "results", "failover", "rebalance", "serve", "monitor")
 
 # categories whose mb-tagged spans carry the microbatch flow arrows; wire
 # spans are untagged (the transport does not parse frame payloads), so the

@@ -162,6 +162,43 @@ def analyze_spans(spans: Sequence[dict],
             failover["recoveries_s"] = [round(v, 6) for v in recov]
             failover["detect_to_recover_s"] = round(max(recov), 6)
 
+    # -- per-round bubble ----------------------------------------------
+    # the same busy/idle math per schedule round: round 0 carries compile
+    # and connection setup, later rounds are warm — and on a --rebalance
+    # auto run, the LAST round shows the settled partition. Comparing
+    # final rounds is how the rebalance A/B avoids chasing startup noise.
+    rounds = []
+    for t0_seg, t1_seg in segments:
+        seg_window = max(1, t1_seg - t0_seg)
+        seg_bubbles = {}
+        for key, intervals in stage_busy.items():
+            clipped = [(max(t0, t0_seg), min(t1, t1_seg))
+                       for t0, t1 in intervals
+                       if t1 > t0_seg and t0 < t1_seg]
+            if not clipped:
+                # the stage recorded nothing this round (e.g. failed over
+                # away): absent, not 100% idle — it must not inflate the
+                # round's mean
+                continue
+            busy_ns = _union_ns(clipped)
+            seg_bubbles[key] = 100.0 * max(0, seg_window - busy_ns) \
+                / seg_window
+        staged_seg = [v for k, v in seg_bubbles.items()
+                      if k.startswith("stage")]
+        seg_pool = staged_seg if staged_seg else list(seg_bubbles.values())
+        rounds.append({
+            "window_s": round(seg_window / 1e9, 6),
+            "bubble_pct": (round(sum(seg_pool) / len(seg_pool), 3)
+                           if seg_pool else None),
+        })
+
+    # -- closed-loop rebalancing --------------------------------------
+    # "plan" spans time every consideration; an instant "apply" span marks
+    # each ACCEPTED re-partition (the zero-churn assertion counts these)
+    rebalance_events = sum(1 for s in spans
+                           if s.get("cat") == "rebalance"
+                           and s.get("name") == "apply")
+
     if span_cost_ns is None:
         span_cost_ns = measure_span_cost_ns()
     overhead_pct = 100.0 * len(spans) * span_cost_ns / window_ns
@@ -171,10 +208,12 @@ def analyze_spans(spans: Sequence[dict],
         "ranks": sorted({int(s.get("rank", 0)) for s in spans}),
         "window_s": round(window_ns / 1e9, 6),
         "bubble_pct": bubble_pct,
+        "rounds": rounds,
         "stages": stages,
         "edges": edges,
         "mb_latency": mb_latency,
         "failover": failover,
+        "rebalance_events": rebalance_events,
         "span_cost_ns": round(span_cost_ns, 1),
         "span_overhead_pct": round(overhead_pct, 4),
     }

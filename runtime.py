@@ -110,6 +110,9 @@ _FAILOVER_EVENTS = prom.REGISTRY.counter(
     "mid-run peer deaths entering the failover path")
 _PEER_DEATHS = prom.REGISTRY.counter(
     "pipeedge_peer_deaths_total", "peer deaths observed (any mode)")
+_REBALANCE_EVENTS = prom.REGISTRY.counter(
+    "pipeedge_rebalance_events_total",
+    "accepted telemetry-driven partition rebalances (--rebalance auto)")
 
 
 def handle_cmd(cmd: int, tensors: Tuple) -> None:
@@ -505,7 +508,14 @@ def run_pipeline_host(args, stage_layers, stage_quant, stage_ranks,
     # single-shot methodology, runtime.py:493-505 there); later rounds
     # measure the warm pipeline. Same data each round, so label-driven
     # accuracy is unchanged; per-round lines let callers record both.
-    for rnd in range(max(1, args.measure_rounds)):
+    # --rebalance auto: between rounds, re-split the batch to the
+    # microbatch size the MEASURED steady-state cadence says minimizes the
+    # fill/drain-vs-overhead latency model (parallel/pipeline.py
+    # plan_microbatches), instead of keeping the CLI --ubatch forever.
+    rounds = max(1, args.measure_rounds)
+    adaptive_mb = args.rebalance == "auto" and rounds > 1
+    stats = {}
+    for rnd in range(rounds):
         if rnd:
             for lb in labels:
                 label_queue.put(lb)
@@ -517,11 +527,72 @@ def run_pipeline_host(args, stage_layers, stage_quant, stage_ranks,
         # consumers (report/flows) key on these intervals
         telemetry.record("runtime", f"round{rnd}", t_span0,
                          time.monotonic_ns())
-        if args.measure_rounds > 1:
-            batch_total = sum(len(u) for u in ubatches)
+        if rounds > 1:
+            batch_total = sum(len(u) for u in inputs)
+            steady = stats.get("steady_state_throughput_items_sec")
             print(f"round={rnd} latency_sec={tok - tik:.6f} "
                   f"throughput_items_sec={batch_total / (tok - tik):.3f}")
-    _report(tik, tok, ubatches)
+            if steady:
+                # own line, steady-first: both the round= and latency_sec=
+                # line formats are parsed by tooling/tests
+                print(f"steady_state_throughput_items_sec={steady:.3f} "
+                      f"round={rnd}")
+        if adaptive_mb and rnd + 1 < rounds:
+            # growth bound: the user sized --ubatch for the device's
+            # memory; the planner may merge up to 4x that (activations
+            # grow linearly with u) but never balloon to the whole batch
+            inputs, labels = _adapt_microbatches(
+                pipe, stats, inputs, labels,
+                max_ubatch=4 * args.ubatch_size)
+    _report(tik, tok, inputs)
+    steady = stats.get("steady_state_throughput_items_sec")
+    if steady:
+        # warm cadence without the first (compile-tainted) microbatch —
+        # what rebalance decisions and benches should chase, next to the
+        # end-to-end number _report prints
+        print(f"steady_state_throughput_items_sec={steady:.3f}")
+
+
+def _adapt_microbatches(pipe, stats, inputs, labels,
+                        max_ubatch: Optional[int] = None):
+    """One adaptive-microbatching step between host-driver measure rounds:
+    decompose this round's measured steady per-microbatch interval into
+    per-item time vs per-microbatch fixed overhead, ask `plan_microbatches`
+    for the latency-minimizing split, and re-slice the batch (inputs AND
+    labels, same boundaries, so FIFO label/result pairing holds). The next
+    round pays one re-compile for the new shape — that is what measure
+    rounds are for."""
+    import jax.numpy as jnp
+
+    interval = stats.get("steady_mb_interval_s")
+    if not interval or not inputs:
+        return inputs, labels
+    u_cur = max(len(u) for u in inputs)
+    t_fixed = stats.get("host_dispatch_s_per_ubatch") or 0.0
+    t_item = max(0.0, interval - t_fixed) / u_cur
+    batch_total = sum(len(u) for u in inputs)
+    u_new, m_new, t_pred = host_pipeline.plan_microbatches(
+        batch_total, len(pipe.stages), t_item, t_fixed,
+        max_ubatch=max(max_ubatch or 0, u_cur) or None)
+    if u_new == u_cur:
+        return inputs, labels
+    logger.info("adaptive ubatch: %d -> %d items/microbatch (%d -> %d "
+                "microbatches; modeled round latency %.4fs)", u_cur, u_new,
+                len(inputs), m_new, t_pred)
+    print(f"adaptive_ubatch={u_new} microbatches={m_new} "
+          f"predicted_latency_sec={t_pred:.6f}")
+    flat = jnp.concatenate(list(inputs), axis=0)
+    new_inputs = [flat[i:i + u_new] for i in range(0, batch_total, u_new)]
+    new_labels = labels
+    if labels and all(lb is not None for lb in labels):
+        lflat = np.concatenate([np.asarray(lb) for lb in labels], axis=0)
+        new_labels = [lflat[i:i + u_new]
+                      for i in range(0, batch_total, u_new)]
+    # window follows the split: enough in-flight microbatches to cover the
+    # pipeline depth, but never more than double buffering provides
+    pipe.max_inflight = max(len(pipe.stages) + 1,
+                            min(2 * len(pipe.stages), m_new))
+    return new_inputs, new_labels
 
 
 def run_pipeline_spmd(args, stage_layers, stage_quant, stage_ranks,
@@ -657,6 +728,74 @@ class _MicrobatchLedger:
         return True
 
 
+def _consider_rebalance(ctx, args, policy, sched, prev_digests: dict,
+                        rnd: int):
+    """One closed-loop decision at a round boundary (data rank only):
+    pull every stage rank's cumulative span digest over the command
+    channel (kilobytes; comm/dcn.py `collect_digest`), difference against
+    the previous round's digests for this round's window, decompose into
+    per-stage service estimates (telemetry/feedback.py), and ask the
+    policy (sched/rebalance.py) whether re-solving the partition with the
+    MEASURED profile is worth a re-schedule. Returns the accepted
+    Proposal or None; never raises — an unmeasurable round (dead peer,
+    incomplete estimates) keeps the running partition."""
+    from pipeedge_tpu.telemetry import feedback
+
+    stage_layers, _stage_quant, stage_ranks = sched
+    t0 = time.monotonic_ns()
+    with dead_lock:
+        gone = set(dead_ranks)
+    windows = []
+    collected = {}
+    for src in sorted(set(stage_ranks)):
+        if src == args.rank:
+            rec = telemetry.recorder()
+            cur = rec.digest() if rec is not None else {}
+        elif src in gone:
+            logger.info("rebalance: rank %d is dead; skipping this "
+                        "round's window", src)
+            return None
+        else:
+            try:
+                cur = ctx.collect_digest(src, timeout=10.0)
+            except Exception as exc:  # noqa: BLE001 - any peer hiccup
+                logger.warning("rebalance: digest collection from rank %d "
+                               "failed (%s); keeping partition", src, exc)
+                return None
+        windows.append(feedback.diff_digests(cur, prev_digests.get(src, {})))
+        collected[src] = cur
+    # commit the baselines only once EVERY rank collected: a failure
+    # mid-iteration must not advance some ranks' windows and not others',
+    # or the next round's per-stage windows cover different time spans
+    prev_digests.update(collected)
+    est = feedback.stage_estimates(feedback.merge_digests(windows))
+    problems = feedback.check_estimates(est, len(stage_layers),
+                                        min_samples=2)
+    if problems:
+        logger.info("rebalance: estimates failed the self-test (%s); "
+                    "keeping partition", "; ".join(problems))
+        return None
+    proposal = policy.consider(list(stage_layers), est, rnd)
+    now = time.monotonic_ns()
+    telemetry.record("rebalance", "plan", t0, now)
+    if proposal is None:
+        return None
+    # instant marker per ACCEPTED re-partition: trace_report's
+    # `rebalance_events` (the zero-churn assertion) counts these
+    telemetry.record("rebalance", "apply", now, now)
+    _REBALANCE_EVENTS.inc()
+    logger.warning("rebalance: round %d partition %s -> %s (predicted "
+                   "bottleneck %.4fs -> %.4fs, gain %.1f%%)", rnd,
+                   list(stage_layers), proposal.partition,
+                   proposal.bottleneck_before_s,
+                   proposal.bottleneck_after_s, 100 * proposal.gain)
+    # machine-parseable line (bench_rebalance.py / CI grep this)
+    print(f"rebalance_round={rnd} "
+          f"partition={','.join(f'{l},{r}' for l, r in proposal.partition)} "
+          f"predicted_gain={proposal.gain:.4f}")
+    return proposal
+
+
 def _plan_failover(args, sched, world_size: int, dead_now: set):
     """Re-schedule over the survivors (sched/failover.py cascade). The
     native scheduler re-solve is attempted only when profile files were
@@ -788,10 +927,25 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
             # failover all leave a merged trace (best-effort, like
             # CMD_STOP): on the clean path it runs BEFORE the empty
             # CMD_SCHED below, while every worker is still serving frames
+            # closed-loop rebalancer (--rebalance auto): re-partition the
+            # NEXT rounds from this round's measured per-stage timings,
+            # applied through the same CMD_SCHED broadcast failover uses
+            rebalancer = None
+            prev_digests: dict = {}
+            if args.rebalance == "auto":
+                from pipeedge_tpu.sched import rebalance as rebalance_sched
+                rebalancer = rebalance_sched.RebalancePolicy(
+                    threshold=args.rebalance_threshold,
+                    cooldown=args.rebalance_cooldown,
+                    confirm=args.rebalance_confirm,
+                    align=4 if args.stage_tp > 1 else 1)
+            schedules = [tuple(s) for s in schedules]
             try:
                 rnd = 0
                 fo_t0 = None   # recovery span: detection stamp, if any
-                for stage_layers, stage_quant, stage_ranks in schedules:
+                for sched_idx in range(len(schedules)):
+                    stage_layers, stage_quant, stage_ranks = \
+                        schedules[sched_idx]
                     sched = (stage_layers, stage_quant, stage_ranks)
                     ledger = None
                     if failover_mode:
@@ -829,6 +983,22 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
                                                  fo_t0, time.monotonic_ns())
                                 fo_t0 = None
                                 del _failover_detect_ns[:]
+                            if rebalancer is not None \
+                                    and sched_idx + 1 < len(schedules):
+                                proposal = _consider_rebalance(
+                                    ctx, args, rebalancer, sched,
+                                    prev_digests, rnd - 1)
+                                if proposal is not None:
+                                    # re-cut the REMAINING rounds; their
+                                    # quant/rank specs stand, and a death
+                                    # before they run still goes through
+                                    # the per-round failover re-plan above
+                                    for j in range(sched_idx + 1,
+                                                   len(schedules)):
+                                        _, q_j, r_j = schedules[j]
+                                        schedules[j] = (
+                                            [tuple(p) for p in
+                                             proposal.partition], q_j, r_j)
                             break
                         if fo_t0 is None:
                             # FIRST detection of this episode (appends are
@@ -1201,6 +1371,9 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                 # the stage spans with it so replays trace correctly
                 mb_of=((lambda ts: int(np.asarray(ts[0]).reshape(-1)[0]))
                        if failover_mode else None),
+                # stage-tagged spans: per-stage busy tracks on the merged
+                # trace AND the digest windows the rebalancer consumes
+                stage=i,
                 depth=args.stage_depth or None,
                 recv_channel=(dcn.CHANNEL_FEED if is_first
                               else dcn.CHANNEL_DATA) + parity,
@@ -1496,6 +1669,37 @@ def main():
     parser.add_argument("--sched-timeout", type=float, default=300,
                         help="seconds a worker waits for the schedule / "
                              "results / stop (dcn mode)")
+    parser.add_argument("--rebalance", default="off",
+                        choices=["off", "auto"],
+                        help="closed-loop rebalancing from live telemetry "
+                             "(docs/REBALANCE.md). dcn mode: the data rank "
+                             "re-solves the layer partition each round from "
+                             "measured per-stage timings (span digests over "
+                             "the command channel) and applies it at the "
+                             "next round boundary via CMD_SCHED — pass the "
+                             "flag to every rank. host mode with "
+                             "--measure-rounds > 1: adapt the microbatch "
+                             "size to the measured steady-state stage time "
+                             "vs fill/drain overhead")
+    parser.add_argument("--rebalance-threshold", type=float, default=0.10,
+                        help="minimum predicted relative bottleneck gain "
+                             "before a re-partition is applied (hysteresis: "
+                             "a balanced fleet never churns)")
+    parser.add_argument("--rebalance-cooldown", type=int, default=1,
+                        help="full rounds to wait after a rebalance before "
+                             "considering another (no oscillation while "
+                             "the previous re-plan is still being measured)")
+    parser.add_argument("--rebalance-confirm", type=int, default=1,
+                        help="extra consecutive windows that must blame the "
+                             "SAME bottleneck stage before a re-partition "
+                             "is applied (filters round-to-round drift; a "
+                             "real straggler persists; 0 = act on the "
+                             "first actionable window)")
+    parser.add_argument("--rounds", type=int, default=1,
+                        help="dcn mode: run the schedule this many rounds "
+                             "(same batch each round) — the boundaries "
+                             "--rebalance auto re-plans at; equivalent to "
+                             "repeating the schedule with ';'")
     parser.add_argument("--on-peer-death", default="abort",
                         choices=["abort", "failover"],
                         help="dcn mode reaction to a stage rank dying "
@@ -1587,6 +1791,43 @@ def main():
     n_rounds = max(len(pt_rounds), len(q_rounds), len(r_rounds))
     if n_rounds > 1 and args.comm != "dcn":
         parser.error("';'-separated re-schedule rounds require --comm dcn")
+    if args.rounds < 1:
+        parser.error("--rounds must be >= 1")
+    if args.rounds > 1:
+        if args.comm != "dcn":
+            parser.error("--rounds requires --comm dcn (use "
+                         "--measure-rounds for the host driver)")
+        if n_rounds > 1:
+            parser.error("--rounds cannot combine with ';'-separated "
+                         "re-schedule rounds (pick one)")
+    if args.rebalance == "auto":
+        if args.comm == "spmd":
+            parser.error("--rebalance auto applies to the dcn driver "
+                         "(partition re-solve) and the host driver "
+                         "(adaptive microbatching), not spmd")
+        if len(set(pt_rounds)) > 1:
+            # the rebalancer assumes rounds repeat the same workload; it
+            # would silently overwrite deliberately distinct partitions
+            parser.error("--rebalance auto cannot combine with distinct "
+                         "';'-separated partitions")
+        if args.stage_ckpt:
+            # the per-stage checkpoint manifest pins the partition; a
+            # re-cut would fail every rank's compatibility check on the
+            # next round's restore
+            parser.error("--rebalance auto cannot combine with "
+                         "--stage-ckpt (the checkpoint manifest pins the "
+                         "partition)")
+        # a single round leaves no boundary to re-plan at: refuse the
+        # silent no-op (matches the validation style of the combinations
+        # above)
+        if args.comm == "dcn" and args.rounds == 1 and n_rounds == 1:
+            parser.error("--rebalance auto needs round boundaries to "
+                         "re-plan at: pass --rounds N (or ';'-separated "
+                         "schedule rounds)")
+        if args.comm != "dcn" and args.measure_rounds <= 1:
+            parser.error("--rebalance auto on the host driver adapts the "
+                         "microbatch size BETWEEN measure rounds: pass "
+                         "--measure-rounds N > 1")
     if args.stage_tp > 1 and args.comm != "dcn":
         parser.error("--stage-tp requires --comm dcn (per-rank local TP; "
                      "use the spmd driver's mesh axes for single-controller "
@@ -1646,6 +1887,9 @@ def main():
                 args.model_name, args.ubatch_size, args.sched_models_file,
                 args.sched_dev_types_file, args.sched_dev_file,
                 dtype=args.dtype))
+        # --rounds N: the single resolved schedule runs N times (the round
+        # boundaries --rebalance auto re-plans at)
+        schedules = schedules * max(1, args.rounds)
         stage_layers, stage_quant, stage_ranks = schedules[0]
 
         dataset = load_dataset(
@@ -1674,10 +1918,13 @@ def main():
     if args.save_results and not is_dcn_worker:
         _results_sink = []
 
-    if args.trace_spans:
+    if args.trace_spans or (args.rebalance == "auto" and args.comm == "dcn"):
         # every rank records; in dcn mode the data rank merges the fleet
         # (workers serve their rings over _MSG_SPANS), single-controller
-        # drivers write their own single-rank timeline below
+        # drivers write their own single-rank timeline below. The
+        # rebalancer's digests come from the same recorder (workers answer
+        # _MSG_SPANS digest requests inline), so --rebalance auto records
+        # even without a trace destination.
         telemetry.configure(rank=args.rank if args.comm == "dcn" else 0)
 
     try:

@@ -410,3 +410,21 @@ def test_traced_dcn_round_and_report(tmp_path):
     assert rep["mb_latency"]["p50_ms"] > 0
     assert rep["failover"] == {}           # clean run
     assert rep["span_overhead_pct"] < 1.0  # hot-path tax stays negligible
+
+
+def test_per_round_bubble_skips_absent_stages():
+    """A stage with no spans in a round (failed over away) is absent from
+    that round's mean, not counted 100% idle."""
+    ms = 1_000_000
+    spans = []
+    for rnd, base in ((0, 0), (1, 100 * ms)):
+        spans.append({"cat": "runtime", "name": f"round{rnd}", "rank": 0,
+                      "stage": None, "mb": None, "t0": base,
+                      "t1": base + 20 * ms})
+        stages = (0, 1, 2) if rnd == 0 else (0, 1)   # stage 2 died
+        for st in stages:
+            spans.append({"cat": "stage", "name": "dispatch", "rank": st,
+                          "stage": st, "mb": 0, "t0": base,
+                          "t1": base + 10 * ms})
+    rep = report.analyze_spans(spans, span_cost_ns=1000.0)
+    assert [r["bubble_pct"] for r in rep["rounds"]] == [50.0, 50.0]

@@ -124,3 +124,53 @@ def test_quantized_edge_changes_bitwidth_without_error(tiny_vit):
     stages[0].quant_bit = 0
     r3, _ = pipe.run([x])
     assert np.asarray(r1[0]).shape == np.asarray(r2[0]).shape == np.asarray(r3[0]).shape
+
+
+def test_run_reports_steady_state_throughput(tiny_vit):
+    """steady_state_throughput_items_sec excludes the first (compile-
+    tainted) microbatch: on a cold pipeline it must beat the end-to-end
+    number, and the warm cadence interval must be positive."""
+    cfg, weights = tiny_vit
+    devices = jax.devices()
+    pipe = HostPipeline(_stages(cfg, weights, [(1, 6), (7, 12)], devices))
+    rng = np.random.default_rng(4)
+    ubatches = [jnp.asarray(rng.normal(size=(2, 3, 16, 16)).astype(np.float32))
+                for _ in range(6)]
+    _, stats = pipe.run(ubatches)
+    steady = stats["steady_state_throughput_items_sec"]
+    assert steady > 0 and stats["steady_mb_interval_s"] > 0
+    # round 0 paid the XLA compiles in its first microbatch; excluding it
+    # must not report a SLOWER cadence than the tainted end-to-end one
+    assert steady > stats["throughput_items_sec"]
+    assert stats["host_dispatch_s_per_ubatch"] >= 0
+    # a single microbatch has no steady window to report
+    _, stats1 = pipe.run(ubatches[:1])
+    assert "steady_state_throughput_items_sec" not in stats1
+
+
+def test_retirement_is_opportunistic_and_fifo(tiny_vit):
+    """With a tiny window, already-finished heads retire without waiting
+    for the window to fill, callbacks stay FIFO, and results match the
+    unwindowed run (the satellite fix: a full window no longer always
+    blocks dispatch on the oldest microbatch's full host readback)."""
+    from pipeedge_tpu.parallel import pipeline as pipeline_mod
+
+    cfg, weights = tiny_vit
+    devices = jax.devices()
+    stages = _stages(cfg, weights, [(1, 6), (7, 12)], devices)
+    rng = np.random.default_rng(5)
+    base = rng.normal(size=(1, 3, 16, 16)).astype(np.float32)
+    ubatches = [jnp.asarray(base * (i + 1)) for i in range(8)]
+    expected, _ = HostPipeline(stages).run(ubatches)
+
+    pipe = HostPipeline(stages, max_inflight=2)
+    seen = []
+    pipe.ubatch_callback = lambda i, out: seen.append(i)
+    got, _ = pipe.run(ubatches)
+    assert seen == list(range(8))
+    for e, g in zip(expected, got):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(e),
+                                   rtol=2e-4, atol=1e-5)
+    # ready payloads answer the non-blocking probe; odd payloads don't
+    assert pipeline_mod.payload_ready(jax.block_until_ready(got[0]))
+    assert not pipeline_mod.payload_ready(object())

@@ -1,0 +1,225 @@
+"""Closed-loop rebalancing A/B: frozen partition vs `--rebalance auto` on a
+loopback DCN fleet with one artificially slow stage.
+
+Launches two (optionally three) fleets of `runtime.py` ranks — one OS
+process each, a dedicated data rank plus one rank per stage — with
+chaos-delayed sends (`DCN_CHAOS=delay@1:MS`, pipeedge_tpu/comm/chaos.py)
+armed in the victim rank's environment only:
+
+1. **frozen**: the CLI partition runs every round unchanged (today's
+   behavior — a mis-profiled or straggling stage bubbles the pipeline
+   forever). The default partition deliberately overloads the victim
+   stage, the drifted-profile scenario the closed loop exists for.
+2. **auto**: the data rank re-solves the partition from the measured span
+   digests and re-broadcasts it at a round boundary (docs/REBALANCE.md).
+3. **balanced** (`--check-balanced`): auto mode, NO chaos, a partition
+   sized to the measured per-stage costs — the zero-churn guard (a
+   healthy fleet must record zero rebalance events).
+
+Each run writes a merged span trace; `tools/trace_report.py` turns both
+into pipeline-bubble percentages. The headline comparison is the LAST
+round of each run — both warm, the auto run settled on its re-cut — so
+startup compile noise doesn't pollute the A/B. Emits ONE JSON line
+(chaos_dcn.py idiom) with both bubbles, the measured drop, and the
+rebalance-event counts — the acceptance record for ISSUE 4.
+
+Example (the CI quick-gate smoke):
+
+  python tools/bench_rebalance.py --check-balanced
+"""
+import argparse
+import json
+import os
+import re
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_ports(n):
+    socks = [socket.create_server(("127.0.0.1", 0)) for _ in range(n)]
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def run_fleet(args, tag, rebalance, chaos, partition=None):
+    """One loopback fleet run; returns (data stdout+stderr, report dict,
+    trace path, report path)."""
+    addrs = ",".join(f"127.0.0.1:{p}" for p in _free_ports(args.world))
+    # absolute: the ranks run with cwd=workdir and resolve --trace-spans
+    # against it — a relative workdir would double up in the path
+    workdir = os.path.abspath(os.path.join(args.workdir, tag))
+    os.makedirs(workdir, exist_ok=True)
+    trace = os.path.join(workdir, "trace.json")
+    common = [sys.executable, os.path.join(REPO, "runtime.py")]
+    # stages on dedicated ranks; rank 0 is data-only, so rebalancing never
+    # fights the feed/results threads for the data rank's interpreter
+    rank_order = ",".join(str(r) for r in range(1, args.world))
+    opts = ["-c", "dcn", "--platform", "cpu", "-m", args.model,
+            "-b", str(args.batch), "-u", str(args.ubatch),
+            "-pt", partition or args.partition, "-r", rank_order,
+            "--rounds", str(args.rounds),
+            "--dcn-addrs", addrs, "--sched-timeout", str(args.sched_timeout),
+            "--trace-spans", trace, "--rebalance", rebalance,
+            "--rebalance-threshold", str(args.threshold),
+            "--rebalance-confirm", str(args.confirm),
+            "--rebalance-cooldown", str(args.cooldown)]
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               DCN_CONNECT_TIMEOUT="30")
+    workers = []
+    logs = []
+    for r in range(1, args.world):
+        wenv = dict(env, DCN_CHAOS=chaos) if (chaos and r == args.victim) \
+            else env
+        # file-backed worker logs: an unread PIPE fills after ~64 KiB of
+        # rank logging and then BLOCKS the worker mid-round
+        log = open(os.path.join(workdir, f"rank{r}.log"), "w",
+                   encoding="utf8")
+        logs.append(log)
+        workers.append(subprocess.Popen(
+            common + [str(r), str(args.world)] + opts, cwd=workdir,
+            env=wenv, text=True, stdout=log, stderr=subprocess.STDOUT))
+    try:
+        data = subprocess.run(common + ["0", str(args.world)] + opts,
+                              cwd=workdir, env=env, capture_output=True,
+                              text=True, timeout=args.timeout)
+    finally:
+        for w in workers:
+            try:
+                w.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                w.kill()
+        for log in logs:
+            log.close()
+    out = data.stdout + data.stderr
+    with open(os.path.join(workdir, "data_rank.log"), "w",
+              encoding="utf8") as f:
+        f.write(out)
+    if data.returncode != 0:
+        raise SystemExit(f"{tag} fleet failed (rc={data.returncode}):\n"
+                         + out[-4000:])
+    rep = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_report.py"),
+         trace, "--require-spans"], capture_output=True, text=True,
+        timeout=120)
+    if rep.returncode != 0:
+        raise SystemExit(f"{tag} trace_report failed:\n"
+                         + rep.stdout + rep.stderr)
+    report_path = os.path.join(workdir, "report.json")
+    with open(report_path, "w", encoding="utf8") as f:
+        f.write(rep.stdout)
+    return out, json.loads(rep.stdout), trace, report_path
+
+
+def _last_round_bubble(rep):
+    rounds = [r for r in rep.get("rounds", ())
+              if r.get("bubble_pct") is not None]
+    return rounds[-1]["bubble_pct"] if rounds else rep.get("bubble_pct")
+
+
+def _last_round_latency(out):
+    lats = re.findall(r"^latency_sec=([0-9.]+)", out, re.M)
+    return float(lats[-1]) if lats else None
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--world", type=int, default=4,
+                   help="ranks: one data rank + world-1 stage ranks")
+    p.add_argument("--victim", type=int, default=2,
+                   help="stage rank whose sends the chaos delay slows")
+    p.add_argument("--delay-ms", type=float, default=60.0,
+                   help="per-send delay injected at the victim (the "
+                        "artificial straggler)")
+    p.add_argument("--model", default="pipeedge/test-tiny-vit")
+    p.add_argument("--partition", default="1,1,2,7,8,8",
+                   help="the frozen starting partition — deliberately "
+                        "overloading the victim stage (it gets most of "
+                        "the layers AND the slow link): the drifted-"
+                        "profile scenario the closed loop exists for")
+    p.add_argument("--batch", type=int, default=48)
+    p.add_argument("--ubatch", type=int, default=4)
+    p.add_argument("--rounds", type=int, default=5,
+                   help="rounds per run: enough that even a re-plan "
+                        "confirmed one window late leaves a warm settled "
+                        "round to compare")
+    p.add_argument("--threshold", type=float, default=0.10,
+                   help="--rebalance-threshold (the runtime default: the "
+                        "compound imbalance clears it with margin, window "
+                        "noise does not)")
+    p.add_argument("--confirm", type=int, default=1,
+                   help="--rebalance-confirm: one confirming window "
+                        "filters the compile-tainted first window without "
+                        "giving up determinism")
+    p.add_argument("--cooldown", type=int, default=10,
+                   help="--rebalance-cooldown: larger than the run, so a "
+                        "short A/B re-plans exactly once — a second "
+                        "rebuild would eat its own win before the run "
+                        "ends")
+    p.add_argument("--check-balanced", action="store_true",
+                   help="also run auto with NO chaos on a measured-"
+                        "balanced partition and record that zero "
+                        "rebalance events occurred (the no-churn guard)")
+    p.add_argument("--balanced-partition", default="1,2,3,5,6,8",
+                   help="partition for the --check-balanced run (sized to "
+                        "the MEASURED per-stage costs: the embed-bearing "
+                        "head stage is the immovable bottleneck)")
+    p.add_argument("--workdir", default="bench_rebalance_runs")
+    p.add_argument("--sched-timeout", type=float, default=120)
+    p.add_argument("--timeout", type=float, default=420,
+                   help="per-fleet wall clock limit (seconds)")
+    args = p.parse_args()
+
+    chaos = f"delay@1:{args.delay_ms:g}"
+    frozen_out, frozen_rep, _, frozen_report = run_fleet(
+        args, "frozen", "off", chaos)
+    auto_out, auto_rep, _, auto_report = run_fleet(
+        args, "auto", "auto", chaos)
+
+    events = auto_rep.get("rebalance_events", 0)
+    partitions = re.findall(r"rebalance_round=\d+ partition=(\S+)", auto_out)
+    frozen_last = _last_round_bubble(frozen_rep)
+    auto_last = _last_round_bubble(auto_rep)
+    record = {
+        "metric": "dcn_rebalance_last_round_bubble_pct",
+        "world": args.world,
+        "chaos": chaos,
+        "rounds": args.rounds,
+        "partition_frozen": args.partition,
+        "partitions_rebalanced": partitions,
+        # headline: LAST round of each run (both warm; auto settled)
+        "bubble_pct_frozen": frozen_last,
+        "bubble_pct_auto": auto_last,
+        "bubble_drop_pct": (round(frozen_last - auto_last, 3)
+                            if frozen_last is not None
+                            and auto_last is not None else None),
+        "last_round_latency_sec_frozen": _last_round_latency(frozen_out),
+        "last_round_latency_sec_auto": _last_round_latency(auto_out),
+        # whole-window numbers for reference (startup noise included)
+        "bubble_pct_frozen_whole": frozen_rep.get("bubble_pct"),
+        "bubble_pct_auto_whole": auto_rep.get("bubble_pct"),
+        "rebalance_events": events,
+        "rebalance_events_frozen": frozen_rep.get("rebalance_events", 0),
+        "reports": {"frozen": frozen_report, "auto": auto_report},
+    }
+    if args.check_balanced:
+        bal_out, bal_rep, _, bal_report = run_fleet(
+            args, "balanced", "auto", None,
+            partition=args.balanced_partition)
+        record["rebalance_events_balanced"] = bal_rep.get(
+            "rebalance_events", 0)
+        record["balanced_churned"] = "rebalance_round=" in bal_out
+        record["reports"]["balanced"] = bal_report
+    record["rebalanced"] = events > 0
+    record["improved"] = (record["bubble_drop_pct"] is not None
+                          and record["bubble_drop_pct"] > 0)
+    print(json.dumps(record))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

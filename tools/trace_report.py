@@ -20,6 +20,13 @@ Examples:
 
   # machine-checkable gate (CI smoke): fail unless spans were recorded
   python tools/trace_report.py /tmp/trace.json --require-spans
+
+  # convert the measured per-stage timings into a scheduler-consumable
+  # profiler_results.yml (offline re-scheduling from live measurements:
+  # feed it to profiler_results_to_device_types.py / sched/profiles.py)
+  python tools/trace_report.py /tmp/trace.json \
+      --emit-profiles live.yaml --partition 1,24,25,48 \
+      --model google/vit-base-patch16-224 --profile-batch-size 8
 """
 import argparse
 import json
@@ -28,7 +35,33 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from pipeedge_tpu.telemetry import chrome_trace, report  # noqa: E402
+from pipeedge_tpu.telemetry import chrome_trace, feedback, report  # noqa: E402
+
+
+def _emit_profiles(args, spans) -> None:
+    """Measured per-stage service times -> profiler_results.yml
+    (sched/profiles.py ingestion)."""
+    from pipeedge_tpu.sched import profiles
+
+    nums = [int(x) for x in args.partition.split(",")]
+    if len(nums) % 2:
+        raise SystemExit("--partition needs comma-separated layer PAIRS")
+    partition = list(zip(nums[::2], nums[1::2]))
+    est = feedback.stage_estimates(feedback.digest_from_spans(spans))
+    problems = feedback.check_estimates(est, len(partition))
+    if problems:
+        raise SystemExit("--emit-profiles: trace measurements incomplete: "
+                         + "; ".join(problems))
+    record = profiles.results_from_measured(
+        args.model, args.dtype, args.profile_batch_size,
+        total_layers=partition[-1][1], partition=partition,
+        # layer_s, NOT service_s: the per-microbatch emit/wire fixed cost
+        # must not be baked into per-layer compute times — the offline
+        # scheduler models comm separately (bw_Mbps x boundary elements)
+        stage_times_s=[est[i].layer_s for i in range(len(partition))])
+    profiles.save_measured_profiles(args.emit_profiles, record)
+    print(f"emitted measured per-layer profiles for {len(partition)} "
+          f"stage(s) -> {args.emit_profiles}", file=sys.stderr)
 
 
 def main() -> int:
@@ -40,7 +73,24 @@ def main() -> int:
                         "no bubble/latency fields (the CI smoke gate)")
     p.add_argument("--indent", action="store_true",
                    help="pretty-print instead of the one-line record")
+    p.add_argument("--emit-profiles", metavar="OUT.yaml", default=None,
+                   help="also write the trace's measured per-stage service "
+                        "times as a profiler_results.yml the scheduler "
+                        "tooling ingests (requires --partition + --model)")
+    p.add_argument("--partition", default=None,
+                   help="the layer partition the traced run used, e.g. "
+                        "'1,24,25,48' (--emit-profiles needs it to spread "
+                        "stage times over layers)")
+    p.add_argument("--model", default=None,
+                   help="model name recorded in the emitted profiles")
+    p.add_argument("--dtype", default="float32",
+                   help="dtype key recorded in the emitted profiles")
+    p.add_argument("--profile-batch-size", type=int, default=8,
+                   help="batch-size key recorded in the emitted profiles "
+                        "(the traced run's microbatch size)")
     args = p.parse_args()
+    if args.emit_profiles and not (args.partition and args.model):
+        p.error("--emit-profiles requires --partition and --model")
 
     with open(args.trace, encoding="utf8") as f:
         doc = json.load(f)
@@ -49,6 +99,8 @@ def main() -> int:
     record["trace"] = args.trace
     print(json.dumps(record, indent=2 if args.indent else None,
                      sort_keys=True))
+    if args.emit_profiles:
+        _emit_profiles(args, spans)
     if args.require_spans:
         ok = (record.get("spans", 0) > 0
               and record.get("bubble_pct") is not None
