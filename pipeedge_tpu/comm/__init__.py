@@ -54,6 +54,11 @@ CMD_BID = 2
 # fleet — CMD_DEAD only records the death; the data rank reacts by ending
 # the round and re-scheduling over the survivors (runtime.py failover path)
 CMD_DEAD = 3
+# admission acknowledgment (elastic membership, the inverse of CMD_DEAD):
+# the data rank confirms a rejoined peer's re-admission, payload is the
+# current global round index — the rejoiner logs it and knows its next
+# CMD_SCHED is live traffic, not a stale replay (runtime.py rejoin path)
+CMD_ADMIT = 4
 
 DistCmdHandler = Callable[[int, Tuple[Any, ...]], None]
 

@@ -18,6 +18,18 @@ variable only in that rank's environment. Grammar (`;`-separated actions):
     drop@K          silently swallow the K-th tensor-frame send
     delay@K:MS      sleep MS milliseconds before every tensor-frame send
                     from the K-th on (slow-link / straggler simulation)
+    restart@K:MS    kill@K, then RE-EXEC the same command line MS
+                    milliseconds later with DCN_EPOCH incremented and the
+                    chaos spec cleared — the transient-crash-and-recover
+                    case the elastic membership plane (JOIN handshake,
+                    docs/FAULT_TOLERANCE.md healing) re-admits
+    flap@K:MS       drop every open connection (data, command, accepted)
+                    before the K-th send and stay silent for MS ms, then
+                    resume — a network blip. Survivable without failover
+                    when every rank's DCN_RECONNECT_GRACE exceeds MS;
+                    with grace 0 the fleet treats it as a death (and,
+                    because the flapped rank keeps its epoch, its
+                    post-fence frames are dropped as stale)
 
 Counting is over `send_tensors` calls on the wrapped context (command and
 heartbeat frames are not counted — they are the recovery machinery under
@@ -29,6 +41,7 @@ from __future__ import annotations
 import logging
 import os
 import signal
+import socket as socket_mod
 import threading
 import time
 from dataclasses import dataclass, field
@@ -41,7 +54,7 @@ logger = logging.getLogger(__name__)
 
 @dataclass
 class ChaosAction:
-    kind: str            # kill | hang | drop | delay
+    kind: str            # kill | hang | drop | delay | restart | flap
     at_send: int         # 1-based send index the action arms at
     delay_ms: float = 0.0
 
@@ -60,9 +73,9 @@ class ChaosSpec:
             try:
                 kind, _, where = part.partition("@")
                 kind = kind.strip().lower()
-                if kind == "delay":
+                if kind in ("delay", "restart", "flap"):
                     at, _, ms = where.partition(":")
-                    actions.append(ChaosAction("delay", int(at),
+                    actions.append(ChaosAction(kind, int(at),
                                                delay_ms=float(ms or 0)))
                 elif kind in ("kill", "hang", "drop"):
                     actions.append(ChaosAction(kind, int(where)))
@@ -71,7 +84,8 @@ class ChaosSpec:
             except ValueError as exc:
                 raise ValueError(
                     f"bad {ENV_CHAOS} clause {part!r}: {exc} (grammar: "
-                    "kill@K | hang@K | drop@K | delay@K:MS)") from None
+                    "kill@K | hang@K | drop@K | delay@K:MS | "
+                    "restart@K:MS | flap@K:MS)") from None
         return cls(actions)
 
 
@@ -81,6 +95,7 @@ class _ChaosSender:
     data rank's feed thread may share one context."""
 
     def __init__(self, ctx, spec: ChaosSpec):
+        self._ctx = ctx
         self._inner = ctx.send_tensors
         self._spec = spec
         self._lock = threading.Lock()
@@ -98,6 +113,8 @@ class _ChaosSender:
                     logger.error("chaos: killing this process before "
                                  "send %d", n)
                     os._exit(137)
+                if act.kind == "restart":
+                    _restart(n, act.delay_ms)
                 if act.kind == "hang":
                     logger.error("chaos: SIGSTOPping this process before "
                                  "send %d", n)
@@ -105,7 +122,64 @@ class _ChaosSender:
                 if act.kind == "drop":
                     logger.warning("chaos: dropping send %d", n)
                     return
+                if act.kind == "flap":
+                    _flap(self._ctx, n, act.delay_ms)
         return self._inner(dst, tensors, channel=channel)
+
+
+def _restart(n: int, delay_ms: float) -> None:
+    """kill@K followed by a delayed re-exec of the SAME command line: the
+    replacement process starts `delay_ms` later with DCN_EPOCH incremented
+    (a genuinely new incarnation the JOIN handshake can admit) and the
+    chaos spec cleared (the restarted rank must not crash again). The
+    relauncher is a detached child so it survives this process's exit;
+    stdout/stderr are inherited, so a harness reading this rank's pipe
+    also sees the new incarnation's lines."""
+    import subprocess
+    import sys
+
+    from . import dcn
+
+    epoch = int(os.getenv(dcn.ENV_EPOCH, "0")) + 1
+    env = dict(os.environ)
+    env.pop(ENV_CHAOS, None)
+    env[dcn.ENV_EPOCH] = str(epoch)
+    argv = [sys.executable] + list(sys.argv)
+    logger.error("chaos: killing this process before send %d; re-exec "
+                 "as epoch %d in %.0f ms", n, epoch, delay_ms)
+    subprocess.Popen(
+        [sys.executable, "-c",
+         "import subprocess, sys, time; time.sleep(float(sys.argv[1])); "
+         "sys.exit(subprocess.call(sys.argv[2:]))",
+         str(delay_ms / 1e3)] + argv,
+        env=env, start_new_session=True)
+    os._exit(137)
+
+
+def _flap(ctx, n: int, delay_ms: float) -> None:
+    """Drop every open connection on `ctx` (peers see the break; this
+    rank's readers see their sockets die), stay silent for `delay_ms`,
+    then return — the pending send redials. The listener stays bound, so
+    peers inside a reconnect-grace window revive the rank on redial."""
+    logger.error("chaos: flapping before send %d (all connections "
+                 "dropped for %.0f ms)", n, delay_ms)
+    with ctx._conns_lock:
+        conns = (list(ctx._conns.values()) + list(ctx._cmd_conns.values())
+                 + list(ctx._accepted))
+        ctx._conns.clear()
+        ctx._cmd_conns.clear()
+        ctx._accepted.clear()
+    for c in conns:
+        try:
+            c.shutdown(socket_mod.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            c.close()
+        except OSError:
+            pass
+    time.sleep(delay_ms / 1e3)
+    logger.warning("chaos: flap over; connections will redial")
 
 
 def maybe_install(ctx) -> Optional[ChaosSpec]:

@@ -25,6 +25,13 @@ Capability parity with the reference's wire layer, redesigned for numpy/JAX:
 There is no pickle fallback: payloads are always ndarrays (the reference
 needs pickling for its schedule broadcast, util.py:28-46; here schedules are
 encoded as int arrays by the caller, runtime.py's CMD_SCHED tensor format).
+
+Elastic membership (docs/FAULT_TOLERANCE.md rank lifecycle): every HELLO
+carries the sender's incarnation epoch (env DCN_EPOCH); a confirmed death
+fences the dead incarnation so zombie frames are dropped at the reader;
+and a restarted peer with a higher epoch re-admits itself through the
+`_MSG_JOIN` handshake (`announce_join` / `register_peer_rejoin_handler`),
+coming back as live spare capacity instead of staying dead forever.
 """
 from __future__ import annotations
 
@@ -85,6 +92,13 @@ _MSG_HEARTBEAT = 6
 # doubles as the NTP-style clock probe `collect_spans` aligns ranks with.
 _MSG_SPANS = 7
 _MSG_SPANS_ACK = 8
+# elastic membership plane (aux = joiner's epoch): a restarted (or late)
+# peer asks to be re-admitted over the command channel. The receiver
+# un-deads the rank (cancels pending death timers, resets the heartbeat
+# watch) when the epoch is NEWER than every incarnation it has fenced,
+# and replies _MSG_JOIN_ACK (aux = receiver's epoch; -1 = refused).
+_MSG_JOIN = 9
+_MSG_JOIN_ACK = 10
 _SPANS_PROBE = 1    # aux: timestamps only (clock probe)
 _SPANS_REQUEST = 0  # aux: timestamps + span ring
 _SPANS_DIGEST = 2   # aux: timestamps + cumulative duration digest — the
@@ -103,6 +117,11 @@ ENV_HEARTBEAT_MISS = "DCN_HEARTBEAT_MISS"           # missed-beat threshold
 ENV_RECONNECT_GRACE = "DCN_RECONNECT_GRACE"         # seconds a dropped peer
 # may reconnect before its death is confirmed (0 = declare immediately)
 ENV_SEND_RETRIES = "DCN_SEND_RETRIES"               # redial+resend attempts
+ENV_EPOCH = "DCN_EPOCH"                             # this rank's incarnation
+# number (0 = first launch). A restarted rank MUST come up with a higher
+# epoch than the incarnation that died, or its JOIN is refused and its
+# frames stay fenced (comm/chaos.py `restart@K:MS` re-execs with it
+# incremented; orchestrators do the same).
 DEFAULT_HEARTBEAT_MISS = 3
 
 
@@ -111,6 +130,17 @@ DEFAULT_HEARTBEAT_MISS = 3
 _HEARTBEAT_MISSES = prom.REGISTRY.counter(
     "pipeedge_heartbeat_miss_total",
     "peers whose heartbeat silence exceeded interval*miss (per event)")
+# epoch fencing: frames a reader dropped because they were sent by an
+# incarnation that has since been fenced (declared dead, or superseded by
+# a newer incarnation's admission) — the "stale zombie frame" signal
+_STALE_FRAMES = prom.REGISTRY.counter(
+    "pipeedge_stale_frames_dropped_total",
+    "frames dropped at the reader because their sender incarnation was "
+    "fenced (dead or superseded), by sender rank")
+# membership plane: admissions this context granted to rejoining peers
+_PEER_REJOINS = prom.REGISTRY.counter(
+    "pipeedge_peer_rejoins_total",
+    "JOIN admissions granted to restarted/rejoining peers, by rank")
 
 
 def _env_number(name: str, default, cast):
@@ -330,7 +360,9 @@ class DistDcnContext(DistContext):
                  cmd_handler: Optional[Callable] = None,
                  edge_bits_supported: Optional[Sequence[int]] = None,
                  reconnect_grace: Optional[float] = None,
-                 send_retries: Optional[int] = None):
+                 send_retries: Optional[int] = None,
+                 epoch: Optional[int] = None,
+                 accept_joins: bool = True):
         super().__init__(world_size=world_size, rank=rank)
         assert len(rank_addrs) == world_size
         self._rank_addrs = list(rank_addrs)
@@ -382,6 +414,26 @@ class DistDcnContext(DistContext):
         self._dead: set = set()
         self._dead_lock = threading.Lock()
         self._peer_death_handler: Optional[Callable[[int], None]] = None
+        # elastic membership (docs/FAULT_TOLERANCE.md rank lifecycle):
+        # this rank's incarnation number — travels in every HELLO so the
+        # receiver can fence frames from a dead incarnation
+        self.epoch = int(epoch if epoch is not None
+                         else _env_number(ENV_EPOCH, 0, int))
+        # admission policy: with accept_joins=False every _MSG_JOIN is
+        # refused (the runtime's --on-peer-rejoin ignore), so a confirmed
+        # death stays terminal exactly as before this plane existed
+        self.accept_joins = bool(accept_joins)
+        # highest epoch each peer ever HELLO'd/JOINed with (under _dead_lock)
+        self._peer_epoch: Dict[int, int] = {}
+        # fence floor per peer: frames from incarnations with epoch below
+        # this are stale and dropped at the reader. Raised to dead_epoch+1
+        # when a death is confirmed, and to the admitted epoch on JOIN.
+        self._min_epoch: Dict[int, int] = {}
+        self._peer_rejoin_handler: Optional[
+            Callable[[int, int], None]] = None
+        # instance-level stale counter so tests and the runtime can assert
+        # "the fenced frame never reached the ledger" without scraping
+        self.stale_frames_dropped = 0
         # peers whose listener answered at least once (dialed out or dialed
         # us): a later connection-REFUSED from one of these is a death
         # signal, not a still-starting listener (_ensure_conn fast path)
@@ -411,6 +463,10 @@ class DistDcnContext(DistContext):
         self._hb_last_rx: Dict[int, float] = {}
         self._hb_lock = threading.Lock()
         self._hb_hook: Optional[Callable[[int], None]] = None
+        # per-peer redial backoff for the beat loop — instance state (not
+        # loop-local) so a rejoin admission can clear it and the plane
+        # starts beating the restored rank immediately
+        self._hb_dial_backoff: Dict[int, float] = {}
         # send/recv measurement hooks (reference p2p:132-152): pre fires just
         # before the payload moves, post just after, so (post - pre) is the
         # actual wire transfer time — excluding idle waits for data to exist.
@@ -476,23 +532,41 @@ class DistDcnContext(DistContext):
 
     def _confirm_dead(self, rank: int, marked_at: float, reason: str) -> None:
         """Grace expiry: the peer is dead unless it showed a life sign
-        (inbound frame / fresh HELLO / successful dial) after the mark."""
+        (inbound frame / fresh HELLO / successful dial / JOIN admission)
+        after the mark."""
         with self._dead_lock:
             self._pending_death.pop(rank, None)
-            revived = self._alive_at.get(rank, 0.0) > marked_at
+        self._declare_dead(rank, reason + " (grace expired)",
+                           not_after=marked_at)
+
+    def _declare_dead(self, rank: int, reason: str,
+                      not_after: Optional[float] = None) -> None:
+        if self._stop.is_set():
+            return
+        with self._dead_lock:
+            # revive check INSIDE the same critical section that declares:
+            # a JOIN admission (which stamps _alive_at under this lock)
+            # racing a grace-expiry timer must never be overridden by the
+            # timer fencing the just-admitted incarnation
+            if not_after is not None \
+                    and self._alive_at.get(rank, 0.0) > not_after:
+                revived = True
+            elif rank in self._dead:
+                return
+            else:
+                revived = False
+                self._dead.add(rank)
+                # fence the dead incarnation: anything it (or a zombie
+                # copy of it) still manages to push onto a half-open
+                # socket is stale. A restart must come back with a HIGHER
+                # epoch to be heard.
+                dead_epoch = self._peer_epoch.get(rank, 0)
+                self._min_epoch[rank] = max(self._min_epoch.get(rank, 0),
+                                            dead_epoch + 1)
         if revived:
             logger.info("rank %d: peer rank %d reconnected within grace",
                         self._rank, rank)
             return
-        self._declare_dead(rank, reason + " (grace expired)")
-
-    def _declare_dead(self, rank: int, reason: str) -> None:
-        if self._stop.is_set():
-            return
-        with self._dead_lock:
-            if rank in self._dead:
-                return
-            self._dead.add(rank)
         logger.warning("rank %d: peer rank %d %s (peer death?)",
                        self._rank, rank, reason)
         if self._peer_death_handler is not None:
@@ -505,9 +579,116 @@ class DistDcnContext(DistContext):
             self._alive_at[rank] = time.monotonic()
 
     def dead_ranks(self) -> frozenset:
-        """Ranks this context has confirmed dead (post-grace)."""
+        """Ranks this context has confirmed dead (post-grace) and not
+        since re-admitted via the JOIN handshake."""
         with self._dead_lock:
             return frozenset(self._dead)
+
+    def min_epoch_of(self, rank: int) -> int:
+        """The fence floor for `rank`: frames from incarnations with a
+        lower epoch are stale (dropped at the reader). 0 = never fenced."""
+        with self._dead_lock:
+            return self._min_epoch.get(rank, 0)
+
+    # -- elastic membership (rejoin) -----------------------------------
+
+    def register_peer_rejoin_handler(
+            self, handler: Optional[Callable[[int, int], None]]) -> None:
+        """`handler(rank, epoch)` fires (off-thread) when a peer passes
+        the JOIN admission handshake — the signal the runtime uses to pull
+        the rank out of its terminal dead set and plan a heal."""
+        self._peer_rejoin_handler = handler
+
+    def _admit_peer(self, src: int, epoch: int) -> bool:
+        """Process a _MSG_JOIN from `src` claiming incarnation `epoch`:
+        admit (un-dead, reset liveness watch, drop stale conns) when the
+        epoch is not below the fence floor, refuse otherwise. Returns
+        whether the peer was admitted."""
+        if not self.accept_joins or src < 0 or src == self._rank:
+            return False
+        with self._dead_lock:
+            if epoch < self._min_epoch.get(src, 0):
+                return False    # a zombie of a fenced incarnation
+            was_dead = src in self._dead
+            self._dead.discard(src)
+            timer = self._pending_death.pop(src, None)
+            self._alive_at[src] = time.monotonic()
+            self._peer_epoch[src] = max(self._peer_epoch.get(src, 0), epoch)
+            # supersede every older incarnation: even if the old one was
+            # never CONFIRMED dead (fast restart inside grace), its frames
+            # must not interleave with the new incarnation's
+            self._min_epoch[src] = max(self._min_epoch.get(src, 0), epoch)
+        if timer is not None:
+            timer.cancel()
+        # the old incarnation's outgoing sockets are gone; drop them so
+        # the next send/beat redials the restarted listener
+        with self._conns_lock:
+            self._conns.pop(src, None)
+            self._cmd_conns.pop(src, None)
+        # heartbeat hygiene: restart the watch from the peer's FIRST new
+        # beat (watching-starts-at-first-beat rule), and clear the dial
+        # backoff so this rank resumes beating it immediately — a second
+        # death of the same rank must be detected like the first
+        with self._hb_lock:
+            self._hb_last_rx.pop(src, None)
+        self._hb_dial_backoff.pop(src, None)
+        _PEER_REJOINS.inc(peer=str(src))
+        logger.warning("rank %d: peer rank %d rejoined (epoch %d%s)",
+                       self._rank, src, epoch,
+                       ", was confirmed dead" if was_dead else "")
+        if self._peer_rejoin_handler is not None:
+            # off-thread like _mark_dead: the handler may broadcast
+            # commands, and this reader must keep serving frames
+            threading.Thread(target=self._peer_rejoin_handler,
+                             args=(src, epoch), daemon=True).start()
+        return True
+
+    def _cmd_channel_send(self, dst: int, msg_type: int, aux: int,
+                          tensors: Sequence[np.ndarray] = (),
+                          timeout: Optional[float] = None) -> None:
+        """One frame to `dst` over the dedicated command connection,
+        invalidating the cached conn on failure so the next send redials
+        — the shared core of every point-to-point control-channel path
+        (negotiation, span replies, JOIN, CMD sends)."""
+        with self._cmd_conn_locks[dst]:
+            conn = self._ensure_conn(dst, timeout=timeout,
+                                     conns=self._cmd_conns)
+            try:
+                _send_frame(conn, msg_type, aux, tensors)
+            except OSError:
+                with self._conns_lock:
+                    if self._cmd_conns.get(dst) is conn:
+                        del self._cmd_conns[dst]
+                raise
+
+    def announce_join(self, peers: Optional[Sequence[int]] = None,
+                      timeout: float = 5.0) -> List[int]:
+        """Ask every peer (default: the whole fleet) to re-admit this rank
+        at its current epoch — what a restarted rank calls after init().
+        Best-effort per peer (a peer that is itself down just misses the
+        announcement); returns the list of peers the JOIN reached."""
+        reached = []
+        for dst in (peers if peers is not None else range(self._world_size)):
+            if dst == self._rank:
+                continue
+            try:
+                self._cmd_channel_send(dst, _MSG_JOIN, self.epoch,
+                                       timeout=timeout)
+                reached.append(dst)
+            except OSError as exc:
+                logger.warning("rank %d: JOIN announcement to rank %d "
+                               "failed: %s", self._rank, dst, exc)
+        return reached
+
+    def cmd_send(self, dst: int, cmd: int,
+                 tensors: Sequence[np.ndarray] = (),
+                 timeout: Optional[float] = None) -> None:
+        """Send a command frame to ONE peer over the command connection —
+        the point-to-point complement of `cmd_broadcast` (an admission ACK
+        must reach exactly the rejoiner, not the fleet). Raises OSError
+        when `dst` is unreachable."""
+        self._cmd_channel_send(dst, _MSG_CMD, cmd, tensors,
+                               timeout=timeout)
 
     # -- liveness plane ------------------------------------------------
 
@@ -540,6 +721,7 @@ class DistDcnContext(DistContext):
                                            else range(self._world_size))
                                if p != self._rank)
         self._hb_stop = threading.Event()
+        self._hb_dial_backoff = {}
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, daemon=True,
             name=f"dcn-heartbeat-{self._rank}")
@@ -558,7 +740,7 @@ class DistDcnContext(DistContext):
         # blocking dials to (say) a SYN-blackholed host would stretch THIS
         # rank's own beat period past other ranks' silence thresholds and
         # get healthy ranks declared dead. One attempt per miss-window.
-        dial_backoff: Dict[int, float] = {}
+        dial_backoff = self._hb_dial_backoff
         while not self._stop.is_set() and not self._hb_stop.is_set():
             for dst in self._hb_peers:
                 if dst in self._dead or self._hb_stop.is_set():
@@ -631,6 +813,10 @@ class DistDcnContext(DistContext):
         self._alive_at = {}
         self._pending_death = {}
         self._hb_last_rx = {}
+        self._hb_dial_backoff = {}
+        self._peer_epoch = {}
+        self._min_epoch = {}
+        self.stale_frames_dropped = 0
         # forget which peers were ever up: a relaunched fleet's listeners
         # get the full rendezvous budget again, not the fast-refusal path
         self._ever_connected = set()
@@ -704,16 +890,48 @@ class DistDcnContext(DistContext):
 
     def _reader_loop(self, conn: socket.socket) -> None:
         src = -1
+        conn_epoch = 0
+        warned_stale = False
         try:
-            msg_type, src, _, _ = _recv_frame(conn)
+            msg_type, src, _, hello = _recv_frame(conn)
             if msg_type != _MSG_HELLO:
                 logger.error("peer spoke before HELLO; dropping connection")
                 return
+            # the HELLO's payload carries the sender's incarnation number
+            # (absent = 0, the pre-epoch wire layout): every frame on THIS
+            # connection belongs to that incarnation
+            conn_epoch = int(np.asarray(hello[0]).reshape(-1)[0]) \
+                if hello else 0
+            with self._dead_lock:
+                self._peer_epoch[src] = max(self._peer_epoch.get(src, 0),
+                                            conn_epoch)
             with self._conns_lock:
                 self._ever_connected.add(src)
             self._alive_sign(src)
             while not self._stop.is_set():
                 msg_type, aux, channel, n_tensors = _recv_header(conn)
+                # epoch fence: a frame from an incarnation that has since
+                # been fenced (confirmed dead, or superseded by a newer
+                # JOIN) must never reach queues, handlers, or the ledger.
+                # The payload is still drained (stream framing), then
+                # dropped — with no hooks, no life sign, no beat credit:
+                # a zombie must not keep its own death window open.
+                with self._dead_lock:
+                    stale = conn_epoch < self._min_epoch.get(src, 0)
+                if stale:
+                    _recv_body(conn, n_tensors)
+                    self.stale_frames_dropped += 1
+                    _STALE_FRAMES.inc(peer=str(src))
+                    # one WARNING per connection, debug thereafter: a
+                    # zombie that keeps streaming would otherwise flood
+                    # the logs for the rest of the run (the counter
+                    # carries the ongoing signal)
+                    log = logger.debug if warned_stale else logger.warning
+                    warned_stale = True
+                    log("rank %d: dropping stale frame(s) (type %d) from "
+                        "rank %d epoch %d (fence %d)", self._rank,
+                        msg_type, src, conn_epoch, self.min_epoch_of(src))
+                    continue
                 self._alive_sign(src)
                 hooked = (msg_type == _MSG_TENSORS
                           and self._recv_pre_hook is not None)
@@ -743,11 +961,14 @@ class DistDcnContext(DistContext):
                     # blocks when the consumer is behind: TCP backpressure
                     # propagates the stall to the sender (reference
                     # p2p:252-257 semantics); re-check _stop so shutdown
-                    # can't leave this thread parked on a full queue forever
+                    # can't leave this thread parked on a full queue forever.
+                    # Items carry the sending incarnation's epoch so
+                    # `recv_tensors_meta` consumers (the failover ledger)
+                    # can key their dedupe on it.
                     q = self._queue_for(src, channel)
                     while not self._stop.is_set():
                         try:
-                            q.put(tensors, timeout=0.2)
+                            q.put((conn_epoch, tensors), timeout=0.2)
                             break
                         except queue.Full:
                             continue
@@ -782,13 +1003,46 @@ class DistDcnContext(DistContext):
                         self._hb_last_rx[aux] = time.monotonic()
                     if self._hb_hook is not None:
                         self._hb_hook(aux)
+                elif msg_type == _MSG_JOIN:
+                    # admission handshake (aux = joiner's claimed epoch):
+                    # a JOIN always rides a NEW connection from the new
+                    # incarnation, so its epoch should match conn_epoch —
+                    # trust the HELLO (what fencing keys on) when they
+                    # disagree
+                    admitted = self._admit_peer(src, conn_epoch)
+                    try:
+                        self._send_neg(src, _MSG_JOIN_ACK,
+                                       self.epoch if admitted else -1)
+                    except OSError as exc:
+                        logger.warning("rank %d: JOIN ack to rank %d "
+                                       "failed: %s", self._rank, src, exc)
+                elif msg_type == _MSG_JOIN_ACK:
+                    if aux < 0:
+                        logger.error("rank %d: rank %d REFUSED this "
+                                     "rank's JOIN (epoch %d is fenced "
+                                     "there)", self._rank, src, self.epoch)
+                    else:
+                        with self._dead_lock:
+                            self._peer_epoch[src] = max(
+                                self._peer_epoch.get(src, 0), aux)
                 else:
                     logger.error("unknown frame type %d from rank %d",
                                  msg_type, src)
         except (ConnectionError, OSError) as exc:
             if not self._stop.is_set():
-                logger.warning("connection from rank %d dropped: %s", src, exc)
-                self._mark_dead(src)
+                # a FENCED incarnation's connection dropping is not news:
+                # the zombie finally exiting must not re-kill a rank whose
+                # new incarnation has since been admitted
+                with self._dead_lock:
+                    fenced = (src >= 0
+                              and conn_epoch < self._min_epoch.get(src, 0))
+                if fenced:
+                    logger.info("fenced connection from rank %d (epoch %d) "
+                                "dropped: %s", src, conn_epoch, exc)
+                else:
+                    logger.warning("connection from rank %d dropped: %s",
+                                   src, exc)
+                    self._mark_dead(src)
         finally:
             conn.close()
 
@@ -840,7 +1094,10 @@ class DistDcnContext(DistContext):
                 time.sleep(0.2)
         conn.settimeout(None)
         _tune_socket(conn)
-        _send_frame(conn, _MSG_HELLO, self._rank, ())
+        # HELLO carries this incarnation's epoch so the receiver can fence
+        # stale frames per connection (readers without the payload read 0)
+        _send_frame(conn, _MSG_HELLO, self._rank,
+                    (np.asarray(self.epoch, np.int64),))
         with self._conns_lock:
             conns[dst] = conn
             self._ever_connected.add(dst)
@@ -909,13 +1166,24 @@ class DistDcnContext(DistContext):
         """Receive the next tensor list from `src` (p2p:111-121). Raises
         queue.Empty on timeout, ConnectionError if `src`'s connection died
         and no frames remain (already-delivered frames drain first)."""
+        return self.recv_tensors_meta(src, timeout=timeout,
+                                      channel=channel)[0]
+
+    def recv_tensors_meta(self, src: int, timeout: Optional[float] = None,
+                          channel: int = CHANNEL_DATA) \
+            -> Tuple[List[np.ndarray], int]:
+        """`recv_tensors` plus the sending incarnation's epoch:
+        `(tensors, epoch)`. What the failover ledger keys its epoch-aware
+        dedupe on (stale incarnations are already fenced at the reader;
+        the epoch here is forensic + belt-and-braces)."""
         q = self._queue_for(src, channel)
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             try:
-                return q.get(timeout=0.2 if deadline is None
-                             else max(0.0, min(0.2,
-                                               deadline - time.monotonic())))
+                epoch, tensors = q.get(
+                    timeout=0.2 if deadline is None
+                    else max(0.0, min(0.2, deadline - time.monotonic())))
+                return tensors, epoch
             except queue.Empty:
                 with self._dead_lock:
                     dead = src in self._dead
@@ -969,7 +1237,21 @@ class DistDcnContext(DistContext):
                     remaining = max(1.0, deadline - time.monotonic())
                     conn = self._ensure_conn(dst, timeout=remaining,
                                              conns=self._cmd_conns)
-                    _send_frame(conn, _MSG_CMD, cmd, tensors)
+                    try:
+                        _send_frame(conn, _MSG_CMD, cmd, tensors)
+                    except OSError:
+                        # the CACHED connection went stale (the peer
+                        # flapped or restarted inside its grace window):
+                        # one fresh redial before declaring the peer
+                        # unreachable — it is alive, only the old socket
+                        # is dead
+                        with self._conns_lock:
+                            if self._cmd_conns.get(dst) is conn:
+                                del self._cmd_conns[dst]
+                        remaining = max(1.0, deadline - time.monotonic())
+                        conn = self._ensure_conn(dst, timeout=remaining,
+                                                 conns=self._cmd_conns)
+                        _send_frame(conn, _MSG_CMD, cmd, tensors)
             except OSError as exc:
                 # keep delivering to the remaining reachable peers either
                 # way; drop the broken conn so a later broadcast redials
@@ -1004,15 +1286,7 @@ class DistDcnContext(DistContext):
     def _send_neg(self, dst: int, msg_type: int, bit: int) -> None:
         # rides the dedicated command connections: a proposal must never
         # queue behind a backpressured data send to the same peer
-        with self._cmd_conn_locks[dst]:
-            conn = self._ensure_conn(dst, conns=self._cmd_conns)
-            try:
-                _send_frame(conn, msg_type, bit, ())
-            except OSError:
-                with self._conns_lock:
-                    if self._cmd_conns.get(dst) is conn:
-                        del self._cmd_conns[dst]
-                raise
+        self._cmd_channel_send(dst, msg_type, bit)
 
     def negotiate_edge_bits(self, dst: int, proposed: int,
                             timeout: Optional[float] = 30.0) -> int:

@@ -27,7 +27,8 @@ Span categories in use (docs/OBSERVABILITY.md has the full reference):
 pipeline per-stage dispatch/retire), `compute` (the jitted shard step),
 `quant` (wire encode/decode), `feed`/`results` (data-rank microbatch
 lifecycle), `runtime` (schedule rounds), `failover` (detection→recovery),
-`serve` (HTTP request lifecycle).
+`rejoin` (JOIN admission → heal-to-full-capacity), `serve` (HTTP request
+lifecycle).
 """
 from __future__ import annotations
 

@@ -16,6 +16,9 @@ span stream this repo's runtime emits:
   p50/p95/p99 reflect the true hop-to-hop path including queueing.
 - failover breakdown: the detection and recovery spans the runtime records
   around a mid-run death (docs/FAULT_TOLERANCE.md).
+- rejoin breakdown: JOIN admissions and heal spans of the elastic
+  membership plane — each heal span's duration is that episode's
+  time-to-full-capacity (first detection -> partition healed).
 - span_overhead_pct: the recorder's own cost — per-record cost measured
   live on this host times the span count, over the window — the number
   that keeps the observability plane honest about its hot-path tax.
@@ -199,6 +202,23 @@ def analyze_spans(spans: Sequence[dict],
                            if s.get("cat") == "rebalance"
                            and s.get("name") == "apply")
 
+    # -- elastic membership: rejoin -> heal breakdown ------------------
+    # an instant "admit" span per JOIN admission; each "heal" span runs
+    # the episode's first death detection -> partition healed, i.e. its
+    # duration IS the time-to-full-capacity (docs/FAULT_TOLERANCE.md)
+    rejoin = {}
+    rj = [s for s in spans if s.get("cat") == "rejoin"]
+    if rj:
+        heals = sorted((int(s["t1"]) - int(s["t0"])) / 1e9
+                       for s in rj if s["name"] == "heal")
+        rejoin = {
+            "admissions": sum(1 for s in rj if s["name"] == "admit"),
+            "heals": len(heals),
+        }
+        if heals:
+            rejoin["heals_s"] = [round(v, 6) for v in heals]
+            rejoin["time_to_full_capacity_s"] = round(max(heals), 6)
+
     if span_cost_ns is None:
         span_cost_ns = measure_span_cost_ns()
     overhead_pct = 100.0 * len(spans) * span_cost_ns / window_ns
@@ -213,6 +233,7 @@ def analyze_spans(spans: Sequence[dict],
         "edges": edges,
         "mb_latency": mb_latency,
         "failover": failover,
+        "rejoin": rejoin,
         "rebalance_events": rebalance_events,
         "span_cost_ns": round(span_cost_ns, 1),
         "span_overhead_pct": round(overhead_pct, 4),

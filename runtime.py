@@ -33,7 +33,7 @@ import numpy as np
 
 import monitoring
 from pipeedge_tpu import telemetry
-from pipeedge_tpu.comm import CMD_DEAD, CMD_SCHED, CMD_STOP
+from pipeedge_tpu.comm import CMD_ADMIT, CMD_DEAD, CMD_SCHED, CMD_STOP
 from pipeedge_tpu.telemetry import metrics as prom
 from pipeedge_tpu.models import get_microbatch_size, registry
 from pipeedge_tpu.parallel import pipeline as host_pipeline
@@ -84,9 +84,25 @@ fleet_shutdown = threading.Event()
 # CMD_DEAD or observed locally; deaths accumulate for the whole run
 dead_ranks: set = set()
 dead_lock = threading.Lock()
+# rejoined-but-not-healed ranks (guarded by dead_lock): alive spare
+# capacity that must NOT silently reclaim its old stage at the next
+# round's failover re-plan. --on-peer-rejoin spare keeps ranks here;
+# heal clears the bench at the round boundary that restores capacity.
+benched_ranks: set = set()
 # a death landed mid-round: the data rank ends the round, re-schedules over
 # the survivors, and replays the unacknowledged microbatches
 failover_event = threading.Event()
+# elastic membership (--on-peer-rejoin): a confirmed-dead rank passed the
+# JOIN admission handshake and is live again. The handler removes it from
+# dead_ranks; `_heal_state` carries what the data rank's round loop needs
+# to close the capacity loop at the next boundary (docs/FAULT_TOLERANCE.md
+# rank lifecycle: alive -> grace -> dead -> rejoining -> spare/healed).
+_heal_state: dict = {
+    "detect_ns": None,    # first death detection of the open episode
+    "rejoin_ns": None,    # admission stamp of the most recent rejoin
+    "pre_failure": None,  # schedule running when the episode's death hit
+    "pending": False,     # a heal should be attempted at the boundary
+}
 # optional result capture (--save-results): handle_results appends every
 # delivered output here so runs can be compared bit-for-bit
 _results_sink: Optional[list] = None
@@ -113,6 +129,13 @@ _PEER_DEATHS = prom.REGISTRY.counter(
 _REBALANCE_EVENTS = prom.REGISTRY.counter(
     "pipeedge_rebalance_events_total",
     "accepted telemetry-driven partition rebalances (--rebalance auto)")
+_REJOINS = prom.REGISTRY.counter(
+    "pipeedge_rejoins_total",
+    "peers re-admitted through the JOIN handshake after a confirmed death")
+_TTFC = prom.REGISTRY.gauge(
+    "pipeedge_time_to_full_capacity_seconds",
+    "latest heal episode: first death detection -> partition healed back "
+    "to full capacity at a round boundary")
 
 
 def handle_cmd(cmd: int, tensors: Tuple) -> None:
@@ -126,7 +149,19 @@ def handle_cmd(cmd: int, tensors: Tuple) -> None:
         stop_event.set()
     elif cmd == CMD_SCHED:
         logger.info("handle_cmd: sched")
-        sched_q.put(tensors)
+        # pair the schedule with the stop count at its ARRIVAL (commands
+        # from the data rank ride one connection, so this round's stop is
+        # guaranteed not yet counted): the worker's round ends at base+1.
+        # Relative counting is what lets a REJOINED worker — who missed
+        # every earlier round's stop — fall straight into the sequence.
+        sched_q.put((stop_counter.value, tensors))
+    elif cmd == CMD_ADMIT:
+        # the admission ack: purely informational on the worker — its
+        # next CMD_SCHED carries everything it needs (global round index,
+        # stop baseline); the log line is the operator's confirmation
+        rnd_now = int(np.asarray(tensors[0])) if tensors else -1
+        logger.warning("handle_cmd: re-admitted into the fleet "
+                       "(current round %d)", rnd_now)
     elif cmd == CMD_DEAD:
         dead = int(np.asarray(tensors[0]))
         logger.warning("handle_cmd: rank %d announced dead (failover)", dead)
@@ -152,6 +187,10 @@ def _record_failover_detect(dead: int, failover: bool = True) -> None:
     now = time.monotonic_ns()
     telemetry.record("failover", "detect", now, now)
     _failover_detect_ns.append(now)
+    if _heal_state["detect_ns"] is None:
+        # anchor of the time-to-full-capacity clock: the FIRST detection
+        # of the episode a later heal closes
+        _heal_state["detect_ns"] = now
     _PEER_DEATHS.inc(peer=str(dead))
     if failover:
         _FAILOVER_EVENTS.inc()
@@ -684,9 +723,18 @@ class _MicrobatchLedger:
         self._ubatches = list(ubatches)
         self._labels = (list(labels) if labels
                         else [None] * len(self._ubatches))
-        self._acked: set = set()
+        # mbid -> epoch of the incarnation whose result was accepted: the
+        # dedupe key carries the epoch, so forensics (and tests) can tell
+        # a same-incarnation resend from a stale-incarnation replay
+        self._acked: dict = {}
         self._held: dict = {}       # acked but not yet contiguous
         self._next_deliver = 0
+        # per-source epoch floor (fence_rank): an ack produced by an
+        # incarnation below the floor is stale and refused — the transport
+        # already fences these at the reader; this is the ledger's own
+        # belt-and-braces (a stale frame must NEVER ack a microbatch)
+        self._epoch_floor: dict = {}
+        self.stale_dropped = 0
         self._lock = threading.Lock()
         self.done = threading.Event()
         if not self._ubatches:
@@ -704,15 +752,32 @@ class _MicrobatchLedger:
             return [(i, u) for i, u in enumerate(self._ubatches)
                     if i not in self._acked]
 
-    def ack(self, mbid: int, out: np.ndarray) -> bool:
+    def acked_epochs(self) -> dict:
+        """mbid -> producing incarnation's epoch, for every accepted ack."""
+        with self._lock:
+            return dict(self._acked)
+
+    def fence_rank(self, src: int, min_epoch: int) -> None:
+        """Refuse acks from `src` incarnations below `min_epoch` (mirrors
+        the transport fence, `DistDcnContext.min_epoch_of`)."""
+        with self._lock:
+            self._epoch_floor[src] = max(self._epoch_floor.get(src, 0),
+                                         int(min_epoch))
+
+    def ack(self, mbid: int, out: np.ndarray, epoch: int = 0,
+            src: Optional[int] = None) -> bool:
         """Acknowledge microbatch `mbid`'s result; False for a duplicate
-        (dropped). Results are surfaced through `handle_results` in id
-        order so the label queue and accuracy bookkeeping stay aligned."""
+        or a stale-incarnation ack (both dropped). Results are surfaced
+        through `handle_results` in id order so the label queue and
+        accuracy bookkeeping stay aligned."""
         deliver = []
         with self._lock:
+            if src is not None and epoch < self._epoch_floor.get(src, 0):
+                self.stale_dropped += 1
+                return False
             if mbid in self._acked or not 0 <= mbid < len(self._ubatches):
                 return False
-            self._acked.add(mbid)
+            self._acked[mbid] = int(epoch)
             self._held[mbid] = out
             while self._next_deliver in self._held:
                 i = self._next_deliver
@@ -796,11 +861,14 @@ def _consider_rebalance(ctx, args, policy, sched, prev_digests: dict,
     return proposal
 
 
-def _plan_failover(args, sched, world_size: int, dead_now: set):
+def _plan_failover(args, sched, world_size: int, dead_now: set,
+                   benched: Optional[set] = None):
     """Re-schedule over the survivors (sched/failover.py cascade). The
     native scheduler re-solve is attempted only when profile files were
     given; spare substitution — which preserves the partition and thus
-    bit-identical replay — is the fallback. None = no capacity: abort."""
+    bit-identical replay — is the fallback. None = no capacity: abort.
+    `benched` ranks (rejoined, not healed) keep no stage but stay in the
+    spare pool at lowest priority."""
     from pipeedge_tpu.sched import failover as failover_sched
 
     scheduler_fn = None
@@ -813,7 +881,8 @@ def _plan_failover(args, sched, world_size: int, dead_now: set):
                 args.sched_dev_types_file, args.sched_dev_file,
                 dtype=args.dtype)
     return failover_sched.plan_failover(*sched, world_size, dead_now,
-                                        scheduler_fn=scheduler_fn)
+                                        scheduler_fn=scheduler_fn,
+                                        benched=benched)
 
 
 def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
@@ -840,7 +909,9 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
     dtype = jnp.bfloat16 if args.dtype == 'bfloat16' else jnp.float32
 
     with dcn.DistDcnContext(world_size, rank, addrs,
-                            cmd_handler=handle_cmd) as ctx:
+                            cmd_handler=handle_cmd,
+                            accept_joins=args.on_peer_rejoin != "ignore"
+                            ) as ctx:
         _register_dcn_monitor_hooks(ctx)
         chaos.maybe_install(ctx)   # deterministic fault injection, env-gated
         if ctx.send_retries > 0 and not failover_mode:
@@ -901,6 +972,55 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
             stop_event.set()
 
         ctx.register_peer_death_handler(on_peer_death)
+
+        # heal cascade state shared between the rejoin handler (reader-
+        # thread dispatch) and the data rank's round loop
+        round_state = {"rnd": 0}
+
+        def on_peer_rejoin(src: int, epoch: int) -> None:
+            """A peer passed the JOIN admission handshake: pull it out of
+            the terminal dead set (it is live idle-spare capacity again),
+            and — on the data rank — ack the admission (CMD_ADMIT) and arm
+            the heal for the next round boundary."""
+            with dead_lock:
+                was_dead = src in dead_ranks
+                dead_ranks.discard(src)
+                # the rejoiner is live idle capacity, but its old stage
+                # stays where the failover moved it until a heal says
+                # otherwise (spare mode never says otherwise)
+                if was_dead:
+                    benched_ranks.add(src)
+            now = time.monotonic_ns()
+            telemetry.record("rejoin", "admit", now, now)
+            _REJOINS.inc(peer=str(src))
+            _heal_state["rejoin_ns"] = now
+            if was_dead:
+                _heal_state["pending"] = True
+            logger.warning("rank %d: peer rank %d rejoined with epoch %d"
+                           "%s", rank, src, epoch,
+                           " (was confirmed dead)" if was_dead else "")
+            if rank != data_rank:
+                return
+            # machine-parseable admission line (tools/chaos_dcn.py keys
+            # its rejoin timestamp on it)
+            print(f"rejoin_rank={src} epoch={epoch} "
+                  f"was_dead={int(was_dead)}", flush=True)
+            # epoch floor for the ledger: results signed by the fenced
+            # incarnation must never ack a microbatch
+            ledger = ledger_ref[0]
+            if ledger is not None:
+                ledger.fence_rank(src, ctx.min_epoch_of(src))
+            try:
+                ctx.cmd_send(src, CMD_ADMIT,
+                             [np.asarray(round_state["rnd"], np.int32)],
+                             timeout=10.0)
+            except OSError as exc:
+                logger.warning("CMD_ADMIT to rank %d failed (%s); it "
+                               "will learn from the next CMD_SCHED",
+                               src, exc)
+
+        ledger_ref: List[Optional[_MicrobatchLedger]] = [None]
+        ctx.register_peer_rejoin_handler(on_peer_rejoin)
         # liveness plane: beat every peer, watch every peer's beats, and
         # feed each received beat into the monitoring heartbeat windows
         # (the 'liveness' CSV is the post-mortem timeline of peer health)
@@ -921,6 +1041,15 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
             else None,
             miss_threshold=args.heartbeat_miss if args.heartbeat_miss > 0
             else None)
+        if ctx.epoch > 0:
+            # this process IS a restarted incarnation (env DCN_EPOCH,
+            # e.g. chaos restart@K:MS or an orchestrator relaunch): ask
+            # the fleet to re-admit it before settling in to wait for a
+            # schedule
+            reached = ctx.announce_join()
+            logger.warning("rank %d: restarted as epoch %d; JOIN "
+                           "announced to rank(s) %s", rank, ctx.epoch,
+                           reached)
         results_target = [0]
         if rank == data_rank:
             # span collection runs in the finally so round end, abort, AND
@@ -957,15 +1086,22 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
                         failover_event.clear()
                         with dead_lock:
                             dead_now = set(dead_ranks)
-                        if dead_now:
+                            bench_now = set(benched_ranks)
+                        if dead_now or bench_now:
                             # a LATER schedule round may still name a rank
-                            # that died earlier; remap before broadcasting
+                            # that died earlier (or rejoined un-healed);
+                            # remap before broadcasting
+                            if _heal_state["pre_failure"] is None:
+                                _heal_state["pre_failure"] = sched
                             sched = _plan_failover(args, sched, world_size,
-                                                   dead_now)
+                                                   dead_now,
+                                                   benched=bench_now)
                             if sched is None:
                                 _abort_no_capacity(ctx, dead_now)
                         ledger = _MicrobatchLedger(ubatches, labels)
+                        ledger_ref[0] = ledger
                     while True:
+                        round_state["rnd"] = rnd
                         if rnd:
                             logger.info("re-schedule: broadcasting round %d "
                                         "(partition %s)", rnd, sched[0])
@@ -999,6 +1135,14 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
                                         schedules[j] = (
                                             [tuple(p) for p in
                                              proposal.partition], q_j, r_j)
+                            if args.on_peer_rejoin == "heal" \
+                                    and _heal_state["pending"] \
+                                    and sched_idx + 1 < len(schedules):
+                                # heal-at-round-boundary: capacity came
+                                # back mid-run; restore (or re-expand)
+                                # before the next round's broadcast
+                                _maybe_heal(args, sched, world_size, rnd,
+                                            schedules, sched_idx)
                             break
                         if fo_t0 is None:
                             # FIRST detection of this episode (appends are
@@ -1010,10 +1154,17 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
                         failover_event.clear()
                         with dead_lock:
                             dead_now = set(dead_ranks)
+                            bench_now = set(benched_ranks)
+                        if _heal_state["pre_failure"] is None:
+                            # the schedule running when the episode's
+                            # death hit: what --on-peer-rejoin heal
+                            # restores when its ranks come back
+                            _heal_state["pre_failure"] = sched
                         replay = ledger.pending()
                         with telemetry.span("failover", "reschedule"):
                             planned = _plan_failover(args, sched, world_size,
-                                                     dead_now)
+                                                     dead_now,
+                                                     benched=bench_now)
                         if planned is None:
                             _abort_no_capacity(ctx, dead_now)
                         logger.warning(
@@ -1039,7 +1190,7 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
                 deadline = time.monotonic() + args.sched_timeout
                 while True:
                     try:
-                        tensors = sched_q.get(timeout=0.5)
+                        stop_base, tensors = sched_q.get(timeout=0.5)
                         break
                     except queue.Empty:
                         if stop_info[0] is not None:
@@ -1060,8 +1211,16 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
                 stage_layers = [tuple(map(int, lr)) for lr in tensors[0]]
                 stage_quant = [int(q) for q in tensors[1]]
                 stage_ranks = [int(r) for r in tensors[2]]
+                # the schedule carries the data rank's GLOBAL round index:
+                # channel round-parity must match the fleet's, not this
+                # worker's local count — a rejoined worker starts counting
+                # mid-sequence (older peers without the tensor: fall back
+                # to the local count, correct when nothing was missed)
+                if len(tensors) > 3:
+                    rnd = int(np.asarray(tensors[3]).reshape(-1)[0])
                 _dcn_round(args, ctx, rnd, stage_layers, stage_quant,
-                           stage_ranks, [], [], dtype, results_target)
+                           stage_ranks, [], [], dtype, results_target,
+                           stop_base=stop_base)
                 rnd += 1
 
 
@@ -1094,6 +1253,64 @@ def _collect_write_spans(ctx, args) -> None:
     logger.info("trace-spans: %d span(s) from %d rank(s) -> %s (load in "
                 "ui.perfetto.dev; report: python tools/trace_report.py %s)",
                 len(merged), ranks_seen, args.trace_spans, args.trace_spans)
+
+
+def _maybe_heal(args, sched, world_size: int, rnd: int,
+                schedules, sched_idx: int) -> None:
+    """One heal decision at a round boundary (`--on-peer-rejoin heal`,
+    data rank only): if the capacity the episode lost is restorable —
+    every rank the pre-failure schedule names is alive again, or idle
+    ranks allow a re-expansion (sched/failover.py `plan_rejoin`) — clear
+    the bench so the next round runs the fleet at full capacity, and
+    close the episode's time-to-full-capacity clock. A restore needs no
+    schedule rewrite (each remaining round's own schedule replans clean
+    once the bench is empty); a genuine RE-EXPANSION is written over the
+    remaining rounds, since no original schedule expresses it. The heal
+    line reports the schedule the next round will ACTUALLY run. A
+    rejoiner that cannot restore capacity yet simply stays a spare and
+    the heal stays pending for a later boundary."""
+    from pipeedge_tpu.sched import failover as failover_sched
+
+    with dead_lock:
+        dead_now = set(dead_ranks)
+    pre = _heal_state["pre_failure"]
+    healed = failover_sched.plan_rejoin(sched, pre, world_size, dead_now,
+                                        align=4 if args.stage_tp > 1 else 1)
+    if healed is None:
+        logger.info("heal: capacity not restorable yet (dead=%s); the "
+                    "rejoined rank stays a spare", sorted(dead_now))
+        return
+    restored = pre is not None and healed == (list(pre[0]), list(pre[1]),
+                                              list(pre[2]))
+    if restored:
+        # the next round's own (possibly rebalance-re-cut) schedule runs
+        # clean once the bench is empty: report THAT, not the plan
+        layers, _quant, ranks = schedules[sched_idx + 1]
+    else:
+        for j in range(sched_idx + 1, len(schedules)):
+            schedules[j] = (list(healed[0]), list(healed[1]),
+                            list(healed[2]))
+        layers, _quant, ranks = healed
+    now = time.monotonic_ns()
+    t0 = _heal_state["detect_ns"] or _heal_state["rejoin_ns"] or now
+    telemetry.record("rejoin", "heal", t0, now)
+    ttfc = (now - t0) / 1e9
+    _TTFC.set(ttfc)
+    with dead_lock:
+        benched_ranks.clear()
+    _heal_state["pending"] = False
+    _heal_state["pre_failure"] = None
+    _heal_state["detect_ns"] = None
+    logger.warning("heal: partition %s to full capacity for round "
+                   "%d: layers=%s ranks=%s (%.3fs after detection)",
+                   "restored" if restored else "re-expanded",
+                   rnd, list(layers), list(ranks), ttfc)
+    # machine-parseable heal line (tools/chaos_dcn.py and the CI restart
+    # smoke key their healed timestamp and final partition on it)
+    print(f"heal_round={rnd} "
+          f"partition={','.join(f'{l},{r}' for l, r in layers)} "
+          f"ranks={','.join(str(r) for r in ranks)} "
+          f"time_to_full_capacity_s={ttfc:.3f}", flush=True)
 
 
 def _abort_no_capacity(ctx, dead_now: set) -> None:
@@ -1181,7 +1398,8 @@ def _make_tp_stage(args, l, r, stage, dtype, restored):
 
 def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                ubatches, labels, dtype, results_target,
-               ledger: Optional[_MicrobatchLedger] = None) -> Optional[str]:
+               ledger: Optional[_MicrobatchLedger] = None,
+               stop_base: Optional[int] = None) -> Optional[str]:
     """One schedule round on a live DCN fleet: (data rank) broadcast the
     schedule, build this rank's stage if it is in the schedule, stream the
     batch, stop; (worker) build, run until this round's CMD_STOP.
@@ -1219,7 +1437,11 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
         ctx.cmd_broadcast(CMD_SCHED, [
             np.asarray(stage_layers, np.int32),
             np.asarray(stage_quant, np.int32),
-            np.asarray(stage_ranks, np.int32)], exclude=gone)
+            np.asarray(stage_ranks, np.int32),
+            # the global round index: workers derive channel parity and
+            # their stop baseline from it, which is what lets a REJOINED
+            # worker (who missed earlier rounds) fall into the sequence
+            np.asarray(rnd, np.int32)], exclude=gone)
 
     try:
         my_stages = [i for i, r in enumerate(stage_ranks) if r == rank]
@@ -1418,7 +1640,11 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                     while not stop_event.is_set() \
                             and not ledger.done.is_set():
                         try:
-                            tensors = ctx.recv_tensors(
+                            # meta variant: the producing incarnation's
+                            # epoch keys the ledger's epoch-aware dedupe
+                            # (stale incarnations are fenced at the
+                            # reader; this is the ledger's own guard)
+                            tensors, epoch = ctx.recv_tensors_meta(
                                 last_rank, timeout=0.5,
                                 channel=dcn.CHANNEL_RESULTS + parity)
                         except queue.Empty:
@@ -1428,7 +1654,8 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                         mbid = int(np.asarray(tensors[0]).reshape(-1)[0])
                         with telemetry.span("results", "deliver", mb=mbid):
                             out = _wire_decode(tensors[1:], dtype)
-                            if not ledger.ack(mbid, np.asarray(out)):
+                            if not ledger.ack(mbid, np.asarray(out),
+                                              epoch=epoch, src=last_rank):
                                 logger.info("failover: duplicate result "
                                             "for microbatch %d dropped",
                                             mbid)
@@ -1559,16 +1786,21 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
             _report(tik, tok, ubatches)
             return "ok"
         else:
-            # wait on the stop COUNT, not the event: round rnd ends at the
-            # (rnd+1)-th CMD_STOP, which may already have landed while this
-            # worker was still tearing down the previous round. Poll so a
-            # LOCALLY detected death (own send failed; own broadcast skips
-            # self, so stop_counter never moves) also aborts promptly.
+            # wait on the stop COUNT, not the event: this round ends at
+            # the first CMD_STOP after its schedule arrived (stop_base =
+            # stops counted when the CMD_SCHED landed, paired in
+            # handle_cmd) — a stop that lands while this worker is still
+            # tearing down the previous round is counted, not lost, and a
+            # REJOINED worker who missed earlier rounds' stops needs no
+            # absolute history. Poll so a LOCALLY detected death (own
+            # send failed; own broadcast skips self, so stop_counter
+            # never moves) also aborts promptly.
+            target = (stop_base + 1) if stop_base is not None else rnd + 1
             deadline = time.monotonic() + args.sched_timeout
             stopped = False
             while not stopped and stop_info[0] is None \
                     and time.monotonic() < deadline:
-                stopped = stop_counter.wait_gte(rnd + 1, timeout=0.5)
+                stopped = stop_counter.wait_gte(target, timeout=0.5)
             if stop_info[0] is not None:
                 raise RuntimeError(
                     f"rank {rank}: pipeline aborted: rank "
@@ -1709,6 +1941,18 @@ def main():
                              "microbatches (must be uniform across the "
                              "fleet; results are exactly-once by "
                              "microbatch id)")
+    parser.add_argument("--on-peer-rejoin", default="spare",
+                        choices=["ignore", "spare", "heal"],
+                        help="dcn mode reaction to a confirmed-dead rank "
+                             "passing the JOIN admission handshake (a "
+                             "restarted incarnation with a higher "
+                             "DCN_EPOCH): ignore refuses re-admission "
+                             "(deaths stay terminal), spare re-admits it "
+                             "as live idle capacity for FUTURE failovers, "
+                             "heal additionally restores the pre-failure "
+                             "partition (or re-expands onto the restored "
+                             "rank) at the next round boundary — "
+                             "docs/FAULT_TOLERANCE.md")
     parser.add_argument("--heartbeat-interval", type=float, default=0.0,
                         help="dcn liveness plane: seconds between heartbeat "
                              "frames to every peer (0 = env "

@@ -31,9 +31,10 @@ def _free_ports(n):
 
 
 def _run_chaos_fleet(tmp_path, world, chaos=None, victim=1, extra=(),
-                     batch=24, timeout=240):
+                     batch=24, timeout=240, env_extra=None):
     """Launch a `world`-rank failover-mode fleet, arming `chaos` in the
-    victim's env. Returns (data rc, data output, [worker outputs])."""
+    victim's env (`env_extra` lands in EVERY rank's env). Returns
+    (data rc, data output, [worker outputs])."""
     addrs = ",".join(f"127.0.0.1:{p}" for p in _free_ports(world))
     common = [sys.executable, os.path.join(REPO, "runtime.py")]
     opts = ["-c", "dcn", "--platform", "cpu", "-m", _MODEL,
@@ -41,7 +42,7 @@ def _run_chaos_fleet(tmp_path, world, chaos=None, victim=1, extra=(),
             "-r", "0,1", "--dcn-addrs", addrs, "--sched-timeout", "120",
             "--on-peer-death", "failover", *extra]
     env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
-               DCN_CONNECT_TIMEOUT="30")
+               DCN_CONNECT_TIMEOUT="30", **(env_extra or {}))
     dirs = []
     for r in range(world):
         d = tmp_path / f"rank{r}"
@@ -107,6 +108,102 @@ def test_chaos_no_spare_capacity_aborts_naming_rank(tmp_path):
     assert "no spare capacity" in out and "rank 1 died" in out
 
 
+def test_chaos_restart_rejoins_and_heals(tmp_path):
+    """CI chaos-restart smoke (kill -> failover -> restart -> heal): the
+    last stage dies at its 3rd send and re-execs 1.5s later as a new
+    incarnation (DCN_EPOCH+1). The fleet fails over to a spare and
+    replays; the restarted rank passes the JOIN admission handshake
+    (rejoin event at the data rank); and with --on-peer-rejoin heal the
+    pre-failure partition is restored at a round boundary — the final
+    partition runs on the ORIGINAL ranks, every round's results exactly
+    once."""
+    data, wouts, dirs = _run_chaos_fleet(
+        tmp_path, world=4, chaos="restart@3:1500", batch=16,
+        extra=["--rounds", "3", "--on-peer-rejoin", "heal",
+               "--save-results", "results.npz"])
+    assert data.returncode == 0, data.stdout + data.stderr
+    out = data.stdout + data.stderr
+    # the failover leg ran (spare took the stage over)
+    assert "moves rank 1 -> 2" in out
+    # the restarted incarnation was admitted exactly once...
+    assert out.count("rejoin_rank=1") == 1
+    assert "epoch=1" in out
+    # ...and the heal restored the pre-failure placement with a finite
+    # time-to-full-capacity
+    assert "heal_round=" in out
+    heal_line = [ln for ln in data.stdout.splitlines()
+                 if ln.startswith("heal_round=")][0]
+    assert "ranks=0,1" in heal_line
+    assert "time_to_full_capacity_s=" in heal_line
+    # the victim really died and came back as epoch 1
+    assert "chaos: killing this process" in wouts[0]
+    assert "re-exec as epoch 1" in wouts[0]
+    assert "JOIN announced" in wouts[0]
+    # 4 microbatches x 3 rounds, exactly once each
+    results = np.load(dirs[0] / "results.npz")
+    assert len(results.files) == 12
+
+
+@pytest.mark.slow
+def test_chaos_restart_heal_bit_identical(tmp_path):
+    """The healed run's outputs are bit-identical to a fault-free run of
+    the same 3 rounds: spare substitution keeps the partition, the heal
+    restores the original placement, and the epoch-aware ledger delivers
+    every microbatch exactly once."""
+    fault, _, fdirs = _run_chaos_fleet(
+        tmp_path / "fault", world=4, chaos="restart@3:1500", batch=16,
+        extra=["--rounds", "3", "--on-peer-rejoin", "heal",
+               "--save-results", "results.npz"])
+    clean, _, cdirs = _run_chaos_fleet(
+        tmp_path / "clean", world=4, chaos=None, batch=16,
+        extra=["--rounds", "3", "--on-peer-rejoin", "heal",
+               "--save-results", "results.npz"])
+    assert fault.returncode == 0, fault.stdout + fault.stderr
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    got = np.load(fdirs[0] / "results.npz")
+    want = np.load(cdirs[0] / "results.npz")
+    assert sorted(got.files) == sorted(want.files)
+    for k in got.files:
+        assert got[k].dtype == want[k].dtype
+        np.testing.assert_array_equal(got[k], want[k])
+
+
+@pytest.mark.slow
+def test_chaos_restart_spare_mode_keeps_substitution(tmp_path):
+    """--on-peer-rejoin spare: the restarted rank is re-admitted as idle
+    capacity but its old stage STAYS on the substitute — no heal line,
+    later rounds keep the failed-over placement, results exactly once."""
+    data, _, dirs = _run_chaos_fleet(
+        tmp_path, world=4, chaos="restart@3:1500", batch=16,
+        extra=["--rounds", "3", "--on-peer-rejoin", "spare",
+               "--save-results", "results.npz"])
+    assert data.returncode == 0, data.stdout + data.stderr
+    out = data.stdout + data.stderr
+    assert "rejoin_rank=1" in out
+    assert "heal_round=" not in out
+    assert "moves rank 1 -> 2" in out
+    results = np.load(dirs[0] / "results.npz")
+    assert len(results.files) == 12
+
+
+@pytest.mark.slow
+def test_chaos_flap_survived_with_grace(tmp_path):
+    """flap@K:MS inside every rank's reconnect-grace window: a network
+    blip, not a death — the run completes with no failover and no
+    rejoin (same incarnation throughout), results exactly once."""
+    data, _, dirs = _run_chaos_fleet(
+        tmp_path, world=3, chaos="flap@2:400", batch=16,
+        extra=["--save-results", "results.npz"],
+        env_extra={"DCN_RECONNECT_GRACE": "5", "DCN_SEND_RETRIES": "3"})
+    assert data.returncode == 0, data.stdout + data.stderr
+    out = data.stdout + data.stderr
+    assert "chaos: flapping" not in out          # victim's log, not data's
+    assert "entering failover" not in out
+    assert "rejoin_rank=" not in out
+    results = np.load(dirs[0] / "results.npz")
+    assert len(results.files) == 4
+
+
 @pytest.mark.slow
 def test_chaos_kill_replay_bit_identical(tmp_path):
     """The exactly-once guarantee, bitwise: a killed-and-failed-over run's
@@ -155,6 +252,28 @@ def test_chaos_delay_is_survived_without_failover(tmp_path):
     assert data.returncode == 0, data.stdout + data.stderr
     assert "latency_sec=" in data.stdout
     assert "entering failover" not in data.stdout + data.stderr
+
+
+@pytest.mark.slow
+def test_chaos_tool_records_time_to_full_capacity(tmp_path):
+    """tools/chaos_dcn.py restart experiment end to end: the JSON record
+    carries the healing timeline (detect -> rejoin -> healed) with a
+    finite time_to_full_capacity_s."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_dcn.py"),
+         "--world", "4", "--victim", "1", "--chaos", "restart@3:1500",
+         "--rounds", "3", "--on-peer-rejoin", "heal", "--expect", "heal",
+         "-b", "16"],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, PYTHONPATH=REPO), cwd=tmp_path)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    record = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert record["completed"] and not record["timed_out"]
+    assert record["rejoin_s"] is not None and record["rejoin_s"] > 0
+    assert record["heal_s"] is not None
+    assert record["time_to_full_capacity_s"] is not None
+    assert record["time_to_full_capacity_s"] > 0
+    assert record["rejoin_mode"] == "heal"
 
 
 @pytest.mark.slow

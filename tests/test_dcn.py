@@ -470,6 +470,142 @@ def test_heartbeat_disabled_by_default():
         ctxs[0].shutdown()
 
 
+# -- elastic membership: epoch fencing + JOIN admission ----------------
+
+def _wait_for(predicate, timeout=10.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_stale_epoch_frames_fenced_after_death():
+    """Frames from a dead incarnation never reach the receive queues: a
+    'zombie' context HELLOing with the fenced epoch has every frame
+    dropped at the reader (counter bump), while a NEW incarnation (higher
+    epoch) that passes the JOIN handshake flows normally."""
+    ports = _free_ports(2)
+    addrs = [("127.0.0.1", p) for p in ports]
+    watcher = dcn.DistDcnContext(2, 0, addrs)
+    watcher.init()
+    deaths = queue.Queue()
+    rejoins = queue.Queue()
+    watcher.register_peer_death_handler(deaths.put)
+    watcher.register_peer_rejoin_handler(lambda r, e: rejoins.put((r, e)))
+    peer = dcn.DistDcnContext(2, 1, addrs)       # incarnation 0
+    peer.init()
+    zombie = fresh = None
+    try:
+        peer.send_tensors(0, [np.arange(3, dtype=np.int32)])
+        watcher.recv_tensors(1, timeout=10)
+        peer.shutdown()                          # dies for real
+        assert deaths.get(timeout=10) == 1
+        assert 1 in watcher.dead_ranks()
+        assert watcher.min_epoch_of(1) == 1      # incarnation 0 fenced
+        # a zombie of the dead incarnation keeps sending: every frame is
+        # dropped at the reader and never reaches the queue/ledger
+        zombie = dcn.DistDcnContext(2, 1, addrs, epoch=0)
+        zombie.init()
+        zombie.send_tensors(0, [np.full((2,), 6, np.int32)])
+        _wait_for(lambda: watcher.stale_frames_dropped >= 1,
+                  what="stale frame drop")
+        assert 1 in watcher.dead_ranks()         # a zombie does not revive
+        zombie.shutdown()                        # frees rank 1's listener
+        zombie = None
+        # the restarted incarnation joins with a higher epoch: admitted,
+        # un-deaded, and its frames flow
+        fresh = dcn.DistDcnContext(2, 1, addrs, epoch=1)
+        fresh.init()
+        assert fresh.announce_join() == [0]
+        assert rejoins.get(timeout=10) == (1, 1)
+        assert 1 not in watcher.dead_ranks()
+        fresh.send_tensors(0, [np.full((2,), 7, np.int32)])
+        got, epoch = watcher.recv_tensors_meta(1, timeout=10)
+        np.testing.assert_array_equal(got[0], np.full((2,), 7, np.int32))
+        assert epoch == 1
+    finally:
+        for c in (zombie, fresh, watcher):
+            if c is not None:
+                c.shutdown()
+
+
+def test_join_refused_when_accept_joins_off():
+    """accept_joins=False (--on-peer-rejoin ignore): the JOIN handshake
+    is refused and a confirmed death stays terminal."""
+    ports = _free_ports(2)
+    addrs = [("127.0.0.1", p) for p in ports]
+    watcher = dcn.DistDcnContext(2, 0, addrs, accept_joins=False)
+    watcher.init()
+    deaths = queue.Queue()
+    rejoins = queue.Queue()
+    watcher.register_peer_death_handler(deaths.put)
+    watcher.register_peer_rejoin_handler(lambda r, e: rejoins.put((r, e)))
+    peer = dcn.DistDcnContext(2, 1, addrs)
+    peer.init()
+    fresh = None
+    try:
+        peer.send_tensors(0, [np.zeros(1, np.float32)])
+        watcher.recv_tensors(1, timeout=10)
+        peer.shutdown()
+        assert deaths.get(timeout=10) == 1
+        fresh = dcn.DistDcnContext(2, 1, addrs, epoch=1)
+        fresh.init()
+        fresh.announce_join()
+        time.sleep(0.5)                          # ack round trip
+        assert rejoins.empty()
+        assert 1 in watcher.dead_ranks()
+    finally:
+        for c in (fresh, watcher):
+            if c is not None:
+                c.shutdown()
+
+
+def test_heartbeat_rewatch_dead_alive_dead():
+    """Heartbeat hygiene across a rejoin: the liveness plane resumes
+    watching a re-admitted rank, so a SECOND death of the same rank is
+    detected exactly like the first (satellite: dead -> alive -> dead)."""
+    ports = _free_ports(2)
+    addrs = [("127.0.0.1", p) for p in ports]
+    watcher = dcn.DistDcnContext(2, 0, addrs)
+    watcher.init()
+    deaths = queue.Queue()
+    beats = queue.Queue()
+    watcher.register_peer_death_handler(deaths.put)
+    watcher.register_heartbeat_hook(beats.put)
+    peer = dcn.DistDcnContext(2, 1, addrs)
+    peer.init()
+    fresh = None
+    try:
+        watcher.start_heartbeat([1], interval=0.2, miss_threshold=3)
+        peer.start_heartbeat([0], interval=0.2, miss_threshold=3)
+        assert beats.get(timeout=10) == 1
+        # first death: beats stop AND the sockets drop
+        peer.stop_heartbeat()
+        peer.shutdown()
+        assert deaths.get(timeout=10) == 1
+        # restart as a new incarnation; admission must reset the watch
+        # (_hb_last_rx) and the beat-loop dial backoff for rank 1
+        fresh = dcn.DistDcnContext(2, 1, addrs, epoch=1)
+        fresh.init()
+        assert fresh.announce_join() == [0]
+        _wait_for(lambda: 1 not in watcher.dead_ranks(), what="rejoin")
+        while not beats.empty():
+            beats.get()
+        fresh.start_heartbeat([0], interval=0.2, miss_threshold=3)
+        assert beats.get(timeout=10) == 1        # beats flow again
+        # second death of the SAME rank: beats stop, sockets stay open —
+        # only a live re-armed watch can catch it
+        fresh.stop_heartbeat()
+        assert deaths.get(timeout=10) == 1
+        assert 1 in watcher.dead_ranks()
+    finally:
+        for c in (fresh, watcher):
+            if c is not None:
+                c.shutdown()
+
+
 def test_send_retries_heal_transient_break(monkeypatch):
     """DCN_SEND_RETRIES: a send hitting a broken connection redials and
     resends instead of failing — paired with a receiver-side grace window

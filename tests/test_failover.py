@@ -65,6 +65,48 @@ def test_ledger_empty_batch_is_done():
     assert rt._MicrobatchLedger([], []).done.is_set()
 
 
+def test_ledger_dedupe_keys_include_epoch():
+    """Epoch-aware dedupe: the accepted ack records which incarnation
+    produced it, and a duplicate is dropped regardless of the epoch it
+    claims (exactly-once is by microbatch id; the epoch is the forensic
+    key distinguishing a resend from a stale-incarnation replay)."""
+    rt, ledger = _make_ledger(2)
+    orig = rt.handle_results
+    rt.handle_results = lambda out: None
+    try:
+        assert ledger.ack(0, np.zeros(2), epoch=0)
+        assert not ledger.ack(0, np.ones(2), epoch=1)   # dup, any epoch
+        assert ledger.ack(1, np.ones(2), epoch=2)
+        assert ledger.acked_epochs() == {0: 0, 1: 2}
+    finally:
+        rt.handle_results = orig
+        while not rt.label_queue.empty():
+            rt.label_queue.get()
+
+
+def test_ledger_fences_stale_incarnation_acks():
+    """A result signed by an incarnation below the per-source fence floor
+    must never acknowledge a microbatch — the ledger-level mirror of the
+    transport's reader fence."""
+    rt, ledger = _make_ledger(2)
+    orig = rt.handle_results
+    rt.handle_results = lambda out: None
+    try:
+        ledger.fence_rank(3, 1)
+        assert not ledger.ack(0, np.zeros(2), epoch=0, src=3)   # stale
+        assert ledger.stale_dropped == 1
+        assert [i for i, _ in ledger.pending()] == [0, 1]       # unacked
+        # the NEW incarnation's replay of the same microbatch lands
+        assert ledger.ack(0, np.zeros(2), epoch=1, src=3)
+        # other sources are not fenced by rank 3's floor
+        assert ledger.ack(1, np.ones(2), epoch=0, src=2)
+        assert ledger.done.is_set()
+    finally:
+        rt.handle_results = orig
+        while not rt.label_queue.empty():
+            rt.label_queue.get()
+
+
 # -- survivor re-scheduling --------------------------------------------
 
 _LAYERS = [(1, 4), (5, 8)]
@@ -117,6 +159,69 @@ def test_failover_scheduler_fn_failure_falls_through_to_spares():
     assert planned is not None and planned[2] == [0, 2]
 
 
+def test_failover_benched_rank_loses_stage_prefers_fresh_spare():
+    """A benched rank (rejoined under --on-peer-rejoin spare) is alive
+    but must not keep its scheduled stage; a fresh spare takes it."""
+    planned = failover.plan_failover(_LAYERS, [0, 0], [0, 1],
+                                     world_size=4, dead_ranks=set(),
+                                     benched={1})
+    assert planned is not None and planned[2] == [0, 2]
+
+
+def test_failover_benched_rank_is_last_resort_spare():
+    """When the benched rank is the ONLY idle capacity it is used anyway
+    — running on a benched rank beats aborting."""
+    planned = failover.plan_failover(_LAYERS, [0, 0], [0, 1],
+                                     world_size=2, dead_ranks=set(),
+                                     benched={1})
+    assert planned is not None and planned[2] == [0, 1]
+
+
+# -- rejoin / heal planning --------------------------------------------
+
+def test_plan_rejoin_restores_pre_failure_schedule():
+    """The original rank rejoined: the pre-failure schedule comes back
+    verbatim (partition + quant + placement -> bit-identical numerics)."""
+    pre = (_LAYERS, [8, 0], [0, 1])
+    cur = (_LAYERS, [8, 0], [0, 2])      # failed over onto the spare
+    planned = failover.plan_rejoin(cur, pre, world_size=4,
+                                   dead_ranks=set())
+    assert planned == (_LAYERS, [8, 0], [0, 1])
+
+
+def test_plan_rejoin_waits_while_pre_failure_rank_still_dead():
+    pre = (_LAYERS, [0, 0], [0, 1])
+    cur = (_LAYERS, [0, 0], [0, 2])
+    # rank 1 is still dead and no contraction happened: nothing to heal
+    assert failover.plan_rejoin(cur, pre, world_size=3,
+                                dead_ranks={1}) is None
+
+
+def test_plan_rejoin_expands_contracted_partition():
+    """The failover contracted to fewer stages (scheduler re-solve); the
+    rejoined capacity re-expands the span via the rebalance DP."""
+    pre = (_LAYERS, [0, 0], [0, 1])
+    cur = ([(1, 8)], [0], [0])           # contracted to one stage
+    planned = failover.plan_rejoin(cur, pre, world_size=2,
+                                   dead_ranks=set())
+    # restore path applies (both pre-failure ranks alive)
+    assert planned == (_LAYERS, [0, 0], [0, 1])
+    # without a restorable pre-failure schedule: genuine re-expansion
+    planned = failover.plan_rejoin(cur, None, world_size=2,
+                                   dead_ranks=set())
+    assert planned is not None
+    layers, quant, ranks = planned
+    assert len(layers) == 2 and layers[0][0] == 1 and layers[-1][1] == 8
+    assert layers[0][1] + 1 == layers[1][0]      # contiguous cut
+    assert ranks == [0, 1] and quant == [0, 0]
+
+
+def test_plan_rejoin_no_spares_returns_none():
+    cur = ([(1, 8)], [0], [0])
+    assert failover.plan_rejoin(cur, None, world_size=2,
+                                dead_ranks={1}) is None
+
+
 # -- chaos spec grammar ------------------------------------------------
 
 def test_chaos_spec_parse():
@@ -126,7 +231,14 @@ def test_chaos_spec_parse():
     assert spec.actions[1].delay_ms == 250.0
 
 
-@pytest.mark.parametrize("bad", ["explode@3", "kill@x", "delay@2:abc"])
+def test_chaos_spec_parse_restart_and_flap():
+    spec = chaos.ChaosSpec.parse("restart@3:2000; flap@2:500")
+    assert [(a.kind, a.at_send, a.delay_ms) for a in spec.actions] == [
+        ("restart", 3, 2000.0), ("flap", 2, 500.0)]
+
+
+@pytest.mark.parametrize("bad", ["explode@3", "kill@x", "delay@2:abc",
+                                 "restart@x:5", "flap@2:zz"])
 def test_chaos_spec_rejects_bad_clauses(bad):
     with pytest.raises(ValueError, match="DCN_CHAOS"):
         chaos.ChaosSpec.parse(bad)

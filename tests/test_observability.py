@@ -220,6 +220,27 @@ def test_report_failover_breakdown_and_empty():
     assert report.analyze_spans([]) == {"spans": 0}
 
 
+def test_report_rejoin_breakdown():
+    """The elastic-membership section: admissions counted from instant
+    'admit' spans; each 'heal' span's duration is that episode's
+    time-to-full-capacity."""
+    ms = 1_000_000
+    spans = _two_stage_spans() + [
+        {"cat": "rejoin", "name": "admit", "rank": 0, "stage": None,
+         "mb": None, "t0": 20 * ms, "t1": 20 * ms},
+        {"cat": "rejoin", "name": "heal", "rank": 0, "stage": None,
+         "mb": None, "t0": 12 * ms, "t1": 37 * ms},
+    ]
+    rep = report.analyze_spans(spans, span_cost_ns=1000.0)
+    assert rep["rejoin"]["admissions"] == 1
+    assert rep["rejoin"]["heals"] == 1
+    assert rep["rejoin"]["heals_s"] == [0.025]
+    assert rep["rejoin"]["time_to_full_capacity_s"] == 0.025
+    # no rejoin spans -> empty section (key present, falsy)
+    assert report.analyze_spans(_two_stage_spans(),
+                                span_cost_ns=1000.0)["rejoin"] == {}
+
+
 def test_report_multi_failover_recoveries_are_per_event():
     """Two failovers far apart must NOT report the healthy time between
     them as recovery time — each recover span is its own event."""

@@ -79,6 +79,29 @@ def test_spread_layer_costs_uniform_per_stage():
         rebalance.spread_layer_costs([(1, 2), (4, 8)], [1.0, 1.0])
 
 
+def test_expand_partition_recuts_over_more_stages():
+    """The healing side of the loop: a contracted partition re-expands
+    over restored capacity with the same bottleneck-minimizing DP."""
+    assert rebalance.expand_partition([(1, 8)], 2) == [(1, 4), (5, 8)]
+    # measured costs steer the cut: a heavy tail gets the smaller range
+    skewed = rebalance.expand_partition(
+        [(1, 8)], 2, layer_costs=[1, 1, 1, 1, 1, 1, 4, 4])
+    assert skewed[-1][0] > 5
+    # alignment constraint (--stage-tp) holds through an expansion
+    aligned = rebalance.expand_partition([(1, 16)], 2, align=4)
+    for l, r in aligned:
+        assert (l - 1) % 4 == 0 and r % 4 == 0
+
+
+def test_expand_partition_rejects_non_expansions():
+    with pytest.raises(ValueError):
+        rebalance.expand_partition([(1, 4), (5, 8)], 2)   # not more stages
+    with pytest.raises(ValueError):
+        rebalance.expand_partition([], 2)
+    with pytest.raises(ValueError):
+        rebalance.expand_partition([(1, 8)], 2, layer_costs=[1.0] * 3)
+
+
 # -- policy guardrails ---------------------------------------------------
 
 def test_policy_balanced_fleet_is_noop():

@@ -635,6 +635,55 @@ def test_degraded_window_503_retry_after_and_healthz(server):
     assert len(out["ids"][0]) == 5
 
 
+def test_degraded_healing_healed_lifecycle(server):
+    """The heal-aware window lifecycle: degraded -> healing (rank
+    rejoined; still refusing with Retry-After, but /healthz distinguishes
+    the phase) -> healed ({"degraded": false, "healed": true} clears the
+    window AND counts on rejoined_ranks_total / /metrics)."""
+    port = server
+
+    def health():
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=30) as resp:
+            return json.loads(resp.read())
+
+    before = health()["stats"]["rejoined_ranks_total"]
+    try:
+        _post(port, "/degraded", {"degraded": True, "dead_rank": 1,
+                                  "retry_after": 2})
+        assert health()["degraded"]["phase"] == "degraded"
+        # the rank rejoined; the orchestrator flips the window to healing
+        _post(port, "/degraded", {"degraded": True, "healing": True})
+        h = health()
+        assert h["degraded"]["phase"] == "healing"
+        assert h["degraded"]["dead_rank"] == 1   # window state preserved
+        # still refusing admission while the heal is in flight
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(port, "/generate", {"ids": [[1, 2, 3]], "new_tokens": 2})
+        assert err.value.code == 503
+    finally:
+        # capacity restored: the healed close clears the window and bumps
+        # the rejoined counter on BOTH surfaces
+        _post(port, "/degraded", {"degraded": False, "healed": True,
+                                  "rank": 1})
+    h = health()
+    assert h["degraded"] is False
+    assert h["stats"]["rejoined_ranks_total"] == before + 1
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30) as resp:
+        text = resp.read().decode()
+    assert "pipeedge_serve_rejoined_ranks_total" in text
+    # a stray healing signal with no window open must not resurrect one
+    _post(port, "/degraded", {"degraded": True, "healing": True})
+    assert health()["degraded"] is False
+    # and a plain (non-healed) clear does not count as a rejoin
+    _post(port, "/degraded", {"degraded": True, "dead_rank": 2})
+    _post(port, "/degraded", {"degraded": False})
+    assert health()["stats"]["rejoined_ranks_total"] == before + 1
+    out = _post(port, "/generate", {"ids": [[1, 2, 3]], "new_tokens": 2})
+    assert len(out["ids"][0]) == 5
+
+
 def test_degraded_in_flight_request_replayed(solo_pipe):
     """A request that was IN FLIGHT when the failover window opened and
     whose executor fails during it is replayed once after recovery — the

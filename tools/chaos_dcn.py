@@ -11,6 +11,9 @@ lines to measure the fault-tolerance layer end to end:
 - recover_s: detection -> run completion (`latency_sec=` from the data
              rank) — failover mode only; in abort mode the fleet stops
 - replayed:  microbatches replayed after the failover re-schedule
+- rejoin_s / heal_s / time_to_full_capacity_s: the healing timeline of a
+             restart fault (detect -> rejoin admission -> partition
+             healed at a round boundary); null when no rejoin happened
 
 Emits one JSON line (plus pass-through logs with --verbose). Examples:
 
@@ -24,6 +27,11 @@ Emits one JSON line (plus pass-through logs with --verbose). Examples:
   # hang (SIGSTOP) a stage: only the heartbeat liveness plane can see it
   python tools/chaos_dcn.py --world 3 --victim 1 --chaos hang@3 \
       --heartbeat-interval 0.5
+
+  # kill + restart after 2s: the rank rejoins (epoch 1) and the healed
+  # fleet's final round runs the pre-failure partition again
+  python tools/chaos_dcn.py --world 4 --victim 1 --chaos restart@3:2000 \
+      --rounds 3 --on-peer-rejoin heal --expect heal
 """
 import argparse
 import json
@@ -84,13 +92,25 @@ def main():
                         "data rank)")
     p.add_argument("--chaos", default="kill@3",
                    help="DCN_CHAOS spec: kill@K | hang@K | drop@K | "
-                        "delay@K:MS")
+                        "delay@K:MS | restart@K:MS | flap@K:MS")
     p.add_argument("--expect", default="recover",
-                   choices=["recover", "abort"],
+                   choices=["recover", "abort", "heal"],
                    help="recover: the run must complete; abort: the fleet "
-                        "must stop naming the victim")
+                        "must stop naming the victim; heal: the run must "
+                        "complete AND the victim must rejoin AND the "
+                        "partition must heal (finite "
+                        "time_to_full_capacity_s)")
     p.add_argument("--on-peer-death", default="failover",
                    choices=["abort", "failover"])
+    p.add_argument("--on-peer-rejoin", default="spare",
+                   choices=["ignore", "spare", "heal"],
+                   help="fleet rejoin policy (restart@K:MS faults)")
+    p.add_argument("--rounds", type=int, default=1,
+                   help="schedule rounds (heal applies at round "
+                        "boundaries, so restart experiments need > 1)")
+    p.add_argument("--reconnect-grace", type=float, default=0.0,
+                   help="DCN_RECONNECT_GRACE for every rank (flap@K:MS "
+                        "faults are survivable when this exceeds MS)")
     p.add_argument("-m", "--model-name", default="pipeedge/test-tiny-vit")
     p.add_argument("-pt", "--partition", default="1,4,5,8")
     p.add_argument("-r", "--rank-order", default="0,1")
@@ -119,10 +139,14 @@ def main():
               "--dcn-addrs", addrs,
               "--sched-timeout", str(args.sched_timeout),
               "--on-peer-death", args.on_peer_death,
+              "--on-peer-rejoin", args.on_peer_rejoin,
+              "--rounds", str(args.rounds),
               "--heartbeat-interval", str(args.heartbeat_interval),
               "--heartbeat-miss", str(args.heartbeat_miss)]
     env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
     env.setdefault("DCN_CONNECT_TIMEOUT", "30")
+    if args.reconnect_grace > 0:
+        env["DCN_RECONNECT_GRACE"] = str(args.reconnect_grace)
 
     def launch(rank, extra_env=None):
         return subprocess.Popen(
@@ -170,6 +194,15 @@ def main():
         for tok in replayed_line[1].split():
             if tok.isdigit():
                 replayed = int(tok)
+    # healing timeline (restart faults): the data rank prints one
+    # machine-parseable line per admission and per heal
+    rejoin = readers[0].first("rejoin_rank=")
+    healed = readers[0].first("heal_round=")
+    ttfc = None
+    if healed:
+        for tok in healed[1].split():
+            if tok.startswith("time_to_full_capacity_s="):
+                ttfc = float(tok.split("=", 1)[1])
     completed = (not timed_out and data.returncode == 0
                  and recover is not None)
     aborted = (not timed_out and data.returncode not in (None, 0)
@@ -179,6 +212,7 @@ def main():
         "victim": args.victim,
         "world": args.world,
         "mode": args.on_peer_death,
+        "rejoin_mode": args.on_peer_rejoin,
         "expect": args.expect,
         "completed": completed,
         "aborted": aborted,
@@ -188,6 +222,15 @@ def main():
                      if detect and fault else None),
         "recover_s": (round(recover[0] - detect[0], 3)
                       if recover and detect and completed else None),
+        # detect -> JOIN admission at the data rank
+        "rejoin_s": (round(rejoin[0] - detect[0], 3)
+                     if rejoin and detect else None),
+        # admission -> partition healed at a round boundary
+        "heal_s": (round(healed[0] - rejoin[0], 3)
+                   if healed and rejoin else None),
+        # the data rank's own detection->healed clock (finite only when
+        # a heal actually closed the episode)
+        "time_to_full_capacity_s": ttfc,
         "total_s": round(time.monotonic() - t0, 3),
         "replayed": replayed,
     }
@@ -197,7 +240,12 @@ def main():
             for t, line in reader.lines:
                 print(f"[rank{rank} +{t - t0:7.3f}] {line}",
                       file=sys.stderr)
-    ok = completed if args.expect == "recover" else aborted
+    if args.expect == "heal":
+        ok = completed and rejoin is not None and ttfc is not None
+    elif args.expect == "recover":
+        ok = completed
+    else:
+        ok = aborted
     return 0 if ok else 1
 
 

@@ -15,9 +15,12 @@ Endpoints (all JSON unless noted):
                                "stats": {tokens, active,
                                pending, prefixes,
                                degraded_entered_total,
-                               failover_replays_total, last_dead_rank, ...;
+                               failover_replays_total,
+                               rejoined_ranks_total, last_dead_rank, ...;
                                stage mode adds per-worker
                                stage_steps/busy/queued}};
+                               the degraded object carries a "phase"
+                               ("degraded" | "healing");
                                HTTP 503 once a serving worker has died
 - GET  /metrics            -> Prometheus text format (the observability
                               plane, docs/OBSERVABILITY.md): request count/
@@ -29,13 +32,23 @@ Endpoints (all JSON unless noted):
                               and whatever the runtime's DCN hooks fed
                               into the shared registry (wire bytes,
                               negotiated edge bitwidths, heartbeats)
-- POST /degraded {"degraded": bool, "dead_rank"?: n, "retry_after"?: s}
+- POST /degraded {"degraded": bool, "dead_rank"?: n, "retry_after"?: s,
+                  "healing"?: bool, "healed"?: bool, "rank"?: n}
                            -> {"degraded": bool} — the failover
                               orchestrator's hook: while degraded, new
                               work is answered 503 + Retry-After and
                               /healthz names the dead rank; an in-flight
                               request whose executor fails during the
-                              window is replayed once after recovery
+                              window is replayed once after recovery.
+                              Lifecycle (docs/FAULT_TOLERANCE.md): the
+                              orchestrator posts {"degraded": true, ...}
+                              at the death, {"degraded": true, "healing":
+                              true} once the rank rejoins (window still
+                              open, /healthz phase flips to "healing"),
+                              and {"degraded": false, "healed": true,
+                              "rank": n} when capacity is restored — that
+                              last form clears the window AND counts the
+                              rank on pipeedge_serve_rejoined_ranks_total
 - POST /prefix   {"ids": [t0, t1, ...]}
                            -> {"prefix_id": "p0", "len": N}
 - POST /generate {"ids": [[...], ...] | [...], "new_tokens": N,
@@ -137,6 +150,10 @@ class _Service:
         self.m_replays = prom.REGISTRY.counter(
             "pipeedge_serve_failover_replays_total",
             "in-flight requests replayed after a degraded window closed")
+        self.m_rejoined = prom.REGISTRY.counter(
+            "pipeedge_serve_rejoined_ranks_total",
+            "degraded windows closed as HEALED (capacity restored by a "
+            "rank rejoining), by rank")
         self.m_last_dead = prom.REGISTRY.gauge(
             "pipeedge_serve_last_dead_rank",
             "rank named by the most recent degraded window (-1 = none)")
@@ -242,16 +259,39 @@ class _Service:
         with self.cond:
             self.degraded_info = {"dead_rank": dead_rank,
                                   "since": time.monotonic(),
-                                  "retry_after": float(retry_after)}
+                                  "retry_after": float(retry_after),
+                                  "phase": "degraded"}
             self.cond.notify_all()
         self.m_degraded.inc()
         if dead_rank is not None:
             self.m_last_dead.set(int(dead_rank))
 
-    def exit_degraded(self):
+    def mark_healing(self):
+        """The dead rank rejoined and the orchestrator is restoring the
+        partition: the window stays open (new work still bounces with
+        Retry-After — the heal lands at a round boundary, not instantly),
+        but /healthz distinguishes `healing` from plain `degraded`. A
+        no-op when no window is open (a stray healing signal must not
+        resurrect a closed window)."""
         with self.cond:
+            if self.degraded_info is not None:
+                self.degraded_info["phase"] = "healing"
+                self.cond.notify_all()
+
+    def exit_degraded(self, healed: bool = False, rank=None):
+        """Close the window. `healed=True` records the close as a
+        capacity restoration (the orchestrator's {"degraded": false,
+        "healed": true} form) on pipeedge_serve_rejoined_ranks_total —
+        distinct from a plain manual clear."""
+        with self.cond:
+            was_open = self.degraded_info is not None
             self.degraded_info = None
             self.cond.notify_all()
+        if healed and was_open:
+            # unlabeled on purpose: healthz stats() reads the same series
+            # back (value() is per-label-set); the healed rank stays
+            # visible as last_dead_rank history
+            self.m_rejoined.inc()
 
     def _check_admittable(self):
         deg = self.degraded_info
@@ -433,6 +473,7 @@ class _Service:
         # instruments /metrics renders, so the two surfaces cannot diverge
         s["degraded_entered_total"] = int(self.m_degraded.value())
         s["failover_replays_total"] = int(self.m_replays.value())
+        s["rejoined_ranks_total"] = int(self.m_rejoined.value())
         last = self.m_last_dead.value()
         s["last_dead_rank"] = (None if last is None or last < 0
                                else int(last))
@@ -567,7 +608,10 @@ def make_handler(service, model_name):
                     degraded = {"dead_rank": deg["dead_rank"],
                                 "since_s": round(time.monotonic()
                                                  - deg["since"], 3),
-                                "retry_after": deg["retry_after"]}
+                                "retry_after": deg["retry_after"],
+                                # "degraded" (hole open) vs "healing"
+                                # (rank rejoined, restore in progress)
+                                "phase": deg.get("phase", "degraded")}
                 self._send(503 if dead else 200,
                            {"ok": not dead, "model": model_name,
                             "stages": len(service.pipe.stages),
@@ -583,13 +627,20 @@ def make_handler(service, model_name):
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n) or b"{}")
                 if self.path == "/degraded":
-                    # the failover orchestrator's switch (see module doc)
+                    # the failover orchestrator's switch (see module doc):
+                    # degraded -> healing -> healed lifecycle
                     if req.get("degraded", True):
-                        service.enter_degraded(
-                            dead_rank=req.get("dead_rank"),
-                            retry_after=float(req.get("retry_after", 5)))
+                        if req.get("healing"):
+                            service.mark_healing()
+                        else:
+                            service.enter_degraded(
+                                dead_rank=req.get("dead_rank"),
+                                retry_after=float(req.get("retry_after",
+                                                          5)))
                     else:
-                        service.exit_degraded()
+                        service.exit_degraded(
+                            healed=bool(req.get("healed")),
+                            rank=req.get("rank"))
                     self._send(200, {"degraded":
                                      service.degraded_info is not None})
                 elif self.path == "/prefix":
