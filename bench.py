@@ -196,18 +196,48 @@ def main():
             "energy_source": "unreadable on this host (no readable RAPL "
                              "powercap domains)"}
 
-    # p50 microbatch latency: individual dispatch, fenced per microbatch
+    # p50 microbatch latency: individual dispatch, fenced per microbatch.
+    # Segmented (dispatch = host enqueue of the jitted call, transfer =
+    # device execution + readiness fence, emit = host scalar readback)
+    # through telemetry spans so the medians come out of the same span
+    # machinery the DCN trace reports use — the per-segment view of
+    # where the steady-vs-p50 gap lives (ROADMAP item 5).
+    from pipeedge_tpu import telemetry
+    from pipeedge_tpu.telemetry import report as span_report
+
     @jax.jit
     def run_one(p, x):
         return jnp.sum(fn(p, x).astype(jnp.float32))
 
     float(run_one(params, xs[0]))  # compile + warm
+    rec = telemetry.configure(rank=0)
     lats = []
     for i in range(n_ubatch):
         tik = time.monotonic()
-        float(run_one(params, xs[i]))
+        with telemetry.span("stage", "dispatch", mb=i):
+            fut = run_one(params, xs[i])
+        with telemetry.span("stage", "transfer", mb=i):
+            fut.block_until_ready()
+        with telemetry.span("stage", "emit", mb=i):
+            float(fut)
         lats.append(time.monotonic() - tik)
+    segments = span_report.segment_medians(rec.snapshot(),
+                                           cats=frozenset(("stage",)))
+    telemetry.disable()
     p50_ms = statistics.median(lats) * 1e3
+    steady_lats = sorted(lats[1:])
+    latency_breakdown = {
+        # first measured microbatch vs the warm rest: the fill/steady
+        # split BENCH rounds track against steady_state_ubatch_ms
+        "fill_ms": round(lats[0] * 1e3, 2),
+        "steady_p50_ms": round(
+            span_report._percentile(steady_lats, 50) * 1e3, 2),
+        "steady_p99_ms": round(
+            span_report._percentile(steady_lats, 99) * 1e3, 2),
+        "segments_p50_ms": {
+            key.split("/", 1)[1]: val["p50_ms"]
+            for key, val in segments.items()},
+    }
 
     flops_img = _model_flops_per_image(cfg)
     achieved = img_per_sec * flops_img
@@ -277,6 +307,7 @@ def main():
         "value_spread": [round(samples[0], 3), round(samples[-1], 3)],
         "value_samples": [round(s, 3) for s in samples],
         "p50_microbatch_latency_ms": round(p50_ms, 2),
+        "latency_breakdown": latency_breakdown,
         "steady_state_ubatch_ms": round(min(times) / n_ubatch * 1e3, 2),
         "mfu": round(achieved / peak_flops, 3),
         "mfu_calibrated": round(achieved / peak_flops, 3),

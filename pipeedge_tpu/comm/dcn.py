@@ -40,6 +40,7 @@ import os
 import queue
 import socket
 import struct
+import sys
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -99,6 +100,15 @@ _MSG_SPANS_ACK = 8
 # and replies _MSG_JOIN_ACK (aux = receiver's epoch; -1 = refused).
 _MSG_JOIN = 9
 _MSG_JOIN_ACK = 10
+# tiered-transport negotiation (aux = path-tier code): a producing rank
+# asks the consuming rank which transport tier its edge should ride —
+# answered inline by the receiving reader thread like `_MSG_NEG`. The
+# receiver grants the COLOCATED tier only when the proposer's context is
+# registered in this very process (the hand-off is a direct queue put of
+# device buffers, so both ends must share an address space), else the
+# zero-copy socket tier when its receive pool is enabled, else legacy v2.
+_MSG_PATH = 11
+_MSG_PATH_ACK = 12
 _SPANS_PROBE = 1    # aux: timestamps only (clock probe)
 _SPANS_REQUEST = 0  # aux: timestamps + span ring
 _SPANS_DIGEST = 2   # aux: timestamps + cumulative duration digest — the
@@ -123,6 +133,115 @@ ENV_EPOCH = "DCN_EPOCH"                             # this rank's incarnation
 # frames stay fenced (comm/chaos.py `restart@K:MS` re-execs with it
 # incremented; orchestrators do the same).
 DEFAULT_HEARTBEAT_MISS = 3
+
+# -- tiered inter-stage transport (docs/DCN_WIRE.md selection matrix) ----
+# Per edge, the producer negotiates the cheapest path the consumer can
+# serve (`negotiate_edge_bits` idiom, `_MSG_PATH` on the control channel):
+#
+#   local      colocated ranks (same process): device buffers hand off
+#              through the consumer context's bounded recv queue directly —
+#              no serialize, no D2H/H2D round trip, no socket. The wire
+#              protocol's framing (src, epoch, channel) rides as queue
+#              metadata; epoch fencing, liveness signs, and the monitor
+#              hooks behave exactly like the socket reader's.
+#   zerocopy   remote edges: scatter-gather `sendmsg` writes (no flattening
+#              copy — the pre-existing send path) paired with POOLED
+#              receive buffers: payloads land via `recv_into` in reusable
+#              buffers and surface as ndarray views, eliminating the
+#              per-tensor bytes() copy. Buffers recycle only when no
+#              consumer still references them (refcount ownership), so a
+#              retained array — the failover ledger, a replay — can never
+#              observe a recycled buffer.
+#   socket_v2  the legacy copy-on-receive socket path (fallback, and the
+#              A/B baseline: DCN_RECV_POOL=0).
+PATH_SOCKET_V2 = "socket_v2"
+PATH_ZEROCOPY = "zerocopy"
+PATH_LOCAL = "local"
+PATH_CODES = {PATH_SOCKET_V2: 0, PATH_ZEROCOPY: 1, PATH_LOCAL: 2}
+_PATH_BY_CODE = {v: k for k, v in PATH_CODES.items()}
+ENV_RECV_POOL = "DCN_RECV_POOL"          # 0 disables pooled recv buffers
+ENV_LOCAL_HANDOFF = "DCN_LOCAL_HANDOFF"  # 0 disables the colocated tier
+
+# process-local context registry, keyed by listen address: how a sender
+# discovers that a destination rank's context lives in THIS process (and
+# its frames can skip the socket entirely). Registered in init(),
+# unregistered in shutdown().
+_LOCAL_CONTEXTS: Dict[Tuple[str, int], "DistDcnContext"] = {}
+_LOCAL_LOCK = threading.Lock()
+
+
+class _RecvBufferPool:
+    """Reusable receive buffers for the zero-copy socket tier.
+
+    `acquire(n)` hands out a bytearray of at least `n` bytes; payloads are
+    `recv_into`'d and surfaced as `np.frombuffer` views, so the buffer
+    stays referenced for exactly as long as any consumer holds the array.
+    Recycling is refcount-driven: a buffer is reused only when the pool
+    itself is its sole owner — ownership hand-off without a release
+    protocol, and a retained array (the ledger holding a result, a replay
+    in flight) silently promotes its buffer out of rotation instead of
+    ever being overwritten. One pool per reader thread: no locking.
+    """
+
+    # 3 == pool list + loop variable + getrefcount argument: no array
+    # view (or any other consumer) references the buffer
+    _FREE_REFCOUNT = 3
+
+    def __init__(self, max_buffers: int = 16):
+        self._bufs: List[bytearray] = []
+        self._max = max_buffers
+
+    def acquire(self, n: int) -> bytearray:
+        for buf in self._bufs:
+            if len(buf) >= n \
+                    and sys.getrefcount(buf) == self._FREE_REFCOUNT:
+                return buf
+        buf = bytearray(max(n, 4096))
+        # retained buffers (refcount > free) rotate out: drop the oldest
+        # still-held entry first — its consumer keeps it alive, and the
+        # pool can never reuse it while held — so free (just too-small)
+        # buffers survive for smaller frames; only a fully-free pool
+        # evicts a reusable one
+        if len(self._bufs) >= self._max:
+            idx = 0
+            for old in self._bufs:   # same refcount shape as the scan above
+                if sys.getrefcount(old) != self._FREE_REFCOUNT:
+                    break            # held: evict this one
+                idx += 1
+            self._bufs.pop(idx if idx < len(self._bufs) else 0)
+        self._bufs.append(buf)
+        return buf
+
+
+def _recv_pool_enabled() -> bool:
+    return os.getenv(ENV_RECV_POOL, "1") != "0" \
+        and hasattr(sys, "getrefcount")
+
+
+def _local_handoff_enabled() -> bool:
+    return os.getenv(ENV_LOCAL_HANDOFF, "1") != "0"
+
+
+def _put_on_device(tensors: List, device) -> List:
+    """Move the device arrays in a colocated hand-off onto the consumer's
+    device (`utils/jax_compat.py` has no shim to add here: `device_put`
+    between colocated devices is the ICI/DMA transfer — it never routes
+    through the host; within one mesh the SPMD pipeline's
+    `collective_permute` edges in parallel/spmd.py cover the same hop).
+    Host ndarrays pass through untouched — the consumer's first jit
+    places them. No-jax builds (socket-only users) degrade to a no-op."""
+    try:
+        import jax
+    except ImportError:  # pragma: no cover - jax ships with this tree
+        return tensors
+    out = []
+    for t in tensors:
+        if isinstance(t, jax.Array) and device is not None \
+                and getattr(t, "sharding", None) is not None \
+                and t.sharding.device_set != {device}:
+            t = jax.device_put(t, device)
+        out.append(t)
+    return out
 
 
 # /metrics plane: exceeded-silence events the liveness watcher saw (the
@@ -260,16 +379,22 @@ def _unpack_nibbles(payload: bytes, n: int, dtype: np.dtype) -> np.ndarray:
     return nib.astype(dtype)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray(n)
-    view = memoryview(buf)
-    got = 0
+def _recv_into_exact(sock: socket.socket, view: memoryview) -> None:
+    """Fill `view` completely from the socket (raises on peer close)."""
+    got, n = 0, view.nbytes
     while got < n:
         r = sock.recv_into(view[got:], n - got)
         if r == 0:
             raise ConnectionError("peer closed")
         got += r
-    return bytes(buf)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytearray:
+    # returns the bytearray itself (struct.unpack and np.frombuffer both
+    # take any buffer): no bytes() flattening copy
+    buf = bytearray(n)
+    _recv_into_exact(sock, memoryview(buf))
+    return buf
 
 
 # Linux caps sendmsg at UIO_MAXIOV (1024) iovecs; frames with many tensors
@@ -315,7 +440,8 @@ def _recv_header(sock: socket.socket) -> Tuple[int, int, int, int]:
     return _HEADER.unpack(_recv_exact(sock, _HEADER.size))
 
 
-def _recv_body(sock: socket.socket, n: int) -> List[np.ndarray]:
+def _recv_body(sock: socket.socket, n: int,
+               pool: Optional[_RecvBufferPool] = None) -> List[np.ndarray]:
     tensors = []
     for _ in range(n):
         code, ndim = _TENSOR_HEADER.unpack(
@@ -331,8 +457,19 @@ def _recv_body(sock: socket.socket, n: int) -> List[np.ndarray]:
             payload = _recv_exact(sock, (n_values + 1) // 2)
             tensors.append(_unpack_nibbles(payload, n_values,
                                            dtype).reshape(shape))
+            continue
+        nbytes = dtype.itemsize * n_values
+        if pool is not None and nbytes > 0:
+            # zero-copy tier: the payload lands directly in a pooled
+            # buffer and the array is a VIEW over it — no intermediate
+            # allocation or copy. The view's refcount is what keeps the
+            # buffer out of rotation (see _RecvBufferPool).
+            buf = pool.acquire(nbytes)
+            _recv_into_exact(sock, memoryview(buf)[:nbytes])
+            tensors.append(np.frombuffer(buf, dtype=dtype,
+                                         count=n_values).reshape(shape))
         else:
-            payload = _recv_exact(sock, dtype.itemsize * n_values)
+            payload = _recv_exact(sock, nbytes)
             tensors.append(np.frombuffer(payload, dtype=dtype).reshape(shape))
     return tensors
 
@@ -379,6 +516,16 @@ class DistDcnContext(DistContext):
         # in-flight collect_spans per peer, like negotiation)
         self._span_replies: Dict[int, "queue.Queue"] = {}
         self._span_lock = threading.Lock()
+        # tiered transport (docs/DCN_WIRE.md): negotiated path per
+        # DESTINATION rank (producer side; only PATH_LOCAL changes this
+        # context's send behavior), path-negotiation reply queues, the
+        # env-resolved tier capabilities, and the device colocated
+        # hand-offs should land on (set_local_device)
+        self._edge_path: Dict[int, str] = {}
+        self._path_replies: Dict[int, "queue.Queue"] = {}
+        self._recv_pool_on = _recv_pool_enabled()
+        self._local_on = _local_handoff_enabled()
+        self._local_device = None
         # env override so small test fleets / fast-failing deployments don't
         # wait the full minute for a peer that will never come up
         env_timeout = os.getenv("DCN_CONNECT_TIMEOUT")
@@ -567,6 +714,9 @@ class DistDcnContext(DistContext):
             logger.info("rank %d: peer rank %d reconnected within grace",
                         self._rank, rank)
             return
+        # a dead peer's negotiated path is void: whatever replaces it
+        # (failover target, restarted incarnation) must renegotiate
+        self._edge_path.pop(rank, None)
         logger.warning("rank %d: peer rank %d %s (peer death?)",
                        self._rank, rank, reason)
         if self._peer_death_handler is not None:
@@ -621,7 +771,10 @@ class DistDcnContext(DistContext):
         if timer is not None:
             timer.cancel()
         # the old incarnation's outgoing sockets are gone; drop them so
-        # the next send/beat redials the restarted listener
+        # the next send/beat redials the restarted listener. Its
+        # negotiated transport path is equally stale (a restarted rank
+        # is a NEW process: a colocated grant would now dangle).
+        self._edge_path.pop(src, None)
         with self._conns_lock:
             self._conns.pop(src, None)
             self._cmd_conns.pop(src, None)
@@ -809,6 +962,8 @@ class DistDcnContext(DistContext):
         self._recv_queues = {}
         self._neg_replies = {}
         self._span_replies = {}
+        self._path_replies = {}
+        self._edge_path = {}
         self._dead = set()
         self._alive_at = {}
         self._pending_death = {}
@@ -828,10 +983,18 @@ class DistDcnContext(DistContext):
             target=self._accept_loop, daemon=True,
             name=f"dcn-accept-{self._rank}")
         self._accept_thread.start()
+        # colocated-tier discovery: contexts in one process find each
+        # other by listen address (a rank's address is unique fleet-wide)
+        with _LOCAL_LOCK:
+            _LOCAL_CONTEXTS[tuple(self._rank_addrs[self._rank])] = self
         super().init()
 
     def shutdown(self) -> None:
         self._stop.set()
+        key = tuple(self._rank_addrs[self._rank])
+        with _LOCAL_LOCK:
+            if _LOCAL_CONTEXTS.get(key) is self:
+                del _LOCAL_CONTEXTS[key]
         self.stop_heartbeat()
         with self._dead_lock:
             timers = list(self._pending_death.values())
@@ -892,6 +1055,9 @@ class DistDcnContext(DistContext):
         src = -1
         conn_epoch = 0
         warned_stale = False
+        # zero-copy tier: one receive-buffer pool per connection (reader
+        # threads never share buffers, so the pool needs no lock)
+        pool = _RecvBufferPool() if self._recv_pool_on else None
         try:
             msg_type, src, _, hello = _recv_frame(conn)
             if msg_type != _MSG_HELLO:
@@ -919,7 +1085,7 @@ class DistDcnContext(DistContext):
                 with self._dead_lock:
                     stale = conn_epoch < self._min_epoch.get(src, 0)
                 if stale:
-                    _recv_body(conn, n_tensors)
+                    _recv_body(conn, n_tensors, pool)
                     self.stale_frames_dropped += 1
                     _STALE_FRAMES.inc(peer=str(src))
                     # one WARNING per connection, debug thereafter: a
@@ -944,7 +1110,7 @@ class DistDcnContext(DistContext):
                          if msg_type == _MSG_TENSORS and telemetry.enabled()
                          else 0)
                 try:
-                    tensors = _recv_body(conn, n_tensors)
+                    tensors = _recv_body(conn, n_tensors, pool)
                 except Exception:
                     # abort notification: a paired measurement started by the
                     # pre hook must be discarded, or this (recyclable) thread
@@ -987,6 +1153,18 @@ class DistDcnContext(DistContext):
                                        exc)
                 elif msg_type == _MSG_NEG_ACK:
                     self._neg_queue(src).put(aux)
+                elif msg_type == _MSG_PATH:
+                    # transport-tier proposal: answered inline like the
+                    # bitwidth handshake (no app wiring)
+                    try:
+                        self._send_neg(src, _MSG_PATH_ACK,
+                                       self._accept_edge_path(src, aux))
+                    except OSError as exc:
+                        logger.warning("rank %d: path-handshake reply to "
+                                       "rank %d failed: %s", self._rank,
+                                       src, exc)
+                elif msg_type == _MSG_PATH_ACK:
+                    self._path_queue(src).put(aux)
                 elif msg_type == _MSG_SPANS:
                     # answer inline (transport-level, like _MSG_NEG): the
                     # requester's clock probe needs t_rx stamped NOW
@@ -1114,7 +1292,24 @@ class DistDcnContext(DistContext):
         killing the edge. The receiver discards a torn partial frame with
         its dropped connection, so a resend can duplicate a frame but never
         corrupt one; consumers that must be exactly-once dedupe at the
-        application layer (runtime.py's microbatch-id ledger)."""
+        application layer (runtime.py's microbatch-id ledger).
+
+        When `negotiate_edge_path` agreed the COLOCATED tier for `dst`,
+        the frame skips the socket entirely: tensors (host or device
+        arrays) hand off through the in-process peer's recv queue with
+        the framing as metadata. A peer that left the process meanwhile
+        (clean shutdown) degrades back to the socket path."""
+        if self._edge_path.get(dst) == PATH_LOCAL:
+            peer = self._local_peer(dst)
+            if peer is not None:
+                try:
+                    self._deliver_local(peer, dst, tensors, channel)
+                    return
+                except (ConnectionError, OSError):
+                    self._mark_dead(dst)
+                    raise
+            # grant went stale (peer context gone): socket truth resumes
+            self._edge_path.pop(dst, None)
         attempts = 1 + max(0, self.send_retries)
         for attempt in range(attempts):
             try:
@@ -1306,6 +1501,151 @@ class DistDcnContext(DistContext):
                 break
         self._send_neg(dst, _MSG_NEG, int(proposed))
         return int(q.get(timeout=timeout))
+
+    # -- tiered transport (colocated / zero-copy / legacy v2) ----------
+
+    def _path_queue(self, peer: int) -> "queue.Queue":
+        with self._neg_lock:
+            q = self._path_replies.get(peer)
+            if q is None:
+                q = queue.Queue()
+                self._path_replies[peer] = q
+            return q
+
+    def _local_peer(self, rank: int) -> Optional["DistDcnContext"]:
+        """The live context serving `rank` IN THIS PROCESS, or None. The
+        registry is keyed by listen address, so the check is also proof
+        both ends share an address space — the colocated tier's only
+        requirement."""
+        if not 0 <= rank < self._world_size:
+            return None
+        with _LOCAL_LOCK:
+            peer = _LOCAL_CONTEXTS.get(tuple(self._rank_addrs[rank]))
+        if peer is None or peer._rank != rank or peer._stop.is_set():
+            return None
+        return peer
+
+    def _accept_edge_path(self, src: int, proposed_code: int) -> int:
+        """Receiver policy for a `_MSG_PATH` proposal: the colocated tier
+        when the proposer's context is registered in this process (and
+        both sides enable it), else zero-copy when this context pools its
+        receive buffers, else legacy v2."""
+        if proposed_code >= PATH_CODES[PATH_LOCAL] and self._local_on \
+                and self._local_peer(src) is not None:
+            return PATH_CODES[PATH_LOCAL]
+        if self._recv_pool_on:
+            return PATH_CODES[PATH_ZEROCOPY]
+        return PATH_CODES[PATH_SOCKET_V2]
+
+    def negotiate_edge_path(self, dst: int,
+                            timeout: Optional[float] = 30.0) -> str:
+        """Agree this edge's transport tier with the consuming rank over
+        the control channel (the `negotiate_edge_bits` idiom): propose the
+        cheapest tier this side supports, get back what `dst` serves.
+        PATH_LOCAL switches `send_tensors(dst, ...)` to the in-process
+        device-buffer hand-off; the socket tiers are receiver-local
+        behavior and the answer is informational (telemetry records it
+        either way). Run once per edge before streaming — the runtime
+        renegotiates at every round build, so failover targets and
+        restarted incarnations never ride a stale grant. Raises
+        queue.Empty on timeout and OSError when `dst` is unreachable."""
+        proposed = (PATH_CODES[PATH_LOCAL]
+                    if self._local_on and self._local_peer(dst) is not None
+                    else PATH_CODES[PATH_ZEROCOPY])
+        q = self._path_queue(dst)
+        while True:  # drop stale replies from an abandoned negotiation
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+        self._send_neg(dst, _MSG_PATH, proposed)
+        code = int(q.get(timeout=timeout))
+        tier = _PATH_BY_CODE.get(code, PATH_SOCKET_V2)
+        if tier == PATH_LOCAL and (not self._local_on
+                                   or self._local_peer(dst) is None):
+            # the grant outlived the peer's registration (or this side
+            # disabled the tier): degrade to the socket truth
+            tier = (PATH_ZEROCOPY if self._recv_pool_on
+                    else PATH_SOCKET_V2)
+        self._edge_path[dst] = tier
+        # per-tier telemetry marker: trace_report's transport section
+        # counts edges per tier from these instants
+        now = time.monotonic_ns()
+        telemetry.record("transport", f"{tier}:{self._rank}->{dst}",
+                         now, now)
+        logger.info("rank %d: edge ->%d rides the %s path", self._rank,
+                    dst, tier)
+        return tier
+
+    def edge_path(self, dst: int) -> Optional[str]:
+        """The tier `negotiate_edge_path` agreed for sends to `dst`
+        (None = never negotiated: the legacy socket path)."""
+        return self._edge_path.get(dst)
+
+    def set_local_device(self, device) -> None:
+        """Device colocated hand-offs INTO this context should land on:
+        a producer's device buffers are moved device-to-device (ICI /
+        DMA via `jax.device_put`, never through the host) before they
+        reach this rank's recv queue. None (default) hands buffers off
+        wherever they already live."""
+        self._local_device = device
+
+    def _deliver_local(self, peer: "DistDcnContext", dst: int,
+                       tensors: Sequence, channel: int) -> None:
+        """Colocated-tier send: hand `tensors` (host OR device arrays)
+        straight to `peer`'s bounded recv queue. Framing travels as
+        metadata (src rank, sender epoch, channel); the send/recv monitor
+        hooks and telemetry fire exactly like the socket path's."""
+        if self._send_pre_hook is not None:
+            self._send_pre_hook(dst, channel)
+        t0 = time.monotonic_ns() if telemetry.enabled() else 0
+        try:
+            peer._local_put(self._rank, self.epoch, list(tensors), channel)
+        except Exception:
+            if self._send_pre_hook is not None \
+                    and self._send_post_hook is not None:
+                self._send_post_hook(dst, channel, None)  # abort
+            raise
+        if t0:
+            telemetry.record("wire", f"local->r{dst}", t0,
+                             time.monotonic_ns())
+        if self._send_post_hook is not None:
+            self._send_post_hook(dst, channel, tensors)
+
+    def _local_put(self, src: int, epoch: int, tensors: List,
+                   channel: int) -> None:
+        """Receiver half of the colocated hand-off: the reader loop's
+        contract (epoch fence, life sign, recv hooks, bounded queue
+        backpressure) without a socket in between. Runs on the SENDER's
+        thread; blocking on a full queue is this tier's backpressure."""
+        with self._dead_lock:
+            self._peer_epoch[src] = max(self._peer_epoch.get(src, 0), epoch)
+            stale = epoch < self._min_epoch.get(src, 0)
+        if stale:
+            # same fencing as the socket reader: a zombie incarnation's
+            # hand-off must never reach queues — and earns no life sign
+            self.stale_frames_dropped += 1
+            _STALE_FRAMES.inc(peer=str(src))
+            logger.warning("rank %d: dropping stale local hand-off from "
+                           "rank %d epoch %d (fence %d)", self._rank, src,
+                           epoch, self.min_epoch_of(src))
+            return
+        self._alive_sign(src)
+        if self._local_device is not None:
+            tensors = _put_on_device(tensors, self._local_device)
+        if self._recv_pre_hook is not None:
+            self._recv_pre_hook(src, channel)
+        if self._recv_post_hook is not None:
+            self._recv_post_hook(src, channel, tensors)
+        q = self._queue_for(src, channel)
+        while not self._stop.is_set():
+            try:
+                q.put((epoch, tensors), timeout=0.2)
+                return
+            except queue.Full:
+                continue
+        raise ConnectionError(f"rank {self._rank} stopped; local hand-off "
+                              f"from rank {src} refused")
 
     # -- fleet span collection (telemetry) -----------------------------
 

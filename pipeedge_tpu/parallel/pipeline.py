@@ -115,7 +115,14 @@ class PipelineStage:
         return fn
 
     def __call__(self, payload):
-        payload = jax.device_put(payload, self.device)
+        # tiered edge transfer (docs/DCN_WIRE.md): a payload already
+        # resident on this stage's device (the single-device pipeline, or
+        # consecutive stages sharing a chip) skips the device_put dispatch
+        # entirely — the host-hop-free degenerate of the DCN colocated
+        # hand-off; cross-device payloads ride device-to-device DMA/ICI.
+        if not _payload_on_device(payload, self.device):
+            with telemetry.span("wire", f"edge->{self.name or 'stage'}"):
+                payload = jax.device_put(payload, self.device)
         return self._fn_for_bit(self.quant_bit)(self.params, payload)
 
 
@@ -188,6 +195,9 @@ class HostPipeline:
         # (items, t_retired) per microbatch, stamped as each result becomes
         # host-visible — the steady-state measurement's raw series
         retired: List[Tuple[int, float]] = []
+        # per-mb end-to-end latency (enqueue -> host-visible result): the
+        # fill/steady breakdown's raw series
+        mb_latency_s: List[float] = []
         track_edges = self.edge_bytes_callback is not None
         tik = time.monotonic()
         dispatch_s: List[float] = []  # per-mb host enqueue cost (t_fixed)
@@ -196,13 +206,13 @@ class HostPipeline:
             t_d0 = time.monotonic()
             out = self.enqueue(ubatch, edge_bytes, mb=i)
             dispatch_s.append(time.monotonic() - t_d0)
-            inflight.append((i, out, edge_bytes))
+            inflight.append((i, out, edge_bytes, t_d0))
             while inflight and payload_ready(inflight[0][1]):
-                self._retire(inflight.pop(0), results, retired)
+                self._retire(inflight.pop(0), results, retired, mb_latency_s)
             while len(inflight) >= self.max_inflight:
-                self._retire(inflight.pop(0), results, retired)
+                self._retire(inflight.pop(0), results, retired, mb_latency_s)
         while inflight:
-            self._retire(inflight.pop(0), results, retired)
+            self._retire(inflight.pop(0), results, retired, mb_latency_s)
         tok = time.monotonic()
         items = sum(_leading_dim(u) for u in ubatches)
         latency = tok - tik
@@ -225,14 +235,29 @@ class HostPipeline:
                 stats["steady_state_throughput_items_sec"] = \
                     steady_items / steady_s
                 stats["steady_mb_interval_s"] = steady_s / (len(retired) - 1)
+        if mb_latency_s:
+            # fill vs steady split (BENCH latency-gap tracking, ROADMAP
+            # item 5): the first microbatch's latency carries compile +
+            # pipeline fill; the steady percentiles are what an SLO sees
+            from pipeedge_tpu.telemetry.report import _percentile
+            steady = sorted(mb_latency_s[1:]) or [mb_latency_s[0]]
+            stats["latency_breakdown"] = {
+                "fill_ms": round(mb_latency_s[0] * 1e3, 3),
+                "steady_p50_ms": round(_percentile(steady, 50) * 1e3, 3),
+                "steady_p99_ms": round(_percentile(steady, 99) * 1e3, 3),
+            }
         return results, stats
 
-    def _retire(self, item, results, retired: Optional[list] = None):
-        i, out, edge_bytes = item
+    def _retire(self, item, results, retired: Optional[list] = None,
+                mb_latency_s: Optional[list] = None):
+        i, out, edge_bytes, t_enq = item
         with telemetry.span("results", "retire", mb=i):
             out = jax.block_until_ready(out)
+        now = time.monotonic()
         if retired is not None:
-            retired.append((_leading_dim(out), time.monotonic()))
+            retired.append((_leading_dim(out), now))
+        if mb_latency_s is not None and t_enq is not None:
+            mb_latency_s.append(now - t_enq)
         if self.edge_bytes_callback is not None:
             self.edge_bytes_callback(i, edge_bytes)
         if self.ubatch_callback is not None:
@@ -243,6 +268,26 @@ class HostPipeline:
 def _leading_dim(ubatch) -> int:
     t = ubatch[0] if isinstance(ubatch, tuple) else ubatch
     return int(t.shape[0])
+
+
+def _payload_on_device(payload, device) -> bool:
+    """Whether every array in a stage payload is already committed to
+    `device` (single-device shardings only). Conservative False for host
+    arrays and anything that cannot answer, so callers fall back to the
+    explicit device_put."""
+    tensors = payload if isinstance(payload, tuple) else (payload,)
+    for t in tensors:
+        if isinstance(t, quant_ops.QuantizedTensor):
+            if not _payload_on_device((t.data, t.scale, t.shift), device):
+                return False
+            continue
+        sharding = getattr(t, "sharding", None)
+        try:
+            if sharding is None or sharding.device_set != {device}:
+                return False
+        except Exception:  # noqa: BLE001 - deleted buffer, odd sharding
+            return False
+    return True
 
 
 def payload_ready(payload) -> bool:

@@ -73,6 +73,44 @@ def _percentile(sorted_vals: List[float], q: float) -> float:
     return sorted_vals[idx]
 
 
+# span categories whose per-name duration distributions are worth a
+# segment breakdown (the dispatch/transfer/emit gap-hunting view)
+SEGMENT_CATEGORIES = frozenset(("stage", "wire", "quant", "feed",
+                                "results"))
+
+
+def segment_medians(spans: Sequence[dict],
+                    cats: Optional[frozenset] = None) -> Dict[str, dict]:
+    """Per-(category, name) duration percentiles over a span list:
+    `{"cat/name": {"n", "p50_ms", "p95_ms"}}`. The per-segment view of
+    where a microbatch's end-to-end time goes — dispatch vs transfer vs
+    emit — consumed by `tools/trace_report.py` and bench.py's latency
+    breakdown. Feed/results names embed microbatch ids; they are folded
+    to their prefix so the table stays bounded."""
+    cats = SEGMENT_CATEGORIES if cats is None else cats
+    series: Dict[str, List[float]] = {}
+    for s in spans:
+        if s.get("cat") not in cats or s.get("t1") is None:
+            continue
+        name = str(s.get("name", ""))
+        # fold per-mb names ("mb17") and per-peer names ("send->r2") so
+        # one segment key aggregates the whole series
+        for sep in ("->", "<-"):
+            if sep in name:
+                name = name.split(sep)[0] + sep
+        if name.startswith("mb") and name[2:].isdigit():
+            name = "mb"
+        series.setdefault(f"{s['cat']}/{name}", []).append(
+            (int(s["t1"]) - int(s["t0"])) / 1e6)
+    out = {}
+    for key in sorted(series):
+        vals = sorted(series[key])
+        out[key] = {"n": len(vals),
+                    "p50_ms": round(_percentile(vals, 50), 3),
+                    "p95_ms": round(_percentile(vals, 95), 3)}
+    return out
+
+
 def analyze_spans(spans: Sequence[dict],
                   span_cost_ns: Optional[float] = None) -> dict:
     """One merged-timeline span list -> the report record (plain dict,
@@ -195,6 +233,37 @@ def analyze_spans(spans: Sequence[dict],
                            if seg_pool else None),
         })
 
+    # -- transport tiers (docs/DCN_WIRE.md selection matrix) -----------
+    # negotiation instants (cat "transport", name "tier:src->dst") count
+    # edges per tier; wire-span names split busy time into the colocated
+    # hand-off ("local->...") vs the socket paths — the view that proves
+    # where an edge's host-hop time went after a tier switch
+    # edge -> (t0, tier): the runtime renegotiates every round build, so
+    # an edge's tier is its LATEST negotiation, and counts are unique
+    # edges — not negotiation events
+    edge_tier: Dict[str, Tuple[int, str]] = {}
+    for s in spans:
+        if s.get("cat") == "transport":
+            tier, _, edge = str(s.get("name", "")).partition(":")
+            t0 = int(s.get("t0", 0))
+            if edge not in edge_tier or t0 >= edge_tier[edge][0]:
+                edge_tier[edge] = (t0, tier)
+    tier_edges: Dict[str, int] = {}
+    for _, tier in edge_tier.values():
+        tier_edges[tier] = tier_edges.get(tier, 0) + 1
+    local_busy = _union_ns([(int(s["t0"]), int(s["t1"])) for s in spans
+                            if s.get("cat") == WIRE_CATEGORY
+                            and str(s.get("name", "")).startswith("local")])
+    wire_busy = _union_ns([(int(s["t0"]), int(s["t1"])) for s in spans
+                           if s.get("cat") == WIRE_CATEGORY])
+    transport = {
+        "edges_by_tier": dict(sorted(tier_edges.items())),
+        "local_edges": tier_edges.get("local", 0),
+        "local_busy_s": round(local_busy / 1e9, 6),
+        "local_share_pct": round(100.0 * local_busy / wire_busy, 3)
+        if wire_busy else 0.0,
+    }
+
     # -- closed-loop rebalancing --------------------------------------
     # "plan" spans time every consideration; an instant "apply" span marks
     # each ACCEPTED re-partition (the zero-churn assertion counts these)
@@ -231,6 +300,8 @@ def analyze_spans(spans: Sequence[dict],
         "rounds": rounds,
         "stages": stages,
         "edges": edges,
+        "segments": segment_medians(spans),
+        "transport": transport,
         "mb_latency": mb_latency,
         "failover": failover,
         "rejoin": rejoin,

@@ -118,6 +118,13 @@ _WIRE_BYTES = prom.REGISTRY.counter(
 _EDGE_BITS = prom.REGISTRY.gauge(
     "pipeedge_edge_bits",
     "negotiated wire bitwidth per DCN edge (0 = uncompressed)")
+_EDGE_PATH = prom.REGISTRY.gauge(
+    "pipeedge_edge_path",
+    "negotiated transport tier per DCN edge "
+    "(0 = socket_v2, 1 = zerocopy, 2 = local hand-off)")
+_LEDGER_SNAPSHOTS = prom.REGISTRY.counter(
+    "pipeedge_ledger_snapshots_total",
+    "microbatch-ledger snapshots taken (bounds failover replay state)")
 _HEARTBEATS_RX = prom.REGISTRY.counter(
     "pipeedge_heartbeats_received_total",
     "liveness-plane heartbeat frames received, by sender rank")
@@ -709,6 +716,14 @@ from pipeedge_tpu.comm.wire import (wire_decode as _wire_decode,
                                     wire_encode_device as _wire_encode_device)
 
 
+ENV_LEDGER_SNAPSHOT = "DCN_LEDGER_SNAPSHOT"  # acks between ledger
+# snapshots (0 disables). Each snapshot compacts acknowledged microbatch
+# payloads out of the ledger and advances the replay frontier, so a
+# failover replays from the last snapshot's frontier — O(unacknowledged)
+# work and memory — instead of rescanning (and holding) the whole round.
+DEFAULT_LEDGER_SNAPSHOT = 8
+
+
 class _MicrobatchLedger:
     """Bounded in-flight ledger for the data rank (failover mode): every
     microbatch is registered with its id before dispatch, acknowledged when
@@ -717,12 +732,26 @@ class _MicrobatchLedger:
     frame that was already in flight when the stage died, or a transient
     resend — are dropped by id, and delivery to `handle_results` is held
     until contiguous, so the result stream at the data rank is exactly-once
-    and in microbatch order regardless of arrival order."""
+    and in microbatch order regardless of arrival order.
 
-    def __init__(self, ubatches, labels):
+    Snapshots (`maybe_snapshot`, every `snapshot_every` acks) keep the
+    failover replay O(in-flight) instead of O(round): acknowledged
+    payloads are dropped (they can never be refed — an ack is final) and
+    the replay frontier advances past the acked prefix, so `pending()`
+    after a mid-round death scans and ships only the microbatches that
+    genuinely need replaying from the last snapshot on."""
+
+    def __init__(self, ubatches, labels, snapshot_every: Optional[int] = None):
         self._ubatches = list(ubatches)
         self._labels = (list(labels) if labels
                         else [None] * len(self._ubatches))
+        self._snapshot_every = (snapshot_every if snapshot_every is not None
+                                else int(os.getenv(
+                                    ENV_LEDGER_SNAPSHOT,
+                                    str(DEFAULT_LEDGER_SNAPSHOT))))
+        self._acks_since_snapshot = 0
+        self._frontier = 0        # lowest possibly-unacked microbatch id
+        self.snapshots = 0        # snapshots taken (tests/metrics)
         # mbid -> epoch of the incarnation whose result was accepted: the
         # dedupe key carries the epoch, so forensics (and tests) can tell
         # a same-incarnation resend from a stale-incarnation replay
@@ -747,10 +776,47 @@ class _MicrobatchLedger:
 
     def pending(self) -> List[Tuple[int, np.ndarray]]:
         """(microbatch id, ubatch) pairs not yet acknowledged — what the
-        feed loop sends, and after a failover, exactly the replay set."""
+        feed loop sends, and after a failover, exactly the replay set.
+        The scan starts at the snapshot frontier: everything below it was
+        acked (and compacted away) by the last snapshot."""
         with self._lock:
-            return [(i, u) for i, u in enumerate(self._ubatches)
+            return [(i, self._ubatches[i])
+                    for i in range(self._frontier, len(self._ubatches))
                     if i not in self._acked]
+
+    def maybe_snapshot(self) -> bool:
+        """Count an ack toward the snapshot cadence; snapshot when due.
+        Called by the results loop after every accepted ack (cheap: a
+        counter bump between snapshots)."""
+        if self._snapshot_every <= 0:
+            return False
+        with self._lock:
+            self._acks_since_snapshot += 1
+            if self._acks_since_snapshot < self._snapshot_every:
+                return False
+            self._snapshot_locked()
+        _LEDGER_SNAPSHOTS.inc()
+        return True
+
+    def snapshot(self) -> None:
+        """Compact now (see `maybe_snapshot` for the periodic form)."""
+        with self._lock:
+            self._snapshot_locked()
+        _LEDGER_SNAPSHOTS.inc()
+
+    def _snapshot_locked(self) -> None:
+        # an acked payload is never refed (acks are final even across
+        # failovers — replay covers only unacked ids), so drop it and
+        # advance the frontier past the acked prefix: replay work and
+        # ledger memory both become O(unacknowledged since snapshot)
+        for i in range(self._frontier, len(self._ubatches)):
+            if i in self._acked:
+                self._ubatches[i] = None
+        while self._frontier < len(self._ubatches) \
+                and self._frontier in self._acked:
+            self._frontier += 1
+        self._acks_since_snapshot = 0
+        self.snapshots += 1
 
     def acked_epochs(self) -> dict:
         """mbid -> producing incarnation's epoch, for every accepted ack."""
@@ -1469,6 +1535,15 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                     dtype=dtype, params=restored)
             out_bit = stage_quant[i] if i < len(stage_layers) - 1 else 0
             is_first, is_last = i == 0, i == len(stage_layers) - 1
+            if args.stage_tp <= 1:
+                # colocated hand-offs INTO this rank land on its compute
+                # device (device-to-device move in dcn._put_on_device; a
+                # same-device buffer passes through untouched). TP stages
+                # keep the default: their jit places inbound host arrays
+                # per its own in_shardings, and a forced single-device
+                # commit would fight the mesh.
+                import jax
+                ctx.set_local_device(jax.local_devices()[0])
             # adaptive policy (env ADAPTIVE_QUANT): this rank adapts its
             # own output edge on its own measured 'send' window, exactly
             # the reference's per-rank hook (runtime.py:121-216). The
@@ -1523,6 +1598,23 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                                            timeout=min(30.0,
                                                        args.sched_timeout))
 
+            # transport-tier handshake for this stage's OUTPUT edge
+            # (docs/DCN_WIRE.md selection matrix): colocated consumers
+            # take device buffers straight off this process's queues —
+            # readback then skips the D2H finalize entirely — remote
+            # consumers declare zero-copy vs legacy socket. Timeout or
+            # an unreachable peer keeps the (always-correct) socket path.
+            edge_tier = [None]
+            try:
+                edge_tier[0] = ctx.negotiate_edge_path(
+                    rank_dst, timeout=min(10.0, args.sched_timeout))
+                _EDGE_PATH.set(dcn.PATH_CODES[edge_tier[0]],
+                               edge=f"{rank}->{rank_dst}")
+            except (queue.Empty, OSError) as exc:
+                logger.warning("edge rank %d->%d: transport-path "
+                               "handshake failed (%s); keeping the "
+                               "socket path", rank, rank_dst, exc)
+
             # Overlapped work contract (DcnPipelineStage dispatch/readback
             # split): dispatch decodes the inbound frame ON device, runs
             # the shard step, and quantizes the output edge ON device
@@ -1564,7 +1656,14 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
 
             def readback_cb(item):
                 pending, out, n_items, mbid = item
-                wire = pending.finalize()   # completes the async copies
+                if edge_tier[0] == dcn.PATH_LOCAL:
+                    # colocated consumer: hand the DEVICE buffers off
+                    # as-is — no D2H readback, no serialize; the frame
+                    # metadata rides the local queue (send_tensors'
+                    # negotiated local path)
+                    wire = list(pending.parts)
+                else:
+                    wire = pending.finalize()   # completes the async copies
                 # beat-to-beat measurement (no iteration_start: dispatch
                 # runs on another thread): in steady state the interval
                 # between retiring microbatches IS the per-ubatch time.
@@ -1621,6 +1720,21 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
             first_rank = stage_ranks[0]
             last_rank = stage_ranks[-1]
 
+            # transport tier for the FEED edge (data rank -> head stage):
+            # when the head stage is colocated — the common `-r 0,...`
+            # layout puts stage 0 on the data rank itself — raw inputs
+            # hand off in-process instead of riding a loopback socket
+            # round trip per microbatch
+            try:
+                feed_tier = ctx.negotiate_edge_path(
+                    first_rank, timeout=min(10.0, args.sched_timeout))
+                _EDGE_PATH.set(dcn.PATH_CODES[feed_tier],
+                               edge=f"{rank}->{first_rank}:feed")
+            except (queue.Empty, OSError) as exc:
+                logger.warning("feed edge rank %d->%d: transport-path "
+                               "handshake failed (%s); keeping the "
+                               "socket path", rank, first_rank, exc)
+
             def death_hits_schedule() -> bool:
                 # a dead IDLE spare is recorded but must not tear down a
                 # healthy round (the rebuild + replay cost is real); only
@@ -1654,11 +1768,21 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                         mbid = int(np.asarray(tensors[0]).reshape(-1)[0])
                         with telemetry.span("results", "deliver", mb=mbid):
                             out = _wire_decode(tensors[1:], dtype)
+                            # the ledger retains the DECODED result, not
+                            # the wire views — and a pooled recv buffer
+                            # is recycled only when nothing references
+                            # it (dcn._RecvBufferPool), so even a
+                            # retained view could never be overwritten
                             if not ledger.ack(mbid, np.asarray(out),
                                               epoch=epoch, src=last_rank):
                                 logger.info("failover: duplicate result "
                                             "for microbatch %d dropped",
                                             mbid)
+                            else:
+                                # periodic snapshot: keeps the replay a
+                                # mid-round death would trigger bounded
+                                # to the unacked in-flight window
+                                ledger.maybe_snapshot()
                     return
                 for mbid in range(len(ubatches)):
                     if stop_event.is_set():

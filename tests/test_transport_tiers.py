@@ -1,0 +1,536 @@
+"""Tiered inter-stage transport (docs/DCN_WIRE.md selection matrix):
+path negotiation, the colocated in-process hand-off, the zero-copy pooled
+socket path, buffer-ownership safety, the ledger's snapshot-bounded
+failover replay, and the per-tier telemetry the reports consume."""
+import queue
+import socket
+import sys
+import threading
+import time
+
+import ml_dtypes
+import numpy as np
+import pytest
+
+from pipeedge_tpu import telemetry
+from pipeedge_tpu.comm import dcn
+from pipeedge_tpu.telemetry import report
+
+
+def _free_ports(n):
+    socks = [socket.create_server(("127.0.0.1", 0)) for _ in range(n)]
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _make_contexts(n, **kwargs):
+    addrs = [("127.0.0.1", p) for p in _free_ports(n)]
+    ctxs = [dcn.DistDcnContext(n, r, addrs, **kwargs) for r in range(n)]
+    for c in ctxs:
+        c.init()
+    return ctxs
+
+
+def _shutdown(ctxs):
+    for c in ctxs:
+        c.shutdown()
+
+
+# every wire dtype the frame codec supports, including the nibble-packed
+# sub-byte ones — the bit-identity matrix below runs each through every
+# transport tier
+def _all_dtype_tensors():
+    rng = np.random.default_rng(7)
+    tensors = []
+    for dt in (np.float16, np.float32, np.float64, np.uint8, np.int8,
+               np.int16, np.int32, np.int64, np.bool_, np.complex64,
+               np.complex128, np.uint16, np.uint32, np.uint64):
+        tensors.append((rng.normal(size=(3, 5)) * 10).astype(dt))
+    tensors.append(rng.normal(size=(2, 7)).astype(ml_dtypes.bfloat16))
+    # 4-bit, odd element count (exercises the pad nibble) + signed values
+    tensors.append(np.arange(-8, 7, dtype=np.int8).astype(ml_dtypes.int4))
+    tensors.append(np.arange(0, 15, dtype=np.int8).astype(ml_dtypes.uint4))
+    tensors.append(np.float32(3.25).reshape(()))          # 0-d
+    tensors.append(np.zeros((0, 4), np.float32))          # zero-size
+    return tensors
+
+
+def _roundtrip(ctxs, tensors):
+    ctxs[0].send_tensors(1, tensors)
+    return ctxs[1].recv_tensors(0, timeout=10)
+
+
+# -- negotiation matrix ------------------------------------------------
+
+def test_negotiates_local_for_in_process_peers():
+    ctxs = _make_contexts(2)
+    try:
+        assert ctxs[0].negotiate_edge_path(1, timeout=10) == dcn.PATH_LOCAL
+        assert ctxs[0].edge_path(1) == dcn.PATH_LOCAL
+        # the consumer's own view of its upstream edge is independent
+        assert ctxs[1].edge_path(0) is None
+    finally:
+        _shutdown(ctxs)
+
+
+def test_negotiates_zerocopy_when_local_disabled(monkeypatch):
+    monkeypatch.setenv(dcn.ENV_LOCAL_HANDOFF, "0")
+    ctxs = _make_contexts(2)
+    try:
+        assert ctxs[0].negotiate_edge_path(1, timeout=10) \
+            == dcn.PATH_ZEROCOPY
+    finally:
+        _shutdown(ctxs)
+
+
+def test_negotiates_legacy_when_pool_also_disabled(monkeypatch):
+    monkeypatch.setenv(dcn.ENV_LOCAL_HANDOFF, "0")
+    monkeypatch.setenv(dcn.ENV_RECV_POOL, "0")
+    ctxs = _make_contexts(2)
+    try:
+        assert ctxs[0].negotiate_edge_path(1, timeout=10) \
+            == dcn.PATH_SOCKET_V2
+    finally:
+        _shutdown(ctxs)
+
+
+def test_self_edge_negotiates_local():
+    """The data rank feeding its own colocated stage (the `-r 0,...`
+    layout) is the most common colocated edge: a self-send must skip the
+    loopback socket."""
+    ctxs = _make_contexts(2)
+    try:
+        assert ctxs[0].negotiate_edge_path(0, timeout=10) == dcn.PATH_LOCAL
+        ctxs[0].send_tensors(0, [np.arange(6.0)], channel=dcn.CHANNEL_FEED)
+        out = ctxs[0].recv_tensors(0, timeout=5, channel=dcn.CHANNEL_FEED)
+        np.testing.assert_array_equal(out[0], np.arange(6.0))
+    finally:
+        _shutdown(ctxs)
+
+
+def test_negotiation_records_transport_span():
+    telemetry.configure(rank=0)
+    try:
+        ctxs = _make_contexts(2)
+        try:
+            ctxs[0].negotiate_edge_path(1, timeout=10)
+        finally:
+            _shutdown(ctxs)
+        names = [s["name"] for s in telemetry.recorder().snapshot()
+                 if s["cat"] == "transport"]
+        assert "local:0->1" in names
+    finally:
+        telemetry.disable()
+
+
+# -- bit-identity across tiers -----------------------------------------
+
+@pytest.mark.parametrize("env", [
+    {},                                                     # local
+    {dcn.ENV_LOCAL_HANDOFF: "0"},                           # zerocopy
+    {dcn.ENV_LOCAL_HANDOFF: "0", dcn.ENV_RECV_POOL: "0"},   # legacy v2
+], ids=["local", "zerocopy", "socket_v2"])
+def test_tier_bit_identical_all_wire_dtypes(monkeypatch, env):
+    """Every tier must deliver byte-identical tensors (all wire dtypes,
+    incl. the nibble-packed sub-byte ones) — the colocated and zero-copy
+    fast paths are transports, not transforms."""
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    ctxs = _make_contexts(2)
+    try:
+        tier = ctxs[0].negotiate_edge_path(1, timeout=10)
+        expect = {(): dcn.PATH_LOCAL,
+                  ("DCN_LOCAL_HANDOFF",): dcn.PATH_ZEROCOPY}.get(
+            tuple(sorted(env)), dcn.PATH_SOCKET_V2)
+        assert tier == expect
+        tensors = _all_dtype_tensors()
+        out = _roundtrip(ctxs, tensors)
+        assert len(out) == len(tensors)
+        for sent, got in zip(tensors, out):
+            got = np.asarray(got)
+            assert got.dtype == sent.dtype and got.shape == sent.shape
+            assert got.tobytes() == sent.tobytes()
+    finally:
+        _shutdown(ctxs)
+
+
+# -- zero-copy pool ownership ------------------------------------------
+
+def test_recv_pool_reuses_free_buffers():
+    pool = dcn._RecvBufferPool()
+    buf = pool.acquire(1000)
+    ident = id(buf)
+    del buf            # no consumer holds it: next acquire reuses it
+    assert id(pool.acquire(500)) == ident
+
+
+def test_recv_pool_never_recycles_referenced_buffers():
+    pool = dcn._RecvBufferPool()
+    buf = pool.acquire(1000)
+    arr = np.frombuffer(buf, np.uint8, count=16)   # a consumer view
+    assert id(pool.acquire(1000)) != id(buf)
+    del arr
+    del buf
+    assert any(sys.getrefcount(b) == dcn._RecvBufferPool._FREE_REFCOUNT
+               for b in pool._bufs)
+
+
+def test_recv_pool_evicts_held_entries_before_free_ones():
+    """A full pool drops a RETAINED entry (useless to the pool — its
+    consumer keeps it alive) before sacrificing a free, reusable one."""
+    pool = dcn._RecvBufferPool(max_buffers=2)
+    free_buf = pool.acquire(64)
+    free_id = id(free_buf)
+    del free_buf                                    # stays free in-pool
+    held = np.frombuffer(pool.acquire(64), np.uint8, count=8)
+    pool.acquire(1 << 20)   # full pool, nothing fits: must evict the held
+    assert any(id(b) == free_id for b in pool._bufs)
+    del held
+
+
+def test_retained_recv_array_survives_buffer_churn():
+    """The satellite-fix contract: an array a consumer RETAINS (the
+    ledger holding a result through a replay) must never observe a
+    recycled buffer, no matter how many frames follow on the edge."""
+    ctxs = _make_contexts(2)
+    try:
+        marker = np.full((64, 64), 7.5, np.float32)
+        ctxs[0].send_tensors(1, [marker])
+        retained = ctxs[1].recv_tensors(0, timeout=10)[0]
+        for i in range(40):   # > pool size: plenty of recycle pressure
+            ctxs[0].send_tensors(1, [np.full((64, 64), float(i),
+                                             np.float32)])
+            ctxs[1].recv_tensors(0, timeout=10)
+        assert retained.tobytes() == marker.tobytes()
+    finally:
+        _shutdown(ctxs)
+
+
+# -- colocated hand-off semantics --------------------------------------
+
+def test_local_handoff_fires_monitor_hooks():
+    ctxs = _make_contexts(2)
+    seen = {"send": [], "recv": []}
+    ctxs[0].register_send_hooks(
+        pre=lambda dst, ch: None,
+        post=lambda dst, ch, ts: seen["send"].append(
+            sum(int(t.nbytes) for t in ts)))
+    ctxs[1].register_recv_hooks(
+        pre=lambda src, ch: None,
+        post=lambda src, ch, ts: seen["recv"].append(
+            sum(int(t.nbytes) for t in ts)))
+    try:
+        ctxs[0].negotiate_edge_path(1, timeout=10)
+        payload = np.ones((8, 8), np.float32)
+        ctxs[0].send_tensors(1, [payload])
+        ctxs[1].recv_tensors(0, timeout=10)
+        assert seen["send"] == [payload.nbytes]
+        assert seen["recv"] == [payload.nbytes]
+    finally:
+        _shutdown(ctxs)
+
+
+def test_local_handoff_respects_epoch_fence():
+    """A fenced incarnation's hand-off must be dropped at the queue door,
+    exactly like the socket reader's zombie-frame fence."""
+    ctxs = _make_contexts(2)
+    try:
+        ctxs[0].negotiate_edge_path(1, timeout=10)
+        with ctxs[1]._dead_lock:
+            ctxs[1]._min_epoch[0] = 5    # rank 0 epochs < 5 are zombies
+        before = ctxs[1].stale_frames_dropped
+        ctxs[0].send_tensors(1, [np.ones(4)])
+        assert ctxs[1].stale_frames_dropped == before + 1
+        with pytest.raises(queue.Empty):
+            ctxs[1].recv_tensors(0, timeout=0.3)
+    finally:
+        _shutdown(ctxs)
+
+
+def test_local_handoff_preserves_backpressure():
+    """The bounded recv queue (depth 1) is the tier's backpressure: a
+    second hand-off blocks until the consumer drains the first."""
+    ctxs = _make_contexts(2)
+    try:
+        ctxs[0].negotiate_edge_path(1, timeout=10)
+        ctxs[0].send_tensors(1, [np.ones(2)])     # fills the depth-1 queue
+        done = threading.Event()
+
+        def second():
+            ctxs[0].send_tensors(1, [np.ones(2) * 2])
+            done.set()
+
+        t = threading.Thread(target=second, daemon=True)
+        t.start()
+        assert not done.wait(timeout=0.5)          # blocked: queue full
+        ctxs[1].recv_tensors(0, timeout=5)         # drain one
+        assert done.wait(timeout=5)                # unblocked
+        ctxs[1].recv_tensors(0, timeout=5)
+        t.join(timeout=5)
+    finally:
+        _shutdown(ctxs)
+
+
+def test_local_grant_degrades_to_socket_when_peer_leaves():
+    """A negotiated colocated grant whose peer context shut down (clean
+    exit from this process) must fall back to the socket truth instead
+    of delivering into a dead queue."""
+    addrs = [("127.0.0.1", p) for p in _free_ports(2)]
+    a = dcn.DistDcnContext(2, 0, addrs)
+    b = dcn.DistDcnContext(2, 1, addrs)
+    a.init()
+    b.init()
+    try:
+        assert a.negotiate_edge_path(1, timeout=10) == dcn.PATH_LOCAL
+        b.shutdown()
+        # fresh context, same rank/address, NEW process in spirit: the
+        # stale grant must not reach it through the local registry
+        b2 = dcn.DistDcnContext(2, 1, addrs)
+        b2.init()
+        try:
+            # grant cleared lazily on first send; the send itself rides
+            # the socket (b2 registered itself, so a re-negotiation may
+            # re-grant local — the point is no dead-queue delivery)
+            a.send_tensors(1, [np.arange(3.0)])
+            out = b2.recv_tensors(0, timeout=10)
+            np.testing.assert_array_equal(out[0], np.arange(3.0))
+        finally:
+            b2.shutdown()
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_set_local_device_routes_handoff_buffers():
+    """A consumer that declared its compute device gets colocated device
+    buffers moved there (device-to-device `device_put`); host arrays and
+    already-resident buffers pass through untouched."""
+    import jax
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs >= 2 devices (conftest forces 8 on CPU)")
+    ctxs = _make_contexts(2)
+    try:
+        ctxs[1].set_local_device(devs[1])
+        ctxs[0].negotiate_edge_path(1, timeout=10)
+        committed = jax.device_put(jax.numpy.arange(8.0), devs[0])
+        host = np.full((3,), 2.5, np.float32)
+        ctxs[0].send_tensors(1, [committed, host])
+        out = ctxs[1].recv_tensors(0, timeout=10)
+        assert out[0].sharding.device_set == {devs[1]}
+        np.testing.assert_array_equal(np.asarray(out[0]), np.arange(8.0))
+        assert isinstance(out[1], np.ndarray)          # host passthrough
+        np.testing.assert_array_equal(out[1], host)
+    finally:
+        _shutdown(ctxs)
+
+
+def test_local_handoff_wire_span_per_tier():
+    telemetry.configure(rank=0)
+    try:
+        ctxs = _make_contexts(2)
+        try:
+            ctxs[0].negotiate_edge_path(1, timeout=10)
+            ctxs[0].send_tensors(1, [np.ones(8)])
+            ctxs[1].recv_tensors(0, timeout=10)
+        finally:
+            _shutdown(ctxs)
+        wire = [s["name"] for s in telemetry.recorder().snapshot()
+                if s["cat"] == "wire"]
+        assert "local->r1" in wire
+        assert not any(n.startswith("send->") for n in wire)
+    finally:
+        telemetry.disable()
+
+
+# -- report: transport + segments sections ------------------------------
+
+def _span(cat, name, t0, t1, rank=0, stage=None, mb=None):
+    return {"cat": cat, "name": name, "rank": rank, "stage": stage,
+            "mb": mb, "t0": t0, "t1": t1}
+
+
+def test_report_transport_section():
+    spans = [
+        _span("transport", "local:0->0", 0, 0),
+        _span("transport", "local:1->0", 0, 0),
+        _span("transport", "zerocopy:1->2", 0, 0),
+        _span("wire", "local->r0", 0, 1_000_000),
+        _span("wire", "send->r2", 1_000_000, 4_000_000),
+        _span("stage", "dispatch", 0, 2_000_000, stage=0, mb=0),
+    ]
+    rec = report.analyze_spans(spans, span_cost_ns=100.0)
+    t = rec["transport"]
+    assert t["edges_by_tier"] == {"local": 2, "zerocopy": 1}
+    assert t["local_edges"] == 2
+    assert t["local_share_pct"] == 25.0
+    assert rec["segments"]["wire/local->"]["n"] == 1
+    assert rec["segments"]["stage/dispatch"]["p50_ms"] == 2.0
+
+
+def test_report_transport_counts_edges_not_negotiations():
+    """The runtime renegotiates every round build: repeated instants for
+    one edge are ONE edge in the report, at its latest tier."""
+    spans = [
+        _span("transport", "socket_v2:0->1", 0, 0),     # round 1
+        _span("transport", "local:0->1", 5, 5),         # round 2: upgraded
+        _span("transport", "local:0->1", 9, 9),         # round 3
+    ]
+    rec = report.analyze_spans(spans, span_cost_ns=100.0)
+    assert rec["transport"]["edges_by_tier"] == {"local": 1}
+    assert rec["transport"]["local_edges"] == 1
+
+
+def test_report_without_transport_spans_is_empty_not_missing():
+    spans = [_span("stage", "dispatch", 0, 1_000_000, stage=0, mb=0)]
+    rec = report.analyze_spans(spans, span_cost_ns=100.0)
+    assert rec["transport"]["local_edges"] == 0
+    assert rec["transport"]["edges_by_tier"] == {}
+
+
+def test_segment_medians_folds_unbounded_names():
+    spans = [_span("feed", "mb0", 0, 1_000_000),
+             _span("feed", "mb1", 0, 3_000_000),
+             _span("wire", "send->r1", 0, 2_000_000),
+             _span("wire", "send->r2", 0, 2_000_000)]
+    seg = report.segment_medians(spans)
+    assert seg["feed/mb"]["n"] == 2
+    assert seg["wire/send->"]["n"] == 2
+
+
+# -- ledger snapshots: O(in-flight) failover replay ---------------------
+
+def _make_ledger(n, snapshot_every):
+    import runtime as rt
+    ubatches = [np.full((2, 3), i, np.float32) for i in range(n)]
+    return rt, rt._MicrobatchLedger(ubatches, None,
+                                    snapshot_every=snapshot_every), ubatches
+
+
+def test_ledger_snapshot_compacts_and_bounds_replay():
+    rt, ledger, ubatches = _make_ledger(8, snapshot_every=3)
+    orig = rt.handle_results
+    rt.handle_results = lambda out: None
+    try:
+        for i in range(5):
+            assert ledger.ack(i, np.full((2,), float(i)))
+            ledger.maybe_snapshot()
+        # 5 acks at cadence 3 -> 1 snapshot; payloads of the acked prefix
+        # are compacted away and the replay frontier moved past it
+        assert ledger.snapshots == 1
+        assert ledger._frontier == 3
+        assert all(ledger._ubatches[i] is None for i in range(3))
+        # the replay set is still exactly the unacknowledged tail,
+        # payloads intact and bit-identical to the originals
+        pending = ledger.pending()
+        assert [i for i, _ in pending] == [5, 6, 7]
+        for i, u in pending:
+            assert u.tobytes() == ubatches[i].tobytes()
+    finally:
+        rt.handle_results = orig
+        while not rt.label_queue.empty():
+            rt.label_queue.get()
+
+
+def test_ledger_replay_from_snapshot_exactly_once_bit_identical():
+    """The failover contract with snapshots on: replaying the post-
+    snapshot set (duplicates included — a replay overlaps in-flight
+    results) delivers every result exactly once, in order, bit-identical."""
+    rt, ledger, _ = _make_ledger(6, snapshot_every=2)
+    delivered = []
+    orig = rt.handle_results
+    rt.handle_results = lambda out: delivered.append(np.asarray(out))
+    try:
+        results = {i: np.full((2, 2), i * 1.5, np.float32)
+                   for i in range(6)}
+        for i in (0, 1, 2):                       # pre-death progress
+            assert ledger.ack(i, results[i])
+            ledger.maybe_snapshot()
+        assert ledger.snapshots >= 1
+        replay = [i for i, _ in ledger.pending()]
+        assert replay == [3, 4, 5]
+        # failover replay: a duplicate of an acked mb arrives too (it was
+        # in flight when the stage died) — dropped, not re-delivered
+        assert not ledger.ack(2, results[2] + 99)
+        for i in (4, 3, 5):                       # out-of-order arrivals
+            assert ledger.ack(i, results[i])
+            ledger.maybe_snapshot()
+        assert ledger.done.is_set()
+        assert len(delivered) == 6
+        for i, out in enumerate(delivered):
+            assert out.tobytes() == results[i].tobytes()
+    finally:
+        rt.handle_results = orig
+        while not rt.label_queue.empty():
+            rt.label_queue.get()
+
+
+def test_ledger_snapshot_disabled_keeps_payloads():
+    rt, ledger, _ = _make_ledger(4, snapshot_every=0)
+    orig = rt.handle_results
+    rt.handle_results = lambda out: None
+    try:
+        for i in range(4):
+            ledger.ack(i, np.full((2,), float(i)))
+            assert not ledger.maybe_snapshot()
+        assert ledger.snapshots == 0
+        assert all(u is not None for u in ledger._ubatches)
+    finally:
+        rt.handle_results = orig
+        while not rt.label_queue.empty():
+            rt.label_queue.get()
+
+
+def test_ledger_snapshot_respects_epoch_fence():
+    rt, ledger, _ = _make_ledger(4, snapshot_every=1)
+    orig = rt.handle_results
+    rt.handle_results = lambda out: None
+    try:
+        ledger.fence_rank(2, 3)
+        assert not ledger.ack(0, np.zeros(2), epoch=1, src=2)  # stale
+        assert ledger.stale_dropped == 1
+        assert ledger.ack(0, np.zeros(2), epoch=3, src=2)
+        ledger.maybe_snapshot()
+        assert ledger._frontier == 1
+    finally:
+        rt.handle_results = orig
+        while not rt.label_queue.empty():
+            rt.label_queue.get()
+
+
+# -- host pipeline: edge-skip + latency breakdown -----------------------
+
+def test_host_pipeline_latency_breakdown_stats():
+    import jax.numpy as jnp
+
+    from pipeedge_tpu.parallel.pipeline import HostPipeline, PipelineStage
+
+    import jax
+    dev = jax.devices()[0]
+    stage = PipelineStage(shard_fn=lambda p, x: x * 2.0, params={},
+                          device=dev, name="s0")
+    pipe = HostPipeline([stage])
+    ubatches = [jnp.ones((2, 4)) * i for i in range(5)]
+    results, stats = pipe.run(ubatches)
+    assert len(results) == 5
+    bd = stats["latency_breakdown"]
+    assert set(bd) == {"fill_ms", "steady_p50_ms", "steady_p99_ms"}
+    assert bd["fill_ms"] > 0 and bd["steady_p50_ms"] > 0
+    assert bd["steady_p99_ms"] >= bd["steady_p50_ms"]
+
+
+def test_payload_on_device_detection():
+    import jax
+    import jax.numpy as jnp
+
+    from pipeedge_tpu.parallel import pipeline as pl
+
+    devs = jax.devices()
+    committed = jax.device_put(jnp.ones((2, 2)), devs[0])
+    assert pl._payload_on_device(committed, devs[0])
+    assert not pl._payload_on_device(np.ones((2, 2)), devs[0])
+    if len(devs) > 1:
+        assert not pl._payload_on_device(committed, devs[1])
+    assert pl._payload_on_device((committed, committed), devs[0])

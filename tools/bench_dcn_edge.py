@@ -23,21 +23,36 @@ rebuild) so they are recorded, not asserted:
    depth-1 split variant is reported alongside to separate the split's
    contribution from the buffering's.
 
-CPU-safe (JAX_PLATFORMS=cpu) — nothing here needs a TPU. Prints ONE JSON
-line, BENCH-record style.
+3. **Transport-tier latency A/B** (`--latency`) — a loopback world-4
+   fleet (data rank + one relay stage + two idle spares, the world-4
+   shape a 1-stage schedule runs) streams ViT-shaped microbatches over
+   each transport tier of docs/DCN_WIRE.md's selection matrix — legacy
+   v2 socket, zero-copy socket (pooled recv), colocated hand-off — and
+   reports, ONE JSON line per tier: individually-dispatched p50/p99
+   end-to-end microbatch latency, the streamed steady-state ubatch time,
+   and their ratio (the BENCH_r05 "10× gap" number; ROADMAP item 5's
+   target is ratio ≤ 2 on the colocated path).
 
-Usage: JAX_PLATFORMS=cpu python tools/bench_dcn_edge.py
+CPU-safe (JAX_PLATFORMS=cpu) — nothing here needs a TPU. Prints ONE JSON
+line, BENCH-record style (one line per tier in --latency mode).
+
+Usage: JAX_PLATFORMS=cpu python tools/bench_dcn_edge.py [--latency]
 """
+import argparse
 import json
 import os
 import queue
 import socket
 import sys
+import threading
 import time
 
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pipeedge_tpu.telemetry.report import _percentile  # noqa: E402 - one
+# percentile estimator across the latency benches and the span reports
 
 UBATCH_SHAPE = (8, 197, 1024)   # ViT-Large hidden-state microbatch (b=8)
 N_FRAMES = 8                    # loopback transfer reps per frame kind
@@ -171,7 +186,135 @@ def bench_overlap():
     }
 
 
+# -- transport-tier latency A/B (--latency) ------------------------------
+
+LAT_WORLD = 4                   # data rank + 1 relay stage + 2 idle spares
+LAT_N_UBATCH = 24               # per-tier stream length
+LAT_WORK_MS = 8.0               # modeled stage compute (ViT-L ubatch-ish,
+#                                 BENCH_r05 steady ubatch = 8.15 ms)
+
+# tier name -> env staging applied BEFORE the fleet's contexts exist
+# (both knobs are read at context construction)
+LAT_TIERS = (
+    ("socket_v2", {"DCN_LOCAL_HANDOFF": "0", "DCN_RECV_POOL": "0"}),
+    ("zerocopy", {"DCN_LOCAL_HANDOFF": "0", "DCN_RECV_POOL": "1"}),
+    ("local", {"DCN_LOCAL_HANDOFF": "1", "DCN_RECV_POOL": "1"}),
+)
+
+
+def _free_ports(n):
+    socks = [socket.create_server(("127.0.0.1", 0)) for _ in range(n)]
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+
+def bench_latency_tier(tier: str, env: dict) -> dict:
+    """One tier's loopback world-4 run: data rank 0 streams microbatches
+    to a relay stage on rank 1 (modeled compute LAT_WORK_MS), results come
+    home to rank 0; ranks 2-3 idle. Individually-dispatched latency and
+    streamed steady-state cadence per the BENCH latency method."""
+    from pipeedge_tpu.comm import dcn
+
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        addrs = [("127.0.0.1", p) for p in _free_ports(LAT_WORLD)]
+        ctxs = [dcn.DistDcnContext(LAT_WORLD, r, addrs)
+                for r in range(LAT_WORLD)]
+        for c in ctxs:
+            c.init()
+    finally:
+        for k, v in saved.items():
+            (os.environ.pop(k, None) if v is None
+             else os.environ.__setitem__(k, v))
+    rng = np.random.default_rng(0)
+    payload = [rng.normal(size=UBATCH_SHAPE).astype(np.float32)]
+
+    def work(tensors):
+        time.sleep(LAT_WORK_MS / 1e3)
+        return tensors
+
+    stage = dcn.DcnPipelineStage(ctxs[1], rank_src=0, rank_dst=0,
+                                 work_cb=work,
+                                 send_channel=dcn.CHANNEL_RESULTS)
+    stage.start()
+    try:
+        # negotiate both directions of the relay edge (producer-side, the
+        # runtime's round-build idiom), then verify the tier we got
+        ctxs[0].negotiate_edge_path(1, timeout=10)
+        ctxs[1].negotiate_edge_path(0, timeout=10)
+        got = ctxs[0].edge_path(1)
+        # warm the edge (dials + first-frame costs stay out of the stats)
+        ctxs[0].send_tensors(1, payload)
+        ctxs[0].recv_tensors(1, timeout=30, channel=dcn.CHANNEL_RESULTS)
+
+        # individually dispatched: enqueue -> result home, fenced per mb
+        lats = []
+        for _ in range(LAT_N_UBATCH):
+            tik = time.monotonic()
+            ctxs[0].send_tensors(1, payload)
+            ctxs[0].recv_tensors(1, timeout=30,
+                                 channel=dcn.CHANNEL_RESULTS)
+            lats.append(time.monotonic() - tik)
+
+        # streamed: feeder thread keeps the stage busy; cadence = T/M
+        def feed():
+            for _ in range(LAT_N_UBATCH):
+                ctxs[0].send_tensors(1, payload)
+
+        feeder = threading.Thread(target=feed, daemon=True)
+        tik = time.monotonic()
+        feeder.start()
+        for _ in range(LAT_N_UBATCH):
+            ctxs[0].recv_tensors(1, timeout=30,
+                                 channel=dcn.CHANNEL_RESULTS)
+        steady_s = (time.monotonic() - tik) / LAT_N_UBATCH
+        feeder.join()
+    finally:
+        stage.stop()
+        for c in ctxs:
+            c.shutdown()
+    lats_sorted = sorted(lats)
+    p50 = _percentile(lats_sorted, 50)
+    return {
+        "metric": "dcn_transport_latency",
+        "path": tier,
+        "path_negotiated": got,
+        "world": LAT_WORLD,
+        "ubatch_shape": list(UBATCH_SHAPE),
+        "modeled_work_ms": LAT_WORK_MS,
+        "n_ubatch": LAT_N_UBATCH,
+        "p50_microbatch_latency_ms": round(p50 * 1e3, 2),
+        "p99_microbatch_latency_ms": round(
+            _percentile(lats_sorted, 99) * 1e3, 2),
+        "steady_state_ubatch_ms": round(steady_s * 1e3, 2),
+        # the ROADMAP item 5 headline: end-to-end p50 over steady cadence
+        # (1.0 = transport adds nothing; BENCH_r05 measured ~10)
+        "p50_over_steady": round(p50 / steady_s, 3) if steady_s else None,
+        "throughput_frames_sec": round(1.0 / steady_s, 1) if steady_s
+        else None,
+    }
+
+
+def bench_latency() -> int:
+    """A/B all three tiers; one JSON line per tier (oldest tier first so
+    the gap reads top-to-bottom)."""
+    for tier, env in LAT_TIERS:
+        print(json.dumps(bench_latency_tier(tier, env)), flush=True)
+    return 0
+
+
 def main():
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--latency", action="store_true",
+                   help="run the transport-tier latency A/B (one JSON "
+                        "line per tier) instead of the wire/overlap bench")
+    args = p.parse_args()
+    if args.latency:
+        sys.exit(bench_latency())
     record = {"metric": "dcn_edge_wire_and_overlap",
               "ubatch_shape": list(UBATCH_SHAPE)}
     record.update(bench_wire_bytes())

@@ -7,6 +7,11 @@ chaos_dcn.py idiom — with:
 - `bubble_pct`: mean per-stage idle share of the active window, plus the
   per-stage busy/idle split under `stages`
 - `edges`: per-edge wire-time busy seconds + share of the window
+- `segments`: per-(category, name) duration p50/p95 — the dispatch vs
+  transfer vs emit breakdown of a microbatch's end-to-end path
+- `transport`: edges per negotiated tier (colocated / zerocopy /
+  socket_v2, docs/DCN_WIRE.md) + the colocated hand-off's share of
+  wire-busy time
 - `mb_latency`: per-microbatch end-to-end p50/p95/p99 (ms) across ranks
 - `failover`: detection -> recovery breakdown when a failover happened
 - `span_overhead_pct`: the recorder's own measured hot-path tax (per-span
@@ -71,6 +76,10 @@ def main() -> int:
     p.add_argument("--require-spans", action="store_true",
                    help="exit nonzero when the trace holds no spans or "
                         "no bubble/latency fields (the CI smoke gate)")
+    p.add_argument("--require-local-edges", action="store_true",
+                   help="exit nonzero unless at least one edge negotiated "
+                        "the colocated (on-device hand-off) transport tier "
+                        "(the CI colocated-world gate)")
     p.add_argument("--indent", action="store_true",
                    help="pretty-print instead of the one-line record")
     p.add_argument("--emit-profiles", metavar="OUT.yaml", default=None,
@@ -101,6 +110,12 @@ def main() -> int:
                      sort_keys=True))
     if args.emit_profiles:
         _emit_profiles(args, spans)
+    if args.require_local_edges:
+        transport = record.get("transport", {})
+        if not transport.get("local_edges", 0):
+            print("trace_report: no edge negotiated the colocated "
+                  "transport tier", file=sys.stderr)
+            return 1
     if args.require_spans:
         ok = (record.get("spans", 0) > 0
               and record.get("bubble_pct") is not None
