@@ -33,6 +33,7 @@ the decode subsystem itself is already beyond-reference — docs/DECODE.md).
 """
 from __future__ import annotations
 
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -63,6 +64,12 @@ class _Request:
     # tokens decoded so far, freeing its cache slots/admission slot early
     # (dead streaming clients must not hold capacity, tools/serve.py)
     cancel: Optional[object] = None
+    # absolute monotonic deadline (docs/SERVING.md): checked at every
+    # decode-step boundary; expiry FIRES the cancel flag and completes
+    # the request early — expired work must stop consuming TPU time
+    # mid-flight, not decode uselessly to the cap
+    deadline: Optional[float] = None
+    expired: bool = False            # the deadline check tripped
     rows_done: Optional[np.ndarray] = None   # [B] eos seen per row
     caches: Optional[List] = None    # per-stage cache slots (admission)
     tokens: List = field(default_factory=list)
@@ -79,7 +86,8 @@ def _build_request(pipe: DecodePipeline, rid, ids, new_tokens: int,
                    temperature: float, top_k: int, seed: int,
                    eos_token: Optional[int], pad_token: Optional[int],
                    prefix: Optional[Dict],
-                   on_token=None, cancel=None) -> _Request:
+                   on_token=None, cancel=None,
+                   deadline: Optional[float] = None) -> _Request:
     """Validate one request's arguments against `pipe` and build its
     `_Request` — the shared admission contract of the wave batcher and
     the stage-worker executor (identical errors, identical rng/pick
@@ -106,7 +114,8 @@ def _build_request(pipe: DecodePipeline, rid, ids, new_tokens: int,
         rng=jax.random.PRNGKey(seed), prompt_len=prompt_len,
         prefix=prefix, eos_token=eos_token,
         pad_token=eos_token if pad_token is None else pad_token,
-        on_token=on_token, cancel=cancel)
+        on_token=on_token, cancel=cancel,
+        deadline=None if deadline is None else float(deadline))
 
 
 def _seed_caches(pipe: DecodePipeline, req: _Request) -> str:
@@ -146,11 +155,32 @@ def _run_stage(pipe: DecodePipeline, i: int, req: _Request, data,
     return out
 
 
+def _expired(req: _Request, now: Optional[float] = None) -> bool:
+    """THE deadline check, shared by both executors at their decode-step
+    boundaries (and at admission): past-deadline requests fire the
+    existing `cancel` flag — one cancellation mechanism, two triggers
+    (client disconnect, deadline) — and record `expired` so the serving
+    layer can tell a 504 from an ordinary early completion."""
+    if req.deadline is None:
+        return False
+    if (now if now is not None else time.monotonic()) < req.deadline:
+        return False
+    req.expired = True
+    cancel_set = getattr(req.cancel, "set", None)
+    if cancel_set is not None:
+        cancel_set()
+    return True
+
+
 def _finalize_tokens(req: _Request) -> np.ndarray:
     """[B, S + T] result array: prompt + picked tokens, with everything
     strictly after each row's first eos masked to its pad token (rows
     that hit eos early kept decoding in lockstep; no garbage
     continuation reaches the caller)."""
+    if not req.tokens:
+        # a request expired/cancelled before its first pick completes
+        # with the bare prompt (the serving layer answers it 504)
+        return np.asarray(req.ids)
     toks = np.stack([np.asarray(t) for t in req.tokens], axis=1)  # [B, T]
     if req.eos_token is not None:
         seen = np.cumsum(toks == req.eos_token, axis=1) > 0
@@ -205,7 +235,8 @@ class ContinuousBatcher:
                eos_token: Optional[int] = None,
                pad_token: Optional[int] = None,
                prefix: Optional[Dict] = None,
-               on_token=None, cancel=None) -> None:
+               on_token=None, cancel=None,
+               deadline: Optional[float] = None) -> None:
         """Queue a request. `ids` [B, S] is a prompt batch decoded in
         lockstep (B=1 for a single sequence); each distinct (B, S) shape
         compiles its own prefill program, shared across requests.
@@ -236,18 +267,32 @@ class ContinuousBatcher:
         cooperative cancellation: once set, the request completes at its
         next pick with the tokens decoded so far — freeing its cache
         slots for pending requests instead of decoding to the cap for a
-        caller that stopped listening."""
+        caller that stopped listening.
+
+        `deadline` (absolute `time.monotonic()` seconds) bounds the
+        request's USEFUL lifetime: the executor checks it at every
+        decode-step boundary, and expiry fires the `cancel` flag and
+        completes the request with the tokens decoded so far
+        (`docs/SERVING.md` — expired work must not keep consuming the
+        pipeline)."""
         if rid in self.results or rid in self._live_rids:
             raise ValueError(f"duplicate request id {rid!r}")
         req = _build_request(self.pipe, rid, ids, new_tokens, temperature,
                              top_k, seed, eos_token, pad_token, prefix,
-                             on_token=on_token, cancel=cancel)
+                             on_token=on_token, cancel=cancel,
+                             deadline=deadline)
         self._live_rids.add(rid)
         self.pending.append(req)
 
     def _admit(self) -> None:
         while self.pending and self.active < self.max_active:
             req = self.pending.popleft()
+            if _expired(req):
+                # dead before its first wave: never seed caches or touch
+                # the pipeline — the whole point of deadline propagation
+                self.results[req.rid] = _finalize_tokens(req)
+                self._live_rids.discard(req.rid)
+                continue
             kind = _seed_caches(self.pipe, req)
             self.active += 1
             self._stage_q[0].append((req, req.ids, kind))
@@ -270,13 +315,15 @@ class ContinuousBatcher:
         self.stats["tokens"] += int(token.shape[0])
         if req.on_token is not None:
             req.on_token(len(req.tokens) - 1, token)
-        if req.cancel is not None and req.cancel.is_set():
-            self._complete(req)     # caller gone: free the slots early
+        done = len(req.tokens) >= req.new_tokens
+        if not done and (_expired(req) or (req.cancel is not None
+                                           and req.cancel.is_set())):
+            self._complete(req)     # expired/caller gone: free the slots
             return
         if req.eos_token is not None:
             eos_pending.append(req)
             return
-        if len(req.tokens) >= req.new_tokens:
+        if done:
             self._complete(req)
         else:
             reentries.append((req, token[:, None], "step"))
@@ -418,15 +465,18 @@ class StageWorkerExecutor:
                eos_token: Optional[int] = None,
                pad_token: Optional[int] = None,
                prefix: Optional[Dict] = None,
-               on_token=None, cancel=None) -> None:
+               on_token=None, cancel=None,
+               deadline: Optional[float] = None) -> None:
         """Admit one request (same argument contract as
         `ContinuousBatcher.submit`, including prefix-handle validation,
-        the `on_token` streaming hook and the `cancel` flag). BLOCKS
-        while `max_active` requests are in flight — admission
-        backpressure is the caller's thread, not an internal queue."""
+        the `on_token` streaming hook, the `cancel` flag and the
+        `deadline`). BLOCKS while `max_active` requests are in flight —
+        admission backpressure is the caller's thread, not an internal
+        queue."""
         req = _build_request(self.pipe, rid, ids, new_tokens, temperature,
                              top_k, seed, eos_token, pad_token, prefix,
-                             on_token=on_token, cancel=cancel)
+                             on_token=on_token, cancel=cancel,
+                             deadline=deadline)
         with self._lock:
             self._check_dead()
             if rid in self.results or rid in self._live:
@@ -438,6 +488,16 @@ class StageWorkerExecutor:
                 if self._dead is not None:   # woken by _die's over-release
                     self._check_dead()
                 self.active += 1
+            if _expired(req):
+                # the admission wait outlived the deadline: complete with
+                # the bare prompt without ever touching the pipeline
+                with self._lock:
+                    self.results[rid] = _finalize_tokens(req)
+                    self._live.discard(rid)
+                    self.active -= 1
+                    self._lock.notify_all()
+                self._slots.release()
+                return
             try:
                 kind = _seed_caches(self.pipe, req)
                 self._q[0].put((req, req.ids, kind))
@@ -541,6 +601,8 @@ class StageWorkerExecutor:
         if req.on_token is not None:
             req.on_token(len(req.tokens) - 1, token)
         done = len(req.tokens) >= req.new_tokens
+        if not done and _expired(req):
+            done = True             # deadline passed: cancel mid-flight
         if not done and req.cancel is not None and req.cancel.is_set():
             done = True             # caller gone: free the slot early
         if not done and req.eos_token is not None:

@@ -1,0 +1,32 @@
+"""Overload protection for the serving plane (docs/SERVING.md).
+
+Overload is a fault class, not a steady state to be endured: without
+admission control a surge degrades latency for *every* request instead
+of shedding the excess (ROADMAP item 3's "per-class SLOs with admission
+control and 503/Retry-After backpressure"). This package gives
+tools/serve.py the three mechanisms that bound the damage:
+
+- `admission`: per-class token-bucket rate limits, a bounded
+  earliest-deadline-first admission queue, load shedding with a
+  Retry-After computed from the observed service rate, and deadline
+  bookkeeping (`AdmissionController`).
+- `brownout`: a watermark-driven degradation ladder that steps through
+  disable-speculative -> clamp new_tokens -> shed best-effort -> shed
+  batch, and steps back down with hysteresis (`BrownoutLadder`).
+- deadline propagation itself lives in the executors
+  (`parallel/batcher.py`): each request's absolute deadline rides into
+  the decode loop, and expiry fires the existing `cancel` flag at the
+  next decode-step boundary so dead work stops consuming TPU time.
+"""
+from .admission import (AdmissionController, AdmissionShed, ClassPolicy,
+                        DeadlineExceeded, EDFQueue, REQUEST_CLASSES,
+                        ServiceRateEstimator, TokenBucket, default_policies,
+                        parse_class_map)
+from .brownout import BrownoutLadder, LEVEL_NAMES, Watermarks
+
+__all__ = [
+    "AdmissionController", "AdmissionShed", "BrownoutLadder",
+    "ClassPolicy", "DeadlineExceeded", "EDFQueue", "LEVEL_NAMES",
+    "REQUEST_CLASSES", "ServiceRateEstimator", "TokenBucket",
+    "Watermarks", "default_policies", "parse_class_map",
+]

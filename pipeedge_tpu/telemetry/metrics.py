@@ -85,6 +85,17 @@ class Counter(_Instrument):
         with self._lock:
             return self._values.get(self._key(labels), 0.0)
 
+    def total(self) -> float:
+        """Sum over every label set — the headline number for a labeled
+        counter (e.g. sheds across all (class, reason) pairs)."""
+        with self._lock:
+            return sum(self._values.values())
+
+    def values(self) -> Dict[Tuple[Tuple[str, str], ...], float]:
+        """Snapshot of every (label-set, value) pair."""
+        with self._lock:
+            return dict(self._values)
+
     def render(self) -> List[str]:
         with self._lock:
             items = sorted(self._values.items())
@@ -144,6 +155,23 @@ class Histogram(_Instrument):
         with self._lock:
             s = self._series.get(self._key(labels))
             return s[2] if s else 0
+
+    def snapshot(self, **labels) -> Tuple[List[int], int]:
+        """Copy of (per-bucket counts, total observation count) for one
+        label set — the raw material for WINDOWED percentiles: diff two
+        snapshots and feed the delta to `percentile_from_counts` (the
+        brownout governor's p95-over-the-last-interval read)."""
+        with self._lock:
+            s = self._series.get(self._key(labels))
+            if s is None:
+                return [0] * len(self.buckets), 0
+            return list(s[0]), s[2]
+
+    def percentile(self, q: float, **labels) -> Optional[float]:
+        """All-time nearest-bucket-upper-bound percentile (None when the
+        series has no observations)."""
+        counts, n = self.snapshot(**labels)
+        return percentile_from_counts(self.buckets, counts, n, q)
 
     def render(self) -> List[str]:
         with self._lock:
@@ -226,6 +254,26 @@ class Registry:
 
 
 REGISTRY = Registry()
+
+
+def percentile_from_counts(buckets: Sequence[float], counts: Sequence[int],
+                           n: int, q: float) -> Optional[float]:
+    """Nearest-bucket-upper-bound percentile from a (possibly differenced)
+    histogram window: the smallest bucket bound whose cumulative count
+    covers rank q. `n` may exceed sum(counts) — observations above the
+    last finite bucket live only in the total — in which case a rank
+    falling into that overflow returns +inf (honestly 'worse than every
+    bound', which is exactly what an overload watermark wants to see).
+    Returns None for an empty window."""
+    if n <= 0:
+        return None
+    rank = q / 100.0 * n
+    cum = 0
+    for bound, c in zip(buckets, counts):
+        cum += c
+        if cum >= rank:
+            return float(bound)
+    return float("inf")
 
 
 def render_monitoring_snapshot(snapshot: dict,

@@ -288,6 +288,45 @@ def analyze_spans(spans: Sequence[dict],
             rejoin["heals_s"] = [round(v, 6) for v in heals]
             rejoin["time_to_full_capacity_s"] = round(max(heals), 6)
 
+    # -- serving plane: admission waits / sheds / brownout -------------
+    # tools/serve.py records cat "serve" spans: "admit:{class}" (duration
+    # = EDF-queue wait of an ADMITTED request — shed waits record under
+    # "shed:{class}:{reason}" so they can't skew this stat), instant
+    # "brownout:{level}" per ladder transition, and "generate"/
+    # "speculative" around each admitted request (docs/SERVING.md)
+    serving = {}
+    sv = [s for s in spans if s.get("cat") == "serve"]
+    if sv:
+        admit_waits: Dict[str, List[float]] = {}
+        sheds_by_class: Dict[str, int] = {}
+        sheds_by_reason: Dict[str, int] = {}
+        levels: List[int] = []
+        for s in sv:
+            name = str(s["name"])
+            if name.startswith("admit:"):
+                admit_waits.setdefault(name[len("admit:"):], []).append(
+                    (int(s["t1"]) - int(s["t0"])) / 1e6)
+            elif name.startswith("shed:"):
+                _, cls, reason = name.split(":", 2)
+                sheds_by_class[cls] = sheds_by_class.get(cls, 0) + 1
+                sheds_by_reason[reason] = sheds_by_reason.get(reason, 0) + 1
+            elif name.startswith("brownout:"):
+                levels.append(int(name[len("brownout:"):]))
+        serving = {
+            "requests": sum(1 for s in sv
+                            if s["name"] in ("generate", "speculative")),
+            "admit_wait_ms": {
+                cls: {"n": len(vals),
+                      "p50": round(_percentile(sorted(vals), 50), 3),
+                      "p95": round(_percentile(sorted(vals), 95), 3)}
+                for cls, vals in sorted(admit_waits.items())},
+            "sheds": sum(sheds_by_class.values()),
+            "sheds_by_class": dict(sorted(sheds_by_class.items())),
+            "sheds_by_reason": dict(sorted(sheds_by_reason.items())),
+            "brownout": {"transitions": len(levels),
+                         "max_level": max(levels) if levels else 0},
+        }
+
     if span_cost_ns is None:
         span_cost_ns = measure_span_cost_ns()
     overhead_pct = 100.0 * len(spans) * span_cost_ns / window_ns
@@ -303,6 +342,7 @@ def analyze_spans(spans: Sequence[dict],
         "segments": segment_medians(spans),
         "transport": transport,
         "mb_latency": mb_latency,
+        "serving": serving,
         "failover": failover,
         "rejoin": rejoin,
         "rebalance_events": rebalance_events,

@@ -1,0 +1,323 @@
+"""Open-loop load generator for the serving plane (docs/SERVING.md).
+
+Drives tools/serve.py's /generate with a per-class request mix at a
+target aggregate arrival rate and reports ONE JSON line (the
+chaos_dcn.py idiom) of per-class SLO attainment, goodput, and shed
+accounting — the "proof under fire" for the admission/brownout plane:
+at 5x sustained overload, interactive goodput should hold while the
+excess converts to 503s with a dynamic Retry-After, not to collapse.
+
+OPEN loop: arrivals are scheduled on the clock (every 1/qps seconds),
+not gated on completions — the honest overload model. A server that
+slows down does not slow the offered load down with it; requests the
+client cannot even launch (in-flight cap, a safety valve) are counted
+separately as `client_dropped` so a wedged server cannot silently look
+like a polite one.
+
+Each request carries its class and a deadline budget (`deadline_ms`,
+defaulting to the class SLO): the server sheds it at admission, expires
+it in queue, or cancels it mid-flight (HTTP 504) when the budget runs
+out — every outcome lands in a distinct counter below.
+
+Outcome taxonomy (per class and aggregate):
+- `ok`        HTTP 200 within the class SLO (client-side wall time)
+- `ok_late`   HTTP 200, but over the SLO (admitted yet too slow — the
+              failure mode admission control exists to prevent)
+- `shed`      HTTP 503 with `"shed": true` + Retry-After (admission)
+- `degraded`  HTTP 503 with `"degraded": true` (failover window)
+- `deadline`  HTTP 504 (expired MID-FLIGHT; cancelled at a decode step)
+- `error`     anything else — handler exceptions, connection failures,
+              malformed bodies; the CI smoke gates on error == 0
+
+`slo_attainment` = ok / (ok + ok_late): of the requests the server chose
+to serve, how many met their SLO. `goodput_rps` = ok / duration: the
+rate of USEFUL work — the acceptance metric ("within 20% of the
+uncontended value at 5x overload"). Shed requests hurt neither; that is
+the point of shedding.
+
+Capacity calibration: `--overload-factor F` first measures the server's
+closed-loop sequential service rate for `--calibrate-s` seconds, then
+offers F times it — "5x overload" stays 5x on any machine. `--qps`
+skips calibration.
+
+Examples:
+  # calibrated 5x overload, default 70/20/10 mix, 2s SLOs
+  python tools/loadgen.py --port 8321 --overload-factor 5 --duration 8
+
+  # explicit rate + per-class mix/SLO
+  python tools/loadgen.py --port 8321 --qps 40 --duration 10 \
+      --mix interactive=0.5 --mix batch=0.3 --mix best_effort=0.2 \
+      --slo interactive=1000 --slo batch=5000
+"""
+import argparse
+import json
+import os
+import random
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pipeedge_tpu.serving import (REQUEST_CLASSES,  # noqa: E402
+                                  parse_class_map)
+
+DEFAULT_MIX = {"interactive": 0.7, "batch": 0.2, "best_effort": 0.1}
+DEFAULT_SLO_MS = {"interactive": 2000.0, "batch": 10000.0,
+                  "best_effort": 30000.0}
+OUTCOMES = ("ok", "ok_late", "shed", "degraded", "deadline", "error")
+
+
+def _post(url, obj, timeout):
+    """POST JSON; returns (status, body-dict, retry_after | None)."""
+    req = urllib.request.Request(
+        url, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read()), None
+    except urllib.error.HTTPError as exc:
+        ra = exc.headers.get("Retry-After")
+        try:
+            body = json.loads(exc.read())
+        except Exception:       # noqa: BLE001 — non-JSON error body
+            body = {}
+        return exc.code, body, None if ra is None else float(ra)
+
+
+def calibrate(url, seconds, new_tokens, prompt_len, timeout, seed=0):
+    """Closed-loop sequential service rate (requests/s): the capacity
+    baseline `--overload-factor` multiplies. The first request is
+    discarded as compile warmup."""
+    rng = random.Random(seed)
+    ids = [[rng.randrange(100) for _ in range(prompt_len)]]
+    body = {"ids": ids, "new_tokens": new_tokens, "class": "interactive"}
+    status, _, _ = _post(url, body, timeout)          # warmup (compile)
+    if status != 200:
+        raise RuntimeError(f"calibration warmup failed: HTTP {status}")
+    n = 0
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < seconds:
+        status, _, _ = _post(url, body, timeout)
+        if status != 200:
+            raise RuntimeError(f"calibration request failed: HTTP {status}")
+        n += 1
+    dt = time.monotonic() - t0
+    if n == 0:
+        raise RuntimeError(
+            f"calibration made no complete request in {seconds}s")
+    return n / dt
+
+
+class _Stats:
+    """Per-class outcome/latency accumulator (one lock, short holds)."""
+
+    def __init__(self, classes):
+        self._lock = threading.Lock()
+        self.counts = {c: dict.fromkeys(OUTCOMES, 0) for c in classes}
+        self.latencies = {c: [] for c in classes}     # ok + ok_late, ms
+        self.retry_after = []
+        self.client_dropped = 0
+        self.first_error = None
+
+    def record(self, cls, outcome, latency_ms=None, retry_after=None,
+               error=None):
+        with self._lock:
+            self.counts[cls][outcome] += 1
+            if latency_ms is not None:
+                self.latencies[cls].append(latency_ms)
+            if retry_after is not None:
+                self.retry_after.append(retry_after)
+            if error is not None and self.first_error is None:
+                self.first_error = f"{cls}: {error}"
+
+    def drop(self):
+        with self._lock:
+            self.client_dropped += 1
+
+
+def _percentile(vals, q):
+    if not vals:
+        return None
+    vals = sorted(vals)
+    idx = min(len(vals) - 1, max(0, int(round(q / 100.0 * (len(vals) - 1)))))
+    return round(vals[idx], 3)
+
+
+def _one_request(url, cls, slo_ms, deadline_ms, new_tokens, prompt_len,
+                 timeout, stats, rng_seed):
+    rng = random.Random(rng_seed)
+    ids = [[rng.randrange(100) for _ in range(prompt_len)]]
+    body = {"ids": ids, "new_tokens": new_tokens, "class": cls}
+    if deadline_ms is not None:
+        body["deadline_ms"] = deadline_ms
+    t0 = time.monotonic()
+    try:
+        status, resp, retry_after = _post(url, body, timeout)
+    except Exception as exc:    # noqa: BLE001 — connection-level failure
+        stats.record(cls, "error", error=repr(exc))
+        return
+    ms = (time.monotonic() - t0) * 1e3
+    if status == 200:
+        outcome = "ok" if (slo_ms is None or ms <= slo_ms) else "ok_late"
+        stats.record(cls, outcome, latency_ms=ms)
+    elif status == 503 and resp.get("shed"):
+        stats.record(cls, "shed", retry_after=retry_after)
+    elif status == 503 and resp.get("degraded"):
+        stats.record(cls, "degraded", retry_after=retry_after)
+    elif status == 504 and resp.get("deadline_exceeded"):
+        stats.record(cls, "deadline")
+    else:
+        stats.record(cls, "error",
+                     error=f"HTTP {status}: {resp.get('error', resp)!r}")
+
+
+def run_load(url, duration_s, qps, mix=None, slo_ms=None,
+             deadline_from_slo=True, new_tokens=8, prompt_len=6,
+             timeout=120.0, max_inflight=128, seed=0):
+    """Offer `qps` requests/s for `duration_s` with the per-class `mix`;
+    return the report dict (see module doc for the outcome taxonomy).
+    Importable — the overload acceptance test and the CI smoke both call
+    this in-process instead of shelling out."""
+    mix = dict(DEFAULT_MIX if mix is None else mix)
+    unknown = set(mix) - set(REQUEST_CLASSES)
+    if unknown:
+        raise ValueError(f"unknown classes in mix: {sorted(unknown)}")
+    total_w = sum(mix.values())
+    if total_w <= 0 or qps <= 0 or duration_s <= 0:
+        raise ValueError("mix weights, qps and duration must be > 0")
+    slo_ms = dict(DEFAULT_SLO_MS if slo_ms is None else slo_ms)
+    classes = sorted(mix)
+    weights = [mix[c] / total_w for c in classes]
+    stats = _Stats(classes)
+    rng = random.Random(seed)
+    inflight = threading.Semaphore(max_inflight)
+    threads = []
+    n = max(1, int(round(qps * duration_s)))
+    t0 = time.monotonic()
+    for i in range(n):
+        target = t0 + i / qps            # open loop: arrivals on the clock
+        delay = target - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        cls = rng.choices(classes, weights=weights)[0]
+        if not inflight.acquire(blocking=False):
+            stats.drop()                 # safety valve, not backpressure
+            continue
+        cls_slo = slo_ms.get(cls)
+        deadline = cls_slo if deadline_from_slo else None
+
+        def work(cls=cls, cls_slo=cls_slo, deadline=deadline, i=i):
+            try:
+                _one_request(url, cls, cls_slo, deadline, new_tokens,
+                             prompt_len, timeout, stats, seed * 100003 + i)
+            finally:
+                inflight.release()
+
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=timeout)
+    wall = time.monotonic() - t0
+    report = {"url": url, "duration_s": round(wall, 3),
+              "offered_qps": round(qps, 3), "requests": n,
+              "client_dropped": stats.client_dropped,
+              "classes": {}, "totals": dict.fromkeys(OUTCOMES, 0)}
+    for c in classes:
+        counts = stats.counts[c]
+        served = counts["ok"] + counts["ok_late"]
+        sent = sum(counts.values())
+        lat = stats.latencies[c]
+        report["classes"][c] = {
+            **counts, "sent": sent,
+            "slo_ms": slo_ms.get(c),
+            "slo_attainment": (None if not served
+                               else round(counts["ok"] / served, 4)),
+            "goodput_rps": round(counts["ok"] / wall, 3),
+            "latency_ms": {"p50": _percentile(lat, 50),
+                           "p95": _percentile(lat, 95)},
+        }
+        for k in OUTCOMES:
+            report["totals"][k] += counts[k]
+    ra = stats.retry_after
+    report["retry_after"] = {
+        "n": len(ra), "min": min(ra) if ra else None,
+        "max": max(ra) if ra else None,
+        "distinct": len({round(v, 3) for v in ra})}
+    report["first_error"] = stats.first_error
+    return report
+
+
+def _parse_class_map(pairs, what, default):
+    try:
+        return {**default, **parse_class_map(pairs, what)}
+    except ValueError as exc:
+        raise SystemExit(str(exc)) from None
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8321)
+    p.add_argument("--duration", type=float, default=8.0,
+                   help="seconds of offered load")
+    rate = p.add_mutually_exclusive_group(required=True)
+    rate.add_argument("--qps", type=float, default=None,
+                      help="explicit aggregate arrival rate")
+    rate.add_argument("--overload-factor", type=float, default=None,
+                      help="offer FACTOR x the measured sequential "
+                           "service rate (see --calibrate-s)")
+    p.add_argument("--calibrate-s", type=float, default=3.0,
+                   help="closed-loop capacity measurement window used by "
+                        "--overload-factor")
+    p.add_argument("--mix", action="append", metavar="CLASS=WEIGHT",
+                   help=f"per-class arrival weight (default {DEFAULT_MIX})")
+    p.add_argument("--slo", action="append", metavar="CLASS=MS",
+                   help="per-class SLO (and deadline_ms budget; default "
+                        f"{DEFAULT_SLO_MS})")
+    p.add_argument("--no-deadline", action="store_true",
+                   help="do not send deadline_ms (SLO still scored "
+                        "client-side; the server never sheds on expiry)")
+    p.add_argument("--new-tokens", type=int, default=8)
+    p.add_argument("--prompt-len", type=int, default=6)
+    p.add_argument("--timeout", type=float, default=120.0)
+    p.add_argument("--max-inflight", type=int, default=128,
+                   help="client-side thread cap (arrivals beyond it are "
+                        "counted as client_dropped, not silently delayed)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--indent", action="store_true",
+                   help="pretty-print instead of the one-line record")
+    args = p.parse_args()
+
+    url = f"http://{args.host}:{args.port}/generate"
+    qps = args.qps
+    calibrated = None
+    if qps is None:
+        calibrated = calibrate(url, args.calibrate_s, args.new_tokens,
+                               args.prompt_len, args.timeout,
+                               seed=args.seed)
+        qps = calibrated * args.overload_factor
+        print(f"calibrated capacity {calibrated:.2f} req/s -> offering "
+              f"{qps:.2f} req/s ({args.overload_factor:g}x)",
+              file=sys.stderr)
+    report = run_load(
+        url, args.duration, qps,
+        mix=_parse_class_map(args.mix, "--mix", DEFAULT_MIX),
+        slo_ms=_parse_class_map(args.slo, "--slo", DEFAULT_SLO_MS),
+        deadline_from_slo=not args.no_deadline,
+        new_tokens=args.new_tokens, prompt_len=args.prompt_len,
+        timeout=args.timeout, max_inflight=args.max_inflight,
+        seed=args.seed)
+    if calibrated is not None:
+        report["calibrated_capacity_rps"] = round(calibrated, 3)
+        report["overload_factor"] = args.overload_factor
+    print(json.dumps(report, indent=2 if args.indent else None,
+                     sort_keys=True))
+    return 0 if report["totals"]["error"] == 0 else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

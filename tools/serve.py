@@ -8,10 +8,25 @@ admit as they arrive, share the pipeline, and prompt prefixes
 registered once via /prefix are reused by any number of /generate
 requests (prompt caching).
 
+Overload is handled as a fault, not a steady state (docs/SERVING.md):
+every /generate rides the SLO-aware admission plane
+(`pipeedge_tpu/serving/`) — per-class token buckets, a bounded
+earliest-deadline-first queue, and watermark-driven brownout — so a
+surge shed excess load with 503 + a Retry-After computed from the
+observed service rate instead of degrading every request. Requests may
+carry `"class"` ("interactive" | "batch" | "best_effort", default
+interactive) and `"deadline_ms"` (budget from receipt); the deadline
+propagates into the executors, which cancel expired work at the next
+decode-step boundary (HTTP 504, `pipeedge_deadline_exceeded_total`).
+
 Endpoints (all JSON unless noted):
 - GET  /healthz            -> {"ok", "model", "stages", "speculative",
                                "executor", "degraded": false | {"dead_rank",
                                "since_s", "retry_after"},
+                               "serving": {deadline_exceeded_total,
+                               "admission": {queue_depth, in_flight,
+                               shed_classes, service_rate_rps, ...},
+                               "brownout": {level, name, floor, ...}},
                                "stats": {tokens, active,
                                pending, prefixes,
                                degraded_entered_total,
@@ -100,6 +115,11 @@ from typing import Optional
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from pipeedge_tpu import telemetry  # noqa: E402
+from pipeedge_tpu.serving import (AdmissionController,  # noqa: E402
+                                  AdmissionShed, BrownoutLadder,
+                                  DeadlineExceeded, REQUEST_CLASSES,
+                                  Watermarks, default_policies,
+                                  parse_class_map)
 from pipeedge_tpu.telemetry import metrics as prom  # noqa: E402
 
 
@@ -121,8 +141,12 @@ class _Service:
     and wait for (or stream) their results."""
 
     def __init__(self, pipe, max_active=None, max_prefixes=8, spec=None,
-                 executor="wave", edge_itemsize=2):
-        from collections import OrderedDict
+                 executor="wave", edge_itemsize=2,
+                 admission_enabled=True, queue_capacity=64,
+                 class_rates=None, class_deadlines_s=None,
+                 brownout_enabled=True, brownout_marks=None,
+                 clamp_new_tokens=16, governor_interval=0.25):
+        from collections import OrderedDict, deque
 
         from pipeedge_tpu.parallel.batcher import (ContinuousBatcher,
                                                    StageWorkerExecutor)
@@ -186,6 +210,14 @@ class _Service:
         # work is refused with 503 + Retry-After and healthz reports the
         # dead rank; unlike `_dead` it is expected to clear
         self.degraded_info: Optional[dict] = None
+        # replay gate: set on every window close so in-flight requests
+        # waiting out a failover wake IMMEDIATELY on recovery instead of
+        # polling (the _await_recovery contract)
+        self._recovered = threading.Event()
+        # observed heal durations (window open -> healed close): the
+        # basis of the DERIVED Retry-After when the orchestrator's
+        # /degraded post doesn't carry one
+        self._heal_s = deque(maxlen=8)
         if executor == "stage":
             self.exec = StageWorkerExecutor(pipe, max_active=max_active)
             self.batcher = None
@@ -198,6 +230,33 @@ class _Service:
         else:
             raise ValueError(f"unknown executor {executor!r} "
                              "(expected 'wave' or 'stage')")
+        # -- overload-protection plane (docs/SERVING.md) ----------------
+        # admission concurrency mirrors the executor's own bound, so the
+        # EDF queue is the ONLY place requests wait and the executor
+        # admits a granted request immediately
+        concurrency = (self.exec.max_active if self.exec is not None
+                       else self.batcher.max_active)
+        self.m_deadline = prom.REGISTRY.counter(
+            "pipeedge_deadline_exceeded_total",
+            "requests whose deadline expired mid-flight (cancelled at a "
+            "decode-step boundary and answered 504)")
+        self.admission: Optional[AdmissionController] = None
+        if admission_enabled:
+            self.admission = AdmissionController(
+                concurrency=concurrency, queue_capacity=queue_capacity,
+                policies=default_policies(class_rates, class_deadlines_s))
+        self.brownout: Optional[BrownoutLadder] = None
+        self._governor = None
+        self._gov_stop = threading.Event()
+        self.governor_interval = float(governor_interval)
+        if brownout_enabled:
+            self.brownout = BrownoutLadder(
+                brownout_marks if brownout_marks is not None
+                else Watermarks(), clamp_new_tokens=clamp_new_tokens)
+            self._governor = threading.Thread(target=self._governor_loop,
+                                              daemon=True,
+                                              name="brownout-governor")
+            self._governor.start()
 
     def _loop(self):
         while True:
@@ -250,12 +309,56 @@ class _Service:
         if dead is not None:
             raise RuntimeError(f"serving worker died: {dead!r}")
 
+    # -- brownout governor ----------------------------------------------
+
+    def _governor_loop(self):
+        """Periodic brownout tick: windowed p95 of the request-latency
+        histogram (delta between scrapes of the SAME instrument /metrics
+        renders) + admission queue depth drive the ladder; the degraded
+        lifecycle floors it (healing implies at least level 1). The
+        ladder's shed classes feed straight into admission."""
+        prev_counts, prev_n = self.m_latency.snapshot()
+        last_level = self.brownout.level
+        while not self._gov_stop.wait(self.governor_interval):
+            counts, n = self.m_latency.snapshot()
+            delta = [c - p for c, p in zip(counts, prev_counts)]
+            p95 = prom.percentile_from_counts(
+                self.m_latency.buckets, delta, n - prev_n, 95.0)
+            prev_counts, prev_n = counts, n
+            depth = (self.admission.queue_depth
+                     if self.admission is not None else 0)
+            self.brownout.set_floor(
+                1 if self.degraded_info is not None else 0)
+            level = self.brownout.update(depth, p95)
+            if self.admission is not None:
+                self.admission.set_shed_classes(
+                    self.brownout.shed_classes())
+            if level != last_level:
+                t = time.monotonic_ns()
+                telemetry.record("serve", f"brownout:{level}", t, t)
+                last_level = level
+
     # -- failover window ------------------------------------------------
 
-    def enter_degraded(self, dead_rank=None, retry_after: float = 5.0):
+    def _derived_retry_after(self) -> float:
+        """Retry-After for a window the orchestrator opened WITHOUT a
+        hint: the median observed heal time (how long capacity actually
+        took to come back in this process's history), 5 s until a heal
+        has been seen."""
+        if self._heal_s:
+            med = sorted(self._heal_s)[len(self._heal_s) // 2]
+            return min(60.0, max(0.5, med))
+        return 5.0
+
+    def enter_degraded(self, dead_rank=None,
+                       retry_after: Optional[float] = None):
         """Open a failover window: admission refuses new work with
         503 + Retry-After until `exit_degraded` (the orchestrator's signal
-        that the backing pipeline recovered)."""
+        that the backing pipeline recovered). `retry_after=None` derives
+        the hint from observed heal telemetry (`_derived_retry_after`)."""
+        if retry_after is None:
+            retry_after = self._derived_retry_after()
+        self._recovered.clear()
         with self.cond:
             self.degraded_info = {"dead_rank": dead_rank,
                                   "since": time.monotonic(),
@@ -282,11 +385,17 @@ class _Service:
         """Close the window. `healed=True` records the close as a
         capacity restoration (the orchestrator's {"degraded": false,
         "healed": true} form) on pipeedge_serve_rejoined_ranks_total —
-        distinct from a plain manual clear."""
+        distinct from a plain manual clear — and feeds the window's
+        duration into the heal-telemetry history future windows derive
+        their Retry-After from."""
         with self.cond:
             was_open = self.degraded_info is not None
+            if healed and was_open:
+                self._heal_s.append(
+                    time.monotonic() - self.degraded_info["since"])
             self.degraded_info = None
             self.cond.notify_all()
+        self._recovered.set()     # wake replay waiters immediately
         if healed and was_open:
             # unlabeled on purpose: healthz stats() reads the same series
             # back (value() is per-label-set); the healed rank stays
@@ -301,28 +410,113 @@ class _Service:
     def _await_recovery(self) -> bool:
         """Block until the degraded window closes (True) or its retry
         budget runs out / the worker is truly dead (False). The replay
-        gate for a request that was in flight when the failover began."""
+        gate for a request that was in flight when the failover began.
+
+        Waits on the `_recovered` event `exit_degraded` signals, so a
+        heal admits the replay IMMEDIATELY — the 2x retry_after budget is
+        only the give-up bound, not a polling interval. The short wait
+        slices exist solely to notice a TRUE executor death mid-window
+        (nothing signals an event for that) without holding the handler
+        thread for the whole budget."""
         with self.cond:
             deg = self.degraded_info
             if deg is None:
                 return False   # the failure was not a failover window
-            deadline = time.monotonic() + 2 * deg["retry_after"]
-            while self.degraded_info is not None:
-                left = deadline - time.monotonic()
-                if left <= 0 or self.dead is not None:
-                    return False
-                self.cond.wait(timeout=min(0.5, left))
-            return True
+        deadline = time.monotonic() + 2 * deg["retry_after"]
+        while True:
+            left = deadline - time.monotonic()
+            if left <= 0 or self.dead is not None:
+                return False
+            if self._recovered.wait(timeout=min(0.5, left)):
+                return (self.dead is None
+                        and self.degraded_info is None)
 
-    def generate_speculative(self, ids, new_tokens, prefix_id=None):
+    # -- admission plumbing (docs/SERVING.md) ---------------------------
+
+    def speculative_allowed(self) -> bool:
+        """Brownout rung 1 (`no_speculative`) is the ladder's first,
+        cheapest degradation: speculative requests fall back to plain
+        greedy (token-identical) instead of occupying the serialized
+        draft/verify path."""
+        return self.brownout is None or self.brownout.allow_speculative()
+
+    def admit(self, request_class: str, deadline_s=None):
+        """Acquire an admission ticket (blocking, EDF order) + its
+        absolute deadline. Returns (ticket, deadline); raises
+        `AdmissionShed` (503 + dynamic Retry-After) on shed, KeyError on
+        an unknown class (the handler's 400). The caller must hand the
+        ticket to `generate(..., ticket=...)`, which releases it."""
+        if self.admission is None:
+            deadline = (None if deadline_s is None
+                        else time.monotonic() + float(deadline_s))
+            return None, deadline
+        deadline = self.admission.deadline_for(request_class, deadline_s)
+        # spans recorded by hand, not a context manager: an `admit:`
+        # sample must mean "queue wait of an ADMITTED request" (the
+        # report's admit_wait_ms) — a shed waiter's wasted wait records
+        # under its `shed:` span instead of skewing that stat
+        t0 = time.monotonic_ns()
+        try:
+            ticket = self.admission.admit(request_class, deadline)
+        except AdmissionShed as exc:
+            telemetry.record(
+                "serve", f"shed:{exc.request_class}:{exc.reason}",
+                t0, time.monotonic_ns())
+            raise
+        telemetry.record("serve", f"admit:{request_class}",
+                         t0, time.monotonic_ns())
+        return ticket, deadline
+
+    def retry_after_hint(self) -> float:
+        """Best current 'come back in N seconds' estimate — the value
+        every 503 path attaches: the open degraded window's hint, else
+        the admission plane's queue-drain estimate."""
+        deg = self.degraded_info
+        if deg is not None:
+            return deg["retry_after"]
+        if self.admission is not None:
+            return self.admission.retry_after()
+        return 5.0
+
+    def serving_stats(self) -> dict:
+        """The /healthz `serving` block (admission + brownout state)."""
+        s = {"deadline_exceeded_total": int(self.m_deadline.value())}
+        if self.admission is not None:
+            s["admission"] = self.admission.snapshot()
+        if self.brownout is not None:
+            s["brownout"] = self.brownout.snapshot()
+        return s
+
+    def generate_speculative(self, ids, new_tokens, prefix_id=None,
+                             request_class="interactive",
+                             deadline_s=None, ticket=None):
         """Greedy speculative decoding (token-identical to plain greedy;
         the draft only changes the dispatch count). Holds only the
         dedicated spec lock during the generation — concurrent plain
-        requests keep flowing through the executor."""
+        requests keep flowing through the executor. Admission applies
+        like any generate (the deadline guards the QUEUE wait; the
+        speculative loop itself has no mid-flight cancel boundary —
+        docs/SERVING.md)."""
         t0 = time.monotonic()
+        released = self.admission is None
         try:
-            out = self._generate_speculative_once(ids, new_tokens,
-                                                  prefix_id)
+            if ticket is None and self.admission is not None:
+                ticket, _ = self.admit(request_class, deadline_s)
+            completed = False
+            try:
+                out = self._generate_speculative_once(ids, new_tokens,
+                                                      prefix_id)
+                completed = True
+            finally:
+                if not released:
+                    # failures must not feed the service-rate estimator
+                    # (they would inflate the rate Retry-After divides by)
+                    self.admission.release(ticket, completed=completed)
+                    released = True
+        except AdmissionShed:
+            self.m_requests.inc(endpoint="/generate-speculative",
+                                status="503")
+            raise
         except ServiceDegraded:
             self.m_requests.inc(endpoint="/generate-speculative",
                                 status="503")
@@ -385,11 +579,59 @@ class _Service:
             self.prefixes.move_to_end(pid)     # LRU touch
             kw["prefix"] = self.prefixes[pid]
 
-    def generate(self, ids, new_tokens, on_token=None, **kw):
+    def generate(self, ids, new_tokens, on_token=None,
+                 request_class="interactive", deadline_s=None,
+                 ticket=None, deadline=None, **kw):
+        """One admitted generation. `request_class`/`deadline_s` drive
+        the admission plane; a pre-admitted `ticket` (+ its absolute
+        `deadline`) comes from the streaming path, which must shed
+        BEFORE the chunked headers commit. The deadline rides into the
+        executor, whose decode-step expiry check fires the request's
+        `cancel` flag — a mid-flight expiry surfaces as
+        `DeadlineExceeded` (HTTP 504)."""
         t0 = time.monotonic()
+        completed = False
         try:
-            with telemetry.span("serve", "generate"):
-                out = self._generate_policied(ids, new_tokens, on_token, kw)
+            if ticket is None and deadline is None:
+                # the streaming path pre-admits (its ticket, or with
+                # --no-admission just the computed deadline) — don't
+                # clobber a deadline that arrives without a ticket
+                ticket, deadline = self.admit(request_class, deadline_s)
+            try:
+                if self.brownout is not None:
+                    new_tokens = self.brownout.clamp(new_tokens)
+                cancel = kw.get("cancel")
+                if deadline is not None:
+                    if cancel is None:
+                        cancel = threading.Event()
+                        kw["cancel"] = cancel
+                    kw["deadline"] = deadline
+                with telemetry.span("serve", "generate"):
+                    out = self._generate_policied(ids, new_tokens,
+                                                  on_token, kw)
+                now = time.monotonic()
+                if (deadline is not None and now >= deadline
+                        and cancel.is_set()):
+                    # the executor cancelled it at a decode-step
+                    # boundary: the work was cut short, answer 504
+                    completed = True   # it DID occupy a full slot
+                    raise DeadlineExceeded(
+                        request_class, deadline_s
+                        if deadline_s is not None else deadline - t0)
+                completed = True
+            finally:
+                # generate releases ANY ticket it holds: the streaming
+                # handler hands its pre-admitted ticket over with the
+                # request and never touches it again
+                if ticket is not None and self.admission is not None:
+                    self.admission.release(ticket, completed=completed)
+        except AdmissionShed:
+            self.m_requests.inc(endpoint="/generate", status="503")
+            raise
+        except DeadlineExceeded:
+            self.m_deadline.inc()
+            self.m_requests.inc(endpoint="/generate", status="504")
+            raise
         except ServiceDegraded:
             self.m_requests.inc(endpoint="/generate", status="503")
             raise
@@ -480,6 +722,9 @@ class _Service:
         return s
 
     def stop(self):
+        self._gov_stop.set()
+        if self.admission is not None:
+            self.admission.close()   # shed every queued waiter (shutdown)
         with self.cond:
             self._stop = True
             self.cond.notify_all()
@@ -509,7 +754,8 @@ def make_handler(service, model_name):
             self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
             self.wfile.flush()
 
-        def _stream_generate(self, ids, new_tokens, kw):
+        def _stream_generate(self, ids, new_tokens, kw,
+                             request_class="interactive", deadline_s=None):
             """Chunked x-ndjson response: one line per decode step as the
             token lands, then the authoritative final line. The worker
             pushes DEVICE token arrays into a queue; the readback (the
@@ -525,16 +771,27 @@ def make_handler(service, model_name):
             import numpy as np
             t0 = time.monotonic()
             # validate BEFORE headers commit: bad requests still 400
-            # (raises into do_POST's error mapping); after this point
-            # failures surface as a terminal {"error": ...} stream line
+            # (raises into do_POST's error mapping) and don't spend
+            # admission tokens; then ADMIT before headers commit too — a
+            # shed must surface as a real 503 + Retry-After, not a 200
+            # whose body is an error line. After this point failures
+            # surface as a terminal {"error": ...} stream line.
             kw = service.prevalidate(ids, new_tokens, kw)
-            cancel = threading.Event()
-            kw["cancel"] = cancel
-            q = queue_mod.Queue()
-            worker = threading.Thread(
-                target=self._run_generate,
-                args=(ids, new_tokens, kw, q), daemon=True)
-            worker.start()
+            ticket, deadline = service.admit(request_class, deadline_s)
+            try:
+                cancel = threading.Event()
+                kw.update(cancel=cancel, request_class=request_class,
+                          ticket=ticket, deadline=deadline)
+                q = queue_mod.Queue()
+                worker = threading.Thread(
+                    target=self._run_generate,
+                    args=(ids, new_tokens, kw, q), daemon=True)
+                # once started, generate() owns the ticket's release
+                worker.start()
+            except BaseException:
+                if ticket is not None:
+                    service.admission.release(ticket, completed=False)
+                raise
             self.send_response(200)
             self.send_header("Content-Type", "application/x-ndjson")
             self.send_header("Transfer-Encoding", "chunked")
@@ -618,6 +875,7 @@ def make_handler(service, model_name):
                             "speculative": service.spec is not None,
                             "executor": service.executor,
                             "degraded": degraded,
+                            "serving": service.serving_stats(),
                             "stats": service.stats()})
             else:
                 self._send(404, {"error": "unknown path"})
@@ -633,10 +891,13 @@ def make_handler(service, model_name):
                         if req.get("healing"):
                             service.mark_healing()
                         else:
+                            # no hint -> DERIVE the Retry-After from the
+                            # observed heal history (_derived_retry_after)
+                            ra = req.get("retry_after")
                             service.enter_degraded(
                                 dead_rank=req.get("dead_rank"),
-                                retry_after=float(req.get("retry_after",
-                                                          5)))
+                                retry_after=(None if ra is None
+                                             else float(ra)))
                     else:
                         service.exit_degraded(
                             healed=bool(req.get("healed")),
@@ -650,6 +911,19 @@ def make_handler(service, model_name):
                     ids = req["ids"]
                     if ids and not isinstance(ids[0], list):
                         ids = [ids]
+                    # admission identity: every /generate carries a class
+                    # (default interactive) and may carry a deadline
+                    # budget in ms from receipt (docs/SERVING.md)
+                    request_class = req.get("class", "interactive")
+                    if request_class not in REQUEST_CLASSES:
+                        raise ValueError(
+                            f"unknown request class {request_class!r} "
+                            f"(expected one of {sorted(REQUEST_CLASSES)})")
+                    deadline_s = None
+                    if req.get("deadline_ms") is not None:
+                        deadline_s = float(req["deadline_ms"]) / 1e3
+                        if deadline_s <= 0:
+                            raise ValueError("deadline_ms must be > 0")
                     if req.get("speculative"):
                         if req.get("temperature") or req.get("top_k") \
                                 or req.get("eos_token") is not None \
@@ -658,9 +932,23 @@ def make_handler(service, model_name):
                                 "speculative generation is greedy-exact "
                                 "whole-rounds; it does not compose with "
                                 "sampling/eos/stream")
-                        out = service.generate_speculative(
-                            ids, int(req["new_tokens"]),
-                            prefix_id=req.get("prefix_id"))
+                        if not service.speculative_allowed():
+                            # brownout rung 1 (no_speculative): fall back
+                            # to plain greedy — token-identical, but the
+                            # serialized draft/verify path stays free
+                            out = service.generate(
+                                ids, int(req["new_tokens"]),
+                                request_class=request_class,
+                                deadline_s=deadline_s,
+                                temperature=0.0, top_k=0, seed=0,
+                                eos_token=None,
+                                prefix_id=req.get("prefix_id"))
+                        else:
+                            out = service.generate_speculative(
+                                ids, int(req["new_tokens"]),
+                                prefix_id=req.get("prefix_id"),
+                                request_class=request_class,
+                                deadline_s=deadline_s)
                         self._send(200, {"ids": out.tolist()})
                     else:
                         kw = dict(
@@ -671,15 +959,34 @@ def make_handler(service, model_name):
                             prefix_id=req.get("prefix_id"))
                         if req.get("stream"):
                             self._stream_generate(
-                                ids, int(req["new_tokens"]), kw)
+                                ids, int(req["new_tokens"]), kw,
+                                request_class, deadline_s)
                         else:
                             out = service.generate(
-                                ids, int(req["new_tokens"]), **kw)
+                                ids, int(req["new_tokens"]),
+                                request_class=request_class,
+                                deadline_s=deadline_s, **kw)
                             self._send(200, {"ids": out.tolist()})
                 else:
                     self._send(404, {"error": "unknown path"})
             except (KeyError, ValueError, TypeError, IndexError) as exc:
                 self._send(400, {"error": str(exc)})
+            except AdmissionShed as exc:
+                # overload backpressure: the Retry-After is COMPUTED from
+                # the observed service rate ("come back when the queue you
+                # would join has drained"), not a constant
+                self._send(503, {"error": str(exc), "shed": True,
+                                 "class": exc.request_class,
+                                 "reason": exc.reason},
+                           headers=(("Retry-After",
+                                     f"{exc.retry_after:g}"),))
+            except DeadlineExceeded as exc:
+                # the deadline expired while EXECUTING: the executor
+                # cancelled it at a decode-step boundary (no Retry-After —
+                # re-sending the same budget would expire the same way)
+                self._send(504, {"error": str(exc),
+                                 "deadline_exceeded": True,
+                                 "class": exc.request_class})
             except ServiceDegraded as exc:
                 # a degraded window is transient by contract: tell the
                 # client exactly when to come back instead of hanging it
@@ -689,9 +996,22 @@ def make_handler(service, model_name):
                            headers=(("Retry-After",
                                      f"{exc.retry_after:g}"),))
             except RuntimeError as exc:
-                self._send(503, {"error": str(exc)})
+                # every 503 carries a Retry-After (docs/SERVING.md audit):
+                # even a dead-worker 503 names the best current estimate
+                self._send(503, {"error": str(exc)},
+                           headers=(("Retry-After",
+                                     f"{service.retry_after_hint():g}"),))
 
     return Handler
+
+
+def _parse_class_map(pairs, what, parser):
+    """`interactive=2.5`-style repeated CLI pairs -> {class: float}."""
+    try:
+        out = parse_class_map(pairs, what)
+    except ValueError as exc:
+        parser.error(str(exc))
+    return out or None
 
 
 def main():
@@ -719,6 +1039,37 @@ def main():
                    help="LRU bound on registered prompt prefixes (each "
                         "handle retains full max_len KV buffers)")
     p.add_argument("--port", default=8321, type=int)
+    # -- overload protection (docs/SERVING.md) --------------------------
+    p.add_argument("--no-admission", action="store_true",
+                   help="disable the SLO-aware admission plane (requests "
+                        "block in executor backpressure like pre-serving "
+                        "builds; deadlines still propagate)")
+    p.add_argument("--queue-capacity", default=64, type=int,
+                   help="bound on the EDF admission queue; overflow sheds "
+                        "the latest-deadline waiter with 503 + Retry-After")
+    p.add_argument("--class-rate", action="append", metavar="CLASS=RPS",
+                   help="per-class sustained token-bucket admit rate "
+                        "(repeatable; default: unlimited)")
+    p.add_argument("--class-deadline", action="append",
+                   metavar="CLASS=SECONDS",
+                   help="per-class DEFAULT deadline budget applied when a "
+                        "request carries no deadline_ms (repeatable)")
+    p.add_argument("--no-brownout", action="store_true",
+                   help="disable the watermark-driven brownout ladder")
+    p.add_argument("--brownout-queue-high", default=8, type=int)
+    p.add_argument("--brownout-queue-low", default=1, type=int)
+    p.add_argument("--brownout-p95-high", default=2.0, type=float,
+                   help="windowed request-latency p95 (s) above which the "
+                        "ladder steps up")
+    p.add_argument("--brownout-p95-low", default=0.5, type=float)
+    p.add_argument("--brownout-dwell-up", default=0.5, type=float,
+                   help="seconds the hot condition must persist per "
+                        "step up (hysteresis)")
+    p.add_argument("--brownout-dwell-down", default=2.0, type=float)
+    p.add_argument("--brownout-clamp-tokens", default=16, type=int,
+                   help="new_tokens clamp at brownout level >= 2")
+    p.add_argument("--governor-interval", default=0.25, type=float,
+                   help="brownout governor tick (s)")
     p.add_argument("--trace-spans", default=None, metavar="OUT",
                    help="record request/stage spans and write a Perfetto-"
                         "loadable trace JSON to OUT on shutdown "
@@ -760,7 +1111,23 @@ def main():
     service = _Service(pipe, max_active=args.max_active,
                        max_prefixes=args.max_prefixes, spec=spec,
                        executor=args.executor,
-                       edge_itemsize=2 if args.dtype == "bfloat16" else 4)
+                       edge_itemsize=2 if args.dtype == "bfloat16" else 4,
+                       admission_enabled=not args.no_admission,
+                       queue_capacity=args.queue_capacity,
+                       class_rates=_parse_class_map(
+                           args.class_rate, "--class-rate", p),
+                       class_deadlines_s=_parse_class_map(
+                           args.class_deadline, "--class-deadline", p),
+                       brownout_enabled=not args.no_brownout,
+                       brownout_marks=Watermarks(
+                           queue_high=args.brownout_queue_high,
+                           queue_low=args.brownout_queue_low,
+                           p95_high_s=args.brownout_p95_high,
+                           p95_low_s=args.brownout_p95_low,
+                           dwell_up_s=args.brownout_dwell_up,
+                           dwell_down_s=args.brownout_dwell_down),
+                       clamp_new_tokens=args.brownout_clamp_tokens,
+                       governor_interval=args.governor_interval)
     server = ThreadingHTTPServer(("127.0.0.1", args.port),
                                  make_handler(service, args.model_name))
     print(f"serving {args.model_name} ({len(pipe.stages)} stages, "

@@ -13,6 +13,9 @@ chaos_dcn.py idiom — with:
   socket_v2, docs/DCN_WIRE.md) + the colocated hand-off's share of
   wire-busy time
 - `mb_latency`: per-microbatch end-to-end p50/p95/p99 (ms) across ranks
+- `serving`: when the trace came from a `tools/serve.py --trace-spans`
+  run — admitted request count, per-class admission-wait p50/p95, sheds
+  by class and reason, brownout transitions + max rung (docs/SERVING.md)
 - `failover`: detection -> recovery breakdown when a failover happened
 - `span_overhead_pct`: the recorder's own measured hot-path tax (per-span
   cost measured live on this host x span count / window)
