@@ -22,7 +22,7 @@ import threading
 from typing import Optional, Union
 
 from pipeedge_tpu.monitoring import MonitorContext, MonitorIterationContext
-from pipeedge_tpu.utils.threads import RWLock
+from pipeedge_tpu.utils.threads import RWLock, make_lock
 
 ENV_CSV_FILE_MODE: str = "CSV_FILE_MODE"
 _DEFAULT_CSV_MODE = 'w'  # fresh logs each run; CSV_FILE_MODE=x refuses to
@@ -60,7 +60,7 @@ class _Session:
         self.inflight = {}   # (thread ident, key) -> MonitorIterationContext
 
     def register(self, key: str, work_type: str, acc_type: str) -> None:
-        self.key_locks[key] = threading.Lock()
+        self.key_locks[key] = make_lock(f"monitoring.key[{key}]")
         self.units[key] = (work_type, acc_type)
 
     def begin(self, key: str) -> MonitorIterationContext:
@@ -90,7 +90,7 @@ class _Session:
 
 
 _session: Optional[_Session] = None
-_session_lock = RWLock()
+_session_lock = RWLock("monitoring.session")
 
 
 def init(key: str, window_size: int, work_type: str = 'items',

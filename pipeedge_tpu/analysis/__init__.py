@@ -1,0 +1,21 @@
+"""Repo-specific static analysis + runtime invariant witnesses (pipelint).
+
+Two halves, one correctness plane (docs/STATIC_ANALYSIS.md):
+
+- `lint` + the `rules_*` modules: an AST rule engine encoding the
+  codebase's own laws — lock discipline, thread hygiene, JAX dispatch-path
+  rules, DCN protocol-table rules, telemetry pre-declaration — run by
+  `tools/pipelint.py` over every diff (CI gate: zero non-baselined
+  findings). Rules support `# pipelint: disable=RULE` suppression and a
+  checked-in justified baseline for grandfathered findings.
+- `lockdep`: an opt-in (env PIPEEDGE_LOCKDEP=1) runtime lock-order
+  witness behind `utils/threads.py`'s lock factories: per-thread
+  acquisition stacks feed a global order graph, cycles and
+  held-lock-across-blocking-call hazards are detected while the tier-1
+  suite exercises the real interleavings, and a one-JSON-line report is
+  dumped at exit.
+
+This package is stdlib-only by design: `utils/threads.py` imports
+`lockdep` at module load, so nothing here may pull jax/numpy (or any
+other piece of the runtime it watches).
+"""

@@ -1,0 +1,368 @@
+"""pipelint engine: AST rule runner, suppressions, justified baseline.
+
+The machinery under `tools/pipelint.py` (docs/STATIC_ANALYSIS.md). A rule
+is a class with an id (PLxxx), severity, fix-hint, and a `check(module)`
+generator over `Finding`s; cross-file rules (metric declarations live in a
+different module than the increments they license) get a `collect(module)`
+pre-pass over every linted file before any `check` runs.
+
+Three escape hatches, in order of preference:
+
+- fix the code (the rules encode laws PRs 1-7 enforced by hand-audit);
+- `# pipelint: disable=PL102` trailing comment on the flagged line
+  (`disable=all` silences every rule there) — for the rare line where
+  the law genuinely doesn't apply and the reason fits in the comment;
+- a baseline entry (tools/pipelint_baseline.json) carrying a non-empty
+  `justification` — for grandfathered findings that survive triage.
+  Entries are matched by FINGERPRINT (rule + file + symbol + message, no
+  line numbers), so edits elsewhere in a file never invalidate them;
+  repeats of one fingerprint are occurrence-indexed ('#2', '#3' in line
+  order) so a justified entry covers exactly its one violation, not
+  future identical copies. A baseline entry without a justification
+  fails the whole run.
+
+Stdlib-only, like everything in `analysis/`.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import json
+import os
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+SEVERITY_ERROR = "error"
+SEVERITY_WARNING = "warning"
+
+_SUPPRESS_RE = re.compile(r"#\s*pipelint:\s*disable=([A-Za-z0-9_,\s]+)")
+_SUPPRESS_FILE_RE = re.compile(
+    r"#\s*pipelint:\s*disable-file=([A-Za-z0-9_,\s]+)")
+
+# attribute / variable names that denote a mutex in this codebase (the
+# make_lock sites): `self._lock`, `self._dead_lock`, `dead_lock`,
+# `self.key_locks[key]`, `self.cond`, `self.spec_lock`, ...
+_LOCK_NAME_RE = re.compile(
+    r"(^|_)(lock|locks|cond|conds|mutex|rwlock)$")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    severity: str
+    path: str
+    line: int
+    col: int
+    message: str
+    fix_hint: str = ""
+    symbol: str = ""
+    # 1-based index among findings sharing a raw fingerprint, assigned by
+    # run_lint in line order: a SECOND identical violation in the same
+    # function gets a distinct '#2' fingerprint, so one justified baseline
+    # entry can never grandfather new copies of the same violation
+    occurrence: int = 1
+
+    @property
+    def fingerprint(self) -> str:
+        """Line-number-independent identity: stable across unrelated edits
+        to the same file, which is what lets a baseline entry survive."""
+        raw = f"{self.rule}|{self.path}|{self.symbol}|{self.message}"
+        fp = hashlib.sha1(raw.encode()).hexdigest()[:12]
+        return fp if self.occurrence <= 1 else f"{fp}#{self.occurrence}"
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["fingerprint"] = self.fingerprint
+        return d
+
+    def format(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        hint = f"\n    fix: {self.fix_hint}" if self.fix_hint else ""
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"({self.severity}) {self.message}{sym}{hint}")
+
+
+class LintError(Exception):
+    """Engine-level failure (unparseable file, malformed baseline)."""
+
+
+class Module:
+    """One parsed file + the lookaside structures every rule needs."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        try:
+            self.tree = ast.parse(source)
+        except SyntaxError as exc:
+            raise LintError(f"{path}: syntax error: {exc}") from exc
+        # parent links + enclosing (class, function) symbol per node
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        self._symbols: Dict[ast.AST, str] = {}
+        self._link(self.tree, None, ())
+        # suppression maps
+        self._line_suppress: Dict[int, set] = {}
+        self._file_suppress: set = set()
+        for i, text in enumerate(self.lines, start=1):
+            m = _SUPPRESS_FILE_RE.search(text)
+            if m:
+                self._file_suppress |= {
+                    r.strip() for r in m.group(1).split(",") if r.strip()}
+                continue
+            m = _SUPPRESS_RE.search(text)
+            if m:
+                self._line_suppress[i] = {
+                    r.strip() for r in m.group(1).split(",") if r.strip()}
+
+    def _link(self, node: ast.AST, parent: Optional[ast.AST],
+              scope: Tuple[str, ...]) -> None:
+        for child in ast.iter_child_nodes(node):
+            self._parents[child] = node
+            child_scope = scope
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                child_scope = scope + (child.name,)
+            self._symbols[child] = ".".join(child_scope)
+            self._link(child, node, child_scope)
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def symbol(self, node: ast.AST) -> str:
+        return self._symbols.get(node, "")
+
+    def segment(self, node: ast.AST) -> str:
+        return ast.get_source_segment(self.source, node) or ""
+
+    def suppressed(self, rule_id: str, line: int) -> bool:
+        if rule_id in self._file_suppress or "all" in self._file_suppress:
+            return True
+        rules = self._line_suppress.get(line)
+        return bool(rules) and (rule_id in rules or "all" in rules)
+
+
+class Rule:
+    """Base rule: subclasses set the class attributes and implement
+    `check`; `collect` is the optional cross-file pre-pass."""
+
+    id = "PL000"
+    name = "abstract"
+    severity = SEVERITY_ERROR
+    fix_hint = ""
+    rationale = ""
+
+    def collect(self, module: Module) -> None:
+        pass
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        return iter(())
+
+    def finding(self, module: Module, node: ast.AST, message: str,
+                fix_hint: Optional[str] = None) -> Finding:
+        return Finding(rule=self.id, severity=self.severity,
+                       path=module.path, line=getattr(node, "lineno", 1),
+                       col=getattr(node, "col_offset", 0) + 1,
+                       message=message,
+                       fix_hint=self.fix_hint if fix_hint is None
+                       else fix_hint,
+                       symbol=module.symbol(node))
+
+
+# -- shared AST helpers (the lock grammar of this codebase) --------------
+
+def lock_name(node: ast.AST) -> Optional[str]:
+    """Canonical name when `node` denotes a lock, else None.
+
+    Recognized: `self._lock` / `self._dead_lock` (attribute whose name
+    matches the lock grammar), bare `dead_lock` names, and indexed lock
+    tables `self._conn_locks[dst]` / `self.key_locks[key]`.
+    """
+    if isinstance(node, ast.Attribute) and _LOCK_NAME_RE.search(node.attr):
+        return node.attr
+    if isinstance(node, ast.Name) and _LOCK_NAME_RE.search(node.id):
+        return node.id
+    if isinstance(node, ast.Subscript):
+        inner = lock_name(node.value)
+        if inner is not None:
+            return inner + "[]"
+    return None
+
+
+def with_lock_names(node: ast.With) -> List[Tuple[str, ast.AST]]:
+    """(lock name, context expr) for every lock-denoting item of a With —
+    including the RWLock context managers `x.lock_read()`/`x.lock_write()`."""
+    out = []
+    for item in node.items:
+        expr = item.context_expr
+        name = lock_name(expr)
+        if name is None and isinstance(expr, ast.Call) \
+                and isinstance(expr.func, ast.Attribute) \
+                and expr.func.attr in ("lock_read", "lock_write"):
+            name = expr.func.attr
+        if name is not None:
+            out.append((name, expr))
+    return out
+
+
+def walk_excluding_nested_functions(body: Sequence[ast.AST]) \
+        -> Iterator[ast.AST]:
+    """Every node in `body`, NOT descending into nested function/lambda
+    definitions (their bodies execute later, outside the lexical lock)."""
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue    # yielded as a statement, body deferred
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+def dotted(node: ast.AST) -> str:
+    """`jax.jit` -> "jax.jit", best-effort for Name/Attribute chains."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    return ""
+
+
+# -- file walking --------------------------------------------------------
+
+def iter_py_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+                out.extend(os.path.join(root, f) for f in sorted(files)
+                           if f.endswith(".py"))
+        elif p.endswith(".py"):
+            out.append(p)
+        else:
+            raise LintError(f"not a python file or directory: {p}")
+    return out
+
+
+def load_modules(files: Sequence[str]) -> Tuple[List[Module], List[str]]:
+    modules, errors = [], []
+    for f in files:
+        try:
+            with open(f, encoding="utf-8") as fh:
+                source = fh.read()
+            modules.append(Module(os.path.normpath(f).replace(os.sep, "/"),
+                                  source))
+        except (OSError, LintError) as exc:
+            errors.append(str(exc))
+    return modules, errors
+
+
+def default_rules() -> List[Rule]:
+    # local import: rules modules import this one for the base class
+    from . import (rules_jax, rules_locks, rules_protocol, rules_telemetry,
+                   rules_threads)
+    rules: List[Rule] = []
+    for mod in (rules_locks, rules_threads, rules_jax, rules_protocol,
+                rules_telemetry):
+        rules.extend(cls() for cls in mod.RULES)
+    return sorted(rules, key=lambda r: r.id)
+
+
+def run_lint(paths: Sequence[str],
+             rules: Optional[Sequence[Rule]] = None) \
+        -> Tuple[List[Finding], List[str], int]:
+    """Lint `paths`; returns (findings, engine errors, files seen).
+    Suppressed findings are dropped here — the baseline is the caller's
+    layer (tools/pipelint.py), so programmatic users see raw results."""
+    if rules is None:
+        rules = default_rules()
+    modules, errors = load_modules(iter_py_files(paths))
+    for rule in rules:
+        for m in modules:
+            rule.collect(m)
+    findings: List[Finding] = []
+    for rule in rules:
+        for m in modules:
+            for f in rule.check(m):
+                if not m.suppressed(f.rule, f.line):
+                    findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    dupes: Dict[str, int] = {}
+    for f in findings:
+        raw = f"{f.rule}|{f.path}|{f.symbol}|{f.message}"
+        f.occurrence = dupes[raw] = dupes.get(raw, 0) + 1
+    return findings, errors, len(modules)
+
+
+# -- baseline ------------------------------------------------------------
+
+class Baseline:
+    """Checked-in grandfather list: every entry names a finding by
+    fingerprint and MUST carry a justification (the 'empty-or-justified'
+    acceptance law — an unexplained suppression is itself a finding)."""
+
+    def __init__(self, entries: Optional[List[dict]] = None):
+        self.entries = entries or []
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        try:
+            with open(path, encoding="utf-8") as fh:
+                data = json.load(fh)
+        except OSError as exc:
+            raise LintError(f"cannot read baseline {path}: {exc}") from exc
+        except ValueError as exc:
+            raise LintError(f"baseline {path} is not valid JSON: {exc}") \
+                from exc
+        entries = data.get("findings", [])
+        for e in entries:
+            if not e.get("fingerprint"):
+                raise LintError(
+                    f"baseline {path}: entry missing fingerprint: {e}")
+            if not str(e.get("justification", "")).strip():
+                raise LintError(
+                    f"baseline {path}: entry {e.get('fingerprint')} "
+                    f"({e.get('rule')} in {e.get('path')}) has no "
+                    "justification — baselines must explain themselves")
+        return cls(entries)
+
+    def split(self, findings: Sequence[Finding]) \
+            -> Tuple[List[Finding], List[Finding], List[dict]]:
+        """(new, baselined, stale entries)."""
+        by_fp = {e["fingerprint"]: e for e in self.entries}
+        new, base = [], []
+        seen = set()
+        for f in findings:
+            if f.fingerprint in by_fp:
+                base.append(f)
+                seen.add(f.fingerprint)
+            else:
+                new.append(f)
+        stale = [e for e in self.entries if e["fingerprint"] not in seen]
+        return new, base, stale
+
+    @staticmethod
+    def render(findings: Sequence[Finding],
+               justifications: Optional[Dict[str, str]] = None) -> str:
+        """A baseline document for `findings`; justification defaults to
+        an empty string the author must fill in (the loader enforces it)."""
+        justifications = justifications or {}
+        entries = [{
+            "fingerprint": f.fingerprint,
+            "rule": f.rule,
+            "path": f.path,
+            "symbol": f.symbol,
+            "message": f.message,
+            "justification": justifications.get(f.fingerprint, ""),
+        } for f in findings]
+        return json.dumps({"version": 1, "findings": entries}, indent=2,
+                          sort_keys=False) + "\n"

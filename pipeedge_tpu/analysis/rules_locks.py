@@ -1,0 +1,221 @@
+"""Lock-discipline rules: guarded-field writes and blocking-under-lock.
+
+The laws PRs 2-7 enforced by hand: a field that one method protects with
+`self._lock` is protected EVERYWHERE (PL101), and a critical section never
+executes a blocking call — socket traffic, queue waits, device syncs,
+sleeps — because every other thread needing that lock stalls for the full
+I/O latency, and a blocked-holder + reverse-order acquirer is half a
+deadlock (PL102; `analysis/lockdep.py` witnesses the dynamic half).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .lint import (Finding, Module, Rule, SEVERITY_ERROR, SEVERITY_WARNING,
+                   lock_name, walk_excluding_nested_functions,
+                   with_lock_names)
+
+# methods where unlocked writes to guarded fields are the idiom, not a
+# race: construction happens before any other thread can see the object
+_INIT_METHODS = frozenset(("__init__", "__new__", "__post_init__"))
+
+# the `_locked` suffix is this codebase's contract that the CALLER holds
+# the lock (admission._grant_locked, runtime._snapshot_locked): writes
+# inside are dynamically locked even though no `with` is lexically visible
+_LOCKED_SUFFIX = "_locked"
+
+
+def _self_attr_target(node: ast.AST) -> Optional[str]:
+    """`self.X` as an assignment target -> "X" (plain attributes only)."""
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class LockGuardedFieldWrite(Rule):
+    id = "PL101"
+    name = "lock-guarded-field-write"
+    severity = SEVERITY_WARNING
+    fix_hint = ("take the same lock this field is written under elsewhere "
+                "(or move the write into the existing critical section)")
+    rationale = ("a field written under `self._lock` in one method is "
+                 "lock-protected shared state; writing it bare in another "
+                 "method races every reader that trusts the lock")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        for cls in ast.walk(module.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            yield from self._check_class(module, cls)
+
+    def _check_class(self, module: Module, cls: ast.ClassDef) \
+            -> Iterator[Finding]:
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        # pass 1: fields assigned inside a `with <lock>` in any method
+        guarded: Dict[str, Tuple[str, str]] = {}   # field -> (lock, method)
+        for m in methods:
+            for node in ast.walk(m):
+                if not isinstance(node, ast.With):
+                    continue
+                locks = with_lock_names(node)
+                if not locks:
+                    continue
+                lk = locks[0][0]
+                for inner in walk_excluding_nested_functions(node.body):
+                    targets: List[ast.AST] = []
+                    if isinstance(inner, ast.Assign):
+                        targets = list(inner.targets)
+                    elif isinstance(inner, (ast.AugAssign, ast.AnnAssign)):
+                        targets = [inner.target]
+                    for t in targets:
+                        if isinstance(t, ast.Tuple):
+                            elts: List[ast.AST] = list(t.elts)
+                        else:
+                            elts = [t]
+                        for e in elts:
+                            attr = _self_attr_target(e)
+                            if attr is not None:
+                                guarded.setdefault(attr, (lk, m.name))
+        if not guarded:
+            return
+        # pass 2: writes to a guarded field outside every lock
+        for m in methods:
+            if m.name in _INIT_METHODS or m.name.endswith(_LOCKED_SUFFIX):
+                continue
+            yield from self._scan_method(module, m, guarded)
+
+    def _scan_method(self, module: Module, method: ast.AST,
+                     guarded: Dict[str, Tuple[str, str]]) \
+            -> Iterator[Finding]:
+        def visit(nodes, lock_depth: int):
+            for node in nodes:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)):
+                    continue
+                depth = lock_depth
+                if isinstance(node, ast.With) and with_lock_names(node):
+                    depth += 1
+                if depth == 0:
+                    targets: List[ast.AST] = []
+                    if isinstance(node, ast.Assign):
+                        targets = list(node.targets)
+                    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                        targets = [node.target]
+                    for t in targets:
+                        elts = list(t.elts) if isinstance(t, ast.Tuple) \
+                            else [t]
+                        for e in elts:
+                            attr = _self_attr_target(e)
+                            if attr in guarded:
+                                lk, where = guarded[attr]
+                                yield self.finding(
+                                    module, node,
+                                    f"self.{attr} is written under "
+                                    f"{lk} in {where}() but written "
+                                    f"without a lock here")
+                yield from visit(ast.iter_child_nodes(node), depth)
+
+        yield from visit(getattr(method, "body", []), 0)
+
+
+# attribute calls that block (or synchronize with the device) — executing
+# one inside a critical section stalls every thread contending the lock
+_BLOCKING_ATTRS = frozenset((
+    "sleep",             # time.sleep
+    "recv", "recv_into", "sendall", "sendmsg", "send", "accept", "connect",
+    "select",
+    "block_until_ready", "result",
+    "wait", "wait_for", "wait_gte",
+))
+# repo-specific blocking transport helpers called as bare names (comm/dcn.py
+# framing layer: each performs full socket sends/reads)
+_BLOCKING_FUNCS = frozenset((
+    "_send_frame", "_recv_frame", "_recv_header", "_recv_body",
+    "_read_exact",
+))
+
+
+def _is_blocking_call(node: ast.Call, held_exprs: List[str]) \
+        -> Optional[str]:
+    """Description of the blocking call, or None.
+
+    `held_exprs` are source renderings of the held locks' context
+    expressions: `cond.wait()` inside `with cond:` is the condition-wait
+    idiom (the wait RELEASES the lock) and is exempt.
+    """
+    func = node.func
+    if isinstance(func, ast.Name):
+        if func.id in _BLOCKING_FUNCS:
+            return f"{func.id}()"
+        return None
+    if not isinstance(func, ast.Attribute):
+        return None
+    attr = func.attr
+    if attr not in _BLOCKING_ATTRS and attr not in ("get", "put", "join"):
+        return None
+    recv_src = ast.unparse(func.value) if hasattr(ast, "unparse") else ""
+    if attr in ("wait", "wait_for"):
+        # waiting on the very condition you hold releases it — the idiom
+        if recv_src in held_exprs:
+            return None
+        return f"{recv_src}.{attr}()"
+    if attr == "get":
+        # queue.get() blocks; dict.get(key[, default]) doesn't. A bare
+        # get() or a get with block=/timeout= kwargs is queue-style.
+        kw = {k.arg for k in node.keywords}
+        if node.args and not ({"block", "timeout"} & kw):
+            return None
+        return f"{recv_src}.get()"
+    if attr == "put":
+        kw = {k.arg for k in node.keywords}
+        if ({"block", "timeout"} & kw) or len(node.args) == 1:
+            return f"{recv_src}.put()"
+        return None
+    if attr == "join":
+        # thread.join() / thread.join(5) block; ", ".join(seq) doesn't
+        if isinstance(func.value, ast.Constant):
+            return None
+        if len(node.args) == 1 and not node.keywords:
+            a = node.args[0]
+            if not (isinstance(a, ast.Constant)
+                    and isinstance(a.value, (int, float))):
+                return None      # one non-numeric arg: string join
+        return f"{recv_src}.join()"
+    return f"{recv_src}.{attr}()"
+
+
+class BlockingCallUnderLock(Rule):
+    id = "PL102"
+    name = "blocking-call-under-lock"
+    severity = SEVERITY_ERROR
+    fix_hint = ("move the blocking call outside the critical section: "
+                "snapshot state under the lock, do the I/O after release "
+                "(comm/dcn.py's _declare_dead/_admit_peer pattern)")
+    rationale = ("a lock held across socket/queue/device/sleep blocking "
+                 "stalls every contending thread for the I/O's latency "
+                 "and is half of a lock-order deadlock")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.With):
+                continue
+            locks = with_lock_names(node)
+            if not locks:
+                continue
+            held_exprs = [ast.unparse(expr) if hasattr(ast, "unparse")
+                          else "" for _, expr in locks]
+            lock_desc = ", ".join(n for n, _ in locks)
+            for inner in walk_excluding_nested_functions(node.body):
+                if not isinstance(inner, ast.Call):
+                    continue
+                desc = _is_blocking_call(inner, held_exprs)
+                if desc is not None:
+                    yield self.finding(
+                        module, inner,
+                        f"blocking call {desc} while holding {lock_desc}")
+
+
+RULES = (LockGuardedFieldWrite, BlockingCallUnderLock)

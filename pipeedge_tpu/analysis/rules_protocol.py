@@ -1,0 +1,119 @@
+"""DCN protocol-table rules: unique `_MSG_*` ids, no orphan ids, 503s
+carry Retry-After.
+
+comm/dcn.py's wire protocol is a hand-maintained table of `_MSG_*`
+constants dispatched by an if/elif chain in `_reader_loop`: a colliding
+id silently routes one message type into another's handler (PL401), and a
+constant nobody dispatches is a frame the reader logs as "unknown" and
+drops (PL402 — `dcn._check_protocol_table()` enforces the same law at
+import time). PL403 is PR 7's serving-plane audit as a machine check:
+every 503 response names a Retry-After, because a bare 503 teaches
+clients to hammer.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List
+
+from .lint import Finding, Module, Rule, SEVERITY_ERROR
+
+
+class MsgIdCollision(Rule):
+    id = "PL401"
+    name = "msg-id-collision"
+    severity = SEVERITY_ERROR
+    fix_hint = "pick the next unused integer for the new _MSG_ constant"
+    rationale = ("two _MSG_ constants sharing an id silently route one "
+                 "frame type into the other's dispatch arm")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        by_id: Dict[int, List[str]] = {}
+        nodes: Dict[str, ast.AST] = {}
+        for node in module.tree.body:
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            t = node.targets[0]
+            if not (isinstance(t, ast.Name) and t.id.startswith("_MSG_")):
+                continue
+            if isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, int):
+                by_id.setdefault(node.value.value, []).append(t.id)
+                nodes[t.id] = node
+        for msg_id, names in sorted(by_id.items()):
+            for name in names[1:]:
+                yield self.finding(
+                    module, nodes[name],
+                    f"{name} reuses protocol id {msg_id} "
+                    f"(already taken by {names[0]})")
+
+
+class MsgIdUnhandled(Rule):
+    id = "PL402"
+    name = "msg-id-unhandled"
+    severity = SEVERITY_ERROR
+    fix_hint = ("add the dispatch arm (and sender) for the new message "
+                "type, or delete the dead constant")
+    rationale = ("a _MSG_ constant referenced nowhere else is a frame "
+                 "type the reader drops as 'unknown frame type'")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        defined: Dict[str, ast.AST] = {}
+        uses: Dict[str, int] = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Name) and node.id.startswith("_MSG_"):
+                if isinstance(node.ctx, ast.Store):
+                    defined[node.id] = node
+                else:
+                    uses[node.id] = uses.get(node.id, 0) + 1
+        for name, node in sorted(defined.items()):
+            if uses.get(name, 0) == 0:
+                yield self.finding(
+                    module, node,
+                    f"protocol constant {name} is defined but never "
+                    f"dispatched or sent")
+
+
+class MissingRetryAfter(Rule):
+    id = "PL403"
+    name = "missing-retry-after"
+    severity = SEVERITY_ERROR
+    fix_hint = ("attach a Retry-After header (serve.py retry_after_hint() "
+                "is the shared source) on every 503 path")
+    rationale = ("a 503 without Retry-After turns graceful shedding into "
+                 "a client retry storm (docs/SERVING.md audit, PR 7)")
+
+    _SEND_NAMES = ("send", "_send", "send_response", "send_error",
+                   "respond")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            fname = func.attr if isinstance(func, ast.Attribute) \
+                else (func.id if isinstance(func, ast.Name) else "")
+            if fname not in self._SEND_NAMES:
+                continue
+            if not any(isinstance(a, ast.Constant) and a.value == 503
+                       for a in node.args):
+                continue
+            call_src = module.segment(node)
+            if "retry_after" in call_src.lower() \
+                    or "retry-after" in call_src.lower():
+                continue
+            # the header may be attached right after (send_response(503)
+            # ... send_header("Retry-After", ...)): accept a mention in
+            # the few lines following the call — but NOT anywhere in the
+            # enclosing function, where one compliant 503 path would
+            # silently immunize every other 503 path beside it
+            end = getattr(node, "end_lineno", node.lineno)
+            window = "\n".join(module.lines[node.lineno - 1:end + 5])
+            if "retry-after" in window.lower() \
+                    or "retry_after" in window.lower():
+                continue
+            yield self.finding(
+                module, node,
+                "503 response without a Retry-After hint")
+
+
+RULES = (MsgIdCollision, MsgIdUnhandled, MissingRetryAfter)

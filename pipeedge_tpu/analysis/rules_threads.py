@@ -1,0 +1,126 @@
+"""Thread-hygiene rule: every thread is daemon or has a join path.
+
+A non-daemon thread with no `.join()` keeps the interpreter alive after
+`main` returns — the hung-fleet-teardown class of bug (a rank that
+"exited" but its process never died, holding its listen port and wedging
+the next run's rendezvous). The law: every `threading.Thread`/`Timer`
+either passes `daemon=True` at construction, sets `.daemon = True` before
+start, or is joined somewhere (the close()/stop() path of its owner).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Set
+
+from .lint import Finding, Module, Rule, SEVERITY_ERROR, dotted
+
+
+def _thread_ctor(node: ast.Call) -> Optional[str]:
+    """"Thread"/"Timer" when `node` constructs one (threading.Thread /
+    threading.Timer / bare Thread from an import)."""
+    name = dotted(node.func)
+    if name in ("threading.Thread", "threading.Timer", "Thread", "Timer"):
+        return name.split(".")[-1]
+    return None
+
+
+def _bound_name(module: Module, node: ast.Call) -> Optional[str]:
+    """The name the constructed thread is bound to: `t = Thread(...)` ->
+    "t", `self._hb_thread = Thread(...)` -> "_hb_thread", a list/dict
+    element or comprehension -> the collection's name."""
+    parent = module.parent(node)
+    # unwrap containers: [Thread(...) for ...], [Thread(...), ...]
+    hops = 0
+    while parent is not None and hops < 6 and not isinstance(
+            parent, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        if isinstance(parent, (ast.Expr, ast.Call)):
+            return None     # Thread(...).start() / passed straight away
+        parent = module.parent(parent)
+        hops += 1
+    if parent is None:
+        return None
+    target = parent.targets[0] if isinstance(parent, ast.Assign) \
+        else parent.target
+    if isinstance(target, ast.Name):
+        return target.id
+    if isinstance(target, ast.Attribute):
+        return target.attr
+    if isinstance(target, ast.Subscript):
+        inner = target.value
+        if isinstance(inner, ast.Attribute):
+            return inner.attr
+        if isinstance(inner, ast.Name):
+            return inner.id
+    return None
+
+
+class ThreadWithoutJoinOrDaemon(Rule):
+    id = "PL201"
+    name = "thread-without-join-or-daemon"
+    severity = SEVERITY_ERROR
+    fix_hint = ("pass daemon=True at construction, or join the thread "
+                "from the owner's close()/stop() path")
+    rationale = ("a non-daemon thread with no join path outlives main and "
+                 "wedges process teardown (the port-holding zombie-rank "
+                 "failure class)")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        # module-wide sets: names ever joined, names ever set daemon=True
+        joined: Set[str] = set()
+        daemoned: Set[str] = set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "join":
+                recv = node.func.value
+                if isinstance(recv, ast.Attribute):
+                    joined.add(recv.attr)
+                elif isinstance(recv, ast.Name):
+                    joined.add(recv.id)
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and t.attr == "daemon":
+                        recv = t.value
+                        if isinstance(recv, ast.Attribute):
+                            daemoned.add(recv.attr)
+                        elif isinstance(recv, ast.Name):
+                            daemoned.add(recv.id)
+        # `for w in self._workers: w.join()` — joining the loop variable
+        # counts for the iterated collection's name
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.For) and isinstance(node.target,
+                                                        ast.Name):
+                loop_var = node.target.id
+                if loop_var in joined or loop_var in daemoned:
+                    src = node.iter
+                    if isinstance(src, ast.Attribute):
+                        joined.add(src.attr)
+                    elif isinstance(src, ast.Name):
+                        joined.add(src.id)
+
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _thread_ctor(node)
+            if kind is None:
+                continue
+            daemon_kw = next((k for k in node.keywords
+                              if k.arg == "daemon"), None)
+            if daemon_kw is not None and not (
+                    isinstance(daemon_kw.value, ast.Constant)
+                    and not daemon_kw.value.value):
+                # daemon=True or a computed value: owned. An explicit
+                # constant daemon=False/None says the author CHOSE a
+                # non-daemon thread — it still needs a join path.
+                continue
+            bound = _bound_name(module, node)
+            if bound is not None and (bound in joined or bound in daemoned):
+                continue
+            where = f" (bound to {bound!r})" if bound else ""
+            yield self.finding(
+                module, node,
+                f"threading.{kind} is neither daemon nor joined "
+                f"anywhere in this module{where}")
+
+
+RULES = (ThreadWithoutJoinOrDaemon,)

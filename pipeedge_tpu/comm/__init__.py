@@ -40,6 +40,8 @@ import queue
 import threading
 from typing import Any, Callable, Optional, Tuple
 
+from ..utils.threads import make_lock
+
 logger = logging.getLogger(__name__)
 
 # Command identifiers (reference runtime.py:36-37)
@@ -186,7 +188,7 @@ class CommandPlane:
         # Set only by an in-handler stop(): the dispatch thread that is
         # still draining its session's queue and couldn't be joined there.
         self._draining: Optional[threading.Thread] = None
-        self._lock = threading.Lock()
+        self._lock = make_lock("comm.dispatcher")
 
     def start(self) -> None:
         """Start the dispatch thread. If the previous session was stopped

@@ -42,10 +42,11 @@ import logging
 import os
 import signal
 import socket as socket_mod
-import threading
 import time
 from dataclasses import dataclass, field
 from typing import List, Optional
+
+from ..utils.threads import make_lock
 
 ENV_CHAOS = "DCN_CHAOS"
 
@@ -98,7 +99,7 @@ class _ChaosSender:
         self._ctx = ctx
         self._inner = ctx.send_tensors
         self._spec = spec
-        self._lock = threading.Lock()
+        self._lock = make_lock("chaos.sender")
         self._count = 0
 
     def __call__(self, dst, tensors, channel=0):

@@ -50,6 +50,7 @@ import numpy as np
 from . import CMD_STOP, DistContext
 from .. import telemetry
 from ..telemetry import metrics as prom
+from ..utils.threads import make_lock
 
 try:  # bfloat16 on the wire (JAX's native TPU dtype)
     import ml_dtypes
@@ -167,7 +168,7 @@ ENV_LOCAL_HANDOFF = "DCN_LOCAL_HANDOFF"  # 0 disables the colocated tier
 # its frames can skip the socket entirely). Registered in init(),
 # unregistered in shutdown().
 _LOCAL_CONTEXTS: Dict[Tuple[str, int], "DistDcnContext"] = {}
-_LOCAL_LOCK = threading.Lock()
+_LOCAL_LOCK = make_lock("dcn.local_registry")
 
 
 class _RecvBufferPool:
@@ -511,11 +512,11 @@ class DistDcnContext(DistContext):
             else DEFAULT_EDGE_BITS)))
         # bitwidth-negotiation replies, keyed by the answering peer
         self._neg_replies: Dict[int, "queue.Queue"] = {}
-        self._neg_lock = threading.Lock()
+        self._neg_lock = make_lock("dcn.neg")
         # span-collection replies, keyed by the answering peer (one
         # in-flight collect_spans per peer, like negotiation)
         self._span_replies: Dict[int, "queue.Queue"] = {}
-        self._span_lock = threading.Lock()
+        self._span_lock = make_lock("dcn.span")
         # tiered transport (docs/DCN_WIRE.md): negotiated path per
         # DESTINATION rank (producer side; only PATH_LOCAL changes this
         # context's send behavior), path-negotiation reply queues, the
@@ -547,25 +548,35 @@ class DistDcnContext(DistContext):
         self._cmd_conns: Dict[int, socket.socket] = {}
         # per-destination locks (created upfront: world size is known), so a
         # slow dial to one peer never stalls traffic to the others
-        self._conn_locks = [threading.Lock() for _ in range(world_size)]
-        self._cmd_conn_locks = [threading.Lock() for _ in range(world_size)]
-        self._conns_lock = threading.Lock()              # dict/list mutation
+        self._conn_locks = [make_lock(f"dcn.conn[{i}]")
+                            for i in range(world_size)]
+        self._cmd_conn_locks = [make_lock(f"dcn.cmd_conn[{i}]")
+                                for i in range(world_size)]
+        self._conns_lock = make_lock("dcn.conns")        # dict/list mutation
         self._accepted: List[socket.socket] = []         # incoming
         self._recv_queues: Dict[Tuple[int, int], "queue.Queue"] = {}
-        self._recv_lock = threading.Lock()
+        self._recv_lock = make_lock("dcn.recv")
         self._stop = threading.Event()
         # peer-death detection (beyond the reference, whose RPC backpressure
         # "breaks down if the previous stage fails to send data afterward",
         # rpc/__init__.py:83-86): ranks whose connection dropped outside a
         # clean shutdown, and an optional notification callback
         self._dead: set = set()
-        self._dead_lock = threading.Lock()
+        self._dead_lock = make_lock("dcn.dead")
         self._peer_death_handler: Optional[Callable[[int], None]] = None
         # elastic membership (docs/FAULT_TOLERANCE.md rank lifecycle):
         # this rank's incarnation number — travels in every HELLO so the
         # receiver can fence frames from a dead incarnation
         self.epoch = int(epoch if epoch is not None
                          else _env_number(ENV_EPOCH, 0, int))
+        # /metrics hygiene (pipelint PL501): membership is known here, so
+        # the per-peer label matrices render from the first scrape — a
+        # scraper watching a peer's series sees 0, not series-absent
+        for r in range(world_size):
+            if r != rank:
+                _HEARTBEAT_MISSES.declare(peer=str(r))
+                _STALE_FRAMES.declare(peer=str(r))
+                _PEER_REJOINS.declare(peer=str(r))
         # admission policy: with accept_joins=False every _MSG_JOIN is
         # refused (the runtime's --on-peer-rejoin ignore), so a confirmed
         # death stays terminal exactly as before this plane existed
@@ -608,7 +619,7 @@ class DistDcnContext(DistContext):
         self._hb_miss = DEFAULT_HEARTBEAT_MISS
         self._hb_peers: Tuple[int, ...] = ()
         self._hb_last_rx: Dict[int, float] = {}
-        self._hb_lock = threading.Lock()
+        self._hb_lock = make_lock("dcn.hb")
         self._hb_hook: Optional[Callable[[int], None]] = None
         # per-peer redial backoff for the beat loop — instance state (not
         # loop-local) so a rejoin admission can clear it and the plane
@@ -1930,3 +1941,44 @@ class DcnPipelineStage:
                     return  # downstream died: peer-death handler notified
             elif self._results_cb is not None:
                 self._results_cb(item)
+
+
+# -- protocol-table self-check (import-time; pipelint PL401/PL402 is the
+# -- same law enforced statically on every diff) -------------------------
+
+def _check_protocol_table() -> None:
+    """Assert the `_MSG_*` table is coherent: every id unique, and every
+    constant actually dispatched by `_reader_loop` (introspected from its
+    source, so the check cannot drift from the code). A message type that
+    only ever needs SENDING would go in `_MSG_SENDER_ONLY` — today every
+    type is also received somewhere, so it is empty. Runs at import: a
+    colliding or orphaned id fails the process before any frame moves."""
+    import ast as _ast
+    import inspect
+    import textwrap
+
+    msgs = {name: val for name, val in globals().items()
+            if name.startswith("_MSG_") and isinstance(val, int)}
+    by_id: Dict[int, List[str]] = {}
+    for name, val in msgs.items():
+        by_id.setdefault(val, []).append(name)
+    dupes = {i: sorted(ns) for i, ns in by_id.items() if len(ns) > 1}
+    assert not dupes, f"_MSG_ id collisions: {dupes}"
+    try:
+        reader_src = inspect.getsource(DistDcnContext._reader_loop)
+        reader_tree = _ast.parse(textwrap.dedent(reader_src))
+    except (OSError, TypeError, SyntaxError):  # pragma: no cover
+        return                    # frozen/stripped: uniqueness still checked
+    # CODE references only (ast.Name) — a comment or docstring mentioning
+    # a _MSG_ constant must not satisfy the dispatch requirement
+    dispatched = {n.id for n in _ast.walk(reader_tree)
+                  if isinstance(n, _ast.Name) and n.id.startswith("_MSG_")}
+    sender_only: frozenset = frozenset()
+    missing = sorted(set(msgs) - dispatched - sender_only)
+    assert not missing, (
+        f"_MSG_ constants with no _reader_loop dispatch entry: {missing} "
+        "(add the dispatch arm, or list the name in _MSG_SENDER_ONLY "
+        "inside _check_protocol_table)")
+
+
+_check_protocol_table()

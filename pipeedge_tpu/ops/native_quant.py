@@ -15,10 +15,11 @@ from __future__ import annotations
 import ctypes
 import logging
 import os
-import threading
 from typing import Optional, Sequence, Tuple
 
 import numpy as np
+
+from ..utils.threads import make_lock
 
 logger = logging.getLogger(__name__)
 
@@ -26,7 +27,7 @@ _LIB_PATH = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)))), 'native', 'build', 'libquantpack.so')
 
 _lib = None
-_lib_lock = threading.Lock()
+_lib_lock = make_lock("native_quant.lib")
 _load_failed = False
 
 

@@ -431,6 +431,8 @@ class StageWorkerExecutor:
         import queue as queue_mod
         import threading
 
+        from ..utils.threads import make_condition
+
         if pipe.sp_degree != 1:
             raise ValueError("stage workers drive per-request decode "
                              "waves; sp prefill is a whole-pipeline pass")
@@ -444,7 +446,7 @@ class StageWorkerExecutor:
         # plain (not Bounded) semaphore: _die() over-releases on purpose
         # so submitters blocked on admission wake up and see the failure
         self._slots = threading.Semaphore(self.max_active)
-        self._lock = threading.Condition()
+        self._lock = make_condition("batcher.results")
         self.results: Dict = {}
         self._live = set()
         self._dead: Optional[BaseException] = None

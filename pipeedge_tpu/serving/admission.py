@@ -36,6 +36,7 @@ from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Tuple
 
 from ..telemetry import metrics as prom
+from ..utils.threads import make_lock
 
 # shed order under brownout is reverse priority: best_effort first
 REQUEST_CLASSES = ("interactive", "batch", "best_effort")
@@ -324,7 +325,7 @@ class AdmissionController:
         self.concurrency = int(concurrency)
         self._free = int(concurrency)
         self._queue = EDFQueue(queue_capacity)
-        self._lock = threading.Lock()
+        self._lock = make_lock("serving.admission")
         self._closed = False
         self._buckets = {
             name: TokenBucket(p.rate, p.burst)

@@ -34,12 +34,13 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from ..utils.threads import make_lock
 
 ENV_SPAN_CAPACITY = "PIPEEDGE_SPAN_CAPACITY"
 DEFAULT_SPAN_CAPACITY = 32768
@@ -83,7 +84,7 @@ class SpanRecorder:
         # DIGEST_CATEGORIES spans; what a lightweight per-round collection
         # (dcn.collect_digest) ships instead of the full ring
         self._digest: Dict[Tuple[str, str, Optional[int]], List[int]] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("telemetry.span_ring")
 
     def record(self, cat: str, name: str, t0: int, t1: int,
                stage: Optional[int] = None, mb: Optional[int] = None) -> None:

@@ -17,8 +17,9 @@ without restarting the process in tests).
 """
 from __future__ import annotations
 
-import threading
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..utils.threads import make_lock
 
 DEFAULT_LATENCY_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                            1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
@@ -51,7 +52,7 @@ class _Instrument:
     def __init__(self, name: str, help_text: str):
         self.name = name
         self.help = help_text
-        self._lock = threading.Lock()
+        self._lock = make_lock(f"metrics.{name}")
 
     def _key(self, labels: dict) -> Tuple[Tuple[str, str], ...]:
         return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
@@ -200,7 +201,7 @@ class Registry:
     """Named instrument collection rendering to Prometheus text format."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.registry")
         self._instruments: Dict[str, _Instrument] = {}
 
     def register(self, inst: _Instrument) -> _Instrument:

@@ -1,21 +1,57 @@
-"""Thread utilities: readers-writer lock and waitable counter.
+"""Thread utilities: lock factories, readers-writer lock, waitable counter.
 
 Capability parity with /root/reference/utils/threads.py (RWLock at 5-57,
 ThreadSafeCounter at 60-91). The TPU runtime is single-controller and far
 less thread-heavy than the reference's 4-threads-per-rank design, but the
 monitoring facade and host-driven pipeline still use these.
+
+`make_lock`/`make_rlock`/`make_condition` are the repo's lock constructors
+(docs/STATIC_ANALYSIS.md): plain stdlib primitives normally, and NAMED
+`analysis/lockdep.py` tracked locks when the runtime lock-order witness is
+on (env PIPEEDGE_LOCKDEP=1) — per-thread acquisition stacks feed a global
+order graph so the tier-1 suite convicts lock-order inversions and
+blocking-calls-under-lock the moment a PR introduces them. The name is the
+graph node: instances of one lock site share it (``dcn.dead``), indexed
+sites embed the index (``dcn.conn[3]``).
 """
 from __future__ import annotations
 
 import threading
 from contextlib import contextmanager
 
+from ..analysis import lockdep
+
+
+def make_lock(name: str) -> "threading.Lock":
+    """A mutex for lock site `name`: tracked when the witness is on."""
+    if lockdep.enabled():
+        return lockdep.TrackedLock(lockdep.state(), name)
+    return threading.Lock()
+
+
+def make_rlock(name: str) -> "threading.RLock":
+    """A re-entrant mutex for lock site `name` (witness-aware)."""
+    if lockdep.enabled():
+        return lockdep.TrackedRLock(lockdep.state(), name)
+    return threading.RLock()
+
+
+def make_condition(name: str) -> "threading.Condition":
+    """A condition variable for lock site `name` (witness-aware): the
+    tracked variant rides a `TrackedRLock`, and `wait()` releases the
+    witness's held stack with the lock — parking in a wait is not
+    'holding a lock across a blocking call'."""
+    if lockdep.enabled():
+        return threading.Condition(
+            lockdep.TrackedRLock(lockdep.state(), name))
+    return threading.Condition()
+
 
 class RWLock:
     """A readers-writer lock: many concurrent readers, exclusive writers."""
 
-    def __init__(self):
-        self._cond = threading.Condition()
+    def __init__(self, name: str = "rwlock"):
+        self._cond = make_condition(name)
         self._readers = 0
         self._writer = False
 
@@ -65,9 +101,9 @@ class ThreadSafeCounter:
     """A counter whose waiters can block until a threshold is reached
     (reference utils/threads.py:60-91; used to count pipeline results)."""
 
-    def __init__(self, value: int = 0):
+    def __init__(self, value: int = 0, name: str = "counter"):
         self._value = value
-        self._cond = threading.Condition()
+        self._cond = make_condition(name)
 
     @property
     def value(self) -> int:

@@ -41,7 +41,7 @@ from pipeedge_tpu.parallel import spmd
 from pipeedge_tpu.sched.scheduler import sched_pipeline
 from pipeedge_tpu.utils import data as data_utils
 from pipeedge_tpu.utils import quant as quantutil
-from pipeedge_tpu.utils.threads import ThreadSafeCounter
+from pipeedge_tpu.utils.threads import ThreadSafeCounter, make_lock
 
 logger = logging.getLogger(__name__)
 
@@ -64,7 +64,7 @@ MONITORING_KEY_RECV = 'recv'
 # peer's beats stopped
 MONITORING_KEY_LIVENESS = 'liveness'
 
-results_counter = ThreadSafeCounter()
+results_counter = ThreadSafeCounter(name="runtime.results")
 label_queue = queue.Queue()
 # multi-process (dcn) command state (reference runtime.py:400-415)
 stop_event = threading.Event()
@@ -76,14 +76,14 @@ stop_info: List[Optional[int]] = [None]
 # cumulative CMD_STOP count: round r of a multi-schedule run ends at the
 # (r+1)-th stop, so a stop that lands while a worker is still tearing down
 # the previous round is counted, not lost (stop_event alone would race)
-stop_counter = ThreadSafeCounter()
+stop_counter = ThreadSafeCounter(name="runtime.stops")
 # set once the fleet is tearing down cleanly (empty CMD_SCHED sent/received):
 # from then on, dropped connections are expected, not peer deaths
 fleet_shutdown = threading.Event()
 # failover mode state (--on-peer-death failover): ranks announced dead via
 # CMD_DEAD or observed locally; deaths accumulate for the whole run
 dead_ranks: set = set()
-dead_lock = threading.Lock()
+dead_lock = make_lock("runtime.dead")
 # rejoined-but-not-healed ranks (guarded by dead_lock): alive spare
 # capacity that must NOT silently reclaim its old stage at the next
 # round's failover re-plan. --on-peer-rejoin spare keeps ranks here;
@@ -143,6 +143,21 @@ _TTFC = prom.REGISTRY.gauge(
     "pipeedge_time_to_full_capacity_seconds",
     "latest heal episode: first death detection -> partition healed back "
     "to full capacity at a round boundary")
+
+
+def _declare_fleet_metric_labels(world_size: int, rank: int) -> None:
+    """Pre-declare the per-peer label matrices (pipelint PL501): the
+    fleet's membership fixes every (direction, peer) series up front, so
+    scrapers see the full matrix at 0 instead of series appearing at
+    first increment."""
+    for r in range(world_size):
+        if r == rank:
+            continue
+        _HEARTBEATS_RX.declare(src=str(r))
+        _PEER_DEATHS.declare(peer=str(r))
+        _REJOINS.declare(peer=str(r))
+        for key in (MONITORING_KEY_SEND, MONITORING_KEY_RECV):
+            _WIRE_BYTES.declare(direction=key, peer=str(r))
 
 
 def handle_cmd(cmd: int, tensors: Tuple) -> None:
@@ -764,7 +779,7 @@ class _MicrobatchLedger:
         # belt-and-braces (a stale frame must NEVER ack a microbatch)
         self._epoch_floor: dict = {}
         self.stale_dropped = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("runtime.ledger")
         self.done = threading.Event()
         if not self._ubatches:
             self.done.set()
@@ -969,6 +984,7 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
     from pipeedge_tpu.comm import chaos, dcn
 
     rank, world_size = args.rank, args.worldsize
+    _declare_fleet_metric_labels(world_size, rank)
     data_rank = args.data_rank
     failover_mode = args.on_peer_death == "failover"
     addrs = dcn.parse_rank_addrs(args.dcn_addrs, world_size, args.port)
@@ -1636,7 +1652,9 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                                           else None)
                 else:
                     payload = _wire_decode(tensors, dtype)
-                mb = (int(np.asarray(mbid).reshape(-1)[0])
+                # mbid is the host-side wire tensor stripped above,
+                # never a device array: the asarray cannot sync
+                mb = (int(np.asarray(mbid).reshape(-1)[0])  # pipelint: disable=PL303
                       if mbid is not None else mb_seq[0])
                 mb_seq[0] += 1
                 # compute span: host dispatch of the jitted shard step

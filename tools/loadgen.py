@@ -63,6 +63,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from pipeedge_tpu.serving import (REQUEST_CLASSES,  # noqa: E402
                                   parse_class_map)
+from pipeedge_tpu.utils.threads import make_lock  # noqa: E402
 
 DEFAULT_MIX = {"interactive": 0.7, "batch": 0.2, "best_effort": 0.1}
 DEFAULT_SLO_MS = {"interactive": 2000.0, "batch": 10000.0,
@@ -115,7 +116,7 @@ class _Stats:
     """Per-class outcome/latency accumulator (one lock, short holds)."""
 
     def __init__(self, classes):
-        self._lock = threading.Lock()
+        self._lock = make_lock("loadgen.stats")
         self.counts = {c: dict.fromkeys(OUTCOMES, 0) for c in classes}
         self.latencies = {c: [] for c in classes}     # ok + ok_late, ms
         self.retry_after = []

@@ -1,0 +1,143 @@
+"""pipelint: the repo's invariant-aware static-analysis gate.
+
+Runs the `pipeedge_tpu/analysis/` AST rule engine over the given paths
+and gates on zero non-baselined findings (docs/STATIC_ANALYSIS.md has the
+rule catalog and the triage workflow).
+
+Usage:
+    python -m tools.pipelint pipeedge_tpu tools runtime.py
+    python -m tools.pipelint --list-rules
+    python -m tools.pipelint --json report.json pipeedge_tpu
+    python -m tools.pipelint --write-baseline pipeedge_tpu tools runtime.py
+
+Exit codes: 0 clean (everything suppressed/baselined with justification),
+1 non-baselined findings, 2 engine error (syntax error in a linted file,
+malformed or unjustified baseline).
+
+The baseline (default tools/pipelint_baseline.json) grandfathers findings
+by fingerprint; every entry must carry a non-empty justification — the
+loader fails the run otherwise. `--write-baseline` regenerates the file
+from the current findings with EMPTY justifications for new entries
+(preserving existing ones), so a freshly-grandfathered finding cannot
+pass CI until a human explains it.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pipeedge_tpu.analysis import lint  # noqa: E402
+
+DEFAULT_BASELINE = os.path.join("tools", "pipelint_baseline.json")
+
+
+def _list_rules() -> None:
+    for rule in lint.default_rules():
+        print(f"{rule.id} {rule.name} [{rule.severity}]")
+        print(f"    {rule.rationale}")
+        if rule.fix_hint:
+            print(f"    fix: {rule.fix_hint}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="pipelint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*", help="files/directories to lint")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default %(default)s; ignored "
+                    "when missing)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, grandfathered or not")
+    ap.add_argument("--json", metavar="OUT",
+                    help="write the one-JSON-line report here ('-' for "
+                    "stdout)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from current findings "
+                    "(new entries get empty justifications to fill in)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        _list_rules()
+        return 0
+    if not args.paths:
+        ap.error("no paths given (try: pipeedge_tpu tools runtime.py)")
+
+    try:
+        findings, errors, n_files = lint.run_lint(args.paths)
+    except lint.LintError as exc:
+        print(f"pipelint: {exc}", file=sys.stderr)
+        return 2
+    if errors:
+        for e in errors:
+            print(f"pipelint: {e}", file=sys.stderr)
+        return 2
+
+    baseline = lint.Baseline()
+    if not args.no_baseline and os.path.exists(args.baseline):
+        try:
+            baseline = lint.Baseline.load(args.baseline)
+        except lint.LintError as exc:
+            print(f"pipelint: {exc}", file=sys.stderr)
+            return 2
+
+    if args.write_baseline:
+        keep = {e["fingerprint"]: str(e.get("justification", ""))
+                for e in baseline.entries}
+        with open(args.baseline, "w", encoding="utf-8") as fh:
+            fh.write(lint.Baseline.render(findings, keep))
+        print(f"pipelint: wrote {len(findings)} entries to "
+              f"{args.baseline} "
+              f"({sum(1 for f in findings if not keep.get(f.fingerprint))} "
+              "need justifications)")
+        return 0
+
+    new, baselined, stale = baseline.split(findings)
+
+    # With --json - the report owns stdout; human lines move to stderr.
+    human = sys.stderr if args.json == "-" else sys.stdout
+    for f in new:
+        print(f.format(), file=human)
+    if stale:
+        print(f"pipelint: note: {len(stale)} stale baseline "
+              f"entr{'y' if len(stale) == 1 else 'ies'} no longer "
+              "match any finding (prune with --write-baseline):",
+              file=sys.stderr)
+        for e in stale:
+            print(f"  {e['fingerprint']} {e['rule']} {e['path']} "
+                  f"[{e.get('symbol', '')}]", file=sys.stderr)
+
+    counts = {}
+    for f in new:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    report = {
+        "files": n_files,
+        "rules": len(lint.default_rules()),
+        "findings": [f.to_dict() for f in new],
+        "counts_by_rule": counts,
+        "baselined": len(baselined),
+        "stale_baseline": [e["fingerprint"] for e in stale],
+        "ok": not new,
+    }
+    if args.json:
+        line = json.dumps(report, separators=(",", ":")) + "\n"
+        if args.json == "-":
+            sys.stdout.write(line)
+        else:
+            with open(args.json, "w", encoding="utf-8") as fh:
+                fh.write(line)
+
+    tag = "clean" if not new else f"{len(new)} finding(s)"
+    print(f"pipelint: {n_files} files, {tag}, {len(baselined)} baselined",
+          file=human)
+    return 0 if not new else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -121,6 +121,7 @@ from pipeedge_tpu.serving import (AdmissionController,  # noqa: E402
                                   Watermarks, default_policies,
                                   parse_class_map)
 from pipeedge_tpu.telemetry import metrics as prom  # noqa: E402
+from pipeedge_tpu.utils.threads import make_condition, make_lock  # noqa: E402
 
 
 class ServiceDegraded(RuntimeError):
@@ -153,7 +154,7 @@ class _Service:
         self.pipe = pipe
         self.spec = spec
         self.executor = executor
-        self.cond = threading.Condition()
+        self.cond = make_condition("serve.results")
         # -- /metrics + healthz counters (one source of truth) ----------
         # the registry instruments below ARE the state: healthz's stats
         # read them back (stats()), so both surfaces always agree — even
@@ -163,6 +164,10 @@ class _Service:
         self.m_requests = prom.REGISTRY.counter(
             "pipeedge_serve_requests_total",
             "generate requests by endpoint and outcome status")
+        # full endpoint x outcome matrix from the first scrape (PL501)
+        for endpoint in ("/generate", "/generate-speculative"):
+            for status in ("200", "503", "504", "error"):
+                self.m_requests.declare(endpoint=endpoint, status=status)
         self.m_tokens = prom.REGISTRY.counter(
             "pipeedge_serve_tokens_total", "tokens generated (rows x steps)")
         self.m_latency = prom.REGISTRY.histogram(
@@ -198,7 +203,7 @@ class _Service:
         # requests and result waits proceed concurrently (the pipeline's
         # jitted programs are thread-safe; serializing speculative
         # requests with each other bounds their cache memory)
-        self.spec_lock = threading.Lock()
+        self.spec_lock = make_lock("serve.speculative")
         self.prefixes = OrderedDict()   # LRU-bounded: handles hold full
         self.spec_prefixes = OrderedDict()   # max_len KV buffers
         self.max_prefixes = max_prefixes
@@ -1104,8 +1109,11 @@ def main():
 
     if args.trace_spans:
         telemetry.configure(rank=0)
+    from pipeedge_tpu.analysis import lockdep
+    if args.trace_spans or lockdep.enabled():
         # SIGTERM must unwind through the finally below (the default
-        # handler would kill the process before the trace is written)
+        # handler would kill the process before the trace — or the
+        # PIPEEDGE_LOCKDEP atexit report — is written)
         import signal
         signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))
     service = _Service(pipe, max_active=args.max_active,
