@@ -28,6 +28,7 @@ sessions, docs/PERF.md) is visible inside one record. MFU is reported
 against BOTH denominators: the session-calibrated peak (chained 8192³ bf16
 matmuls) and the platform's nominal bf16 spec when the device kind is known.
 """
+import argparse
 import json
 import statistics
 import time
@@ -70,12 +71,15 @@ CALIBRATION_RECIPE = {
 }
 
 
-def _calibrate_peak_samples() -> list:
+def _calibrate_peak_samples(m: int = None) -> list:
     """Per-rep implied bf16 FLOP/s (2*M*N*K) under CALIBRATION_RECIPE;
     the chain amortizes dispatch/tunnel latency out of the measurement.
     max(samples) is the session peak; the spread IS the error bar on
-    every calibrated-MFU number this session."""
-    m = CALIBRATION_RECIPE["matmul_mnk"][0]
+    every calibrated-MFU number this session. A non-default `m`
+    (--cal-dim, CPU-loopback A/B runs) is off-recipe: its MFU numbers
+    are marked and never comparable across records."""
+    if m is None:
+        m = CALIBRATION_RECIPE["matmul_mnk"][0]
     k_iters = CALIBRATION_RECIPE["chain_length"]
     a = jnp.ones((m, m), jnp.bfloat16)
     b = jnp.ones((m, m), jnp.bfloat16)
@@ -112,10 +116,168 @@ def _model_flops_per_image(cfg) -> float:
     return l * per_block + embed + head
 
 
+def _top1_agreement(logits_exact: np.ndarray, logits_var: np.ndarray) -> dict:
+    """The accuracy-delta fields EVERY non-exact bench variant reports
+    beside its throughput (fast_numerics, quant_collectives, ...): a
+    non-exact number without its agreement is not self-describing."""
+    return {
+        "top1_agreement_vs_exact": round(float(np.mean(
+            np.argmax(logits_exact, -1) == np.argmax(logits_var, -1))), 4),
+        "max_abs_logit_delta": round(
+            float(np.max(np.abs(logits_exact - logits_var))), 4),
+    }
+
+
+def _quant_collectives_ab(name, bits: int, xs, flops_img: float,
+                          peak_flops: float, nominal_peak) -> dict:
+    """A/B for ROADMAP item 2: the SAME streamed TP run with exact
+    full-width psums vs int`bits` quantized collectives
+    (ops/qcollectives.py qpsum at every Megatron psum site in
+    parallel/tensor.py), interleaved rounds so session drift hits both
+    sides equally. Reports img/s for both, the speedup quotient, the
+    top-1 agreement + max-abs logit delta vs the exact side, and the
+    traced wire footprint (docs/QUANT_COLLECTIVES.md).
+
+    Needs >= 2 devices on the TP axis — a single-device backend has no
+    ICI collective site to quantize, and the block says so instead of
+    reporting a vacuous measurement."""
+    from functools import partial
+
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from pipeedge_tpu.models import registry
+    from pipeedge_tpu.ops import qcollectives
+    from pipeedge_tpu.parallel import tensor as tp
+    from pipeedge_tpu.utils import jax_compat
+
+    entry = registry.get_model_entry(name)
+    cfg = entry.config
+    devs = jax.devices()
+    n_tp, d = 1, 2
+    while (d <= len(devs) and cfg.num_attention_heads % d == 0
+           and cfg.intermediate_size % d == 0 and cfg.kv_heads % d == 0):
+        n_tp, d = d, d * 2
+    if n_tp < 2:
+        return {"mode": "skipped", "bits": bits,
+                "reason": f"{len(devs)} device(s) available: no ICI "
+                          "collective sites (the TP axis needs >= 2 "
+                          "devices dividing the head/FFN dims)"}
+    _, params, _ = registry.module_shard_factory(
+        name, None, 1, registry.get_model_layers(name),
+        dtype=jnp.bfloat16, unroll=True)
+    mesh = Mesh(np.asarray(devs[:n_tp]), ("tp",))
+    blocks = tuple(tp.shard_block_params(cfg, bp, mesh)
+                   for bp in params["blocks"])
+    family = entry.family
+    embed_p = jax.device_put(params.get("embeddings"))
+    final_p = jax.device_put(params.get("final"))
+    specs, local = tp.family_tp_plan(cfg)
+
+    def build_and_warm(mode_bits: int):
+        # the collective bitwidth is a trace-time flag: pin it across the
+        # fresh shard_map body + jit wrapper AND their first (tracing)
+        # call, then restore exact for everything else in this process
+        tp.set_tp_quant_bits(mode_bits)
+        try:
+            body = jax_compat.shard_map(
+                partial(local, cfg=cfg, axis="tp"), mesh=mesh,
+                in_specs=(specs, P()), out_specs=P())
+
+            @jax.jit
+            def run_all(ep, fp, bps, xs):
+                def step(carry, x):
+                    h = family.embed(ep, x, cfg)
+                    for bp in bps:
+                        h = body(bp, h)
+                    logits = family.finalize(fp, h, cfg)
+                    return carry + jnp.sum(logits.astype(jnp.float32)), None
+
+                total, _ = jax.lax.scan(step, jnp.float32(0), xs)
+                return total
+
+            @jax.jit
+            def run_one(ep, fp, bps, x):
+                h = family.embed(ep, x, cfg)
+                for bp in bps:
+                    h = body(bp, h)
+                return family.finalize(fp, h, cfg)
+
+            logits = np.asarray(run_one(embed_p, final_p, blocks,
+                                        xs[0]).astype(jnp.float32))
+            # run_one traced the SAME psum sites run_all is about to: drop
+            # its tally entries so the wire accounting below counts each
+            # site once, with run_all's execution multiplier
+            qcollectives.reset_trace_tally()
+            float(run_all(embed_p, final_p, blocks, xs))   # compile + warm
+        finally:
+            tp.set_tp_quant_bits(0)
+        return run_all, logits
+
+    n_ubatch, batch = xs.shape[0], xs.shape[1]
+    run_exact, logits_exact = build_and_warm(0)
+    run_q, logits_q = build_and_warm(bits)
+    q_times, exact_times = [], []
+    for _ in range(3):
+        tik = time.monotonic()
+        float(run_exact(embed_p, final_p, blocks, xs))
+        exact_times.append(time.monotonic() - tik)
+        tik = time.monotonic()
+        float(run_q(embed_p, final_p, blocks, xs))
+        q_times.append(time.monotonic() - tik)
+    q_img = statistics.median(n_ubatch * batch / t for t in q_times)
+    exact_img = statistics.median(n_ubatch * batch / t for t in exact_times)
+    # per-run executions of each traced qpsum site: the block loop is
+    # unrolled, so every site runs once per scan step (per microbatch)
+    # over 1 warm + 3 timed run_all calls; run_one's single execution per
+    # site was dropped from the tally above (one logits probe, < 1% of
+    # the streamed traffic)
+    collectives = qcollectives.record_collectives(
+        executions=4 * n_ubatch)
+    q_achieved = q_img * flops_img
+    return {
+        "mode": "tp-shard-map",
+        "bits": bits,
+        "tp": n_tp,
+        "images_per_sec": round(q_img, 3),
+        "exact_interleaved_images_per_sec": round(exact_img, 3),
+        "speedup_vs_exact": round(q_img / exact_img, 3),
+        "mfu_calibrated": round(q_achieved / peak_flops, 3),
+        "mfu_nominal": (round(q_achieved / nominal_peak, 3)
+                        if nominal_peak else None),
+        "achieved_tflops": round(q_achieved / 1e12, 1),
+        **_top1_agreement(logits_exact, logits_q),
+        "collectives": collectives,
+    }
+
+
 def main():
     from pipeedge_tpu.models import registry
     from pipeedge_tpu.models.layers import set_fast_numerics
     from pipeedge_tpu.utils import require_live_backend
+
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--tp-quant-bits", type=int, default=8,
+                        choices=[8, 4],
+                        help="bitwidth of the quant_collectives A/B "
+                             "variant (runtime.py --tp-quant-bits; "
+                             "docs/QUANT_COLLECTIVES.md)")
+    parser.add_argument("--model", default="google/vit-large-patch16-224",
+                        help="model to bench (default: the ViT-L headline; "
+                             "non-default models re-name the metric)")
+    parser.add_argument("--ubatches", type=int, default=128,
+                        help="microbatches in the streamed set (128 "
+                             "amortizes dispatch overhead on TPU; lower "
+                             "for CPU-loopback A/B evidence runs)")
+    parser.add_argument("--reps", type=int, default=REPS,
+                        help="timed repetitions (median reported)")
+    parser.add_argument("--cal-dim", type=int,
+                        default=CALIBRATION_RECIPE["matmul_mnk"][0],
+                        help="calibration matmul dimension; non-default "
+                             "values are off-recipe (MFU marked, not "
+                             "comparable across records) — for CPU-"
+                             "loopback A/B runs where 8192^3 is "
+                             "infeasible")
+    args = parser.parse_args()
 
     # Pin exact numerics for the headline/calibration passes BEFORE any
     # trace: an inherited PIPEEDGE_FAST_NUMERICS=1 would otherwise compile
@@ -123,9 +285,20 @@ def main():
     # speedup while claiming exact-parity numerics (ADVICE.md r5).
     set_fast_numerics(False)
 
+    name = args.model
+    family_name = registry.get_model_entry(name).family.FAMILY.name
+    if family_name not in ("vit", "deit"):
+        # the streamed loop builds pixel inputs from patch geometry and
+        # the TP A/B assumes the dense column/row kernel plan — token
+        # families would crash mid-bench after the compile time is spent
+        parser.error(f"--model must be an image family (vit/deit) for "
+                     f"this bench; {name} is family '{family_name}'")
+    metric = ("vit_large_images_per_sec_b8"
+              if name == "google/vit-large-patch16-224"
+              else f"{name.rsplit('/', 1)[-1].replace('-', '_')}"
+                   "_images_per_sec_b8")
     # lease-neutral wedge diagnostic (shared with bench_decode.py)
-    require_live_backend("vit_large_images_per_sec_b8", unit="images/sec")
-    name = "google/vit-large-patch16-224"
+    require_live_backend(metric, unit="images/sec")
     cfg = registry.get_model_entry(name).config
     fn, params, _ = registry.module_shard_factory(
         name, None, 1, registry.get_model_layers(name), dtype=jnp.bfloat16)
@@ -133,13 +306,15 @@ def main():
     batch = 8   # reference profiles use batch=8 (README_Scheduler.md:148-151)
     # 128 microbatches amortize the fixed per-dispatch overhead (~65 ms on
     # the tunneled axon platform) to <6% of the run; input set = 385 MB HBM
-    n_ubatch = 128
+    n_ubatch = args.ubatches
     rng = np.random.default_rng(0)
+    side = int(round(cfg.num_patches ** 0.5)) * cfg.patch_size
     xs = jax.device_put(jnp.asarray(
-        rng.normal(size=(n_ubatch, batch, 3, 224, 224)), dtype=jnp.bfloat16))
+        rng.normal(size=(n_ubatch, batch, cfg.num_channels, side, side)),
+        dtype=jnp.bfloat16))
     params = jax.device_put(params)
 
-    cal_samples = _calibrate_peak_samples()
+    cal_samples = _calibrate_peak_samples(args.cal_dim)
     peak_flops = max(cal_samples)
 
     # the UN-jitted shard apply: the factory's fn is jitted, and jit
@@ -174,7 +349,7 @@ def main():
     float(run_all(params, xs))  # compile + warmup (readback fences)
     e0 = energy_src.get_uj() if energy_src is not None else 0
     times = []
-    for _ in range(REPS):
+    for _ in range(args.reps):
         tik = time.monotonic()
         float(run_all(params, xs))
         times.append(time.monotonic() - tik)
@@ -185,7 +360,7 @@ def main():
         wall = sum(times)
         energy_fields = {
             "host_energy_j_per_image": round(
-                (e1 - e0) / 1e6 / (REPS * n_ubatch * batch), 4),
+                (e1 - e0) / 1e6 / (args.reps * n_ubatch * batch), 4),
             "host_power_w": round((e1 - e0) / 1e6 / wall, 1),
             "energy_source": "rapl-powercap (host CPU packages; TPU chip "
                              "power not exposed through JAX)",
@@ -283,8 +458,6 @@ def main():
         # stay exact-mode regardless of the inherited environment
         set_fast_numerics(False)
     fast_achieved = fast_img_per_sec * flops_img
-    top1_agree = float(np.mean(np.argmax(logits_exact, -1)
-                               == np.argmax(logits_fast, -1)))
     fast_fields = {
         "images_per_sec": round(fast_img_per_sec, 3),
         "exact_interleaved_images_per_sec": round(exact_adjacent, 3),
@@ -293,13 +466,17 @@ def main():
         "mfu_nominal": (round(fast_achieved / nominal_peak, 3)
                         if nominal_peak else None),
         "achieved_tflops": round(fast_achieved / 1e12, 1),
-        "top1_agreement_vs_exact": round(top1_agree, 4),
-        "max_abs_logit_delta": round(
-            float(np.max(np.abs(logits_exact - logits_fast))), 4),
+        **_top1_agreement(logits_exact, logits_fast),
     }
 
+    # quantized-collectives A/B (ROADMAP item 2): exact math, quantized
+    # ICI comms — the variant meant to land between the exact and
+    # fast-numerics endpoints at near-1.0 agreement
+    qc_fields = _quant_collectives_ab(name, args.tp_quant_bits, xs,
+                                      flops_img, peak_flops, nominal_peak)
+
     print(json.dumps({
-        "metric": "vit_large_images_per_sec_b8",
+        "metric": metric,
         "value": round(img_per_sec, 3),
         "unit": "images/sec",
         "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 1),
@@ -324,6 +501,9 @@ def main():
         # 7): calibrated MFU carries explicit error bars
         "calibration": dict(
             CALIBRATION_RECIPE,
+            matmul_mnk=[args.cal_dim] * 3,
+            off_recipe=(args.cal_dim
+                        != CALIBRATION_RECIPE["matmul_mnk"][0]) or None,
             session_samples_tflops=[round(s / 1e12, 1)
                                     for s in cal_samples],
             calibration_spread=[round(min(cal_samples) / 1e12, 1),
@@ -332,6 +512,10 @@ def main():
             round(achieved / max(cal_samples), 3),
             round(achieved / min(cal_samples), 3)],
         "fast_numerics": fast_fields,
+        "quant_collectives": qc_fields,
+        # the active collective bitwidth rides the record so BENCH_r0N
+        # trajectories are self-describing (which knob produced this line)
+        "tp_quant_bits": args.tp_quant_bits,
         "device_kind": device_kind,
         **energy_fields,
     }))

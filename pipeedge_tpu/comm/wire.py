@@ -129,7 +129,7 @@ def wire_encode_device(out, bit: int) -> PendingWire:
 def _wire_encode_device_timed(out, bit: int) -> PendingWire:
     import jax.numpy as jnp
 
-    from ..ops import quant as quant_ops
+    from ..ops import fused_quant
     tensors = out if isinstance(out, tuple) else (out,)
     header = np.asarray([WIRE_V2_MAGIC, WIRE_V2_VERSION, bit, FLAG_ON_DEVICE,
                          len(tensors)], np.int32)
@@ -141,7 +141,10 @@ def _wire_encode_device_timed(out, bit: int) -> PendingWire:
             parts.append(t)
         return PendingWire(parts)
     for t in tensors:
-        enc = quant_ops.tensor_encode_outerdim(jnp.asarray(t), bit)
+        # fused Pallas encode when enabled (ops/fused_quant.py) — the
+        # packing layout is bit-identical to the XLA/native codecs, so
+        # any consumer generation still decodes this frame
+        enc = fused_quant.encode_outerdim(jnp.asarray(t), bit)
         for a in (enc.data, enc.scale, enc.shift):
             _start_host_copy(a)
         parts += [enc.data, enc.scale, enc.shift,
@@ -155,9 +158,11 @@ def _is_v2_header(header: np.ndarray) -> bool:
 
 
 def _wire_decode_v2(header, tensors, dtype):
-    """Decode a v2 body ON the receiving device (jitted dequantize)."""
+    """Decode a v2 body ON the receiving device (jitted dequantize; the
+    fused-dequant prologue when enabled)."""
     import jax.numpy as jnp
 
+    from ..ops import fused_quant
     from ..ops import quant as quant_ops
     bit = int(header[2])
     n_payload = int(header[4])
@@ -180,7 +185,7 @@ def _wire_decode_v2(header, tensors, dtype):
                 data=jnp.asarray(data), scale=jnp.asarray(scale),
                 shift=jnp.asarray(shift),
                 shape=tuple(int(s) for s in shape), bit=bit)
-            out.append(quant_ops.tensor_decode_outerdim(enc).astype(dtype))
+            out.append(fused_quant.decode_outerdim(enc).astype(dtype))
         out = tuple(out)
     return out[0] if len(out) == 1 else out
 

@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from ._blocks import pick_block
+
 _NEG_INF = -1e30
 
 
@@ -81,15 +83,8 @@ def _attention_kernel(q_ref, k_ref, v_ref, o_ref, *, kv_block: int,
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
 
 
-def _pick_block(seq_len: int, preferred: int) -> int:
-    """Largest multiple of 8 (TPU sublane) <= preferred that divides seq_len;
-    falls back to the full sequence (always a legal block)."""
-    block = min(preferred, seq_len) // 8 * 8
-    while block >= 8:
-        if seq_len % block == 0:
-            return block
-        block -= 8
-    return seq_len
+# one block resolver across the fused kernels (ops/_blocks.py)
+_pick_block = pick_block
 
 
 @functools.partial(jax.jit, static_argnames=("q_block", "kv_block",

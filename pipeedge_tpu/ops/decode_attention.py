@@ -32,6 +32,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ._blocks import pick_block
+
 _NEG_INF = -1e30
 
 
@@ -156,13 +158,8 @@ def _kernel_v2(pos_ref, q_ref, kq_ref, ks_ref, kz_ref, vq_ref, vs_ref,
                        / l_scr[...][..., None]).astype(o_ref.dtype)
 
 
-def _pick_block(width: int, preferred: int = 128) -> int:
-    block = min(preferred, width) // 8 * 8
-    while block >= 8:
-        if width % block == 0:
-            return block
-        block -= 8
-    return width
+# one block resolver across the fused kernels (ops/_blocks.py)
+_pick_block = pick_block
 
 
 _V2_VMEM_BUDGET = 8 << 20

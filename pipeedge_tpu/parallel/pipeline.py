@@ -38,6 +38,7 @@ import jax
 
 from .. import telemetry
 from ..ops import clamp as clamp_ops
+from ..ops import fused_quant
 from ..ops import quant as quant_ops
 from ..utils import tracing
 
@@ -58,17 +59,20 @@ def _encode_payload(payload, bit: int, clamp: bool):
     for t in tensors:
         if clamp:
             t = clamp_ops.clamp_banner2019_laplace(t, bit)
-        out.append(quant_ops.tensor_encode_outerdim(t, bit))
+        # fused Pallas epilogue when enabled (ops/fused_quant.py): the
+        # encode rides the stage's last matmul inside this same jit
+        out.append(fused_quant.encode_outerdim(t, bit))
     return out[0] if single else tuple(out)
 
 
 def _decode_payload(payload):
-    """Dequantize a payload produced by `_encode_payload` (no-op otherwise)."""
+    """Dequantize a payload produced by `_encode_payload` (no-op otherwise);
+    the fused-dequant consumer prologue when enabled."""
     if isinstance(payload, quant_ops.QuantizedTensor):
-        return quant_ops.tensor_decode_outerdim(payload)
+        return fused_quant.decode_outerdim(payload)
     if isinstance(payload, tuple) and any(
             isinstance(t, quant_ops.QuantizedTensor) for t in payload):
-        return tuple(quant_ops.tensor_decode_outerdim(t) for t in payload)
+        return tuple(fused_quant.decode_outerdim(t) for t in payload)
     return payload
 
 

@@ -40,6 +40,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models import block_slices
 from ..models.layers import TransformerConfig
 from ..models.shard import FamilySpec, stack_blocks
+from ..ops import fused_quant
 from ..ops import quant as quant_ops
 
 logger = logging.getLogger(__name__)
@@ -151,7 +152,12 @@ class SpmdPipeline:
         """The param-explicit compiled program `fn(params, inputs)` for
         this input shape (cached per shape/dtype/edge-bits) — the public
         handle `run()`, the training step, and tests share."""
-        key = (inputs.shape, str(inputs.dtype), self.stage_bits)
+        from .tensor import get_tp_quant_bits
+        # the intra-stage collective bitwidth is a trace-time flag
+        # (tensor.set_tp_quant_bits): keying the cache on it makes a
+        # flag flip rebuild instead of silently reusing the stale trace
+        key = (inputs.shape, str(inputs.dtype), self.stage_bits,
+               get_tp_quant_bits())
         fn = self._compiled.get(key)
         if fn is None:
             fn = self._build(inputs)
@@ -175,6 +181,10 @@ class SpmdPipeline:
         dp = mesh.shape.get("dp", 1)
 
         sp = mesh.shape.get("sp", 1)
+        # intra-stage collective bitwidth, pinned for THIS trace (the
+        # compile cache key carries it, so a later flag flip retraces)
+        from .tensor import get_tp_quant_bits
+        collective_bits = get_tp_quant_bits()
 
         # trace shapes: embedded hidden + final output
         embed_shape = jax.eval_shape(
@@ -269,12 +279,15 @@ class SpmdPipeline:
             def encode(h, stage):
                 if quant_bit == 0:
                     return h
-                return quant_ops.tensor_encode_outerdim(h, quant_bit)
+                # fused Pallas epilogue when enabled (ops/fused_quant.py):
+                # the encode rides the stage's last matmul instead of a
+                # separate XLA fusion — bit-identical either way
+                return fused_quant.encode_outerdim(h, quant_bit)
 
             def decode(e, stage):
                 if quant_bit == 0:
                     return e
-                return quant_ops.tensor_decode_outerdim(e)
+                return fused_quant.decode_outerdim(e)
 
             def zero_carry(dt=None):
                 return encode(jnp.zeros(hidden_local.shape,
@@ -295,7 +308,7 @@ class SpmdPipeline:
                         scale = jnp.ones((b_local,), jnp.float32)
                         shift = jnp.zeros((b_local,), jnp.float32)
                     else:
-                        q = quant_ops.tensor_encode_outerdim(h, wb)
+                        q = fused_quant.encode_outerdim(h, wb)
                         data, scale, shift = q.data, q.scale, q.shift
                     pad = max_words - data.shape[1]
                     if pad:
@@ -312,7 +325,7 @@ class SpmdPipeline:
                     q = quant_ops.QuantizedTensor(
                         data=data[:, :words_for[wb]], scale=scale, shift=shift,
                         shape=hidden_local.shape, bit=wb)
-                    return quant_ops.tensor_decode_outerdim(q).astype(
+                    return fused_quant.decode_outerdim(q).astype(
                         hidden_local.dtype)
                 return dec
 
@@ -425,7 +438,15 @@ class SpmdPipeline:
                     if sp > 1:
                         # pooler/classifier reads the full sequence (CLS at
                         # position 0 lives on sp rank 0): gather the chunks
-                        hh = jax.lax.all_gather(hh, "sp", axis=1, tiled=True)
+                        # — quantized over ICI when --tp-quant-bits is set
+                        # (ops/qcollectives.py), exact otherwise
+                        if collective_bits:
+                            from ..ops import qcollectives
+                            hh = qcollectives.qall_gather(
+                                hh, "sp", collective_bits, axis=1, tiled=True)
+                        else:
+                            hh = jax.lax.all_gather(hh, "sp", axis=1,
+                                                    tiled=True)
                     return family.finalize(params["final"], hh, cfg).astype(
                         out_shape.dtype)
 

@@ -25,6 +25,38 @@ from ..models.layers import (TransformerConfig, apply_causal_mask, gelu,
                              layer_norm)
 
 
+# -- quantized TP collectives (trace-time flag, layers.set_fast_numerics
+#    idiom): 0 = exact full-width psum; 4/8 = EQuARX-style quantized
+#    allreduce (ops/qcollectives.py). Consumers must trace AFTER setting
+#    it — make_tp_block_fn builds fresh per call, and SpmdPipeline keys
+#    its compile cache on the current value.
+_TP_QUANT_BITS = 0
+
+
+def set_tp_quant_bits(bit: int) -> None:
+    """Select the bitwidth of intra-stage TP/SP collectives (the
+    runtime's --tp-quant-bits knob; docs/QUANT_COLLECTIVES.md)."""
+    global _TP_QUANT_BITS  # pylint: disable=global-statement
+    if bit not in (0, 4, 8):
+        raise ValueError(f"tp quant bits must be 0, 4 or 8, got {bit}")
+    _TP_QUANT_BITS = int(bit)
+
+
+def get_tp_quant_bits() -> int:
+    return _TP_QUANT_BITS
+
+
+def tp_psum(x: jax.Array, axis: str) -> jax.Array:
+    """THE allreduce of every Megatron block body here: exact psum at
+    bits=0, quantized collective otherwise — the single gate the
+    --tp-quant-bits knob flips for all six psum sites."""
+    bit = _TP_QUANT_BITS
+    if bit:
+        from ..ops import qcollectives
+        return qcollectives.qpsum(x, axis, bit)
+    return jax.lax.psum(x, axis)
+
+
 def _shard_by_specs(params: Dict, specs: Dict, mesh: Mesh,
                     axis: str) -> Dict:
     """Place a block's params per the SAME spec table shard_map uses as
@@ -88,7 +120,7 @@ def _tp_block_local(p: Dict, x: jax.Array, cfg: TransformerConfig,
     # row-parallel output projection: partial products summed across devices
     attn = jnp.dot(ctx, p["attn_out"]["w"].astype(x.dtype),
                    preferred_element_type=jnp.float32)
-    attn = jax.lax.psum(attn, axis) + p["attn_out"]["b"]
+    attn = tp_psum(attn, axis) + p["attn_out"]["b"]
     x = attn.astype(x.dtype) + x
 
     normed = layer_norm(p["ln_after"], x, cfg.layer_norm_eps)
@@ -99,7 +131,7 @@ def _tp_block_local(p: Dict, x: jax.Array, cfg: TransformerConfig,
     hidden = act(up.astype(x.dtype))
     down = jnp.dot(hidden, p["mlp_down"]["w"].astype(x.dtype),
                    preferred_element_type=jnp.float32)
-    down = jax.lax.psum(down, axis) + p["mlp_down"]["b"]
+    down = tp_psum(down, axis) + p["mlp_down"]["b"]
     return down.astype(x.dtype) + x
 
 
@@ -184,7 +216,7 @@ def _tp_bert_block_local(p: Dict, x: jax.Array, cfg: TransformerConfig,
     ctx = ctx.reshape(b, s, heads_local * hd)
     attn = jnp.dot(ctx, p["attn_out"]["w"].astype(x.dtype),
                    preferred_element_type=jnp.float32)
-    attn = jax.lax.psum(attn, axis) + p["attn_out"]["b"]
+    attn = tp_psum(attn, axis) + p["attn_out"]["b"]
     x = layer_norm(p["attn_ln"], attn.astype(x.dtype) + x,
                    cfg.layer_norm_eps)
 
@@ -193,7 +225,7 @@ def _tp_bert_block_local(p: Dict, x: jax.Array, cfg: TransformerConfig,
     hidden = gelu(up.astype(x.dtype))
     down = jnp.dot(hidden, p["mlp_down"]["w"].astype(x.dtype),
                    preferred_element_type=jnp.float32)
-    down = jax.lax.psum(down, axis) + p["mlp_down"]["b"]
+    down = tp_psum(down, axis) + p["mlp_down"]["b"]
     return layer_norm(p["out_ln"], down.astype(x.dtype) + x,
                       cfg.layer_norm_eps)
 
@@ -239,7 +271,7 @@ def _tp_llama_block_local(p: Dict, x: jax.Array, cfg: TransformerConfig,
            else _gqa_attend(q, k, v, cfg))   # local heads, causal
     attn = jnp.dot(ctx, p["attn_out"]["w"].astype(x.dtype),
                    preferred_element_type=jnp.float32)
-    attn = jax.lax.psum(attn, axis) + p["attn_out"]["b"]
+    attn = tp_psum(attn, axis) + p["attn_out"]["b"]
     x = attn.astype(x.dtype) + x
 
     normed = rms_norm(p["ln_after"], x, cfg.layer_norm_eps)
@@ -250,7 +282,7 @@ def _tp_llama_block_local(p: Dict, x: jax.Array, cfg: TransformerConfig,
     hidden = jax.nn.silu(gate).astype(x.dtype) * up.astype(x.dtype)
     down = jnp.dot(hidden, p["mlp_down"]["w"].astype(x.dtype),
                    preferred_element_type=jnp.float32)
-    down = jax.lax.psum(down, axis) + p["mlp_down"]["b"]
+    down = tp_psum(down, axis) + p["mlp_down"]["b"]
     return down.astype(x.dtype) + x
 
 

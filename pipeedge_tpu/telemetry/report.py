@@ -264,6 +264,45 @@ def analyze_spans(spans: Sequence[dict],
         if wire_busy else 0.0,
     }
 
+    # -- quantized ICI collectives: per-stage bits moved ---------------
+    # instant "collective" spans (ops/qcollectives.record_collectives)
+    # carry their run-total wire bytes in the name ("psum8:253440"): the
+    # per-stage view that separates ICI-collective traffic from the
+    # DCN-edge traffic the `edges` section times — bubble attribution
+    # can then say whether a stage's wire time is inter-stage (DCN) or
+    # intra-stage (quantized psum/all_gather over ICI)
+    collectives = {}
+    col = [s for s in spans if s.get("cat") == "collective"]
+    if col:
+        col_per_stage: Dict[str, dict] = {}
+        col_by_kind: Dict[str, int] = {}
+        col_bytes = 0
+        for s in col:
+            kindbit, _, nbytes_str = str(s.get("name", "")).partition(":")
+            try:
+                nbytes = int(nbytes_str)
+            except ValueError:
+                nbytes = 0
+            stage = s.get("stage")
+            key = (f"stage{stage}" if stage is not None
+                   else f"rank{s.get('rank', 0)}")
+            st = col_per_stage.setdefault(key, {"sites": 0, "wire_bytes": 0})
+            st["sites"] += 1
+            st["wire_bytes"] += nbytes
+            col_by_kind[kindbit] = col_by_kind.get(kindbit, 0) + nbytes
+            col_bytes += nbytes
+        dcn_busy_s = round(wire_busy / 1e9, 6)
+        collectives = {
+            "sites": len(col),
+            "wire_bytes": col_bytes,
+            "by_kind": dict(sorted(col_by_kind.items())),
+            "per_stage": {k: col_per_stage[k] for k in sorted(col_per_stage)},
+            # the ICI-vs-DCN split: bytes the collectives moved beside the
+            # time the DCN edges spent (the edges section holds per-edge
+            # detail; this is the one-glance comparison)
+            "dcn_edge_busy_s": dcn_busy_s,
+        }
+
     # -- closed-loop rebalancing --------------------------------------
     # "plan" spans time every consideration; an instant "apply" span marks
     # each ACCEPTED re-partition (the zero-churn assertion counts these)
@@ -341,6 +380,7 @@ def analyze_spans(spans: Sequence[dict],
         "edges": edges,
         "segments": segment_medians(spans),
         "transport": transport,
+        "collectives": collectives,
         "mb_latency": mb_latency,
         "serving": serving,
         "failover": failover,

@@ -706,6 +706,8 @@ def run_pipeline_spmd(args, stage_layers, stage_quant, stage_ranks,
     mesh = spmd.make_pipeline_mesh(n_stages, dp=args.spmd_dp,
                                    tp=args.spmd_tp, sp=args.spmd_sp,
                                    stage_ranks=ranks)
+    from pipeedge_tpu.ops import qcollectives
+    qcollectives.reset_trace_tally()
     pipe = spmd.build_spmd_pipeline(entry.family.FAMILY, entry.config,
                                     stage_layers, stage_params, mesh,
                                     quant_bit=list(stage_quant) if stage_quant
@@ -721,6 +723,20 @@ def run_pipeline_spmd(args, stage_layers, stage_quant, stage_ranks,
     for out in outputs:
         handle_results(out)
     _report(tik, tok, ubatches)
+    if args.tp_quant_bits:
+        # fold the traced quantized-collective sites into telemetry +
+        # /metrics: each site inside the tick scan executes ~ticks x
+        # blocks-per-stage times per run (bubble ticks included — they
+        # move wire bits too); 2 runs (warmup + timed). stage=None: the
+        # whole pipeline is ONE XLA program here, so the record is an
+        # all-stage aggregate — per-stage attribution comes from the dcn
+        # --stage-tp path, where each worker folds its own tally
+        blocks_per_stage = max((r - l + 1) // 4 for l, r in stage_layers)
+        ticks = len(ubatches) + n_stages - 1
+        summary = qcollectives.record_collectives(
+            executions=2 * ticks * max(1, blocks_per_stage))
+        logger.info("quantized collectives (--tp-quant-bits %d): %s",
+                    args.tp_quant_bits, summary)
 
 
 # Host-side quantized wire codec: moved to the library
@@ -1544,6 +1560,12 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                 restored = ckpt_utils.load_stage_checkpoint(
                     args.stage_ckpt, i)
             if args.stage_tp > 1:
+                if args.tp_quant_bits:
+                    # per-round collective accounting: the tally records
+                    # traced sites; this round's fold (in the finally
+                    # below) must not re-count a previous round's build
+                    from pipeedge_tpu.ops import qcollectives
+                    qcollectives.reset_trace_tally()
                 fn, params = _make_tp_stage(args, l, r, i, dtype, restored)
             else:
                 fn, params, _ = registry.module_shard_factory(
@@ -1958,6 +1980,20 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                          time.monotonic_ns())
         if stage is not None:
             stage.stop()
+            if args.stage_tp > 1 and args.tp_quant_bits:
+                # fold this stage's quantized-collective wire footprint,
+                # STAGE-TAGGED (the per-stage bits-moved attribution the
+                # trace report's collectives section promises): one
+                # shared block trace per stage, executed once per block
+                # per dispatched microbatch
+                from pipeedge_tpu.ops import qcollectives
+                summary = qcollectives.record_collectives(
+                    executions=mb_seq[0] * max(1, (r - l + 1) // 4),
+                    stage=i)
+                qcollectives.reset_trace_tally()
+                logger.info("rank %d stage %d quantized collectives "
+                            "(--tp-quant-bits %d): %s", rank, i,
+                            args.tp_quant_bits, summary)
 
 
 def _report(tik, tok, ubatches):
@@ -2033,6 +2069,17 @@ def main():
                              "over N local devices (block-aligned stages): "
                              "pipeline across hosts over DCN, tensor "
                              "parallelism within each host")
+    parser.add_argument("--tp-quant-bits", type=int, default=0,
+                        choices=[0, 8, 4],
+                        help="bitwidth of intra-stage TP/SP collectives "
+                             "(EQuARX-style quantized allreduce/all-gather "
+                             "over ICI, ops/qcollectives.py): 0 = exact "
+                             "full-width psum/all_gather; 8/4 = block-"
+                             "scaled int8/int4 ring collectives with an "
+                             "f32 accumulator. Gates every tensor.py psum "
+                             "site (--spmd-tp, --stage-tp) and the "
+                             "sequence-parallel gather (--spmd-sp); see "
+                             "docs/QUANT_COLLECTIVES.md")
     parser.add_argument("--stage-depth", type=int, default=0,
                         help="dcn stage pipelining depth: microbatches "
                              "buffered per hand-off queue, letting the next "
@@ -2214,6 +2261,20 @@ def main():
             parser.error("--rebalance auto on the host driver adapts the "
                          "microbatch size BETWEEN measure rounds: pass "
                          "--measure-rounds N > 1")
+    if args.tp_quant_bits:
+        has_tp_sites = (args.stage_tp > 1
+                        or (args.comm == "spmd"
+                            and (args.spmd_tp > 1 or args.spmd_sp > 1)))
+        if not has_tp_sites:
+            parser.error("--tp-quant-bits gates intra-stage TP/SP "
+                         "collectives, but no TP axis is active: pass "
+                         "--spmd-tp/--spmd-sp > 1 (--comm spmd) or "
+                         "--stage-tp > 1 (--comm dcn)")
+        # one global trace-time flag (layers.set_fast_numerics idiom):
+        # set BEFORE any driver traces a TP block body, and inherited by
+        # dcn worker processes through their own arg parse
+        from pipeedge_tpu.parallel import tensor as _tensor_flags
+        _tensor_flags.set_tp_quant_bits(args.tp_quant_bits)
     if args.stage_tp > 1 and args.comm != "dcn":
         parser.error("--stage-tp requires --comm dcn (per-rank local TP; "
                      "use the spmd driver's mesh axes for single-controller "

@@ -307,12 +307,99 @@ def bench_latency() -> int:
     return 0
 
 
+# -- quantized ICI collectives A/B (--quant-collectives) ------------------
+
+def bench_quant_collectives() -> dict:
+    """Collective-level A/B for the quantized ICI plane
+    (docs/QUANT_COLLECTIVES.md): exact `psum`/`all_gather` vs the
+    EQuARX-style `qpsum`/`qall_gather` over a 2-device mesh on
+    ViT-Large-shaped activations — per-collective max-abs error against
+    the analytic bound, wire-byte reduction from the trace tally, and
+    loopback wall time per call (CPU numbers measure the codec overhead
+    only; the wire win is ICI-bound and shows on TPU meshes)."""
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from pipeedge_tpu.ops import qcollectives
+    from pipeedge_tpu.utils import jax_compat
+
+    devs = jax.devices()
+    assert len(devs) >= 2, "main() forces a >=2-device host mesh"
+    mesh = Mesh(np.asarray(devs[:2]), ("tp",))
+    rng = np.random.default_rng(0)
+    # two per-device psum addends, ViT-L row-parallel-output-shaped
+    x = jnp.asarray(rng.normal(size=(2,) + UBATCH_SHAPE).astype(np.float32))
+    exact_sum = np.asarray(x).sum(axis=0)
+    shard_absrange = float(max(
+        np.asarray(x)[i].max() - np.asarray(x)[i].min() for i in range(2)))
+
+    out = {"metric": "quant_collectives_ici", "world": 2,
+           "ubatch_shape": list(UBATCH_SHAPE)}
+    for bit in (8, 4):
+        qcollectives.reset_trace_tally()
+        # offline bench: one jit per benched bitwidth, never a hot path
+        fn = jax.jit(jax_compat.shard_map(  # pipelint: disable=PL301
+            partial(qcollectives.qpsum, axis_name="tp", bit=bit),
+            mesh=mesh, in_specs=P("tp"), out_specs=P("tp")))
+        got = np.asarray(fn(x))            # compile + correctness sample
+        err = float(np.abs(got - exact_sum[None]).max())
+        bound = qcollectives.qpsum_error_bound(shard_absrange, bit, 2)
+        reps = []
+        for _ in range(N_FRAMES):
+            tik = time.monotonic()
+            np.asarray(fn(x))
+            reps.append(time.monotonic() - tik)
+        tally = qcollectives.trace_tally()[0]
+        out[f"qpsum_int{bit}"] = {
+            "max_abs_error": round(err, 6),
+            "error_bound": round(bound, 6),
+            "within_bound": err <= bound,
+            "wire_bytes_per_device": tally["wire_bytes"],
+            "raw_bytes_per_device": tally["raw_bytes"],
+            "wire_reduction": round(
+                tally["raw_bytes"] / tally["wire_bytes"], 3),
+            "loopback_ms_per_call": round(
+                sorted(reps)[len(reps) // 2] * 1e3, 2),
+        }
+    # exact psum reference timing (same mesh, same loopback)
+    fn0 = jax.jit(jax_compat.shard_map(
+        lambda t: jax.lax.psum(t, "tp"), mesh=mesh,
+        in_specs=P("tp"), out_specs=P("tp")))
+    np.asarray(fn0(x))
+    reps = []
+    for _ in range(N_FRAMES):
+        tik = time.monotonic()
+        np.asarray(fn0(x))
+        reps.append(time.monotonic() - tik)
+    out["exact_psum"] = {"loopback_ms_per_call": round(
+        sorted(reps)[len(reps) // 2] * 1e3, 2)}
+    out["value"] = out["qpsum_int8"]["wire_reduction"]
+    out["unit"] = "x fewer ICI collective wire bytes at int8 vs fp32"
+    return out
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--latency", action="store_true",
                    help="run the transport-tier latency A/B (one JSON "
                         "line per tier) instead of the wire/overlap bench")
+    p.add_argument("--quant-collectives", action="store_true",
+                   help="run the quantized ICI collectives A/B "
+                        "(qpsum/qall_gather vs exact, one JSON line; "
+                        "docs/QUANT_COLLECTIVES.md)")
     args = p.parse_args()
+    if args.quant_collectives:
+        # a >= 2-device mesh even on CPU-only hosts: force BEFORE the
+        # first jax backend init (parse-once flag). This bench measures
+        # codec numerics + bytes, so the virtual-CPU mesh is the point —
+        # same idiom as the test suite's 8-device conftest.
+        from pipeedge_tpu.utils import force_host_cpu_devices
+        force_host_cpu_devices(2)
+        print(json.dumps(bench_quant_collectives()))
+        return
     if args.latency:
         sys.exit(bench_latency())
     record = {"metric": "dcn_edge_wire_and_overlap",

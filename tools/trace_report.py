@@ -12,6 +12,10 @@ chaos_dcn.py idiom — with:
 - `transport`: edges per negotiated tier (colocated / zerocopy /
   socket_v2, docs/DCN_WIRE.md) + the colocated hand-off's share of
   wire-busy time
+- `collectives`: per-stage bits moved by quantized ICI collectives
+  (`collective` spans, ops/qcollectives.py) beside the DCN-edge busy
+  time — the view that distinguishes intra-stage (ICI psum/all_gather)
+  traffic from inter-stage (DCN) traffic (docs/QUANT_COLLECTIVES.md)
 - `mb_latency`: per-microbatch end-to-end p50/p95/p99 (ms) across ranks
 - `serving`: when the trace came from a `tools/serve.py --trace-spans`
   run — admitted request count, per-class admission-wait p50/p95, sheds
