@@ -102,7 +102,7 @@ class _ChaosSender:
         self._lock = make_lock("chaos.sender")
         self._count = 0
 
-    def __call__(self, dst, tensors, channel=0):
+    def __call__(self, dst, tensors, channel=0, trace=None):
         with self._lock:
             self._count += 1
             n = self._count
@@ -125,7 +125,7 @@ class _ChaosSender:
                     return
                 if act.kind == "flap":
                     _flap(self._ctx, n, act.delay_ms)
-        return self._inner(dst, tensors, channel=channel)
+        return self._inner(dst, tensors, channel=channel, trace=trace)
 
 
 def _restart(n: int, delay_ms: float) -> None:

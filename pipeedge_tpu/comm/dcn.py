@@ -110,6 +110,16 @@ _MSG_JOIN_ACK = 10
 # zero-copy socket tier when its receive pool is enabled, else legacy v2.
 _MSG_PATH = 11
 _MSG_PATH_ACK = 12
+# request-scoped tracing (docs/OBSERVABILITY.md): a `_MSG_TENSORS` frame
+# whose FIRST tensor is a uint8 JSON trace-context blob (telemetry.
+# TraceContext.to_wire). The reader strips the blob and delivers the
+# remaining tensors exactly like a plain data frame, with the decoded
+# context as queue metadata — so stage workers' dispatch/readback/emit
+# spans and per-edge transfer spans inherit the request id fleet-wide.
+# Wire-v2 compatible by construction: plain `_MSG_TENSORS` frames stay
+# byte-identical (absent = untraced), and an undecodable/truncated blob
+# degrades to untraced (counted), never to a dead reader.
+_MSG_TENSORS_TRACED = 13
 _SPANS_PROBE = 1    # aux: timestamps only (clock probe)
 _SPANS_REQUEST = 0  # aux: timestamps + span ring
 _SPANS_DIGEST = 2   # aux: timestamps + cumulative duration digest — the
@@ -261,6 +271,15 @@ _STALE_FRAMES = prom.REGISTRY.counter(
 _PEER_REJOINS = prom.REGISTRY.counter(
     "pipeedge_peer_rejoins_total",
     "JOIN admissions granted to restarted/rejoining peers, by rank")
+# request tracing: data frames that arrived carrying a trace context, per
+# producing peer (the per-edge trace counter the request-tracing plane
+# reports), and blobs that failed to decode (tolerated as untraced)
+_TRACED_FRAMES = prom.REGISTRY.counter(
+    "pipeedge_traced_frames_total",
+    "data frames received with a trace-context field, by producing peer")
+_TRACE_INVALID = prom.REGISTRY.counter(
+    "pipeedge_trace_ctx_invalid_total",
+    "trace-context blobs that failed to decode (frame delivered untraced)")
 
 
 def _env_number(name: str, default, cast):
@@ -577,6 +596,7 @@ class DistDcnContext(DistContext):
                 _HEARTBEAT_MISSES.declare(peer=str(r))
                 _STALE_FRAMES.declare(peer=str(r))
                 _PEER_REJOINS.declare(peer=str(r))
+                _TRACED_FRAMES.declare(peer=str(r))
         # admission policy: with accept_joins=False every _MSG_JOIN is
         # refused (the runtime's --on-peer-rejoin ignore), so a confirmed
         # death stays terminal exactly as before this plane existed
@@ -1087,6 +1107,14 @@ class DistDcnContext(DistContext):
             self._alive_sign(src)
             while not self._stop.is_set():
                 msg_type, aux, channel, n_tensors = _recv_header(conn)
+                # traced data frame: identical to _MSG_TENSORS except the
+                # leading uint8 trace-context blob (stripped after the
+                # body read, below) — normalize the type here so every
+                # data-frame branch (hooks, spans, fences, queues) stays
+                # one code path
+                traced = msg_type == _MSG_TENSORS_TRACED
+                if traced:
+                    msg_type = _MSG_TENSORS
                 # epoch fence: a frame from an incarnation that has since
                 # been fenced (confirmed dead, or superseded by a newer
                 # JOIN) must never reach queues, handlers, or the ledger.
@@ -1129,9 +1157,22 @@ class DistDcnContext(DistContext):
                     if hooked and self._recv_post_hook is not None:
                         self._recv_post_hook(src, channel, None)
                     raise
+                tctx = None
+                if traced:
+                    # strip the leading trace-context blob; decode failure
+                    # (truncated/garbage) degrades to untraced — the
+                    # payload tensors are intact either way
+                    tctx = telemetry.TraceContext.from_wire(tensors[0]) \
+                        if tensors else None
+                    tensors = tensors[1:]
+                    if tctx is None:
+                        _TRACE_INVALID.inc()
+                    else:
+                        _TRACED_FRAMES.inc(peer=str(src))
                 if t_rx0:
                     telemetry.record("wire", f"recv<-r{src}", t_rx0,
-                                     time.monotonic_ns())
+                                     time.monotonic_ns(),
+                                     rid=tctx.rid if tctx else None)
                 if msg_type == _MSG_TENSORS and self._recv_post_hook is not None:
                     self._recv_post_hook(src, channel, tensors)
                 if msg_type == _MSG_TENSORS:
@@ -1145,7 +1186,7 @@ class DistDcnContext(DistContext):
                     q = self._queue_for(src, channel)
                     while not self._stop.is_set():
                         try:
-                            q.put((conn_epoch, tensors), timeout=0.2)
+                            q.put((conn_epoch, tensors, tctx), timeout=0.2)
                             break
                         except queue.Full:
                             continue
@@ -1294,8 +1335,16 @@ class DistDcnContext(DistContext):
         return conn
 
     def send_tensors(self, dst: int, tensors: Sequence[np.ndarray],
-                     channel: int = CHANNEL_DATA) -> None:
+                     channel: int = CHANNEL_DATA,
+                     trace: Optional["telemetry.TraceContext"] = None) \
+            -> None:
         """Send a tensor list to `dst` (reference _send_tensor, p2p:96-108).
+
+        `trace` (a telemetry.TraceContext) rides the frame as an optional
+        leading uint8 blob (`_MSG_TENSORS_TRACED`): the consumer's stage
+        and wire spans inherit its request id. None sends the plain (and
+        byte-identical to pre-tracing) `_MSG_TENSORS` frame — untraced
+        runs pay zero wire bytes for the feature.
 
         With `send_retries` > 0 (env DCN_SEND_RETRIES), a broken connection
         is redialed and the WHOLE frame resent, with exponential backoff —
@@ -1314,7 +1363,8 @@ class DistDcnContext(DistContext):
             peer = self._local_peer(dst)
             if peer is not None:
                 try:
-                    self._deliver_local(peer, dst, tensors, channel)
+                    self._deliver_local(peer, dst, tensors, channel,
+                                        trace=trace)
                     return
                 except (ConnectionError, OSError):
                     self._mark_dead(dst)
@@ -1324,7 +1374,7 @@ class DistDcnContext(DistContext):
         attempts = 1 + max(0, self.send_retries)
         for attempt in range(attempts):
             try:
-                self._send_tensors_once(dst, tensors, channel)
+                self._send_tensors_once(dst, tensors, channel, trace=trace)
                 return
             except OSError as exc:
                 if attempt + 1 >= attempts or self._stop.is_set():
@@ -1341,14 +1391,25 @@ class DistDcnContext(DistContext):
                 time.sleep(backoff)
 
     def _send_tensors_once(self, dst: int, tensors: Sequence[np.ndarray],
-                           channel: int) -> None:
+                           channel: int,
+                           trace: Optional["telemetry.TraceContext"] = None
+                           ) -> None:
+        # wire frame vs hook payload kept separate: the recv side strips
+        # the blob BEFORE its hooks fire, so the send hooks must count
+        # the same (payload-only) tensors or the per-edge send/recv byte
+        # accounting would permanently diverge on traced edges
+        msg_type = _MSG_TENSORS
+        wire_tensors = tensors
+        if trace is not None:
+            wire_tensors = [trace.to_wire()] + list(tensors)
+            msg_type = _MSG_TENSORS_TRACED
         with self._conn_locks[dst]:
             conn = self._ensure_conn(dst)
             if self._send_pre_hook is not None:
                 self._send_pre_hook(dst, channel)
             t_tx0 = time.monotonic_ns() if telemetry.enabled() else 0
             try:
-                _send_frame(conn, _MSG_TENSORS, self._rank, tensors,
+                _send_frame(conn, msg_type, self._rank, wire_tensors,
                             channel)
             except Exception as exc:
                 if self._send_pre_hook is not None \
@@ -1363,7 +1424,8 @@ class DistDcnContext(DistContext):
                 raise
             if t_tx0:
                 telemetry.record("wire", f"send->r{dst}", t_tx0,
-                                 time.monotonic_ns())
+                                 time.monotonic_ns(),
+                                 rid=trace.rid if trace else None)
             if self._send_post_hook is not None:
                 self._send_post_hook(dst, channel, tensors)
 
@@ -1382,14 +1444,27 @@ class DistDcnContext(DistContext):
         `(tensors, epoch)`. What the failover ledger keys its epoch-aware
         dedupe on (stale incarnations are already fenced at the reader;
         the epoch here is forensic + belt-and-braces)."""
+        tensors, epoch, _ = self.recv_tensors_traced(src, timeout=timeout,
+                                                     channel=channel)
+        return tensors, epoch
+
+    def recv_tensors_traced(self, src: int,
+                            timeout: Optional[float] = None,
+                            channel: int = CHANNEL_DATA) \
+            -> Tuple[List[np.ndarray], int,
+                     Optional["telemetry.TraceContext"]]:
+        """`recv_tensors_meta` plus the frame's trace context
+        `(tensors, epoch, trace)` — None for a plain (untraced) frame or
+        an undecodable blob. What the DCN stage workers pull so their
+        spans inherit the producing request's id."""
         q = self._queue_for(src, channel)
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             try:
-                epoch, tensors = q.get(
+                epoch, tensors, tctx = q.get(
                     timeout=0.2 if deadline is None
                     else max(0.0, min(0.2, deadline - time.monotonic())))
-                return tensors, epoch
+                return tensors, epoch, tctx
             except queue.Empty:
                 with self._dead_lock:
                     dead = src in self._dead
@@ -1602,16 +1677,20 @@ class DistDcnContext(DistContext):
         self._local_device = device
 
     def _deliver_local(self, peer: "DistDcnContext", dst: int,
-                       tensors: Sequence, channel: int) -> None:
+                       tensors: Sequence, channel: int,
+                       trace: Optional["telemetry.TraceContext"] = None
+                       ) -> None:
         """Colocated-tier send: hand `tensors` (host OR device arrays)
         straight to `peer`'s bounded recv queue. Framing travels as
-        metadata (src rank, sender epoch, channel); the send/recv monitor
-        hooks and telemetry fire exactly like the socket path's."""
+        metadata (src rank, sender epoch, channel, trace context); the
+        send/recv monitor hooks and telemetry fire exactly like the
+        socket path's."""
         if self._send_pre_hook is not None:
             self._send_pre_hook(dst, channel)
         t0 = time.monotonic_ns() if telemetry.enabled() else 0
         try:
-            peer._local_put(self._rank, self.epoch, list(tensors), channel)
+            peer._local_put(self._rank, self.epoch, list(tensors), channel,
+                            trace=trace)
         except Exception:
             if self._send_pre_hook is not None \
                     and self._send_post_hook is not None:
@@ -1619,12 +1698,15 @@ class DistDcnContext(DistContext):
             raise
         if t0:
             telemetry.record("wire", f"local->r{dst}", t0,
-                             time.monotonic_ns())
+                             time.monotonic_ns(),
+                             rid=trace.rid if trace else None)
         if self._send_post_hook is not None:
             self._send_post_hook(dst, channel, tensors)
 
     def _local_put(self, src: int, epoch: int, tensors: List,
-                   channel: int) -> None:
+                   channel: int,
+                   trace: Optional["telemetry.TraceContext"] = None
+                   ) -> None:
         """Receiver half of the colocated hand-off: the reader loop's
         contract (epoch fence, life sign, recv hooks, bounded queue
         backpressure) without a socket in between. Runs on the SENDER's
@@ -1648,10 +1730,12 @@ class DistDcnContext(DistContext):
             self._recv_pre_hook(src, channel)
         if self._recv_post_hook is not None:
             self._recv_post_hook(src, channel, tensors)
+        if trace is not None:
+            _TRACED_FRAMES.inc(peer=str(src))
         q = self._queue_for(src, channel)
         while not self._stop.is_set():
             try:
-                q.put((epoch, tensors), timeout=0.2)
+                q.put((epoch, tensors, trace), timeout=0.2)
                 return
             except queue.Full:
                 continue
@@ -1865,10 +1949,13 @@ class DcnPipelineStage:
             t.join(timeout=10)
         self._threads.clear()
 
-    def enqueue_tensors(self, tensors: List[np.ndarray]) -> None:
+    def enqueue_tensors(self, tensors: List[np.ndarray],
+                        trace: Optional["telemetry.TraceContext"] = None
+                        ) -> None:
         """Inject data at the head of the pipeline (reference
-        enqueue_tensor, p2p:442-450); blocks when the stage is busy."""
-        self._queue_work.put(tensors)
+        enqueue_tensor, p2p:442-450); blocks when the stage is busy.
+        `trace` tags this microbatch's spans and rides downstream."""
+        self._queue_work.put((tensors, trace))
 
     def __enter__(self):
         self.start()
@@ -1882,8 +1969,9 @@ class DcnPipelineStage:
             return  # head stage: fed by enqueue_tensors
         while not self._stop.is_set():
             try:
-                tensors = self._ctx.recv_tensors(self._rank_src, timeout=0.2,
-                                                 channel=self._recv_channel)
+                tensors, _, trace = self._ctx.recv_tensors_traced(
+                    self._rank_src, timeout=0.2,
+                    channel=self._recv_channel)
             except queue.Empty:
                 continue
             except ConnectionError:
@@ -1891,7 +1979,7 @@ class DcnPipelineStage:
                 # fleet-wide reaction (CMD_STOP broadcast); this thread just
                 # stops pulling
                 return
-            self._queue_work.put(tensors)
+            self._queue_work.put((tensors, trace))
 
     def _work_loop(self) -> None:
         # span mb tag: the global id when the frame carries one (mb_of),
@@ -1902,16 +1990,22 @@ class DcnPipelineStage:
             item = self._queue_work.get()
             if item is self._SENTINEL or self._stop.is_set():
                 return
+            tensors, trace = item
             mb = seq
             if self._mb_of is not None:
                 try:
-                    mb = self._mb_of(item)
+                    mb = self._mb_of(tensors)
                 except Exception:  # malformed frame: keep the sequence tag
                     pass
-            with telemetry.span("stage", "dispatch", stage=self._stage,
-                                mb=mb):
-                out = self._dispatch_cb(item)
-            self._queue_out.put((mb, out))
+            rid = trace.rid if trace is not None else None
+            # trace_scope: spans the callback records WITHOUT an explicit
+            # rid (the compute span inside dispatch_cb) inherit this
+            # microbatch's request id through the thread-local context
+            with telemetry.trace_scope(trace), \
+                    telemetry.span("stage", "dispatch", stage=self._stage,
+                                   mb=mb, rid=rid):
+                out = self._dispatch_cb(tensors)
+            self._queue_out.put((mb, out, trace))
             seq += 1
 
     def _send_loop(self) -> None:
@@ -1919,12 +2013,14 @@ class DcnPipelineStage:
             item = self._queue_out.get()
             if item is self._SENTINEL or self._stop.is_set():
                 return
-            mb, item = item
+            mb, item, trace = item
+            rid = trace.rid if trace is not None else None
             if self._readback_cb is not None:
                 # drain the async readback HERE, after the work thread is
                 # already free to dispatch the next microbatch
-                with telemetry.span("stage", "readback", stage=self._stage,
-                                    mb=mb):
+                with telemetry.trace_scope(trace), \
+                        telemetry.span("stage", "readback",
+                                       stage=self._stage, mb=mb, rid=rid):
                     item = self._readback_cb(item)
             if self._rank_dst is not None:
                 try:
@@ -1932,11 +2028,15 @@ class DcnPipelineStage:
                     # plus any slow-link stall or backpressure. A cost the
                     # stage pays per microbatch REGARDLESS of its layer
                     # range, which is exactly how the rebalance solver
-                    # treats it (feedback.StageEstimate.fixed_s)
+                    # treats it (feedback.StageEstimate.fixed_s). The
+                    # trace context rides the outbound frame, so the next
+                    # stage inherits the request id without the payload
+                    # tensors ever carrying it.
                     with telemetry.span("stage", "emit", stage=self._stage,
-                                        mb=mb):
+                                        mb=mb, rid=rid):
                         self._ctx.send_tensors(self._rank_dst, item,
-                                               channel=self._send_channel)
+                                               channel=self._send_channel,
+                                               trace=trace)
                 except OSError:
                     return  # downstream died: peer-death handler notified
             elif self._results_cb is not None:

@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import telemetry
 from .decode import (DecodePipeline, _repeat_batch, make_token_picker,
                      validate_capacity)
 
@@ -136,22 +137,30 @@ def _run_stage(pipe: DecodePipeline, i: int, req: _Request, data,
     """One stage-step dispatch for request `req` at stage `i` — THE
     per-stage semantics (device placement, prefill vs span vs step),
     shared by ContinuousBatcher.tick and StageWorkerExecutor's workers
-    so the two executors can never drift apart."""
+    so the two executors can never drift apart. Each step records a
+    request-tagged `stage`/`exec{i}` span (rid = the request id), so
+    trace_report --request attributes a slow request's per-stage compute
+    without a fleet trace — free when span recording is off. The mb tag
+    stays None: decode-step indices are NOT microbatch ids, and tagging
+    them as such would cross-link unrelated concurrent requests through
+    every mb-keyed consumer (trace_slice, flow events)."""
     st = pipe.stages[i]
-    if st["device"] is not None:
-        data = jax.device_put(data, st["device"])
-    if kind == "prefill":
-        out, req.caches[i] = st["prefill"](st["params"], data,
-                                           req.caches[i])
-    elif kind == "span":
-        # prefix-seeded prompt pass: the suffix runs as one span at the
-        # prefix offset (DecodePipeline.extend's rule)
-        out, req.caches[i] = pipe._decode_step(
-            st, data, req.caches[i], req.prefix["len"],
-            span=data.shape[1])
-    else:
-        out, req.caches[i] = pipe._decode_step(st, data, req.caches[i],
-                                               req.pos)
+    with telemetry.span("stage", f"exec{i}", stage=i,
+                        rid=str(req.rid)):
+        if st["device"] is not None:
+            data = jax.device_put(data, st["device"])
+        if kind == "prefill":
+            out, req.caches[i] = st["prefill"](st["params"], data,
+                                               req.caches[i])
+        elif kind == "span":
+            # prefix-seeded prompt pass: the suffix runs as one span at
+            # the prefix offset (DecodePipeline.extend's rule)
+            out, req.caches[i] = pipe._decode_step(
+                st, data, req.caches[i], req.prefix["len"],
+                span=data.shape[1])
+        else:
+            out, req.caches[i] = pipe._decode_step(st, data, req.caches[i],
+                                                   req.pos)
     return out
 
 

@@ -156,14 +156,17 @@ class HostPipeline:
         self.edge_bytes_callback = edge_bytes_callback
 
     def enqueue(self, ubatch, edge_bytes: Optional[List[int]] = None,
-                mb: Optional[int] = None):
+                mb: Optional[int] = None,
+                trace: Optional[telemetry.TraceContext] = None):
         """Dispatch one microbatch through all stages; returns the (device-
         resident, not yet materialized) final payload. When `edge_bytes` is a
         list, it receives the wire byte count of each inter-stage edge.
         `mb` tags the telemetry spans with the microbatch id (flow events
-        on the merged trace)."""
+        on the merged trace); `trace` additionally tags them with the
+        request id this microbatch serves (trace_report --request)."""
         data = ubatch
         last = len(self.stages) - 1
+        rid = trace.rid if trace is not None else None
         for i, stage in enumerate(self.stages):
             # named profiler region: stage dispatch shows up on the trace
             # timeline (see utils/tracing.py; no-op cost when not tracing).
@@ -171,14 +174,18 @@ class HostPipeline:
             # is async); the retire span is where device time surfaces.
             with tracing.annotate(stage.name or f"stage{i}"), \
                     telemetry.span("stage", stage.name or f"stage{i}",
-                                   stage=i, mb=mb):
+                                   stage=i, mb=mb, rid=rid):
                 data = stage(data)
             if edge_bytes is not None and i < last:
                 edge_bytes.append(payload_wire_bytes(data))
         return _undequantized_guard(data)
 
-    def run(self, ubatches: Sequence[Any]) -> Tuple[List[Any], Dict[str, float]]:
-        """Stream all microbatches; returns (results, stats).
+    def run(self, ubatches: Sequence[Any],
+            traces: Optional[Sequence[telemetry.TraceContext]] = None
+            ) -> Tuple[List[Any], Dict[str, float]]:
+        """Stream all microbatches; returns (results, stats). `traces`
+        (optional, one per microbatch) request-tags each microbatch's
+        dispatch/retire spans.
 
         Stats mirror the reference's end-of-run measurement: latency =
         t(last result) - t(first enqueue); throughput = total items / latency
@@ -207,10 +214,12 @@ class HostPipeline:
         dispatch_s: List[float] = []  # per-mb host enqueue cost (t_fixed)
         for i, ubatch in enumerate(ubatches):
             edge_bytes: Optional[List[int]] = [] if track_edges else None
+            trace = traces[i] if traces is not None and i < len(traces) \
+                else None
             t_d0 = time.monotonic()
-            out = self.enqueue(ubatch, edge_bytes, mb=i)
+            out = self.enqueue(ubatch, edge_bytes, mb=i, trace=trace)
             dispatch_s.append(time.monotonic() - t_d0)
-            inflight.append((i, out, edge_bytes, t_d0))
+            inflight.append((i, out, edge_bytes, t_d0, trace))
             while inflight and payload_ready(inflight[0][1]):
                 self._retire(inflight.pop(0), results, retired, mb_latency_s)
             while len(inflight) >= self.max_inflight:
@@ -254,8 +263,9 @@ class HostPipeline:
 
     def _retire(self, item, results, retired: Optional[list] = None,
                 mb_latency_s: Optional[list] = None):
-        i, out, edge_bytes, t_enq = item
-        with telemetry.span("results", "retire", mb=i):
+        i, out, edge_bytes, t_enq, trace = item
+        with telemetry.span("results", "retire", mb=i,
+                            rid=trace.rid if trace is not None else None):
             out = jax.block_until_ready(out)
         now = time.monotonic()
         if retired is not None:

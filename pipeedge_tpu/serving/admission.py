@@ -215,6 +215,14 @@ class EDFQueue:
                 return True
         return False
 
+    def items(self) -> List[object]:
+        """Alive entries in deadline order (non-destructive): the
+        postmortem/debug view of who is waiting. O(n log n) over a small
+        bounded heap."""
+        return [e[2] for e in sorted(
+            (e for e in self._heap if e[3]),
+            key=lambda e: (e[0], e[1]))]
+
 
 class ServiceRateEstimator:
     """EWMA of the completion rate, and the Retry-After it implies.
@@ -291,16 +299,20 @@ SHED_REASONS = ("rate", "queue_full", "brownout", "expired", "shutdown")
 
 class _Ticket:
     __slots__ = ("request_class", "deadline", "t_enq", "event",
-                 "shed_reason", "granted")
+                 "shed_reason", "granted", "rid")
 
     def __init__(self, request_class: str, deadline: Optional[float],
-                 t_enq: float):
+                 t_enq: float, rid: Optional[str] = None):
         self.request_class = request_class
         self.deadline = deadline
         self.t_enq = t_enq
         self.event = threading.Event()
         self.shed_reason: Optional[str] = None
         self.granted = False
+        # request id (trace context): queue-wait spans and the admission
+        # snapshot in a postmortem bundle name WHO is waiting, not just
+        # how many (docs/OBSERVABILITY.md request tracing)
+        self.rid = rid
 
 
 class AdmissionController:
@@ -401,12 +413,14 @@ class AdmissionController:
 
     def admit(self, request_class: str = "interactive",
               deadline: Optional[float] = None,
-              now: Optional[float] = None) -> _Ticket:
+              now: Optional[float] = None,
+              rid: Optional[str] = None) -> _Ticket:
         """Block until granted an execution slot (EDF order) or shed.
-        `deadline` is ABSOLUTE monotonic time (see `deadline_for`)."""
+        `deadline` is ABSOLUTE monotonic time (see `deadline_for`);
+        `rid` request-tags the ticket for snapshots/postmortems."""
         now = time.monotonic() if now is None else now
         self.policy(request_class)          # KeyError -> caller's 400
-        ticket = _Ticket(request_class, deadline, now)
+        ticket = _Ticket(request_class, deadline, now, rid=rid)
         shed_waiter: Optional[_Ticket] = None
         with self._lock:
             if self._closed:
@@ -518,15 +532,20 @@ class AdmissionController:
             return self.concurrency - self._free
 
     def snapshot(self) -> dict:
-        """Best-effort state for /healthz's `serving` block."""
+        """Best-effort state for /healthz's `serving` block (and the
+        admission slice of a postmortem bundle: `waiting` names the
+        queued request ids in grant order)."""
         with self._lock:
             depth = len(self._queue)
             in_flight = self.concurrency - self._free
+            waiting = [{"rid": t.rid, "class": t.request_class}
+                       for t in self._queue.items()]
         rate = self.estimator.rate()
         return {"queue_depth": depth, "in_flight": in_flight,
                 "concurrency": self.concurrency,
                 "queue_capacity": self._queue.capacity,
                 "shed_classes": sorted(self._shed_classes),
+                "waiting": waiting,
                 "service_rate_rps": (None if rate is None
                                      else round(rate, 3)),
                 "shed_total": int(self.m_shed.total())}

@@ -6,9 +6,11 @@ which stage bubbled, which edge's wire time dominated, where a failover
 stalled the fleet. This subsystem is the missing correlation layer:
 
 - `SpanRecorder`: a fixed-size per-rank ring buffer of
-  `(category, name, rank, stage, mb, t_start_ns, t_end_ns)` records,
+  `(category, name, rank, stage, mb, t_start_ns, t_end_ns, rid)` records,
   `time.monotonic_ns()`-stamped, drop-oldest under pressure — a `record()`
-  NEVER blocks the hot send/dispatch threads it instruments.
+  NEVER blocks the hot send/dispatch threads it instruments. `rid` is the
+  request id of the span's `TraceContext` (request-scoped tracing), None
+  when untraced.
 - module-level `configure()` / `span()` / `record()`: the instrumentation
   surface. Recording is OFF by default; when off, `span()` returns a shared
   no-op context manager, so the hot-path cost of a disabled probe is one
@@ -34,6 +36,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -45,8 +48,12 @@ from ..utils.threads import make_lock
 ENV_SPAN_CAPACITY = "PIPEEDGE_SPAN_CAPACITY"
 DEFAULT_SPAN_CAPACITY = 32768
 
-# dict-record field order (also the ring tuple layout)
-_FIELDS = ("cat", "name", "rank", "stage", "mb", "t0", "t1")
+# dict-record field order (also the ring tuple layout). `rid` — the
+# request id of the trace context a span belongs to — sits LAST so the
+# wire codec stays compatible with pre-request-tracing rows: a 7-field
+# row decodes with rid absent (untraced), an 8-field row read by an old
+# decoder simply drops the tail (zip truncates).
+_FIELDS = ("cat", "name", "rank", "stage", "mb", "t0", "t1", "rid")
 
 # categories folded into the cumulative digest (sched/rebalance.py's
 # sensor): bounded name sets only — feed/results names embed microbatch
@@ -87,11 +94,17 @@ class SpanRecorder:
         self._lock = make_lock("telemetry.span_ring")
 
     def record(self, cat: str, name: str, t0: int, t1: int,
-               stage: Optional[int] = None, mb: Optional[int] = None) -> None:
+               stage: Optional[int] = None, mb: Optional[int] = None,
+               rid: Optional[str] = None) -> None:
+        if rid is None:
+            ctx = current_trace()
+            if ctx is not None:
+                rid = ctx.rid
         with self._lock:
             if len(self._ring) == self.capacity:
                 self.dropped += 1
-            self._ring.append((cat, name, self.rank, stage, mb, t0, t1))
+            self._ring.append((cat, name, self.rank, stage, mb, t0, t1,
+                               rid))
             if cat in DIGEST_CATEGORIES:
                 cell = self._digest.get((cat, name, stage))
                 if cell is None:
@@ -101,9 +114,10 @@ class SpanRecorder:
                     cell[1] += t1 - t0
 
     def span(self, cat: str, name: str, stage: Optional[int] = None,
-             mb: Optional[int] = None) -> "_Span":
+             mb: Optional[int] = None,
+             rid: Optional[str] = None) -> "_Span":
         """Context manager recording [enter, exit] as one span."""
-        return _Span(self, cat, name, stage, mb)
+        return _Span(self, cat, name, stage, mb, rid)
 
     def __len__(self) -> int:
         with self._lock:
@@ -134,14 +148,15 @@ class SpanRecorder:
 class _Span:
     """Live span: stamps monotonic_ns on enter/exit, records on exit."""
 
-    __slots__ = ("_rec", "_cat", "_name", "_stage", "_mb", "_t0")
+    __slots__ = ("_rec", "_cat", "_name", "_stage", "_mb", "_rid", "_t0")
 
-    def __init__(self, rec, cat, name, stage, mb):
+    def __init__(self, rec, cat, name, stage, mb, rid=None):
         self._rec = rec
         self._cat = cat
         self._name = name
         self._stage = stage
         self._mb = mb
+        self._rid = rid
 
     def __enter__(self):
         self._t0 = time.monotonic_ns()
@@ -149,7 +164,8 @@ class _Span:
 
     def __exit__(self, *exc):
         self._rec.record(self._cat, self._name, self._t0,
-                         time.monotonic_ns(), self._stage, self._mb)
+                         time.monotonic_ns(), self._stage, self._mb,
+                         rid=self._rid)
         return False
 
 
@@ -192,22 +208,126 @@ def enabled() -> bool:
 
 
 def span(cat: str, name: str, stage: Optional[int] = None,
-         mb: Optional[int] = None):
+         mb: Optional[int] = None, rid: Optional[str] = None):
     """Instrumentation probe: a recording span when configured, the shared
-    no-op otherwise. Safe on any thread."""
+    no-op otherwise. Safe on any thread. `rid` tags the span with a
+    request id; None picks up the calling thread's current trace context
+    (set_trace / trace_scope) at record time."""
     rec = _recorder
     if rec is None:
         return _NULL_SPAN
-    return _Span(rec, cat, name, stage, mb)
+    return _Span(rec, cat, name, stage, mb, rid)
 
 
 def record(cat: str, name: str, t0: int, t1: int,
-           stage: Optional[int] = None, mb: Optional[int] = None) -> None:
+           stage: Optional[int] = None, mb: Optional[int] = None,
+           rid: Optional[str] = None) -> None:
     """Record a pre-timed span (e.g. failover detection→recovery, whose
     endpoints live on different threads); no-op when disabled."""
     rec = _recorder
     if rec is not None:
-        rec.record(cat, name, t0, t1, stage=stage, mb=mb)
+        rec.record(cat, name, t0, t1, stage=stage, mb=mb, rid=rid)
+
+
+# -- request-scoped trace context (docs/OBSERVABILITY.md) ----------------
+
+class TraceContext:
+    """Compact per-request trace identity, threaded end-to-end: minted at
+    admission (tools/serve.py) or per microbatch at the data rank's feed
+    (runtime.py), carried through the executors, and across DCN frames
+    (`comm/dcn.py` `_MSG_TENSORS_TRACED`) so every rank's spans inherit
+    the request id fleet-wide.
+
+    Fields: `rid` (the request id — the correlation key every span
+    carries), `cls` (request class, docs/SERVING.md), `deadline_ms`
+    (remaining budget at mint time, forensic), `parent` (the minting
+    span/site, so a timeline names its origin)."""
+
+    __slots__ = ("rid", "cls", "deadline_ms", "parent")
+
+    def __init__(self, rid: str, cls: str = "interactive",
+                 deadline_ms: Optional[float] = None,
+                 parent: Optional[str] = None):
+        self.rid = str(rid)
+        self.cls = str(cls)
+        self.deadline_ms = (None if deadline_ms is None
+                            else float(deadline_ms))
+        self.parent = None if parent is None else str(parent)
+
+    def to_dict(self) -> dict:
+        d = {"rid": self.rid, "cls": self.cls}
+        if self.deadline_ms is not None:
+            d["deadline_ms"] = self.deadline_ms
+        if self.parent is not None:
+            d["parent"] = self.parent
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TraceContext":
+        return cls(d["rid"], d.get("cls", "interactive"),
+                   d.get("deadline_ms"), d.get("parent"))
+
+    def to_wire(self) -> np.ndarray:
+        """One uint8 ndarray (UTF-8 JSON) — the optional leading tensor a
+        traced DCN frame carries (comm/dcn.py)."""
+        blob = json.dumps(self.to_dict(), separators=(",", ":")).encode()
+        return np.frombuffer(blob, np.uint8)
+
+    @classmethod
+    def from_wire(cls, arr) -> Optional["TraceContext"]:
+        """Inverse of `to_wire`. Tolerant by contract: an empty,
+        truncated, or otherwise undecodable blob means UNTRACED (None),
+        never a dead reader thread — a frame without a valid context is
+        still a valid frame."""
+        try:
+            blob = bytes(np.asarray(arr, np.uint8))
+            if not blob:
+                return None
+            d = json.loads(blob)
+            if not isinstance(d, dict) or "rid" not in d:
+                return None
+            return cls.from_dict(d)
+        except Exception:  # noqa: BLE001 — any malformed blob = untraced
+            return None
+
+    def __repr__(self):
+        return (f"TraceContext(rid={self.rid!r}, cls={self.cls!r}, "
+                f"deadline_ms={self.deadline_ms}, parent={self.parent!r})")
+
+
+_TRACE_TLS = threading.local()
+
+
+def set_trace(ctx: Optional[TraceContext]) -> None:
+    """Set (or clear, with None) the calling thread's current trace
+    context: spans recorded on this thread without an explicit `rid`
+    inherit it."""
+    _TRACE_TLS.ctx = ctx
+
+
+def current_trace() -> Optional[TraceContext]:
+    return getattr(_TRACE_TLS, "ctx", None)
+
+
+class trace_scope:
+    """`with trace_scope(ctx):` — install `ctx` as the thread's current
+    trace context for the block, restoring the previous one on exit
+    (exception paths included). Reentrant; None is a valid ctx (an
+    explicitly-untraced block)."""
+
+    __slots__ = ("_ctx", "_prev")
+
+    def __init__(self, ctx: Optional[TraceContext]):
+        self._ctx = ctx
+
+    def __enter__(self):
+        self._prev = current_trace()
+        set_trace(self._ctx)
+        return self._ctx
+
+    def __exit__(self, *exc):
+        set_trace(self._prev)
+        return False
 
 
 # -- wire codec (DCN command-channel payloads are ndarrays only) ---------

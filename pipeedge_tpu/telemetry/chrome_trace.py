@@ -67,6 +67,10 @@ def build_trace(spans: Sequence[dict],
             args["stage"] = int(s["stage"])
         if s.get("mb") is not None:
             args["mb"] = int(s["mb"])
+        if s.get("rid") is not None:
+            # request id (trace context): the key trace_report --request
+            # correlates on, and a Perfetto-searchable arg
+            args["rid"] = str(s["rid"])
         ev = {"ph": "X", "pid": rank, "tid": _tid_for(cat), "cat": cat,
               "name": str(s["name"]), "ts": ts, "dur": dur, "args": args}
         events.append(ev)
@@ -130,6 +134,7 @@ def trace_to_spans(doc: dict) -> List[dict]:
                       "rank": int(ev.get("pid", 0)),
                       "stage": ev.get("args", {}).get("stage"),
                       "mb": ev.get("args", {}).get("mb"),
+                      "rid": ev.get("args", {}).get("rid"),
                       "t0": t0,
                       "t1": t0 + int(round(float(ev.get("dur", 0)) * 1e3))})
     return spans

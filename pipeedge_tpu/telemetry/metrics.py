@@ -17,6 +17,7 @@ without restarting the process in tests).
 """
 from __future__ import annotations
 
+import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from ..utils.threads import make_lock
@@ -127,16 +128,32 @@ class Gauge(_Instrument):
 class Histogram(_Instrument):
     kind = "histogram"
 
+    # horizon after which a retained exemplar is considered stale and any
+    # fresh observation replaces it (the "per bucket window" semantics:
+    # within a window the MAX-latency observation's trace id is kept)
+    DEFAULT_EXEMPLAR_WINDOW_S = 60.0
+
     def __init__(self, name: str, help_text: str,
-                 buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS):
+                 buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS,
+                 exemplar_window_s: Optional[float] = None):
         super().__init__(name, help_text)
         self.buckets = tuple(sorted(float(b) for b in buckets))
         if not self.buckets:
             raise ValueError("histogram needs at least one bucket bound")
         # label key -> (per-bucket counts, sum, count)
         self._series: Dict[Tuple, list] = {}
+        # label key -> bucket index -> [value, trace_id, t] — the trace id
+        # of the worst (max-value) observation in the current window, so a
+        # p99 spike on a dashboard links straight to the request trace
+        # that caused it (docs/OBSERVABILITY.md exemplar semantics). The
+        # index len(buckets) is the +Inf overflow bucket.
+        self.exemplar_window_s = (self.DEFAULT_EXEMPLAR_WINDOW_S
+                                  if exemplar_window_s is None
+                                  else float(exemplar_window_s))
+        self._exemplars: Dict[Tuple, Dict[int, list]] = {}
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None,
+                now: Optional[float] = None, **labels) -> None:
         key = self._key(labels)
         with self._lock:
             s = self._series.get(key)
@@ -145,12 +162,44 @@ class Histogram(_Instrument):
                 self._series[key] = s
             counts, _, _ = s
             # per-bucket (non-cumulative) storage; render() cumulates
+            idx = len(self.buckets)          # +Inf overflow by default
             for i, bound in enumerate(self.buckets):
                 if value <= bound:
                     counts[i] += 1
+                    idx = i
                     break
             s[1] += float(value)
             s[2] += 1
+            if exemplar is not None:
+                now = time.monotonic() if now is None else now
+                ex = self._exemplars.setdefault(key, {})
+                cur = ex.get(idx)
+                # retain the max-latency observation of the window; a
+                # stale (rolled-over) exemplar loses to ANY fresh one
+                if cur is None or value >= cur[0] \
+                        or now - cur[2] > self.exemplar_window_s:
+                    ex[idx] = [float(value), str(exemplar), now]
+
+    def exemplars(self, now: Optional[float] = None,
+                  **labels) -> Dict[str, dict]:
+        """Current (unexpired) exemplars for one label set:
+        `{le: {"value", "trace_id", "age_s"}}` with `le` the bucket's
+        upper bound as a string ("+Inf" for the overflow bucket). The
+        /healthz-facing view; /metrics renders the same data as
+        `# EXEMPLAR` comment lines."""
+        now = time.monotonic() if now is None else now
+        out: Dict[str, dict] = {}
+        with self._lock:
+            ex = self._exemplars.get(self._key(labels), {})
+            items = [(i, list(v)) for i, v in ex.items()]
+        for i, (value, trace_id, t) in sorted(items):
+            if now - t > self.exemplar_window_s:
+                continue
+            le = ("+Inf" if i >= len(self.buckets)
+                  else _fmt(self.buckets[i]))
+            out[le] = {"value": round(value, 6), "trace_id": trace_id,
+                       "age_s": round(max(0.0, now - t), 3)}
+        return out
 
     def count(self, **labels) -> int:
         with self._lock:
@@ -179,6 +228,7 @@ class Histogram(_Instrument):
             items = sorted((k, (list(s[0]), s[1], s[2]))
                            for k, s in self._series.items())
         lines = []
+        now = time.monotonic()
         for key, (counts, total, n) in items:
             cum = 0
             for bound, c in zip(self.buckets, counts):
@@ -189,6 +239,17 @@ class Histogram(_Instrument):
             lines.append(f"{self.name}_bucket{_label_str(lk)} {n}")
             lines.append(f"{self.name}_sum{_label_str(key)} {_fmt(total)}")
             lines.append(f"{self.name}_count{_label_str(key)} {n}")
+            # exemplars as COMMENT lines: the exposition stays valid
+            # Prometheus text format 0.0.4 (every parser skips '#' lines
+            # that are not HELP/TYPE), while the p99-spike -> trace-id
+            # link is still one grep away (OpenMetrics-shaped payload)
+            for le, ex in self.exemplars(
+                    now=now, **dict(key)).items():
+                lk = key + (("le", le),)
+                lines.append(
+                    f"# EXEMPLAR {self.name}_bucket{_label_str(lk)} "
+                    f'{{trace_id="{_escape(ex["trace_id"])}"}} '
+                    f"{_fmt(ex['value'])}")
         return lines
 
     def _key(self, labels: dict):

@@ -366,6 +366,27 @@ def analyze_spans(spans: Sequence[dict],
                          "max_level": max(levels) if levels else 0},
         }
 
+    # -- request dimension (request-scoped tracing) --------------------
+    # every rid-tagged span belongs to one request's causal timeline;
+    # the worst list is the "which request do I trace_report --request"
+    # entry point when no loadgen/504 artifact named one
+    rid_bounds: Dict[str, Tuple[int, int]] = {}
+    for s in spans:
+        rid = s.get("rid")
+        if rid is None:
+            continue
+        t0, t1 = int(s["t0"]), int(s["t1"])
+        cur = rid_bounds.get(rid)
+        rid_bounds[rid] = ((t0, t1) if cur is None
+                           else (min(cur[0], t0), max(cur[1], t1)))
+    worst = sorted(((t1 - t0) / 1e6, rid)
+                   for rid, (t0, t1) in rid_bounds.items())[-3:]
+    requests = {}
+    if rid_bounds:
+        requests = {"n": len(rid_bounds),
+                    "worst": [{"rid": rid, "ms": round(ms, 3)}
+                              for ms, rid in reversed(worst)]}
+
     if span_cost_ns is None:
         span_cost_ns = measure_span_cost_ns()
     overhead_pct = 100.0 * len(spans) * span_cost_ns / window_ns
@@ -383,9 +404,110 @@ def analyze_spans(spans: Sequence[dict],
         "collectives": collectives,
         "mb_latency": mb_latency,
         "serving": serving,
+        "requests": requests,
         "failover": failover,
         "rejoin": rejoin,
         "rebalance_events": rebalance_events,
         "span_cost_ns": round(span_cost_ns, 1),
         "span_overhead_pct": round(overhead_pct, 4),
+    }
+
+
+# -- request-scoped causal timeline (trace_report --request) -------------
+
+def _segment_key(s: dict) -> Optional[str]:
+    """Attribution bucket of one request-tagged span: the named slice of
+    the request's end-to-end time this span explains. None = an envelope
+    span (the whole-request wrapper) that must not compete with its own
+    parts for the dominant-stall title."""
+    cat = str(s.get("cat", ""))
+    name = str(s.get("name", ""))
+    stage = s.get("stage")
+    if cat == "serve":
+        if name.startswith("admit:"):
+            return "queue_wait"
+        if name.startswith("shed:"):
+            return "shed_wait"
+        return None                     # generate/speculative: envelope
+    if cat == "compute":
+        return f"stage{stage}/compute" if stage is not None else "compute"
+    if cat == "stage":
+        if name in ("dispatch", "readback", "emit"):
+            return (f"stage{stage}/{name}" if stage is not None
+                    else name)
+        # executor exec{i} / host-pipeline stage{i}: per-stage compute
+        return (f"stage{stage}/compute" if stage is not None
+                else f"{name}/compute")
+    if cat == "wire":
+        return f"wire/{name}"
+    if cat == "quant":
+        return f"stage{stage}/quant" if stage is not None else "quant"
+    if cat == "feed":
+        return "feed"
+    if cat == "results":
+        return "retire"
+    return None
+
+
+def request_timeline(spans: Sequence[dict], rid: str,
+                     max_events: int = 400) -> dict:
+    """One request's causal timeline from a merged span list: every span
+    tagged with `rid`, ordered, attributed to named segments (queue wait,
+    per-stage compute/dispatch/readback/emit, per-edge transfer, feed,
+    retire), with the DOMINANT STALL — the segment whose union-busy time
+    explains the largest share of the request's end-to-end window —
+    called out. The artifact that answers "why was THIS request slow"
+    (ISSUE 10 acceptance)."""
+    mine = [s for s in spans
+            if s.get("rid") == rid and s.get("t1") is not None]
+    if not mine:
+        return {"rid": rid, "found": False}
+    mine.sort(key=lambda s: (int(s["t0"]), int(s["t1"])))
+    t_lo = min(int(s["t0"]) for s in mine)
+    t_hi = max(int(s["t1"]) for s in mine)
+    total_ns = max(1, t_hi - t_lo)
+
+    seg_intervals: Dict[str, List[Tuple[int, int]]] = {}
+    all_intervals: List[Tuple[int, int]] = []
+    for s in mine:
+        key = _segment_key(s)
+        iv = (int(s["t0"]), int(s["t1"]))
+        if key is not None:
+            seg_intervals.setdefault(key, []).append(iv)
+            all_intervals.append(iv)
+    segments = {}
+    busy_by_key = {}
+    for key in sorted(seg_intervals):
+        busy_ns = _union_ns(seg_intervals[key])
+        busy_by_key[key] = busy_ns
+        segments[key] = {"n": len(seg_intervals[key]),
+                         "busy_ms": round(busy_ns / 1e6, 3),
+                         "share_pct": round(100.0 * busy_ns / total_ns, 3)}
+    dominant = None
+    if segments:
+        # rank on raw ns (rounded ms would tie sub-ms segments)
+        name = max(busy_by_key, key=busy_by_key.get)
+        dominant = {"segment": name, **segments[name]}
+    unattributed_ns = max(0, total_ns - _union_ns(all_intervals))
+
+    timeline = [{"t_ms": round((int(s["t0"]) - t_lo) / 1e6, 3),
+                 "dur_ms": round((int(s["t1"]) - int(s["t0"])) / 1e6, 3),
+                 "cat": s.get("cat"), "name": s.get("name"),
+                 "rank": s.get("rank"), "stage": s.get("stage"),
+                 "mb": s.get("mb")}
+                for s in mine[:max_events]]
+    return {
+        "rid": rid,
+        "found": True,
+        "spans": len(mine),
+        "ranks": sorted({int(s.get("rank", 0)) for s in mine}),
+        "stages": sorted({int(s["stage"]) for s in mine
+                          if s.get("stage") is not None}),
+        "mbs": sorted({int(s["mb"]) for s in mine
+                       if s.get("mb") is not None}),
+        "total_ms": round(total_ns / 1e6, 3),
+        "segments": segments,
+        "dominant_stall": dominant,
+        "unattributed_ms": round(unattributed_ns / 1e6, 3),
+        "timeline": timeline,
     }

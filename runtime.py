@@ -34,6 +34,7 @@ import numpy as np
 import monitoring
 from pipeedge_tpu import telemetry
 from pipeedge_tpu.comm import CMD_ADMIT, CMD_DEAD, CMD_SCHED, CMD_STOP
+from pipeedge_tpu.telemetry import flight
 from pipeedge_tpu.telemetry import metrics as prom
 from pipeedge_tpu.models import get_microbatch_size, registry
 from pipeedge_tpu.parallel import pipeline as host_pipeline
@@ -208,6 +209,7 @@ def _record_failover_detect(dead: int, failover: bool = True) -> None:
     `failover=False` (abort path) skips the failover-event counter."""
     now = time.monotonic_ns()
     telemetry.record("failover", "detect", now, now)
+    flight.note("peer_death", dead_rank=dead, failover=failover)
     _failover_detect_ns.append(now)
     if _heal_state["detect_ns"] is None:
         # anchor of the time-to-full-capacity clock: the FIRST detection
@@ -582,7 +584,13 @@ def run_pipeline_host(args, stage_layers, stage_quant, stage_ranks,
                 label_queue.put(lb)
         tik = time.monotonic()
         t_span0 = time.monotonic_ns()
-        _, stats = pipe.run(inputs)
+        # request-tagged dispatch/retire spans (single-controller
+        # analogue of the DCN feed's per-microbatch trace contexts)
+        traces = ([telemetry.TraceContext(f"r{rnd}.mb{i}", "batch",
+                                          parent="host.run")
+                   for i in range(len(inputs))]
+                  if telemetry.enabled() else None)
+        _, stats = pipe.run(inputs, traces=traces)
         tok = time.monotonic()
         # round track: mb ids restart each measure round; the segmenting
         # consumers (report/flows) key on these intervals
@@ -795,10 +803,42 @@ class _MicrobatchLedger:
         # belt-and-braces (a stale frame must NEVER ack a microbatch)
         self._epoch_floor: dict = {}
         self.stale_dropped = 0
+        # request <-> microbatch mapping (docs/OBSERVABILITY.md request
+        # tracing): the feed loop records each microbatch's trace/request
+        # id here, so a postmortem bundle and trace_report --request can
+        # resolve a request to its microbatches (and back) after the fact
+        self._traces: dict = {}
         self._lock = make_lock("runtime.ledger")
         self.done = threading.Event()
         if not self._ubatches:
             self.done.set()
+
+    def record_trace(self, mbid: int, rid: str) -> None:
+        """Bind microbatch `mbid` to request id `rid` (feed time)."""
+        with self._lock:
+            self._traces[int(mbid)] = str(rid)
+
+    def trace_of(self, mbid: int) -> Optional[str]:
+        with self._lock:
+            return self._traces.get(int(mbid))
+
+    def forensics(self) -> dict:
+        """The ledger slice of a failover postmortem bundle: progress,
+        the replay set, and the request ids in flight when it was taken
+        (ids only — payloads stay out of the bundle)."""
+        with self._lock:
+            pending = [i for i in range(self._frontier,
+                                        len(self._ubatches))
+                       if i not in self._acked]
+            return {"microbatches": len(self._ubatches),
+                    "acked": len(self._acked),
+                    "pending_mbids": pending,
+                    "frontier": self._frontier,
+                    "snapshots": self.snapshots,
+                    "stale_dropped": self.stale_dropped,
+                    "next_deliver": self._next_deliver,
+                    "traces": {str(k): v
+                               for k, v in sorted(self._traces.items())}}
 
     @property
     def acked_count(self) -> int:
@@ -1001,6 +1041,9 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
 
     rank, world_size = args.rank, args.worldsize
     _declare_fleet_metric_labels(world_size, rank)
+    # per-rank flight recorder: always-on event ring; postmortem bundles
+    # fire on failover (data rank) — one per cooldown window
+    flight.configure(rank=rank)
     data_rank = args.data_rank
     failover_mode = args.on_peer_death == "failover"
     addrs = dcn.parse_rank_addrs(args.dcn_addrs, world_size, args.port)
@@ -1248,6 +1291,21 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
                             fo_t0 = (_failover_detect_ns[0]
                                      if _failover_detect_ns
                                      else time.monotonic_ns())
+                        # failover postmortem bundle (flight recorder):
+                        # the ledger's replay set + request map and the
+                        # membership state at the moment the round failed
+                        # over — written before the re-plan mutates them
+                        with dead_lock:
+                            fo_dead = sorted(dead_ranks)
+                            fo_bench = sorted(benched_ranks)
+                        flight.note("failover", dead_ranks=fo_dead,
+                                    round=rnd)
+                        flight.maybe_dump("failover", context={
+                            "round": rnd,
+                            "dead_ranks": fo_dead,
+                            "benched_ranks": fo_bench,
+                            "ledger": (ledger.forensics()
+                                       if ledger is not None else None)})
                         # clear-then-snapshot, same ordering as above
                         failover_event.clear()
                         with dead_lock:
@@ -1794,11 +1852,14 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                     while not stop_event.is_set() \
                             and not ledger.done.is_set():
                         try:
-                            # meta variant: the producing incarnation's
+                            # traced variant: the producing incarnation's
                             # epoch keys the ledger's epoch-aware dedupe
                             # (stale incarnations are fenced at the
-                            # reader; this is the ledger's own guard)
-                            tensors, epoch = ctx.recv_tensors_meta(
+                            # reader; this is the ledger's own guard),
+                            # and the trace context the feed minted rides
+                            # the whole loop back — the retire span
+                            # closes the request's fleet-wide timeline
+                            tensors, epoch, tctx = ctx.recv_tensors_traced(
                                 last_rank, timeout=0.5,
                                 channel=dcn.CHANNEL_RESULTS + parity)
                         except queue.Empty:
@@ -1806,7 +1867,10 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                         except ConnectionError:
                             return
                         mbid = int(np.asarray(tensors[0]).reshape(-1)[0])
-                        with telemetry.span("results", "deliver", mb=mbid):
+                        rid = (tctx.rid if tctx is not None
+                               else ledger.trace_of(mbid))
+                        with telemetry.span("results", "deliver", mb=mbid,
+                                            rid=rid):
                             out = _wire_decode(tensors[1:], dtype)
                             # the ledger retains the DECODED result, not
                             # the wire views — and a pooled recv buffer
@@ -1828,14 +1892,15 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                     if stop_event.is_set():
                         return
                     try:
-                        tensors = ctx.recv_tensors(
+                        tensors, _, tctx = ctx.recv_tensors_traced(
                             last_rank, timeout=args.sched_timeout,
                             channel=dcn.CHANNEL_RESULTS + parity)
                     except (queue.Empty, ConnectionError):
                         # timeout, or the last stage died: the peer-death
                         # handler aborts the run; just stop consuming
                         return
-                    with telemetry.span("results", "deliver", mb=mbid):
+                    with telemetry.span("results", "deliver", mb=mbid,
+                                        rid=tctx.rid if tctx else None):
                         out = _wire_decode(tensors, dtype)
                         handle_results(np.asarray(out))
 
@@ -1848,6 +1913,23 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                 # the main thread must stay free to abort (peer death) and
                 # broadcast CMD_STOP. On send failure the transport's
                 # peer-death handler aborts the run; just stop feeding.
+                # request dimension of the batch world: each microbatch
+                # is a "request" with a fleet-unique id — the trace
+                # context rides every hop's frame, the ledger records the
+                # rid<->mbid mapping, and trace_report --request replays
+                # the admit(feed)->stages->retire timeline across ranks.
+                # Minted only when span recording is on: untraced rounds
+                # send byte-identical v2 frames.
+                def trace_for(mbid):
+                    if not telemetry.enabled():
+                        return None
+                    tctx = telemetry.TraceContext(
+                        f"r{rnd}.mb{mbid}", "batch",
+                        parent=f"feed.rank{rank}")
+                    if ledger is not None:
+                        ledger.record_trace(mbid, tctx.rid)
+                    return tctx
+
                 try:
                     if ledger is not None:
                         for mbid, u in feed_items:
@@ -1855,21 +1937,28 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                                     failover_event.is_set()
                                     and death_hits_schedule()):
                                 return
+                            tctx = trace_for(mbid)
                             with telemetry.span("feed", f"mb{mbid}",
-                                                mb=mbid):
+                                                mb=mbid,
+                                                rid=tctx.rid
+                                                if tctx else None):
                                 ctx.send_tensors(
                                     first_rank,
                                     [np.asarray(mbid, np.int64),
                                      np.asarray(u)],
-                                    channel=dcn.CHANNEL_FEED + parity)
+                                    channel=dcn.CHANNEL_FEED + parity,
+                                    trace=tctx)
                         return
                     for mbid, u in enumerate(ubatches):
                         if stop_event.is_set():
                             return
-                        with telemetry.span("feed", f"mb{mbid}", mb=mbid):
+                        tctx = trace_for(mbid)
+                        with telemetry.span("feed", f"mb{mbid}", mb=mbid,
+                                            rid=tctx.rid if tctx
+                                            else None):
                             ctx.send_tensors(first_rank, [np.asarray(u)],
                                              channel=dcn.CHANNEL_FEED
-                                             + parity)
+                                             + parity, trace=tctx)
                 except OSError as exc:
                     logger.error("feeding stage rank %d failed (%s)",
                                  first_rank, exc)

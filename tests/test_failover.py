@@ -250,7 +250,7 @@ def test_chaos_drop_and_delay_wrap(monkeypatch):
     sent = []
 
     class _Ctx:
-        def send_tensors(self, dst, tensors, channel=0):
+        def send_tensors(self, dst, tensors, channel=0, trace=None):
             sent.append((dst, channel))
 
     ctx = _Ctx()

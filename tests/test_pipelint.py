@@ -477,12 +477,42 @@ def probe(rec):
 """
 
 
+PL502_REQUEST_BAD = """
+from pipeedge_tpu import telemetry
+
+def run_stage(req, i):
+    # request-tagged span created outside `with`: the rid tag does not
+    # exempt it — an error path still leaks the begin stamp
+    s = telemetry.span("stage", f"exec{i}", stage=i, rid=str(req.rid))
+    s.__enter__()
+"""
+
+PL502_REQUEST_CLEAN = """
+from pipeedge_tpu import telemetry
+
+def run_stage(req, i, trace):
+    rid = trace.rid if trace is not None else None
+    with telemetry.span("stage", "dispatch", stage=i, mb=0, rid=rid):
+        pass
+    # cross-thread request pairs belong to record(), which is not a span
+    telemetry.record("serve", "admit:interactive", 0, 1, rid=rid)
+"""
+
+
 def test_pl502_fires(tmp_path):
     assert "PL502" in rule_ids(run_on(tmp_path, PL502_BAD))
 
 
+def test_pl502_fires_on_request_tagged_span(tmp_path):
+    assert "PL502" in rule_ids(run_on(tmp_path, PL502_REQUEST_BAD))
+
+
 def test_pl502_clean(tmp_path):
     assert "PL502" not in rule_ids(run_on(tmp_path, PL502_CLEAN))
+
+
+def test_pl502_clean_request_spans(tmp_path):
+    assert "PL502" not in rule_ids(run_on(tmp_path, PL502_REQUEST_CLEAN))
 
 
 # -- suppression + baseline ----------------------------------------------

@@ -697,14 +697,14 @@ def test_degraded_in_flight_request_replayed(solo_pipe):
         calls = []
         orig = svc._generate_once
 
-        def flaky(ids, new_tokens, on_token, kw):
+        def flaky(ids, new_tokens, on_token, kw, rid=None):
             if not calls:
                 calls.append(1)
                 # the stage dies under this request: the service degrades
                 # and the executor surfaces a transient failure
                 svc.enter_degraded(dead_rank=1, retry_after=5.0)
                 raise RuntimeError("stage died under this request")
-            return orig(ids, new_tokens, on_token, kw)
+            return orig(ids, new_tokens, on_token, kw, rid=rid)
 
         svc._generate_once = flaky
         recover = threading.Timer(0.5, svc.exit_degraded)

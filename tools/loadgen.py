@@ -112,6 +112,9 @@ def calibrate(url, seconds, new_tokens, prompt_len, timeout, seed=0):
     return n / dt
 
 
+WORST_N = 5      # per-class worst-latency request ids kept in the report
+
+
 class _Stats:
     """Per-class outcome/latency accumulator (one lock, short holds)."""
 
@@ -119,16 +122,31 @@ class _Stats:
         self._lock = make_lock("loadgen.stats")
         self.counts = {c: dict.fromkeys(OUTCOMES, 0) for c in classes}
         self.latencies = {c: [] for c in classes}     # ok + ok_late, ms
+        # per-class worst-N (latency_ms, rid) of served requests: the
+        # cross-reference from a bench run into trace_report --request
+        # and the flight recorder's postmortem bundles
+        self.worst = {c: [] for c in classes}
+        # request ids the server answered 504 (each one triggered a
+        # postmortem bundle server-side)
+        self.deadline_rids = []
         self.retry_after = []
         self.client_dropped = 0
         self.first_error = None
 
     def record(self, cls, outcome, latency_ms=None, retry_after=None,
-               error=None):
+               error=None, rid=None):
         with self._lock:
             self.counts[cls][outcome] += 1
             if latency_ms is not None:
                 self.latencies[cls].append(latency_ms)
+                if rid is not None:
+                    w = self.worst[cls]
+                    w.append((latency_ms, rid))
+                    w.sort(reverse=True)
+                    del w[WORST_N:]
+            if outcome == "deadline" and rid is not None \
+                    and len(self.deadline_rids) < WORST_N:
+                self.deadline_rids.append(rid)
             if retry_after is not None:
                 self.retry_after.append(retry_after)
             if error is not None and self.first_error is None:
@@ -161,15 +179,16 @@ def _one_request(url, cls, slo_ms, deadline_ms, new_tokens, prompt_len,
         stats.record(cls, "error", error=repr(exc))
         return
     ms = (time.monotonic() - t0) * 1e3
+    rid = resp.get("rid") if isinstance(resp, dict) else None
     if status == 200:
         outcome = "ok" if (slo_ms is None or ms <= slo_ms) else "ok_late"
-        stats.record(cls, outcome, latency_ms=ms)
+        stats.record(cls, outcome, latency_ms=ms, rid=rid)
     elif status == 503 and resp.get("shed"):
-        stats.record(cls, "shed", retry_after=retry_after)
+        stats.record(cls, "shed", retry_after=retry_after, rid=rid)
     elif status == 503 and resp.get("degraded"):
-        stats.record(cls, "degraded", retry_after=retry_after)
+        stats.record(cls, "degraded", retry_after=retry_after, rid=rid)
     elif status == 504 and resp.get("deadline_exceeded"):
-        stats.record(cls, "deadline")
+        stats.record(cls, "deadline", rid=rid)
     else:
         stats.record(cls, "error",
                      error=f"HTTP {status}: {resp.get('error', resp)!r}")
@@ -240,6 +259,11 @@ def run_load(url, duration_s, qps, mix=None, slo_ms=None,
             "goodput_rps": round(counts["ok"] / wall, 3),
             "latency_ms": {"p50": _percentile(lat, 50),
                            "p95": _percentile(lat, 95)},
+            # worst-N served requests BY ID: feed one to
+            # `trace_report --request` (or cross-reference it against
+            # the server's postmortem bundles) to explain the tail
+            "worst": [{"rid": rid, "ms": round(ms, 3)}
+                      for ms, rid in stats.worst[c]],
         }
         for k in OUTCOMES:
             report["totals"][k] += counts[k]
@@ -248,6 +272,9 @@ def run_load(url, duration_s, qps, mix=None, slo_ms=None,
         "n": len(ra), "min": min(ra) if ra else None,
         "max": max(ra) if ra else None,
         "distinct": len({round(v, 3) for v in ra})}
+    # 504'd request ids: each one triggered a deadline postmortem bundle
+    # server-side — the bench-to-bundle cross-reference
+    report["deadline_rids"] = stats.deadline_rids
     report["first_error"] = stats.first_error
     return report
 

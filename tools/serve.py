@@ -64,14 +64,28 @@ Endpoints (all JSON unless noted):
                               "rank": n} when capacity is restored — that
                               last form clears the window AND counts the
                               rank on pipeedge_serve_rejoined_ranks_total
+- POST /debug/dump {"rid"?: "q17"}
+                           -> {"path": ..., "written_total": n} — write a
+                              flight-recorder postmortem bundle NOW
+                              (docs/OBSERVABILITY.md): the event ring, a
+                              request-scoped span slice, and the
+                              admission/brownout state. Bundles are also
+                              written automatically on 504s, sheds,
+                              degraded windows, and SLO-breach brownout
+                              steps; /healthz's "flight" block names the
+                              latest bundle path.
 - POST /prefix   {"ids": [t0, t1, ...]}
                            -> {"prefix_id": "p0", "len": N}
 - POST /generate {"ids": [[...], ...] | [...], "new_tokens": N,
                   "temperature"?: f, "top_k"?: n, "seed"?: n,
                   "eos_token"?: n, "prefix_id"?: "p0",
                   "stream"?: true, "speculative"?: true}
-                           -> {"ids": [[prompt+continuation], ...]}
-                              (suffix+continuation when prefix_id given)
+                           -> {"ids": [[prompt+continuation], ...],
+                               "rid": "q17"}
+                              (suffix+continuation when prefix_id given;
+                              "rid" is the minted request id — the trace
+                              key for `trace_report --request`, also
+                              carried by 503/504 error bodies)
 
 With `"stream": true` the response is chunked `application/x-ndjson`:
 one line per decode step `{"step": i, "tokens": [[...]]}` as the token
@@ -120,8 +134,13 @@ from pipeedge_tpu.serving import (AdmissionController,  # noqa: E402
                                   DeadlineExceeded, REQUEST_CLASSES,
                                   Watermarks, default_policies,
                                   parse_class_map)
+from pipeedge_tpu.telemetry import flight  # noqa: E402
 from pipeedge_tpu.telemetry import metrics as prom  # noqa: E402
 from pipeedge_tpu.utils.threads import make_condition, make_lock  # noqa: E402
+
+# request outcomes the per-class counter tracks (the request-class x
+# outcome matrix — pre-declared at service construction, pipelint PL501)
+REQUEST_OUTCOMES = ("ok", "shed", "deadline", "degraded", "error")
 
 
 class ServiceDegraded(RuntimeError):
@@ -146,7 +165,8 @@ class _Service:
                  admission_enabled=True, queue_capacity=64,
                  class_rates=None, class_deadlines_s=None,
                  brownout_enabled=True, brownout_marks=None,
-                 clamp_new_tokens=16, governor_interval=0.25):
+                 clamp_new_tokens=16, governor_interval=0.25,
+                 postmortem_dir=None):
         from collections import OrderedDict, deque
 
         from pipeedge_tpu.parallel.batcher import (ContinuousBatcher,
@@ -173,6 +193,19 @@ class _Service:
         self.m_latency = prom.REGISTRY.histogram(
             "pipeedge_serve_request_latency_seconds",
             "end-to-end generate latency (request receipt -> result)")
+        # request-class x outcome matrix (the request-tracing plane's
+        # per-class view; full matrix renders from the first scrape)
+        self.m_class_outcome = prom.REGISTRY.counter(
+            "pipeedge_requests_by_class_total",
+            "generate requests by request class and outcome "
+            "(ok / shed / deadline / degraded / error)")
+        for cls in REQUEST_CLASSES:
+            for outcome in REQUEST_OUTCOMES:
+                self.m_class_outcome.declare(**{"class": cls,
+                                                "outcome": outcome})
+        # flight recorder (docs/OBSERVABILITY.md): always-on event ring +
+        # postmortem bundles on 504 / shed / failover / SLO breach
+        self.flight = flight.configure(rank=0, out_dir=postmortem_dir)
         self.m_degraded = prom.REGISTRY.counter(
             "pipeedge_serve_degraded_entered_total",
             "failover windows opened via POST /degraded")
@@ -341,6 +374,13 @@ class _Service:
             if level != last_level:
                 t = time.monotonic_ns()
                 telemetry.record("serve", f"brownout:{level}", t, t)
+                self.flight.note("brownout", level=level,
+                                 queue_depth=depth, p95_s=p95)
+                if level >= 2 and level > last_level:
+                    # stepping INTO the clamp/shed rungs is the SLO-breach
+                    # trigger: capture the state that drove the ladder up
+                    self.flight.maybe_dump("slo",
+                                           context=self.bundle_context())
                 last_level = level
 
     # -- failover window ------------------------------------------------
@@ -373,6 +413,11 @@ class _Service:
         self.m_degraded.inc()
         if dead_rank is not None:
             self.m_last_dead.set(int(dead_rank))
+        # failover IS a flight-recorder trigger: the bundle carries the
+        # brownout/admission state at the moment the window opened
+        self.flight.note("degraded", dead_rank=dead_rank,
+                         retry_after=retry_after)
+        self.flight.maybe_dump("failover", context=self.bundle_context())
 
     def mark_healing(self):
         """The dead rank rejoined and the orchestrator is restoring the
@@ -401,6 +446,7 @@ class _Service:
             self.degraded_info = None
             self.cond.notify_all()
         self._recovered.set()     # wake replay waiters immediately
+        self.flight.note("degraded_closed", healed=healed, rank=rank)
         if healed and was_open:
             # unlabeled on purpose: healthz stats() reads the same series
             # back (value() is per-label-set); the healed rank stays
@@ -445,12 +491,24 @@ class _Service:
         draft/verify path."""
         return self.brownout is None or self.brownout.allow_speculative()
 
-    def admit(self, request_class: str, deadline_s=None):
+    def mint_rid(self) -> str:
+        """Mint one request id — THE request identity every span, flight
+        event, response body, and postmortem bundle correlates on
+        (docs/OBSERVABILITY.md request tracing). The trace CONTEXT is
+        built where the class/deadline are known (generate paths)."""
+        with self.cond:
+            n = self._next_rid
+            self._next_rid += 1
+        return f"q{n}"
+
+    def admit(self, request_class: str, deadline_s=None, rid=None):
         """Acquire an admission ticket (blocking, EDF order) + its
         absolute deadline. Returns (ticket, deadline); raises
         `AdmissionShed` (503 + dynamic Retry-After) on shed, KeyError on
         an unknown class (the handler's 400). The caller must hand the
-        ticket to `generate(..., ticket=...)`, which releases it."""
+        ticket to `generate(..., ticket=...)`, which releases it. `rid`
+        request-tags the queue-wait span, the ticket, and the flight
+        events, so a trace/bundle names WHO waited and who was shed."""
         if self.admission is None:
             deadline = (None if deadline_s is None
                         else time.monotonic() + float(deadline_s))
@@ -462,15 +520,53 @@ class _Service:
         # under its `shed:` span instead of skewing that stat
         t0 = time.monotonic_ns()
         try:
-            ticket = self.admission.admit(request_class, deadline)
+            ticket = self.admission.admit(request_class, deadline, rid=rid)
         except AdmissionShed as exc:
             telemetry.record(
                 "serve", f"shed:{exc.request_class}:{exc.reason}",
-                t0, time.monotonic_ns())
+                t0, time.monotonic_ns(), rid=rid)
+            self.flight.note("shed", rid=rid, cls=exc.request_class,
+                             reason=exc.reason,
+                             retry_after=exc.retry_after)
+            # gate BEFORE assembling the context: a shed storm must not
+            # pay a full serving snapshot per cooldown-suppressed dump
+            if self.flight.would_dump("shed"):
+                self.flight.maybe_dump("shed", rid=rid,
+                                       context=self.bundle_context())
             raise
         telemetry.record("serve", f"admit:{request_class}",
-                         t0, time.monotonic_ns())
+                         t0, time.monotonic_ns(), rid=rid)
+        self.flight.note("admit", rid=rid, cls=request_class,
+                         wait_ms=round((time.monotonic_ns() - t0) / 1e6, 3))
         return ticket, deadline
+
+    def bundle_context(self) -> dict:
+        """The serving-state slice every postmortem bundle carries:
+        admission + brownout snapshots, the degraded window, and the
+        executor stats — what was true of the service when the trigger
+        fired."""
+        ctx = {"serving": self.serving_stats(), "stats": self.stats()}
+        deg = self.degraded_info
+        if deg is not None:
+            ctx["degraded"] = {"dead_rank": deg["dead_rank"],
+                               "phase": deg.get("phase"),
+                               "since_s": round(time.monotonic()
+                                                - deg["since"], 3)}
+        ctx["latency_exemplars"] = self.m_latency.exemplars()
+        return ctx
+
+    def dump_postmortem(self, rid=None, trigger="manual"):
+        """POST /debug/dump's implementation: write a bundle NOW (manual
+        dumps bypass the cooldown). Returns the bundle path."""
+        return self.flight.maybe_dump(trigger, rid=rid,
+                                      context=self.bundle_context())
+
+    def flight_stats(self) -> dict:
+        """The /healthz `flight` block — shared with /metrics through the
+        same counter family (pipeedge_postmortems_written_total)."""
+        return {"postmortems_written_total": self.flight.written_total(),
+                "last_postmortem": self.flight.last_path(),
+                "events_dropped": self.flight.dropped}
 
     def retry_after_hint(self) -> float:
         """Best current 'come back in N seconds' estimate — the value
@@ -494,7 +590,7 @@ class _Service:
 
     def generate_speculative(self, ids, new_tokens, prefix_id=None,
                              request_class="interactive",
-                             deadline_s=None, ticket=None):
+                             deadline_s=None, ticket=None, rid=None):
         """Greedy speculative decoding (token-identical to plain greedy;
         the draft only changes the dispatch count). Holds only the
         dedicated spec lock during the generation — concurrent plain
@@ -503,14 +599,21 @@ class _Service:
         speculative loop itself has no mid-flight cancel boundary —
         docs/SERVING.md)."""
         t0 = time.monotonic()
+        if rid is None:
+            rid = self.mint_rid()
+        tctx = telemetry.TraceContext(rid, request_class,
+                                      deadline_ms=None if deadline_s is None
+                                      else deadline_s * 1e3,
+                                      parent="serve.speculative")
         released = self.admission is None
         try:
             if ticket is None and self.admission is not None:
-                ticket, _ = self.admit(request_class, deadline_s)
+                ticket, _ = self.admit(request_class, deadline_s, rid=rid)
             completed = False
             try:
-                out = self._generate_speculative_once(ids, new_tokens,
-                                                      prefix_id)
+                with telemetry.trace_scope(tctx):
+                    out = self._generate_speculative_once(ids, new_tokens,
+                                                          prefix_id)
                 completed = True
             finally:
                 if not released:
@@ -521,17 +624,25 @@ class _Service:
         except AdmissionShed:
             self.m_requests.inc(endpoint="/generate-speculative",
                                 status="503")
+            self.m_class_outcome.inc(**{"class": request_class,
+                                        "outcome": "shed"})
             raise
         except ServiceDegraded:
             self.m_requests.inc(endpoint="/generate-speculative",
                                 status="503")
+            self.m_class_outcome.inc(**{"class": request_class,
+                                        "outcome": "degraded"})
             raise
         except BaseException:
             self.m_requests.inc(endpoint="/generate-speculative",
                                 status="error")
+            self.m_class_outcome.inc(**{"class": request_class,
+                                        "outcome": "error"})
             raise
-        self.m_latency.observe(time.monotonic() - t0)
+        self.m_latency.observe(time.monotonic() - t0, exemplar=rid)
         self.m_requests.inc(endpoint="/generate-speculative", status="200")
+        self.m_class_outcome.inc(**{"class": request_class,
+                                    "outcome": "ok"})
         self.m_tokens.inc(len(ids) * int(new_tokens))
         self._account_edge_bytes(ids, int(new_tokens))
         return out
@@ -586,22 +697,31 @@ class _Service:
 
     def generate(self, ids, new_tokens, on_token=None,
                  request_class="interactive", deadline_s=None,
-                 ticket=None, deadline=None, **kw):
+                 ticket=None, deadline=None, rid=None, **kw):
         """One admitted generation. `request_class`/`deadline_s` drive
         the admission plane; a pre-admitted `ticket` (+ its absolute
         `deadline`) comes from the streaming path, which must shed
         BEFORE the chunked headers commit. The deadline rides into the
         executor, whose decode-step expiry check fires the request's
         `cancel` flag — a mid-flight expiry surfaces as
-        `DeadlineExceeded` (HTTP 504)."""
+        `DeadlineExceeded` (HTTP 504). `rid` is the minted request id
+        (mint_rid); every span, flight event, and the executor's
+        per-stage spans carry it."""
         t0 = time.monotonic()
+        if rid is None:
+            rid = self.mint_rid()
+        tctx = telemetry.TraceContext(rid, request_class,
+                                      deadline_ms=None if deadline_s is None
+                                      else deadline_s * 1e3,
+                                      parent="serve.generate")
         completed = False
         try:
             if ticket is None and deadline is None:
                 # the streaming path pre-admits (its ticket, or with
                 # --no-admission just the computed deadline) — don't
                 # clobber a deadline that arrives without a ticket
-                ticket, deadline = self.admit(request_class, deadline_s)
+                ticket, deadline = self.admit(request_class, deadline_s,
+                                              rid=rid)
             try:
                 if self.brownout is not None:
                     new_tokens = self.brownout.clamp(new_tokens)
@@ -611,9 +731,10 @@ class _Service:
                         cancel = threading.Event()
                         kw["cancel"] = cancel
                     kw["deadline"] = deadline
-                with telemetry.span("serve", "generate"):
+                with telemetry.trace_scope(tctx), \
+                        telemetry.span("serve", "generate", rid=rid):
                     out = self._generate_policied(ids, new_tokens,
-                                                  on_token, kw)
+                                                  on_token, kw, rid=rid)
                 now = time.monotonic()
                 if (deadline is not None and now >= deadline
                         and cancel.is_set()):
@@ -632,29 +753,54 @@ class _Service:
                     self.admission.release(ticket, completed=completed)
         except AdmissionShed:
             self.m_requests.inc(endpoint="/generate", status="503")
+            self.m_class_outcome.inc(**{"class": request_class,
+                                        "outcome": "shed"})
             raise
         except DeadlineExceeded:
             self.m_deadline.inc()
             self.m_requests.inc(endpoint="/generate", status="504")
+            self.m_class_outcome.inc(**{"class": request_class,
+                                        "outcome": "deadline"})
+            # a 504 is exactly the artifact the flight recorder exists
+            # for: which stage/queue/brownout rung ate the budget
+            self.flight.note("deadline", rid=rid, cls=request_class,
+                             budget_s=deadline_s,
+                             elapsed_ms=round((time.monotonic() - t0) * 1e3,
+                                              3))
+            self.flight.maybe_dump("deadline", rid=rid,
+                                   context=self.bundle_context())
             raise
         except ServiceDegraded:
             self.m_requests.inc(endpoint="/generate", status="503")
+            self.m_class_outcome.inc(**{"class": request_class,
+                                        "outcome": "degraded"})
             raise
         except BaseException:
             self.m_requests.inc(endpoint="/generate", status="error")
+            self.m_class_outcome.inc(**{"class": request_class,
+                                        "outcome": "error"})
             raise
-        self.m_latency.observe(time.monotonic() - t0)
+        elapsed = time.monotonic() - t0
+        # the exemplar links a latency-histogram bucket back to THIS
+        # request's trace id: a p99 spike on a dashboard resolves to a
+        # trace_report --request invocation (docs/OBSERVABILITY.md)
+        self.m_latency.observe(elapsed, exemplar=rid)
         self.m_requests.inc(endpoint="/generate", status="200")
+        self.m_class_outcome.inc(**{"class": request_class,
+                                    "outcome": "ok"})
         self.m_tokens.inc(len(ids) * int(new_tokens))
         self._account_edge_bytes(ids, int(new_tokens))
+        self.flight.note("done", rid=rid, cls=request_class,
+                         ms=round(elapsed * 1e3, 3))
         return out
 
-    def _generate_policied(self, ids, new_tokens, on_token, kw):
+    def _generate_policied(self, ids, new_tokens, on_token, kw, rid=None):
         with self.cond:
             self._check_dead()
             self._check_admittable()   # degraded: 503 + Retry-After
         try:
-            return self._generate_once(ids, new_tokens, on_token, kw)
+            return self._generate_once(ids, new_tokens, on_token, kw,
+                                       rid=rid)
         except ServiceDegraded:
             raise
         except RuntimeError:
@@ -665,7 +811,14 @@ class _Service:
             if on_token is not None or not self._await_recovery():
                 raise
             self.m_replays.inc()
-            return self._generate_once(ids, new_tokens, on_token, kw)
+            self.flight.note("replay", rid=rid)
+            # derived executor id: the failed attempt may still hold the
+            # original rid in the executor's live set, and the replay's
+            # spans should be distinguishable from the first try's while
+            # staying greppable by prefix
+            return self._generate_once(ids, new_tokens, on_token, kw,
+                                       rid=None if rid is None
+                                       else f"{rid}.replay")
 
     def _account_edge_bytes(self, ids, new_tokens: int) -> None:
         """Per-edge activation traffic of one completed request: every
@@ -682,20 +835,21 @@ class _Service:
         for i in range(n_edges):
             self.m_edge_bytes.inc(per_edge, edge=f"{i}->{i + 1}")
 
-    def _generate_once(self, ids, new_tokens, on_token, kw):
+    def _generate_once(self, ids, new_tokens, on_token, kw, rid=None):
+        # the trace rid doubles as the EXECUTOR request id: the mapping
+        # between an HTTP request and its executor lifecycle is identity,
+        # and the executors' per-stage spans tag it for free (_run_stage)
+        if rid is None:
+            rid = self.mint_rid()
         if self.exec is not None:
             with self.cond:
                 self._check_dead()
                 self._resolve_prefix(kw)
-                rid = self._next_rid
-                self._next_rid += 1
             self.exec.submit(rid, ids, new_tokens, on_token=on_token, **kw)
             return self.exec.wait(rid)
         with self.cond:
             self._check_dead()
             self._resolve_prefix(kw)
-            rid = self._next_rid
-            self._next_rid += 1
             self.batcher.submit(rid, ids, new_tokens, on_token=on_token,
                                 **kw)
             self.cond.notify_all()
@@ -760,7 +914,8 @@ def make_handler(service, model_name):
             self.wfile.flush()
 
         def _stream_generate(self, ids, new_tokens, kw,
-                             request_class="interactive", deadline_s=None):
+                             request_class="interactive", deadline_s=None,
+                             rid=None):
             """Chunked x-ndjson response: one line per decode step as the
             token lands, then the authoritative final line. The worker
             pushes DEVICE token arrays into a queue; the readback (the
@@ -782,11 +937,24 @@ def make_handler(service, model_name):
             # whose body is an error line. After this point failures
             # surface as a terminal {"error": ...} stream line.
             kw = service.prevalidate(ids, new_tokens, kw)
-            ticket, deadline = service.admit(request_class, deadline_s)
+            if rid is None:
+                rid = service.mint_rid()
+            try:
+                ticket, deadline = service.admit(request_class, deadline_s,
+                                                 rid=rid)
+            except AdmissionShed:
+                # the non-streaming path counts its shed inside
+                # generate(); a streaming shed never reaches generate(),
+                # so both counters are settled here — the class x outcome
+                # matrix must reconcile against the 503s either way
+                service.m_requests.inc(endpoint="/generate", status="503")
+                service.m_class_outcome.inc(**{"class": request_class,
+                                               "outcome": "shed"})
+                raise
             try:
                 cancel = threading.Event()
                 kw.update(cancel=cancel, request_class=request_class,
-                          ticket=ticket, deadline=deadline)
+                          ticket=ticket, deadline=deadline, rid=rid)
                 q = queue_mod.Queue()
                 worker = threading.Thread(
                     target=self._run_generate,
@@ -806,10 +974,11 @@ def make_handler(service, model_name):
             while True:
                 kind, payload = q.get()
                 if kind in ("error", "result"):
-                    final = ({"error": str(payload)} if kind == "error"
+                    final = ({"error": str(payload), "rid": rid}
+                             if kind == "error"
                              else {"ids": payload.tolist(),
                                    "first_token_ms": first_ms,
-                                   "steps": steps})
+                                   "steps": steps, "rid": rid})
                     if not cancel.is_set():
                         try:
                             self._chunk(final)
@@ -881,15 +1050,24 @@ def make_handler(service, model_name):
                             "executor": service.executor,
                             "degraded": degraded,
                             "serving": service.serving_stats(),
+                            "flight": service.flight_stats(),
                             "stats": service.stats()})
             else:
                 self._send(404, {"error": "unknown path"})
 
         def do_POST(self):
+            rid = None       # minted for /generate; names error bodies too
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n) or b"{}")
-                if self.path == "/degraded":
+                if self.path == "/debug/dump":
+                    # on-demand postmortem bundle (manual trigger — never
+                    # cooldown-suppressed): optionally scoped to one rid
+                    path = service.dump_postmortem(rid=req.get("rid"))
+                    self._send(200, {"path": path,
+                                     "written_total":
+                                     service.flight.written_total()})
+                elif self.path == "/degraded":
                     # the failover orchestrator's switch (see module doc):
                     # degraded -> healing -> healed lifecycle
                     if req.get("degraded", True):
@@ -929,6 +1107,11 @@ def make_handler(service, model_name):
                         deadline_s = float(req["deadline_ms"]) / 1e3
                         if deadline_s <= 0:
                             raise ValueError("deadline_ms must be > 0")
+                    # mint the request id HERE, before any admission
+                    # decision: every outcome (200/503/504) names the
+                    # same rid, so a loadgen worst-N entry or a 504 body
+                    # cross-references the trace and postmortem bundles
+                    rid = service.mint_rid()
                     if req.get("speculative"):
                         if req.get("temperature") or req.get("top_k") \
                                 or req.get("eos_token") is not None \
@@ -944,7 +1127,7 @@ def make_handler(service, model_name):
                             out = service.generate(
                                 ids, int(req["new_tokens"]),
                                 request_class=request_class,
-                                deadline_s=deadline_s,
+                                deadline_s=deadline_s, rid=rid,
                                 temperature=0.0, top_k=0, seed=0,
                                 eos_token=None,
                                 prefix_id=req.get("prefix_id"))
@@ -953,8 +1136,8 @@ def make_handler(service, model_name):
                                 ids, int(req["new_tokens"]),
                                 prefix_id=req.get("prefix_id"),
                                 request_class=request_class,
-                                deadline_s=deadline_s)
-                        self._send(200, {"ids": out.tolist()})
+                                deadline_s=deadline_s, rid=rid)
+                        self._send(200, {"ids": out.tolist(), "rid": rid})
                     else:
                         kw = dict(
                             temperature=float(req.get("temperature", 0.0)),
@@ -965,13 +1148,14 @@ def make_handler(service, model_name):
                         if req.get("stream"):
                             self._stream_generate(
                                 ids, int(req["new_tokens"]), kw,
-                                request_class, deadline_s)
+                                request_class, deadline_s, rid=rid)
                         else:
                             out = service.generate(
                                 ids, int(req["new_tokens"]),
                                 request_class=request_class,
-                                deadline_s=deadline_s, **kw)
-                            self._send(200, {"ids": out.tolist()})
+                                deadline_s=deadline_s, rid=rid, **kw)
+                            self._send(200, {"ids": out.tolist(),
+                                             "rid": rid})
                 else:
                     self._send(404, {"error": "unknown path"})
             except (KeyError, ValueError, TypeError, IndexError) as exc:
@@ -982,22 +1166,24 @@ def make_handler(service, model_name):
                 # would join has drained"), not a constant
                 self._send(503, {"error": str(exc), "shed": True,
                                  "class": exc.request_class,
-                                 "reason": exc.reason},
+                                 "reason": exc.reason, "rid": rid},
                            headers=(("Retry-After",
                                      f"{exc.retry_after:g}"),))
             except DeadlineExceeded as exc:
                 # the deadline expired while EXECUTING: the executor
                 # cancelled it at a decode-step boundary (no Retry-After —
-                # re-sending the same budget would expire the same way)
+                # re-sending the same budget would expire the same way).
+                # The rid cross-references the postmortem bundle this 504
+                # just triggered (flight recorder).
                 self._send(504, {"error": str(exc),
                                  "deadline_exceeded": True,
-                                 "class": exc.request_class})
+                                 "class": exc.request_class, "rid": rid})
             except ServiceDegraded as exc:
                 # a degraded window is transient by contract: tell the
                 # client exactly when to come back instead of hanging it
                 self._send(503, {"error": str(exc),
                                  "degraded": True,
-                                 "dead_rank": exc.dead_rank},
+                                 "dead_rank": exc.dead_rank, "rid": rid},
                            headers=(("Retry-After",
                                      f"{exc.retry_after:g}"),))
             except RuntimeError as exc:
@@ -1017,6 +1203,36 @@ def _parse_class_map(pairs, what, parser):
     except ValueError as exc:
         parser.error(str(exc))
     return out or None
+
+
+def _inject_stall(pipe, spec, parser):
+    """`--inject-stall STAGE:MS` — wrap every callable of one pipeline
+    stage with a fixed sleep. A deterministic, attributable stall for the
+    traced-serve smoke: it lands INSIDE that stage's `exec{i}` span, so
+    `trace_report --request` must name exactly this stage as the
+    dominant stall (the acceptance gate)."""
+    import functools
+    try:
+        stage_s, ms_s = spec.split(":", 1)
+        idx, delay_s = int(stage_s), float(ms_s) / 1e3
+        st = pipe.stages[idx]
+    except (ValueError, IndexError):
+        parser.error(f"--inject-stall expects STAGE:MS with STAGE < "
+                     f"{len(pipe.stages)}, got {spec!r}")
+        return
+
+    def slow(fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            time.sleep(delay_s)
+            return fn(*a, **kw)
+        return wrapper
+
+    for key, fn in list(st.items()):
+        if callable(fn):
+            st[key] = slow(fn)
+    print(f"chaos: injecting {ms_s}ms stall into every step of stage "
+          f"{idx}", flush=True)
 
 
 def main():
@@ -1079,6 +1295,16 @@ def main():
                    help="record request/stage spans and write a Perfetto-"
                         "loadable trace JSON to OUT on shutdown "
                         "(tools/trace_report.py analyzes it)")
+    p.add_argument("--postmortem-dir", default=None, metavar="DIR",
+                   help="directory for flight-recorder postmortem bundles "
+                        "(default: env PIPEEDGE_POSTMORTEM_DIR or "
+                        "./postmortems); bundles are written on 504s, "
+                        "sheds, failover, SLO breach, and POST /debug/dump")
+    p.add_argument("--inject-stall", default=None, metavar="STAGE:MS",
+                   help="chaos hook (tests/CI only): sleep MS ms inside "
+                        "every step of pipeline stage STAGE — the "
+                        "deterministic stall the traced-serve smoke "
+                        "asserts trace_report --request can name")
     args = p.parse_args()
 
     from pipeedge_tpu.utils import apply_env_platform
@@ -1095,6 +1321,8 @@ def main():
     pipe = build_decode_pipeline(
         args.model_name, partition, max_len=args.max_len, dtype=dtype,
         cache_bits=args.kv_bits, attend_floor=args.attend_floor)
+    if args.inject_stall:
+        _inject_stall(pipe, args.inject_stall, p)
     spec = None
     if args.draft_model:
         if args.kv_bits:
@@ -1135,7 +1363,8 @@ def main():
                            dwell_up_s=args.brownout_dwell_up,
                            dwell_down_s=args.brownout_dwell_down),
                        clamp_new_tokens=args.brownout_clamp_tokens,
-                       governor_interval=args.governor_interval)
+                       governor_interval=args.governor_interval,
+                       postmortem_dir=args.postmortem_dir)
     server = ThreadingHTTPServer(("127.0.0.1", args.port),
                                  make_handler(service, args.model_name))
     print(f"serving {args.model_name} ({len(pipe.stages)} stages, "

@@ -20,15 +20,28 @@ chaos_dcn.py idiom — with:
 - `serving`: when the trace came from a `tools/serve.py --trace-spans`
   run — admitted request count, per-class admission-wait p50/p95, sheds
   by class and reason, brownout transitions + max rung (docs/SERVING.md)
+- `requests`: distinct traced request ids + the worst-N by end-to-end
+  duration — the entry point into `--request` when nothing else named one
 - `failover`: detection -> recovery breakdown when a failover happened
 - `span_overhead_pct`: the recorder's own measured hot-path tax (per-span
   cost measured live on this host x span count / window)
+
+With `--request RID` the tool instead renders ONE request's causal
+timeline (admit -> queue -> per-mb per-stage per-edge -> retire) with
+its dominant stall named — docs/OBSERVABILITY.md request tracing. The
+input may be a merged trace OR a flight-recorder postmortem bundle.
 
 Examples:
 
   # trace a loopback fleet, then report on it
   python runtime.py 0 2 -c dcn ... --trace-spans /tmp/trace.json
   python tools/trace_report.py /tmp/trace.json
+
+  # why was THIS request slow? (rid from a /generate response, a 504
+  # body, a loadgen worst-N entry, or the report's requests.worst)
+  python tools/trace_report.py /tmp/trace.json --request q17
+  python tools/trace_report.py postmortems/postmortem-r0-0000-deadline.json \
+      --request q17
 
   # machine-checkable gate (CI smoke): fail unless spans were recorded
   python tools/trace_report.py /tmp/trace.json --require-spans
@@ -76,10 +89,30 @@ def _emit_profiles(args, spans) -> None:
           f"stage(s) -> {args.emit_profiles}", file=sys.stderr)
 
 
+def _load_spans(path: str):
+    """Span dicts from either input shape: a merged Chrome-trace JSON
+    (`--trace-spans` output) or a flight-recorder postmortem bundle
+    (telemetry/flight.py — its `spans` slice is already span dicts)."""
+    with open(path, encoding="utf8") as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and doc.get("bundle") == "pipeedge-postmortem":
+        return list(doc.get("spans", ())), doc
+    return chrome_trace.trace_to_spans(doc), None
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("trace", help="merged trace JSON from --trace-spans "
-                                 "(Chrome trace-event format)")
+                                 "(Chrome trace-event format), or a "
+                                 "flight-recorder postmortem bundle")
+    p.add_argument("--request", metavar="RID", default=None,
+                   help="render ONE request's causal timeline (admit -> "
+                        "queue -> per-mb per-stage per-edge -> retire) "
+                        "with the dominant stall named, instead of the "
+                        "fleet report; RID comes from a /generate "
+                        "response, a loadgen worst-N entry, a 504 body, "
+                        "or the report's requests.worst list. Exit 3 "
+                        "when the trace holds no spans for RID.")
     p.add_argument("--require-spans", action="store_true",
                    help="exit nonzero when the trace holds no spans or "
                         "no bubble/latency fields (the CI smoke gate)")
@@ -108,9 +141,15 @@ def main() -> int:
     if args.emit_profiles and not (args.partition and args.model):
         p.error("--emit-profiles requires --partition and --model")
 
-    with open(args.trace, encoding="utf8") as f:
-        doc = json.load(f)
-    spans = chrome_trace.trace_to_spans(doc)
+    spans, bundle = _load_spans(args.trace)
+    if args.request is not None:
+        record = report.request_timeline(spans, args.request)
+        record["trace"] = args.trace
+        if bundle is not None:
+            record["bundle_trigger"] = bundle.get("trigger")
+        print(json.dumps(record, indent=2 if args.indent else None,
+                         sort_keys=True))
+        return 0 if record.get("found") else 3
     record = report.analyze_spans(spans)
     record["trace"] = args.trace
     print(json.dumps(record, indent=2 if args.indent else None,
