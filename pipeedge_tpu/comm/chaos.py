@@ -30,6 +30,21 @@ variable only in that rank's environment. Grammar (`;`-separated actions):
                     with grace 0 the fleet treats it as a death (and,
                     because the flapped rank keeps its epoch, its
                     post-fence frames are dropped as stale)
+    slow@K:MS       persistent gray degradation: MS ms added to every
+                    send from the K-th on, FOREVER — the throttled-TPU /
+                    degrading-NIC straggler the peer-health plane
+                    (docs/FAULT_TOLERANCE.md gray failures) must detect.
+                    `slow@K-J:MS` bounds it to sends K..J inclusive (the
+                    "chaos clears" case probation readmission needs)
+    jitter@K:MS     like slow, but the per-send delay is uniform random
+                    in [0, MS] — deterministic per process via
+                    DCN_CHAOS_SEED (default 0). `jitter@K-J:MS` bounds it
+    corrupt@K       flip one bit in the K-th send's largest payload
+                    tensor AFTER any frame checksum was computed
+                    (comm/dcn.py applies it below the integrity layer),
+                    so PIPEEDGE_WIRE_CRC verification sees genuine wire
+                    corruption; without CRC the garbage propagates —
+                    what the NaN guard exists to catch
 
 Counting is over `send_tensors` calls on the wrapped context (command and
 heartbeat frames are not counted — they are the recovery machinery under
@@ -49,15 +64,21 @@ from typing import List, Optional
 from ..utils.threads import make_lock
 
 ENV_CHAOS = "DCN_CHAOS"
+ENV_CHAOS_SEED = "DCN_CHAOS_SEED"   # jitter determinism (default 0)
 
 logger = logging.getLogger(__name__)
 
 
 @dataclass
 class ChaosAction:
-    kind: str            # kill | hang | drop | delay | restart | flap
+    kind: str            # kill | hang | drop | delay | restart | flap |
+    # slow | jitter | corrupt
     at_send: int         # 1-based send index the action arms at
     delay_ms: float = 0.0
+    until_send: Optional[int] = None   # slow/jitter: last affected send
+    # (inclusive); None = the degradation persists forever
+    fired: bool = False  # slow/jitter: arming logged (harnesses stamp
+    # the fault instant off that one log line, like kill/hang/drop do)
 
 
 @dataclass
@@ -78,7 +99,13 @@ class ChaosSpec:
                     at, _, ms = where.partition(":")
                     actions.append(ChaosAction(kind, int(at),
                                                delay_ms=float(ms or 0)))
-                elif kind in ("kill", "hang", "drop"):
+                elif kind in ("slow", "jitter"):
+                    at, _, ms = where.partition(":")
+                    at, _, until = at.partition("-")
+                    actions.append(ChaosAction(
+                        kind, int(at), delay_ms=float(ms or 0),
+                        until_send=int(until) if until else None))
+                elif kind in ("kill", "hang", "drop", "corrupt"):
                     actions.append(ChaosAction(kind, int(where)))
                 else:
                     raise ValueError(f"unknown chaos action {kind!r}")
@@ -86,7 +113,8 @@ class ChaosSpec:
                 raise ValueError(
                     f"bad {ENV_CHAOS} clause {part!r}: {exc} (grammar: "
                     "kill@K | hang@K | drop@K | delay@K:MS | "
-                    "restart@K:MS | flap@K:MS)") from None
+                    "restart@K:MS | flap@K:MS | slow@K[-J]:MS | "
+                    "jitter@K[-J]:MS | corrupt@K)") from None
         return cls(actions)
 
 
@@ -101,6 +129,10 @@ class _ChaosSender:
         self._spec = spec
         self._lock = make_lock("chaos.sender")
         self._count = 0
+        # jitter determinism: one seeded stream per process (the spec is
+        # per-process, so replaying the same seed replays the delays)
+        import random
+        self._rng = random.Random(int(os.getenv(ENV_CHAOS_SEED, "0")))
 
     def __call__(self, dst, tensors, channel=0, trace=None):
         with self._lock:
@@ -109,7 +141,31 @@ class _ChaosSender:
         for act in self._spec.actions:
             if act.kind == "delay" and n >= act.at_send:
                 time.sleep(act.delay_ms / 1e3)
+            elif act.kind in ("slow", "jitter") and n >= act.at_send \
+                    and (act.until_send is None or n <= act.until_send):
+                if not act.fired:
+                    # one arming line at the FAULT instant (the
+                    # per-send sleeps are silent): what chaos_dcn.py
+                    # stamps fault-to-quarantine latency against
+                    act.fired = True
+                    logger.error("chaos: %s arming at send %d "
+                                 "(%.0f ms/send%s)", act.kind, n,
+                                 act.delay_ms,
+                                 "" if act.until_send is None
+                                 else f" through send {act.until_send}")
+                ms = (act.delay_ms if act.kind == "slow"
+                      else self._rng.uniform(0.0, act.delay_ms))
+                time.sleep(ms / 1e3)
             elif n == act.at_send:
+                if act.kind == "corrupt":
+                    # one-shot flag the transport consumes BELOW its
+                    # integrity layer (dcn._send_tensors_once): the bit
+                    # flips after any checksum was computed and after the
+                    # resend cache captured the clean frame — genuine
+                    # wire corruption, recoverable by a resend
+                    logger.error("chaos: corrupting send %d (one bit "
+                                 "flip)", n)
+                    self._ctx._corrupt_next_send = True
                 if act.kind == "kill":
                     logger.error("chaos: killing this process before "
                                  "send %d", n)

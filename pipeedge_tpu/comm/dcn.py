@@ -43,11 +43,13 @@ import struct
 import sys
 import threading
 import time
+from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from . import CMD_STOP, DistContext
+from . import wire as wire_codec
 from .. import telemetry
 from ..telemetry import metrics as prom
 from ..utils.threads import make_lock
@@ -120,6 +122,27 @@ _MSG_PATH_ACK = 12
 # byte-identical (absent = untraced), and an undecodable/truncated blob
 # degrades to untraced (counted), never to a dead reader.
 _MSG_TENSORS_TRACED = 13
+# heartbeat RTT echo (aux = the echoed beat sequence number): a beat that
+# carries a sequence-number payload is answered inline by the receiving
+# reader with this ack, so the beat sender can measure the command-plane
+# round trip per peer — the latency signal the gray-failure detector
+# (pipeedge_tpu/health/) consumes; beats without the payload (older
+# peers) simply go unanswered and keep their pure-liveness meaning.
+_MSG_HEARTBEAT_ACK = 14
+# frame-integrity recovery (aux = the corrupt frame's per-edge sequence
+# number, -1 = latest; payload = [channel int32]): the receiving READER
+# verifies CRC-flagged frames in flight and, on a checksum mismatch,
+# drops the frame and asks the producer to re-send it BY SEQUENCE
+# NUMBER — with PIPEEDGE_WIRE_CRC armed, data-frame headers carry a
+# per-(dst, channel) seq in the aux field, and the producer keeps the
+# last RESEND_CACHE_DEPTH clean frames per edge (pipelined sends mean
+# "the last frame" may already be a LATER one; the seq address makes the
+# replay exact). Each cached frame replays at most max(1, send_retries)
+# times — the bounded redial+resend the integrity satellite reuses
+# DCN_SEND_RETRIES for. A cache miss (producer restarted, cap hit,
+# frame aged out) means the frame is lost and the round's normal
+# timeout/failover semantics apply.
+_MSG_RESEND = 15
 _SPANS_PROBE = 1    # aux: timestamps only (clock probe)
 _SPANS_REQUEST = 0  # aux: timestamps + span ring
 _SPANS_DIGEST = 2   # aux: timestamps + cumulative duration digest — the
@@ -280,6 +303,20 @@ _TRACED_FRAMES = prom.REGISTRY.counter(
 _TRACE_INVALID = prom.REGISTRY.counter(
     "pipeedge_trace_ctx_invalid_total",
     "trace-context blobs that failed to decode (frame delivered untraced)")
+# gray-failure signal: bounded redial+resend attempts the transport paid
+# per destination (DCN_SEND_RETRIES) — a link that needs retries is
+# degrading even when every retry eventually succeeds
+_SEND_RETRIES_TOTAL = prom.REGISTRY.counter(
+    "pipeedge_send_retries_total",
+    "data-send redial+resend attempts (DCN_SEND_RETRIES), by peer rank")
+# frame integrity (PIPEEDGE_WIRE_CRC): frames whose checksum failed at
+# the receiving reader — each one triggers a bounded seq-addressed
+# resend request. Public: the runtime's belt-and-braces decode handlers
+# count on the same family.
+FRAMES_CORRUPT = prom.REGISTRY.counter(
+    "pipeedge_frames_corrupt_total",
+    "wire frames that failed the integrity checksum on receive, by "
+    "producing peer")
 
 
 def _env_number(name: str, default, cast):
@@ -499,6 +536,23 @@ def _recv_frame(sock: socket.socket) -> Tuple[int, int, int, List[np.ndarray]]:
     return msg_type, aux, channel, _recv_body(sock, n)
 
 
+def _flip_one_bit(tensors: Sequence) -> List:
+    """Chaos corrupt@K: return `tensors` with one bit flipped in a COPY
+    of the largest tensor (the activation payload — never the header,
+    microbatch id, or checksum, which are all small). The caller's
+    arrays are untouched."""
+    tensors = list(tensors)
+    sizes = [int(np.asarray(t).nbytes) for t in tensors]
+    if not sizes or max(sizes) == 0:
+        return tensors
+    idx = sizes.index(max(sizes))
+    victim = np.asarray(tensors[idx]).copy()
+    flat = victim.reshape(-1).view(np.uint8)
+    flat[flat.size // 2] ^= np.uint8(1)
+    tensors[idx] = victim
+    return tensors
+
+
 class DistDcnContext(DistContext):
     """Point-to-point tensor transport between ranks over TCP (DCN).
 
@@ -511,6 +565,11 @@ class DistDcnContext(DistContext):
 
     RECV_QUEUE_DEPTH = 1   # reference ConditionQueue maxsize=1 backpressure
     CONNECT_TIMEOUT = 60.0  # total dial deadline incl. refused-retry backoff
+    # clean frames cached per (dst, channel) for integrity resends —
+    # deeper than the default stage pipelining depth (2), so the frame a
+    # consumer flags corrupt is still addressable by seq even after the
+    # producer pipelined a few more sends on that edge
+    RESEND_CACHE_DEPTH = 4
 
     def __init__(self, world_size: int, rank: int,
                  rank_addrs: Sequence[Tuple[str, int]],
@@ -597,6 +656,8 @@ class DistDcnContext(DistContext):
                 _STALE_FRAMES.declare(peer=str(r))
                 _PEER_REJOINS.declare(peer=str(r))
                 _TRACED_FRAMES.declare(peer=str(r))
+                _SEND_RETRIES_TOTAL.declare(peer=str(r))
+                FRAMES_CORRUPT.declare(peer=str(r))
         # admission policy: with accept_joins=False every _MSG_JOIN is
         # refused (the runtime's --on-peer-rejoin ignore), so a confirmed
         # death stays terminal exactly as before this plane existed
@@ -645,6 +706,31 @@ class DistDcnContext(DistContext):
         # loop-local) so a rejoin admission can clear it and the plane
         # starts beating the restored rank immediately
         self._hb_dial_backoff: Dict[int, float] = {}
+        # heartbeat RTT measurement (all under _hb_lock): beat sequence
+        # counter, in-flight probes (dst, seq) -> send stamp, and per-peer
+        # bounded RTT sample windows (ms). Beats carry the seq as a
+        # payload; the peer's reader echoes it back (_MSG_HEARTBEAT_ACK).
+        self._hb_seq = 0
+        self._hb_rtt_pending: Dict[Tuple[int, int], float] = {}
+        self._hb_rtt: Dict[int, deque] = {}
+        self._hb_rtt_hook: Optional[Callable[[int, float], None]] = None
+        # gray-failure accounting + frame-integrity recovery (under
+        # _retry_lock): per-destination redial+resend counts, and — when
+        # PIPEEDGE_WIRE_CRC arms frame checksums — a per-(dst, channel)
+        # frame sequence counter (travels in the data-frame aux field)
+        # plus a bounded cache of the last clean frames per edge, each
+        # entry [seq, msg_type, tensors, replays]. Deeper than the stage
+        # pipelining depth (default 2) so a corrupt frame's seq is still
+        # cached by the time the consumer's resend request arrives.
+        self._retry_lock = make_lock("dcn.retry")
+        self._send_retry_counts: Dict[int, int] = {}
+        self._frame_seq: Dict[Tuple[int, int], int] = {}
+        self._last_frames: Dict[Tuple[int, int], deque] = {}
+        self._wire_crc = wire_codec.crc_enabled()
+        # chaos hook (comm/chaos.py corrupt@K): one-shot bit flip applied
+        # BELOW the integrity layer, on a copy, so the resend cache and
+        # any checksum stay clean — simulated wire corruption
+        self._corrupt_next_send = False
         # send/recv measurement hooks (reference p2p:132-152): pre fires just
         # before the payload moves, post just after, so (post - pre) is the
         # actual wire transfer time — excluding idle waits for data to exist.
@@ -845,6 +931,34 @@ class DistDcnContext(DistContext):
                         del self._cmd_conns[dst]
                 raise
 
+    def _try_cmd_send(self, dst: int, msg_type: int, aux: int,
+                      tensors: Sequence[np.ndarray] = (),
+                      lock_timeout: float = 0.5,
+                      dial_timeout: float = 2.0) -> bool:
+        """Best-effort, BOUNDED command-channel send for reader-thread
+        replies (heartbeat-RTT echoes, resend requests): a busy conn
+        lock (e.g. a broadcast blocked mid-send to the same peer) or a
+        failed dial just drops the reply — one lost probe/request, never
+        a wedged reader. Returns whether the frame went out."""
+        lock = self._cmd_conn_locks[dst]
+        if not lock.acquire(timeout=lock_timeout):
+            return False
+        try:
+            conn = self._ensure_conn(dst, timeout=dial_timeout,
+                                     conns=self._cmd_conns)
+            try:
+                _send_frame(conn, msg_type, aux, tensors)  # pipelint: disable=PL102
+                return True
+            except OSError:
+                with self._conns_lock:
+                    if self._cmd_conns.get(dst) is conn:
+                        del self._cmd_conns[dst]
+                return False
+        except OSError:
+            return False
+        finally:
+            lock.release()
+
     def announce_join(self, peers: Optional[Sequence[int]] = None,
                       timeout: float = 5.0) -> List[int]:
         """Ask every peer (default: the whole fleet) to re-admit this rank
@@ -881,6 +995,128 @@ class DistDcnContext(DistContext):
         """`hook(src)` fires on the reader thread for every heartbeat frame
         received — the feed for monitoring's heartbeat windows."""
         self._hb_hook = hook
+
+    def register_heartbeat_rtt_hook(
+            self, hook: Optional[Callable[[int, float], None]]) -> None:
+        """`hook(src, rtt_ms)` fires on the reader thread for every
+        heartbeat probe that comes home — the per-sample feed for
+        monitoring's RTT windows (the aggregate view is
+        `heartbeat_rtt_stats`)."""
+        self._hb_rtt_hook = hook
+
+    def heartbeat_rtt_stats(self) -> Dict[int, Dict[str, float]]:
+        """Per-peer heartbeat round-trip statistics over the bounded
+        sample window: `{peer: {"n", "p50_ms", "p99_ms"}}` (nearest-rank
+        percentiles; peers with no completed probe are absent). The
+        latency signal the gray-failure scorer and the
+        `pipeedge_heartbeat_rtt_ms` gauges read — beats prove liveness,
+        these prove the link is still FAST."""
+        with self._hb_lock:
+            samples = {p: sorted(dq) for p, dq in self._hb_rtt.items()
+                       if dq}
+        out: Dict[int, Dict[str, float]] = {}
+        for peer, vals in samples.items():
+            def pct(q):
+                idx = max(0, min(len(vals) - 1,
+                                 int(round(q / 100.0 * (len(vals) - 1)))))
+                return round(vals[idx], 3)
+            out[peer] = {"n": len(vals), "p50_ms": pct(50),
+                         "p99_ms": pct(99)}
+        return out
+
+    def send_retry_counts(self) -> Dict[int, int]:
+        """Cumulative redial+resend attempts per destination (the
+        DCN_SEND_RETRIES loop) — the gray-failure scorer differences two
+        snapshots for a per-window count."""
+        with self._retry_lock:
+            return dict(self._send_retry_counts)
+
+    # -- frame-integrity recovery (PIPEEDGE_WIRE_CRC) -------------------
+
+    def request_resend(self, src: int, channel: int, seq: int = -1,
+                       timeout: float = 5.0) -> None:
+        """Ask `src` to replay data frame `seq` (its per-edge sequence
+        number, carried in the data-frame aux when PIPEEDGE_WIRE_CRC is
+        armed; -1 = the latest cached frame) on `channel` — the consumer
+        half of the integrity-recovery path. The reader loop calls this
+        automatically on a checksum mismatch; it stays public for
+        belt-and-braces consumers (runtime.py's decode handlers).
+        Best-effort: the replayed frame arrives as a normal data frame
+        on the same recv queue; a cache miss or replay-cap hit on the
+        producer means the frame is lost and the round's
+        timeout/failover semantics apply. Raises OSError when `src` is
+        unreachable."""
+        self._cmd_channel_send(src, _MSG_RESEND, int(seq),
+                               (np.asarray(channel, np.int32),),
+                               timeout=timeout)
+
+    def _resend_last(self, dst: int, channel: int, seq: int = -1) -> bool:
+        """Producer half: replay cached frame `seq` (-1 = latest) for
+        (dst, channel), at most max(1, send_retries) times per frame.
+        Runs on the reader thread: the data-conn lock acquire AND the
+        replay send itself are bounded (a backpressured consumer that
+        stopped draining its socket forfeits the replay rather than
+        wedging this reader)."""
+        with self._retry_lock:
+            dq = self._last_frames.get((dst, channel))
+            entry = None
+            if dq:
+                if seq < 0:
+                    entry = dq[-1]
+                else:
+                    for e in dq:
+                        if e[0] == seq:
+                            entry = e
+                            break
+            if entry is None:
+                logger.warning("rank %d: resend request from rank %d "
+                               "(channel %d, seq %d) missed the cache "
+                               "(PIPEEDGE_WIRE_CRC off, restarted, or "
+                               "aged past RESEND_CACHE_DEPTH=%d)",
+                               self._rank, dst, channel, seq,
+                               self.RESEND_CACHE_DEPTH)
+                return False
+            cap = max(1, self.send_retries)
+            if entry[3] >= cap:
+                logger.warning("rank %d: resend cap (%d) hit for rank %d "
+                               "channel %d seq %d; frame stays lost",
+                               self._rank, cap, dst, channel, entry[0])
+                return False
+            entry[3] += 1
+            frame_seq, msg_type, tensors = entry[0], entry[1], entry[2]
+        lock = self._conn_locks[dst]
+        if not lock.acquire(timeout=5.0):
+            logger.warning("rank %d: resend to rank %d skipped (data "
+                           "conn busy)", self._rank, dst)
+            return False
+        try:
+            conn = self._ensure_conn(dst, timeout=5.0)
+            conn.settimeout(10.0)
+            try:
+                # deliberate send under the per-dst conn lock: the same
+                # frame-serializer discipline as _send_tensors_once; the
+                # socket timeout bounds it (see docstring)
+                _send_frame(conn, msg_type, frame_seq, tensors, channel)  # pipelint: disable=PL102
+            except OSError:
+                with self._conns_lock:
+                    if self._conns.get(dst) is conn:
+                        del self._conns[dst]
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                raise
+            finally:
+                try:
+                    conn.settimeout(None)
+                except OSError:
+                    pass
+        finally:
+            lock.release()
+        logger.warning("rank %d: replayed frame seq %d to rank %d on "
+                       "channel %d (integrity recovery)", self._rank,
+                       frame_seq, dst, channel)
+        return True
 
     def start_heartbeat(self, peers: Optional[Sequence[int]] = None,
                         interval: Optional[float] = None,
@@ -943,7 +1179,24 @@ class DistDcnContext(DistContext):
                     conn = self._ensure_conn(
                         dst, timeout=min(0.5, interval),
                         conns=self._cmd_conns)
-                    _send_frame(conn, _MSG_HEARTBEAT, self._rank, ())
+                    # sequence-numbered beat: the peer's reader echoes the
+                    # seq (_MSG_HEARTBEAT_ACK), turning liveness beats
+                    # into RTT probes. Stamp BEFORE the send so kernel
+                    # buffering counts toward the measured round trip;
+                    # prune probes older than the miss window (a lost ack
+                    # must not leak its stamp forever).
+                    with self._hb_lock:
+                        self._hb_seq += 1
+                        seq = self._hb_seq
+                        self._hb_rtt_pending[(dst, seq)] = time.monotonic()
+                        horizon = (time.monotonic()
+                                   - interval * max(1, self._hb_miss))
+                        for key in [k for k, t
+                                    in self._hb_rtt_pending.items()
+                                    if t < horizon]:
+                            del self._hb_rtt_pending[key]
+                    _send_frame(conn, _MSG_HEARTBEAT, self._rank,
+                                (np.asarray(seq, np.int64),))
                     dial_backoff.pop(dst, None)
                 except OSError:
                     dial_backoff[dst] = (time.monotonic()
@@ -1000,6 +1253,11 @@ class DistDcnContext(DistContext):
         self._pending_death = {}
         self._hb_last_rx = {}
         self._hb_dial_backoff = {}
+        self._hb_rtt_pending = {}
+        self._hb_rtt = {}
+        self._send_retry_counts = {}
+        self._frame_seq = {}
+        self._last_frames = {}
         self._peer_epoch = {}
         self._min_epoch = {}
         self.stale_frames_dropped = 0
@@ -1137,7 +1395,14 @@ class DistDcnContext(DistContext):
                         "rank %d epoch %d (fence %d)", self._rank,
                         msg_type, src, conn_epoch, self.min_epoch_of(src))
                     continue
-                self._alive_sign(src)
+                # "any inbound frame counts as life" — EXCEPT the
+                # heartbeat-RTT echo: an ack proves only that the peer's
+                # reader thread can write a socket (it is generated in
+                # response to OUR probe). Crediting it would keep a
+                # partially-hung peer — beat loop wedged, reader alive —
+                # alive forever, defeating beat-silence detection.
+                if msg_type != _MSG_HEARTBEAT_ACK:
+                    self._alive_sign(src)
                 hooked = (msg_type == _MSG_TENSORS
                           and self._recv_pre_hook is not None)
                 if hooked:
@@ -1175,6 +1440,30 @@ class DistDcnContext(DistContext):
                                      rid=tctx.rid if tctx else None)
                 if msg_type == _MSG_TENSORS and self._recv_post_hook is not None:
                     self._recv_post_hook(src, channel, tensors)
+                if msg_type == _MSG_TENSORS and self._wire_crc and tensors:
+                    # frame integrity: verify CRC-flagged frames HERE,
+                    # where src, channel AND the producer's frame seq
+                    # (aux) are all known — a corrupt frame is dropped
+                    # (never enqueued, so consumers only ever see clean
+                    # frames) and its EXACT seq is requested back. The
+                    # request rides the bounded try-send: recovery must
+                    # never wedge this reader.
+                    idx = wire_codec.locate_crc_header(tensors)
+                    if idx is not None:
+                        try:
+                            wire_codec.verify_frame(tensors[idx + 1:-1],
+                                                    tensors[-1])
+                        except wire_codec.WireCorruptError as exc:
+                            FRAMES_CORRUPT.inc(peer=str(src))
+                            logger.error(
+                                "rank %d: corrupt frame from rank %d "
+                                "(channel %d, seq %d): %s; requesting "
+                                "resend", self._rank, src, channel, aux,
+                                exc)
+                            self._try_cmd_send(
+                                src, _MSG_RESEND, aux,
+                                (np.asarray(channel, np.int32),))
+                            continue
                 if msg_type == _MSG_TENSORS:
                     # blocks when the consumer is behind: TCP backpressure
                     # propagates the stall to the sender (reference
@@ -1233,6 +1522,47 @@ class DistDcnContext(DistContext):
                         self._hb_last_rx[aux] = time.monotonic()
                     if self._hb_hook is not None:
                         self._hb_hook(aux)
+                    if tensors:
+                        # sequence-numbered beat: echo the seq so the
+                        # sender measures this command plane's RTT.
+                        # BOUNDED send (lock + dial budgets): a busy cmd
+                        # conn or unreachable peer just loses this one
+                        # probe — it must never wedge this reader (a
+                        # wedged reader stops crediting the peer's DATA
+                        # frames as life signs and falsely kills it).
+                        seq = int(np.asarray(tensors[0]).reshape(-1)[0])
+                        if not self._try_cmd_send(src, _MSG_HEARTBEAT_ACK,
+                                                  seq):
+                            logger.debug("rank %d: heartbeat-RTT echo to "
+                                         "rank %d skipped", self._rank,
+                                         src)
+                elif msg_type == _MSG_HEARTBEAT_ACK:
+                    # our own probe coming home (aux = echoed seq)
+                    now = time.monotonic()
+                    rtt_ms = None
+                    with self._hb_lock:
+                        t0 = self._hb_rtt_pending.pop((src, aux), None)
+                        if t0 is not None:
+                            rtt_ms = (now - t0) * 1e3
+                            dq = self._hb_rtt.get(src)
+                            if dq is None:
+                                dq = self._hb_rtt[src] = deque(maxlen=512)
+                            dq.append(rtt_ms)
+                    if rtt_ms is not None \
+                            and self._hb_rtt_hook is not None:
+                        self._hb_rtt_hook(src, rtt_ms)
+                elif msg_type == _MSG_RESEND:
+                    # frame-integrity recovery: replay the cached clean
+                    # frame for (requester, channel=payload, seq=aux) —
+                    # bounded, best-effort (see _MSG_RESEND's comment)
+                    ch = (int(np.asarray(tensors[0]).reshape(-1)[0])
+                          if tensors else 0)
+                    try:
+                        self._resend_last(src, ch, aux)
+                    except OSError as exc:
+                        logger.warning("rank %d: resend to rank %d "
+                                       "(channel %d, seq %d) failed: %s",
+                                       self._rank, src, ch, aux, exc)
                 elif msg_type == _MSG_JOIN:
                     # admission handshake (aux = joiner's claimed epoch):
                     # a JOIN always rides a NEW connection from the new
@@ -1383,6 +1713,12 @@ class DistDcnContext(DistContext):
                     # locks (deadlock otherwise)
                     self._mark_dead(dst)
                     raise
+                # gray-failure signal: a link that needs redials is
+                # degrading even when every retry eventually succeeds
+                with self._retry_lock:
+                    self._send_retry_counts[dst] = \
+                        self._send_retry_counts.get(dst, 0) + 1
+                _SEND_RETRIES_TOTAL.inc(peer=str(dst))
                 backoff = min(2.0, 0.2 * (2 ** attempt))
                 logger.warning(
                     "rank %d: send to rank %d failed (%s); retry %d/%d "
@@ -1403,13 +1739,37 @@ class DistDcnContext(DistContext):
         if trace is not None:
             wire_tensors = [trace.to_wire()] + list(tensors)
             msg_type = _MSG_TENSORS_TRACED
+        # chaos corrupt@K: flip one bit in a COPY, below the integrity
+        # layer — the resend cache (and any frame checksum, computed by
+        # the caller's PendingWire.finalize) keeps the clean bytes, so a
+        # consumer-requested resend genuinely recovers the frame
+        frame_tensors = wire_tensors
+        if self._corrupt_next_send:
+            self._corrupt_next_send = False
+            frame_tensors = _flip_one_bit(wire_tensors)
+        # frame integrity: with PIPEEDGE_WIRE_CRC armed, CRC-FLAGGED
+        # frames carry a per-(dst, channel) sequence number in the aux
+        # field instead of the (reader-unused) sender rank, so a
+        # consumer can address a corrupt frame's resend EXACTLY —
+        # pipelined sends mean "the last frame" may already be a later
+        # one. Unflagged frames (raw feed microbatches, v1) are neither
+        # stamped nor cached: the receiver can never verify them, so
+        # caching would only pin dead copies of large inputs per edge.
+        aux = self._rank
+        seq = None
+        if self._wire_crc \
+                and wire_codec.locate_crc_header(wire_tensors) is not None:
+            with self._retry_lock:
+                seq = self._frame_seq.get((dst, channel), 0) + 1
+                self._frame_seq[(dst, channel)] = seq
+            aux = seq
         with self._conn_locks[dst]:
             conn = self._ensure_conn(dst)
             if self._send_pre_hook is not None:
                 self._send_pre_hook(dst, channel)
             t_tx0 = time.monotonic_ns() if telemetry.enabled() else 0
             try:
-                _send_frame(conn, msg_type, self._rank, wire_tensors,
+                _send_frame(conn, msg_type, aux, frame_tensors,
                             channel)
             except Exception as exc:
                 if self._send_pre_hook is not None \
@@ -1428,6 +1788,17 @@ class DistDcnContext(DistContext):
                                  rid=trace.rid if trace else None)
             if self._send_post_hook is not None:
                 self._send_post_hook(dst, channel, tensors)
+        if seq is not None:
+            # frame-integrity resend cache: the last RESEND_CACHE_DEPTH
+            # CLEAN CRC-flagged frames per edge-channel, seq-addressed
+            # (memory is bounded at a few in-flight microbatches per
+            # edge), each with its own replay count
+            with self._retry_lock:
+                dq = self._last_frames.get((dst, channel))
+                if dq is None:
+                    dq = self._last_frames[(dst, channel)] = deque(
+                        maxlen=self.RESEND_CACHE_DEPTH)
+                dq.append([seq, msg_type, wire_tensors, 0])
 
     def recv_tensors(self, src: int, timeout: Optional[float] = None,
                      channel: int = CHANNEL_DATA) -> List[np.ndarray]:
@@ -1861,6 +2232,11 @@ class DcnPipelineStage:
     """
 
     _SENTINEL = object()
+    # dispatch_cb return value meaning "drop this item": nothing is
+    # enqueued for readback/send and the stage-local sequence counter
+    # does not advance — the recovery path for a corrupt inbound frame
+    # whose resend will re-enter the recv loop as a fresh item
+    SKIP = object()
 
     def __init__(self, ctx: DistDcnContext, rank_src: Optional[int],
                  rank_dst: Optional[int],
@@ -2005,6 +2381,8 @@ class DcnPipelineStage:
                     telemetry.span("stage", "dispatch", stage=self._stage,
                                    mb=mb, rid=rid):
                 out = self._dispatch_cb(tensors)
+            if out is self.SKIP:
+                continue    # dropped (corrupt frame awaiting its resend)
             self._queue_out.put((mb, out, trace))
             seq += 1
 

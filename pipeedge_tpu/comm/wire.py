@@ -35,7 +35,8 @@ Consumers: the DCN runtime driver (runtime.py) and the DCN decode mode
 from __future__ import annotations
 
 import os
-from typing import List, Sequence
+import zlib
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -49,6 +50,101 @@ _V2_HEADER_LEN = 5
 # flags bit 0: payload was encoded on-device (XLA ops); informational —
 # the packing layout is identical either way.
 FLAG_ON_DEVICE = 1
+# flags bit 1: the frame's tensor list ends with a [algo, crc] uint32
+# checksum over every body tensor's bytes (frame integrity,
+# docs/FAULT_TOLERANCE.md gray failures). Decoders without the bit see a
+# plain v2 frame — old frames still decode, new frames degrade to
+# unchecked on old decoders (the flag is advisory, like FLAG_ON_DEVICE).
+FLAG_CRC = 2
+
+ENV_WIRE_CRC = "PIPEEDGE_WIRE_CRC"   # 1 = checksum every v2 frame
+
+# Checksum algorithm ids (travel IN the checksum tensor, so a fleet with
+# mixed wheels still verifies): CRC32C (Castagnoli) when a native wheel
+# is importable — the satellite's named algorithm — else zlib's CRC32
+# (ISO-HDLC), which is always available at C speed. A verifier that
+# lacks the frame's algorithm skips verification rather than raising a
+# false corruption.
+CRC_ALGO_CRC32C = 0
+CRC_ALGO_CRC32 = 1
+try:                               # pragma: no cover - env-dependent
+    import crc32c as _crc32c_mod   # type: ignore
+except ImportError:
+    _crc32c_mod = None
+
+
+def crc_enabled() -> bool:
+    """Whether v2 frames should carry an integrity checksum (env
+    PIPEEDGE_WIRE_CRC; runtime --wire-crc sets it for the process)."""
+    return os.getenv(ENV_WIRE_CRC, "0") == "1"
+
+
+class WireCorruptError(ValueError):
+    """A v2 frame's checksum did not match its payload bytes — the frame
+    was corrupted in flight. Consumers recover by requesting one bounded
+    resend over the control channel (comm/dcn.py `request_resend`)."""
+
+    def __init__(self, expected: int, got: int):
+        self.expected = int(expected)
+        self.got = int(got)
+        super().__init__(
+            f"wire frame failed integrity check (checksum "
+            f"{got:#010x} != expected {expected:#010x})")
+
+
+def _checksum_fn(algo: int):
+    if algo == CRC_ALGO_CRC32C and _crc32c_mod is not None:
+        return _crc32c_mod.crc32c
+    if algo == CRC_ALGO_CRC32:
+        return zlib.crc32
+    return None
+
+
+def frame_checksum(tensors: Sequence,
+                   algo: Optional[int] = None) -> Tuple[int, int]:
+    """`(algo, crc)` over every tensor's raw bytes, in list order. The
+    default algorithm is CRC32C when the native wheel is present, zlib
+    CRC32 otherwise; the id rides the frame so the verifier always knows
+    what to recompute."""
+    if algo is None:
+        algo = (CRC_ALGO_CRC32C if _crc32c_mod is not None
+                else CRC_ALGO_CRC32)
+    fn = _checksum_fn(algo)
+    if fn is None:
+        raise ValueError(f"checksum algorithm {algo} unavailable")
+    crc = 0
+    for t in tensors:
+        a = np.ascontiguousarray(np.asarray(t))
+        crc = fn(a.reshape(-1).view(np.uint8).data, crc)
+    return algo, crc & 0xFFFFFFFF
+
+
+def locate_crc_header(tensors: Sequence, scan: int = 3) -> Optional[int]:
+    """Index of the CRC-flagged v2 header within a frame's tensor list,
+    or None. The header may not be first: failover frames prepend the
+    microbatch-id tensor (which the checksum deliberately excludes — it
+    is host-attached after `finalize()`). What the transport reader uses
+    to verify frames in flight (comm/dcn.py)."""
+    for i, t in enumerate(tensors[:scan]):
+        a = np.asarray(t)
+        if _is_v2_header(a) and int(a[3]) & FLAG_CRC:
+            return i
+    return None
+
+
+def verify_frame(body: Sequence, crc_tensor) -> Sequence:
+    """Verify a v2 frame's trailing `[algo, crc]` tensor against `body`
+    (the tensor list between header and checksum); returns `body`.
+    Raises `WireCorruptError` on mismatch. An unknown algorithm (a newer
+    producer) degrades to unverified — never a false corruption."""
+    vals = np.asarray(crc_tensor, np.uint32).reshape(-1)
+    algo, expected = int(vals[0]), int(vals[1])
+    if _checksum_fn(algo) is None:  # pragma: no cover - future algos
+        return body
+    _, got = frame_checksum(body, algo=algo)
+    if got != expected:
+        raise WireCorruptError(expected, got)
+    return body
 
 
 def native_wire_codec(bit: int):
@@ -94,15 +190,30 @@ class PendingWire:
     payload, scale, shift) whose `copy_to_host_async()` has been kicked
     off. `finalize()` materializes everything as numpy (blocking only on
     the already-started copies) — call it on the readback thread, after
-    dispatching the NEXT microbatch's compute."""
+    dispatching the NEXT microbatch's compute.
 
-    __slots__ = ("parts",)
+    With `crc=True` the finalized frame gains the integrity trailer: the
+    header copy's FLAG_CRC bit is set and a `[algo, crc]` uint32 tensor
+    over every body tensor's bytes is appended. The flag lives on the
+    FINALIZED frame only — a colocated (local-tier) hand-off ships
+    `parts` as-is, device buffers and all, and an in-process reference
+    hand-off has no wire to corrupt (and no host bytes to checksum)."""
 
-    def __init__(self, parts: List):
+    __slots__ = ("parts", "crc")
+
+    def __init__(self, parts: List, crc: bool = False):
         self.parts = parts
+        self.crc = bool(crc)
 
     def finalize(self) -> List[np.ndarray]:
-        return [np.asarray(p) for p in self.parts]
+        out = [np.asarray(p) for p in self.parts]
+        if self.crc:
+            header = out[0].copy()
+            header[3] |= FLAG_CRC
+            out[0] = header
+            algo, crc = frame_checksum(out[1:])
+            out.append(np.asarray([algo, crc], np.uint32))
+        return out
 
 
 def _start_host_copy(arr) -> None:
@@ -114,19 +225,25 @@ def _start_host_copy(arr) -> None:
             pass  # later np.asarray() still works, just synchronously
 
 
-def wire_encode_device(out, bit: int) -> PendingWire:
+def wire_encode_device(out, bit: int,
+                       crc: Optional[bool] = None) -> PendingWire:
     """Stage output (tensor or tuple) -> pending v2 wire frame.
 
     Quantizes ON the producing device (jitted `tensor_encode_outerdim`,
     cached per bitwidth) and starts the async readback of only the wire
     payload — packed words + per-item scale/shift at bit>0, the raw
     arrays at bit=0. Never blocks (so the telemetry span covers host
-    dispatch only; the device time lands in the readback span)."""
+    dispatch only; the device time lands in the readback span).
+
+    `crc` arms the integrity trailer (default: env PIPEEDGE_WIRE_CRC);
+    the checksum itself is computed at `finalize()`, when host bytes
+    exist — local-tier hand-offs never pay (or carry) it."""
     with telemetry.span("quant", f"encode_device{bit}"):
-        return _wire_encode_device_timed(out, bit)
+        return _wire_encode_device_timed(
+            out, bit, crc_enabled() if crc is None else bool(crc))
 
 
-def _wire_encode_device_timed(out, bit: int) -> PendingWire:
+def _wire_encode_device_timed(out, bit: int, crc: bool) -> PendingWire:
     import jax.numpy as jnp
 
     from ..ops import fused_quant
@@ -139,7 +256,7 @@ def _wire_encode_device_timed(out, bit: int) -> PendingWire:
             t = jnp.asarray(t)
             _start_host_copy(t)
             parts.append(t)
-        return PendingWire(parts)
+        return PendingWire(parts, crc=crc)
     for t in tensors:
         # fused Pallas encode when enabled (ops/fused_quant.py) — the
         # packing layout is bit-identical to the XLA/native codecs, so
@@ -149,7 +266,7 @@ def _wire_encode_device_timed(out, bit: int) -> PendingWire:
             _start_host_copy(a)
         parts += [enc.data, enc.scale, enc.shift,
                   np.asarray(enc.shape, np.int64)]
-    return PendingWire(parts)
+    return PendingWire(parts, crc=crc)
 
 
 def _is_v2_header(header: np.ndarray) -> bool:
@@ -194,7 +311,9 @@ def wire_decode(tensors: List[np.ndarray], dtype):
     """Inverse of `wire_encode`/`wire_encode_device` (version and bitwidth
     read from the wire header); returns the stage payload (tensor/tuple).
     v2 frames dequantize on the receiving device; v1 frames through the
-    native host codec when available."""
+    native host codec when available. A v2 frame carrying the FLAG_CRC
+    trailer is verified FIRST — a corrupted frame raises
+    `WireCorruptError` before any garbage reaches a device."""
     with telemetry.span("quant", "decode"):
         return _wire_decode_timed(tensors, dtype)
 
@@ -205,7 +324,13 @@ def _wire_decode_timed(tensors: List[np.ndarray], dtype):
     from ..ops import quant as quant_ops
     header = np.asarray(tensors[0])
     if _is_v2_header(header):
-        return _wire_decode_v2(header, tensors[1:], dtype)
+        body = tensors[1:]
+        if int(header[3]) & FLAG_CRC:
+            if not body:
+                raise ValueError("malformed v2 wire frame: FLAG_CRC set "
+                                 "but no checksum tensor")
+            body = verify_frame(body[:-1], body[-1])
+        return _wire_decode_v2(header, body, dtype)
     bit = int(header)
     tensors = tensors[1:]
     if bit == 0:
@@ -256,6 +381,8 @@ def frame_payload_bytes(tensors: Sequence) -> int:
     body = list(tensors[1:])
     if _is_v2_header(header):
         bit = int(header[2])
+        if int(header[3]) & FLAG_CRC and body:
+            body = body[:-1]    # the integrity trailer is metadata
     else:
         bit = int(header)
     if bit == 0:

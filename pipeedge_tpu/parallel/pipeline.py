@@ -267,6 +267,16 @@ class HostPipeline:
         with telemetry.span("results", "retire", mb=i,
                             rid=trace.rid if trace is not None else None):
             out = jax.block_until_ready(out)
+            # opt-in NaN/Inf guard (PIPEEDGE_NAN_GUARD=1): the host
+            # driver's stage hand-offs stay on-device for overlap, so the
+            # boundary check lands here, where the result is already
+            # fenced — a poisoned microbatch raises the named error
+            # instead of reaching the result callback
+            from ..health import guard as nan_guard
+            if nan_guard.nan_guard_enabled():
+                out = nan_guard.check_finite(
+                    out, where="host_pipeline/retire", mb=i,
+                    rid=trace.rid if trace is not None else None)
         now = time.monotonic()
         if retired is not None:
             retired.append((_leading_dim(out), now))

@@ -29,7 +29,8 @@ Span categories in use (docs/OBSERVABILITY.md has the full reference):
 pipeline per-stage dispatch/retire), `compute` (the jitted shard step),
 `quant` (wire encode/decode), `feed`/`results` (data-rank microbatch
 lifecycle), `runtime` (schedule rounds), `failover` (detection→recovery),
-`rejoin` (JOIN admission → heal-to-full-capacity), `serve` (HTTP request
+`rejoin` (JOIN admission → heal-to-full-capacity), `health` (gray-failure
+lifecycle transitions, pipeedge_tpu/health/), `serve` (HTTP request
 lifecycle).
 """
 from __future__ import annotations

@@ -18,6 +18,10 @@ Triggers (docs/OBSERVABILITY.md):
 - `failover` — a degraded window opened / a rank died (runtime.py,
                tools/serve.py POST /degraded)
 - `slo`      — the brownout ladder crossed its SLO-breach rung
+- `gray`     — the peer-health plane quarantined a gray-failing rank
+               (pipeedge_tpu/health/, docs/FAULT_TOLERANCE.md)
+- `poison`   — the NaN/Inf activation guard tripped at a stage boundary
+               (PIPEEDGE_NAN_GUARD=1, pipeedge_tpu/health/guard.py)
 - `manual`   — POST /debug/dump (never cooldown-limited)
 
 Dumps are JSON files under `PIPEEDGE_POSTMORTEM_DIR` (default
@@ -52,7 +56,8 @@ DEFAULT_POSTMORTEM_DIR = "postmortems"
 DEFAULT_CAPACITY = 4096
 DEFAULT_COOLDOWN_S = 5.0
 
-TRIGGERS = ("deadline", "shed", "failover", "slo", "manual")
+TRIGGERS = ("deadline", "shed", "failover", "slo", "gray", "poison",
+            "manual")
 
 _POSTMORTEMS = prom.REGISTRY.counter(
     "pipeedge_postmortems_written_total",

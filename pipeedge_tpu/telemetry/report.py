@@ -19,6 +19,11 @@ span stream this repo's runtime emits:
 - rejoin breakdown: JOIN admissions and heal spans of the elastic
   membership plane — each heal span's duration is that episode's
   time-to-full-capacity (first detection -> partition healed).
+- gray-failure breakdown: peer-health lifecycle transitions (suspect /
+  quarantine / readmit / recovered / floor-held, pipeedge_tpu/health/)
+  per affected rank — the zero-false-quarantines assertion on a clean
+  run and the exactly-one-quarantine gate on a straggler run both read
+  this section.
 - span_overhead_pct: the recorder's own cost — per-record cost measured
   live on this host times the span count, over the window — the number
   that keeps the observability plane honest about its hot-path tax.
@@ -123,23 +128,38 @@ def analyze_spans(spans: Sequence[dict],
     window_ns = max(1, t_max - t_min)
 
     # -- per-stage busy/idle + bubble % --------------------------------
+    # Two lenses: `stage_busy` counts every stage/compute span (the
+    # historical bubble number), `stage_busy_core` excludes the `emit`
+    # span — the downstream hand-off, which BACKPRESSURE and slow links
+    # inflate (REBALANCE.md "backpressure-inflated emit"): a straggling
+    # edge makes every stage LOOK busy and deflates the all-span bubble.
+    # The core lens counts only genuine work (dispatch/readback/compute),
+    # so a slow-link straggler honestly reads as idle — the number the
+    # gray-failure A/B compares (docs/FAULT_TOLERANCE.md).
     stage_busy: Dict[str, List[Tuple[int, int]]] = {}
+    stage_busy_core: Dict[str, List[Tuple[int, int]]] = {}
     for s in spans:
         if s.get("cat") in BUSY_CATEGORIES:
             stage = s.get("stage")
             key = (f"stage{stage}" if stage is not None
                    else f"rank{s.get('rank', 0)}")
-            stage_busy.setdefault(key, []).append(
-                (int(s["t0"]), int(s["t1"])))
+            iv = (int(s["t0"]), int(s["t1"]))
+            stage_busy.setdefault(key, []).append(iv)
+            if not (s.get("cat") == "stage" and s.get("name") == "emit"):
+                stage_busy_core.setdefault(key, []).append(iv)
     stages = {}
     bubble_by_key = {}
     for key in sorted(stage_busy):
         busy_ns = _union_ns(stage_busy[key])
         idle_ns = max(0, window_ns - busy_ns)
         pct = 100.0 * idle_ns / window_ns
+        core_ns = _union_ns(stage_busy_core.get(key, ()))
         stages[key] = {"busy_s": round(busy_ns / 1e9, 6),
                        "idle_s": round(idle_ns / 1e9, 6),
-                       "bubble_pct": round(pct, 3)}
+                       "bubble_pct": round(pct, 3),
+                       "bubble_compute_pct": round(
+                           100.0 * max(0, window_ns - core_ns)
+                           / window_ns, 3)}
         bubble_by_key[key] = pct
     # headline bubble: mean over stage-indexed tracks when any span carried
     # a stage id (the rankN fallback tracks shadow the same work on DCN
@@ -211,26 +231,34 @@ def analyze_spans(spans: Sequence[dict],
     rounds = []
     for t0_seg, t1_seg in segments:
         seg_window = max(1, t1_seg - t0_seg)
-        seg_bubbles = {}
-        for key, intervals in stage_busy.items():
-            clipped = [(max(t0, t0_seg), min(t1, t1_seg))
-                       for t0, t1 in intervals
-                       if t1 > t0_seg and t0 < t1_seg]
-            if not clipped:
-                # the stage recorded nothing this round (e.g. failed over
-                # away): absent, not 100% idle — it must not inflate the
-                # round's mean
-                continue
-            busy_ns = _union_ns(clipped)
-            seg_bubbles[key] = 100.0 * max(0, seg_window - busy_ns) \
-                / seg_window
-        staged_seg = [v for k, v in seg_bubbles.items()
-                      if k.startswith("stage")]
-        seg_pool = staged_seg if staged_seg else list(seg_bubbles.values())
+
+        def seg_mean(busy_map):
+            seg_bubbles = {}
+            for key, intervals in busy_map.items():
+                clipped = [(max(t0, t0_seg), min(t1, t1_seg))
+                           for t0, t1 in intervals
+                           if t1 > t0_seg and t0 < t1_seg]
+                if not clipped:
+                    # the stage recorded nothing this round (e.g. failed
+                    # over away): absent, not 100% idle — it must not
+                    # inflate the round's mean
+                    continue
+                busy_ns = _union_ns(clipped)
+                seg_bubbles[key] = 100.0 * max(0, seg_window - busy_ns) \
+                    / seg_window
+            staged_seg = [v for k, v in seg_bubbles.items()
+                          if k.startswith("stage")]
+            seg_pool = (staged_seg if staged_seg
+                        else list(seg_bubbles.values()))
+            return (round(sum(seg_pool) / len(seg_pool), 3)
+                    if seg_pool else None)
+
         rounds.append({
             "window_s": round(seg_window / 1e9, 6),
-            "bubble_pct": (round(sum(seg_pool) / len(seg_pool), 3)
-                           if seg_pool else None),
+            "bubble_pct": seg_mean(stage_busy),
+            # emit excluded (see the two-lens comment above): the
+            # steady-state number the gray-failure A/B compares
+            "bubble_compute_pct": seg_mean(stage_busy_core),
         })
 
     # -- transport tiers (docs/DCN_WIRE.md selection matrix) -----------
@@ -327,6 +355,32 @@ def analyze_spans(spans: Sequence[dict],
             rejoin["heals_s"] = [round(v, 6) for v in heals]
             rejoin["time_to_full_capacity_s"] = round(max(heals), 6)
 
+    # -- gray failures: peer-health transitions ------------------------
+    # instant "health" spans, one per lifecycle transition, with the
+    # affected rank in the name ("quarantine:r2"): suspect / quarantine /
+    # readmit (quarantined -> probation) / recovered (probation ->
+    # healthy) / held (min-fleet floor refused the bench) — the section
+    # the gray-failure CI smoke gates on (exactly one quarantine on the
+    # chaos run, ZERO on the clean run). docs/FAULT_TOLERANCE.md.
+    gray = {}
+    hl = [s for s in spans if s.get("cat") == "health"]
+    if hl:
+        by_kind: Dict[str, int] = {}
+        by_rank: Dict[str, List[str]] = {}
+        for s in hl:
+            kind, _, target = str(s.get("name", "")).partition(":")
+            by_kind[kind] = by_kind.get(kind, 0) + 1
+            if target:
+                by_rank.setdefault(target, []).append(kind)
+        gray = {
+            "suspects": by_kind.get("suspect", 0),
+            "quarantines": by_kind.get("quarantine", 0),
+            "readmits": by_kind.get("readmit", 0),
+            "recovered": by_kind.get("recovered", 0),
+            "held": by_kind.get("held", 0),
+            "by_rank": {k: by_rank[k] for k in sorted(by_rank)},
+        }
+
     # -- serving plane: admission waits / sheds / brownout -------------
     # tools/serve.py records cat "serve" spans: "admit:{class}" (duration
     # = EDF-queue wait of an ADMITTED request — shed waits record under
@@ -407,6 +461,7 @@ def analyze_spans(spans: Sequence[dict],
         "requests": requests,
         "failover": failover,
         "rejoin": rejoin,
+        "gray": gray,
         "rebalance_events": rebalance_events,
         "span_cost_ns": round(span_cost_ns, 1),
         "span_overhead_pct": round(overhead_pct, 4),

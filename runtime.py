@@ -34,6 +34,7 @@ import numpy as np
 import monitoring
 from pipeedge_tpu import telemetry
 from pipeedge_tpu.comm import CMD_ADMIT, CMD_DEAD, CMD_SCHED, CMD_STOP
+from pipeedge_tpu.health import guard as nan_guard
 from pipeedge_tpu.telemetry import flight
 from pipeedge_tpu.telemetry import metrics as prom
 from pipeedge_tpu.models import get_microbatch_size, registry
@@ -64,6 +65,11 @@ MONITORING_KEY_RECV = 'recv'
 # column = sender rank), so the post-mortem CSV shows exactly when each
 # peer's beats stopped
 MONITORING_KEY_LIVENESS = 'liveness'
+# heartbeat RTT: one row per completed beat round trip (work = rtt ms,
+# accuracy column = peer rank) — beats prove liveness, these prove the
+# command plane is still FAST; the monitoring snapshot and hb_rtt.csv
+# carry the same series /metrics exports as pipeedge_heartbeat_rtt_ms
+MONITORING_KEY_HB_RTT = 'hb_rtt'
 
 results_counter = ThreadSafeCounter(name="runtime.results")
 label_queue = queue.Queue()
@@ -90,6 +96,13 @@ dead_lock = make_lock("runtime.dead")
 # round's failover re-plan. --on-peer-rejoin spare keeps ranks here;
 # heal clears the bench at the round boundary that restores capacity.
 benched_ranks: set = set()
+# gray-quarantined ranks (guarded by dead_lock): alive but benched by
+# the peer-health plane (--on-peer-degraded quarantine) because their
+# EWMA degradation score confirmed a straggler. Kept SEPARATE from
+# benched_ranks so a rejoin heal clearing the bench can never silently
+# readmit a quarantined straggler; only probation readmission
+# (pipeedge_tpu/health/scorer.py) removes entries here.
+quarantined_ranks: set = set()
 # a death landed mid-round: the data rank ends the round, re-schedules over
 # the survivors, and replays the unacknowledged microbatches
 failover_event = threading.Event()
@@ -144,6 +157,14 @@ _TTFC = prom.REGISTRY.gauge(
     "pipeedge_time_to_full_capacity_seconds",
     "latest heal episode: first death detection -> partition healed back "
     "to full capacity at a round boundary")
+# gray-failure plane (docs/FAULT_TOLERANCE.md): the heartbeat RTT
+# percentiles the peer-health scorer reads (q = p50 | p99). The frame-
+# integrity counter (pipeedge_frames_corrupt_total) lives with its
+# verification site in comm/dcn.py (`dcn.FRAMES_CORRUPT`).
+_HB_RTT = prom.REGISTRY.gauge(
+    "pipeedge_heartbeat_rtt_ms",
+    "heartbeat round-trip percentiles per peer over the bounded sample "
+    "window (q = p50 | p99)")
 
 
 def _declare_fleet_metric_labels(world_size: int, rank: int) -> None:
@@ -750,7 +771,9 @@ def run_pipeline_spmd(args, stage_layers, stage_quant, stage_ranks,
 # Host-side quantized wire codec: moved to the library
 # (pipeedge_tpu/comm/wire.py) so the DCN decode mode shares it; aliased here
 # for the runtime call sites and existing tests.
-from pipeedge_tpu.comm.wire import (wire_decode as _wire_decode,
+from pipeedge_tpu.comm.wire import (WireCorruptError,
+                                    crc_enabled as _wire_crc_enabled,
+                                    wire_decode as _wire_decode,
                                     wire_encode as _wire_encode,
                                     wire_encode_device as _wire_encode_device)
 
@@ -930,52 +953,80 @@ class _MicrobatchLedger:
         return True
 
 
-def _consider_rebalance(ctx, args, policy, sched, prev_digests: dict,
-                        rnd: int):
-    """One closed-loop decision at a round boundary (data rank only):
-    pull every stage rank's cumulative span digest over the command
-    channel (kilobytes; comm/dcn.py `collect_digest`), difference against
-    the previous round's digests for this round's window, decompose into
-    per-stage service estimates (telemetry/feedback.py), and ask the
-    policy (sched/rebalance.py) whether re-solving the partition with the
-    MEASURED profile is worth a re-schedule. Returns the accepted
-    Proposal or None; never raises — an unmeasurable round (dead peer,
-    incomplete estimates) keeps the running partition."""
-    from pipeedge_tpu.telemetry import feedback
-
-    stage_layers, _stage_quant, stage_ranks = sched
-    t0 = time.monotonic_ns()
+def _collect_fleet_digests(ctx, args, stage_ranks):
+    """Pull every stage rank's CUMULATIVE span digest over the command
+    channel once (kilobytes; comm/dcn.py `collect_digest`). Collected
+    ONCE per round boundary and shared by every consumer — the
+    rebalancer and the peer-health scorer each difference the same
+    cumulative snapshot against their own baselines, so two features
+    never pay two serial fleet sweeps (up to N x 10 s each on exactly
+    the degraded links the health plane targets). Returns
+    `{rank: digest}`, or None when any rank is dead/unreachable (the
+    whole window is unmeasurable — partial snapshots must not advance
+    anyone's baseline)."""
     with dead_lock:
         gone = set(dead_ranks)
-    windows = []
-    collected = {}
+    out = {}
     for src in sorted(set(stage_ranks)):
         if src == args.rank:
             rec = telemetry.recorder()
-            cur = rec.digest() if rec is not None else {}
+            out[src] = rec.digest() if rec is not None else {}
         elif src in gone:
-            logger.info("rebalance: rank %d is dead; skipping this "
-                        "round's window", src)
+            logger.info("telemetry window: rank %d is dead; skipping "
+                        "this round", src)
             return None
         else:
             try:
-                cur = ctx.collect_digest(src, timeout=10.0)
+                out[src] = ctx.collect_digest(src, timeout=10.0)
             except Exception as exc:  # noqa: BLE001 - any peer hiccup
-                logger.warning("rebalance: digest collection from rank %d "
-                               "failed (%s); keeping partition", src, exc)
+                logger.warning("telemetry window: digest collection from "
+                               "rank %d failed (%s)", src, exc)
                 return None
-        windows.append(feedback.diff_digests(cur, prev_digests.get(src, {})))
-        collected[src] = cur
-    # commit the baselines only once EVERY rank collected: a failure
-    # mid-iteration must not advance some ranks' windows and not others',
-    # or the next round's per-stage windows cover different time spans
-    prev_digests.update(collected)
+    return out
+
+
+def _estimates_from_digests(cur_digests, sched, prev_digests: dict,
+                            min_samples: int = 2):
+    """One consumer's measured window: difference a fleet digest
+    snapshot against `prev_digests` (the CALLER-owned baselines, which
+    advance here — every rank's, atomically, so windows always cover one
+    time span) and decompose into per-stage service estimates
+    (telemetry/feedback.py). Returns the estimates dict, or None when
+    the snapshot is absent or fails the self-test."""
+    from pipeedge_tpu.telemetry import feedback
+
+    if cur_digests is None:
+        return None
+    stage_layers = sched[0]
+    windows = [feedback.diff_digests(cur, prev_digests.get(src, {}))
+               for src, cur in cur_digests.items()]
+    prev_digests.update(cur_digests)
     est = feedback.stage_estimates(feedback.merge_digests(windows))
     problems = feedback.check_estimates(est, len(stage_layers),
-                                        min_samples=2)
+                                        min_samples=min_samples)
     if problems:
-        logger.info("rebalance: estimates failed the self-test (%s); "
-                    "keeping partition", "; ".join(problems))
+        logger.info("telemetry window failed the self-test (%s)",
+                    "; ".join(problems))
+        return None
+    return est
+
+
+def _consider_rebalance(ctx, args, policy, sched, prev_digests: dict,
+                        rnd: int, cur_digests=None):
+    """One closed-loop decision at a round boundary (data rank only):
+    measure this round's window (from the boundary's shared digest
+    snapshot `cur_digests`, collected by `_collect_fleet_digests`) and
+    ask the policy (sched/rebalance.py) whether re-solving the partition
+    with the MEASURED profile is worth a re-schedule. Returns the
+    accepted Proposal or None; never raises — an unmeasurable round
+    (dead peer, incomplete estimates) keeps the running partition."""
+    stage_layers, _stage_quant, _stage_ranks = sched
+    t0 = time.monotonic_ns()
+    # cur_digests=None means the boundary's one shared sweep already
+    # failed — do NOT sweep again (the failure was fleet-wide)
+    est = _estimates_from_digests(cur_digests, sched, prev_digests)
+    if est is None:
+        logger.info("rebalance: no measurable window; keeping partition")
         return None
     proposal = policy.consider(list(stage_layers), est, rnd)
     now = time.monotonic_ns()
@@ -996,6 +1047,155 @@ def _consider_rebalance(ctx, args, policy, sched, prev_digests: dict,
           f"partition={','.join(f'{l},{r}' for l, r in proposal.partition)} "
           f"predicted_gain={proposal.gain:.4f}")
     return proposal
+
+
+def _consider_peer_health(ctx, args, hstate: dict, sched, next_sched,
+                          world_size: int, rnd: int,
+                          cur_digests=None) -> None:
+    """One gray-failure decision at a round boundary (data rank only,
+    docs/FAULT_TOLERANCE.md gray failures): fold this round's measured
+    signals — per-stage service time vs the fleet median (the same
+    digest windows the rebalancer reads), heartbeat RTT p99 vs the fleet
+    median (comm/dcn.py `heartbeat_rtt_stats`), transport redial counts
+    — into the EWMA health scorer, and act on its transitions:
+
+    - suspect / floor-hold / recovery: recorded (health spans, flight
+      ring) but nothing moves.
+    - quarantine (`--on-peer-degraded quarantine`, confirmed over N
+      windows, min-fleet floor verified by DRY-RUNNING the next round's
+      failover plan with the victim benched): a PLANNED bench — the rank
+      is alive and this round fully drained, so adding it to
+      `quarantined_ranks` makes the next boundary's re-plan move its
+      stage to a spare with no ledger replay.
+    - probation readmit: the score recovered (heartbeat RTT is the main
+      signal a benched rank still emits); un-benching lets the next
+      round's own schedule restore the stage through the same re-plan
+      path — and one bad probation window re-quarantines without
+      re-confirmation.
+
+    Never raises; an unmeasurable service window still folds RTT/retry
+    signals so quarantined ranks keep moving toward (or away from)
+    readmission."""
+    from pipeedge_tpu import health as health_mod
+
+    scorer = hstate["scorer"]
+    _stage_layers, _q, stage_ranks = sched
+    # cur_digests=None = the boundary's shared sweep failed: no service
+    # signal this window, but RTT/retry signals still fold below
+    est = _estimates_from_digests(cur_digests, sched,
+                                  hstate["prev_digests"])
+
+    # TRUE median (statistics.median: middle-two mean for even counts):
+    # an upper median would make a 2-stage fleet's straggler its own
+    # baseline (ratio 1.0 — detector blind)
+    from statistics import median
+
+    # relative signals: a fleet where everything is slow is balanced,
+    # not gray — normalize against the fleet median. Absolute floors
+    # guard the false-positive side: a stage a few ms over the median
+    # (natural skew) or a sub-5 ms loopback RTT at 2x the median (pure
+    # noise) reads as HEALTHY (ratio 1.0 — an actively decaying signal,
+    # not a missing one). Env-tunable for unusual fleets.
+    excess_floor_s = float(os.getenv("PIPEEDGE_HEALTH_MIN_EXCESS_S",
+                                     "0.02"))
+    rtt_floor_ms = float(os.getenv("PIPEEDGE_HEALTH_RTT_FLOOR_MS", "5"))
+    service_ratio: dict = {}
+    if est:
+        svc = {stage_ranks[i]: e.service_s for i, e in est.items()
+               if 0 <= i < len(stage_ranks)}
+        med = median(svc.values()) if svc else 0.0
+        if med > 0:
+            service_ratio = {
+                r: (s / med if s - med >= excess_floor_s else 1.0)
+                for r, s in svc.items()}
+    rtt = ctx.heartbeat_rtt_stats()
+    rtt_ratio: dict = {}
+    if rtt:
+        med = median(v["p99_ms"] for v in rtt.values())
+        for peer, v in rtt.items():
+            _HB_RTT.set(v["p50_ms"], peer=str(peer), q="p50")
+            _HB_RTT.set(v["p99_ms"], peer=str(peer), q="p99")
+            if med > 0 and len(rtt) > 1:
+                rtt_ratio[peer] = (v["p99_ms"] / med
+                                   if v["p99_ms"] >= rtt_floor_ms
+                                   else 1.0)
+    retries_now = ctx.send_retry_counts()
+    prev_r = hstate["prev_retries"]
+    window_retries = {p: n - prev_r.get(p, 0)
+                      for p, n in retries_now.items()}
+    hstate["prev_retries"] = retries_now
+
+    with dead_lock:
+        dead_now = set(dead_ranks)
+        bench_now = set(benched_ranks) | set(quarantined_ranks)
+    # score every rank carrying a stage this round PLUS every
+    # quarantined rank (still beating — its RTT drives readmission)
+    for peer in sorted((set(stage_ranks) | set(quarantined_ranks))
+                       - dead_now - {args.rank}):
+        sample = health_mod.HealthSample(
+            service_ratio=service_ratio.get(peer),
+            rtt_ratio=rtt_ratio.get(peer),
+            send_retries=int(window_retries.get(peer, 0)))
+        floor_ok = False
+        if args.on_peer_degraded == "quarantine" \
+                and scorer.state_of(peer) in (health_mod.STATE_SUSPECT,
+                                              health_mod.STATE_PROBATION):
+            # min-fleet floor: quarantine (or a probation RELAPSE —
+            # also a quarantine decision) only if the NEXT round still
+            # has a runnable plan with this rank ALSO benched — the same
+            # failover cascade the boundary re-plan will actually run
+            planned = _plan_failover(args, next_sched, world_size,
+                                     dead_now,
+                                     benched=bench_now | {peer})
+            floor_ok = planned is not None
+        t = scorer.observe(peer, sample, can_quarantine=floor_ok)
+        if t is None:
+            continue
+        now = time.monotonic_ns()
+        if t.to == health_mod.STATE_QUARANTINED:
+            with dead_lock:
+                quarantined_ranks.add(peer)
+            telemetry.record("health", f"quarantine:r{peer}", now, now)
+            flight.note("peer_degraded", rank=peer, to=t.to,
+                        score=round(t.score, 4), reason=t.reason)
+            flight.maybe_dump("gray", context={
+                "rank": peer, "round": rnd, "score": t.score,
+                "reason": t.reason,
+                "health": scorer.snapshot()})
+            logger.warning("peer health: QUARANTINING rank %d at round "
+                           "%d (%s); its stage moves to a spare at the "
+                           "next boundary", peer, rnd, t.reason)
+            # machine-parseable line (tools/chaos_dcn.py + CI gate)
+            print(f"quarantine_rank={peer} round={rnd} "
+                  f"score={t.score:.4f}", flush=True)
+        elif t.frm == health_mod.STATE_QUARANTINED \
+                and t.to == health_mod.STATE_PROBATION:
+            with dead_lock:
+                quarantined_ranks.discard(peer)
+            telemetry.record("health", f"readmit:r{peer}", now, now)
+            flight.note("peer_readmitted", rank=peer,
+                        score=round(t.score, 4))
+            logger.warning("peer health: READMITTING rank %d on "
+                           "probation at round %d (%s)", peer, rnd,
+                           t.reason)
+            print(f"readmit_rank={peer} round={rnd} "
+                  f"score={t.score:.4f}", flush=True)
+        elif t.frm == t.to:
+            # floor hold (suspect stays suspect / probation relapse
+            # held): checked BEFORE the suspect branch, which would
+            # otherwise swallow a suspect-state hold as a second
+            # `suspect` span and keep gray.held at zero
+            telemetry.record("health", f"held:r{peer}", now, now)
+            flight.note("peer_quarantine_held", rank=peer,
+                        score=round(t.score, 4))
+        elif t.to == health_mod.STATE_SUSPECT:
+            telemetry.record("health", f"suspect:r{peer}", now, now)
+            flight.note("peer_suspect", rank=peer,
+                        score=round(t.score, 4), reason=t.reason)
+        else:                 # suspect/probation -> healthy
+            telemetry.record("health", f"recovered:r{peer}", now, now)
+            flight.note("peer_recovered", rank=peer,
+                        score=round(t.score, 4))
 
 
 def _plan_failover(args, sched, world_size: int, dead_now: set,
@@ -1177,6 +1377,19 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
                                    accuracy=src)
 
         ctx.register_heartbeat_hook(liveness_beat)
+
+        def rtt_sample(src: int, rtt_ms: float) -> None:
+            # per-probe feed for the monitoring snapshot / hb_rtt.csv
+            # (work = rtt ms, accuracy = peer rank); the p50/p99 gauge
+            # aggregation happens at round boundaries in
+            # _consider_peer_health from the transport's bounded window
+            with monitoring.get_locked_context(MONITORING_KEY_HB_RTT) \
+                    as mctx:
+                if mctx is not None:
+                    mctx.iteration(key=MONITORING_KEY_HB_RTT,
+                                   work=rtt_ms, accuracy=src)
+
+        ctx.register_heartbeat_rtt_hook(rtt_sample)
         ctx.start_heartbeat(
             interval=args.heartbeat_interval if args.heartbeat_interval > 0
             else None,
@@ -1209,6 +1422,25 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
                     cooldown=args.rebalance_cooldown,
                     confirm=args.rebalance_confirm,
                     align=4 if args.stage_tp > 1 else 1)
+            # peer-health plane (gray-failure detection): active whenever
+            # the fleet records spans — the scorer reads the same digest
+            # windows the rebalancer does. `--on-peer-degraded
+            # quarantine` forces telemetry on (main()); with `ignore` +
+            # --trace-spans the scorer still runs for observability
+            # (scores, suspect spans, flight events) but never benches.
+            health_state = None
+            if telemetry.enabled() and world_size > 1:
+                from pipeedge_tpu import health as health_mod
+                h_scorer = health_mod.PeerHealthScorer(
+                    [r for r in range(world_size) if r != rank],
+                    policy=health_mod.HealthPolicy(
+                        suspect_threshold=args.degraded_threshold,
+                        readmit_threshold=args.degraded_threshold / 2,
+                        confirm=args.degraded_confirm,
+                        readmit=args.degraded_readmit))
+                health_mod.set_scorer(h_scorer)
+                health_state = {"scorer": h_scorer, "prev_digests": {},
+                                "prev_retries": {}}
             schedules = [tuple(s) for s in schedules]
             try:
                 rnd = 0
@@ -1227,11 +1459,13 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
                         failover_event.clear()
                         with dead_lock:
                             dead_now = set(dead_ranks)
-                            bench_now = set(benched_ranks)
+                            bench_now = (set(benched_ranks)
+                                         | set(quarantined_ranks))
                         if dead_now or bench_now:
                             # a LATER schedule round may still name a rank
-                            # that died earlier (or rejoined un-healed);
-                            # remap before broadcasting
+                            # that died earlier (or rejoined un-healed, or
+                            # was gray-quarantined); remap before
+                            # broadcasting
                             if _heal_state["pre_failure"] is None:
                                 _heal_state["pre_failure"] = sched
                             sched = _plan_failover(args, sched, world_size,
@@ -1260,11 +1494,22 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
                                                  fo_t0, time.monotonic_ns())
                                 fo_t0 = None
                                 del _failover_detect_ns[:]
+                            # ONE digest sweep per boundary, shared by
+                            # the rebalancer and the peer-health scorer
+                            # (each differences it against its own
+                            # baseline — the digests are cumulative)
+                            boundary_digests = None
+                            if (rebalancer is not None
+                                    or health_state is not None) \
+                                    and sched_idx + 1 < len(schedules):
+                                boundary_digests = _collect_fleet_digests(
+                                    ctx, args, sched[2])
                             if rebalancer is not None \
                                     and sched_idx + 1 < len(schedules):
                                 proposal = _consider_rebalance(
                                     ctx, args, rebalancer, sched,
-                                    prev_digests, rnd - 1)
+                                    prev_digests, rnd - 1,
+                                    cur_digests=boundary_digests)
                                 if proposal is not None:
                                     # re-cut the REMAINING rounds; their
                                     # quant/rank specs stand, and a death
@@ -1276,6 +1521,18 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
                                         schedules[j] = (
                                             [tuple(p) for p in
                                              proposal.partition], q_j, r_j)
+                            if health_state is not None \
+                                    and sched_idx + 1 < len(schedules):
+                                # gray-failure decision at the boundary:
+                                # fold this round's measured signals and
+                                # quarantine/readmit before the next
+                                # round's re-plan (the round is fully
+                                # drained — a planned bench, no replay)
+                                _consider_peer_health(
+                                    ctx, args, health_state, sched,
+                                    schedules[sched_idx + 1], world_size,
+                                    rnd - 1,
+                                    cur_digests=boundary_digests)
                             if args.on_peer_rejoin == "heal" \
                                     and _heal_state["pending"] \
                                     and sched_idx + 1 < len(schedules):
@@ -1310,7 +1567,8 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
                         failover_event.clear()
                         with dead_lock:
                             dead_now = set(dead_ranks)
-                            bench_now = set(benched_ranks)
+                            bench_now = (set(benched_ranks)
+                                         | set(quarantined_ranks))
                         if _heal_state["pre_failure"] is None:
                             # the schedule running when the episode's
                             # death hit: what --on-peer-rejoin heal
@@ -1552,6 +1810,26 @@ def _make_tp_stage(args, l, r, stage, dtype, restored):
     return stage_fn, {}
 
 
+def _handle_corrupt_results(ctx, src: int, channel: int, exc) -> None:
+    """BELT-AND-BRACES handler: with --wire-crc the transport reader
+    verifies and recovers corrupt frames before they ever reach a
+    consumer, so this only fires on a config mismatch (producer armed
+    CRC, this receiver's PIPEEDGE_WIRE_CRC off). Count it, note it, and
+    request a latest-frame resend (no seq is known here). In failover
+    mode the ledger dedupes and re-orders the replayed frame by
+    microbatch id; without a ledger FIFO label pairing may shift by one
+    — the same caveat DCN_SEND_RETRIES carries outside failover mode."""
+    from pipeedge_tpu.comm import dcn
+    dcn.FRAMES_CORRUPT.inc(peer=str(src))
+    flight.note("frame_corrupt", peer=src, error=str(exc))
+    logger.error("results: corrupt frame from rank %d (%s); requesting "
+                 "resend", src, exc)
+    try:
+        ctx.request_resend(src, channel)
+    except OSError as rexc:
+        logger.error("resend request to rank %d failed: %s", src, rexc)
+
+
 def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                ubatches, labels, dtype, results_target,
                ledger: Optional[_MicrobatchLedger] = None,
@@ -1572,6 +1850,12 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
 
     rank, data_rank = args.rank, args.data_rank
     failover_mode = args.on_peer_death == "failover"
+    # frame integrity (--wire-crc / PIPEEDGE_WIRE_CRC): v2 frames carry a
+    # checksum trailer, verified before decode; a corrupt frame requests
+    # one bounded resend over the control channel. NaN guard
+    # (PIPEEDGE_NAN_GUARD=1): activations checked at stage boundaries.
+    wire_crc = getattr(args, "wire_crc", False) or _wire_crc_enabled()
+    guard_on = nan_guard.nan_guard_enabled()
     # cross-round frame isolation (see dcn.CHANNEL_ROUND_PARITY)
     parity = dcn.CHANNEL_ROUND_PARITY * (rnd % 2)
     # an ABORTING death is terminal for the whole run — stop_info is never
@@ -1731,19 +2015,48 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                                           if tensors[0].dtype.kind == 'f'
                                           else None)
                 else:
-                    payload = _wire_decode(tensors, dtype)
+                    try:
+                        payload = _wire_decode(tensors, dtype)
+                    except WireCorruptError as exc:
+                        # belt-and-braces: the transport reader verifies
+                        # CRC-flagged frames before enqueueing, so this
+                        # only fires on a config mismatch (producer
+                        # armed, this receiver's PIPEEDGE_WIRE_CRC off).
+                        # Drop + request a latest-frame resend; the
+                        # replay re-enters this stage's recv loop.
+                        dcn.FRAMES_CORRUPT.inc(peer=str(rank_src))
+                        flight.note("frame_corrupt", peer=rank_src,
+                                    error=str(exc))
+                        logger.error("stage %d: corrupt frame from rank "
+                                     "%d (%s); requesting resend", i,
+                                     rank_src, exc)
+                        try:
+                            ctx.request_resend(rank_src,
+                                               dcn.CHANNEL_DATA + parity)
+                        except OSError as rexc:
+                            logger.error("resend request to rank %d "
+                                         "failed: %s", rank_src, rexc)
+                        return dcn.DcnPipelineStage.SKIP
                 # mbid is the host-side wire tensor stripped above,
                 # never a device array: the asarray cannot sync
                 mb = (int(np.asarray(mbid).reshape(-1)[0])  # pipelint: disable=PL303
                       if mbid is not None else mb_seq[0])
                 mb_seq[0] += 1
+                if guard_on:
+                    # opt-in NaN/Inf guard at the stage INPUT boundary: a
+                    # poisoned microbatch dies loudly here (named error +
+                    # postmortem bundle) instead of propagating garbage.
+                    # The check is a host sync — exactly why it is opt-in.
+                    payload = nan_guard.check_finite(  # pipelint: disable=PL303
+                        payload, where=f"stage{i}/input", mb=mb)
                 # compute span: host dispatch of the jitted shard step
                 # (async under jit — device completion lands in the stage
                 # readback span, where the wire payload materializes)
                 with telemetry.span("compute", f"stage{i}", stage=i, mb=mb):
                     out = fn(params, payload)
                     pending = _wire_encode_device(
-                        out, edge.quant_bit if edge is not None else 0)
+                        out, edge.quant_bit if edge is not None else 0,
+                        crc=wire_crc)
                 first = out[0] if isinstance(out, tuple) else out
                 # keep the raw device output alive through the hand-off
                 # queue ONLY when the adaptive policy will read it — at
@@ -1869,24 +2182,37 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                         mbid = int(np.asarray(tensors[0]).reshape(-1)[0])
                         rid = (tctx.rid if tctx is not None
                                else ledger.trace_of(mbid))
-                        with telemetry.span("results", "deliver", mb=mbid,
-                                            rid=rid):
-                            out = _wire_decode(tensors[1:], dtype)
-                            # the ledger retains the DECODED result, not
-                            # the wire views — and a pooled recv buffer
-                            # is recycled only when nothing references
-                            # it (dcn._RecvBufferPool), so even a
-                            # retained view could never be overwritten
-                            if not ledger.ack(mbid, np.asarray(out),
-                                              epoch=epoch, src=last_rank):
-                                logger.info("failover: duplicate result "
-                                            "for microbatch %d dropped",
-                                            mbid)
-                            else:
-                                # periodic snapshot: keeps the replay a
-                                # mid-round death would trigger bounded
-                                # to the unacked in-flight window
-                                ledger.maybe_snapshot()
+                        try:
+                            with telemetry.span("results", "deliver",
+                                                mb=mbid, rid=rid):
+                                out = _wire_decode(tensors[1:], dtype)
+                                if guard_on:
+                                    out = nan_guard.check_finite(
+                                        out, where="results", mb=mbid,
+                                        rid=rid)
+                                # the ledger retains the DECODED result,
+                                # not the wire views — and a pooled recv
+                                # buffer is recycled only when nothing
+                                # references it (dcn._RecvBufferPool), so
+                                # even a retained view could never be
+                                # overwritten
+                                if not ledger.ack(mbid, np.asarray(out),
+                                                  epoch=epoch,
+                                                  src=last_rank):
+                                    logger.info("failover: duplicate "
+                                                "result for microbatch "
+                                                "%d dropped", mbid)
+                                else:
+                                    # periodic snapshot: keeps the replay
+                                    # a mid-round death would trigger
+                                    # bounded to the unacked window
+                                    ledger.maybe_snapshot()
+                        except WireCorruptError as exc:
+                            # the resent frame re-enters this loop and
+                            # acks by id — exactly-once holds
+                            _handle_corrupt_results(
+                                ctx, last_rank,
+                                dcn.CHANNEL_RESULTS + parity, exc)
                     return
                 for mbid in range(len(ubatches)):
                     if stop_event.is_set():
@@ -1899,10 +2225,20 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
                         # timeout, or the last stage died: the peer-death
                         # handler aborts the run; just stop consuming
                         return
-                    with telemetry.span("results", "deliver", mb=mbid,
-                                        rid=tctx.rid if tctx else None):
-                        out = _wire_decode(tensors, dtype)
-                        handle_results(np.asarray(out))
+                    try:
+                        with telemetry.span("results", "deliver", mb=mbid,
+                                            rid=tctx.rid if tctx else None):
+                            out = _wire_decode(tensors, dtype)
+                            if guard_on:
+                                out = nan_guard.check_finite(
+                                    out, where="results", mb=mbid)
+                            handle_results(np.asarray(out))
+                    except WireCorruptError as exc:
+                        # the replayed frame is consumed by a later
+                        # iteration of this loop (count stays whole)
+                        _handle_corrupt_results(
+                            ctx, last_rank, dcn.CHANNEL_RESULTS + parity,
+                            exc)
 
             results_thread = threading.Thread(target=results_loop,
                                               daemon=True)
@@ -2231,6 +2567,43 @@ def main():
                              "partition (or re-expands onto the restored "
                              "rank) at the next round boundary — "
                              "docs/FAULT_TOLERANCE.md")
+    parser.add_argument("--on-peer-degraded", default="ignore",
+                        choices=["ignore", "quarantine"],
+                        help="dcn mode reaction to a GRAY-failing peer — "
+                             "alive and beating, but its EWMA health "
+                             "score (relative stage service time, "
+                             "heartbeat RTT, send retries) confirmed a "
+                             "straggler: ignore scores and reports only; "
+                             "quarantine benches the rank at the next "
+                             "round boundary (a planned drain — its "
+                             "stage moves to a spare via the failover "
+                             "re-plan, no replay) and readmits it "
+                             "through probation when the score recovers. "
+                             "Forces span recording on (the scorer reads "
+                             "the rebalancer's digest windows); pass the "
+                             "flag to every rank — "
+                             "docs/FAULT_TOLERANCE.md gray failures")
+    parser.add_argument("--degraded-threshold", type=float, default=0.4,
+                        help="EWMA degradation score at which a rank "
+                             "turns suspect (readmit threshold is half "
+                             "this: the hysteresis band)")
+    parser.add_argument("--degraded-confirm", type=int, default=2,
+                        help="consecutive bad windows AFTER the suspect "
+                             "entry before quarantine (false-positive "
+                             "protection; the entry window never "
+                             "convicts alone)")
+    parser.add_argument("--degraded-readmit", type=int, default=2,
+                        help="consecutive recovered windows before a "
+                             "quarantined rank readmits on probation")
+    parser.add_argument("--wire-crc", action="store_true",
+                        help="frame integrity: checksum every wire-v2 "
+                             "frame (CRC32C when the wheel is present, "
+                             "zlib CRC32 otherwise; algorithm rides the "
+                             "frame), verify on receive, and recover a "
+                             "corrupt frame with one bounded resend "
+                             "over the control channel (cap = max(1, "
+                             "DCN_SEND_RETRIES)). Equivalent to env "
+                             "PIPEEDGE_WIRE_CRC=1; pass to every rank")
     parser.add_argument("--heartbeat-interval", type=float, default=0.0,
                         help="dcn liveness plane: seconds between heartbeat "
                              "frames to every peer (0 = env "
@@ -2350,6 +2723,21 @@ def main():
             parser.error("--rebalance auto on the host driver adapts the "
                          "microbatch size BETWEEN measure rounds: pass "
                          "--measure-rounds N > 1")
+    if args.on_peer_degraded == "quarantine":
+        if args.comm != "dcn":
+            parser.error("--on-peer-degraded quarantine applies to the "
+                         "dcn driver (per-process ranks)")
+        # quarantine acts at round boundaries, like --rebalance auto:
+        # refuse the silent no-op of a single-round run
+        if args.rounds == 1 and n_rounds == 1:
+            parser.error("--on-peer-degraded quarantine acts at round "
+                         "boundaries: pass --rounds N (or ';'-separated "
+                         "schedule rounds)")
+    if args.wire_crc:
+        # one process-wide switch (env), so the transport's resend cache
+        # and chaos corrupt@K see the same setting the codec does
+        from pipeedge_tpu.comm.wire import ENV_WIRE_CRC
+        os.environ[ENV_WIRE_CRC] = "1"
     if args.tp_quant_bits:
         has_tp_sites = (args.stage_tp > 1
                         or (args.comm == "spmd"
@@ -2449,18 +2837,23 @@ def main():
     monitoring.add_key(MONITORING_KEY_QUANT_DECODE, acc_type='bits')
     monitoring.add_key(MONITORING_KEY_LIVENESS, work_type='beats',
                        acc_type='rank')
+    monitoring.add_key(MONITORING_KEY_HB_RTT, work_type='ms',
+                       acc_type='rank')
 
     global _results_sink
     if args.save_results and not is_dcn_worker:
         _results_sink = []
 
-    if args.trace_spans or (args.rebalance == "auto" and args.comm == "dcn"):
+    if args.trace_spans or (args.comm == "dcn"
+                            and (args.rebalance == "auto"
+                                 or args.on_peer_degraded == "quarantine")):
         # every rank records; in dcn mode the data rank merges the fleet
         # (workers serve their rings over _MSG_SPANS), single-controller
         # drivers write their own single-rank timeline below. The
         # rebalancer's digests come from the same recorder (workers answer
         # _MSG_SPANS digest requests inline), so --rebalance auto records
-        # even without a trace destination.
+        # even without a trace destination — and the peer-health scorer
+        # (--on-peer-degraded quarantine) reads the same digest windows.
         telemetry.configure(rank=args.rank if args.comm == "dcn" else 0)
 
     try:

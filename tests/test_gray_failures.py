@@ -1,0 +1,587 @@
+"""Gray-failure plane tests (docs/FAULT_TOLERANCE.md gray failures):
+
+- health-scorer unit matrix: EWMA folding, hysteresis in both
+  directions, confirmation windows, the min-fleet floor hold, probation
+  readmission and relapse (pipeedge_tpu/health/scorer.py)
+- chaos grammar: slow / jitter / corrupt parsing incl. the bounded
+  slow@K-J:MS form (pipeedge_tpu/comm/chaos.py)
+- frame integrity: wire-v2 CRC trailer encode/verify, corruption
+  detection, the transport resend cache + bounded replay, heartbeat RTT
+  measurement (comm/wire.py, comm/dcn.py)
+- NaN/Inf activation guard: named error + postmortem bundle + counter
+  (pipeedge_tpu/health/guard.py)
+- trace_report `gray` section (telemetry/report.py)
+- tier-1 fleet acceptance: a persistent 80 ms straggler (slow@2-J:MS)
+  on a world-4 loopback fleet is quarantined at a round boundary, its
+  stage re-planned onto a spare, and readmitted through probation once
+  the chaos clears — while a clean fleet records ZERO quarantines.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from pipeedge_tpu import health  # noqa: E402
+from pipeedge_tpu.comm import chaos, dcn, wire  # noqa: E402
+from pipeedge_tpu.health import guard as nan_guard  # noqa: E402
+from pipeedge_tpu.health.scorer import (HealthPolicy,  # noqa: E402
+                                        HealthSample, PeerHealthScorer,
+                                        STATE_HEALTHY, STATE_PROBATION,
+                                        STATE_QUARANTINED, STATE_SUSPECT)
+from pipeedge_tpu.telemetry import report  # noqa: E402
+
+
+BAD = HealthSample(service_ratio=3.0)      # fully degraded (>= ratio_bad)
+GOOD = HealthSample(service_ratio=1.0)     # nominal
+EMPTY = HealthSample()
+
+
+def _scorer(**kw):
+    defaults = dict(alpha=1.0, suspect_threshold=0.4,
+                    readmit_threshold=0.2, confirm=1, readmit=1,
+                    probation=1)
+    defaults.update(kw)
+    return PeerHealthScorer([1, 2, 3], policy=HealthPolicy(**defaults))
+
+
+# -- scorer unit matrix ------------------------------------------------
+
+def test_scorer_healthy_rank_never_transitions():
+    s = _scorer()
+    for _ in range(10):
+        assert s.observe(1, GOOD) is None
+    assert s.state_of(1) == STATE_HEALTHY
+    assert s.score_of(1) == 0.0
+
+
+def test_scorer_suspect_then_quarantine_with_confirmation():
+    s = _scorer(confirm=2)
+    t = s.observe(1, BAD)
+    assert t is not None and t.to == STATE_SUSPECT
+    # confirm=2: the entry window never convicts; two MORE bad windows do
+    assert s.observe(1, BAD) is None
+    t = s.observe(1, BAD)
+    assert t is not None and t.to == STATE_QUARANTINED
+    assert s.quarantined() == [1]
+
+
+def test_scorer_ewma_smooths_single_noisy_window():
+    # alpha 0.25: one fully-bad window moves the score to 0.25 < 0.4 —
+    # a single noisy window never even makes suspect
+    s = _scorer(alpha=0.25)
+    assert s.observe(1, BAD) is None
+    assert s.state_of(1) == STATE_HEALTHY
+    assert 0.2 < s.score_of(1) < 0.3
+
+
+def test_scorer_suspect_recovers_without_quarantine():
+    s = _scorer(confirm=3)
+    assert s.observe(1, BAD).to == STATE_SUSPECT
+    t = s.observe(1, GOOD)
+    assert t is not None and t.to == STATE_HEALTHY
+    # the streak reset: going bad again needs full re-confirmation
+    assert s.observe(1, BAD).to == STATE_SUSPECT
+    assert s.observe(1, GOOD).to == STATE_HEALTHY
+
+
+def test_scorer_min_fleet_floor_holds_suspect():
+    s = _scorer(confirm=1)
+    assert s.observe(1, BAD, can_quarantine=False).to == STATE_SUSPECT
+    # confirmed, but the floor refuses: a single "held" note, no bench
+    t = s.observe(1, BAD, can_quarantine=False)
+    assert t is not None and t.frm == t.to == STATE_SUSPECT
+    assert "held" in t.reason
+    assert s.observe(1, BAD, can_quarantine=False) is None  # fires once
+    assert s.quarantined() == []
+    # the floor clears (a spare appeared): quarantine proceeds
+    assert s.observe(1, BAD, can_quarantine=True).to == STATE_QUARANTINED
+
+
+def test_scorer_probation_readmit_and_graduation():
+    s = _scorer(readmit=2, probation=2)
+    s.observe(1, BAD)
+    s.observe(1, BAD)
+    assert s.state_of(1) == STATE_QUARANTINED
+    # readmit=2: two consecutive recovered windows
+    assert s.observe(1, GOOD) is None
+    t = s.observe(1, GOOD)
+    assert t is not None and t.to == STATE_PROBATION
+    # probation=2: two clean windows graduate to healthy
+    assert s.observe(1, GOOD) is None
+    assert s.observe(1, GOOD).to == STATE_HEALTHY
+
+
+def test_scorer_probation_relapse_respects_the_floor():
+    """A probation relapse is still a QUARANTINE decision: with no
+    runnable plan left (the spare died meanwhile) the rank is HELD on
+    probation — running degraded beats aborting the fleet."""
+    s = _scorer()
+    s.observe(1, BAD)
+    s.observe(1, BAD)
+    s.observe(1, GOOD)
+    assert s.state_of(1) == STATE_PROBATION
+    t = s.observe(1, BAD, can_quarantine=False)
+    assert t is not None and t.frm == t.to == STATE_PROBATION
+    assert "held" in t.reason
+    # the floor clears: the relapse proceeds
+    assert s.observe(1, BAD, can_quarantine=True).to == STATE_QUARANTINED
+
+
+def test_scorer_probation_relapse_requarantines_without_confirmation():
+    s = _scorer(confirm=3)
+    for _ in range(4):
+        s.observe(1, BAD)
+    assert s.state_of(1) == STATE_QUARANTINED
+    s.observe(1, GOOD)
+    assert s.state_of(1) == STATE_PROBATION
+    # ONE bad probation window relapses (no 3-window re-confirmation)
+    t = s.observe(1, BAD)
+    assert t is not None and t.to == STATE_QUARANTINED
+
+
+def test_scorer_empty_sample_holds_everything():
+    s = _scorer()
+    s.observe(1, BAD)
+    s.observe(1, BAD)
+    assert s.state_of(1) == STATE_QUARANTINED
+    score = s.score_of(1)
+    for _ in range(5):
+        assert s.observe(1, EMPTY) is None
+    # absence of evidence neither readmits nor convicts
+    assert s.state_of(1) == STATE_QUARANTINED
+    assert s.score_of(1) == score
+
+
+def test_scorer_signal_fusion_takes_the_worst_signal():
+    pol = HealthPolicy(rtt_bad=3.0, retries_bad=3)
+    assert pol.degradation(HealthSample(service_ratio=1.0,
+                                        rtt_ratio=3.0)) == 1.0
+    assert pol.degradation(HealthSample(send_retries=3)) == 1.0
+    assert pol.degradation(HealthSample(service_ratio=1.0, rtt_ratio=1.0,
+                                        send_retries=0)) == 0.0
+    assert pol.degradation(EMPTY) is None
+
+
+def test_scorer_snapshot_and_module_singleton():
+    s = _scorer()
+    s.observe(1, BAD)
+    health.set_scorer(s)
+    try:
+        snap = health.snapshot()
+        assert snap["1"]["state"] == STATE_SUSPECT
+        assert snap["2"]["state"] == STATE_HEALTHY
+        assert snap["1"]["score"] >= 0.4
+    finally:
+        health.set_scorer(None)
+    assert health.snapshot() == {}
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        HealthPolicy(alpha=0.0)
+    with pytest.raises(ValueError):
+        HealthPolicy(suspect_threshold=0.2, readmit_threshold=0.3)
+    with pytest.raises(ValueError):
+        HealthPolicy(confirm=0)
+    with pytest.raises(ValueError):
+        HealthPolicy(ratio_bad=1.0)
+
+
+# -- chaos grammar ------------------------------------------------------
+
+def test_chaos_grammar_gray_faults():
+    spec = chaos.ChaosSpec.parse("slow@2:80;jitter@3-9:40;corrupt@5")
+    kinds = {a.kind: a for a in spec.actions}
+    assert kinds["slow"].at_send == 2 and kinds["slow"].delay_ms == 80
+    assert kinds["slow"].until_send is None
+    assert kinds["jitter"].at_send == 3 and kinds["jitter"].until_send == 9
+    assert kinds["corrupt"].at_send == 5
+    spec = chaos.ChaosSpec.parse("slow@2-12:80")
+    assert spec.actions[0].until_send == 12
+
+
+def test_chaos_grammar_rejects_bad_gray_clauses():
+    for bad in ("slow@x:80", "jitter@2-z:10", "corrupt@", "wat@3"):
+        with pytest.raises(ValueError):
+            chaos.ChaosSpec.parse(bad)
+    # a missing MS parses to 0 delay (the delay@K: precedent)
+    assert chaos.ChaosSpec.parse("jitter@2:").actions[0].delay_ms == 0
+
+
+# -- frame integrity (wire CRC) ----------------------------------------
+
+def test_wire_crc_roundtrip_and_flag():
+    import jax.numpy as jnp
+    out = jnp.asarray(np.random.default_rng(0).normal(
+        size=(4, 8)).astype(np.float32))
+    pending = wire.wire_encode_device(out, 8, crc=True)
+    frame = pending.finalize()
+    header = np.asarray(frame[0])
+    assert int(header[3]) & wire.FLAG_CRC
+    crc_t = np.asarray(frame[-1], np.uint32)
+    assert crc_t.shape == (2,)
+    decoded = wire.wire_decode(frame, jnp.float32)
+    ref = wire.wire_decode(wire.wire_encode_device(out, 8,
+                                                   crc=False).finalize(),
+                           jnp.float32)
+    np.testing.assert_array_equal(np.asarray(decoded), np.asarray(ref))
+
+
+def test_wire_crc_detects_corruption():
+    import jax.numpy as jnp
+    out = jnp.asarray(np.arange(64, dtype=np.float32).reshape(8, 8))
+    frame = wire.wire_encode_device(out, 8, crc=True).finalize()
+    # flip one bit in the packed payload (not header, not crc)
+    sizes = [t.nbytes for t in frame[1:-1]]
+    idx = 1 + sizes.index(max(sizes))
+    bad = list(frame)
+    victim = np.asarray(bad[idx]).copy()
+    victim.reshape(-1).view(np.uint8)[0] ^= 1
+    bad[idx] = victim
+    with pytest.raises(wire.WireCorruptError):
+        wire.wire_decode(bad, jnp.float32)
+
+
+def test_wire_crc_absent_flag_still_decodes():
+    import jax.numpy as jnp
+    out = jnp.asarray(np.ones((2, 4), np.float32))
+    frame = wire.wire_encode_device(out, 0, crc=False).finalize()
+    assert not (int(np.asarray(frame[0])[3]) & wire.FLAG_CRC)
+    np.testing.assert_array_equal(
+        np.asarray(wire.wire_decode(frame, jnp.float32)), np.ones((2, 4)))
+
+
+def test_wire_crc_local_parts_carry_no_trailer():
+    # the colocated tier ships pending.parts WITHOUT finalize: no flag,
+    # no checksum tensor — in-process hand-offs never pay the CRC
+    import jax.numpy as jnp
+    pending = wire.wire_encode_device(jnp.ones((2, 2)), 0, crc=True)
+    header = np.asarray(pending.parts[0])
+    assert not (int(header[3]) & wire.FLAG_CRC)
+    assert len(pending.parts) == 2
+
+
+def test_frame_payload_bytes_ignores_crc_trailer():
+    import jax.numpy as jnp
+    out = jnp.asarray(np.zeros((4, 16), np.float32))
+    plain = wire.wire_encode_device(out, 8, crc=False).finalize()
+    checked = wire.wire_encode_device(out, 8, crc=True).finalize()
+    assert wire.frame_payload_bytes(checked) \
+        == wire.frame_payload_bytes(plain)
+
+
+def test_frame_checksum_algo_rides_the_frame():
+    algo, crc = wire.frame_checksum([np.arange(16, dtype=np.int32)])
+    assert algo in (wire.CRC_ALGO_CRC32C, wire.CRC_ALGO_CRC32)
+    # verify_frame recomputes with the frame's own algorithm
+    body = [np.arange(16, dtype=np.int32)]
+    wire.verify_frame(body, np.asarray([algo, crc], np.uint32))
+    with pytest.raises(wire.WireCorruptError):
+        wire.verify_frame(body, np.asarray([algo, crc ^ 1], np.uint32))
+
+
+# -- transport: RTT measurement + resend cache -------------------------
+
+def _free_ports(n):
+    socks = [socket.create_server(("127.0.0.1", 0)) for _ in range(n)]
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _make_contexts(n):
+    addrs = [("127.0.0.1", p) for p in _free_ports(n)]
+    ctxs = [dcn.DistDcnContext(n, r, addrs) for r in range(n)]
+    for c in ctxs:
+        c.init()
+    return ctxs
+
+
+def test_heartbeat_rtt_measured_per_peer():
+    ctxs = _make_contexts(2)
+    samples = []
+    try:
+        ctxs[0].register_heartbeat_rtt_hook(
+            lambda src, ms: samples.append((src, ms)))
+        ctxs[0].start_heartbeat([1], interval=0.1, miss_threshold=10)
+        deadline = time.monotonic() + 8.0
+        while time.monotonic() < deadline:
+            stats = ctxs[0].heartbeat_rtt_stats()
+            if stats.get(1, {}).get("n", 0) >= 3:
+                break
+            time.sleep(0.05)
+        stats = ctxs[0].heartbeat_rtt_stats()
+        assert 1 in stats, "no RTT samples came home"
+        assert stats[1]["n"] >= 3
+        assert 0.0 <= stats[1]["p50_ms"] <= stats[1]["p99_ms"] < 5000.0
+        assert samples and samples[0][0] == 1
+    finally:
+        for c in ctxs:
+            c.shutdown()
+
+
+def test_resend_cache_replays_last_frame_bounded(monkeypatch):
+    monkeypatch.setenv(wire.ENV_WIRE_CRC, "1")
+    import jax.numpy as jnp
+    ctxs = _make_contexts(2)
+    try:
+        payload = np.arange(32, dtype=np.float32).reshape(4, 8)
+        frame = wire.wire_encode_device(jnp.asarray(payload), 0,
+                                        crc=True).finalize()
+        ctxs[0].send_tensors(1, frame, channel=0)
+        first = ctxs[1].recv_tensors(0, timeout=5.0, channel=0)
+        np.testing.assert_array_equal(
+            np.asarray(wire.wire_decode(first, jnp.float32)), payload)
+        # consumer requests a replay: the cached frame arrives again
+        ctxs[1].request_resend(0, 0)
+        again = ctxs[1].recv_tensors(0, timeout=5.0, channel=0)
+        np.testing.assert_array_equal(
+            np.asarray(wire.wire_decode(again, jnp.float32)), payload)
+        # bounded: send_retries=0 -> cap max(1, 0) = 1 replay per frame
+        ctxs[1].request_resend(0, 0)
+        with pytest.raises(Exception):   # queue.Empty
+            ctxs[1].recv_tensors(0, timeout=1.0, channel=0)
+    finally:
+        for c in ctxs:
+            c.shutdown()
+
+
+def test_unflagged_frames_not_cached(monkeypatch):
+    """Raw frames (feed microbatches, v1) carry no CRC header: the
+    receiver can never verify or request them, so the producer must not
+    pin dead copies in the resend cache."""
+    monkeypatch.setenv(wire.ENV_WIRE_CRC, "1")
+    ctxs = _make_contexts(2)
+    try:
+        ctxs[0].send_tensors(1, [np.arange(8, dtype=np.float32)],
+                             channel=0)
+        ctxs[1].recv_tensors(0, timeout=5.0, channel=0)
+        assert not ctxs[0]._last_frames
+        # a latest-frame request just misses (logged, never raises)
+        ctxs[1].request_resend(0, 0)
+        time.sleep(0.3)
+    finally:
+        for c in ctxs:
+            c.shutdown()
+
+
+def test_corrupt_frame_recovered_transparently(monkeypatch):
+    """End-to-end integrity recovery: the RECEIVING READER verifies
+    CRC-flagged frames, drops a corrupt one, and requests its exact
+    sequence number back — the consumer only ever sees the clean
+    replay (what chaos corrupt@K exercises on a fleet)."""
+    monkeypatch.setenv(wire.ENV_WIRE_CRC, "1")
+    import jax.numpy as jnp
+    ctxs = _make_contexts(2)
+    try:
+        payload = np.random.default_rng(1).normal(
+            size=(8, 8)).astype(np.float32)
+        frame = wire.wire_encode_device(
+            jnp.asarray(payload), 8, crc=True).finalize()
+        before = dcn.FRAMES_CORRUPT.value(peer="0")
+        ctxs[0]._corrupt_next_send = True      # what chaos corrupt@K sets
+        ctxs[0].send_tensors(1, frame, channel=0)
+        got = ctxs[1].recv_tensors(0, timeout=10.0, channel=0)
+        # the corrupt original was dropped at the reader; this IS the
+        # clean replay, and it decodes
+        out = np.asarray(wire.wire_decode(got, jnp.float32))
+        assert np.isfinite(out).all()
+        assert dcn.FRAMES_CORRUPT.value(peer="0") == before + 1
+    finally:
+        for c in ctxs:
+            c.shutdown()
+
+
+def test_corrupt_frame_resend_is_seq_addressed(monkeypatch):
+    """Pipelined sends must not confuse the replay: frame A is corrupted
+    and frame B sent right behind it on the same channel. The reader
+    requests A BY SEQ, so the consumer receives B and then A's clean
+    replay — never B twice / A never."""
+    monkeypatch.setenv(wire.ENV_WIRE_CRC, "1")
+    import jax.numpy as jnp
+    ctxs = _make_contexts(2)
+    try:
+        pa = np.full((4, 4), 3.0, np.float32)
+        pb = np.full((4, 4), 7.0, np.float32)
+        fa = wire.wire_encode_device(jnp.asarray(pa), 0,
+                                     crc=True).finalize()
+        fb = wire.wire_encode_device(jnp.asarray(pb), 0,
+                                     crc=True).finalize()
+        ctxs[0]._corrupt_next_send = True
+        ctxs[0].send_tensors(1, fa, channel=0)   # corrupted in flight
+        ctxs[0].send_tensors(1, fb, channel=0)   # clean, right behind
+        got = [np.asarray(wire.wire_decode(
+                   ctxs[1].recv_tensors(0, timeout=10.0, channel=0),
+                   jnp.float32)) for _ in range(2)]
+        vals = sorted(float(g[0, 0]) for g in got)
+        assert vals == [3.0, 7.0], vals   # BOTH frames, exactly once
+    finally:
+        for c in ctxs:
+            c.shutdown()
+
+
+def test_send_retry_counts_snapshot():
+    ctxs = _make_contexts(2)
+    try:
+        assert ctxs[0].send_retry_counts() == {}
+    finally:
+        for c in ctxs:
+            c.shutdown()
+
+
+# -- NaN/Inf guard ------------------------------------------------------
+
+def test_nan_guard_off_by_default_passes_poison():
+    poisoned = np.asarray([[1.0, float("nan")]], np.float32)
+    assert nan_guard.check_finite(poisoned, "t") is poisoned
+
+
+def test_nan_guard_raises_named_error_and_writes_bundle(
+        tmp_path, monkeypatch):
+    from pipeedge_tpu.telemetry import flight
+    monkeypatch.setenv(nan_guard.ENV_NAN_GUARD, "1")
+    flight.configure(rank=0, out_dir=str(tmp_path))
+    before = nan_guard._POISONED.value()
+    clean = np.ones((2, 2), np.float32)
+    assert nan_guard.check_finite(clean, "t") is clean
+    with pytest.raises(health.PoisonedActivationError) as exc:
+        nan_guard.check_finite(
+            (clean, np.asarray([[np.inf]], np.float32)), "stage1/input",
+            mb=3, rid="r0.mb3")
+    assert "stage1/input" in str(exc.value)
+    assert nan_guard._POISONED.value() == before + 1
+    bundles = list(tmp_path.glob("postmortem-*poison*.json"))
+    assert bundles, "no poison postmortem written"
+    doc = json.loads(bundles[0].read_text())
+    assert doc["trigger"] == "poison"
+    assert doc["context"]["where"] == "stage1/input"
+    # integer payloads (token ids) can never poison
+    ids = np.asarray([[1, 2, 3]], np.int32)
+    assert nan_guard.check_finite(ids, "t") is ids
+
+
+# -- report: gray section ----------------------------------------------
+
+def test_report_gray_section():
+    t = 1_000_000
+    spans = [
+        {"cat": "health", "name": "suspect:r2", "rank": 0, "stage": None,
+         "mb": None, "t0": t, "t1": t},
+        {"cat": "health", "name": "quarantine:r2", "rank": 0,
+         "stage": None, "mb": None, "t0": t + 1, "t1": t + 1},
+        {"cat": "health", "name": "readmit:r2", "rank": 0, "stage": None,
+         "mb": None, "t0": t + 2, "t1": t + 2},
+        {"cat": "compute", "name": "stage0", "rank": 0, "stage": 0,
+         "mb": 0, "t0": t, "t1": t + 10},
+    ]
+    rec = report.analyze_spans(spans, span_cost_ns=100.0)
+    gray = rec["gray"]
+    assert gray["suspects"] == 1
+    assert gray["quarantines"] == 1
+    assert gray["readmits"] == 1
+    assert gray["by_rank"]["r2"] == ["suspect", "quarantine", "readmit"]
+
+
+def test_report_no_gray_section_on_clean_trace():
+    t = 1_000_000
+    spans = [{"cat": "compute", "name": "stage0", "rank": 0, "stage": 0,
+              "mb": 0, "t0": t, "t1": t + 10}]
+    rec = report.analyze_spans(spans, span_cost_ns=100.0)
+    assert rec["gray"] == {}
+
+
+# -- fleet acceptance (tier-1) -----------------------------------------
+
+_MODEL = "pipeedge/test-tiny-vit"
+
+
+def _run_gray_fleet(tmp_path, world, chaos_spec=None, victim=1, extra=(),
+                    rounds=8, batch=24, timeout=280):
+    """World-rank loopback fleet with the gray-failure plane armed;
+    returns (data rc, data stdout, worker outputs)."""
+    addrs = ",".join(f"127.0.0.1:{p}" for p in _free_ports(world))
+    common = [sys.executable, os.path.join(REPO, "runtime.py")]
+    opts = ["-c", "dcn", "--platform", "cpu", "-m", _MODEL,
+            "-b", str(batch), "-u", "4", "-pt", "1,4,5,8", "-q", "0,0",
+            "-r", "0,1", "--dcn-addrs", addrs, "--sched-timeout", "120",
+            "--on-peer-death", "failover",
+            "--on-peer-degraded", "quarantine",
+            "--degraded-confirm", "1", "--degraded-readmit", "1",
+            "--rounds", str(rounds),
+            "--heartbeat-interval", "0.5", "--heartbeat-miss", "8",
+            *extra]
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               DCN_CONNECT_TIMEOUT="30")
+    dirs = []
+    for r in range(world):
+        d = tmp_path / f"rank{r}"
+        d.mkdir(parents=True, exist_ok=True)
+        dirs.append(d)
+    workers = []
+    for r in range(1, world):
+        wenv = dict(env, DCN_CHAOS=chaos_spec) \
+            if (chaos_spec and r == victim) else env
+        workers.append(subprocess.Popen(
+            common + [str(r), str(world)] + opts, cwd=dirs[r], env=wenv,
+            text=True, stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    try:
+        data = subprocess.run(common + ["0", str(world)] + opts,
+                              cwd=dirs[0], env=env, capture_output=True,
+                              text=True, timeout=timeout)
+    finally:
+        wouts = []
+        for w in workers:
+            try:
+                out, _ = w.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                w.kill()
+                out, _ = w.communicate()
+            wouts.append(out)
+    return data, wouts
+
+
+@pytest.mark.fleet
+def test_gray_straggler_quarantined_then_readmitted(tmp_path):
+    """The tentpole acceptance: an 80 ms per-send straggler (never
+    missing a beat) is quarantined at a round boundary, its stage moves
+    to a spare (no replay — the round drained), and once the chaos
+    clears (bounded slow@2-18: sends 2..18 ~= the first three rounds)
+    probation readmits it. Round 0's jit-compile noise can mask the
+    straggler for one window, so the bound leaves two clean measured
+    windows either way."""
+    data, wouts = _run_gray_fleet(tmp_path, world=4,
+                                  chaos_spec="slow@2-18:80")
+    out = data.stdout + data.stderr
+    fleet = out + "\n==WORKERS==\n" + "\n==\n".join(
+        w[-4000:] for w in wouts)
+    assert data.returncode == 0, fleet
+    assert "quarantine_rank=1" in out, fleet
+    # the re-plan moved stage 1 off the straggler onto a spare
+    assert "moves rank 1 ->" in out, fleet
+    # probation readmission once the bounded chaos cleared
+    assert "readmit_rank=1" in out, fleet
+    # every round delivered its full batch (no results lost to the bench)
+    assert out.count("latency_sec=") == 8, fleet
+    # the quarantine was planned, not a death: no failover replay ran
+    assert "unacknowledged microbatch" not in out, fleet
+
+
+@pytest.mark.fleet
+def test_gray_clean_fleet_never_quarantines(tmp_path):
+    """False-positive protection: the same fleet with NO chaos must
+    finish with zero suspect/quarantine transitions."""
+    data, wouts = _run_gray_fleet(tmp_path, world=4, chaos_spec=None,
+                                  rounds=4)
+    out = data.stdout + data.stderr
+    assert data.returncode == 0, out
+    assert "quarantine_rank=" not in out, out
+    assert "readmit_rank=" not in out, out
+    assert out.count("latency_sec=") == 4, out

@@ -32,6 +32,11 @@ Emits one JSON line (plus pass-through logs with --verbose). Examples:
   # fleet's final round runs the pre-failure partition again
   python tools/chaos_dcn.py --world 4 --victim 1 --chaos restart@3:2000 \
       --rounds 3 --on-peer-rejoin heal --expect heal
+
+  # gray failure: an 80 ms per-send straggler never misses a beat; the
+  # peer-health plane must quarantine it at a round boundary
+  python tools/chaos_dcn.py --world 4 --victim 1 --chaos slow@2:80 \
+      --rounds 4 --on-peer-degraded quarantine --expect quarantine
 """
 import argparse
 import json
@@ -92,19 +97,33 @@ def main():
                         "data rank)")
     p.add_argument("--chaos", default="kill@3",
                    help="DCN_CHAOS spec: kill@K | hang@K | drop@K | "
-                        "delay@K:MS | restart@K:MS | flap@K:MS")
+                        "delay@K:MS | restart@K:MS | flap@K:MS | "
+                        "slow@K[-J]:MS | jitter@K[-J]:MS | corrupt@K")
     p.add_argument("--expect", default="recover",
-                   choices=["recover", "abort", "heal"],
+                   choices=["recover", "abort", "heal", "quarantine"],
                    help="recover: the run must complete; abort: the fleet "
                         "must stop naming the victim; heal: the run must "
                         "complete AND the victim must rejoin AND the "
                         "partition must heal (finite "
-                        "time_to_full_capacity_s)")
+                        "time_to_full_capacity_s); quarantine: the run "
+                        "must complete AND the peer-health plane must "
+                        "quarantine the victim (gray-failure faults — "
+                        "slow@K:MS with --on-peer-degraded quarantine)")
     p.add_argument("--on-peer-death", default="failover",
                    choices=["abort", "failover"])
     p.add_argument("--on-peer-rejoin", default="spare",
                    choices=["ignore", "spare", "heal"],
                    help="fleet rejoin policy (restart@K:MS faults)")
+    p.add_argument("--on-peer-degraded", default="ignore",
+                   choices=["ignore", "quarantine"],
+                   help="fleet gray-failure policy (slow/jitter faults; "
+                        "docs/FAULT_TOLERANCE.md gray failures)")
+    p.add_argument("--degraded-confirm", type=int, default=1,
+                   help="confirmation windows before quarantine (chaos "
+                        "experiments default to the fastest honest "
+                        "setting: suspect entry + 1 confirming window)")
+    p.add_argument("--degraded-readmit", type=int, default=1,
+                   help="recovered windows before probation readmission")
     p.add_argument("--rounds", type=int, default=1,
                    help="schedule rounds (heal applies at round "
                         "boundaries, so restart experiments need > 1)")
@@ -140,6 +159,9 @@ def main():
               "--sched-timeout", str(args.sched_timeout),
               "--on-peer-death", args.on_peer_death,
               "--on-peer-rejoin", args.on_peer_rejoin,
+              "--on-peer-degraded", args.on_peer_degraded,
+              "--degraded-confirm", str(args.degraded_confirm),
+              "--degraded-readmit", str(args.degraded_readmit),
               "--rounds", str(args.rounds),
               "--heartbeat-interval", str(args.heartbeat_interval),
               "--heartbeat-miss", str(args.heartbeat_miss)]
@@ -180,8 +202,12 @@ def main():
     for r in readers.values():
         r.join()
 
-    # the fault instant: the chaos module logs right before acting
-    fault = readers[args.victim].first("chaos:")
+    # the fault instant: the chaos module logs right before acting —
+    # skip the startup "chaos: installed <spec>" line, which arrives at
+    # process launch and would fold model-build/jit time into every
+    # detection latency (slow/jitter log an explicit arming line)
+    fault = next(((t, line) for t, line in readers[args.victim].lines
+                  if "chaos:" in line and "installed" not in line), None)
     # the data rank may detect the death itself ("entering failover") or
     # learn it from a survivor's CMD_DEAD ("announced dead")
     detect = (readers[0].first("entering failover")
@@ -203,6 +229,10 @@ def main():
         for tok in healed[1].split():
             if tok.startswith("time_to_full_capacity_s="):
                 ttfc = float(tok.split("=", 1)[1])
+    # gray-failure timeline (slow/jitter faults): the data rank prints
+    # one machine-parseable line per quarantine and per readmission
+    quarantine = readers[0].first("quarantine_rank=")
+    readmit = readers[0].first("readmit_rank=")
     completed = (not timed_out and data.returncode == 0
                  and recover is not None)
     aborted = (not timed_out and data.returncode not in (None, 0)
@@ -231,6 +261,12 @@ def main():
         # the data rank's own detection->healed clock (finite only when
         # a heal actually closed the episode)
         "time_to_full_capacity_s": ttfc,
+        # gray-failure timeline: fault -> quarantine (a planned bench at
+        # a round boundary), quarantine -> probation readmission
+        "quarantine_s": (round(quarantine[0] - fault[0], 3)
+                         if quarantine and fault else None),
+        "readmit_s": (round(readmit[0] - quarantine[0], 3)
+                      if readmit and quarantine else None),
         "total_s": round(time.monotonic() - t0, 3),
         "replayed": replayed,
     }
@@ -242,6 +278,8 @@ def main():
                       file=sys.stderr)
     if args.expect == "heal":
         ok = completed and rejoin is not None and ttfc is not None
+    elif args.expect == "quarantine":
+        ok = completed and quarantine is not None
     elif args.expect == "recover":
         ok = completed
     else:

@@ -27,6 +27,10 @@ Endpoints (all JSON unless noted):
                                "admission": {queue_depth, in_flight,
                                shed_classes, service_rate_rps, ...},
                                "brownout": {level, name, floor, ...}},
+                               "peer_health": {rank: {state, score,
+                               windows}} (the gray-failure scorer's
+                               per-peer view when one runs here, {}
+                               otherwise — docs/FAULT_TOLERANCE.md),
                                "stats": {tokens, active,
                                pending, prefixes,
                                degraded_entered_total,
@@ -128,6 +132,7 @@ from typing import Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from pipeedge_tpu import health as peer_health  # noqa: E402
 from pipeedge_tpu import telemetry  # noqa: E402
 from pipeedge_tpu.serving import (AdmissionController,  # noqa: E402
                                   AdmissionShed, BrownoutLadder,
@@ -1051,6 +1056,10 @@ def make_handler(service, model_name):
                             "degraded": degraded,
                             "serving": service.serving_stats(),
                             "flight": service.flight_stats(),
+                            # per-peer gray-failure scores when a
+                            # peer-health scorer runs in this process
+                            # (docs/FAULT_TOLERANCE.md); {} otherwise
+                            "peer_health": peer_health.snapshot(),
                             "stats": service.stats()})
             else:
                 self._send(404, {"error": "unknown path"})

@@ -22,6 +22,10 @@ chaos_dcn.py idiom — with:
   by class and reason, brownout transitions + max rung (docs/SERVING.md)
 - `requests`: distinct traced request ids + the worst-N by end-to-end
   duration — the entry point into `--request` when nothing else named one
+- `gray`: peer-health lifecycle transitions (suspect / quarantine /
+  readmit / recovered / floor-held) per affected rank — the gray-failure
+  CI smoke gates on exactly one quarantine under an injected straggler
+  and ZERO on a clean run (docs/FAULT_TOLERANCE.md gray failures)
 - `failover`: detection -> recovery breakdown when a failover happened
 - `span_overhead_pct`: the recorder's own measured hot-path tax (per-span
   cost measured live on this host x span count / window)
