@@ -1,525 +1,77 @@
-"""Headline benchmark: ViT-Large images/sec on the available TPU chip(s).
+"""Benchmark observatory CLI: run one scenario recipe, print ONE JSON line.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
-Baseline: the reference's best in-repo single-device ViT-Large number —
-0.22 img/s on RCC-VE-C2000 at batch=8 (BASELINE.md, README_Scheduler.md:213-239).
+`bench.py` is now a thin dispatcher over the `pipeedge_tpu/benchkit/`
+recipe registry (docs/PERF.md has the catalog and the trajectory-record
+schema). The default recipe is `exact` — the historical ViT-Large
+headline — so a bare `python bench.py` still produces the BENCH record
+it always did (same keys, now inside the schema-versioned envelope every
+recipe shares: scenario, config fingerprint, environment stamp,
+noise-banded throughput block).
 
-Reported extras (BASELINE.md north-star metric definition):
-- p50_microbatch_latency_ms: median per-microbatch latency, measured as
-  t(result readback) - t(enqueue) for individually dispatched microbatches
-  (the reference's latency method, runtime.py:493-505, per microbatch).
-  Includes one host<->device round trip — on the tunneled axon platform
-  that round trip is tens of ms; steady_state_ubatch_ms carries the
-  throughput-derived per-microbatch time for comparison.
-- mfu: achieved model FLOP/s over a peak calibrated at bench start by
-  timing chained 8192^3 bf16 matmuls (2*M*N*K FLOPs convention throughout).
+Usage:
+    python bench.py                        # the exact headline (ViT-L b8)
+    python bench.py --recipe serve         # goodput bench at 3x overload
+    python bench.py --recipe quant_collectives --model ... --ubatches 8
+    python bench.py --list-recipes
+    python bench.py --recipe exact -- --help        # recipe flags
+    python bench.py --recipe serve --append-record BENCH_r06.json
 
-Method: microbatches are streamed through the model inside ONE jitted
-`lax.scan` program (the single-stage degenerate of the SPMD pipeline), inputs
-device-resident, and a scalar reduction of the logits is read back to fence
-execution — `block_until_ready` alone does not fence on the tunneled axon
-platform. Blocks run unrolled (registry.should_unroll_blocks): measured ~6%
-over the scanned layout on this model (see models/shard.py).
-
-Statistics: the throughput loop runs REPS timed repetitions; the headline
-`value` is the MEDIAN img/s, with min/max spread and raw per-rep samples in
-the JSON so session-to-session drift (measured 750–943 img/s across tunnel
-sessions, docs/PERF.md) is visible inside one record. MFU is reported
-against BOTH denominators: the session-calibrated peak (chained 8192³ bf16
-matmuls) and the platform's nominal bf16 spec when the device kind is known.
+`--append-record FILE` additionally folds the record into a
+multi-scenario artifact (one record per scenario, newest wins) — how a
+BENCH_r0N.json re-arms per PR. `tools/bench_report.py` diffs two such
+artifacts (or single records) with per-metric noise bands and gates CI.
 """
 import argparse
 import json
-import statistics
-import time
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-BASELINE_IMG_PER_SEC = 0.22  # ViT-Large b=8 on RCC-VE-C2000 (BASELINE.md)
-
-REPS = 5  # timed repetitions of the streaming loop (median reported)
-
-# Nominal dense bf16 peak FLOP/s by device kind (public TPU spec sheets).
-# Used as the second MFU denominator; absent kinds report null.
-NOMINAL_BF16_PEAK = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-}
+import sys
 
 
-# The PINNED peak-TFLOP calibration recipe (round-5 verdict item 7).
-# Version it; never change a field without bumping `version` — the MFU
-# denominators of different BENCH records are only comparable within one
-# recipe version. Per-session spread is recorded alongside every result
-# so the ±% error bars on calibrated MFU are explicit in the record.
-CALIBRATION_RECIPE = {
-    "version": "cal-v1",
-    "matmul_mnk": [8192, 8192, 8192],
-    "chain_length": 32,
-    "dtype": "bfloat16",
-    "accumulate": "float32",
-    "protocol": "one jitted lax.scan chain; 1 compile+warm call, then "
-                "3 timed reps fenced by scalar readback; peak = best "
-                "rep, spread = all reps",
-}
+def main() -> int:
+    from pipeedge_tpu import benchkit
+    from pipeedge_tpu.benchkit import schema
 
+    p = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0], add_help=False)
+    p.add_argument("-h", "--help", action="store_true")
+    p.add_argument("--recipe", default="exact",
+                   help="scenario recipe to run (--list-recipes)")
+    p.add_argument("--list-recipes", action="store_true",
+                   help="print the recipe catalog and exit")
+    p.add_argument("--append-record", metavar="FILE", default=None,
+                   help="also fold the record into the multi-scenario "
+                        "artifact at FILE (created when missing)")
+    p.add_argument("--notes", default=None,
+                   help="free-form provenance appended to the record's "
+                        "notes field (e.g. the r05->r06 gap note)")
+    args, rest = p.parse_known_args()
+    if rest and rest[0] == "--":
+        rest = rest[1:]         # `bench.py --recipe X -- <recipe flags>`
 
-def _calibrate_peak_samples(m: int = None) -> list:
-    """Per-rep implied bf16 FLOP/s (2*M*N*K) under CALIBRATION_RECIPE;
-    the chain amortizes dispatch/tunnel latency out of the measurement.
-    max(samples) is the session peak; the spread IS the error bar on
-    every calibrated-MFU number this session. A non-default `m`
-    (--cal-dim, CPU-loopback A/B runs) is off-recipe: its MFU numbers
-    are marked and never comparable across records."""
-    if m is None:
-        m = CALIBRATION_RECIPE["matmul_mnk"][0]
-    k_iters = CALIBRATION_RECIPE["chain_length"]
-    a = jnp.ones((m, m), jnp.bfloat16)
-    b = jnp.ones((m, m), jnp.bfloat16)
+    if args.list_recipes:
+        for recipe in benchkit.list_recipes():
+            print(f"{recipe.name:18s} [{recipe.tier:5s}] {recipe.help}")
+        return 0
+    if args.help:
+        recipe_given = any(a == "--recipe" or a.startswith("--recipe=")
+                           for a in sys.argv[1:])
+        if recipe_given:
+            rest = ["--help"]   # delegate to the recipe's own parser
+        else:
+            p.print_help()
+            return 0
 
-    @jax.jit
-    def mm(a, b):
-        def step(c, _):
-            y = jnp.dot(c, b, preferred_element_type=jnp.float32)
-            return y.astype(jnp.bfloat16) * 1e-4, None
-
-        out, _ = jax.lax.scan(step, a, None, length=k_iters)
-        return jnp.sum(out.astype(jnp.float32))
-
-    float(mm(a, b))  # compile + warm
-    samples = []
-    for _ in range(3):
-        tik = time.monotonic()
-        float(mm(a, b))
-        samples.append(2 * k_iters * m**3 / (time.monotonic() - tik))
-    return samples
-
-
-def _calibrate_peak_flops() -> float:
-    return max(_calibrate_peak_samples())
-
-
-def _model_flops_per_image(cfg) -> float:
-    """Analytic ViT forward FLOPs per image (2*MAC convention)."""
-    s = cfg.num_patches + 1
-    d, i, l = cfg.hidden_size, cfg.intermediate_size, cfg.num_hidden_layers
-    per_block = 8 * s * d * d + 4 * s * s * d + 4 * s * d * i
-    embed = 2 * s * (cfg.patch_size**2 * cfg.num_channels) * d
-    head = 2 * d * max(cfg.num_labels, 1)
-    return l * per_block + embed + head
-
-
-def _top1_agreement(logits_exact: np.ndarray, logits_var: np.ndarray) -> dict:
-    """The accuracy-delta fields EVERY non-exact bench variant reports
-    beside its throughput (fast_numerics, quant_collectives, ...): a
-    non-exact number without its agreement is not self-describing."""
-    return {
-        "top1_agreement_vs_exact": round(float(np.mean(
-            np.argmax(logits_exact, -1) == np.argmax(logits_var, -1))), 4),
-        "max_abs_logit_delta": round(
-            float(np.max(np.abs(logits_exact - logits_var))), 4),
-    }
-
-
-def _quant_collectives_ab(name, bits: int, xs, flops_img: float,
-                          peak_flops: float, nominal_peak) -> dict:
-    """A/B for ROADMAP item 2: the SAME streamed TP run with exact
-    full-width psums vs int`bits` quantized collectives
-    (ops/qcollectives.py qpsum at every Megatron psum site in
-    parallel/tensor.py), interleaved rounds so session drift hits both
-    sides equally. Reports img/s for both, the speedup quotient, the
-    top-1 agreement + max-abs logit delta vs the exact side, and the
-    traced wire footprint (docs/QUANT_COLLECTIVES.md).
-
-    Needs >= 2 devices on the TP axis — a single-device backend has no
-    ICI collective site to quantize, and the block says so instead of
-    reporting a vacuous measurement."""
-    from functools import partial
-
-    from jax.sharding import Mesh, PartitionSpec as P
-
-    from pipeedge_tpu.models import registry
-    from pipeedge_tpu.ops import qcollectives
-    from pipeedge_tpu.parallel import tensor as tp
-    from pipeedge_tpu.utils import jax_compat
-
-    entry = registry.get_model_entry(name)
-    cfg = entry.config
-    devs = jax.devices()
-    n_tp, d = 1, 2
-    while (d <= len(devs) and cfg.num_attention_heads % d == 0
-           and cfg.intermediate_size % d == 0 and cfg.kv_heads % d == 0):
-        n_tp, d = d, d * 2
-    if n_tp < 2:
-        return {"mode": "skipped", "bits": bits,
-                "reason": f"{len(devs)} device(s) available: no ICI "
-                          "collective sites (the TP axis needs >= 2 "
-                          "devices dividing the head/FFN dims)"}
-    _, params, _ = registry.module_shard_factory(
-        name, None, 1, registry.get_model_layers(name),
-        dtype=jnp.bfloat16, unroll=True)
-    mesh = Mesh(np.asarray(devs[:n_tp]), ("tp",))
-    blocks = tuple(tp.shard_block_params(cfg, bp, mesh)
-                   for bp in params["blocks"])
-    family = entry.family
-    embed_p = jax.device_put(params.get("embeddings"))
-    final_p = jax.device_put(params.get("final"))
-    specs, local = tp.family_tp_plan(cfg)
-
-    def build_and_warm(mode_bits: int):
-        # the collective bitwidth is a trace-time flag: pin it across the
-        # fresh shard_map body + jit wrapper AND their first (tracing)
-        # call, then restore exact for everything else in this process
-        tp.set_tp_quant_bits(mode_bits)
-        try:
-            body = jax_compat.shard_map(
-                partial(local, cfg=cfg, axis="tp"), mesh=mesh,
-                in_specs=(specs, P()), out_specs=P())
-
-            @jax.jit
-            def run_all(ep, fp, bps, xs):
-                def step(carry, x):
-                    h = family.embed(ep, x, cfg)
-                    for bp in bps:
-                        h = body(bp, h)
-                    logits = family.finalize(fp, h, cfg)
-                    return carry + jnp.sum(logits.astype(jnp.float32)), None
-
-                total, _ = jax.lax.scan(step, jnp.float32(0), xs)
-                return total
-
-            @jax.jit
-            def run_one(ep, fp, bps, x):
-                h = family.embed(ep, x, cfg)
-                for bp in bps:
-                    h = body(bp, h)
-                return family.finalize(fp, h, cfg)
-
-            logits = np.asarray(run_one(embed_p, final_p, blocks,
-                                        xs[0]).astype(jnp.float32))
-            # run_one traced the SAME psum sites run_all is about to: drop
-            # its tally entries so the wire accounting below counts each
-            # site once, with run_all's execution multiplier
-            qcollectives.reset_trace_tally()
-            float(run_all(embed_p, final_p, blocks, xs))   # compile + warm
-        finally:
-            tp.set_tp_quant_bits(0)
-        return run_all, logits
-
-    n_ubatch, batch = xs.shape[0], xs.shape[1]
-    run_exact, logits_exact = build_and_warm(0)
-    run_q, logits_q = build_and_warm(bits)
-    q_times, exact_times = [], []
-    for _ in range(3):
-        tik = time.monotonic()
-        float(run_exact(embed_p, final_p, blocks, xs))
-        exact_times.append(time.monotonic() - tik)
-        tik = time.monotonic()
-        float(run_q(embed_p, final_p, blocks, xs))
-        q_times.append(time.monotonic() - tik)
-    q_img = statistics.median(n_ubatch * batch / t for t in q_times)
-    exact_img = statistics.median(n_ubatch * batch / t for t in exact_times)
-    # per-run executions of each traced qpsum site: the block loop is
-    # unrolled, so every site runs once per scan step (per microbatch)
-    # over 1 warm + 3 timed run_all calls; run_one's single execution per
-    # site was dropped from the tally above (one logits probe, < 1% of
-    # the streamed traffic)
-    collectives = qcollectives.record_collectives(
-        executions=4 * n_ubatch)
-    q_achieved = q_img * flops_img
-    return {
-        "mode": "tp-shard-map",
-        "bits": bits,
-        "tp": n_tp,
-        "images_per_sec": round(q_img, 3),
-        "exact_interleaved_images_per_sec": round(exact_img, 3),
-        "speedup_vs_exact": round(q_img / exact_img, 3),
-        "mfu_calibrated": round(q_achieved / peak_flops, 3),
-        "mfu_nominal": (round(q_achieved / nominal_peak, 3)
-                        if nominal_peak else None),
-        "achieved_tflops": round(q_achieved / 1e12, 1),
-        **_top1_agreement(logits_exact, logits_q),
-        "collectives": collectives,
-    }
-
-
-def main():
-    from pipeedge_tpu.models import registry
-    from pipeedge_tpu.models.layers import set_fast_numerics
-    from pipeedge_tpu.utils import require_live_backend
-
-    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    parser.add_argument("--tp-quant-bits", type=int, default=8,
-                        choices=[8, 4],
-                        help="bitwidth of the quant_collectives A/B "
-                             "variant (runtime.py --tp-quant-bits; "
-                             "docs/QUANT_COLLECTIVES.md)")
-    parser.add_argument("--model", default="google/vit-large-patch16-224",
-                        help="model to bench (default: the ViT-L headline; "
-                             "non-default models re-name the metric)")
-    parser.add_argument("--ubatches", type=int, default=128,
-                        help="microbatches in the streamed set (128 "
-                             "amortizes dispatch overhead on TPU; lower "
-                             "for CPU-loopback A/B evidence runs)")
-    parser.add_argument("--reps", type=int, default=REPS,
-                        help="timed repetitions (median reported)")
-    parser.add_argument("--cal-dim", type=int,
-                        default=CALIBRATION_RECIPE["matmul_mnk"][0],
-                        help="calibration matmul dimension; non-default "
-                             "values are off-recipe (MFU marked, not "
-                             "comparable across records) — for CPU-"
-                             "loopback A/B runs where 8192^3 is "
-                             "infeasible")
-    args = parser.parse_args()
-
-    # Pin exact numerics for the headline/calibration passes BEFORE any
-    # trace: an inherited PIPEEDGE_FAST_NUMERICS=1 would otherwise compile
-    # the "exact" side of the A/B in fast mode too, reporting a ~1.0
-    # speedup while claiming exact-parity numerics (ADVICE.md r5).
-    set_fast_numerics(False)
-
-    name = args.model
-    family_name = registry.get_model_entry(name).family.FAMILY.name
-    if family_name not in ("vit", "deit"):
-        # the streamed loop builds pixel inputs from patch geometry and
-        # the TP A/B assumes the dense column/row kernel plan — token
-        # families would crash mid-bench after the compile time is spent
-        parser.error(f"--model must be an image family (vit/deit) for "
-                     f"this bench; {name} is family '{family_name}'")
-    metric = ("vit_large_images_per_sec_b8"
-              if name == "google/vit-large-patch16-224"
-              else f"{name.rsplit('/', 1)[-1].replace('-', '_')}"
-                   "_images_per_sec_b8")
-    # lease-neutral wedge diagnostic (shared with bench_decode.py)
-    require_live_backend(metric, unit="images/sec")
-    cfg = registry.get_model_entry(name).config
-    fn, params, _ = registry.module_shard_factory(
-        name, None, 1, registry.get_model_layers(name), dtype=jnp.bfloat16)
-
-    batch = 8   # reference profiles use batch=8 (README_Scheduler.md:148-151)
-    # 128 microbatches amortize the fixed per-dispatch overhead (~65 ms on
-    # the tunneled axon platform) to <6% of the run; input set = 385 MB HBM
-    n_ubatch = args.ubatches
-    rng = np.random.default_rng(0)
-    side = int(round(cfg.num_patches ** 0.5)) * cfg.patch_size
-    xs = jax.device_put(jnp.asarray(
-        rng.normal(size=(n_ubatch, batch, cfg.num_channels, side, side)),
-        dtype=jnp.bfloat16))
-    params = jax.device_put(params)
-
-    cal_samples = _calibrate_peak_samples(args.cal_dim)
-    peak_flops = max(cal_samples)
-
-    # the UN-jitted shard apply: the factory's fn is jitted, and jit
-    # caches by function identity — a numerics-mode change (trace-time
-    # flag) only binds through a fresh trace of the raw callable
-    raw_fn = fn.__wrapped__
-
-    def make_run_all():
-        # a FRESH jit wrapper (and fresh inner trace via raw_fn) per
-        # numerics mode
-        @jax.jit
-        def run_all(p, xs):
-            def step(carry, x):
-                logits = raw_fn(p, x)
-                return carry + jnp.sum(logits.astype(jnp.float32)), None
-
-            total, _ = jax.lax.scan(step, jnp.float32(0), xs)
-            return total
-
-        return run_all
-
-    run_all = make_run_all()
-
-    # Host-side energy (reference's energy-first monitoring demo,
-    # monitoring/__init__.py:110-114 there): RAPL powercap when readable,
-    # else an explicit unreadable record — never silent omission.
-    from pipeedge_tpu.monitoring.energy import default_energy_source
-    energy_src = default_energy_source()
-    if energy_src is not None:
-        energy_src.init()
-
-    float(run_all(params, xs))  # compile + warmup (readback fences)
-    e0 = energy_src.get_uj() if energy_src is not None else 0
-    times = []
-    for _ in range(args.reps):
-        tik = time.monotonic()
-        float(run_all(params, xs))
-        times.append(time.monotonic() - tik)
-    e1 = energy_src.get_uj() if energy_src is not None else 0
-    samples = sorted(n_ubatch * batch / t for t in times)
-    img_per_sec = statistics.median(samples)
-    if energy_src is not None:
-        wall = sum(times)
-        energy_fields = {
-            "host_energy_j_per_image": round(
-                (e1 - e0) / 1e6 / (args.reps * n_ubatch * batch), 4),
-            "host_power_w": round((e1 - e0) / 1e6 / wall, 1),
-            "energy_source": "rapl-powercap (host CPU packages; TPU chip "
-                             "power not exposed through JAX)",
-        }
-        energy_src.finish()
-    else:
-        energy_fields = {
-            "energy_source": "unreadable on this host (no readable RAPL "
-                             "powercap domains)"}
-
-    # p50 microbatch latency: individual dispatch, fenced per microbatch.
-    # Segmented (dispatch = host enqueue of the jitted call, transfer =
-    # device execution + readiness fence, emit = host scalar readback)
-    # through telemetry spans so the medians come out of the same span
-    # machinery the DCN trace reports use — the per-segment view of
-    # where the steady-vs-p50 gap lives (ROADMAP item 5).
-    from pipeedge_tpu import telemetry
-    from pipeedge_tpu.telemetry import report as span_report
-
-    @jax.jit
-    def run_one(p, x):
-        return jnp.sum(fn(p, x).astype(jnp.float32))
-
-    float(run_one(params, xs[0]))  # compile + warm
-    rec = telemetry.configure(rank=0)
-    lats = []
-    for i in range(n_ubatch):
-        tik = time.monotonic()
-        with telemetry.span("stage", "dispatch", mb=i):
-            fut = run_one(params, xs[i])
-        with telemetry.span("stage", "transfer", mb=i):
-            fut.block_until_ready()
-        with telemetry.span("stage", "emit", mb=i):
-            float(fut)
-        lats.append(time.monotonic() - tik)
-    segments = span_report.segment_medians(rec.snapshot(),
-                                           cats=frozenset(("stage",)))
-    telemetry.disable()
-    p50_ms = statistics.median(lats) * 1e3
-    steady_lats = sorted(lats[1:])
-    latency_breakdown = {
-        # first measured microbatch vs the warm rest: the fill/steady
-        # split BENCH rounds track against steady_state_ubatch_ms
-        "fill_ms": round(lats[0] * 1e3, 2),
-        "steady_p50_ms": round(
-            span_report._percentile(steady_lats, 50) * 1e3, 2),
-        "steady_p99_ms": round(
-            span_report._percentile(steady_lats, 99) * 1e3, 2),
-        "segments_p50_ms": {
-            key.split("/", 1)[1]: val["p50_ms"]
-            for key, val in segments.items()},
-    }
-
-    flops_img = _model_flops_per_image(cfg)
-    achieved = img_per_sec * flops_img
-
-    device_kind = jax.devices()[0].device_kind
-    nominal_peak = NOMINAL_BF16_PEAK.get(device_kind)
-
-    # fast-numerics headline (round-5 verdict item 1): the SAME streamed
-    # loop with model-dtype LayerNorm/softmax and tanh GeLU — the
-    # measured buy-back of the f32-numerics parity bucket, plus the
-    # measured accuracy delta vs the exact mode on this input set
-    # fresh lambdas over raw_fn per mode: jit caches by function
-    # identity, so the trace-time numerics flag needs a new function
-    # object (and no stale inner jit) to rebind
-    logits_exact = np.asarray(
-        jax.jit(lambda p, x: raw_fn(p, x))(params,
-                                           xs[0]).astype(jnp.float32))
-    set_fast_numerics(True)
-    try:
-        run_all_fast = make_run_all()
-        float(run_all_fast(params, xs))          # compile + warm
-        # INTERLEAVED exact/fast rounds (the docs/PERF.md A/B timing
-        # discipline): session drift hits both modes equally, so the
-        # reported speedup is a same-moment quotient, not early-session
-        # exact vs late-session fast
-        fast_times, exact_times = [], []
-        for _ in range(3):
-            tik = time.monotonic()
-            float(run_all(params, xs))
-            exact_times.append(time.monotonic() - tik)
-            tik = time.monotonic()
-            float(run_all_fast(params, xs))
-            fast_times.append(time.monotonic() - tik)
-        fast_img_per_sec = statistics.median(
-            n_ubatch * batch / t for t in fast_times)
-        exact_adjacent = statistics.median(
-            n_ubatch * batch / t for t in exact_times)
-        logits_fast = np.asarray(
-            jax.jit(lambda p, x: raw_fn(p, x))(params,
-                                               xs[0]).astype(jnp.float32))
-    finally:
-        # None would re-defer to the env var — this bench's records must
-        # stay exact-mode regardless of the inherited environment
-        set_fast_numerics(False)
-    fast_achieved = fast_img_per_sec * flops_img
-    fast_fields = {
-        "images_per_sec": round(fast_img_per_sec, 3),
-        "exact_interleaved_images_per_sec": round(exact_adjacent, 3),
-        "speedup_vs_exact": round(fast_img_per_sec / exact_adjacent, 3),
-        "mfu_calibrated": round(fast_achieved / peak_flops, 3),
-        "mfu_nominal": (round(fast_achieved / nominal_peak, 3)
-                        if nominal_peak else None),
-        "achieved_tflops": round(fast_achieved / 1e12, 1),
-        **_top1_agreement(logits_exact, logits_fast),
-    }
-
-    # quantized-collectives A/B (ROADMAP item 2): exact math, quantized
-    # ICI comms — the variant meant to land between the exact and
-    # fast-numerics endpoints at near-1.0 agreement
-    qc_fields = _quant_collectives_ab(name, args.tp_quant_bits, xs,
-                                      flops_img, peak_flops, nominal_peak)
-
-    print(json.dumps({
-        "metric": metric,
-        "value": round(img_per_sec, 3),
-        "unit": "images/sec",
-        "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 1),
-        "value_median": round(img_per_sec, 3),
-        "value_spread": [round(samples[0], 3), round(samples[-1], 3)],
-        "value_samples": [round(s, 3) for s in samples],
-        "p50_microbatch_latency_ms": round(p50_ms, 2),
-        "latency_breakdown": latency_breakdown,
-        "steady_state_ubatch_ms": round(min(times) / n_ubatch * 1e3, 2),
-        "mfu": round(achieved / peak_flops, 3),
-        "mfu_calibrated": round(achieved / peak_flops, 3),
-        "mfu_nominal": (round(achieved / nominal_peak, 3)
-                        if nominal_peak else None),
-        "achieved_tflops": round(achieved / 1e12, 1),
-        # both names kept: calibrated_peak_tflops is the original record
-        # key (BENCH_r01), peak_calibrated_tflops pairs with peak_nominal
-        "calibrated_peak_tflops": round(peak_flops / 1e12, 1),
-        "peak_calibrated_tflops": round(peak_flops / 1e12, 1),
-        "peak_nominal_tflops": (round(nominal_peak / 1e12, 1)
-                                if nominal_peak else None),
-        # pinned calibration recipe + per-session spread (verdict item
-        # 7): calibrated MFU carries explicit error bars
-        "calibration": dict(
-            CALIBRATION_RECIPE,
-            matmul_mnk=[args.cal_dim] * 3,
-            off_recipe=(args.cal_dim
-                        != CALIBRATION_RECIPE["matmul_mnk"][0]) or None,
-            session_samples_tflops=[round(s / 1e12, 1)
-                                    for s in cal_samples],
-            calibration_spread=[round(min(cal_samples) / 1e12, 1),
-                                round(max(cal_samples) / 1e12, 1)]),
-        "mfu_calibrated_range": [
-            round(achieved / max(cal_samples), 3),
-            round(achieved / min(cal_samples), 3)],
-        "fast_numerics": fast_fields,
-        "quant_collectives": qc_fields,
-        # the active collective bitwidth rides the record so BENCH_r0N
-        # trajectories are self-describing (which knob produced this line)
-        "tp_quant_bits": args.tp_quant_bits,
-        "device_kind": device_kind,
-        **energy_fields,
-    }))
+    record = benchkit.run_recipe(args.recipe, rest, notes=args.notes)
+    problems = schema.validate_record(record)
+    if problems:
+        # a recipe that emits an invalid record is a bug, not a bench
+        # result — fail loudly instead of committing a corrupt line
+        print(f"bench.py: invalid record: {problems}", file=sys.stderr)
+        return 2
+    if args.append_record:
+        schema.artifact_append(args.append_record, record)
+    print(json.dumps(record, sort_keys=True))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

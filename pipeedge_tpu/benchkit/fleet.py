@@ -1,0 +1,208 @@
+"""Fleet recipes: the SPMD one-process pipeline and the multi-process
+loopback DCN pipeline, driven through runtime.py subprocesses.
+
+Both recipes reuse the runtime's own measured output (the
+`steady_state_throughput_items_sec=` / `throughput_items_sec=` stdout
+lines every run prints) rather than re-timing from outside — the number
+in the trajectory record is the same number a production fleet logs.
+The `dcn` recipe additionally collects the merged `--trace-spans`
+timeline and folds `trace_report`'s bubble % + per-microbatch latency
+percentiles into the record, so a DCN regression names WHERE the round
+went (bubble vs wire vs compute), not just that it slowed.
+"""
+from __future__ import annotations
+
+import os
+import re
+import socket
+import subprocess
+import sys
+import tempfile
+from typing import Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_STDOUT_KEYS = {
+    "steady_state_throughput_items_sec": "steady_items_per_sec",
+    "throughput_items_sec": "items_per_sec",
+    "latency_sec": "round_latency_s",
+}
+
+
+def parse_runtime_stdout(text: str) -> Dict[str, float]:
+    """Lift the runtime's `key=value` measurement lines into a dict
+    (last occurrence wins — the final settled round is the record)."""
+    out: Dict[str, float] = {}
+    for m in re.finditer(r"(\w+)=([0-9.eE+-]+)", text):
+        key = _STDOUT_KEYS.get(m.group(1))
+        if key is not None:
+            try:
+                out[key] = float(m.group(2))
+            except ValueError:
+                pass
+    return out
+
+
+def _free_ports(n: int) -> List[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _env(platform: str, devices: int) -> dict:
+    env = dict(os.environ)
+    if platform:
+        env["JAX_PLATFORMS"] = platform
+    if platform == "cpu" and devices > 1:
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            f" --xla_force_host_platform_device_count"
+                            f"={devices}").strip()
+    env.setdefault("DCN_CONNECT_TIMEOUT", "30")
+    env["PYTHONPATH"] = REPO
+    return env
+
+
+def _common_fleet_args(p) -> None:
+    p.add_argument("--model", default="pipeedge/test-tiny-vit")
+    p.add_argument("--partition", default="1,4,5,8")
+    p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--ubatches", type=int, default=4)
+    p.add_argument("--platform", default="cpu",
+                   help="JAX platform for the spawned fleet (cpu for "
+                        "loopback smokes; empty = inherit)")
+    p.add_argument("--timeout", type=float, default=300.0,
+                   help="hard wall on the spawned fleet")
+
+
+def _spmd_args(p) -> None:
+    _common_fleet_args(p)
+    p.add_argument("--world", type=int, default=4,
+                   help="virtual SPMD world size (one process)")
+    p.add_argument("--spmd-tp", type=int, default=0,
+                   help="per-stage TP slice width (0 = none)")
+    p.add_argument("--devices", type=int, default=8,
+                   help="forced host device count on the cpu platform")
+
+
+def _run_spmd(args) -> dict:
+    cmd = [sys.executable, os.path.join(REPO, "runtime.py"),
+           "0", str(args.world), "-c", "spmd", "-m", args.model,
+           "-b", str(args.batch), "-u", str(args.ubatches),
+           "-pt", args.partition]
+    if args.platform:
+        cmd += ["--platform", args.platform]
+    if args.spmd_tp:
+        cmd += ["--spmd-tp", str(args.spmd_tp)]
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=args.timeout, cwd=REPO,
+                          env=_env(args.platform, args.devices))
+    if proc.returncode != 0:
+        raise RuntimeError(f"spmd runtime exited {proc.returncode}:\n"
+                           f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}")
+    measured = parse_runtime_stdout(proc.stdout)
+    value = measured.get("steady_items_per_sec",
+                         measured.get("items_per_sec"))
+    if value is None:
+        raise RuntimeError("spmd runtime printed no throughput line:\n"
+                           f"{proc.stdout[-2000:]}")
+    return {
+        "throughput": {"value": round(value, 3), "unit": "items/sec"},
+        "extras": {"measured": measured, "world": args.world,
+                   "spmd_tp": args.spmd_tp or None},
+    }
+
+
+def _dcn_args(p) -> None:
+    _common_fleet_args(p)
+    p.add_argument("--world", type=int, default=2,
+                   help="loopback fleet size (one OS process per rank)")
+    p.add_argument("--trace-out", default=None,
+                   help="merged trace path (default: a temp file; the "
+                        "bubble/latency blocks are folded into the "
+                        "record either way)")
+
+
+def _run_dcn(args) -> dict:
+    import json
+
+    from ..telemetry import chrome_trace, report
+    trace_out = args.trace_out or os.path.join(
+        tempfile.mkdtemp(prefix="benchkit_dcn_"), "trace.json")
+    ports = _free_ports(args.world)
+    addrs = ",".join(f"127.0.0.1:{p}" for p in ports)
+    base = [sys.executable, os.path.join(REPO, "runtime.py")]
+    opts = ["-c", "dcn", "-m", args.model, "-pt", args.partition,
+            "-q", "8,0", "-r", "0,1", "-b", str(args.batch),
+            "-u", str(args.ubatches), "--dcn-addrs", addrs,
+            "--sched-timeout", "120", "--trace-spans", trace_out]
+    if args.platform:
+        opts += ["--platform", args.platform]
+    env = _env(args.platform, 1)
+    workers = [subprocess.Popen(base + [str(r), str(args.world)] + opts,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True,
+                                cwd=REPO, env=env)
+               for r in range(1, args.world)]
+    data_rank: Optional[subprocess.CompletedProcess] = None
+    try:
+        data_rank = subprocess.run(
+            base + ["0", str(args.world)] + opts, capture_output=True,
+            text=True, timeout=args.timeout, cwd=REPO, env=env)
+        for w in workers:
+            w.wait(timeout=60)
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+    if data_rank.returncode != 0:
+        raise RuntimeError(
+            f"dcn data rank exited {data_rank.returncode}:\n"
+            f"{data_rank.stdout[-2000:]}\n{data_rank.stderr[-2000:]}")
+    measured = parse_runtime_stdout(data_rank.stdout)
+    value = measured.get("steady_items_per_sec",
+                         measured.get("items_per_sec"))
+    if value is None:
+        raise RuntimeError("dcn data rank printed no throughput line:\n"
+                           f"{data_rank.stdout[-2000:]}")
+    blocks = {
+        "throughput": {"value": round(value, 3), "unit": "items/sec"},
+        "extras": {"measured": measured, "world": args.world,
+                   "trace": trace_out},
+    }
+    try:
+        with open(trace_out, encoding="utf8") as fh:
+            spans = chrome_trace.trace_to_spans(json.load(fh))
+        analysis = report.analyze_spans(spans)
+        mb = analysis.get("mb_latency") or {}
+        if mb.get("n"):
+            blocks["latency_ms"] = {"p50": mb.get("p50_ms"),
+                                    "p95": mb.get("p95_ms"),
+                                    "p99": mb.get("p99_ms"),
+                                    "n": mb["n"]}
+        blocks["extras"]["bubble_pct"] = analysis.get("bubble_pct")
+        blocks["extras"]["transport"] = analysis.get("transport")
+    except (OSError, ValueError) as exc:
+        blocks["notes"] = f"trace analysis unavailable: {exc!r}"
+    return blocks
+
+
+def _register():
+    from . import Recipe, register
+    register(Recipe(
+        "spmd", "one-process SPMD pipeline (virtual world) via "
+                "runtime.py: steady items/sec",
+        _spmd_args, _run_spmd, tier="fleet"))
+    register(Recipe(
+        "dcn", "multi-process loopback DCN pipeline fleet with a merged "
+               "trace: steady items/sec + bubble % + mb latency",
+        _dcn_args, _run_dcn, tier="fleet"))
+
+
+_register()

@@ -1,0 +1,100 @@
+"""Offline recipes: decode (KV-cache generation) and train (pipeline
+train step), wrapping the existing single-JSON-line benches
+(`bench_decode.py`, `tools/bench_train.py`) into the trajectory
+envelope. The wrapped tool's full record rides under `extras` (nothing
+is lost), while the envelope lifts the headline throughput into the
+block bench_report diffs on.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from typing import List
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run_json_tool(cmd: List[str], timeout: float,
+                  env_extra: dict = None) -> dict:
+    """Run a tool that prints ONE JSON line (the chaos_dcn idiom) and
+    return it parsed — the last parseable `{...}` stdout line wins, so
+    warmup chatter above it is harmless."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    if env_extra:
+        env.update(env_extra)
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=timeout, cwd=REPO, env=env)
+    if proc.returncode != 0:
+        raise RuntimeError(f"{cmd[1]} exited {proc.returncode}:\n"
+                           f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}")
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    raise RuntimeError(f"{cmd[1]} printed no JSON record:\n"
+                       f"{proc.stdout[-2000:]}")
+
+
+def _decode_args(p) -> None:
+    p.add_argument("--model", default="gpt2")
+    p.add_argument("--prompt-len", type=int, default=128)
+    p.add_argument("--new-tokens", type=int, default=64)
+    p.add_argument("--max-len", type=int, default=1024)
+    p.add_argument("--batches", default="1,16")
+    p.add_argument("--timeout", type=float, default=1800.0)
+
+
+def _run_decode(args) -> dict:
+    rec = run_json_tool(
+        [sys.executable, os.path.join(REPO, "bench_decode.py"),
+         "-m", args.model, "--prompt-len", str(args.prompt_len),
+         "--new-tokens", str(args.new_tokens),
+         "--max-len", str(args.max_len), "--batches", args.batches],
+        args.timeout)
+    return {
+        "throughput": {"value": rec["value"], "unit": rec["unit"]},
+        "extras": rec,
+    }
+
+
+def _train_args(p) -> None:
+    p.add_argument("--model", default="google/vit-large-patch16-224")
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--ubatches", type=int, default=4)
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--mixed-precision", action="store_true")
+    p.add_argument("--timeout", type=float, default=1800.0)
+
+
+def _run_train(args) -> dict:
+    cmd = [sys.executable, os.path.join(REPO, "tools", "bench_train.py"),
+           "-m", args.model, "-b", str(args.batch),
+           "-u", str(args.ubatches), "--steps", str(args.steps)]
+    if args.mixed_precision:
+        cmd.append("--mixed-precision")
+    rec = run_json_tool(cmd, args.timeout)
+    return {
+        "throughput": {"value": rec["value"], "unit": rec["unit"]},
+        "extras": rec,
+    }
+
+
+def _register():
+    from . import Recipe, register
+    register(Recipe(
+        "decode", "KV-cache decode throughput (bench_decode.py wrapped "
+                  "into the trajectory envelope)",
+        _decode_args, _run_decode, tier="chip"))
+    register(Recipe(
+        "train", "pipeline train-step throughput (tools/bench_train.py "
+                 "wrapped into the trajectory envelope)",
+        _train_args, _run_train, tier="chip"))
+
+
+_register()

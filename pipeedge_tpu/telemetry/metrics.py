@@ -338,6 +338,40 @@ def percentile_from_counts(buckets: Sequence[float], counts: Sequence[int],
     return float("inf")
 
 
+_EXEMPLAR_RE = None
+
+
+def parse_exemplars(text: str, family: str) -> List[dict]:
+    """The client side of the `# EXEMPLAR` exposition contract: parse a
+    rendered /metrics document back into `{le, trace_id, value}` rows for
+    one histogram family — how the benchkit serve recipe lifts the
+    p99-bucket -> trace-id links off a live server into its trajectory
+    record (value is the observation in the instrument's native unit,
+    seconds for latency histograms)."""
+    global _EXEMPLAR_RE  # pylint: disable=global-statement
+    import re
+    if _EXEMPLAR_RE is None:
+        _EXEMPLAR_RE = re.compile(
+            r'^# EXEMPLAR (?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+            r'\{(?P<labels>[^}]*)\} '
+            r'\{trace_id="(?P<trace_id>[^"]*)"\} '
+            r'(?P<value>[-+0-9.eEinf]+)$')
+    out: List[dict] = []
+    for line in text.splitlines():
+        m = _EXEMPLAR_RE.match(line)
+        if m is None or m.group("name") != f"{family}_bucket":
+            continue
+        le = None
+        for pair in m.group("labels").split(","):
+            if pair.startswith('le="'):
+                le = pair[4:-1]
+        if le is None:
+            continue
+        out.append({"le": le, "trace_id": m.group("trace_id"),
+                    "value": float(m.group("value"))})
+    return out
+
+
 def render_monitoring_snapshot(snapshot: dict,
                                prefix: str = "pipeedge_monitor") -> List[str]:
     """Monitoring's `snapshot()` matrix (key -> scope -> metric -> value)

@@ -78,6 +78,13 @@ def _percentile(sorted_vals: List[float], q: float) -> float:
     return sorted_vals[idx]
 
 
+def percentile(vals: Sequence[float], q: float) -> float:
+    """Public nearest-rank percentile over (not necessarily sorted)
+    samples — the one percentile definition every report surface shares
+    (trace reports, benchkit records, loadgen summaries)."""
+    return _percentile(sorted(vals), q)
+
+
 # span categories whose per-name duration distributions are worth a
 # segment breakdown (the dispatch/transfer/emit gap-hunting view)
 SEGMENT_CATEGORIES = frozenset(("stage", "wire", "quant", "feed",

@@ -1,0 +1,366 @@
+"""Benchmark observatory (pipeedge_tpu/benchkit + tools/bench_report.py):
+recipe registry resolution, trajectory-record schema validation for every
+recipe, bench_report diff/regression/noise-band logic on hand-built
+records, and the tier-1 loopback serve-recipe acceptance run."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from pipeedge_tpu import benchkit                      # noqa: E402
+from pipeedge_tpu.benchkit import schema               # noqa: E402
+from tools import bench_report                         # noqa: E402
+from tools.loadgen import arrival_offsets              # noqa: E402
+
+ALL_RECIPES = {"exact", "quant_collectives", "spmd", "dcn", "decode",
+               "train", "serve"}
+
+
+# -- registry resolution -------------------------------------------------
+
+def test_registry_lists_every_recipe():
+    names = {r.name for r in benchkit.list_recipes()}
+    assert names == ALL_RECIPES
+
+
+def test_get_recipe_resolution_and_tiers():
+    serve = benchkit.get_recipe("serve")
+    assert serve.tier == "fast"
+    assert serve.setup is not None and serve.teardown is not None
+    assert benchkit.get_recipe("exact").tier == "chip"
+    with pytest.raises(KeyError, match="unknown recipe 'nope'"):
+        benchkit.get_recipe("nope")
+
+
+def test_recipe_parsers_have_defaults():
+    """Every recipe must parse an empty argv (bench.py's bare
+    invocation contract)."""
+    for recipe in benchkit.list_recipes():
+        args = recipe.parser().parse_args([])
+        assert vars(args), recipe.name
+
+
+def test_run_counter_matrix_predeclared():
+    """PL501 semantics, checked live: every recipe x status series
+    renders before any recipe ever ran in this process."""
+    from pipeedge_tpu.telemetry import metrics as prom
+    benchkit.list_recipes()            # force recipe registration
+    counter = prom.REGISTRY.get_or_create(
+        prom.Counter, "pipeedge_bench_runs_total", "")
+    values = counter.values()
+    for name in ALL_RECIPES:
+        for status in benchkit.RUN_STATUSES:
+            key = (("recipe", name), ("status", status))
+            assert key in values, (name, status)
+
+
+# -- trajectory schema (one sample per recipe; the set must stay in
+#    lock-step with the registry so a new recipe adds its sample) -------
+
+def _sample_blocks(name):
+    base = {"throughput": {"value": 100.0, "unit": "items/sec"}}
+    if name == "exact":
+        return {"throughput": {"value": 945.8, "unit": "images/sec",
+                               "samples": [945.2, 946.1],
+                               "spread": [945.2, 946.1]},
+                "latency_ms": {"p50": 8.1, "p99": 9.0, "n": 127},
+                "mfu": {"calibrated": 0.89, "nominal": 0.59,
+                        "calibration_version": "cal-v1",
+                        "off_recipe": False},
+                "legacy": {"metric": "vit_large_images_per_sec_b8",
+                           "value": 945.8, "unit": "images/sec"}}
+    if name == "quant_collectives":
+        return {"throughput": {"value": 980.0, "unit": "images/sec"},
+                "quality": {"top1_agreement_vs_exact": 1.0,
+                            "max_abs_logit_delta": 0.04},
+                "extras": {"bits": 8, "tp": 2}}
+    if name == "serve":
+        return {"throughput": {"value": 56.3, "unit": "req/s"},
+                "latency_ms": {"p50": 120.0, "p95": 300.0, "p99": 366.0,
+                               "n": 235,
+                               "exemplars": [{"le": "0.5",
+                                              "trace_id": "q17",
+                                              "value_s": 0.37}]},
+                "serve": {"goodput_rps": {"interactive": 56.3,
+                                          "total": 56.3},
+                          "slo_attainment": {"interactive": 0.99},
+                          "shed": {"shed": 842, "error": 0},
+                          "overload_factor": 3.0,
+                          "p99_exemplar_rid": "q17",
+                          "trace": "bench_serve_trace.json"}}
+    if name == "dcn":
+        return {"throughput": {"value": 210.0, "unit": "items/sec"},
+                "latency_ms": {"p50": 40.0, "p95": 55.0, "p99": 60.0,
+                               "n": 64},
+                "extras": {"bubble_pct": 12.3, "world": 2}}
+    if name in ("spmd", "decode", "train"):
+        return dict(base, extras={"measured": {}})
+    raise AssertionError(f"no sample blocks for recipe {name!r} — add "
+                         "one (the schema test must cover every recipe)")
+
+
+@pytest.mark.parametrize("name", sorted(ALL_RECIPES))
+def test_every_recipe_record_validates(name):
+    record = schema.make_record(name, {"model": "m", "knob": 1},
+                                _sample_blocks(name),
+                                env={"platform": "cpu"})
+    assert schema.validate_record(record) == []
+    # legacy keys merged at top level, never clobbering the envelope
+    if name == "exact":
+        assert record["value"] == 945.8
+        assert record["schema"] == schema.SCHEMA
+
+
+def test_make_record_rejects_unknown_blocks():
+    with pytest.raises(ValueError, match="unknown block"):
+        schema.make_record("exact", {}, {"bogus": 1})
+
+
+def test_validator_rejects_bad_records():
+    good = schema.make_record("serve", {"a": 1}, _sample_blocks("serve"),
+                              env={"platform": "cpu"})
+    assert schema.validate_record(good) == []
+
+    bad = dict(good, schema="pipeedge-bench/v0")
+    assert any("schema" in p for p in schema.validate_record(bad))
+
+    bad = dict(good, config={"a": 2})        # fingerprint now stale
+    assert any("fingerprint" in p for p in schema.validate_record(bad))
+
+    bad = dict(good, throughput={"value": -1, "unit": "req/s"})
+    assert any("throughput" in p for p in schema.validate_record(bad))
+
+    bad = dict(good, latency_ms={"p50": 100.0, "p99": 50.0})
+    assert any("monotonic" in p for p in schema.validate_record(bad))
+
+    bad = dict(good, latency_ms={"p50": 1.0,
+                                 "exemplars": [{"trace_id": "q1"}]})
+    assert any("exemplar" in p for p in schema.validate_record(bad))
+
+    bad = dict(good, serve={"goodput_rps": {}})
+    problems = schema.validate_record(bad)
+    assert any("goodput_rps" in p for p in problems)
+    assert any("slo_attainment" in p for p in problems)
+
+
+def test_artifact_append_replaces_by_scenario(tmp_path):
+    path = str(tmp_path / "BENCH_rXX.json")
+    rec_a = schema.make_record("serve", {"v": 1}, _sample_blocks("serve"),
+                              env={})
+    rec_b = schema.make_record("dcn", {"v": 1}, _sample_blocks("dcn"),
+                              env={})
+    schema.artifact_append(path, rec_a)
+    doc = schema.artifact_append(path, rec_b)
+    assert [r["scenario"] for r in doc["records"]] == ["serve", "dcn"]
+    rec_a2 = schema.make_record("serve", {"v": 2},
+                                _sample_blocks("serve"), env={})
+    doc = schema.artifact_append(path, rec_a2)
+    assert len(doc["records"]) == 2
+    by_scenario = schema.records_from_any(doc)
+    assert by_scenario["serve"]["config"] == {"v": 2}
+    # single-record and list shapes load through the same entry point
+    assert set(schema.records_from_any(rec_b)) == {"dcn"}
+    assert set(schema.records_from_any([rec_a, rec_b])) == {"serve",
+                                                            "dcn"}
+
+
+# -- bench_report diff / regression / noise bands ------------------------
+
+def _serve_record(goodput=50.0, attainment=0.99, p99=400.0, errors=0,
+                  config=None):
+    return schema.make_record(
+        "serve", config or {"model": "m"},
+        {"throughput": {"value": goodput, "unit": "req/s"},
+         "latency_ms": {"p50": 100.0, "p95": 250.0, "p99": p99, "n": 100},
+         "serve": {"goodput_rps": {"interactive": goodput,
+                                   "total": goodput},
+                   "slo_attainment": {"interactive": attainment},
+                   "shed": {"shed": 10, "error": errors}}},
+        env={"platform": "cpu"})
+
+
+def test_compare_within_noise_is_ok():
+    diff = bench_report.compare_records(_serve_record(goodput=50.0),
+                                        _serve_record(goodput=48.0))
+    assert diff["ok"], diff["regressed"]
+    assert diff["metrics"]["throughput"]["verdict"] == "ok"
+    assert diff["config_match"]
+
+
+def test_compare_flags_throughput_regression():
+    diff = bench_report.compare_records(_serve_record(goodput=50.0),
+                                        _serve_record(goodput=30.0))
+    assert not diff["ok"]
+    assert "throughput" in diff["regressed"]
+    assert "serve.goodput_rps.interactive" in diff["regressed"]
+
+
+def test_compare_lower_better_latency():
+    base, worse = _serve_record(p99=400.0), _serve_record(p99=900.0)
+    diff = bench_report.compare_records(base, worse)
+    assert "latency_ms.p99" in diff["regressed"]
+    # the improvement direction is reported but never gated
+    diff = bench_report.compare_records(worse, base)
+    assert diff["ok"]
+    assert diff["metrics"]["latency_ms.p99"]["verdict"] == "improved"
+
+
+def test_compare_zero_tolerance_on_errors():
+    diff = bench_report.compare_records(_serve_record(errors=0),
+                                        _serve_record(errors=1))
+    assert "serve.shed.error" in diff["regressed"]
+
+
+def test_compare_missing_metric_regresses():
+    base = _serve_record()
+    new = _serve_record()
+    del new["latency_ms"]
+    diff = bench_report.compare_records(base, new)
+    assert "latency_ms.p99" in diff["regressed"]
+    assert diff["metrics"]["latency_ms.p99"]["verdict"] == "missing"
+
+
+def test_noise_override_widens_band():
+    base, new = _serve_record(goodput=50.0), _serve_record(goodput=30.0)
+    assert not bench_report.compare_records(base, new)["ok"]
+    diff = bench_report.compare_records(
+        base, new, overrides={"throughput": 0.6,
+                              "serve.goodput_rps": 0.6})
+    assert diff["ok"], diff["regressed"]
+
+
+def test_noise_band_uses_record_spread():
+    base = _serve_record(goodput=50.0)
+    base["throughput"]["spread"] = [30.0, 70.0]   # measured wobble 80%
+    diff = bench_report.compare_records(base, _serve_record(goodput=32.0))
+    assert diff["metrics"]["throughput"]["verdict"] == "ok"
+
+
+def test_bench_report_main_gate_exit_codes(tmp_path, capsys):
+    base_path = str(tmp_path / "base.json")
+    ok_path = str(tmp_path / "ok.json")
+    bad_path = str(tmp_path / "bad.json")
+    json.dump(_serve_record(goodput=50.0), open(base_path, "w"))
+    json.dump(_serve_record(goodput=48.0), open(ok_path, "w"))
+    json.dump(_serve_record(goodput=20.0), open(bad_path, "w"))
+    assert bench_report.main([ok_path, "--baseline", base_path,
+                              "--gate"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["ok"]
+    assert bench_report.main([bad_path, "--baseline", base_path,
+                              "--gate"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["regressed"]
+    # no common scenarios -> usage error
+    other = schema.make_record("dcn", {}, _sample_blocks_dcn(), env={})
+    other_path = str(tmp_path / "other.json")
+    json.dump(other, open(other_path, "w"))
+    assert bench_report.main([other_path, "--baseline", base_path,
+                              "--gate"]) == 2
+
+
+def _sample_blocks_dcn():
+    return {"throughput": {"value": 210.0, "unit": "items/sec"}}
+
+
+def test_bench_report_strict_config(tmp_path):
+    base_path = str(tmp_path / "base.json")
+    new_path = str(tmp_path / "new.json")
+    json.dump(_serve_record(config={"model": "a"}), open(base_path, "w"))
+    json.dump(_serve_record(config={"model": "b"}), open(new_path, "w"))
+    assert bench_report.main([new_path, "--baseline", base_path]) == 0
+    assert bench_report.main([new_path, "--baseline", base_path,
+                              "--strict-config"]) == 2
+
+
+# -- loadgen seeded arrivals + exemplar parse-back -----------------------
+
+def test_arrival_offsets_seeded_and_shaped():
+    import random
+    uniform = arrival_offsets(10, 5.0, "uniform")
+    assert uniform == [i / 5.0 for i in range(10)]
+    a = arrival_offsets(50, 5.0, "poisson", random.Random(7))
+    b = arrival_offsets(50, 5.0, "poisson", random.Random(7))
+    c = arrival_offsets(50, 5.0, "poisson", random.Random(8))
+    assert a == b                      # same seed -> same schedule
+    assert a != c and a != uniform[:50]
+    gaps = [t1 - t0 for t0, t1 in zip(a, a[1:])]
+    assert 0.05 < sum(gaps) / len(gaps) < 0.8   # mean gap ~ 1/qps
+    with pytest.raises(ValueError, match="unknown arrival"):
+        arrival_offsets(1, 1.0, "bursty")
+
+
+def test_parse_exemplars_roundtrip():
+    from pipeedge_tpu.telemetry import metrics as prom
+    reg = prom.Registry()
+    h = reg.histogram("bench_test_latency_seconds", "x",
+                      buckets=(0.1, 1.0))
+    h.observe(0.05, exemplar="q1")
+    h.observe(0.5, exemplar="q2")
+    h.observe(5.0, exemplar="q3")
+    rows = prom.parse_exemplars(reg.render(),
+                                "bench_test_latency_seconds")
+    assert {(r["le"], r["trace_id"]) for r in rows} == {
+        ("0.1", "q1"), ("1", "q2"), ("+Inf", "q3")}
+    assert prom.parse_exemplars(reg.render(), "other_family") == []
+
+
+def test_parse_runtime_stdout():
+    from pipeedge_tpu.benchkit import fleet
+    text = ("round=0 latency_sec=2.5 x\n"
+            "steady_state_throughput_items_sec=104.2 other=1\n"
+            "latency_sec=1.25 throughput_items_sec=99.7\n")
+    out = fleet.parse_runtime_stdout(text)
+    assert out == {"steady_items_per_sec": 104.2,
+                   "items_per_sec": 99.7, "round_latency_s": 1.25}
+    assert fleet.parse_runtime_stdout("no numbers here") == {}
+
+
+# -- the tier-1 loopback serve-recipe acceptance run ---------------------
+
+@pytest.mark.fleet      # spawns the serve.py subprocess via the recipe
+def test_serve_recipe_acceptance(tmp_path):
+    """ISSUE 13 acceptance: `python bench.py --recipe serve` (loopback,
+    1-slot, 3x overload) emits one JSON line with per-class goodput_rps
+    and slo_attainment, and at least one p99 exemplar trace id resolves
+    through `tools/trace_report.py --request`."""
+    trace = str(tmp_path / "serve_trace.json")
+    artifact = str(tmp_path / "BENCH_smoke.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--recipe", "serve", "--append-record", artifact,
+         "--duration", "4", "--calibrate-s", "1.5",
+         "--overload-factor", "3", "--trace-out", trace],
+        capture_output=True, text=True, timeout=400, cwd=REPO, env=env)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    record = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert schema.validate_record(record) == []
+    assert record["scenario"] == "serve"
+    assert record["config"]["max_active"] == 1          # 1-slot default
+    assert record["config"]["overload_factor"] == 3.0
+    serve = record["serve"]
+    assert serve["goodput_rps"]["interactive"] > 0
+    assert 0.0 <= serve["slo_attainment"]["interactive"] <= 1.0
+    assert serve["shed"]["error"] == 0, record.get("notes")
+    assert serve["seed"] == 0 and serve["arrival"] == "uniform"
+    # overload must actually shed (3x a 1-slot server)
+    assert serve["shed"]["shed"] > 0
+    # the artifact re-armed with this scenario
+    doc = json.load(open(artifact))
+    assert [r["scenario"] for r in doc["records"]] == ["serve"]
+
+    rid = serve["p99_exemplar_rid"]
+    assert rid, "no p99 exemplar trace id in the record"
+    assert record["latency_ms"]["exemplars"], "no exemplar rows"
+    timeline = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_report.py"),
+         trace, "--request", rid],
+        capture_output=True, text=True, timeout=120, cwd=REPO, env=env)
+    assert timeline.returncode == 0, timeline.stderr[-2000:]
+    t = json.loads(timeline.stdout)
+    assert t["found"] and t["dominant_stall"] is not None

@@ -64,7 +64,9 @@ def main():
     import jax.numpy as jnp
     import numpy as np
 
-    from bench import NOMINAL_BF16_PEAK, _calibrate_peak_samples
+    from pipeedge_tpu.benchkit.headline import (
+        NOMINAL_BF16_PEAK, calibrate_peak_samples as
+        _calibrate_peak_samples)
     from pipeedge_tpu.models.layers import (dense, gelu, layer_norm,
                                             self_attention,
                                             set_fast_numerics)

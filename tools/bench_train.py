@@ -38,8 +38,9 @@ def main():
     import numpy as np
     import optax
 
-    from bench import (NOMINAL_BF16_PEAK, _calibrate_peak_flops,
-                       _model_flops_per_image)
+    from pipeedge_tpu.benchkit.headline import (
+        NOMINAL_BF16_PEAK, calibrate_peak_flops as _calibrate_peak_flops,
+        model_flops_per_image as _model_flops_per_image)
     from pipeedge_tpu.models import ShardConfig, registry
     from pipeedge_tpu.parallel import spmd, train
 

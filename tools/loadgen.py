@@ -165,6 +165,28 @@ def _percentile(vals, q):
     return round(vals[idx], 3)
 
 
+def arrival_offsets(n, qps, arrival="uniform", rng=None):
+    """Seconds-from-start launch time of each of `n` arrivals at mean
+    rate `qps`. `uniform` is the fixed 1/qps grid (the historical
+    behavior); `poisson` draws seeded exponential gaps — an open-loop
+    memoryless arrival process whose bursts stress the admission queue
+    harder than a metronome at the same mean rate. Pure: same (n, qps,
+    arrival, rng seed) -> same offsets, so a serve-recipe run is
+    reproducible end-to-end (the bench-record contract)."""
+    if arrival == "uniform":
+        return [i / qps for i in range(n)]
+    if arrival != "poisson":
+        raise ValueError(f"unknown arrival process {arrival!r} "
+                         "(expected 'uniform' or 'poisson')")
+    if rng is None:
+        rng = random.Random(0)
+    offsets, t = [], 0.0
+    for _ in range(n):
+        offsets.append(t)
+        t += rng.expovariate(qps)
+    return offsets
+
+
 def _one_request(url, cls, slo_ms, deadline_ms, new_tokens, prompt_len,
                  timeout, stats, rng_seed):
     rng = random.Random(rng_seed)
@@ -196,11 +218,14 @@ def _one_request(url, cls, slo_ms, deadline_ms, new_tokens, prompt_len,
 
 def run_load(url, duration_s, qps, mix=None, slo_ms=None,
              deadline_from_slo=True, new_tokens=8, prompt_len=6,
-             timeout=120.0, max_inflight=128, seed=0):
+             timeout=120.0, max_inflight=128, seed=0,
+             arrival="uniform"):
     """Offer `qps` requests/s for `duration_s` with the per-class `mix`;
     return the report dict (see module doc for the outcome taxonomy).
-    Importable — the overload acceptance test and the CI smoke both call
-    this in-process instead of shelling out."""
+    Importable — the overload acceptance test, the CI smoke, and the
+    benchkit serve recipe all call this in-process instead of shelling
+    out. `seed` drives EVERYTHING random end-to-end (arrival process,
+    class draw, prompt token sampling) and rides the report."""
     mix = dict(DEFAULT_MIX if mix is None else mix)
     unknown = set(mix) - set(REQUEST_CLASSES)
     if unknown:
@@ -216,9 +241,10 @@ def run_load(url, duration_s, qps, mix=None, slo_ms=None,
     inflight = threading.Semaphore(max_inflight)
     threads = []
     n = max(1, int(round(qps * duration_s)))
+    offsets = arrival_offsets(n, qps, arrival, rng)
     t0 = time.monotonic()
     for i in range(n):
-        target = t0 + i / qps            # open loop: arrivals on the clock
+        target = t0 + offsets[i]         # open loop: arrivals on the clock
         delay = target - time.monotonic()
         if delay > 0:
             time.sleep(delay)
@@ -244,13 +270,16 @@ def run_load(url, duration_s, qps, mix=None, slo_ms=None,
     wall = time.monotonic() - t0
     report = {"url": url, "duration_s": round(wall, 3),
               "offered_qps": round(qps, 3), "requests": n,
+              "seed": seed, "arrival": arrival,
               "client_dropped": stats.client_dropped,
               "classes": {}, "totals": dict.fromkeys(OUTCOMES, 0)}
+    all_lat = []
     for c in classes:
         counts = stats.counts[c]
         served = counts["ok"] + counts["ok_late"]
         sent = sum(counts.values())
         lat = stats.latencies[c]
+        all_lat.extend(lat)
         report["classes"][c] = {
             **counts, "sent": sent,
             "slo_ms": slo_ms.get(c),
@@ -258,7 +287,8 @@ def run_load(url, duration_s, qps, mix=None, slo_ms=None,
                                else round(counts["ok"] / served, 4)),
             "goodput_rps": round(counts["ok"] / wall, 3),
             "latency_ms": {"p50": _percentile(lat, 50),
-                           "p95": _percentile(lat, 95)},
+                           "p95": _percentile(lat, 95),
+                           "p99": _percentile(lat, 99)},
             # worst-N served requests BY ID: feed one to
             # `trace_report --request` (or cross-reference it against
             # the server's postmortem bundles) to explain the tail
@@ -267,6 +297,12 @@ def run_load(url, duration_s, qps, mix=None, slo_ms=None,
         }
         for k in OUTCOMES:
             report["totals"][k] += counts[k]
+    # aggregate served-latency percentiles: the serve recipe's
+    # latency_ms block (per-class views stay under classes.*)
+    report["latency_ms"] = {"p50": _percentile(all_lat, 50),
+                            "p95": _percentile(all_lat, 95),
+                            "p99": _percentile(all_lat, 99),
+                            "n": len(all_lat)}
     ra = stats.retry_after
     report["retry_after"] = {
         "n": len(ra), "min": min(ra) if ra else None,
@@ -279,9 +315,15 @@ def run_load(url, duration_s, qps, mix=None, slo_ms=None,
     return report
 
 
+def merge_class_map(pairs, what, default):
+    """CLI `CLASS=VALUE` pairs merged over `default` — shared with the
+    benchkit serve recipe (raises ValueError on malformed pairs)."""
+    return {**default, **parse_class_map(pairs, what)}
+
+
 def _parse_class_map(pairs, what, default):
     try:
-        return {**default, **parse_class_map(pairs, what)}
+        return merge_class_map(pairs, what, default)
     except ValueError as exc:
         raise SystemExit(str(exc)) from None
 
@@ -315,7 +357,13 @@ def main():
     p.add_argument("--max-inflight", type=int, default=128,
                    help="client-side thread cap (arrivals beyond it are "
                         "counted as client_dropped, not silently delayed)")
-    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--seed", type=int, default=0,
+                   help="drives the arrival process, class draw and "
+                        "prompt sampling; recorded in the JSON line")
+    p.add_argument("--arrival", default="uniform",
+                   choices=["uniform", "poisson"],
+                   help="arrival process: fixed 1/qps grid or seeded "
+                        "exponential gaps (bursty open-loop traffic)")
     p.add_argument("--indent", action="store_true",
                    help="pretty-print instead of the one-line record")
     args = p.parse_args()
@@ -338,7 +386,7 @@ def main():
         deadline_from_slo=not args.no_deadline,
         new_tokens=args.new_tokens, prompt_len=args.prompt_len,
         timeout=args.timeout, max_inflight=args.max_inflight,
-        seed=args.seed)
+        seed=args.seed, arrival=args.arrival)
     if calibrated is not None:
         report["calibrated_capacity_rps"] = round(calibrated, 3)
         report["overload_factor"] = args.overload_factor
