@@ -21,7 +21,13 @@ Recipes (see docs/PERF.md for the catalog + flags):
 - `serve`              loadgen-driven goodput-first serving bench: N x
                        calibrated overload against tools/serve.py, per-
                        class goodput/SLO attainment/shed taxonomy, p99
-                       cross-linked to trace exemplars
+                       cross-linked to trace exemplars; --overload-
+                       factors 1,3,5 sweeps a goodput-vs-offered-load
+                       curve in one record
+- `serve_kv`           paged-KV serving bench (--kv-pages server):
+                       shared-prefix hit rate, page-pool occupancy, and
+                       decode p99 with/without a concurrent prefill
+                       burst (colocated vs --disaggregate A/B)
 
 Entry point: `python bench.py --recipe NAME [recipe flags]` (the default
 recipe is `exact`, keeping `python bench.py` the headline record).
@@ -119,7 +125,8 @@ def _ensure_loaded() -> None:
     # re-raise on the next lookup, not leave a silently partial registry
     # (sys.modules caches the modules that DID import, and register()
     # only runs at first import, so a retry never double-registers)
-    from . import fleet, headline, offline, serve_bench  # noqa: F401
+    from . import (fleet, headline, offline, serve_bench,  # noqa: F401
+                   serve_kv_bench)  # noqa: F401
     _loaded = True
 
 

@@ -26,7 +26,12 @@ field reference):
 - `mfu`           calibrated + nominal MFU with the pinned calibration
                   recipe version (bench headline recipes only)
 - `serve`         per-class goodput_rps / slo_attainment / shed taxonomy
-                  (the serve recipe's goodput-first block)
+                  (the serve recipe's goodput-first block; with
+                  --overload-factors also `overload_curve` — one
+                  goodput-vs-offered-load row per swept factor)
+- `kv`            the paged-KV serving block (serve_kv recipe): prefix
+                  hit rate, pages reused/cached, pool occupancy, and
+                  decode p99 with/without a concurrent prefill burst
 - `notes`         free-form provenance (e.g. the r05 -> r06 gap record)
 - `extras`        recipe-specific raw fields, never gated on
 
@@ -49,7 +54,7 @@ ARTIFACT_SCHEMA = "pipeedge-bench-artifact/v1"
 # envelope keys a recipe's block dict may fill (everything else it
 # returns is an error — keeps records greppable across recipes)
 BLOCK_KEYS = ("throughput", "latency_ms", "quality", "mfu", "serve",
-              "notes", "extras", "legacy")
+              "kv", "notes", "extras", "legacy")
 
 
 def config_fingerprint(config: dict) -> str:

@@ -61,6 +61,15 @@ def _serve_args(p) -> None:
     p.add_argument("--overload-factor", type=float, default=3.0,
                    help="offered load as a multiple of the calibrated "
                         "sequential service rate")
+    p.add_argument("--overload-factors", default=None,
+                   metavar="F1,F2,...",
+                   help="sweep MULTIPLE overload factors (e.g. 1,3,5) "
+                        "in one run: each factor gets --duration seconds "
+                        "of offered load against ONE calibration, and "
+                        "the record carries the full goodput-vs-offered-"
+                        "load curve (serve.overload_curve) with the "
+                        "LAST factor as the headline blocks; overrides "
+                        "--overload-factor")
     p.add_argument("--duration", type=float, default=6.0,
                    help="seconds of offered load")
     p.add_argument("--calibrate-s", type=float, default=2.0,
@@ -93,7 +102,6 @@ def _setup(args) -> dict:
            "-m", args.model, "-pt", args.partition,
            "--max-len", str(args.max_len), "-t", args.dtype,
            "--executor", args.executor, "--port", str(port),
-           "--max-active", str(args.max_active),
            "--queue-capacity", str(args.queue_capacity),
            "--trace-spans", args.trace_out,
            # brownout watermarks scaled for a 1-slot loopback server:
@@ -102,6 +110,12 @@ def _setup(args) -> dict:
            "--brownout-p95-high", "0.75", "--brownout-p95-low", "0.3",
            "--brownout-dwell-up", "0.3", "--brownout-dwell-down", "0.7",
            "--brownout-clamp-tokens", "8", "--governor-interval", "0.1"]
+    # 0/absent = let the executor choose (the serve_kv recipe's paged
+    # servers are page-bounded, not slot-bounded)
+    if getattr(args, "max_active", 0):
+        cmd += ["--max-active", str(args.max_active)]
+    # extra flags a composing recipe appends (serve_kv: --kv-pages ...)
+    cmd += list(getattr(args, "extra_serve_args", ()))
     if args.postmortem_dir:
         cmd += ["--postmortem-dir", args.postmortem_dir]
     env = dict(os.environ, PYTHONPATH=REPO)
@@ -159,13 +173,37 @@ def _run(args, state) -> dict:
     capacity = loadgen.calibrate(url, args.calibrate_s, args.new_tokens,
                                  args.prompt_len, timeout=120.0,
                                  seed=args.seed)
-    qps = capacity * args.overload_factor
-    report = loadgen.run_load(
-        url, args.duration, qps, mix=mix, slo_ms=slo,
-        new_tokens=args.new_tokens, prompt_len=args.prompt_len,
-        seed=args.seed, arrival=args.arrival)
+    factors = [args.overload_factor]
+    if args.overload_factors:
+        factors = [float(f) for f in args.overload_factors.split(",")]
+        if not factors or any(f <= 0 for f in factors):
+            raise ValueError(f"bad --overload-factors "
+                             f"{args.overload_factors!r}")
+    # sweep: each factor offers `duration` seconds against the SAME
+    # calibration, so the curve is goodput vs offered load on one
+    # capacity baseline (ROADMAP item 5's 1x/3x/5x goodput curve);
+    # the LAST factor's full report feeds the headline blocks below
+    curve = []
+    report = None
+    for f in factors:
+        report = loadgen.run_load(
+            url, args.duration, capacity * f, mix=mix, slo_ms=slo,
+            new_tokens=args.new_tokens, prompt_len=args.prompt_len,
+            seed=args.seed, arrival=args.arrival)
+        inter = report["classes"].get("interactive", {})
+        curve.append({
+            "factor": f,
+            "offered_qps": report["offered_qps"],
+            "goodput_rps": round(sum(
+                c["goodput_rps"] for c in report["classes"].values()), 3),
+            "interactive_slo_attainment": inter.get("slo_attainment"),
+            "shed": report["totals"]["shed"],
+            "deadline": report["totals"]["deadline"],
+            "errors": report["totals"]["error"],
+            "p99_ms": report["latency_ms"]["p99"],
+        })
     report["calibrated_capacity_rps"] = round(capacity, 3)
-    report["overload_factor"] = args.overload_factor
+    report["overload_factor"] = factors[-1]
 
     exemplars = _scrape_exemplars(state["url"])
     # the worst (highest-value) exemplar is by construction in the
@@ -202,7 +240,8 @@ def _run(args, state) -> dict:
             "offered_qps": report["offered_qps"],
             "requests": report["requests"],
             "calibrated_capacity_rps": report["calibrated_capacity_rps"],
-            "overload_factor": args.overload_factor,
+            "overload_factor": factors[-1],
+            "overload_curve": curve,
             "retry_after": report["retry_after"],
             "deadline_rids": report["deadline_rids"],
             "p99_exemplar_rid": p99_rid,

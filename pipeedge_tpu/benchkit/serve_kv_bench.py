@@ -1,0 +1,260 @@
+"""`serve_kv`: the paged-KV serving bench — prefix sharing, page-pool
+occupancy, and decode-p99 isolation under concurrent prefill.
+
+Three measured phases against one `tools/serve.py --kv-pages` server
+(optionally disaggregated, `--disaggregate local|wire`):
+
+1. **prefix burst** — a shared-prefix workload (`loadgen`'s
+   `shared:PFX:TOTAL:POOL` prompt distribution): every prompt repeats
+   one of POOL deterministic prefixes, so after each prefix's first
+   prefill the trie should serve the rest from shared pages. Reported:
+   prefix hit rate, pages reused, pool occupancy.
+2. **decode solo** — short fixed prompts at a fixed rate: the baseline
+   decode p99.
+3. **decode + prefill burst** — the SAME short-prompt load while a
+   background thread hammers long-prompt requests. The ratio of phase-3
+   to phase-2 p99 is the number disaggregation exists to hold down:
+   colocated, prefill ticks steal stage-time from decode waves;
+   disaggregated, the prefill fleet absorbs them (the A/B in
+   docs/evidence/ runs this recipe both ways).
+
+The record's `kv` block carries all three; `serve`-style goodput/shed
+blocks come from phase 1. Gates the CI `kv-serve` smoke cares about:
+zero handler errors everywhere, prefix hits > 0.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import urllib.request
+
+from .serve_bench import REPO, _setup as _serve_setup, _teardown
+
+
+def _args(p) -> None:
+    p.add_argument("--model", default="pipeedge/test-tiny-gpt2")
+    p.add_argument("--partition", default="1,4,5,8")
+    p.add_argument("--max-len", type=int, default=64)
+    p.add_argument("--dtype", default="float32",
+                   choices=["float32", "bfloat16"])
+    p.add_argument("--executor", default="wave",
+                   choices=["wave", "stage"])
+    p.add_argument("--kv-pages", type=int, default=96,
+                   help="page-pool size (tools/serve.py --kv-pages)")
+    p.add_argument("--kv-page-size", type=int, default=8)
+    p.add_argument("--disaggregate", default="off",
+                   choices=["off", "local", "wire"],
+                   help="run the prefill fleet split (the A/B against "
+                        "'off' is the docs/evidence record)")
+    p.add_argument("--qps", type=float, default=3.0,
+                   help="offered rate for every phase (fixed, not "
+                        "calibrated: the phases compare against each "
+                        "other, so one knob keeps them comparable)")
+    p.add_argument("--duration", type=float, default=6.0)
+    p.add_argument("--new-tokens", type=int, default=6)
+    p.add_argument("--shared-spec", default="shared:16:20:2",
+                   help="phase-1 prompt distribution "
+                        "(loadgen shared:PFX:TOTAL:POOL)")
+    p.add_argument("--short-len", type=int, default=6,
+                   help="phase-2/3 decode-load prompt length")
+    p.add_argument("--long-len", type=int, default=48,
+                   help="phase-3 background prefill-burst prompt length "
+                        "(clamped to max_len - new_tokens)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--queue-capacity", type=int, default=32)
+    p.add_argument("--max-active", type=int, default=0,
+                   help="0 = executor default (page-bounded)")
+    p.add_argument("--trace-out", default="bench_serve_kv_trace.json")
+    p.add_argument("--postmortem-dir", default=None)
+    p.add_argument("--startup-timeout", type=float, default=180.0)
+    p.add_argument("--calibrate-s", type=float, default=0.0,
+                   help="unused (fixed --qps); kept for arg parity")
+
+
+def _setup(args) -> dict:
+    # reuse the serve recipe's spawn/readiness/teardown machinery with
+    # the paged-KV flags appended (one copy of the lifecycle logic)
+    class _A:
+        pass
+
+    a = _A()
+    for k, v in vars(args).items():
+        setattr(a, k, v)
+    a.overload_factor = 1.0
+    extra = ["--kv-pages", str(args.kv_pages),
+             "--kv-page-size", str(args.kv_page_size)]
+    if args.disaggregate != "off":
+        extra += ["--disaggregate", args.disaggregate]
+    if args.max_active:
+        extra += ["--max-active", str(args.max_active)]
+    a.extra_serve_args = extra
+    return _serve_setup(a)
+
+
+def _healthz(url: str) -> dict:
+    with urllib.request.urlopen(f"{url}/healthz", timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def _post(gen_url: str, obj: dict, timeout: float = 120.0):
+    req = urllib.request.Request(
+        gen_url, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _run(args, state) -> dict:
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from tools import loadgen
+
+    url = state["url"]
+    gen_url = f"{url}/generate"
+    mix = {"interactive": 1.0}
+    slo = dict(loadgen.DEFAULT_SLO_MS)
+
+    # warmup: compile each phase's EXACT (prompt shape x page bucket)
+    # programs once so phase p99s measure steady state, not XLA compiles
+    # (paged decode compiles per page-count bucket, so new_tokens is
+    # part of the shape)
+    long_len = min(args.long_len, args.max_len - args.new_tokens - 1)
+    for n, nt in {(loadgen.spec_max_len(args.shared_spec),
+                   args.new_tokens),
+                  (args.short_len, args.new_tokens), (long_len, 2)}:
+        _post(gen_url, {"ids": [[7] * n], "new_tokens": nt})
+
+    # -- phase 1: shared-prefix burst --------------------------------
+    kv0 = _healthz(url)["serving"]["kv"]
+    watch = {"max_in_flight": 0, "min_tokens_free": None}
+    watch_stop = threading.Event()
+
+    def sample_admission():
+        while not watch_stop.is_set():
+            try:
+                adm = _healthz(url)["serving"]["admission"]
+                watch["max_in_flight"] = max(watch["max_in_flight"],
+                                             adm["in_flight"])
+                free = adm.get("tokens_free")
+                if free is not None:
+                    cur = watch["min_tokens_free"]
+                    watch["min_tokens_free"] = (free if cur is None
+                                                else min(cur, free))
+            except OSError:
+                pass
+            watch_stop.wait(0.1)
+
+    sampler = threading.Thread(target=sample_admission, daemon=True,
+                               name="kv-admission-sampler")
+    sampler.start()
+    try:
+        shared = loadgen.run_load(
+            gen_url, args.duration, args.qps, mix=mix, slo_ms=slo,
+            new_tokens=args.new_tokens, prompt_len=args.shared_spec,
+            seed=args.seed, arrival="poisson")
+    finally:
+        watch_stop.set()
+        sampler.join(timeout=30)
+    kv1 = _healthz(url)["serving"]["kv"]
+
+    # -- phase 2: decode load, no prefill pressure -------------------
+    solo = loadgen.run_load(
+        gen_url, args.duration, args.qps, mix=mix, slo_ms=slo,
+        new_tokens=args.new_tokens, prompt_len=args.short_len,
+        seed=args.seed + 1, arrival="uniform")
+
+    # -- phase 3: same decode load + long-prompt prefill burst -------
+    stop = threading.Event()
+    burst_errors = [0]
+
+    def prefill_burst():
+        i = 0
+        while not stop.is_set():
+            try:
+                _post(gen_url, {"ids": [[(i + j) % 97 for j in
+                                         range(long_len)]],
+                                "new_tokens": 2, "class": "batch"})
+            except Exception:   # noqa: BLE001 — sheds are expected here
+                burst_errors[0] += 1
+            i += 1
+
+    burster = threading.Thread(target=prefill_burst, daemon=True,
+                               name="kv-prefill-burst")
+    burster.start()
+    try:
+        contended = loadgen.run_load(
+            gen_url, args.duration, args.qps, mix=mix, slo_ms=slo,
+            new_tokens=args.new_tokens, prompt_len=args.short_len,
+            seed=args.seed + 2, arrival="uniform")
+    finally:
+        stop.set()
+        burster.join(timeout=120)
+    kv2 = _healthz(url)["serving"]["kv"]
+
+    # PHASE-1 deltas, not server-lifetime cumulatives: the warmup posts
+    # (guaranteed misses) and later phases must not dilute the shared-
+    # prefix phase's hit rate
+    lookups = kv1["prefix"]["lookups"] - kv0["prefix"]["lookups"]
+    hits = kv1["prefix"]["hits"] - kv0["prefix"]["hits"]
+    hit_rate = None if lookups <= 0 else round(hits / lookups, 4)
+    p99_solo = solo["latency_ms"]["p99"]
+    p99_contended = contended["latency_ms"]["p99"]
+    errors = (shared["totals"]["error"] + solo["totals"]["error"]
+              + contended["totals"]["error"])
+    notes = None
+    if errors:
+        notes = (f"{errors} handler error(s); first: "
+                 f"{shared['first_error'] or solo['first_error'] or contended['first_error']}")
+    goodput = round(sum(c["goodput_rps"]
+                        for c in shared["classes"].values()), 3)
+    return {
+        "throughput": {"value": goodput, "unit": "req/s",
+                       "detail": "shared-prefix phase goodput"},
+        "latency_ms": {"p50": solo["latency_ms"]["p50"],
+                       "p95": solo["latency_ms"]["p95"],
+                       "p99": p99_solo, "n": solo["latency_ms"]["n"]},
+        "kv": {
+            "pages": args.kv_pages, "page_size": args.kv_page_size,
+            "disaggregate": args.disaggregate,
+            # the token-budget-vs-dense-slots claim in record form: the
+            # budget's token capacity, how many max_len dense slots the
+            # same memory would be, and the observed concurrency peak
+            "token_budget": args.kv_pages * args.kv_page_size,
+            "dense_slots_equivalent": (args.kv_pages
+                                       * args.kv_page_size)
+            // args.max_len,
+            "max_in_flight": watch["max_in_flight"],
+            "min_tokens_free": watch["min_tokens_free"],
+            "prefix_hit_rate": hit_rate,
+            "prefix_lookups": lookups,
+            "pages_reused_total": kv1["prefix"]["pages_reused_total"],
+            "pages_cached": kv1["prefix"]["pages_cached"],
+            "pool_occupancy_after": kv2["pool"]["occupancy"],
+            "pages_evicted_total": kv2["pool"]["pages_evicted_total"],
+            "decode_p99_ms": {"solo": p99_solo,
+                              "with_prefill": p99_contended},
+            "decode_p99_ratio": (None if not p99_solo or not p99_contended
+                                 else round(p99_contended / p99_solo, 3)),
+            "shed": {"shared": shared["totals"]["shed"],
+                     "solo": solo["totals"]["shed"],
+                     "with_prefill": contended["totals"]["shed"]},
+            "errors": errors,
+        },
+        "notes": notes,
+        "extras": {"shared": shared, "solo": solo,
+                   "contended": contended},
+    }
+
+
+def _register():
+    from . import Recipe, register
+    register(Recipe(
+        "serve_kv", "paged-KV serving bench: shared-prefix hit rate, "
+                    "page-pool occupancy, and decode p99 with/without a "
+                    "concurrent prefill burst (colocated vs "
+                    "--disaggregate is the docs/evidence A/B)",
+        _args, _run, setup=_setup, teardown=_teardown, tier="fast"))
+
+
+_register()

@@ -73,6 +73,13 @@ class _Request:
     expired: bool = False            # the deadline check tripped
     rows_done: Optional[np.ndarray] = None   # [B] eos seen per row
     caches: Optional[List] = None    # per-stage cache slots (admission)
+    # paged-KV plane (pipeedge_tpu/kv): page tables + sharing state when
+    # a PagedKvBackend drives this request instead of dense cache slots
+    kvstate: Optional[Dict] = None
+    # a prefill fleet's ship handle (kv/disagg.py): the prompt pass
+    # already ran remotely; admission installs the KV rows and decoding
+    # starts directly at the first decode step
+    shipped: Optional[Dict] = None
     tokens: List = field(default_factory=list)
 
     @property
@@ -88,7 +95,8 @@ def _build_request(pipe: DecodePipeline, rid, ids, new_tokens: int,
                    eos_token: Optional[int], pad_token: Optional[int],
                    prefix: Optional[Dict],
                    on_token=None, cancel=None,
-                   deadline: Optional[float] = None) -> _Request:
+                   deadline: Optional[float] = None,
+                   shipped: Optional[Dict] = None) -> _Request:
     """Validate one request's arguments against `pipe` and build its
     `_Request` — the shared admission contract of the wave batcher and
     the stage-worker executor (identical errors, identical rng/pick
@@ -107,6 +115,9 @@ def _build_request(pipe: DecodePipeline, rid, ids, new_tokens: int,
         # (a mismatch would otherwise surface as an opaque jit shape
         # error mid-tick, or corrupt attend windows)
         pipe.check_prefix(prefix)
+    if shipped is not None and prefix is not None:
+        raise ValueError("shipped KV already covers the whole prompt; "
+                         "it does not compose with a prefix handle")
     prompt_len = ids.shape[1] + (prefix["len"] if prefix else 0)
     validate_capacity(pipe.cfg, pipe.max_len, prompt_len, new_tokens)
     return _Request(
@@ -116,7 +127,8 @@ def _build_request(pipe: DecodePipeline, rid, ids, new_tokens: int,
         prefix=prefix, eos_token=eos_token,
         pad_token=eos_token if pad_token is None else pad_token,
         on_token=on_token, cancel=cancel,
-        deadline=None if deadline is None else float(deadline))
+        deadline=None if deadline is None else float(deadline),
+        shipped=shipped)
 
 
 def _seed_caches(pipe: DecodePipeline, req: _Request) -> str:
@@ -215,17 +227,23 @@ class ContinuousBatcher:
     tick, i.e. ~1 token per tick vs a solo stream's 1 per n_stages.
     """
 
-    def __init__(self, pipe: DecodePipeline, max_active: Optional[int] = None):
+    def __init__(self, pipe: DecodePipeline, max_active: Optional[int] = None,
+                 kv=None):
         if pipe.sp_degree != 1:
             raise ValueError("continuous batching drives per-request decode "
                              "waves; sp prefill is a whole-pipeline pass "
                              "(prefill each request solo instead)")
         self.pipe = pipe
         self.n_stages = len(pipe.stages)
-        # n_stages slots saturate the pipeline; +1 hides the one-tick gap
-        # when a finished request's slot is re-admitted
-        self.max_active = (self.n_stages + 1 if max_active is None
-                           else max_active)
+        # paged-KV backend (kv/backend.py): when set, requests hold page
+        # tables over the shared pool instead of private dense slots, and
+        # admission is bounded by PAGES (max_active defaults to the pool's
+        # page count — effectively token-bounded concurrency)
+        self.kv = kv
+        if max_active is None:
+            max_active = (self.n_stages + 1 if kv is None
+                          else max(self.n_stages + 1, kv.pool.n_pages))
+        self.max_active = max_active
         if self.max_active < 1:
             raise ValueError(f"max_active must be >= 1, got {self.max_active}")
         self.pending: deque = deque()
@@ -245,10 +263,16 @@ class ContinuousBatcher:
                pad_token: Optional[int] = None,
                prefix: Optional[Dict] = None,
                on_token=None, cancel=None,
-               deadline: Optional[float] = None) -> None:
+               deadline: Optional[float] = None,
+               shipped: Optional[Dict] = None) -> None:
         """Queue a request. `ids` [B, S] is a prompt batch decoded in
         lockstep (B=1 for a single sequence); each distinct (B, S) shape
         compiles its own prefill program, shared across requests.
+
+        `shipped` (paged-KV executors only) is a prefill fleet's ship
+        handle (kv/disagg.py): the prompt pass already ran remotely, so
+        admission installs the KV rows into this request's pages and
+        decoding starts at the first decode step.
 
         `prefix` (from the pipeline's `precompute_prefix`) seeds this
         request's cache slots with a shared prompt prefix; `ids` is then
@@ -286,25 +310,49 @@ class ContinuousBatcher:
         pipeline)."""
         if rid in self.results or rid in self._live_rids:
             raise ValueError(f"duplicate request id {rid!r}")
+        if shipped is not None and self.kv is None:
+            raise ValueError("shipped KV needs a paged-KV backend "
+                             "(ContinuousBatcher(kv=...))")
         req = _build_request(self.pipe, rid, ids, new_tokens, temperature,
                              top_k, seed, eos_token, pad_token, prefix,
                              on_token=on_token, cancel=cancel,
-                             deadline=deadline)
+                             deadline=deadline, shipped=shipped)
+        if self.kv is not None:
+            # a reservation bigger than the whole pool would wedge the
+            # pending queue forever (can_admit never true): reject it
+            # up front like the dense path's capacity check
+            self.kv.check_admittable(req)
         self._live_rids.add(rid)
         self.pending.append(req)
 
     def _admit(self) -> None:
         while self.pending and self.active < self.max_active:
-            req = self.pending.popleft()
+            req = self.pending[0]
             if _expired(req):
                 # dead before its first wave: never seed caches or touch
                 # the pipeline — the whole point of deadline propagation
+                self.pending.popleft()
                 self.results[req.rid] = _finalize_tokens(req)
                 self._live_rids.discard(req.rid)
                 continue
-            kind = _seed_caches(self.pipe, req)
+            if self.kv is not None:
+                if not self.kv.can_admit(req):
+                    break       # head-of-line: wait for page releases
+                self.pending.popleft()
+                kind, data = self.kv.admit(req)
+                if req.tokens:
+                    # shipped install picked the first token in admit
+                    self.stats["tokens"] += int(req.ids.shape[0])
+                if kind == "done":
+                    self.kv.release(req)
+                    self.results[req.rid] = _finalize_tokens(req)
+                    self._live_rids.discard(req.rid)
+                    continue
+            else:
+                self.pending.popleft()
+                kind, data = _seed_caches(self.pipe, req), req.ids
             self.active += 1
-            self._stage_q[0].append((req, req.ids, kind))
+            self._stage_q[0].append((req, data, kind))
 
     def _finish_wave(self, req: _Request, out, kind: str,
                      reentries: list, eos_pending: list) -> None:
@@ -340,6 +388,8 @@ class ContinuousBatcher:
     def _complete(self, req: _Request) -> None:
         self.results[req.rid] = _finalize_tokens(req)
         req.caches = None            # free this request's cache slots
+        if self.kv is not None:
+            self.kv.release(req)     # ... or its page references
         self.active -= 1
         self._live_rids.discard(req.rid)
 
@@ -380,7 +430,9 @@ class ContinuousBatcher:
             if not self._stage_q[i]:
                 continue
             req, data, kind = self._stage_q[i].popleft()
-            out = _run_stage(self.pipe, i, req, data, kind)
+            out = (self.kv.run_stage(i, req, data, kind)
+                   if self.kv is not None
+                   else _run_stage(self.pipe, i, req, data, kind))
             self.stats["stage_steps"] += 1
             worked = True
             if i + 1 < self.n_stages:
@@ -436,7 +488,7 @@ class StageWorkerExecutor:
     _DONE = object()
 
     def __init__(self, pipe: DecodePipeline,
-                 max_active: Optional[int] = None):
+                 max_active: Optional[int] = None, kv=None):
         import queue as queue_mod
         import threading
 
@@ -447,8 +499,13 @@ class StageWorkerExecutor:
                              "waves; sp prefill is a whole-pipeline pass")
         self.pipe = pipe
         self.n_stages = len(pipe.stages)
-        self.max_active = (self.n_stages + 1 if max_active is None
-                           else max_active)
+        # paged-KV backend: page-table caches + token-bounded admission
+        # (submit blocks on PAGE availability, not just the slot count)
+        self.kv = kv
+        if max_active is None:
+            max_active = (self.n_stages + 1 if kv is None
+                          else max(self.n_stages + 1, kv.pool.n_pages))
+        self.max_active = max_active
         if self.max_active < 1:
             raise ValueError(f"max_active must be >= 1, got {self.max_active}")
         self._q = [queue_mod.Queue() for _ in range(self.n_stages)]
@@ -477,17 +534,28 @@ class StageWorkerExecutor:
                pad_token: Optional[int] = None,
                prefix: Optional[Dict] = None,
                on_token=None, cancel=None,
-               deadline: Optional[float] = None) -> None:
+               deadline: Optional[float] = None,
+               shipped: Optional[Dict] = None) -> None:
         """Admit one request (same argument contract as
         `ContinuousBatcher.submit`, including prefix-handle validation,
-        the `on_token` streaming hook, the `cancel` flag and the
-        `deadline`). BLOCKS while `max_active` requests are in flight —
+        the `on_token` streaming hook, the `cancel` flag, the `deadline`
+        and — on a paged-KV executor — a prefill fleet's `shipped`
+        handle). BLOCKS while `max_active` requests are in flight —
         admission backpressure is the caller's thread, not an internal
-        queue."""
+        queue; a paged executor additionally blocks on PAGE
+        availability."""
+        if shipped is not None and self.kv is None:
+            raise ValueError("shipped KV needs a paged-KV backend "
+                             "(StageWorkerExecutor(kv=...))")
         req = _build_request(self.pipe, rid, ids, new_tokens, temperature,
                              top_k, seed, eos_token, pad_token, prefix,
                              on_token=on_token, cancel=cancel,
-                             deadline=deadline)
+                             deadline=deadline, shipped=shipped)
+        if self.kv is not None:
+            # reject a bigger-than-the-pool reservation BEFORE taking a
+            # slot (alloc would raise PoolExhausted anyway; this makes
+            # it the same up-front ValueError the wave batcher gives)
+            self.kv.check_admittable(req)
         with self._lock:
             self._check_dead()
             if rid in self.results or rid in self._live:
@@ -510,12 +578,36 @@ class StageWorkerExecutor:
                 self._slots.release()
                 return
             try:
-                kind = _seed_caches(self.pipe, req)
-                self._q[0].put((req, req.ids, kind))
+                if self.kv is not None:
+                    # page admission blocks like the slot semaphore does:
+                    # completions release pages, so waiting here is the
+                    # same caller-thread backpressure contract
+                    kind, data = self.kv.admit(req, block=True)
+                    if req.tokens and kind != "done":
+                        # a shipped install's first token was picked in
+                        # admit — count it like the wave batcher does
+                        with self._lock:
+                            self.stats["tokens"] += int(req.ids.shape[0])
+                else:
+                    kind, data = _seed_caches(self.pipe, req), req.ids
+                if kind == "done":
+                    # a shipped install whose first token already
+                    # completed the request: never touches the pipeline
+                    arr = _finalize_tokens(req)
+                    self.kv.release(req)
+                    with self._lock:
+                        self.stats["tokens"] += int(req.ids.shape[0])
+                        self.results[rid] = arr
+                        self._live.discard(rid)
+                        self.active -= 1
+                        self._lock.notify_all()
+                    self._slots.release()
+                    return
+                self._q[0].put((req, data, kind))
             except BaseException:
-                # roll the admission back (e.g. cache allocation OOM):
-                # leaking the slot would eventually wedge every submit
-                # while healthz still reports ok
+                # roll the admission back (e.g. cache allocation OOM /
+                # page-pool exhaustion): leaking the slot would
+                # eventually wedge every submit while healthz reports ok
                 with self._lock:
                     self.active -= 1
                 raise
@@ -553,6 +645,11 @@ class StageWorkerExecutor:
         the join, every still-live request's waiter is FAILED rather
         than left hanging. Drain with `wait` before stopping if results
         matter."""
+        if self.kv is not None:
+            # wake submitters parked on PAGE availability too (the
+            # semaphore over-release below only reaches slot waiters);
+            # in-flight completions still release their pages
+            self.kv.pool.close()
         for q in self._q:
             q.put(self._DONE)
         for w in self._workers:
@@ -586,7 +683,9 @@ class StageWorkerExecutor:
             req, data, kind = item
             self.stats["busy"][i] = True
             try:
-                out = _run_stage(self.pipe, i, req, data, kind)
+                out = (self.kv.run_stage(i, req, data, kind)
+                       if self.kv is not None
+                       else _run_stage(self.pipe, i, req, data, kind))
                 self.stats["stage_steps"][i] += 1
                 if i + 1 < self.n_stages:
                     self._q[i + 1].put((req, out, kind))
@@ -624,6 +723,8 @@ class StageWorkerExecutor:
         if done:
             arr = _finalize_tokens(req)
             req.caches = None        # free this request's cache slots
+            if self.kv is not None:
+                self.kv.release(req)  # ... or its page references
             with self._lock:
                 self.results[req.rid] = arr
                 self._live.discard(req.rid)
@@ -639,5 +740,8 @@ class StageWorkerExecutor:
                 self._dead = exc
             self._lock.notify_all()
         # wake submitters blocked on admission so they observe the death
+        # — both the slot semaphore and (paged) the page-pool wait
+        if self.kv is not None:
+            self.kv.pool.close()
         for _ in range(self.max_active):
             self._slots.release()

@@ -8,11 +8,15 @@ tools/serve.py the three mechanisms that bound the damage:
 
 - `admission`: per-class token-bucket rate limits, a bounded
   earliest-deadline-first admission queue, load shedding with a
-  Retry-After computed from the observed service rate, and deadline
-  bookkeeping (`AdmissionController`).
+  Retry-After computed from the observed service rate, deadline
+  bookkeeping, and — with a paged KV plane (pipeedge_tpu/kv) — a KV
+  TOKEN budget: each grant charges the request's prompt+max-new-tokens
+  page reservation, so concurrency is bounded by cache tokens instead
+  of `max_active` slots (`AdmissionController`).
 - `brownout`: a watermark-driven degradation ladder that steps through
-  disable-speculative -> clamp new_tokens -> shed best-effort -> shed
-  batch, and steps back down with hysteresis (`BrownoutLadder`).
+  disable-speculative -> clamp new_tokens -> evict cold KV pages ->
+  shed best-effort -> shed batch, and steps back down with hysteresis
+  (`BrownoutLadder`).
 - deadline propagation itself lives in the executors
   (`parallel/batcher.py`): each request's absolute deadline rides into
   the decode loop, and expiry fires the existing `cancel` flag at the

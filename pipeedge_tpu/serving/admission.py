@@ -194,6 +194,19 @@ class EDFQueue:
                 return item, key
         return None
 
+    def peek(self):
+        """(item, deadline_key) of the earliest alive entry WITHOUT
+        removing it (dead entries are drained in passing) — the
+        token-budget grant loop inspects the head and leaves it in
+        place when tokens are short, so the head keeps its position
+        instead of being re-queued behind same-deadline arrivals."""
+        import heapq
+        while self._heap and not self._heap[0][3]:
+            heapq.heappop(self._heap)
+        if not self._heap:
+            return None
+        return self._heap[0][2], self._heap[0][0]
+
     def pop_expired(self, now: float) -> List[object]:
         """Remove and return every entry whose deadline has passed —
         work that would be shed the moment it was granted anyway."""
@@ -294,15 +307,17 @@ class DeadlineExceeded(RuntimeError):
         self.deadline_s = deadline_s
 
 
-SHED_REASONS = ("rate", "queue_full", "brownout", "expired", "shutdown")
+SHED_REASONS = ("rate", "queue_full", "brownout", "expired", "shutdown",
+                "budget")
 
 
 class _Ticket:
     __slots__ = ("request_class", "deadline", "t_enq", "event",
-                 "shed_reason", "granted", "rid")
+                 "shed_reason", "granted", "rid", "tokens")
 
     def __init__(self, request_class: str, deadline: Optional[float],
-                 t_enq: float, rid: Optional[str] = None):
+                 t_enq: float, rid: Optional[str] = None,
+                 tokens: int = 0):
         self.request_class = request_class
         self.deadline = deadline
         self.t_enq = t_enq
@@ -313,6 +328,9 @@ class _Ticket:
         # snapshot in a postmortem bundle name WHO is waiting, not just
         # how many (docs/OBSERVABILITY.md request tracing)
         self.rid = rid
+        # KV-token charge under a token budget (docs/SERVING.md paged
+        # KV): held from grant to release
+        self.tokens = int(tokens)
 
 
 class AdmissionController:
@@ -329,13 +347,24 @@ class AdmissionController:
                  policies: Optional[Dict[str, ClassPolicy]] = None,
                  registry: Optional[prom.Registry] = None,
                  rate_halflife_s: float = 10.0,
-                 retry_after_fallback: float = 5.0):
+                 retry_after_fallback: float = 5.0,
+                 token_budget: Optional[int] = None):
         if concurrency < 1:
             raise ValueError(f"concurrency must be >= 1, got {concurrency}")
+        if token_budget is not None and token_budget < 1:
+            raise ValueError(f"token_budget must be >= 1, got "
+                             f"{token_budget}")
         self.policies = (default_policies() if policies is None
                          else dict(policies))
         self.concurrency = int(concurrency)
         self._free = int(concurrency)
+        # the paged-KV admission unit (docs/SERVING.md): admission
+        # charges each request's KV-token reservation (prompt +
+        # max-new-tokens pages) against this budget; completion frees
+        # it. None = slot-only admission (the dense-cache behavior).
+        self.token_budget = (None if token_budget is None
+                             else int(token_budget))
+        self._tokens_free = self.token_budget
         self._queue = EDFQueue(queue_capacity)
         self._lock = make_lock("serving.admission")
         self._closed = False
@@ -362,6 +391,12 @@ class AdmissionController:
             "pipeedge_admission_queue_depth",
             "requests waiting in the EDF admission queue")
         self.m_queue_depth.set(0)
+        self.m_tokens_free = reg.gauge(
+            "pipeedge_admission_tokens_free",
+            "unreserved KV tokens under the admission token budget "
+            "(absent series when no budget is configured)")
+        if self.token_budget is not None:
+            self.m_tokens_free.set(self.token_budget)
 
     # -- policy helpers ---------------------------------------------------
 
@@ -414,26 +449,39 @@ class AdmissionController:
     def admit(self, request_class: str = "interactive",
               deadline: Optional[float] = None,
               now: Optional[float] = None,
-              rid: Optional[str] = None) -> _Ticket:
+              rid: Optional[str] = None,
+              tokens: int = 0) -> _Ticket:
         """Block until granted an execution slot (EDF order) or shed.
         `deadline` is ABSOLUTE monotonic time (see `deadline_for`);
-        `rid` request-tags the ticket for snapshots/postmortems."""
+        `rid` request-tags the ticket for snapshots/postmortems.
+        `tokens` is the request's KV-token reservation under a token
+        budget (prompt + max-new-tokens pages, tools/serve.py): the
+        grant requires both a slot AND the tokens, so concurrency is
+        bounded by cache TOKENS, not request count."""
         now = time.monotonic() if now is None else now
         self.policy(request_class)          # KeyError -> caller's 400
-        ticket = _Ticket(request_class, deadline, now, rid=rid)
+        tokens = int(tokens) if self.token_budget is not None else 0
+        ticket = _Ticket(request_class, deadline, now, rid=rid,
+                         tokens=tokens)
         shed_waiter: Optional[_Ticket] = None
         with self._lock:
             if self._closed:
                 raise self._shed(request_class, "shutdown")
             if request_class in self._shed_classes:
                 raise self._shed(request_class, "brownout")
+            if self.token_budget is not None \
+                    and tokens > self.token_budget:
+                # bigger than the WHOLE budget: waiting can never help
+                raise self._shed(request_class, "budget")
             bucket = self._buckets.get(request_class)
             if bucket is not None and not bucket.try_take(now=now):
                 raise self._shed(request_class, "rate")
             if deadline is not None and deadline <= now:
                 raise self._shed(request_class, "expired")
-            if self._free > 0 and not len(self._queue):
+            if self._free > 0 and not len(self._queue) \
+                    and self._tokens_ok_locked(tokens):
                 self._free -= 1
+                self._take_tokens_locked(tokens)
                 ticket.granted = True
             else:
                 shed_item = self._queue.push(ticket, deadline)
@@ -480,18 +528,32 @@ class AdmissionController:
         self.m_adm_latency.observe(wait_s, **{"class": request_class})
         return ticket
 
+    def _tokens_ok_locked(self, tokens: int) -> bool:
+        return (self.token_budget is None
+                or self._tokens_free >= tokens)
+
+    def _take_tokens_locked(self, tokens: int) -> None:
+        if self.token_budget is not None and tokens:
+            self._tokens_free -= tokens
+            self.m_tokens_free.set(self._tokens_free)
+
     def release(self, ticket: Optional[_Ticket] = None,
                 completed: bool = True,
                 now: Optional[float] = None) -> None:
-        """Return an execution slot and grant the next EDF head(s).
-        `completed=True` feeds the service-rate estimator (sheds and
-        failures should not inflate the observed service rate)."""
-        del ticket        # symmetry with admit; slots are anonymous
+        """Return an execution slot (and the ticket's token
+        reservation) and grant the next EDF head(s). `completed=True`
+        feeds the service-rate estimator (sheds and failures should not
+        inflate the observed service rate)."""
         now = time.monotonic() if now is None else now
         to_wake: List[_Ticket] = []
         expired: List[_Ticket] = []
         with self._lock:
             self._free = min(self.concurrency, self._free + 1)
+            if self.token_budget is not None and ticket is not None \
+                    and ticket.tokens:
+                self._tokens_free = min(self.token_budget,
+                                        self._tokens_free + ticket.tokens)
+                self.m_tokens_free.set(self._tokens_free)
             if completed:
                 self.estimator.observe(now)
             self._grant_locked(now, to_wake, expired)
@@ -510,11 +572,21 @@ class AdmissionController:
             t.shed_reason = "expired"
             expired.append(t)
         while self._free > 0:
-            nxt = self._queue.pop()
+            nxt = self._queue.peek()
             if nxt is None:
                 break
             t, _ = nxt
+            if not self._tokens_ok_locked(t.tokens):
+                # head-of-line under the token budget: the EDF head
+                # stays IN PLACE (peek, not pop) waiting for token
+                # releases — re-queueing would assign a fresh tie-break
+                # seq and let same-deadline arrivals overtake it,
+                # starving big-context requests under sustained small-
+                # request load
+                break
+            self._queue.pop()          # the same head, under the lock
             self._free -= 1
+            self._take_tokens_locked(t.tokens)
             t.granted = True
             to_wake.append(t)
         self.m_queue_depth.set(len(self._queue))
@@ -541,14 +613,19 @@ class AdmissionController:
             waiting = [{"rid": t.rid, "class": t.request_class}
                        for t in self._queue.items()]
         rate = self.estimator.rate()
-        return {"queue_depth": depth, "in_flight": in_flight,
-                "concurrency": self.concurrency,
-                "queue_capacity": self._queue.capacity,
-                "shed_classes": sorted(self._shed_classes),
-                "waiting": waiting,
-                "service_rate_rps": (None if rate is None
-                                     else round(rate, 3)),
-                "shed_total": int(self.m_shed.total())}
+        out = {"queue_depth": depth, "in_flight": in_flight,
+               "concurrency": self.concurrency,
+               "queue_capacity": self._queue.capacity,
+               "shed_classes": sorted(self._shed_classes),
+               "waiting": waiting,
+               "service_rate_rps": (None if rate is None
+                                    else round(rate, 3)),
+               "shed_total": int(self.m_shed.total())}
+        if self.token_budget is not None:
+            with self._lock:
+                out["token_budget"] = self.token_budget
+                out["tokens_free"] = self._tokens_free
+        return out
 
     def close(self) -> None:
         """Shed every waiter (shutdown) and refuse new admissions."""

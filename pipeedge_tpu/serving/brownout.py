@@ -11,8 +11,12 @@ first — instead of letting the overload degrade everyone uniformly:
 |       |                   | (token-identical; frees the draft model's  |
 |       |                   | serialized dispatch + cache memory)        |
 | 2     | clamp_tokens      | new_tokens clamped to `clamp_new_tokens`   |
-| 3     | shed_best_effort  | best_effort class shed at admission        |
-| 4     | shed_batch        | batch class shed too (interactive only)    |
+| 3     | evict_cold_pages  | reclaim cached-but-idle prefix KV pages    |
+|       |                   | (the paged-KV trie's cold pages — capacity |
+|       |                   | only future requests would miss, spent     |
+|       |                   | BEFORE any live request is shed)           |
+| 4     | shed_best_effort  | best_effort class shed at admission        |
+| 5     | shed_batch        | batch class shed too (interactive only)    |
 
 Stepping is governed by watermarks + dwell times (hysteresis): the hot
 condition must persist `dwell_up_s` before each step up, and the calm
@@ -35,8 +39,9 @@ from typing import Optional
 from ..telemetry import metrics as prom
 
 LEVEL_NAMES = ("normal", "no_speculative", "clamp_tokens",
-               "shed_best_effort", "shed_batch")
+               "evict_cold_pages", "shed_best_effort", "shed_batch")
 MAX_LEVEL = len(LEVEL_NAMES) - 1
+EVICT_LEVEL = LEVEL_NAMES.index("evict_cold_pages")
 
 
 @dataclass
@@ -78,6 +83,11 @@ class BrownoutLadder:
         self._floor = 0         # lifecycle-driven minimum (healing >= 1)
         self._hot_since: Optional[float] = None
         self._calm_since: Optional[float] = None
+        # the evict_cold_pages rung's lever: `hook() -> pages freed`
+        # (the paged-KV backend's cold-prefix sweep, tools/serve.py);
+        # called on every governor tick while the level holds >= 3, so
+        # pages that re-chill during a long hot spell keep reclaiming
+        self.evict_hook: Optional[object] = None
         reg = prom.REGISTRY if registry is None else registry
         self.m_level = reg.gauge(
             "pipeedge_brownout_level",
@@ -149,6 +159,8 @@ class BrownoutLadder:
         if after != before:
             self.m_steps.inc(direction="up" if after > before else "down")
         self.m_level.set(after)
+        if after >= EVICT_LEVEL and self.evict_hook is not None:
+            self.evict_hook()
         return after
 
     # -- effects ----------------------------------------------------------
@@ -164,14 +176,16 @@ class BrownoutLadder:
         return int(new_tokens)
 
     def shed_classes(self) -> frozenset:
-        if self.level >= 4:
+        if self.level >= 5:
             return frozenset(("best_effort", "batch"))
-        if self.level >= 3:
+        if self.level >= 4:
             return frozenset(("best_effort",))
         return frozenset()
 
     def snapshot(self) -> dict:
         return {"level": self.level, "name": self.level_name,
                 "stepped": self._stepped, "floor": self._floor,
+                "evicting": self.level >= EVICT_LEVEL
+                and self.evict_hook is not None,
                 "clamp_new_tokens": (self.clamp_new_tokens
                                      if self.level >= 2 else None)}

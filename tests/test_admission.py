@@ -342,8 +342,10 @@ def test_brownout_steps_up_one_rung_per_dwell():
     assert lad.update(10, None, now=1.5) == 1     # re-armed: one per dwell
     assert lad.update(10, None, now=2.0) == 2
     assert lad.update(0, 5.0, now=3.0) == 3       # p95 alone is hot too
+    assert lad.level_name == "evict_cold_pages"
     assert lad.update(10, None, now=4.0) == 4
-    assert lad.update(10, None, now=9.0) == 4     # capped at max rung
+    assert lad.update(10, None, now=5.0) == 5
+    assert lad.update(10, None, now=9.0) == 5     # capped at max rung
     assert lad.level_name == "shed_batch"
 
 
@@ -385,19 +387,31 @@ def test_brownout_lifecycle_floor_and_effects():
     assert not lad.allow_speculative()
     assert lad.update(0, 0.1, now=100.0) == 1     # calm cannot go below
     assert lad.set_floor(0) == 0
-    # effects ladder: clamp at >=2, shed best_effort at >=3, batch at >=4
+    # effects ladder: clamp at >=2, evict cold KV pages at >=3, shed
+    # best_effort at >=4, batch at >=5
     assert lad.clamp(100) == 100
     lad.update(10, None, now=200.0)
     lad.update(10, None, now=201.0)
     lad.update(10, None, now=202.0)
     assert lad.level == 2 and lad.clamp(100) == lad.clamp_new_tokens
     assert lad.shed_classes() == frozenset()
+    evictions = []
+    lad.evict_hook = lambda: evictions.append(1) or 1
     lad.update(10, None, now=203.0)
-    assert lad.shed_classes() == frozenset({"best_effort"})
+    assert lad.level == 3 and lad.shed_classes() == frozenset()
+    assert evictions, "evict_cold_pages rung never called its hook"
     lad.update(10, None, now=204.0)
+    assert lad.shed_classes() == frozenset({"best_effort"})
+    lad.update(10, None, now=205.0)
     assert lad.shed_classes() == frozenset({"best_effort", "batch"})
+    # the hook keeps firing while the ladder holds at/above the rung
+    # (pages that re-chill during a long hot spell keep reclaiming)
+    n = len(evictions)
+    lad.update(10, None, now=205.5)
+    assert len(evictions) > n
     snap = lad.snapshot()
-    assert snap["level"] == 4 and snap["name"] == "shed_batch"
+    assert snap["level"] == 5 and snap["name"] == "shed_batch"
+    assert snap["evicting"]
 
 
 def test_brownout_gauge_and_transition_counter():
@@ -774,10 +788,11 @@ def test_overload_metrics_exported(overload_server):
             f"http://127.0.0.1:{port}/metrics", timeout=30) as resp:
         text = resp.read().decode()
     assert "# TYPE pipeedge_requests_shed_total counter" in text
+    from pipeedge_tpu.serving.admission import SHED_REASONS
     shed_lines = [ln for ln in text.splitlines()
                   if ln.startswith("pipeedge_requests_shed_total{")]
     # the full (class, reason) matrix renders, and something was shed
-    assert len(shed_lines) == 3 * 5, shed_lines
+    assert len(shed_lines) == 3 * len(SHED_REASONS), shed_lines
     assert any(float(ln.rsplit(" ", 1)[1]) > 0 for ln in shed_lines)
     assert "pipeedge_brownout_level" in text
     assert "pipeedge_brownout_transitions_total" in text

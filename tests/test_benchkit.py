@@ -17,7 +17,7 @@ from tools import bench_report                         # noqa: E402
 from tools.loadgen import arrival_offsets              # noqa: E402
 
 ALL_RECIPES = {"exact", "quant_collectives", "spmd", "dcn", "decode",
-               "train", "serve"}
+               "train", "serve", "serve_kv"}
 
 
 # -- registry resolution -------------------------------------------------
@@ -92,6 +92,24 @@ def _sample_blocks(name):
                           "overload_factor": 3.0,
                           "p99_exemplar_rid": "q17",
                           "trace": "bench_serve_trace.json"}}
+    if name == "serve_kv":
+        return {"throughput": {"value": 2.4, "unit": "req/s"},
+                "latency_ms": {"p50": 40.0, "p95": 80.0, "p99": 95.0,
+                               "n": 12},
+                "kv": {"pages": 96, "page_size": 8,
+                       "disaggregate": "local",
+                       "prefix_hit_rate": 0.73,
+                       "prefix_lookups": 11,
+                       "pages_reused_total": 18,
+                       "pages_cached": 10,
+                       "pool_occupancy_after": 0.958,
+                       "pages_evicted_total": 4,
+                       "decode_p99_ms": {"solo": 93.8,
+                                         "with_prefill": 190.6},
+                       "decode_p99_ratio": 2.03,
+                       "shed": {"shared": 0, "solo": 0,
+                                "with_prefill": 0},
+                       "errors": 0}}
     if name == "dcn":
         return {"throughput": {"value": 210.0, "unit": "items/sec"},
                 "latency_ms": {"p50": 40.0, "p95": 55.0, "p99": 60.0,

@@ -398,7 +398,8 @@ def test_streaming_shed_counts_class_outcome_matrix():
         [(1, total)], [params], max_len=32)
     svc = serve_mod._Service(pipe, executor="wave")
     try:
-        def always_shed(request_class, deadline_s=None, rid=None):
+        def always_shed(request_class, deadline_s=None, rid=None,
+                        tokens=0):
             raise AdmissionShed(request_class, "queue_full", 1.25)
 
         svc.admit = always_shed

@@ -434,7 +434,17 @@ def _tiny_pipe(partition=None, max_len=64):
 def test_stage_executor_stop_wakes_blocked_submitter():
     """stop() must over-release the admission semaphore like _die() does:
     a submitter blocked in _slots.acquire() (pipeline full) wakes and
-    raises instead of hanging forever (ADVICE.md r5)."""
+    raises instead of hanging forever (ADVICE.md r5).
+
+    Deterministic by construction (this flaked under full-suite load
+    when it was sleep-paced): "a" is known admitted once its FIRST token
+    streams back (on_token fires from the last stage's worker), and "b"
+    is known registered once it appears in the executor's live set —
+    which happens BEFORE its semaphore wait, so stop()'s over-release
+    reaches it whether it is already parked in acquire() or still on the
+    way there (both paths re-check _dead and raise). "a" cannot complete
+    early: its 44-token budget would need the whole pipeline to drain
+    between two adjacent host steps here."""
     import threading
 
     import jax.numpy as jnp
@@ -443,22 +453,30 @@ def test_stage_executor_stop_wakes_blocked_submitter():
 
     ex = StageWorkerExecutor(_tiny_pipe(), max_active=1)
     errs = {}
+    first_token = threading.Event()
 
-    def client(rid, tokens):
+    def client(rid, tokens, **kw):
         try:
-            ex.submit(rid, jnp.zeros((1, 4), jnp.int32), tokens)
+            ex.submit(rid, jnp.zeros((1, 4), jnp.int32), tokens, **kw)
             ex.wait(rid, timeout=120)
         except RuntimeError as exc:
             errs[rid] = str(exc)
 
     # "a" holds the only admission slot with a long generation
-    t_a = threading.Thread(target=client, args=("a", 40), daemon=True)
+    t_a = threading.Thread(target=client, args=("a", 44), daemon=True,
+                           kwargs={"on_token":
+                                   lambda s, t: first_token.set()})
     t_a.start()
-    time.sleep(0.5)             # let "a" admit and enter the pipeline
-    # "b" blocks in _slots.acquire (admission backpressure)
+    assert first_token.wait(timeout=120), "'a' never started decoding"
+    # "b" heads for _slots.acquire (admission backpressure): it is in
+    # the live set before it can block, so this wait is bounded by
+    # thread scheduling only, not by any pipeline progress
     t_b = threading.Thread(target=client, args=("b", 2), daemon=True)
     t_b.start()
-    time.sleep(0.5)
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline and "b" not in ex._live:
+        time.sleep(0.01)
+    assert "b" in ex._live, "'b' never reached admission"
     ex.stop()
     t_a.join(timeout=120)
     t_b.join(timeout=120)
@@ -777,3 +795,97 @@ def test_metrics_endpoint_prometheus(server):
         stats["degraded_entered_total"] + 1
     assert stats2["last_dead_rank"] == 3
     assert "pipeedge_serve_last_dead_rank 3" in text2
+
+
+# ---------------------------------------------------------------------------
+# paged KV plane + disaggregated serving over HTTP (docs/SERVING.md)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def kv_server():
+    """A paged, DISAGGREGATED server: --kv-pages turns admission into a
+    token budget and the prefix trie on; --disaggregate wire routes
+    every prompt pass through the prefill fleet + the v2-codec loopback
+    socket ship path."""
+    yield from _spawn_server(("--kv-pages", "48", "--kv-page-size", "4",
+                              "--disaggregate", "wire"))
+
+
+def test_kv_server_tokens_match_solo_and_budget_visible(kv_server,
+                                                        solo_pipe):
+    port = kv_server
+    rng = np.random.default_rng(21)
+    ids = rng.integers(0, 100, size=(1, 7)).tolist()
+    got = _post(port, "/generate", {"ids": ids, "new_tokens": 6})["ids"]
+    np.testing.assert_array_equal(
+        np.asarray(got), np.asarray(solo_pipe.generate(np.asarray(ids), 6)))
+    # sampled too: the pick happens decode-side from shipped logits,
+    # so the rng discipline matches solo exactly
+    got_s = _post(port, "/generate", {"ids": ids, "new_tokens": 5,
+                                      "temperature": 0.9, "seed": 4})["ids"]
+    np.testing.assert_array_equal(
+        np.asarray(got_s),
+        np.asarray(solo_pipe.generate(np.asarray(ids), 5,
+                                      temperature=0.9, seed=4)))
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=30) as resp:
+        serving = json.loads(resp.read())["serving"]
+    kv = serving["kv"]
+    assert kv["disaggregated"] and kv["pool"]["pages_total"] == 48
+    # idle server: every page is back (free + trie-cached)
+    assert kv["pool"]["pages_free"] \
+        + kv["prefix"]["pages_cached"] == 48
+    adm = serving["admission"]
+    assert adm["token_budget"] == 48 * 4
+    assert adm["tokens_free"] == adm["token_budget"]
+
+
+def test_kv_server_prefix_id_rides_the_trie(kv_server, solo_pipe):
+    """Paged mode /prefix: registration is a token list; generate with
+    prefix_id returns suffix+continuation exactly like the dense handle
+    contract, token-identical to a solo full-prompt run."""
+    port = kv_server
+    rng = np.random.default_rng(33)
+    prefix = rng.integers(0, 100, size=(8,)).tolist()
+    reg = _post(port, "/prefix", {"ids": prefix})
+    assert reg["len"] == 8
+    suffix = rng.integers(0, 100, size=(1, 3)).tolist()
+    full = np.asarray([prefix + suffix[0]])
+    want = np.asarray(solo_pipe.generate(full, 5))[:, 8:]
+    for _ in range(2):      # the second run reuses decode-side pages
+        got = _post(port, "/generate",
+                    {"ids": suffix, "new_tokens": 5,
+                     "prefix_id": reg["prefix_id"]})["ids"]
+        np.testing.assert_array_equal(np.asarray(got), want)
+    # unknown prefix ids stay clean 400s in paged mode
+    try:
+        _post(port, "/generate", {"ids": suffix, "new_tokens": 2,
+                                  "prefix_id": "nope"})
+        raise AssertionError("expected HTTP 400")
+    except urllib.error.HTTPError as exc:
+        assert exc.code == 400
+
+
+def test_kv_server_streaming_and_metrics(kv_server):
+    port = kv_server
+    body = json.dumps({"ids": [[1, 2, 3, 4, 5]], "new_tokens": 4,
+                       "stream": True}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        lines = [json.loads(line) for line in
+                 resp.read().decode().strip().splitlines()]
+    assert lines[-1]["steps"] == 4 and len(lines) == 5
+    assert len(lines[-1]["ids"][0]) == 5 + 4
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30) as resp:
+        text = resp.read().decode()
+    for family in ("pipeedge_kv_pages", "pipeedge_kv_prefix_lookups_total",
+                   "pipeedge_kv_ship_bytes_total",
+                   "pipeedge_admission_tokens_free"):
+        assert family in text, family
+    # the wire ship path actually moved bytes
+    wire_line = [line for line in text.splitlines()
+                 if line.startswith('pipeedge_kv_ship_bytes_total{path="wire"}')]
+    assert wire_line and float(wire_line[0].rsplit(" ", 1)[1]) > 0

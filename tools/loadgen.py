@@ -91,9 +91,10 @@ def _post(url, obj, timeout):
 def calibrate(url, seconds, new_tokens, prompt_len, timeout, seed=0):
     """Closed-loop sequential service rate (requests/s): the capacity
     baseline `--overload-factor` multiplies. The first request is
-    discarded as compile warmup."""
+    discarded as compile warmup. Distribution specs calibrate at their
+    LONGEST length (capacity should not be flattered by short draws)."""
     rng = random.Random(seed)
-    ids = [[rng.randrange(100) for _ in range(prompt_len)]]
+    ids = [[rng.randrange(100) for _ in range(spec_max_len(prompt_len))]]
     body = {"ids": ids, "new_tokens": new_tokens, "class": "interactive"}
     status, _, _ = _post(url, body, timeout)          # warmup (compile)
     if status != 200:
@@ -113,6 +114,73 @@ def calibrate(url, seconds, new_tokens, prompt_len, timeout, seed=0):
 
 
 WORST_N = 5      # per-class worst-latency request ids kept in the report
+
+
+def parse_prompt_spec(s):
+    """`--prompt-len DIST` -> spec dict. Forms:
+
+    - `N`                    fixed length N (the historical behavior)
+    - `uniform:LO:HI`        length drawn per request from [LO, HI]
+    - `shared:PFX:TOTAL[:POOL]`  every prompt is TOTAL tokens whose
+      first PFX tokens are one of POOL (default 1) DETERMINISTIC shared
+      prefixes (seed-derived, so reruns share the same prefixes) — the
+      workload shape that exercises the server's prefix trie and
+      long-context token-budget admission (docs/SERVING.md).
+
+    Accepts an int/dict unchanged (the in-process callers)."""
+    if isinstance(s, dict):
+        return s
+    if isinstance(s, int) or (isinstance(s, str) and s.isdigit()):
+        n = int(s)
+        if n < 1:
+            raise ValueError("prompt length must be >= 1")
+        return {"dist": "fixed", "len": n}
+    parts = str(s).split(":")
+    try:
+        if parts[0] == "uniform" and len(parts) == 3:
+            lo, hi = int(parts[1]), int(parts[2])
+            if not 1 <= lo <= hi:
+                raise ValueError
+            return {"dist": "uniform", "lo": lo, "hi": hi}
+        if parts[0] == "shared" and len(parts) in (3, 4):
+            pfx, total = int(parts[1]), int(parts[2])
+            pool = int(parts[3]) if len(parts) == 4 else 1
+            if not (1 <= pfx < total and pool >= 1):
+                raise ValueError
+            return {"dist": "shared", "prefix": pfx, "total": total,
+                    "pool": pool}
+    except ValueError:
+        pass
+    raise ValueError(
+        f"bad --prompt-len {s!r}: expected N, uniform:LO:HI, or "
+        "shared:PFX:TOTAL[:POOL]")
+
+
+def spec_max_len(spec) -> int:
+    """Longest prompt a spec can emit (capacity/calibration sizing)."""
+    spec = parse_prompt_spec(spec)
+    return {"fixed": spec.get("len"), "uniform": spec.get("hi"),
+            "shared": spec.get("total")}[spec["dist"]]
+
+
+def prompt_ids(spec, rng, base_seed: int):
+    """One request's prompt token list under `spec`. Shared prefixes
+    derive from `base_seed` + the drawn pool index ONLY — every request
+    (and every rerun with the same seed) that draws pool index k gets
+    byte-identical prefix tokens, which is what makes the server-side
+    prefix-hit counters deterministic."""
+    spec = parse_prompt_spec(spec)
+    if spec["dist"] == "fixed":
+        return [rng.randrange(100) for _ in range(spec["len"])]
+    if spec["dist"] == "uniform":
+        n = rng.randint(spec["lo"], spec["hi"])
+        return [rng.randrange(100) for _ in range(n)]
+    pool_idx = rng.randrange(spec["pool"])
+    pfx_rng = random.Random(1_000_003 * base_seed + 7919 * pool_idx + 13)
+    prefix = [pfx_rng.randrange(100) for _ in range(spec["prefix"])]
+    suffix = [rng.randrange(100)
+              for _ in range(spec["total"] - spec["prefix"])]
+    return prefix + suffix
 
 
 class _Stats:
@@ -187,10 +255,10 @@ def arrival_offsets(n, qps, arrival="uniform", rng=None):
     return offsets
 
 
-def _one_request(url, cls, slo_ms, deadline_ms, new_tokens, prompt_len,
-                 timeout, stats, rng_seed):
+def _one_request(url, cls, slo_ms, deadline_ms, new_tokens, prompt_spec,
+                 timeout, stats, rng_seed, base_seed):
     rng = random.Random(rng_seed)
-    ids = [[rng.randrange(100) for _ in range(prompt_len)]]
+    ids = [prompt_ids(prompt_spec, rng, base_seed)]
     body = {"ids": ids, "new_tokens": new_tokens, "class": cls}
     if deadline_ms is not None:
         body["deadline_ms"] = deadline_ms
@@ -233,6 +301,7 @@ def run_load(url, duration_s, qps, mix=None, slo_ms=None,
     total_w = sum(mix.values())
     if total_w <= 0 or qps <= 0 or duration_s <= 0:
         raise ValueError("mix weights, qps and duration must be > 0")
+    prompt_spec = parse_prompt_spec(prompt_len)
     slo_ms = dict(DEFAULT_SLO_MS if slo_ms is None else slo_ms)
     classes = sorted(mix)
     weights = [mix[c] / total_w for c in classes]
@@ -258,7 +327,8 @@ def run_load(url, duration_s, qps, mix=None, slo_ms=None,
         def work(cls=cls, cls_slo=cls_slo, deadline=deadline, i=i):
             try:
                 _one_request(url, cls, cls_slo, deadline, new_tokens,
-                             prompt_len, timeout, stats, seed * 100003 + i)
+                             prompt_spec, timeout, stats,
+                             seed * 100003 + i, seed)
             finally:
                 inflight.release()
 
@@ -271,6 +341,7 @@ def run_load(url, duration_s, qps, mix=None, slo_ms=None,
     report = {"url": url, "duration_s": round(wall, 3),
               "offered_qps": round(qps, 3), "requests": n,
               "seed": seed, "arrival": arrival,
+              "prompt_len": prompt_spec,
               "client_dropped": stats.client_dropped,
               "classes": {}, "totals": dict.fromkeys(OUTCOMES, 0)}
     all_lat = []
@@ -352,7 +423,13 @@ def main():
                    help="do not send deadline_ms (SLO still scored "
                         "client-side; the server never sheds on expiry)")
     p.add_argument("--new-tokens", type=int, default=8)
-    p.add_argument("--prompt-len", type=int, default=6)
+    p.add_argument("--prompt-len", default="6",
+                   help="prompt-length distribution: N (fixed), "
+                        "uniform:LO:HI, or shared:PFX:TOTAL[:POOL] "
+                        "(POOL seed-deterministic shared prefixes — "
+                        "exercises the server's prefix trie and "
+                        "long-context admission); recorded in the "
+                        "JSON line")
     p.add_argument("--timeout", type=float, default=120.0)
     p.add_argument("--max-inflight", type=int, default=128,
                    help="client-side thread cap (arrivals beyond it are "

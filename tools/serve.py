@@ -108,6 +108,19 @@ Executors (`--executor`):
   overlaps, and the last stage's token picks / eos readbacks never
   stall earlier stages' dispatch. healthz reports per-worker stats.
 
+Paged KV plane (`--kv-pages N`, docs/SERVING.md): the executors swap
+their dense per-request cache slots for page tables over one shared
+pool (pipeedge_tpu/kv/) — admission charges a KV TOKEN budget
+(prompt + max-new-tokens pages) instead of max_active slots, prompt
+prefixes are shared across requests automatically through a token-hash
+trie (/prefix then registers only the token list), and the brownout
+ladder gains an evict-cold-pages rung. `--disaggregate local|wire`
+additionally splits serving into a prefill fleet (a dedicated pipeline
+running only prompt passes) and the decode executor, shipping finished
+KV pages over the wire-v2 codec (`--kv-ship-bits 8` for int8 wire
+bytes) — token streams stay identical to colocated serving. /healthz
+gains a `serving.kv` block (pool/prefix snapshots).
+
 Speculative requests (`"speculative": true`, needs --draft-model) run
 greedy draft/verify rounds under a DEDICATED lock: they serialize with
 each other (bounding draft+verify cache memory at one in-flight
@@ -171,7 +184,8 @@ class _Service:
                  class_rates=None, class_deadlines_s=None,
                  brownout_enabled=True, brownout_marks=None,
                  clamp_new_tokens=16, governor_interval=0.25,
-                 postmortem_dir=None):
+                 postmortem_dir=None, kv_pages=0, kv_page_size=16,
+                 prefill_fleet=None):
         from collections import OrderedDict, deque
 
         from pipeedge_tpu.parallel.batcher import (ContinuousBatcher,
@@ -179,6 +193,26 @@ class _Service:
         self.pipe = pipe
         self.spec = spec
         self.executor = executor
+        # -- paged KV plane (docs/SERVING.md, pipeedge_tpu/kv) ----------
+        # kv_pages > 0 swaps the executors' dense per-request cache
+        # slots for page tables over one shared pool (+ the prefix
+        # trie); admission then runs on a KV TOKEN budget. The optional
+        # prefill fleet (--disaggregate) runs prompt passes on its OWN
+        # pipeline and ships KV pages in, so decode waves never share
+        # stage-time with prefills.
+        self.kv_backend = None
+        if kv_pages:
+            if spec is not None:
+                raise ValueError("--kv-pages does not compose with "
+                                 "--draft-model (speculative decoding "
+                                 "rides dense draft/verify caches)")
+            from pipeedge_tpu.kv import PagedKvBackend
+            self.kv_backend = PagedKvBackend(pipe, kv_pages,
+                                             kv_page_size)
+        self.prefill_fleet = prefill_fleet
+        if prefill_fleet is not None and self.kv_backend is None:
+            raise ValueError("--disaggregate needs --kv-pages (shipped "
+                             "KV lands in the paged pool)")
         self.cond = make_condition("serve.results")
         # -- /metrics + healthz counters (one source of truth) ----------
         # the registry instruments below ARE the state: healthz's stats
@@ -262,12 +296,14 @@ class _Service:
         # /degraded post doesn't carry one
         self._heal_s = deque(maxlen=8)
         if executor == "stage":
-            self.exec = StageWorkerExecutor(pipe, max_active=max_active)
+            self.exec = StageWorkerExecutor(pipe, max_active=max_active,
+                                            kv=self.kv_backend)
             self.batcher = None
             self.worker = None
         elif executor == "wave":
             self.exec = None
-            self.batcher = ContinuousBatcher(pipe, max_active=max_active)
+            self.batcher = ContinuousBatcher(pipe, max_active=max_active,
+                                             kv=self.kv_backend)
             self.worker = threading.Thread(target=self._loop, daemon=True)
             self.worker.start()
         else:
@@ -285,9 +321,15 @@ class _Service:
             "decode-step boundary and answered 504)")
         self.admission: Optional[AdmissionController] = None
         if admission_enabled:
+            # paged mode: `max_active` becomes a TOKEN budget — each
+            # admit charges the request's prompt+max-new-tokens page
+            # reservation, so many small requests share the capacity a
+            # few dense slots used to pin (docs/SERVING.md)
             self.admission = AdmissionController(
                 concurrency=concurrency, queue_capacity=queue_capacity,
-                policies=default_policies(class_rates, class_deadlines_s))
+                policies=default_policies(class_rates, class_deadlines_s),
+                token_budget=(None if self.kv_backend is None
+                              else self.kv_backend.pool.tokens_capacity))
         self.brownout: Optional[BrownoutLadder] = None
         self._governor = None
         self._gov_stop = threading.Event()
@@ -296,6 +338,10 @@ class _Service:
             self.brownout = BrownoutLadder(
                 brownout_marks if brownout_marks is not None
                 else Watermarks(), clamp_new_tokens=clamp_new_tokens)
+            if self.kv_backend is not None:
+                # the evict_cold_pages rung's lever: reclaim cached-but-
+                # idle prefix pages before any request class is shed
+                self.brownout.evict_hook = self.kv_backend.evict_cold_all
             self._governor = threading.Thread(target=self._governor_loop,
                                               daemon=True,
                                               name="brownout-governor")
@@ -329,6 +375,22 @@ class _Service:
     def add_prefix(self, ids):
         with self.cond:
             self._check_admittable()
+            if self.kv_backend is not None:
+                # paged mode: registration is just the TOKEN LIST — the
+                # prefix trie dedups the actual prefill across every
+                # request that uses it (first use pays one prompt pass;
+                # later uses share its pages), so no max_len KV buffers
+                # are pinned per registration
+                tokens = [int(t) for t in ids]
+                if not tokens:
+                    raise ValueError("prefix must be non-empty")
+                pid = f"p{self._next_pid}"
+                self._next_pid += 1
+                self.prefixes[pid] = {"tokens": tokens,
+                                      "len": len(tokens)}
+                while len(self.prefixes) > self.max_prefixes:
+                    self.prefixes.popitem(last=False)
+                return pid, len(tokens)
             # precompute BOTH handles before registering either, so a
             # draft-side failure cannot leave a half-registered prefix
             # (usable plainly, 400ing speculatively). The target handle
@@ -506,14 +568,26 @@ class _Service:
             self._next_rid += 1
         return f"q{n}"
 
-    def admit(self, request_class: str, deadline_s=None, rid=None):
+    def kv_tokens(self, ids, new_tokens) -> int:
+        """The admission token charge of one request under the paged KV
+        plane: its prompt + max-new-tokens page reservation (0 when
+        dense caches / no admission — slot-only admission)."""
+        if self.kv_backend is None or self.admission is None or not ids:
+            return 0
+        return self.kv_backend.tokens_needed(
+            max(len(r) for r in ids), int(new_tokens), len(ids))
+
+    def admit(self, request_class: str, deadline_s=None, rid=None,
+              tokens: int = 0):
         """Acquire an admission ticket (blocking, EDF order) + its
         absolute deadline. Returns (ticket, deadline); raises
         `AdmissionShed` (503 + dynamic Retry-After) on shed, KeyError on
         an unknown class (the handler's 400). The caller must hand the
         ticket to `generate(..., ticket=...)`, which releases it. `rid`
         request-tags the queue-wait span, the ticket, and the flight
-        events, so a trace/bundle names WHO waited and who was shed."""
+        events, so a trace/bundle names WHO waited and who was shed.
+        `tokens` is the KV-token charge under a token budget
+        (`kv_tokens`)."""
         if self.admission is None:
             deadline = (None if deadline_s is None
                         else time.monotonic() + float(deadline_s))
@@ -525,7 +599,8 @@ class _Service:
         # under its `shed:` span instead of skewing that stat
         t0 = time.monotonic_ns()
         try:
-            ticket = self.admission.admit(request_class, deadline, rid=rid)
+            ticket = self.admission.admit(request_class, deadline,
+                                          rid=rid, tokens=tokens)
         except AdmissionShed as exc:
             telemetry.record(
                 "serve", f"shed:{exc.request_class}:{exc.reason}",
@@ -591,6 +666,9 @@ class _Service:
             s["admission"] = self.admission.snapshot()
         if self.brownout is not None:
             s["brownout"] = self.brownout.snapshot()
+        if self.kv_backend is not None:
+            s["kv"] = self.kv_backend.snapshot()
+            s["kv"]["disaggregated"] = self.prefill_fleet is not None
         return s
 
     def generate_speculative(self, ids, new_tokens, prefix_id=None,
@@ -673,23 +751,30 @@ class _Service:
             return np.asarray(self.spec.generate(ids, new_tokens,
                                                  prefix=prefix))
 
-    def prevalidate(self, ids, new_tokens, kw) -> dict:
+    def prevalidate(self, ids, new_tokens, kw):
         """Resolve prefix_id and run the full admission validation WITHOUT
         submitting — the streaming path needs errors raised BEFORE the
         200/chunked headers commit (a status-checking client must see
-        400, not a 200 whose body is an error line). Returns `kw` with
-        the prefix handle resolved in place of prefix_id."""
+        400, not a 200 whose body is an error line). Returns `(ids, kw)`
+        with the prefix resolved: the dense handle in `kw["prefix"]`, or
+        — paged mode — the prefix TOKENS prepended to `ids` (plus
+        `kw["strip_prefix"]` so the response still omits them)."""
         from pipeedge_tpu.parallel.batcher import _build_request
         kw = dict(kw)
         with self.cond:
             self._check_dead()
             self._check_admittable()
-            self._resolve_prefix(kw)
+            if self.kv_backend is not None:
+                ids, strip = self._expand_prefix(ids, kw)
+                if strip:
+                    kw["strip_prefix"] = strip
+            else:
+                self._resolve_prefix(kw)
         _build_request(self.pipe, "__prevalidate__", ids, new_tokens,
                        kw.get("temperature", 0.0), kw.get("top_k", 0),
                        kw.get("seed", 0), kw.get("eos_token"),
                        kw.get("pad_token"), kw.get("prefix"))
-        return kw
+        return ids, kw
 
     def _resolve_prefix(self, kw):
         pid = kw.pop("prefix_id", None)
@@ -699,6 +784,24 @@ class _Service:
                                "or never registered)")
             self.prefixes.move_to_end(pid)     # LRU touch
             kw["prefix"] = self.prefixes[pid]
+
+    def _expand_prefix(self, ids, kw):
+        """Paged mode: a `prefix_id` becomes its registered tokens
+        prepended to every prompt row — the prefix trie turns the
+        repeated prefill into page reuse (one prompt pass fleet-wide,
+        then shared pages). Returns (expanded ids, strip); callers
+        slice `strip` columns off the result so the response matches
+        the dense handle contract (suffix + continuation)."""
+        pid = kw.pop("prefix_id", None)
+        if pid is None:
+            return ids, 0
+        if pid not in self.prefixes:
+            raise KeyError(f"unknown prefix_id {pid!r} (evicted "
+                           "or never registered)")
+        self.prefixes.move_to_end(pid)         # LRU touch
+        tokens = self.prefixes[pid]["tokens"]
+        return [list(tokens) + [int(t) for t in r] for r in ids], \
+            len(tokens)
 
     def generate(self, ids, new_tokens, on_token=None,
                  request_class="interactive", deadline_s=None,
@@ -719,14 +822,22 @@ class _Service:
                                       deadline_ms=None if deadline_s is None
                                       else deadline_s * 1e3,
                                       parent="serve.generate")
+        # paged mode: a prefix_id becomes prepended tokens BEFORE the
+        # token charge is computed (the reservation must cover the full
+        # prompt; the trie makes the shared part nearly free to run)
+        strip = int(kw.pop("strip_prefix", 0))
+        if self.kv_backend is not None and kw.get("prefix_id") is not None:
+            with self.cond:
+                ids, strip = self._expand_prefix(ids, kw)
         completed = False
         try:
             if ticket is None and deadline is None:
                 # the streaming path pre-admits (its ticket, or with
                 # --no-admission just the computed deadline) — don't
                 # clobber a deadline that arrives without a ticket
-                ticket, deadline = self.admit(request_class, deadline_s,
-                                              rid=rid)
+                ticket, deadline = self.admit(
+                    request_class, deadline_s, rid=rid,
+                    tokens=self.kv_tokens(ids, new_tokens))
             try:
                 if self.brownout is not None:
                     new_tokens = self.brownout.clamp(new_tokens)
@@ -797,7 +908,8 @@ class _Service:
         self._account_edge_bytes(ids, int(new_tokens))
         self.flight.note("done", rid=rid, cls=request_class,
                          ms=round(elapsed * 1e3, 3))
-        return out
+        # paged prefix contract: the response omits the prepended prefix
+        return out[:, strip:] if strip else out
 
     def _generate_policied(self, ids, new_tokens, on_token, kw, rid=None):
         with self.cond:
@@ -846,6 +958,23 @@ class _Service:
         # and the executors' per-stage spans tag it for free (_run_stage)
         if rid is None:
             rid = self.mint_rid()
+        if self.prefill_fleet is not None and kw.get("shipped") is None:
+            # disaggregated: the prompt pass runs on the PREFILL fleet's
+            # own pipeline and ships KV pages in — the decode executor
+            # below only ever runs decode steps, so one tenant's long
+            # prompt no longer stretches everyone else's inter-token
+            # latency (docs/SERVING.md disaggregation). EXCEPT when the
+            # prefix trie already covers the prompt's full pages: then
+            # the only prompt work left is a short suffix span, cheaper
+            # run in place than re-prefilled remotely and re-shipped.
+            route_local = False
+            if len(ids) == 1:
+                toks = [int(t) for t in ids[0]]
+                matched = self.kv_backend.shared_prompt_tokens(toks)
+                route_local = (matched > 0 and matched >= len(toks)
+                               - self.kv_backend.page_size)
+            if not route_local:
+                kw["shipped"] = self.prefill_fleet.prefill(ids, rid=rid)
         if self.exec is not None:
             with self.cond:
                 self._check_dead()
@@ -941,12 +1070,13 @@ def make_handler(service, model_name):
             # shed must surface as a real 503 + Retry-After, not a 200
             # whose body is an error line. After this point failures
             # surface as a terminal {"error": ...} stream line.
-            kw = service.prevalidate(ids, new_tokens, kw)
+            ids, kw = service.prevalidate(ids, new_tokens, kw)
             if rid is None:
                 rid = service.mint_rid()
             try:
-                ticket, deadline = service.admit(request_class, deadline_s,
-                                                 rid=rid)
+                ticket, deadline = service.admit(
+                    request_class, deadline_s, rid=rid,
+                    tokens=service.kv_tokens(ids, new_tokens))
             except AdmissionShed:
                 # the non-streaming path counts its shed inside
                 # generate(); a streaming shed never reaches generate(),
@@ -1267,8 +1397,36 @@ def main():
     p.add_argument("--max-active", default=None, type=int)
     p.add_argument("--max-prefixes", default=8, type=int,
                    help="LRU bound on registered prompt prefixes (each "
-                        "handle retains full max_len KV buffers)")
+                        "handle retains full max_len KV buffers; with "
+                        "--kv-pages only the token lists are stored — "
+                        "the prefix trie owns the KV)")
     p.add_argument("--port", default=8321, type=int)
+    # -- paged KV plane + disaggregation (docs/SERVING.md) --------------
+    p.add_argument("--kv-pages", default=0, type=int,
+                   help="enable the paged KV plane: N fixed-size pages "
+                        "per stage shared by every request (page tables "
+                        "+ cross-request prefix trie); admission then "
+                        "runs on a KV TOKEN budget of N x --kv-page-size "
+                        "instead of max_active slots. 0 = dense "
+                        "per-request cache slots (the historical mode)")
+    p.add_argument("--kv-page-size", default=16, type=int,
+                   help="cache positions per KV page")
+    p.add_argument("--disaggregate", default="off",
+                   choices=["off", "local", "wire"],
+                   help="split serving into a prefill fleet and a decode "
+                        "fleet (needs --kv-pages): prompt passes run on "
+                        "a DEDICATED pipeline and ship finished KV pages "
+                        "into the decode executor — 'local' hands arrays "
+                        "over in-process, 'wire' pushes real bytes "
+                        "through the v2 codec + a loopback socket "
+                        "(see --kv-ship-bits)")
+    p.add_argument("--kv-ship-bits", default=0, type=int, choices=[0, 8],
+                   help="quantize shipped KV pages on the wire (int8 "
+                        "block-scaled, 4x fewer bytes; 0 = exact — the "
+                        "token-parity setting)")
+    p.add_argument("--prefill-concurrency", default=2, type=int,
+                   help="in-flight prompt passes the prefill fleet runs "
+                        "concurrently")
     # -- overload protection (docs/SERVING.md) --------------------------
     p.add_argument("--no-admission", action="store_true",
                    help="disable the SLO-aware admission plane (requests "
@@ -1338,11 +1496,30 @@ def main():
             p.error("--draft-model does not compose with --kv-bits (int8 "
                     "span verification is not bit-identical to serial "
                     "int8 steps)")
+        if args.kv_pages:
+            p.error("--draft-model does not compose with --kv-pages "
+                    "(speculative decoding rides dense draft/verify "
+                    "caches)")
         from pipeedge_tpu.parallel.speculative import SpeculativeDecoder
         d_pipe = build_decode_pipeline(
             args.draft_model, None, max_len=args.max_len, dtype=dtype,
             attend_floor=args.attend_floor)
         spec = SpeculativeDecoder(pipe, d_pipe, gamma=args.gamma)
+    prefill_fleet = None
+    if args.disaggregate != "off":
+        if not args.kv_pages:
+            p.error("--disaggregate needs --kv-pages (shipped KV lands "
+                    "in the paged pool)")
+        from pipeedge_tpu.kv import PrefillFleet
+        # a DEDICATED pipeline: its prompt passes never contend with the
+        # decode executor's stage programs for host dispatch order
+        prefill_pipe = build_decode_pipeline(
+            args.model_name, partition, max_len=args.max_len, dtype=dtype,
+            attend_floor=args.attend_floor)
+        prefill_fleet = PrefillFleet(
+            prefill_pipe, path=args.disaggregate,
+            ship_bits=args.kv_ship_bits,
+            max_concurrent=args.prefill_concurrency)
 
     if args.trace_spans:
         telemetry.configure(rank=0)
@@ -1373,7 +1550,10 @@ def main():
                            dwell_down_s=args.brownout_dwell_down),
                        clamp_new_tokens=args.brownout_clamp_tokens,
                        governor_interval=args.governor_interval,
-                       postmortem_dir=args.postmortem_dir)
+                       postmortem_dir=args.postmortem_dir,
+                       kv_pages=args.kv_pages,
+                       kv_page_size=args.kv_page_size,
+                       prefill_fleet=prefill_fleet)
     server = ThreadingHTTPServer(("127.0.0.1", args.port),
                                  make_handler(service, args.model_name))
     print(f"serving {args.model_name} ({len(pipe.stages)} stages, "
