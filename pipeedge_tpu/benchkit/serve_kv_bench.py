@@ -44,9 +44,21 @@ def _args(p) -> None:
                    help="page-pool size (tools/serve.py --kv-pages)")
     p.add_argument("--kv-page-size", type=int, default=8)
     p.add_argument("--disaggregate", default="off",
-                   choices=["off", "local", "wire"],
+                   choices=["off", "local", "wire", "process"],
                    help="run the prefill fleet split (the A/B against "
-                        "'off' is the docs/evidence record)")
+                        "'off' is the docs/evidence record); 'process' "
+                        "spawns REAL separate prefill worker processes "
+                        "over DCN with the lease/ack ship protocol")
+    p.add_argument("--prefill-ranks", type=int, default=2,
+                   help="worker processes of --disaggregate process")
+    p.add_argument("--fault", default="off",
+                   choices=["off", "kill-prefill"],
+                   help="kill-prefill (needs --disaggregate process): "
+                        "run a FOURTH phase — the phase-2 decode load "
+                        "while a prefill worker is SIGKILLed mid-window "
+                        "— and record the fault window's decode p99, "
+                        "goodput, recovery_s (respawn + readmission), "
+                        "and pages leaked (the ISSUE 15 robustness A/B)")
     p.add_argument("--qps", type=float, default=3.0,
                    help="offered rate for every phase (fixed, not "
                         "calibrated: the phases compare against each "
@@ -82,9 +94,18 @@ def _setup(args) -> dict:
     for k, v in vars(args).items():
         setattr(a, k, v)
     a.overload_factor = 1.0
+    if args.fault != "off" and args.disaggregate != "process":
+        raise ValueError("--fault kill-prefill needs --disaggregate "
+                         "process (there is no worker process to kill "
+                         "otherwise)")
     extra = ["--kv-pages", str(args.kv_pages),
              "--kv-page-size", str(args.kv_page_size)]
-    if args.disaggregate != "off":
+    if args.disaggregate == "process":
+        extra += ["--disaggregate", "process",
+                  "--prefill-ranks", str(args.prefill_ranks),
+                  "--prefill-lease-timeout", "5",
+                  "--prefill-heartbeat-interval", "0.5"]
+    elif args.disaggregate != "off":
         extra += ["--disaggregate", args.disaggregate]
     if args.max_active:
         extra += ["--max-active", str(args.max_active)]
@@ -118,12 +139,18 @@ def _run(args, state) -> dict:
     # warmup: compile each phase's EXACT (prompt shape x page bucket)
     # programs once so phase p99s measure steady state, not XLA compiles
     # (paged decode compiles per page-count bucket, so new_tokens is
-    # part of the shape)
+    # part of the shape). A process-mode prefill fleet compiles PER
+    # WORKER: repeat each shape across the round-robin with DISTINCT
+    # tokens (an identical prompt would hit the trie and never reach
+    # the next worker)
+    reps = (getattr(args, "prefill_ranks", 1)
+            if args.disaggregate == "process" else 1)
     long_len = min(args.long_len, args.max_len - args.new_tokens - 1)
     for n, nt in {(loadgen.spec_max_len(args.shared_spec),
                    args.new_tokens),
                   (args.short_len, args.new_tokens), (long_len, 2)}:
-        _post(gen_url, {"ids": [[7] * n], "new_tokens": nt})
+        for rep in range(reps):
+            _post(gen_url, {"ids": [[7 + rep] * n], "new_tokens": nt})
 
     # -- phase 1: shared-prefix burst --------------------------------
     kv0 = _healthz(url)["serving"]["kv"]
@@ -192,6 +219,88 @@ def _run(args, state) -> dict:
         burster.join(timeout=120)
     kv2 = _healthz(url)["serving"]["kv"]
 
+    # -- phase 4 (opt-in): decode load through a prefill-worker kill --
+    # the robustness half of the disaggregation A/B (ISSUE 15): the
+    # SAME decode load as phase 2, but a prefill worker is SIGKILLed
+    # mid-window — the lease protocol must re-dispatch / fall back
+    # (zero lost, zero errors), the supervisor must respawn + readmit
+    # (recovery_s), and the page pool must close with zero leaks
+    fault_block = None
+    if args.fault == "kill-prefill":
+        import os as os_mod
+        import signal as signal_mod
+        import threading as threading_mod
+        import time as time_mod
+        kv_pre = _healthz(url)["serving"]["kv"]
+        workers = kv_pre["prefill"]["workers"]
+        victim_rank, victim = sorted(workers.items())[0]
+        t_kill = [None]
+        t_readmit = [None]
+
+        def kill_and_watch():
+            # the killer thread ALSO watches for readmission, so a
+            # worker that respawns mid-burst gets its true recovery
+            # time — polling only after the load window would alias
+            # recovery_s to the window length
+            time_mod.sleep(min(1.0, args.duration / 4))
+            os_mod.kill(victim["pid"], signal_mod.SIGKILL)
+            t_kill[0] = time_mod.monotonic()
+            deadline = t_kill[0] + args.duration + 60
+            seen_down = False       # death detection lags the SIGKILL:
+            while time_mod.monotonic() < deadline:   # a full live set
+                try:                 # only counts as READMISSION after
+                    prefill = _healthz(url)["serving"]["kv"]["prefill"]
+                except OSError:      # the rank was observed gone
+                    time_mod.sleep(0.3)
+                    continue
+                if len(prefill["live"]) < len(workers):
+                    seen_down = True
+                elif seen_down:
+                    t_readmit[0] = time_mod.monotonic()
+                    return
+                time_mod.sleep(0.2)
+
+        kt = threading_mod.Thread(target=kill_and_watch, daemon=True,
+                                  name="kv-prefill-killer")
+        kt.start()
+        faulted = loadgen.run_load(
+            gen_url, args.duration, args.qps, mix=mix, slo_ms=slo,
+            new_tokens=args.new_tokens, prompt_len=args.short_len,
+            seed=args.seed + 3, arrival="uniform")
+        kt.join(timeout=args.duration + 90)
+        recovery_s = (round(t_readmit[0] - t_kill[0], 3)
+                      if t_kill[0] is not None and t_readmit[0] is not None
+                      else None)
+        kv_after = _healthz(url)["serving"]["kv"]
+        # FAULT-WINDOW deltas, not server-lifetime cumulatives — the
+        # same discipline the phase-1 prefix stats follow above:
+        # leases shipped during warmup/phases 1-3 must not be
+        # attributed to the fault window
+        lease_delta = {
+            k: kv_after["prefill"]["leases"][k]
+            - kv_pre["prefill"]["leases"].get(k, 0)
+            for k in kv_after["prefill"]["leases"]}
+        colo_pre = kv_pre["prefill"].get("colocated") or {}
+        colo_delta = {
+            k: v - colo_pre.get(k, 0)
+            for k, v in (kv_after["prefill"].get("colocated")
+                         or {}).items()} or None
+        fault_block = {
+            "victim_rank": int(victim_rank),
+            "decode_p99_ms": faulted["latency_ms"]["p99"],
+            "goodput_rps": round(sum(
+                c["goodput_rps"]
+                for c in faulted["classes"].values()), 3),
+            "errors": faulted["totals"]["error"],
+            "lost": faulted["client_dropped"],
+            "recovery_s": recovery_s,
+            "readmitted": recovery_s is not None,
+            "leases": lease_delta,
+            "colocated": colo_delta,
+            "pages_leaked": kv_after["leaked"]
+            - kv_pre.get("leaked", 0),
+        }
+
     # PHASE-1 deltas, not server-lifetime cumulatives: the warmup posts
     # (guaranteed misses) and later phases must not dilute the shared-
     # prefix phase's hit rate
@@ -236,6 +345,7 @@ def _run(args, state) -> dict:
                               "with_prefill": p99_contended},
             "decode_p99_ratio": (None if not p99_solo or not p99_contended
                                  else round(p99_contended / p99_solo, 3)),
+            "fault": fault_block,
             "shed": {"shared": shared["totals"]["shed"],
                      "solo": solo["totals"]["shed"],
                      "with_prefill": contended["totals"]["shed"]},

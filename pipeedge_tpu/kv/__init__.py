@@ -12,8 +12,12 @@ over the existing tiered transport:
 - `backend`: `PagedKvBackend` — the executors' gather/scatter cache
              provider (token-identical to the dense path for fp caches)
 - `ship`:    KV rows as wire-v2 frames (int8 option, CRC, socket path)
-- `disagg`:  `PrefillFleet` — prompt passes on a dedicated pipeline,
-             results shipped into the decode fleet's pages
+- `disagg`:  `PrefillFleet` — prompt passes on a dedicated IN-PROCESS
+             pipeline, results shipped into the decode fleet's pages
+- `fleet`:   `RemotePrefillFleet`/`PrefillWorkerLoop` — the CROSS-
+             PROCESS fleet (tools/prefill_worker.py ranks over DCN)
+             with the fault-tolerant lease/ack ship protocol
+             (docs/FAULT_TOLERANCE.md disaggregated serving)
 
 Grounded in the Gemma-on-TPU serving comparison and production paged-
 attention practice (PAPERS.md); docs/SERVING.md has the operator story
@@ -21,10 +25,13 @@ attention practice (PAPERS.md); docs/SERVING.md has the operator story
 """
 from .backend import PagedKvBackend
 from .disagg import PrefillFleet
+from .fleet import (PrefillUnavailable, PrefillWorkerLoop,
+                    RemotePrefillFleet)
 from .pool import KvPagePool, PoolExhausted, pages_for
 from .prefix import PrefixTrie
 
 __all__ = [
     "KvPagePool", "PagedKvBackend", "PoolExhausted", "PrefillFleet",
-    "PrefixTrie", "pages_for",
+    "PrefillUnavailable", "PrefillWorkerLoop", "PrefixTrie",
+    "RemotePrefillFleet", "pages_for",
 ]

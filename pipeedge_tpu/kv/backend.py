@@ -177,6 +177,11 @@ class PagedKvBackend:
             "owned": shared_pids + [p for row in private for p in row],
             "tokens": tokens, "published": False,
         }
+        # leak audit: the pool's owner ledger mirrors this request's
+        # page references from the instant they exist, so a submitter
+        # that dies anywhere past this point (install failure path
+        # included) is reclaimable by the orphan sweep
+        self.pool.adopt(req.rid, req.kvstate["owned"])
         if shipped is not None:
             try:
                 return self._install_shipped(req, shipped)
@@ -194,6 +199,13 @@ class PagedKvBackend:
         pages and pick the first token from the shipped last-stage
         logits — the decode-fleet side of disaggregation (kv/ship.py
         moved the bytes; this lands them)."""
+        ks0 = req.kvstate
+        if ks0.get("install_result") is not None:
+            # idempotence fence: a second install (retried/zombie ship
+            # delivered twice above the lease fence) must neither
+            # re-scatter pages nor re-append the first token — return
+            # the first install's decision unchanged
+            return ks0["install_result"]
         plen = int(handle["prompt_len"])
         rows = handle["stage_rows"]
         if plen != req.prompt_len:
@@ -240,9 +252,9 @@ class PagedKvBackend:
             hit = np.asarray(token) == req.eos_token
             req.rows_done = hit
             done = bool(hit.all())
-        if done:
-            return "done", None
-        return "step", token[:, None]
+        result = ("done", None) if done else ("step", token[:, None])
+        ks["install_result"] = result
+        return result
 
     # -- the stage-step indirection --------------------------------------
 
@@ -307,7 +319,12 @@ class PagedKvBackend:
         if not ks:
             return
         req.kvstate = None
-        self.pool.release(ks["owned"])
+        # claim-then-release through the owner ledger: if the orphan
+        # sweep already reclaimed this request (we ARE the death it
+        # raced), disown returns None and there is nothing left to drop
+        pids = self.pool.disown(req.rid)
+        if pids is not None:
+            self.pool.release(pids)
 
     def shared_prompt_tokens(self, tokens) -> int:
         """How many leading prompt tokens the trie could serve from
@@ -316,6 +333,13 @@ class PagedKvBackend:
         if self.trie is None or tokens is None:
             return 0
         return self.trie.peek(tokens, max_tokens=len(tokens) - 1)
+
+    def sweep_orphans(self, live_rids) -> int:
+        """Reclaim pages whose owning request is no longer live (the
+        periodic leak audit — a shipper/submitter death mid-transfer
+        must strand zero pages). `live_rids` is the executor's live
+        request-id set; returns pages reclaimed."""
+        return self.pool.sweep_leaked(live_rids)
 
     def evict_cold_all(self) -> int:
         """Drop EVERY cold cached prefix page (the brownout

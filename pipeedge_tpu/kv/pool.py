@@ -118,6 +118,11 @@ class KvPagePool:
         self._cond = make_condition("kv.pool")
         self._free: List[int] = list(range(self.n_pages - 1, -1, -1))
         self._refs: Dict[int, int] = {}
+        # owner ledger (leak audit, docs/FAULT_TOLERANCE.md): the page
+        # references a live REQUEST holds, keyed by its id — what the
+        # periodic sweep reconciles against executor liveness so a
+        # submitter that died mid-ship can never strand its pages
+        self._owners: Dict[str, List[int]] = {}
         self._evict_hook: Optional[Callable[[int], int]] = None
         self._closed = False
         reg = prom.REGISTRY if registry is None else registry
@@ -132,6 +137,13 @@ class KvPagePool:
             "cold prefix pages reclaimed from the trie (allocation "
             "pressure or the brownout evict_cold_pages rung)")
         self.m_evicted.declare()
+        self.m_leaked = reg.counter(
+            "pipeedge_kv_pages_leaked_total",
+            "page references reclaimed by the orphan sweep: their "
+            "owning request was no longer live (submitter/shipper died "
+            "between page charge and release — "
+            "docs/FAULT_TOLERANCE.md disaggregated serving)")
+        self.m_leaked.declare()
 
     # -- accounting -------------------------------------------------------
 
@@ -236,15 +248,69 @@ class KvPagePool:
         if evicted and freed:
             self.m_evicted.inc(freed)
 
+    # -- owner ledger + orphan sweep (leak audit) -------------------------
+
+    def adopt(self, owner, pids: Sequence[int]) -> None:
+        """Record `owner` (a request id) as holding one reference to
+        each page in `pids` — the set `release`/`sweep_leaked` will
+        drop. Exactly ONE of the two ever drops it: `disown` is the
+        atomic claim."""
+        with self._cond:
+            self._owners[str(owner)] = list(pids)
+
+    def disown(self, owner) -> Optional[List[int]]:
+        """Claim `owner`'s page references for release. None = already
+        claimed (the request's own release path and the orphan sweep
+        race benignly: whoever pops the ledger entry does the release,
+        the other sees None and does nothing)."""
+        with self._cond:
+            return self._owners.pop(str(owner), None)
+
+    def sweep_leaked(self, live_owners) -> int:
+        """Reconcile the owner ledger against executor liveness: drop
+        the page references of every owner no longer live (a submitter
+        or shipper that died between page charge and release). Safe
+        against completion races — executors list a request as live
+        BEFORE charging pages and release pages BEFORE delisting it, so
+        a ledger entry whose owner is not live is genuinely orphaned —
+        but ONLY if the ledger is observed FIRST and liveness SECOND:
+        pass `live_owners` as a CALLABLE for live systems (invoked
+        after the ledger snapshot; returning None aborts the sweep) so
+        a request admitted between the two reads can never be taken
+        for dead. A plain set is accepted for offline callers with no
+        concurrent admissions. Returns pages reference-dropped
+        (pipeedge_kv_pages_leaked_total counts them; /healthz surfaces
+        the running total)."""
+        with self._cond:
+            owners = list(self._owners)
+        if callable(live_owners):
+            live_owners = live_owners()
+            if live_owners is None:     # liveness snapshot raced; skip
+                return 0
+        live = {str(o) for o in live_owners}
+        dead = [o for o in owners if o not in live]
+        leaked = 0
+        for owner in dead:
+            pids = self.disown(owner)
+            if pids:
+                self.release(pids)
+                leaked += len(pids)
+        if leaked:
+            self.m_leaked.inc(leaked)
+        return leaked
+
     def stats(self) -> dict:
         with self._cond:
             free = len(self._free)
             shared = sum(1 for r in self._refs.values() if r > 1)
+            owners = len(self._owners)
         return {"pages_total": self.n_pages, "pages_free": free,
                 "page_size": self.page_size,
                 "pages_shared": shared,
                 "occupancy": round(1.0 - free / self.n_pages, 4),
-                "pages_evicted_total": int(self.m_evicted.value())}
+                "pages_evicted_total": int(self.m_evicted.value()),
+                "owners": owners,
+                "leaked": int(self.m_leaked.value())}
 
     # -- the gather/scatter indirection ----------------------------------
 

@@ -15,8 +15,15 @@ first — instead of letting the overload degrade everyone uniformly:
 |       |                   | (the paged-KV trie's cold pages — capacity |
 |       |                   | only future requests would miss, spent     |
 |       |                   | BEFORE any live request is shed)           |
-| 4     | shed_best_effort  | best_effort class shed at admission        |
-| 5     | shed_batch        | batch class shed too (interactive only)    |
+| 4     | colocate_prefill  | disaggregated serving degrades to          |
+|       |                   | COLOCATED prefill: prompt passes stop      |
+|       |                   | shipping to the remote prefill fleet and   |
+|       |                   | run in the decode executor instead         |
+|       |                   | (token-identical; sheds the ship edge's    |
+|       |                   | latency/fault surface when the plane is    |
+|       |                   | already hot — docs/FAULT_TOLERANCE.md)     |
+| 5     | shed_best_effort  | best_effort class shed at admission        |
+| 6     | shed_batch        | batch class shed too (interactive only)    |
 
 Stepping is governed by watermarks + dwell times (hysteresis): the hot
 condition must persist `dwell_up_s` before each step up, and the calm
@@ -39,9 +46,11 @@ from typing import Optional
 from ..telemetry import metrics as prom
 
 LEVEL_NAMES = ("normal", "no_speculative", "clamp_tokens",
-               "evict_cold_pages", "shed_best_effort", "shed_batch")
+               "evict_cold_pages", "colocate_prefill",
+               "shed_best_effort", "shed_batch")
 MAX_LEVEL = len(LEVEL_NAMES) - 1
 EVICT_LEVEL = LEVEL_NAMES.index("evict_cold_pages")
+COLOCATE_LEVEL = LEVEL_NAMES.index("colocate_prefill")
 
 
 @dataclass
@@ -91,8 +100,8 @@ class BrownoutLadder:
         reg = prom.REGISTRY if registry is None else registry
         self.m_level = reg.gauge(
             "pipeedge_brownout_level",
-            "current brownout rung (0=normal .. 4=shed_batch; "
-            "docs/SERVING.md ladder)")
+            f"current brownout rung (0={LEVEL_NAMES[0]} .. "
+            f"{MAX_LEVEL}={LEVEL_NAMES[-1]}; docs/SERVING.md ladder)")
         self.m_level.set(0)
         self.m_steps = reg.counter(
             "pipeedge_brownout_transitions_total",
@@ -175,10 +184,17 @@ class BrownoutLadder:
             return min(int(new_tokens), self.clamp_new_tokens)
         return int(new_tokens)
 
+    def allow_disaggregate(self) -> bool:
+        """Level >= 4 (`colocate_prefill`): stop shipping prompt passes
+        to the remote prefill fleet — run them colocated in the decode
+        executor (token-identical; drops the ship edge's latency and
+        fault surface while the plane is hot)."""
+        return self.level < COLOCATE_LEVEL
+
     def shed_classes(self) -> frozenset:
-        if self.level >= 5:
+        if self.level >= 6:
             return frozenset(("best_effort", "batch"))
-        if self.level >= 4:
+        if self.level >= 5:
             return frozenset(("best_effort",))
         return frozenset()
 

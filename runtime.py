@@ -1582,9 +1582,11 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
                         if planned is None:
                             _abort_no_capacity(ctx, dead_now)
                         logger.warning(
-                            "failover: rank(s) %s dead; re-scheduling over "
-                            "survivors and replaying %d unacknowledged "
-                            "microbatch(es)", sorted(dead_now), len(replay))
+                            "failover: rank(s) %s dead (benched: %s); "
+                            "re-scheduling over survivors and replaying "
+                            "%d unacknowledged microbatch(es)",
+                            sorted(dead_now), sorted(bench_now),
+                            len(replay))
                         sched = planned
             finally:
                 if getattr(args, "trace_spans", None):
@@ -2149,9 +2151,21 @@ def _dcn_round(args, ctx, rnd, stage_layers, stage_quant, stage_ranks,
             def death_hits_schedule() -> bool:
                 # a dead IDLE spare is recorded but must not tear down a
                 # healthy round (the rebuild + replay cost is real); only
-                # a death among this round's stage ranks fails it over
+                # a death among this round's stage ranks fails it over.
+                # A SCHEDULED rank sitting in benched_ranks is lost too:
+                # restart@K:MS can re-exec the victim fast enough that
+                # its JOIN is admitted (dead -> benched) BEFORE this
+                # loop's next 0.5s poll observes the death — the fresh
+                # incarnation holds no stage state and the in-flight
+                # microbatches died with the old one, so waiting on the
+                # original schedule would ride out the full sched
+                # timeout (the test_chaos_restart_rejoins_and_heals
+                # flake). Every call site pairs this check with
+                # failover_event, so a benched rank only fails a round
+                # during an open death episode — never a healthy run.
                 with dead_lock:
-                    return bool(set(dead_ranks) & set(stage_ranks))
+                    lost = set(dead_ranks) | set(benched_ranks)
+                    return bool(lost & set(stage_ranks))
 
             def results_loop():
                 # wire Mbits/time are measured by the transport recv

@@ -344,8 +344,10 @@ def test_brownout_steps_up_one_rung_per_dwell():
     assert lad.update(0, 5.0, now=3.0) == 3       # p95 alone is hot too
     assert lad.level_name == "evict_cold_pages"
     assert lad.update(10, None, now=4.0) == 4
+    assert lad.level_name == "colocate_prefill"
     assert lad.update(10, None, now=5.0) == 5
-    assert lad.update(10, None, now=9.0) == 5     # capped at max rung
+    assert lad.update(10, None, now=6.0) == 6
+    assert lad.update(10, None, now=9.0) == 6     # capped at max rung
     assert lad.level_name == "shed_batch"
 
 
@@ -387,8 +389,8 @@ def test_brownout_lifecycle_floor_and_effects():
     assert not lad.allow_speculative()
     assert lad.update(0, 0.1, now=100.0) == 1     # calm cannot go below
     assert lad.set_floor(0) == 0
-    # effects ladder: clamp at >=2, evict cold KV pages at >=3, shed
-    # best_effort at >=4, batch at >=5
+    # effects ladder: clamp at >=2, evict cold KV pages at >=3,
+    # colocate prefill at >=4, shed best_effort at >=5, batch at >=6
     assert lad.clamp(100) == 100
     lad.update(10, None, now=200.0)
     lad.update(10, None, now=201.0)
@@ -400,17 +402,22 @@ def test_brownout_lifecycle_floor_and_effects():
     lad.update(10, None, now=203.0)
     assert lad.level == 3 and lad.shed_classes() == frozenset()
     assert evictions, "evict_cold_pages rung never called its hook"
+    assert lad.allow_disaggregate()
     lad.update(10, None, now=204.0)
-    assert lad.shed_classes() == frozenset({"best_effort"})
+    # colocate_prefill: shipping stops BEFORE any request class sheds
+    assert lad.level == 4 and not lad.allow_disaggregate()
+    assert lad.shed_classes() == frozenset()
     lad.update(10, None, now=205.0)
+    assert lad.shed_classes() == frozenset({"best_effort"})
+    lad.update(10, None, now=206.0)
     assert lad.shed_classes() == frozenset({"best_effort", "batch"})
     # the hook keeps firing while the ladder holds at/above the rung
     # (pages that re-chill during a long hot spell keep reclaiming)
     n = len(evictions)
-    lad.update(10, None, now=205.5)
+    lad.update(10, None, now=206.5)
     assert len(evictions) > n
     snap = lad.snapshot()
-    assert snap["level"] == 5 and snap["name"] == "shed_batch"
+    assert snap["level"] == 6 and snap["name"] == "shed_batch"
     assert snap["evicting"]
 
 

@@ -116,7 +116,20 @@ def test_chaos_restart_rejoins_and_heals(tmp_path):
     (rejoin event at the data rank); and with --on-peer-rejoin heal the
     pre-failure partition is restored at a round boundary — the final
     partition runs on the ORIGINAL ranks, every round's results exactly
-    once."""
+    once.
+
+    Was flaky (fails ~1 in 3 on the pristine tree): when detection of
+    the death ran late enough that the restarted incarnation's JOIN was
+    admitted FIRST, the victim moved dead_ranks -> benched_ranks before
+    the round loop's 0.5s poll ever saw a dead scheduled rank, so
+    `death_hits_schedule()` stayed false, the failover re-plan never
+    ran, and the round waited out the full --sched-timeout for
+    microbatches that died with the old incarnation ("pipeline
+    delivered 2/16 results within 120.0s"). Fixed in runtime.py:
+    `death_hits_schedule` now also counts a SCHEDULED rank that sits in
+    benched_ranks while a death episode is open — a freshly rejoined
+    incarnation holds no stage state, so the round must fail over to a
+    spare either way (the heal then restores it at the boundary)."""
     data, wouts, dirs = _run_chaos_fleet(
         tmp_path, world=4, chaos="restart@3:1500", batch=16,
         extra=["--rounds", "3", "--on-peer-rejoin", "heal",

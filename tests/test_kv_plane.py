@@ -500,6 +500,118 @@ def test_disaggregated_loopback_matches_colocated(pipe):
                                       results["greedy"])
 
 
+def test_pool_owner_sweep_reclaims_orphans(pipe):
+    """The leak audit's mechanism: pages adopted by an owner that is no
+    longer live are reclaimed by `sweep_leaked`, counted on
+    pipeedge_kv_pages_leaked_total, and returned to the free list —
+    while live owners and un-adopted allocations are untouched."""
+    pool = _pool(pipe, n_pages=8, page_size=4)
+    dead = pool.alloc(3)
+    pool.adopt("dead-req", dead)
+    live = pool.alloc(2)
+    pool.adopt("live-req", live)
+    bare = pool.alloc(1)            # raw allocation, no owner: invisible
+    assert pool.free_pages == 2
+    assert pool.sweep_leaked({"live-req"}) == 3
+    assert pool.free_pages == 5
+    assert pool.stats()["leaked"] == 3
+    # idempotent: the dead owner's ledger entry is gone
+    assert pool.sweep_leaked({"live-req"}) == 0
+    # the live-system form: liveness as a CALLABLE, invoked AFTER the
+    # ledger snapshot (a request admitted between the two reads is
+    # provably live — the TOCTOU the serve governor must not hit);
+    # None from the callable (snapshot raced a mutation) aborts cleanly
+    assert pool.sweep_leaked(lambda: {"live-req"}) == 0
+    assert pool.sweep_leaked(lambda: None) == 0
+    # the racing-release contract: a disowned owner's own release path
+    # sees None and does nothing (no double-release ValueError)
+    assert pool.disown("dead-req") is None
+    pool.release(live + bare)
+    pool.disown("live-req")
+    assert pool.free_pages == 8
+
+
+def test_mid_ship_death_leaks_zero_pages_after_sweep(pipe):
+    """Satellite acceptance (ISSUE 15): a request whose submitter dies
+    mid-ship — pages charged, KV installed, nothing ever released —
+    leaks ZERO pages once the orphan sweep reconciles against liveness,
+    and the pool is fully usable afterwards."""
+    prefill_pipe = _mk_pipe()
+    kv = _backend(pipe, n_pages=24, page_size=4)
+    fleet = PrefillFleet(prefill_pipe, path="local",
+                         registry=prom.Registry())
+    rng = np.random.default_rng(61)
+    ids = rng.integers(0, 100, size=(1, 6))
+    handle = fleet.prefill(ids)
+
+    class _Req:      # the executor-side request skeleton admit needs
+        rid = "died-mid-ship"
+        prompt_len = 6
+        new_tokens = 4
+        tokens = []
+        rows_done = None
+        eos_token = None
+        on_token = None
+        pick = staticmethod(
+            lambda logits, sub: jnp.argmax(logits, axis=-1))
+
+    req = _Req()
+    req.ids = np.asarray(ids)
+    req.shipped = handle
+    import jax
+    req.rng = jax.random.PRNGKey(0)
+    kind, _ = kv.admit(req)
+    assert kind == "step"
+    taken = kv.pool.n_pages - kv.pool.free_pages
+    assert taken > 0
+    # the submitter dies here: no release ever runs. The sweep (liveness
+    # = no live requests) must reclaim every page it held.
+    leaked = kv.sweep_orphans(set())
+    assert leaked == taken
+    assert kv.pool.stats()["leaked"] == leaked
+    # accounting closes exactly: every page is either free again or
+    # legitimately retained by the TRIE (the install published the
+    # prompt's full page for reuse — cached capacity, not a leak)
+    cached = kv.trie.stats()["pages_cached"]
+    assert kv.pool.free_pages + cached == kv.pool.n_pages
+    # the request's own (late) release is a no-op, not a double-free
+    kv.release(req)
+    assert kv.pool.free_pages + cached == kv.pool.n_pages
+    # and the pool still serves fresh requests
+    batcher = ContinuousBatcher(pipe, kv=kv)
+    batcher.submit("after", ids, new_tokens=4)
+    np.testing.assert_array_equal(
+        batcher.run()["after"], np.asarray(pipe.generate(ids, 4)))
+
+
+def test_shipped_install_is_idempotent(pipe):
+    """The install fence: a second `_install_shipped` for the same
+    request (a retried/zombie ship delivered twice above the lease
+    fence) returns the FIRST install's decision and appends no second
+    token — page tables and the token stream cannot be corrupted by
+    at-least-once ship delivery."""
+    prefill_pipe = _mk_pipe()
+    kv = _backend(pipe, n_pages=24, page_size=4, share_prefixes=False)
+    fleet = PrefillFleet(prefill_pipe, path="local",
+                         registry=prom.Registry())
+    rng = np.random.default_rng(67)
+    ids = rng.integers(0, 100, size=(1, 6))
+    handle = fleet.prefill(ids)
+    batcher = ContinuousBatcher(pipe, kv=kv)
+    batcher.submit("idem", ids, new_tokens=4, shipped=handle)
+    batcher._admit()
+    req = batcher._stage_q[0][0][0]
+    assert len(req.tokens) == 1        # the shipped first token
+    first = req.kvstate["install_result"]
+    again = kv._install_shipped(req, handle)
+    assert again == first
+    assert len(req.tokens) == 1, "double install double-appended tokens"
+    while batcher.tick():
+        pass
+    np.testing.assert_array_equal(
+        batcher.results["idem"], np.asarray(pipe.generate(ids, 4)))
+
+
 def test_shipped_install_publishes_prefix(pipe):
     """A shipped prompt's full pages land in the decode-side trie: the
     NEXT colocated request with that prompt prefix reuses them."""

@@ -889,3 +889,34 @@ def test_kv_server_streaming_and_metrics(kv_server):
     wire_line = [line for line in text.splitlines()
                  if line.startswith('pipeedge_kv_ship_bytes_total{path="wire"}')]
     assert wire_line and float(wire_line[0].rsplit(" ", 1)[1]) > 0
+
+
+def test_kv_pages_draft_model_rejected_at_parse_time():
+    """--kv-pages + --draft-model is refused AT PARSE TIME, in
+    milliseconds, with BOTH flags named — not after minutes of weight
+    loading, and not as _Service's bare mid-construction ValueError
+    (ISSUE 15 satellite)."""
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve.py"),
+         "-m", MODEL, "--kv-pages", "8", "--draft-model", MODEL,
+         "--port", str(_free_port())],
+        capture_output=True, text=True, timeout=60,
+        env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO))
+    took = time.monotonic() - t0
+    assert proc.returncode == 2          # argparse usage error
+    assert "--kv-pages" in proc.stderr and "--draft-model" in proc.stderr
+    assert "speculative" in proc.stderr  # says WHY, not just "no"
+    # parse-time means no model was built (interpreter startup only)
+    assert took < 30, f"flag validation took {took:.1f}s — a model build?"
+
+
+def test_disaggregate_without_kv_pages_rejected_at_parse_time():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve.py"),
+         "-m", MODEL, "--disaggregate", "process",
+         "--port", str(_free_port())],
+        capture_output=True, text=True, timeout=60,
+        env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO))
+    assert proc.returncode == 2
+    assert "--disaggregate" in proc.stderr and "--kv-pages" in proc.stderr

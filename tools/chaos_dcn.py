@@ -37,6 +37,14 @@ Emits one JSON line (plus pass-through logs with --verbose). Examples:
   # peer-health plane must quarantine it at a round boundary
   python tools/chaos_dcn.py --world 4 --victim 1 --chaos slow@2:80 \
       --rounds 4 --on-peer-degraded quarantine --expect quarantine
+
+  # disaggregated-serving ship edge (--target serve-disagg): kill the
+  # prefill worker at its 2nd KV ship, mid shared-prefix burst — gates:
+  # zero lost/errored requests (re-dispatch or colocated fallback),
+  # zero leaked pages, and the worker respawned + readmitted (epoch+1
+  # JOIN), with recovery_s in the record
+  python tools/chaos_dcn.py --target serve-disagg --chaos kill@2 \
+      --expect disagg
 """
 import argparse
 import json
@@ -89,8 +97,166 @@ class _TimedReader:
         self._thread.join(timeout=5)
 
 
+def run_serve_disagg(args):
+    """The disaggregated-serving chaos experiment: a `--disaggregate
+    process` server under a shared-prefix burst while `--chaos` is armed
+    on prefill worker rank 1's ship edge (PIPEEDGE_PREFILL_CHAOS). The
+    fault-tolerance contract under test (docs/FAULT_TOLERANCE.md):
+    every request completes (lease re-dispatch or colocated fallback —
+    zero lost, zero errors), page accounting closes with zero leaks,
+    and a killed worker is respawned + readmitted (DCN_EPOCH+1 JOIN).
+    Emits one JSON line with the fault-window goodput and recovery_s."""
+    import json as json_mod
+    import urllib.request
+
+    sys.path.insert(0, REPO)
+    from tools import loadgen
+
+    port = _free_ports(1)[0]
+    url = f"http://127.0.0.1:{port}"
+    env = dict(os.environ, PYTHONPATH=REPO,
+               PIPEEDGE_PREFILL_CHAOS=args.chaos,
+               PIPEEDGE_PREFILL_CHAOS_RANK="1")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.setdefault("DCN_CONNECT_TIMEOUT", "30")
+    cmd = [sys.executable, os.path.join(REPO, "tools", "serve.py"),
+           "-m", args.model_name, "-pt", args.partition,
+           "--max-len", "64", "-t", "float32", "--port", str(port),
+           "--kv-pages", str(args.kv_pages),
+           "--kv-page-size", str(args.kv_page_size),
+           "--disaggregate", "process",
+           "--prefill-ranks", str(args.prefill_ranks),
+           "--prefill-lease-timeout", "5",
+           "--prefill-heartbeat-interval", "0.5"]
+    t0 = time.monotonic()
+    proc = subprocess.Popen(cmd, env=env, text=True,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT)
+    reader = _TimedReader(proc)
+
+    def healthz(timeout=10.0):
+        with urllib.request.urlopen(f"{url}/healthz",
+                                    timeout=timeout) as resp:
+            return json_mod.loads(resp.read())
+
+    record = {"target": "serve-disagg", "chaos": args.chaos,
+              "prefill_ranks": args.prefill_ranks,
+              "expect": args.expect}
+    try:
+        deadline = time.monotonic() + args.timeout
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError("serve.py died during startup")
+            try:
+                healthz(timeout=5)
+                break
+            except OSError:
+                time.sleep(0.5)
+        else:
+            raise RuntimeError("serve.py never became healthy")
+        # warmup compiles the burst's exact shapes so the fault window
+        # measures the protocol, not XLA
+        shared_max = loadgen.spec_max_len(args.shared_spec)
+        for n in {shared_max, 6}:
+            req = urllib.request.Request(
+                f"{url}/generate",
+                data=json_mod.dumps({"ids": [[7] * n],
+                                     "new_tokens": 2}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                resp.read()
+        # the burst: the armed chaos fires at the victim's Kth ship
+        # send. A concurrent watcher stamps the readmission AS IT
+        # HAPPENS — a worker that respawns mid-burst must not have its
+        # recovery_s aliased to the remaining burst duration
+        recovered_at = [None]
+        watch_stop = threading.Event()
+
+        def watch_readmission():
+            seen_down = False
+            while not watch_stop.is_set() and recovered_at[0] is None:
+                try:
+                    live = healthz(timeout=5)["serving"]["kv"][
+                        "prefill"]["live"]
+                except OSError:
+                    watch_stop.wait(0.3)
+                    continue
+                if len(live) < args.prefill_ranks:
+                    seen_down = True
+                elif seen_down:
+                    recovered_at[0] = time.monotonic()
+                    return
+                watch_stop.wait(0.2)
+
+        watcher = threading.Thread(target=watch_readmission,
+                                   daemon=True, name="readmit-watch")
+        watcher.start()
+        report = loadgen.run_load(
+            f"{url}/generate", args.duration, args.qps,
+            mix={"interactive": 1.0}, new_tokens=4,
+            prompt_len=args.shared_spec, seed=7, arrival="poisson")
+        died = reader.first("died")   # supervisor's death line
+        recover_deadline = time.monotonic() + 60
+        while recovered_at[0] is None \
+                and time.monotonic() < recover_deadline:
+            time.sleep(0.3)
+        watch_stop.set()
+        watcher.join(timeout=10)
+        kv = healthz()["serving"]["kv"]
+        prefill = kv["prefill"]
+        record.update({
+            "requests": report["requests"],
+            "lost": report["client_dropped"],
+            "errors": report["totals"]["error"],
+            "shed": report["totals"]["shed"],
+            "fault_window_goodput_rps": round(sum(
+                c["goodput_rps"] for c in report["classes"].values()), 3),
+            "leases": prefill["leases"],
+            "colocated": prefill.get("colocated"),
+            "zombies_dropped": prefill["zombies_dropped_total"],
+            "ship_corrupt": prefill["ship_corrupt_total"],
+            "pages_leaked": kv["leaked"],
+            "live_ranks": prefill["live"],
+            "worker_epochs": {r: w["epoch"] for r, w in
+                              prefill.get("workers", {}).items()},
+            "recovery_s": (round(recovered_at[0] - died[0], 3)
+                           if recovered_at[0] and died else None),
+            "readmitted": recovered_at[0] is not None,
+            "total_s": round(time.monotonic() - t0, 3),
+        })
+    finally:
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+        reader.join()
+    print(json.dumps(record))
+    if args.verbose:
+        for t, line in reader.lines:
+            print(f"[serve +{t - t0:7.3f}] {line}", file=sys.stderr)
+    # the disagg gate: nothing lost, nothing errored, the fault path
+    # engaged (re-dispatch/fallback/colocated), zero leaked pages, and
+    # the victim readmitted after its respawn
+    engaged = (record["leases"]["redispatched"]
+               + record["leases"]["fallback"]
+               + sum((record["colocated"] or {}).values())) > 0
+    ok = (record["errors"] == 0 and record["lost"] == 0
+          and record["pages_leaked"] == 0 and engaged
+          and record["readmitted"])
+    return 0 if ok else 1
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--target", default="runtime",
+                   choices=["runtime", "serve-disagg"],
+                   help="runtime: a runtime.py DCN fleet (the original "
+                        "experiments); serve-disagg: a --disaggregate "
+                        "process serving fleet with --chaos armed on "
+                        "the prefill worker's ship edge")
     p.add_argument("--world", type=int, default=3)
     p.add_argument("--victim", type=int, default=1,
                    help="rank DCN_CHAOS is armed in (must not be the "
@@ -100,7 +266,8 @@ def main():
                         "delay@K:MS | restart@K:MS | flap@K:MS | "
                         "slow@K[-J]:MS | jitter@K[-J]:MS | corrupt@K")
     p.add_argument("--expect", default="recover",
-                   choices=["recover", "abort", "heal", "quarantine"],
+                   choices=["recover", "abort", "heal", "quarantine",
+                            "disagg"],
                    help="recover: the run must complete; abort: the fleet "
                         "must stop naming the victim; heal: the run must "
                         "complete AND the victim must rejoin AND the "
@@ -144,7 +311,24 @@ def main():
                    help="harness deadline for the whole experiment")
     p.add_argument("--verbose", action="store_true",
                    help="replay every rank's output lines to stderr")
+    p.add_argument("--prefill-ranks", type=int, default=2,
+                   help="serve-disagg: prefill worker processes")
+    p.add_argument("--kv-pages", type=int, default=96,
+                   help="serve-disagg: page-pool size")
+    p.add_argument("--kv-page-size", type=int, default=8)
+    p.add_argument("--qps", type=float, default=3.0,
+                   help="serve-disagg: offered burst rate")
+    p.add_argument("--duration", type=float, default=8.0,
+                   help="serve-disagg: burst seconds")
+    p.add_argument("--shared-spec", default="shared:16:24:2",
+                   help="serve-disagg: loadgen shared-prefix prompt "
+                        "distribution for the burst")
     args = p.parse_args()
+    if args.target == "serve-disagg":
+        if args.model_name == "pipeedge/test-tiny-vit":
+            # the runtime default is a ViT; serving needs a decoder
+            args.model_name = "pipeedge/test-tiny-gpt2"
+        return run_serve_disagg(args)
     if args.victim == 0:
         p.error("--victim 0 is the data rank (the driver; killing it "
                 "kills the experiment, not the pipeline)")

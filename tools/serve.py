@@ -185,7 +185,7 @@ class _Service:
                  brownout_enabled=True, brownout_marks=None,
                  clamp_new_tokens=16, governor_interval=0.25,
                  postmortem_dir=None, kv_pages=0, kv_page_size=16,
-                 prefill_fleet=None):
+                 prefill_fleet=None, prefill_supervisor=None):
         from collections import OrderedDict, deque
 
         from pipeedge_tpu.parallel.batcher import (ContinuousBatcher,
@@ -210,9 +210,25 @@ class _Service:
             self.kv_backend = PagedKvBackend(pipe, kv_pages,
                                              kv_page_size)
         self.prefill_fleet = prefill_fleet
+        self.prefill_supervisor = prefill_supervisor
+        self._prefill_unavailable = None
+        self.m_prefill_colocated = None
         if prefill_fleet is not None and self.kv_backend is None:
             raise ValueError("--disaggregate needs --kv-pages (shipped "
                              "KV lands in the paged pool)")
+        if prefill_fleet is not None:
+            from pipeedge_tpu.kv.fleet import PrefillUnavailable
+            self._prefill_unavailable = PrefillUnavailable
+            # colocated-fallback accounting (PL501: the reason matrix is
+            # known here). "unavailable" = every prefill rank/retry
+            # exhausted (docs/FAULT_TOLERANCE.md disaggregated serving);
+            # "brownout" = the colocate_prefill rung turned shipping off
+            self.m_prefill_colocated = prom.REGISTRY.counter(
+                "pipeedge_kv_prefill_colocated_total",
+                "prompt passes run colocated in the decode executor "
+                "while disaggregation was configured, by reason")
+            for reason in ("unavailable", "brownout"):
+                self.m_prefill_colocated.declare(reason=reason)
         self.cond = make_condition("serve.results")
         # -- /metrics + healthz counters (one source of truth) ----------
         # the registry instruments below ARE the state: healthz's stats
@@ -342,6 +358,9 @@ class _Service:
                 # the evict_cold_pages rung's lever: reclaim cached-but-
                 # idle prefix pages before any request class is shed
                 self.brownout.evict_hook = self.kv_backend.evict_cold_all
+        # the governor also owns the paged-KV orphan sweep (leak audit,
+        # docs/FAULT_TOLERANCE.md): it runs whenever EITHER duty exists
+        if brownout_enabled or self.kv_backend is not None:
             self._governor = threading.Thread(target=self._governor_loop,
                                               daemon=True,
                                               name="brownout-governor")
@@ -416,15 +435,35 @@ class _Service:
 
     # -- brownout governor ----------------------------------------------
 
+    def _live_request_ids(self):
+        """Snapshot of every live executor request id — the orphan
+        sweep's liveness set. None = the snapshot raced a mutation
+        (skip this sweep; the next tick retries)."""
+        src = (self.exec._live if self.exec is not None
+               else self.batcher._live_rids)
+        for _ in range(3):
+            try:
+                return set(src)
+            except RuntimeError:     # set mutated during copy
+                continue
+        return None
+
     def _governor_loop(self):
         """Periodic brownout tick: windowed p95 of the request-latency
         histogram (delta between scrapes of the SAME instrument /metrics
         renders) + admission queue depth drive the ladder; the degraded
         lifecycle floors it (healing implies at least level 1). The
-        ladder's shed classes feed straight into admission."""
+        ladder's shed classes feed straight into admission. With a paged
+        KV backend the loop doubles as the leak audit: every ~2s the
+        pool's owner ledger is reconciled against executor liveness, so
+        a submitter/shipper that died mid-request strands zero pages
+        (pipeedge_kv_pages_leaked_total counts the reclaims)."""
         prev_counts, prev_n = self.m_latency.snapshot()
-        last_level = self.brownout.level
+        last_level = self.brownout.level if self.brownout is not None else 0
+        sweep_every = max(1, round(2.0 / self.governor_interval))
+        ticks = 0
         while not self._gov_stop.wait(self.governor_interval):
+            ticks += 1
             counts, n = self.m_latency.snapshot()
             delta = [c - p for c, p in zip(counts, prev_counts)]
             p95 = prom.percentile_from_counts(
@@ -432,23 +471,34 @@ class _Service:
             prev_counts, prev_n = counts, n
             depth = (self.admission.queue_depth
                      if self.admission is not None else 0)
-            self.brownout.set_floor(
-                1 if self.degraded_info is not None else 0)
-            level = self.brownout.update(depth, p95)
-            if self.admission is not None:
-                self.admission.set_shed_classes(
-                    self.brownout.shed_classes())
-            if level != last_level:
-                t = time.monotonic_ns()
-                telemetry.record("serve", f"brownout:{level}", t, t)
-                self.flight.note("brownout", level=level,
-                                 queue_depth=depth, p95_s=p95)
-                if level >= 2 and level > last_level:
-                    # stepping INTO the clamp/shed rungs is the SLO-breach
-                    # trigger: capture the state that drove the ladder up
-                    self.flight.maybe_dump("slo",
-                                           context=self.bundle_context())
-                last_level = level
+            if self.brownout is not None:
+                self.brownout.set_floor(
+                    1 if self.degraded_info is not None else 0)
+                level = self.brownout.update(depth, p95)
+                if self.admission is not None:
+                    self.admission.set_shed_classes(
+                        self.brownout.shed_classes())
+                if level != last_level:
+                    t = time.monotonic_ns()
+                    telemetry.record("serve", f"brownout:{level}", t, t)
+                    self.flight.note("brownout", level=level,
+                                     queue_depth=depth, p95_s=p95)
+                    if level >= 2 and level > last_level:
+                        # stepping INTO the clamp/shed rungs is the
+                        # SLO-breach trigger: capture the state that
+                        # drove the ladder up
+                        self.flight.maybe_dump(
+                            "slo", context=self.bundle_context())
+                    last_level = level
+            if self.kv_backend is not None and ticks % sweep_every == 0:
+                # liveness passed as a CALLABLE: the sweep snapshots
+                # the owner ledger FIRST, liveness second — a request
+                # admitted between the two reads is provably live, so
+                # its in-use pages can never be taken for orphans
+                leaked = self.kv_backend.sweep_orphans(
+                    self._live_request_ids)
+                if leaked:
+                    self.flight.note("kv_pages_reclaimed", pages=leaked)
 
     # -- failover window ------------------------------------------------
 
@@ -669,6 +719,19 @@ class _Service:
         if self.kv_backend is not None:
             s["kv"] = self.kv_backend.snapshot()
             s["kv"]["disaggregated"] = self.prefill_fleet is not None
+            # the leak audit's health surface: running total of page
+            # references the orphan sweep reclaimed (0 = no leaks)
+            s["kv"]["leaked"] = s["kv"]["pool"]["leaked"]
+            fleet_snapshot = getattr(self.prefill_fleet, "snapshot", None)
+            if fleet_snapshot is not None:
+                s["kv"]["prefill"] = fleet_snapshot()
+                if self.m_prefill_colocated is not None:
+                    s["kv"]["prefill"]["colocated"] = {
+                        r: int(self.m_prefill_colocated.value(reason=r))
+                        for r in ("unavailable", "brownout")}
+            if self.prefill_supervisor is not None:
+                s["kv"].setdefault("prefill", {})["workers"] = \
+                    self.prefill_supervisor.snapshot()
         return s
 
     def generate_speculative(self, ids, new_tokens, prefix_id=None,
@@ -968,13 +1031,34 @@ class _Service:
             # the only prompt work left is a short suffix span, cheaper
             # run in place than re-prefilled remotely and re-shipped.
             route_local = False
-            if len(ids) == 1:
+            if self.brownout is not None \
+                    and not self.brownout.allow_disaggregate():
+                # brownout rung 4 (colocate_prefill): the plane is hot
+                # enough that the ship edge's latency + fault surface
+                # costs more than prefill isolation buys — degrade
+                # disaggregate -> colocated deliberately
+                route_local = True
+                self.m_prefill_colocated.inc(reason="brownout")
+                self.flight.note("prefill_colocated", rid=rid,
+                                 reason="brownout")
+            if not route_local and len(ids) == 1:
                 toks = [int(t) for t in ids[0]]
                 matched = self.kv_backend.shared_prompt_tokens(toks)
                 route_local = (matched > 0 and matched >= len(toks)
                                - self.kv_backend.page_size)
             if not route_local:
-                kw["shipped"] = self.prefill_fleet.prefill(ids, rid=rid)
+                try:
+                    kw["shipped"] = self.prefill_fleet.prefill(ids,
+                                                               rid=rid)
+                except self._prefill_unavailable as exc:
+                    # every prefill rank/retry exhausted: the request
+                    # SURVIVES — the decode executor runs the prompt
+                    # pass itself (token-identical; the p99 isolation is
+                    # what degrades, not the request)
+                    self.m_prefill_colocated.inc(reason="unavailable")
+                    self.flight.note("prefill_colocated", rid=rid,
+                                     reason="unavailable",
+                                     error=str(exc))
         if self.exec is not None:
             with self.cond:
                 self._check_dead()
@@ -1023,6 +1107,13 @@ class _Service:
             self.cond.notify_all()
         if self.exec is not None:
             self.exec.stop()
+        # tear the ship plane down LAST: in-flight prefills were already
+        # failed fast by the executor stop above
+        close = getattr(self.prefill_fleet, "close", None)
+        if close is not None:
+            close()
+        if self.prefill_supervisor is not None:
+            self.prefill_supervisor.stop()
 
 
 def make_handler(service, model_name):
@@ -1374,6 +1465,169 @@ def _inject_stall(pipe, spec, parser):
           f"{idx}", flush=True)
 
 
+class PrefillWorkerSupervisor:
+    """Spawns and supervises the prefill worker PROCESSES of
+    `--disaggregate process` (tools/prefill_worker.py ranks 1..N of the
+    ship plane's DCN world). A worker that dies — crash, OOM, chaos
+    kill — is respawned with DCN_EPOCH incremented, so its JOIN clears
+    the decode side's death fence and the fleet readmits it
+    (docs/FAULT_TOLERANCE.md disaggregated serving lifecycle). Chaos:
+    PIPEEDGE_PREFILL_CHAOS (a DCN_CHAOS spec) arms deterministic faults
+    in ONE worker's env (PIPEEDGE_PREFILL_CHAOS_RANK, default 1) for
+    the first incarnation only — respawns come up clean, exactly like
+    the restart@K:MS contract."""
+
+    RESPAWN_DELAY_S = 0.5
+    RESPAWN_BACKOFF_MAX_S = 30.0
+    FAST_DEATH_S = 5.0     # an incarnation dying this fast escalates
+
+    def __init__(self, worker_cmd, ranks, respawn=True):
+        import subprocess
+        self._subprocess = subprocess
+        self._cmd = list(worker_cmd)      # without rank; appended per rank
+        self.ranks = tuple(ranks)
+        self.respawn = bool(respawn)
+        self._procs = {}                  # rank -> Popen
+        self._epoch = {r: 0 for r in self.ranks}
+        self._ready = {r: threading.Event() for r in self.ranks}
+        # crash-loop protection: a worker that dies FAST (startup
+        # failure, host OOM) doubles its respawn delay up to the cap —
+        # each respawn pays a full interpreter + model build, so a
+        # deterministic failure must not thrash the host at 2 Hz; an
+        # incarnation that lived a while resets the backoff
+        self._backoff = {r: self.RESPAWN_DELAY_S for r in self.ranks}
+        self._spawned_at = {r: 0.0 for r in self.ranks}
+        self._respawn_after = {r: 0.0 for r in self.ranks}
+        self._stop = threading.Event()
+        self._lock = make_lock("serve.prefill_sup")
+        self._watchers = []
+        for r in self.ranks:
+            self._spawn(r)
+        self._supervisor = threading.Thread(target=self._watch_loop,
+                                            daemon=True,
+                                            name="prefill-supervisor")
+        self._supervisor.start()
+
+    def _spawn(self, rank):
+        import subprocess
+        env = dict(os.environ)
+        env["DCN_EPOCH"] = str(self._epoch[rank])
+        chaos = os.getenv("PIPEEDGE_PREFILL_CHAOS")
+        chaos_rank = int(os.getenv("PIPEEDGE_PREFILL_CHAOS_RANK", "1"))
+        if chaos and rank == chaos_rank and self._epoch[rank] == 0:
+            env["DCN_CHAOS"] = chaos
+        proc = subprocess.Popen(
+            [sys.executable] + self._cmd[:1] + [str(rank)] + self._cmd[1:],
+            env=env, text=True, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT)
+        with self._lock:
+            # stop() may have swept _procs while this Popen was in
+            # flight (the respawn/shutdown race): a spawn the shutdown
+            # can no longer see must be terminated HERE, not leaked
+            if self._stop.is_set():
+                proc.terminate()
+                return
+            self._procs[rank] = proc
+            self._spawned_at[rank] = time.monotonic()
+        t = threading.Thread(target=self._pump, args=(rank, proc),
+                             daemon=True, name=f"prefill-out-r{rank}")
+        t.start()
+        # pump threads exit when their worker's stdout closes: prune
+        # the dead ones so a long-lived server doesn't accumulate one
+        # Thread record per respawn
+        self._watchers = [w for w in self._watchers if w.is_alive()]
+        self._watchers.append(t)
+        print(f"prefill worker rank {rank} spawned "
+              f"(pid={proc.pid}, epoch={self._epoch[rank]})", flush=True)
+
+    def _pump(self, rank, proc):
+        # tee worker output through the server's stdout (prefixed): the
+        # chaos harness and CI key on the workers' chaos/ready lines
+        ready_line = f"prefill worker rank {rank} ready"
+        for line in proc.stdout:
+            print(f"[prefill r{rank}] {line}", end="", flush=True)
+            # exact machine line only: a bare substring ("ready") would
+            # also match e.g. "...already initialized" warnings from
+            # the model build and release wait_ready() mid-build
+            if line.startswith(ready_line):
+                self._ready[rank].set()
+
+    def _watch_loop(self):
+        dead_pending = set()       # deaths observed, respawn not yet due
+        while not self._stop.wait(self.RESPAWN_DELAY_S):
+            now = time.monotonic()
+            for rank in self.ranks:
+                with self._lock:
+                    proc = self._procs.get(rank)
+                if proc is None or proc.poll() is None:
+                    continue
+                if rank not in dead_pending:
+                    # observe the death ONCE: escalate the backoff only
+                    # for fast deaths (crash loop), reset otherwise
+                    lived = now - self._spawned_at[rank]
+                    if lived < self.FAST_DEATH_S:
+                        self._backoff[rank] = min(
+                            self.RESPAWN_BACKOFF_MAX_S,
+                            self._backoff[rank] * 2)
+                    else:
+                        self._backoff[rank] = self.RESPAWN_DELAY_S
+                    self._respawn_after[rank] = now + self._backoff[rank]
+                    dead_pending.add(rank)
+                    print(f"prefill worker rank {rank} died "
+                          f"(rc={proc.returncode}; respawn backoff "
+                          f"{self._backoff[rank]:g}s)", flush=True)
+                    if not self.respawn:
+                        with self._lock:
+                            self._procs.pop(rank, None)
+                        continue
+                if not self.respawn or self._stop.is_set() \
+                        or now < self._respawn_after[rank]:
+                    continue
+                dead_pending.discard(rank)
+                self._ready[rank].clear()
+                self._epoch[rank] += 1
+                self._spawn(rank)
+
+    def wait_ready(self, timeout=180.0):
+        deadline = time.monotonic() + timeout
+        for rank in self.ranks:
+            if not self._ready[rank].wait(
+                    max(0.0, deadline - time.monotonic())):
+                raise RuntimeError(
+                    f"prefill worker rank {rank} never became ready "
+                    f"within {timeout}s")
+
+    def snapshot(self):
+        with self._lock:
+            return {str(r): {"pid": p.pid, "epoch": self._epoch[r],
+                             "alive": p.poll() is None}
+                    for r, p in self._procs.items()}
+
+    def stop(self):
+        self._stop.set()
+        self._supervisor.join(timeout=5)
+        with self._lock:
+            procs = list(self._procs.values())
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except self._subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=5)
+
+
+def _free_ports(n):
+    import socket as socket_mod
+    socks = [socket_mod.create_server(("127.0.0.1", 0)) for _ in range(n)]
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("-m", "--model-name", default="gpt2")
@@ -1412,14 +1666,34 @@ def main():
     p.add_argument("--kv-page-size", default=16, type=int,
                    help="cache positions per KV page")
     p.add_argument("--disaggregate", default="off",
-                   choices=["off", "local", "wire"],
+                   choices=["off", "local", "wire", "process"],
                    help="split serving into a prefill fleet and a decode "
                         "fleet (needs --kv-pages): prompt passes run on "
                         "a DEDICATED pipeline and ship finished KV pages "
                         "into the decode executor — 'local' hands arrays "
                         "over in-process, 'wire' pushes real bytes "
                         "through the v2 codec + a loopback socket "
-                        "(see --kv-ship-bits)")
+                        "(see --kv-ship-bits), 'process' spawns REAL "
+                        "separate prefill worker processes over DCN "
+                        "sockets with the fault-tolerant lease/ack ship "
+                        "protocol (retry, re-dispatch, colocated "
+                        "fallback — docs/FAULT_TOLERANCE.md)")
+    p.add_argument("--prefill-ranks", default=1, type=int,
+                   help="worker processes of --disaggregate process "
+                        "(leases re-dispatch across them on faults)")
+    p.add_argument("--prefill-lease-timeout", default=30.0, type=float,
+                   help="seconds a dispatched prompt pass may go "
+                        "unacked before it re-dispatches")
+    p.add_argument("--prefill-attempts", default=3, type=int,
+                   help="total lease dispatches per prompt before the "
+                        "request degrades to colocated prefill")
+    p.add_argument("--no-prefill-respawn", action="store_true",
+                   help="do not respawn dead prefill workers (default: "
+                        "respawn with DCN_EPOCH+1 and readmit via JOIN)")
+    p.add_argument("--prefill-heartbeat-interval", default=1.0,
+                   type=float,
+                   help="ship-plane heartbeat interval (0 disables; "
+                        "catches hung workers whose sockets stay open)")
     p.add_argument("--kv-ship-bits", default=0, type=int, choices=[0, 8],
                    help="quantize shipped KV pages on the wire (int8 "
                         "block-scaled, 4x fewer bytes; 0 = exact — the "
@@ -1474,6 +1748,21 @@ def main():
                         "asserts trace_report --request can name")
     args = p.parse_args()
 
+    # parse-time composition checks — BEFORE any model build, so a bad
+    # flag pair fails in milliseconds with both flags named, not after
+    # minutes of weight loading (and never as a bare mid-construction
+    # refusal from _Service)
+    if args.draft_model and args.kv_pages:
+        p.error("--kv-pages does not compose with --draft-model: "
+                "speculative decoding rides dense draft/verify caches, "
+                "which the paged KV plane replaces — drop --draft-model "
+                "to serve paged, or drop --kv-pages to serve "
+                "speculatively (ROADMAP item 2 tracks paging the "
+                "speculative caches)")
+    if args.disaggregate != "off" and not args.kv_pages:
+        p.error("--disaggregate needs --kv-pages (shipped KV lands in "
+                "the paged pool)")
+
     from pipeedge_tpu.utils import apply_env_platform
     apply_env_platform()
     import jax.numpy as jnp
@@ -1496,20 +1785,50 @@ def main():
             p.error("--draft-model does not compose with --kv-bits (int8 "
                     "span verification is not bit-identical to serial "
                     "int8 steps)")
-        if args.kv_pages:
-            p.error("--draft-model does not compose with --kv-pages "
-                    "(speculative decoding rides dense draft/verify "
-                    "caches)")
         from pipeedge_tpu.parallel.speculative import SpeculativeDecoder
         d_pipe = build_decode_pipeline(
             args.draft_model, None, max_len=args.max_len, dtype=dtype,
             attend_floor=args.attend_floor)
         spec = SpeculativeDecoder(pipe, d_pipe, gamma=args.gamma)
     prefill_fleet = None
-    if args.disaggregate != "off":
-        if not args.kv_pages:
-            p.error("--disaggregate needs --kv-pages (shipped KV lands "
-                    "in the paged pool)")
+    prefill_supervisor = None
+    ship_ctx = None
+    if args.disaggregate == "process":
+        # REAL separate prefill processes over DCN sockets (this process
+        # is rank 0 of the ship plane; workers are ranks 1..N). The
+        # lease/ack protocol makes the split survivable: ship timeout /
+        # CRC failure / worker death re-dispatch or degrade to colocated
+        # prefill, and dead workers respawn with DCN_EPOCH+1 and JOIN
+        # back in (docs/FAULT_TOLERANCE.md disaggregated serving)
+        from pipeedge_tpu.comm import dcn
+        from pipeedge_tpu.kv import RemotePrefillFleet
+        world = 1 + args.prefill_ranks
+        addrs = [("127.0.0.1", port) for port in _free_ports(world)]
+        addr_arg = ",".join(f"{h}:{port}" for h, port in addrs)
+        worker_cmd = [
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "prefill_worker.py"),
+            str(world), "--dcn-addrs", addr_arg,
+            "-m", args.model_name, "--max-len", str(args.max_len),
+            "-t", args.dtype, "--attend-floor", str(args.attend_floor),
+            "--heartbeat-interval",
+            str(args.prefill_heartbeat_interval)]
+        if args.partition:
+            worker_cmd += ["-pt", args.partition]
+        prefill_supervisor = PrefillWorkerSupervisor(
+            worker_cmd, ranks=range(1, world),
+            respawn=not args.no_prefill_respawn)
+        ship_ctx = dcn.DistDcnContext(world, 0, addrs)
+        ship_ctx.init()
+        prefill_supervisor.wait_ready()
+        prefill_fleet = RemotePrefillFleet(
+            ship_ctx, ranks=range(1, world), dtype=dtype,
+            ship_bits=args.kv_ship_bits,
+            lease_timeout_s=args.prefill_lease_timeout,
+            max_attempts=args.prefill_attempts,
+            max_concurrent=max(1, args.prefill_concurrency),
+            heartbeat_interval=args.prefill_heartbeat_interval)
+    elif args.disaggregate != "off":
         from pipeedge_tpu.kv import PrefillFleet
         # a DEDICATED pipeline: its prompt passes never contend with the
         # decode executor's stage programs for host dispatch order
@@ -1553,7 +1872,13 @@ def main():
                        postmortem_dir=args.postmortem_dir,
                        kv_pages=args.kv_pages,
                        kv_page_size=args.kv_page_size,
-                       prefill_fleet=prefill_fleet)
+                       prefill_fleet=prefill_fleet,
+                       prefill_supervisor=prefill_supervisor)
+    if prefill_fleet is not None and hasattr(prefill_fleet,
+                                             "flight_note"):
+        # ship-plane faults (lease timeouts, zombie drops, worker
+        # deaths/readmissions) land in the flight recorder's event ring
+        prefill_fleet.flight_note = service.flight.note
     server = ThreadingHTTPServer(("127.0.0.1", args.port),
                                  make_handler(service, args.model_name))
     print(f"serving {args.model_name} ({len(pipe.stages)} stages, "
@@ -1562,6 +1887,8 @@ def main():
         server.serve_forever()
     finally:
         service.stop()
+        if ship_ctx is not None:
+            ship_ctx.shutdown()
         if args.trace_spans and telemetry.recorder() is not None:
             from pipeedge_tpu.telemetry import chrome_trace
             chrome_trace.dump_trace(telemetry.recorder().snapshot(),
