@@ -43,6 +43,12 @@ def main() -> int:
     p.add_argument("--notes", default=None,
                    help="free-form provenance appended to the record's "
                         "notes field (e.g. the r05->r06 gap note)")
+    p.add_argument("--scenario-suffix", metavar="TAG", default=None,
+                   help="record the run as scenario RECIPE@TAG so A/B "
+                        "arms of one recipe coexist in a multi-scenario "
+                        "artifact (artifact_append keys on scenario — "
+                        "without a suffix the second arm replaces the "
+                        "first)")
     args, rest = p.parse_known_args()
     if rest and rest[0] == "--":
         rest = rest[1:]         # `bench.py --recipe X -- <recipe flags>`
@@ -61,6 +67,8 @@ def main() -> int:
             return 0
 
     record = benchkit.run_recipe(args.recipe, rest, notes=args.notes)
+    if args.scenario_suffix:
+        record["scenario"] = f"{args.recipe}@{args.scenario_suffix}"
     problems = schema.validate_record(record)
     if problems:
         # a recipe that emits an invalid record is a bug, not a bench

@@ -94,6 +94,12 @@ def _serve_args(p) -> None:
                    help="flight-recorder bundle dir (serve.py default "
                         "when unset)")
     p.add_argument("--startup-timeout", type=float, default=180.0)
+    p.add_argument("--extra-serve-arg", action="append",
+                   dest="extra_serve_args", metavar="ARG", default=[],
+                   help="extra tools/serve.py argv token, repeatable "
+                        "(e.g. --extra-serve-arg=--kv-pages "
+                        "--extra-serve-arg=64 arms the paged plane for "
+                        "an overload sweep A/B arm)")
 
 
 def _setup(args) -> dict:

@@ -1,8 +1,9 @@
 """`serve_kv`: the paged-KV serving bench — prefix sharing, page-pool
 occupancy, and decode-p99 isolation under concurrent prefill.
 
-Three measured phases against one `tools/serve.py --kv-pages` server
-(optionally disaggregated, `--disaggregate local|wire`):
+Four measured phases against one `tools/serve.py --kv-pages` server
+(optionally disaggregated, `--disaggregate local|wire`; optionally
+continuous+chunked, `--chunked N`):
 
 1. **prefix burst** — a shared-prefix workload (`loadgen`'s
    `shared:PFX:TOTAL:POOL` prompt distribution): every prompt repeats
@@ -17,8 +18,15 @@ Three measured phases against one `tools/serve.py --kv-pages` server
    colocated, prefill ticks steal stage-time from decode waves;
    disaggregated, the prefill fleet absorbs them (the A/B in
    docs/evidence/ runs this recipe both ways).
+4. **decode + mid-run spike** — the same short-prompt load with
+   loadgen's `--burst`: N long prompts launched back-to-back at the
+   midpoint. The served latencies inside the spike's blast-radius
+   window (`kv.chunked.burst_decode_p99_ms`) are the continuous-
+   batching A/B's headline: run `--chunked 0` vs `--chunked N` with
+   the same seed — chunked prefill should hold the burst decode p99
+   down while goodput/attainment hold.
 
-The record's `kv` block carries all three; `serve`-style goodput/shed
+The record's `kv` block carries all four; `serve`-style goodput/shed
 blocks come from phase 1. Gates the CI `kv-serve` smoke cares about:
 zero handler errors everywhere, prefix hits > 0.
 """
@@ -59,6 +67,23 @@ def _args(p) -> None:
                         "— and record the fault window's decode p99, "
                         "goodput, recovery_s (respawn + readmission), "
                         "and pages leaked (the ISSUE 15 robustness A/B)")
+    p.add_argument("--chunked", type=int, default=0, metavar="TOKENS",
+                   help="serve with --chunked-prefill TOKENS --step-join "
+                        "(iteration-level scheduling). The A/B against "
+                        "0 — run-to-completion prefill, same seed — is "
+                        "the continuous-batching evidence record: the "
+                        "phase-4 burst decode p99 should drop while "
+                        "goodput/attainment hold")
+    p.add_argument("--chunked-budget", type=int, default=0,
+                   metavar="TOKENS",
+                   help="explicit --prefill-budget for the chunked arm "
+                        "(0 = serve.py default: one chunk per tick). "
+                        "Raising it past the chunk size keeps short "
+                        "steady-state prompts from queueing behind a "
+                        "long-prompt spike's chunk stream")
+    p.add_argument("--burst-n", type=int, default=3,
+                   help="phase-4 spike size (loadgen --burst long "
+                        "prompts launched back-to-back mid-run)")
     p.add_argument("--qps", type=float, default=3.0,
                    help="offered rate for every phase (fixed, not "
                         "calibrated: the phases compare against each "
@@ -107,6 +132,10 @@ def _setup(args) -> dict:
                   "--prefill-heartbeat-interval", "0.5"]
     elif args.disaggregate != "off":
         extra += ["--disaggregate", args.disaggregate]
+    if args.chunked:
+        extra += ["--chunked-prefill", str(args.chunked), "--step-join"]
+        if args.chunked_budget:
+            extra += ["--prefill-budget", str(args.chunked_budget)]
     if args.max_active:
         extra += ["--max-active", str(args.max_active)]
     a.extra_serve_args = extra
@@ -148,7 +177,8 @@ def _run(args, state) -> dict:
     long_len = min(args.long_len, args.max_len - args.new_tokens - 1)
     for n, nt in {(loadgen.spec_max_len(args.shared_spec),
                    args.new_tokens),
-                  (args.short_len, args.new_tokens), (long_len, 2)}:
+                  (args.short_len, args.new_tokens), (long_len, 2),
+                  (long_len, args.new_tokens)}:
         for rep in range(reps):
             _post(gen_url, {"ids": [[7 + rep] * n], "new_tokens": nt})
 
@@ -219,7 +249,23 @@ def _run(args, state) -> dict:
         burster.join(timeout=120)
     kv2 = _healthz(url)["serving"]["kv"]
 
-    # -- phase 4 (opt-in): decode load through a prefill-worker kill --
+    # -- phase 4: decode load + seeded mid-run long-prompt SPIKE -----
+    # Unlike phase 3's continuous hammering, this is loadgen's --burst:
+    # N long prompts launch back-to-back at the run's midpoint, and the
+    # steady-state decode latencies inside the spike's blast-radius
+    # window report as burst.during_ms — the number chunked prefill
+    # exists to hold down (run-to-completion prefill stalls every
+    # decode step behind each long prompt pass; chunked interleaves).
+    # Runs in BOTH arms so the --chunked 0 vs N records A/B cleanly.
+    spike = loadgen.run_load(
+        gen_url, args.duration, args.qps, mix=mix, slo_ms=slo,
+        new_tokens=args.new_tokens, prompt_len=args.short_len,
+        seed=args.seed + 4, arrival="uniform",
+        burst={"at": 0.5, "n": args.burst_n, "len": long_len,
+               "window_s": 2.0})
+    sched = _healthz(url)["serving"].get("scheduler")
+
+    # -- phase 5 (opt-in): decode load through a prefill-worker kill --
     # the robustness half of the disaggregation A/B (ISSUE 15): the
     # SAME decode load as phase 2, but a prefill worker is SIGKILLed
     # mid-window — the lease protocol must re-dispatch / fall back
@@ -310,11 +356,12 @@ def _run(args, state) -> dict:
     p99_solo = solo["latency_ms"]["p99"]
     p99_contended = contended["latency_ms"]["p99"]
     errors = (shared["totals"]["error"] + solo["totals"]["error"]
-              + contended["totals"]["error"])
+              + contended["totals"]["error"] + spike["totals"]["error"]
+              + spike["burst"]["error"])
     notes = None
     if errors:
         notes = (f"{errors} handler error(s); first: "
-                 f"{shared['first_error'] or solo['first_error'] or contended['first_error']}")
+                 f"{shared['first_error'] or solo['first_error'] or contended['first_error'] or spike['first_error'] or spike['burst']['first_error']}")
     goodput = round(sum(c["goodput_rps"]
                         for c in shared["classes"].values()), 3)
     return {
@@ -345,6 +392,26 @@ def _run(args, state) -> dict:
                               "with_prefill": p99_contended},
             "decode_p99_ratio": (None if not p99_solo or not p99_contended
                                  else round(p99_contended / p99_solo, 3)),
+            # the continuous-batching A/B's headline block: chunk
+            # config + the spike phase's decode-under-burst latency
+            # (--chunked 0 vs N, same seed — docs/SERVING.md)
+            "chunked": {
+                "chunk_tokens": args.chunked,
+                "step_join": bool(args.chunked),
+                "prefill_chunks": (None if sched is None
+                                   else sched["prefill_chunks"]),
+                "burst_n": args.burst_n,
+                "burst_prompt_len": long_len,
+                "burst_decode_p99_ms": spike["burst"]["during_ms"]["p99"],
+                "burst_decode_p50_ms": spike["burst"]["during_ms"]["p50"],
+                "burst_window_served": spike["burst"]["during_ms"]["n"],
+                "spike_p99_ms": spike["burst"]["latency_ms"]["p99"],
+                "goodput_rps": round(sum(
+                    c["goodput_rps"]
+                    for c in spike["classes"].values()), 3),
+                "attainment": spike["classes"]["interactive"]
+                ["slo_attainment"],
+            },
             "fault": fault_block,
             "shed": {"shared": shared["totals"]["shed"],
                      "solo": solo["totals"]["shed"],
@@ -353,7 +420,7 @@ def _run(args, state) -> dict:
         },
         "notes": notes,
         "extras": {"shared": shared, "solo": solo,
-                   "contended": contended},
+                   "contended": contended, "spike": spike},
     }
 
 

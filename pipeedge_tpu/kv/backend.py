@@ -264,6 +264,10 @@ class PagedKvBackend:
             lo, hi = 0, req.prompt_len
         elif kind == "span":
             lo, hi = ks["shared_len"], req.prompt_len
+        elif kind == "chunk":
+            # chunked prefill: only this chunk's slice of the prompt
+            # was written (earlier chunks already scattered theirs)
+            lo, hi = req.chunk_off, req.chunk_off + span
         else:
             lo, hi = req.pos, req.pos + 1
         return range(lo // self.page_size,
@@ -276,7 +280,7 @@ class PagedKvBackend:
         st = self.pipe.stages[i]
         ks = req.kvstate
         batch = req.ids.shape[0]
-        span = data.shape[1] if kind in ("prefill", "span") else 1
+        span = data.shape[1] if kind in ("prefill", "span", "chunk") else 1
         writes = [(b, j) for b in range(batch)
                   for j in self._touched_pages(kind, req, span)
                   if j >= ks["shared"]]
@@ -291,12 +295,22 @@ class PagedKvBackend:
                 elif kind == "span":
                     out, cache = self.pipe._decode_step(
                         st, data, cache, ks["shared_len"], span=span)
+                elif kind == "chunk":
+                    # one slice of a chunked prompt pass: a span at the
+                    # chunk's absolute offset (batcher._run_stage's rule)
+                    out, cache = self.pipe._decode_step(
+                        st, data, cache, req.chunk_off, span=span)
                 else:
                     out, cache = self.pipe._decode_step(st, data, cache,
                                                         req.pos)
                 self.pool.scatter(i, ks["table"], cache, writes)
-        if i == self._n_stages - 1 and kind in ("prefill", "span") \
-                and self.trie is not None and tokens_publishable(req):
+        # trie publish waits for the prompt pass to COMPLETE: a single
+        # prefill/span, or the FINAL chunk of a chunked pass (publishing
+        # a half-written prompt would serve garbage pages to sharers)
+        if i == self._n_stages - 1 and self.trie is not None \
+                and (kind in ("prefill", "span")
+                     or (kind == "chunk" and req.chunk_final)) \
+                and tokens_publishable(req):
             self._publish(req)
         return out
 

@@ -27,6 +27,12 @@ TPU-first constraints drive the design:
   frees (`max_active` bounds cache memory, default = enough to saturate
   the pipeline); arrivals and completions interleave freely mid-run —
   the "continuous" in continuous batching.
+- **Iteration-level scheduling** (opt-in): `step_join=True` joins a
+  pending request the moment a step boundary frees its slot (same tick,
+  not next wave), and `chunk_tokens=N` splits long prompt passes into
+  N-token CHUNKS interleaved with other requests' decode steps under a
+  token-budget-per-step policy — a long prompt streams in at a bounded
+  rate instead of monopolizing the pipeline (docs/SERVING.md).
 
 The reference has no analogue (its runtime is single-shot batch inference;
 the decode subsystem itself is already beyond-reference — docs/DECODE.md).
@@ -43,8 +49,34 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import telemetry
+from ..telemetry import metrics as prom
 from .decode import (DecodePipeline, _repeat_batch, make_token_picker,
                      validate_capacity)
+
+# iteration-level scheduling counters (docs/OBSERVABILITY.md): one family
+# per event, labelled by executor so /metrics tells the wave batcher's
+# steps from the stage workers' without a second registry
+M_STEPS = prom.REGISTRY.counter(
+    "pipeedge_decode_steps_total",
+    "decode-step boundaries crossed (one per picked token wave), "
+    "by executor")
+M_CHUNKS = prom.REGISTRY.counter(
+    "pipeedge_prefill_chunks_total",
+    "prompt chunks dispatched by the chunked-prefill scheduler, "
+    "by executor")
+for _ex in ("wave", "workers"):
+    M_STEPS.declare(executor=_ex)
+    M_CHUNKS.declare(executor=_ex)
+del _ex
+
+
+def _sched_mark(name: str, rid) -> None:
+    """Instant `sched` span (join/retire/chunk): scheduler decisions are
+    point events whose endpoints may straddle threads, so both executors
+    record them pre-timed instead of opening a with-span."""
+    if telemetry.enabled():
+        now = time.monotonic_ns()
+        telemetry.record("sched", name, now, now, rid=str(rid))
 
 
 @dataclass
@@ -80,6 +112,18 @@ class _Request:
     # already ran remotely; admission installs the KV rows and decoding
     # starts directly at the first decode step
     shipped: Optional[Dict] = None
+    # chunked prefill (docs/SERVING.md): a long prompt pass split into
+    # fixed-token chunks interleaved with other requests' decode steps.
+    # One chunk is in flight at a time; `chunk_rest` holds the prompt
+    # tokens not yet dispatched, `chunk_off` the in-flight chunk's
+    # absolute cache offset, `chunk_next` the next chunk's offset, and
+    # `chunk_final` whether the in-flight chunk completes the prompt
+    # (only then does the last stage pick a token / publish trie pages)
+    chunk_rest: Optional[jnp.ndarray] = None
+    chunk_off: int = 0
+    chunk_next: int = 0
+    chunk_final: bool = False
+    chunks_done: int = 0
     tokens: List = field(default_factory=list)
 
     @property
@@ -144,6 +188,44 @@ def _seed_caches(pipe: DecodePipeline, req: _Request) -> str:
     return "prefill"
 
 
+def _next_chunk(req: _Request, chunk_tokens: int) -> jnp.ndarray:
+    """Pop the next prompt chunk off `req.chunk_rest`: advances
+    `chunk_off`/`chunk_next`, sets `chunk_final` on the last slice.
+    `chunk_tokens` is read per pop, so a brownout chunk clamp
+    (`set_chunk_tokens`) takes effect at the next chunk boundary."""
+    rest = req.chunk_rest
+    take = rest.shape[1] if chunk_tokens < 1 \
+        else min(int(chunk_tokens), rest.shape[1])
+    req.chunk_off = req.chunk_next
+    req.chunk_next += take
+    data, rest = rest[:, :take], rest[:, take:]
+    req.chunk_rest = rest if rest.shape[1] else None
+    req.chunk_final = req.chunk_rest is None
+    req.chunks_done += 1
+    _sched_mark("chunk", req.rid)
+    return data
+
+
+def _maybe_chunk(req: _Request, kind: str, data,
+                 chunk_tokens: int):
+    """Convert a long prompt pass into its first CHUNK. A prompt pass
+    ("prefill" for a fresh prompt, "span" for a prefix/trie-seeded
+    suffix) longer than `chunk_tokens` becomes a sequence of "chunk"
+    waves: each runs `chunk_tokens` prompt positions as a span at its
+    absolute offset (DecodePipeline.extend's rule — token-identical to
+    the single pass for fp caches, where masked positions contribute
+    exact softmax zeros), and the scheduler interleaves other requests'
+    decode steps between chunks. The base offset is uniform across
+    seeding paths: prompt_len - data_len (0 fresh, shared_len trie,
+    prefix_len dense prefix)."""
+    if chunk_tokens < 1 or kind not in ("prefill", "span") \
+            or data.shape[1] <= chunk_tokens:
+        return kind, data
+    req.chunk_next = req.prompt_len - data.shape[1]
+    req.chunk_rest = data
+    return "chunk", _next_chunk(req, chunk_tokens)
+
+
 def _run_stage(pipe: DecodePipeline, i: int, req: _Request, data,
                kind: str):
     """One stage-step dispatch for request `req` at stage `i` — THE
@@ -169,6 +251,13 @@ def _run_stage(pipe: DecodePipeline, i: int, req: _Request, data,
             # the prefix offset (DecodePipeline.extend's rule)
             out, req.caches[i] = pipe._decode_step(
                 st, data, req.caches[i], req.prefix["len"],
+                span=data.shape[1])
+        elif kind == "chunk":
+            # chunked prefill: this slice of the prompt runs as a span
+            # at its absolute offset; earlier chunks' KV rows are
+            # already in the caches, so attention is exact
+            out, req.caches[i] = pipe._decode_step(
+                st, data, req.caches[i], req.chunk_off,
                 span=data.shape[1])
         else:
             out, req.caches[i] = pipe._decode_step(st, data, req.caches[i],
@@ -228,7 +317,9 @@ class ContinuousBatcher:
     """
 
     def __init__(self, pipe: DecodePipeline, max_active: Optional[int] = None,
-                 kv=None):
+                 kv=None, chunk_tokens: int = 0,
+                 prefill_budget: Optional[int] = None,
+                 step_join: bool = False, on_step=None):
         if pipe.sp_degree != 1:
             raise ValueError("continuous batching drives per-request decode "
                              "waves; sp prefill is a whole-pipeline pass "
@@ -246,16 +337,46 @@ class ContinuousBatcher:
         self.max_active = max_active
         if self.max_active < 1:
             raise ValueError(f"max_active must be >= 1, got {self.max_active}")
+        # chunked prefill (docs/SERVING.md): prompt passes longer than
+        # `chunk_tokens` are split into chunk waves; `prefill_budget`
+        # bounds the prompt tokens ENTERING stage 0 per tick (default:
+        # one chunk's worth), so decode steps keep landing while a long
+        # prompt streams in. 0 disables chunking.
+        if chunk_tokens < 0:
+            raise ValueError(f"chunk_tokens must be >= 0, got {chunk_tokens}")
+        self.chunk_tokens = int(chunk_tokens)
+        self.prefill_budget = (self.chunk_tokens if prefill_budget is None
+                               else int(prefill_budget))
+        if self.chunk_tokens and self.prefill_budget < 1:
+            raise ValueError("prefill_budget must be >= 1 when chunking")
+        self._budget = 0
+        # step_join: refill a slot freed at the LAST stage into stage 0
+        # within the SAME tick (the reversed drain visits stage 0 after
+        # the completion), so admission happens at step boundaries, not
+        # wave boundaries. Off by default: strict-wave timing is the
+        # contract tests/test_batcher.py pins.
+        self.step_join = bool(step_join)
+        # on_step(): fired after each decode-step boundary (a pick
+        # landed) — tools/serve.py chains admission re-grants to it
+        self.on_step = on_step
         self.pending: deque = deque()
         self.active = 0
         self._live_rids = set()      # pending + admitted (not yet completed)
         # stage i's input queue: (request, data, kind) tuples with kind in
-        # {"prefill", "span", "step"} ("span" = a prefix-seeded request's
-        # suffix prompt pass); `data` is token ids at stage 0, the
-        # previous stage's hidden state after
+        # {"prefill", "span", "chunk", "step"} ("span" = a prefix-seeded
+        # request's suffix prompt pass, "chunk" = one slice of a chunked
+        # prompt pass); `data` is token ids at stage 0, the previous
+        # stage's hidden state after
         self._stage_q: List[deque] = [deque() for _ in range(self.n_stages)]
         self.results: Dict = {}
-        self.stats = {"ticks": 0, "stage_steps": 0, "tokens": 0}
+        self.stats = {"ticks": 0, "stage_steps": 0, "tokens": 0,
+                      "prefill_chunks": 0}
+
+    def set_chunk_tokens(self, n: int) -> None:
+        """Retarget the chunk size (GIL-atomic int write) — the brownout
+        ladder's chunk-clamp rung calls this from the governor thread;
+        in-flight requests see it at their next chunk boundary."""
+        self.chunk_tokens = max(0, int(n))
 
     def submit(self, rid, ids, new_tokens: int, temperature: float = 0.0,
                top_k: int = 0, seed: int = 0,
@@ -351,7 +472,12 @@ class ContinuousBatcher:
             else:
                 self.pending.popleft()
                 kind, data = _seed_caches(self.pipe, req), req.ids
+            kind, data = _maybe_chunk(req, kind, data, self.chunk_tokens)
+            if kind == "chunk":
+                self.stats["prefill_chunks"] += 1
+                M_CHUNKS.inc(executor="wave")
             self.active += 1
+            _sched_mark("join", req.rid)
             self._stage_q[0].append((req, data, kind))
 
     def _finish_wave(self, req: _Request, out, kind: str,
@@ -363,13 +489,31 @@ class ContinuousBatcher:
         tick's dispatch loop (`eos_pending`): the decision needs a host
         readback of the token, and blocking here — the loop's first
         iteration — would serialize every other stage's dispatch behind
-        this request's compute."""
+        this request's compute.
+
+        An INTERMEDIATE prompt chunk produces no token: its chunk
+        boundary is a scheduling point — retire an expired/cancelled
+        request right here (its pages/slots free without decoding a
+        single token) or queue the next chunk."""
+        if kind == "chunk" and not req.chunk_final:
+            if _expired(req) or (req.cancel is not None
+                                 and req.cancel.is_set()):
+                self._complete(req)   # mid-prompt shed: free pages now
+                return
+            data = _next_chunk(req, self.chunk_tokens)
+            self.stats["prefill_chunks"] += 1
+            M_CHUNKS.inc(executor="wave")
+            reentries.append((req, data, "chunk"))
+            return
         del kind  # the last position's logits, for every wave kind:
         logits = out[:, -1]  # prefill [B,S], span [B,S_s], step [B,1]
         req.rng, sub = jax.random.split(req.rng)
         token = req.pick(logits.astype(jnp.float32), sub)
         req.tokens.append(token)
         self.stats["tokens"] += int(token.shape[0])
+        M_STEPS.inc(executor="wave")
+        if self.on_step is not None:
+            self.on_step()
         if req.on_token is not None:
             req.on_token(len(req.tokens) - 1, token)
         done = len(req.tokens) >= req.new_tokens
@@ -388,10 +532,19 @@ class ContinuousBatcher:
     def _complete(self, req: _Request) -> None:
         self.results[req.rid] = _finalize_tokens(req)
         req.caches = None            # free this request's cache slots
+        req.chunk_rest = None
         if self.kv is not None:
             self.kv.release(req)     # ... or its page references
         self.active -= 1
         self._live_rids.discard(req.rid)
+        _sched_mark("retire", req.rid)
+        if self.step_join:
+            # the slot freed at THIS step boundary joins a pending
+            # request into stage 0 immediately: the reversed drain has
+            # not reached stage 0 yet, so the joiner's first wave
+            # dispatches within the same tick (iteration-level
+            # scheduling, not wave-level)
+            self._admit()
 
     def _decide_eos(self, req: _Request) -> None:
         """Post-dispatch stop decision for an eos request: read back the
@@ -409,6 +562,32 @@ class ContinuousBatcher:
         else:
             self._stage_q[0].append((req, token[:, None], "step"))
 
+    def _pop_stage0(self):
+        """Token-budget-per-step policy at stage 0: the budget accrues
+        `prefill_budget` tokens per tick (capped so it cannot bank an
+        unbounded prompt burst) and prompt-kind dispatches
+        (prefill/span/chunk) spend it. A prompt head that outruns the
+        accrued budget is deferred behind the first queued decode step —
+        decode steps keep landing at a guaranteed rate while a long
+        prompt streams in at `prefill_budget` tokens/tick. When no
+        decode step is waiting, prompt work passes regardless (budget
+        throttles competition, not progress), so starvation is
+        impossible. Pure deterministic queue arithmetic: interleaving is
+        reproducible under a pinned seed."""
+        q = self._stage_q[0]
+        if self.chunk_tokens and q[0][2] != "step" \
+                and q[0][1].shape[1] > self._budget:
+            for k in range(1, len(q)):
+                if q[k][2] == "step":
+                    q.rotate(-k)
+                    item = q.popleft()
+                    q.rotate(k)   # restore order minus item k
+                    return item
+        item = q.popleft()
+        if item[2] != "step":
+            self._budget -= item[1].shape[1]
+        return item
+
     def tick(self) -> bool:
         """Advance every stage by at most one stage-step; returns whether
         any work remains.
@@ -421,7 +600,11 @@ class ContinuousBatcher:
         with stages on distinct devices the asynchronously dispatched
         steps genuinely overlap. (A solo request therefore costs exactly
         n_stages ticks per token — the pipeline-bubble baseline the
-        batcher exists to fill.)"""
+        batcher exists to fill.) With `step_join`, completions refill
+        stage 0 mid-tick; with `chunk_tokens`, stage 0's pop obeys the
+        per-tick prefill token budget."""
+        cap = max(self.prefill_budget, self.chunk_tokens)
+        self._budget = min(self._budget + self.prefill_budget, cap)
         self._admit()
         worked = False
         reentries: list = []
@@ -429,7 +612,8 @@ class ContinuousBatcher:
         for i in reversed(range(self.n_stages)):
             if not self._stage_q[i]:
                 continue
-            req, data, kind = self._stage_q[i].popleft()
+            req, data, kind = (self._pop_stage0() if i == 0
+                               else self._stage_q[i].popleft())
             out = (self.kv.run_stage(i, req, data, kind)
                    if self.kv is not None
                    else _run_stage(self.pipe, i, req, data, kind))
@@ -488,7 +672,9 @@ class StageWorkerExecutor:
     _DONE = object()
 
     def __init__(self, pipe: DecodePipeline,
-                 max_active: Optional[int] = None, kv=None):
+                 max_active: Optional[int] = None, kv=None,
+                 chunk_tokens: int = 0, step_join: bool = False,
+                 on_step=None):
         import queue as queue_mod
         import threading
 
@@ -508,6 +694,22 @@ class StageWorkerExecutor:
         self.max_active = max_active
         if self.max_active < 1:
             raise ValueError(f"max_active must be >= 1, got {self.max_active}")
+        # chunked prefill: the stage queues are FIFO, so bounding every
+        # item's token cost at `chunk_tokens` IS the latency policy here
+        # — a decode step queued behind a chunk waits one chunk-time,
+        # not one whole-prompt-time (no explicit budget needed: workers
+        # interleave whatever order the queues hold)
+        if chunk_tokens < 0:
+            raise ValueError(f"chunk_tokens must be >= 0, got {chunk_tokens}")
+        self.chunk_tokens = int(chunk_tokens)
+        # stage workers join/retire at step boundaries BY CONSTRUCTION
+        # (submit feeds stage 0 whenever a slot frees, mid-wave);
+        # `step_join` is accepted for signature parity with the wave
+        # batcher so tools/serve.py configures both identically
+        self.step_join = bool(step_join)
+        # on_step(): fired after each decode-step pick (last stage's
+        # worker thread) — tools/serve.py chains admission re-grants
+        self.on_step = on_step
         self._q = [queue_mod.Queue() for _ in range(self.n_stages)]
         # plain (not Bounded) semaphore: _die() over-releases on purpose
         # so submitters blocked on admission wake up and see the failure
@@ -518,7 +720,8 @@ class StageWorkerExecutor:
         self._dead: Optional[BaseException] = None
         self.active = 0
         self.stats = {"stage_steps": [0] * self.n_stages,
-                      "busy": [False] * self.n_stages, "tokens": 0}
+                      "busy": [False] * self.n_stages, "tokens": 0,
+                      "prefill_chunks": 0}
         self._workers = [
             threading.Thread(target=self._stage_loop, args=(i,),
                              daemon=True, name=f"stage-worker-{i}")
@@ -603,6 +806,13 @@ class StageWorkerExecutor:
                         self._lock.notify_all()
                     self._slots.release()
                     return
+                kind, data = _maybe_chunk(req, kind, data,
+                                          self.chunk_tokens)
+                if kind == "chunk":
+                    with self._lock:
+                        self.stats["prefill_chunks"] += 1
+                    M_CHUNKS.inc(executor="workers")
+                _sched_mark("join", rid)
                 self._q[0].put((req, data, kind))
             except BaseException:
                 # roll the admission back (e.g. cache allocation OOM /
@@ -636,7 +846,14 @@ class StageWorkerExecutor:
                     "busy": list(self.stats["busy"]),
                     "queued": [q.qsize() for q in self._q],
                     "tokens": self.stats["tokens"],
+                    "prefill_chunks": self.stats["prefill_chunks"],
                     "active": self.active}
+
+    def set_chunk_tokens(self, n: int) -> None:
+        """Retarget the chunk size (GIL-atomic int write) — the brownout
+        ladder's chunk-clamp rung calls this from the governor thread;
+        in-flight requests see it at their next chunk boundary."""
+        self.chunk_tokens = max(0, int(n))
 
     def stop(self) -> None:
         """Shut the workers down. Queued work ahead of the sentinels is
@@ -690,24 +907,52 @@ class StageWorkerExecutor:
                 if i + 1 < self.n_stages:
                     self._q[i + 1].put((req, out, kind))
                 else:
-                    self._finish(req, out)
+                    self._finish(req, out, kind)
             except BaseException as exc:   # noqa: BLE001 — a dead worker
                 self._die(exc)             # must fail waiters, not hang them
                 raise
             finally:
                 self.stats["busy"][i] = False
 
-    def _finish(self, req: _Request, out) -> None:
+    def _finish(self, req: _Request, out, kind: str) -> None:
         """Last stage done (runs in the last stage's worker): pick the
         next token, stream it, then complete or re-enter stage 0. The
         eos readback blocks only THIS worker; earlier stages keep
-        dispatching other requests."""
+        dispatching other requests. An INTERMEDIATE prompt chunk picks
+        nothing: its boundary retires an expired/cancelled request (the
+        mid-prompt shed frees pages before a single token decodes) or
+        queues the next chunk."""
+        if kind == "chunk" and not req.chunk_final:
+            if _expired(req) or (req.cancel is not None
+                                 and req.cancel.is_set()):
+                arr = _finalize_tokens(req)   # the bare prompt
+                req.caches = None
+                req.chunk_rest = None
+                if self.kv is not None:
+                    self.kv.release(req)
+                _sched_mark("retire", req.rid)
+                with self._lock:
+                    self.results[req.rid] = arr
+                    self._live.discard(req.rid)
+                    self.active -= 1
+                    self._lock.notify_all()
+                self._slots.release()
+                return
+            data = _next_chunk(req, self.chunk_tokens)
+            with self._lock:
+                self.stats["prefill_chunks"] += 1
+            M_CHUNKS.inc(executor="workers")
+            self._q[0].put((req, data, "chunk"))
+            return
         logits = out[:, -1]
         req.rng, sub = jax.random.split(req.rng)
         token = req.pick(logits.astype(jnp.float32), sub)
         req.tokens.append(token)
         with self._lock:
             self.stats["tokens"] += int(token.shape[0])
+        M_STEPS.inc(executor="workers")
+        if self.on_step is not None:
+            self.on_step()
         if req.on_token is not None:
             req.on_token(len(req.tokens) - 1, token)
         done = len(req.tokens) >= req.new_tokens
@@ -725,6 +970,7 @@ class StageWorkerExecutor:
             req.caches = None        # free this request's cache slots
             if self.kv is not None:
                 self.kv.release(req)  # ... or its page references
+            _sched_mark("retire", req.rid)
             with self._lock:
                 self.results[req.rid] = arr
                 self._live.discard(req.rid)

@@ -99,9 +99,15 @@ class SpeculativeDecoder:
     """
 
     def __init__(self, target: DecodePipeline, draft: DecodePipeline,
-                 gamma: int = 4, sync: str = "auto"):
+                 gamma: int = 4, sync: str = "auto",
+                 target_kv=None, draft_pool=None):
         if gamma < 1:
             raise ValueError(f"gamma must be >= 1, got {gamma}")
+        if (target_kv is None) != (draft_pool is None):
+            raise ValueError(
+                "paged speculative decoding needs BOTH pools: target_kv "
+                "(the decode plane's PagedKvBackend) and draft_pool (a "
+                "KvPagePool over the draft pipeline)")
         if target.cfg.vocab_size != draft.cfg.vocab_size:
             raise ValueError(
                 "draft and target must share a vocabulary: "
@@ -132,6 +138,17 @@ class SpeculativeDecoder:
         self.gamma = gamma
         self.sync = "host" if sync == "auto" and blockers else \
             ("device" if sync == "auto" else sync)
+        # paged mode (docs/SERVING.md): draft/verify caches live as
+        # page-shaped views over KvPagePools instead of dense max_len
+        # slots — speculation's cache residency is charged against the
+        # SAME capacity plane as the decode executor's requests (and a
+        # separate draft-layout pool), so admission tokens, brownout
+        # eviction pressure and the orphan sweep all see it
+        self.kv = target_kv
+        self.draft_pool = draft_pool
+        self._live: set = set()   # owners mid-generate (sweep liveness)
+        import itertools
+        self._seq = itertools.count()
         self.last_acceptance_rate: Optional[float] = None
         self.last_sync_count: Optional[int] = None
         self._round_cache: dict = {}
@@ -192,7 +209,100 @@ class SpeculativeDecoder:
         return {"target": self.target.precompute_prefix(prefix_ids),
                 "draft": self.draft.precompute_prefix(prefix_ids)}
 
-    def generate(self, ids, new_tokens: int, prefix: Optional[dict] = None):
+    # -- paged caches (kv/pool.py) ----------------------------------------
+
+    def attach_paged(self, target_kv, draft_pool) -> None:
+        """Arm paged mode after construction. The serving layer builds
+        the decoder BEFORE the decode plane's PagedKvBackend exists
+        (tools/serve.py constructs the backend inside `_Service`), so
+        the pools are attached here rather than via `__init__`."""
+        if target_kv is None or draft_pool is None:
+            raise ValueError("attach_paged needs BOTH target_kv and "
+                             "draft_pool (see __init__)")
+        self.kv = target_kv
+        self.draft_pool = draft_pool
+
+    def live_rids(self) -> set:
+        """Owners currently mid-generate. The serving governor unions
+        this into the pool sweeps' live set, so a speculative request's
+        pages are never taken for orphans while its thread runs."""
+        return set(self._live)
+
+    def sweep_orphans(self) -> int:
+        """Reclaim DRAFT-pool pages whose generation died between page
+        charge and release (the target pool's pages ride the decode
+        plane's sweep — tools/serve.py passes `live_rids` into it)."""
+        if self.draft_pool is None:
+            return 0
+        return self.draft_pool.sweep_leaked(lambda: self.live_rids())
+
+    def _alloc_paged(self, owner, batch: int, prompt_len: int,
+                     new_tokens: int):
+        """Charge pages for one paged generation — target pages from the
+        decode plane's pool (speculation competes for the SAME capacity
+        as executor requests), draft pages from the draft-layout pool —
+        and return the gathered page-shaped working caches. The views
+        are `[L, B, pages * page_size, ...]`, shorter than dense
+        `max_len` slots: positions past the window are masked to exact
+        softmax zeros, so tokens are identical to the dense path
+        (kv/backend.py's numerics argument; tests pin it). Speculative
+        caches are never shared cross-request, so the pages are held as
+        the capacity reservation and the rounds run on the views —
+        scatters back to the arena would be dead stores."""
+        from ..kv.pool import pages_for
+        g = self.gamma
+        t_per = self.kv.pages_needed(prompt_len, new_tokens + g)
+        dpool = self.draft_pool
+        # the draft pool buckets like PagedKvBackend.pages_needed: page
+        # spans round up to a power of two so the draft programs compile
+        # per bucket, not per exact prompt length
+        d_per = pages_for(prompt_len + new_tokens + g, dpool.page_size)
+        cap = pages_for(self.draft.max_len, dpool.page_size)
+        p2 = 1
+        while p2 < d_per:
+            p2 *= 2
+        d_per = min(p2, cap)
+        t_rows: list = []
+        d_rows: list = []
+        try:
+            for _ in range(batch):
+                t_rows.append(self.kv.pool.alloc(t_per))
+            for _ in range(batch):
+                d_rows.append(dpool.alloc(d_per))
+        except BaseException:
+            for row in t_rows:
+                self.kv.pool.release(row)
+            for row in d_rows:
+                dpool.release(row)
+            raise
+        # ledger adoption: a thread that dies past this point is
+        # reclaimable by the orphan sweeps (owner is in _live already,
+        # so a concurrent sweep cannot take the pages for dead)
+        self.kv.pool.adopt(owner, [p for row in t_rows for p in row])
+        dpool.adopt(owner, [p for row in d_rows for p in row])
+        t_table = np.asarray(t_rows, np.int32)
+        d_table = np.asarray(d_rows, np.int32)
+        with self.kv._arena_lock:
+            t_caches = [self.kv.pool.gather(i, t_table)
+                        for i in range(len(self.target.stages))]
+        d_caches = [dpool.gather(i, d_table)
+                    for i in range(len(self.draft.stages))]
+        return t_caches, d_caches
+
+    def _release_paged(self, owner) -> None:
+        """Drop both pools' page references (claim-then-release through
+        the owner ledgers, so the release path and the orphan sweeps
+        race benignly) and delist the owner."""
+        pids = self.kv.pool.disown(owner)
+        if pids is not None:
+            self.kv.pool.release(pids)
+        pids = self.draft_pool.disown(owner)
+        if pids is not None:
+            self.draft_pool.release(pids)
+        self._live.discard(owner)
+
+    def generate(self, ids, new_tokens: int, prefix: Optional[dict] = None,
+                 rid=None):
         """Greedy-decode `new_tokens` continuations of prompt `ids`
         [B, S]; returns [B, S + new_tokens] (prompt included), token-
         identical to `target.generate(ids, new_tokens)` for fp caches.
@@ -201,7 +311,12 @@ class SpeculativeDecoder:
         `prefix` (from this decoder's `precompute_prefix`) seeds both
         pipelines with a shared prompt prefix; `ids` is then each
         request's SUFFIX (non-empty), and the returned array omits the
-        prefix — matching `DecodePipeline.generate`'s prefix contract."""
+        prefix — matching `DecodePipeline.generate`'s prefix contract.
+
+        In paged mode (`target_kv`/`draft_pool` set) the caches are
+        page-shaped views over the pools instead of dense slots —
+        token-identical — and `rid` names the page owner in the pools'
+        ledgers (defaults to a fresh unique id)."""
         ids = jnp.asarray(ids, jnp.int32)
         batch, suffix_len = ids.shape
         base = prefix["target"]["len"] if prefix else 0
@@ -218,6 +333,11 @@ class SpeculativeDecoder:
                 raise ValueError("prefix reuse needs a non-empty suffix")
         if new_tokens <= 0:
             return ids
+        if self.kv is not None and prefix is not None:
+            raise ValueError(
+                "paged speculative decoding replaces dense prefix "
+                "handles (the serving layer expands prefixes into "
+                "prompt tokens); submit the full prompt instead")
         g = self.gamma
         # worst case writes a full span past the last emitted token
         validate_capacity(self.target.cfg, self.target.max_len,
@@ -225,29 +345,58 @@ class SpeculativeDecoder:
         validate_capacity(self.draft.cfg, self.draft.max_len,
                           prompt_len, new_tokens + g)
 
-        if prefix is None:
-            t_out, t_caches = self.target._prefill(ids)
-            _, d_caches = self.draft._prefill(ids)
-            # the draft has seen the whole prompt; catch-up tokens are
-            # all emitted ones
-            known = []
-        else:
-            from .decode import _repeat_batch
-            t_caches = [_repeat_batch(c, batch)
-                        for c in prefix["target"]["caches"]]
-            t_out, t_caches = self.target.extend(ids, t_caches, base)
-            d_caches = [_repeat_batch(c, batch)
-                        for c in prefix["draft"]["caches"]]
-            # the draft has seen only the prefix: its first catch-up
-            # span covers the whole suffix too (one transfer, [B] rows)
-            known = list(np.asarray(ids, np.int32).T)
+        owner = None
+        try:
+            if self.kv is not None:
+                owner = str(rid) if rid is not None \
+                    else f"spec{next(self._seq)}"
+                self._live.add(owner)
+                t_caches, d_caches = self._alloc_paged(
+                    owner, batch, prompt_len, new_tokens)
+                # the prompt pass runs as a span at offset 0 over the
+                # page-shaped views — token-identical to _prefill (the
+                # same masking rule chunked prefill relies on)
+                t_out, t_caches = self.target.extend(ids, t_caches, 0)
+                _, d_caches = self.draft.extend(ids, d_caches, 0)
+                known = []
+            elif prefix is None:
+                t_out, t_caches = self.target._prefill(ids)
+                _, d_caches = self.draft._prefill(ids)
+                # the draft has seen the whole prompt; catch-up tokens
+                # are all emitted ones
+                known = []
+            else:
+                from .decode import _repeat_batch
+                t_caches = [_repeat_batch(c, batch)
+                            for c in prefix["target"]["caches"]]
+                t_out, t_caches = self.target.extend(ids, t_caches, base)
+                d_caches = [_repeat_batch(c, batch)
+                            for c in prefix["draft"]["caches"]]
+                # the draft has seen only the prefix: its first catch-up
+                # span covers the whole suffix too (one transfer, [B]
+                # rows)
+                known = list(np.asarray(ids, np.int32).T)
+            return self._rounds(ids, new_tokens, t_out, t_caches,
+                                d_caches, known, base, prompt_len,
+                                bool(prefix))
+        finally:
+            if owner is not None:
+                self._release_paged(owner)
+
+    def _rounds(self, ids, new_tokens: int, t_out, t_caches, d_caches,
+                known: list, base: int, prompt_len: int,
+                prefixed: bool):
+        """The draft-propose / target-verify loop (seeding done): shared
+        verbatim by the dense, prefix-seeded and paged cache paths."""
+        g = self.gamma
+        batch = ids.shape[0]
         pending = np.asarray(
             jnp.argmax(t_out[:, -1].astype(jnp.float32), -1),
             np.int32)                       # [B] first continuation token
         syncs = 1                           # the first-token readback
         n_suffix = len(known)    # known = suffix tokens ++ emissions,
         known.append(pending)    # sitting at positions [d_floor, ...)
-        d_floor = base if prefix else prompt_len
+        d_floor = base if prefixed else prompt_len
         n_emitted = 1
         t_pos = prompt_len   # target cache rows [0, t_pos) are committed
         d_pos = d_floor      # draft cache rows [0, d_pos) are committed

@@ -397,6 +397,11 @@ class AdmissionController:
             "(absent series when no budget is configured)")
         if self.token_budget is not None:
             self.m_tokens_free.set(self.token_budget)
+        self.m_step_grants = reg.counter(
+            "pipeedge_admission_step_grants_total",
+            "queued tickets granted by a decode-step notify_step pass "
+            "(iteration-level joins, not release-driven ones)")
+        self.m_step_grants.declare()
 
     # -- policy helpers ---------------------------------------------------
 
@@ -557,6 +562,31 @@ class AdmissionController:
             if completed:
                 self.estimator.observe(now)
             self._grant_locked(now, to_wake, expired)
+        for t in expired:
+            self.m_shed.inc(**{"class": t.request_class,
+                               "reason": "expired"})
+            t.event.set()
+        for t in to_wake:
+            t.event.set()
+
+    def notify_step(self, now: Optional[float] = None) -> None:
+        """Re-run the grant pass at a decode-step boundary (the
+        executors' `on_step` hook, tools/serve.py). Slots and tokens
+        free when `release` runs, but a token-budget head-of-line wait
+        can also unblock when the STEP-granular picture changes (an
+        expired waiter sheds, a clamp lands); stepping the grant pass
+        here makes admission joinable at iteration boundaries instead
+        of request boundaries — and costs one short lock when nothing
+        changed. Counted by `pipeedge_admission_step_grants_total`."""
+        now = time.monotonic() if now is None else now
+        to_wake: List[_Ticket] = []
+        expired: List[_Ticket] = []
+        with self._lock:
+            if self._closed:
+                return
+            self._grant_locked(now, to_wake, expired)
+        if to_wake:
+            self.m_step_grants.inc(len(to_wake))
         for t in expired:
             self.m_shed.inc(**{"class": t.request_class,
                                "reason": "expired"})

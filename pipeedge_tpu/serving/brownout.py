@@ -10,7 +10,10 @@ first — instead of letting the overload degrade everyone uniformly:
 | 1     | no_speculative    | speculative requests run plain greedy      |
 |       |                   | (token-identical; frees the draft model's  |
 |       |                   | serialized dispatch + cache memory)        |
-| 2     | clamp_tokens      | new_tokens clamped to `clamp_new_tokens`   |
+| 2     | clamp_tokens      | new_tokens clamped to `clamp_new_tokens`;  |
+|       |                   | chunked-prefill chunk size clamped to      |
+|       |                   | `clamp_chunk_tokens` when that lever is    |
+|       |                   | armed (shorter pipeline holds per chunk)   |
 | 3     | evict_cold_pages  | reclaim cached-but-idle prefix KV pages    |
 |       |                   | (the paged-KV trie's cold pages — capacity |
 |       |                   | only future requests would miss, spent     |
@@ -80,14 +83,22 @@ class BrownoutLadder:
     def __init__(self, marks: Optional[Watermarks] = None,
                  max_level: int = MAX_LEVEL,
                  clamp_new_tokens: int = 16,
+                 clamp_chunk_tokens: int = 0,
                  registry: Optional[prom.Registry] = None):
         if not 0 <= max_level <= MAX_LEVEL:
             raise ValueError(f"max_level must be in [0, {MAX_LEVEL}]")
         if clamp_new_tokens < 1:
             raise ValueError("clamp_new_tokens must be >= 1")
+        if clamp_chunk_tokens < 0:
+            raise ValueError("clamp_chunk_tokens must be >= 0")
         self.marks = marks if marks is not None else Watermarks()
         self.max_level = int(max_level)
         self.clamp_new_tokens = int(clamp_new_tokens)
+        # the clamp_tokens rung's SECOND lever (0 = not armed): shrink
+        # the chunked-prefill chunk size while hot, so prompt ingress
+        # yields more step boundaries to waiting decode steps
+        # (tools/serve.py's governor applies it via set_chunk_tokens)
+        self.clamp_chunk_tokens = int(clamp_chunk_tokens)
         self._stepped = 0       # watermark-driven rung
         self._floor = 0         # lifecycle-driven minimum (healing >= 1)
         self._hot_since: Optional[float] = None
@@ -184,6 +195,18 @@ class BrownoutLadder:
             return min(int(new_tokens), self.clamp_new_tokens)
         return int(new_tokens)
 
+    def clamp_chunk(self, chunk_tokens: int) -> int:
+        """Level >= 2 with the lever armed: the chunked-prefill chunk
+        size shrinks to `clamp_chunk_tokens` so each prompt chunk holds
+        the pipeline for less time — more step boundaries per second
+        for the decode steps already in flight. Identity when chunking
+        is off (chunk_tokens == 0 stays 0: clamping would ENABLE
+        chunking, a semantic change, not a degradation)."""
+        if (self.level >= 2 and self.clamp_chunk_tokens
+                and chunk_tokens > 0):
+            return min(int(chunk_tokens), self.clamp_chunk_tokens)
+        return int(chunk_tokens)
+
     def allow_disaggregate(self) -> bool:
         """Level >= 4 (`colocate_prefill`): stop shipping prompt passes
         to the remote prefill fleet — run them colocated in the decode
@@ -204,4 +227,8 @@ class BrownoutLadder:
                 "evicting": self.level >= EVICT_LEVEL
                 and self.evict_hook is not None,
                 "clamp_new_tokens": (self.clamp_new_tokens
-                                     if self.level >= 2 else None)}
+                                     if self.level >= 2 else None),
+                "clamp_chunk_tokens": (self.clamp_chunk_tokens
+                                       if self.level >= 2
+                                       and self.clamp_chunk_tokens
+                                       else None)}

@@ -312,6 +312,22 @@ def test_arrival_offsets_seeded_and_shaped():
         arrival_offsets(1, 1.0, "bursty")
 
 
+def test_parse_burst_spec():
+    from tools.loadgen import parse_burst_spec
+    assert parse_burst_spec(None) is None
+    assert parse_burst_spec("0.5:3:48") == {
+        "at": 0.5, "n": 3, "len": 48, "window_s": 2.0}
+    assert parse_burst_spec("0.25:2:32:4.5") == {
+        "at": 0.25, "n": 2, "len": 32, "window_s": 4.5}
+    # dicts pass through (run_load callers hand the parsed form in)
+    spec = {"at": 0.5, "n": 1, "len": 8, "window_s": 2.0}
+    assert parse_burst_spec(spec) is spec
+    for bad in ("1.5:3:48", "0.5:0:48", "0.5:3:0", "0.5:3", "x:y:z",
+                "0.5:3:48:0"):
+        with pytest.raises(ValueError):
+            parse_burst_spec(bad)
+
+
 def test_parse_exemplars_roundtrip():
     from pipeedge_tpu.telemetry import metrics as prom
     reg = prom.Registry()

@@ -891,24 +891,36 @@ def test_kv_server_streaming_and_metrics(kv_server):
     assert wire_line and float(wire_line[0].rsplit(" ", 1)[1]) > 0
 
 
-def test_kv_pages_draft_model_rejected_at_parse_time():
-    """--kv-pages + --draft-model is refused AT PARSE TIME, in
-    milliseconds, with BOTH flags named — not after minutes of weight
-    loading, and not as _Service's bare mid-construction ValueError
-    (ISSUE 15 satellite)."""
+def test_chunked_prefill_without_kv_pages_rejected_at_parse_time():
+    """--chunked-prefill without --kv-pages is refused AT PARSE TIME,
+    in milliseconds, with both flags named (ISSUE 16 satellite: chunk
+    waves write prompt spans at an offset into a page table — dense
+    slots have no such path)."""
     t0 = time.monotonic()
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "serve.py"),
-         "-m", MODEL, "--kv-pages", "8", "--draft-model", MODEL,
+         "-m", MODEL, "--chunked-prefill", "8",
          "--port", str(_free_port())],
         capture_output=True, text=True, timeout=60,
         env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO))
     took = time.monotonic() - t0
     assert proc.returncode == 2          # argparse usage error
-    assert "--kv-pages" in proc.stderr and "--draft-model" in proc.stderr
-    assert "speculative" in proc.stderr  # says WHY, not just "no"
+    assert "--chunked-prefill" in proc.stderr \
+        and "--kv-pages" in proc.stderr
     # parse-time means no model was built (interpreter startup only)
     assert took < 30, f"flag validation took {took:.1f}s — a model build?"
+
+
+def test_prefill_budget_without_chunked_rejected_at_parse_time():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve.py"),
+         "-m", MODEL, "--kv-pages", "8", "--prefill-budget", "4",
+         "--port", str(_free_port())],
+        capture_output=True, text=True, timeout=60,
+        env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO))
+    assert proc.returncode == 2
+    assert "--prefill-budget" in proc.stderr \
+        and "--chunked-prefill" in proc.stderr
 
 
 def test_disaggregate_without_kv_pages_rejected_at_parse_time():
@@ -920,3 +932,73 @@ def test_disaggregate_without_kv_pages_rejected_at_parse_time():
         env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO))
     assert proc.returncode == 2
     assert "--disaggregate" in proc.stderr and "--kv-pages" in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# continuous batching + chunked prefill + paged speculative (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def chunked_server():
+    """Iteration-level scheduling on: prompts longer than 6 tokens run
+    as 6-token chunk waves interleaved with decode steps, and the
+    admission queue is re-driven at every step boundary."""
+    yield from _spawn_server(("--kv-pages", "48", "--kv-page-size", "4",
+                              "--chunked-prefill", "6", "--step-join"))
+
+
+def test_chunked_server_tokens_match_solo(chunked_server, solo_pipe):
+    """Long prompts served through chunked prefill are token-identical
+    to the solo pipeline, and the healthz scheduler block proves chunk
+    waves actually ran."""
+    port = chunked_server
+    rng = np.random.default_rng(57)
+    for plen, nt, kw in ((20, 6, {}), (17, 5, {"temperature": 0.8,
+                                               "seed": 3})):
+        ids = rng.integers(0, 100, size=(1, plen)).tolist()
+        got = _post(port, "/generate",
+                    {"ids": ids, "new_tokens": nt, **kw})["ids"]
+        np.testing.assert_array_equal(
+            np.asarray(got),
+            np.asarray(solo_pipe.generate(np.asarray(ids), nt, **kw)))
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=30) as resp:
+        serving = json.loads(resp.read())["serving"]
+    sched = serving["scheduler"]
+    assert sched["chunked_prefill"] == 6 and sched["step_join"] is True
+    assert sched["chunk_tokens"] == 6      # brownout lever unarmed
+    assert sched["prefill_chunks"] >= 2    # both prompts chunked
+    # idle: every page back (free + trie-cached)
+    kv = serving["kv"]
+    assert kv["pool"]["pages_free"] + kv["prefix"]["pages_cached"] == 48
+
+
+@pytest.fixture(scope="module")
+def spec_kv_server():
+    """--draft-model + --kv-pages now compose (ISSUE 16): speculative
+    draft/verify caches are paged onto the pool plane — the target's
+    rounds reserve from the decode pool, the draft from its own."""
+    yield from _spawn_server(("--kv-pages", "48", "--kv-page-size", "4",
+                              "--draft-model", MODEL, "--gamma", "2"))
+
+
+def test_speculative_over_paged_kv_matches_plain(spec_kv_server,
+                                                 solo_pipe):
+    port = spec_kv_server
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=30) as resp:
+        assert json.loads(resp.read())["speculative"] is True
+    rng = np.random.default_rng(41)
+    ids = rng.integers(0, 100, size=(1, 7)).tolist()
+    want = np.asarray(solo_pipe.generate(np.asarray(ids), 6))
+    got = _post(port, "/generate", {"ids": ids, "new_tokens": 6,
+                                    "speculative": True})["ids"]
+    np.testing.assert_array_equal(np.asarray(got), want)
+    # plain requests share the same pool and stay identical too
+    got_p = _post(port, "/generate", {"ids": ids, "new_tokens": 6})["ids"]
+    np.testing.assert_array_equal(np.asarray(got_p), want)
+    # idle: the speculative rounds returned every page they reserved
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=30) as resp:
+        kv = json.loads(resp.read())["serving"]["kv"]
+    assert kv["pool"]["pages_free"] + kv["prefix"]["pages_cached"] == 48

@@ -74,6 +74,11 @@ METRIC_DEFAULTS: Dict[str, Tuple[int, float]] = {
     "serve.goodput_rps": (+1, 0.20),
     "serve.slo_attainment": (+1, 0.15),
     "serve.shed.error": (-1, 0.0),
+    "kv.errors": (-1, 0.0),
+    "kv.decode_p99_ms": (-1, 0.50),
+    "kv.chunked.burst_decode_p99_ms": (-1, 0.50),
+    "kv.chunked.goodput_rps": (+1, 0.20),
+    "kv.chunked.attainment": (+1, 0.15),
 }
 
 
@@ -102,6 +107,15 @@ def extract_metrics(record: dict) -> Dict[str, float]:
     for cls, val in (serve.get("slo_attainment") or {}).items():
         put(f"serve.slo_attainment.{cls}", val)
     put("serve.shed.error", (serve.get("shed") or {}).get("error"))
+    kv = record.get("kv") or {}
+    put("kv.errors", kv.get("errors"))
+    for phase, val in (kv.get("decode_p99_ms") or {}).items():
+        put(f"kv.decode_p99_ms.{phase}", val)
+    chunked = kv.get("chunked") or {}
+    put("kv.chunked.burst_decode_p99_ms",
+        chunked.get("burst_decode_p99_ms"))
+    put("kv.chunked.goodput_rps", chunked.get("goodput_rps"))
+    put("kv.chunked.attainment", chunked.get("attainment"))
     return out
 
 

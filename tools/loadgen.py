@@ -156,6 +156,33 @@ def parse_prompt_spec(s):
         "shared:PFX:TOTAL[:POOL]")
 
 
+def parse_burst_spec(s):
+    """`--burst AT:N:LEN[:WINDOW]` -> spec dict (None passes through).
+
+    At fraction AT of the run (0..1), N interactive requests with
+    LEN-token prompts launch back-to-back — a seeded long-prompt spike
+    riding an otherwise steady run. The spike's own outcomes/latencies
+    report under `burst`; served steady-state requests launched inside
+    the WINDOW seconds after the spike (default 2.0) report separately
+    as `burst.during_ms` — the decode-latency-under-burst number the
+    chunked-prefill A/B compares (docs/SERVING.md)."""
+    if s is None or isinstance(s, dict):
+        return s
+    parts = str(s).split(":")
+    try:
+        if len(parts) in (3, 4):
+            at, cnt, ln = float(parts[0]), int(parts[1]), int(parts[2])
+            window = float(parts[3]) if len(parts) == 4 else 2.0
+            if 0.0 <= at <= 1.0 and cnt >= 1 and ln >= 1 and window > 0:
+                return {"at": at, "n": cnt, "len": ln,
+                        "window_s": window}
+    except ValueError:
+        pass
+    raise ValueError(
+        f"bad --burst {s!r}: expected AT:N:LEN[:WINDOW_S] "
+        "with AT a fraction in [0, 1]")
+
+
 def spec_max_len(spec) -> int:
     """Longest prompt a spec can emit (capacity/calibration sizing)."""
     spec = parse_prompt_spec(spec)
@@ -186,10 +213,14 @@ def prompt_ids(spec, rng, base_seed: int):
 class _Stats:
     """Per-class outcome/latency accumulator (one lock, short holds)."""
 
-    def __init__(self, classes):
+    def __init__(self, classes, during_window=None):
         self._lock = make_lock("loadgen.stats")
         self.counts = {c: dict.fromkeys(OUTCOMES, 0) for c in classes}
         self.latencies = {c: [] for c in classes}     # ok + ok_late, ms
+        # served latencies of requests LAUNCHED inside [lo, hi] seconds
+        # from start — the burst spike's blast-radius window
+        self.during_window = during_window
+        self.during = []
         # per-class worst-N (latency_ms, rid) of served requests: the
         # cross-reference from a bench run into trace_report --request
         # and the flight recorder's postmortem bundles
@@ -202,11 +233,15 @@ class _Stats:
         self.first_error = None
 
     def record(self, cls, outcome, latency_ms=None, retry_after=None,
-               error=None, rid=None):
+               error=None, rid=None, offset=None):
         with self._lock:
             self.counts[cls][outcome] += 1
             if latency_ms is not None:
                 self.latencies[cls].append(latency_ms)
+                if (self.during_window is not None and offset is not None
+                        and self.during_window[0] <= offset
+                        <= self.during_window[1]):
+                    self.during.append(latency_ms)
                 if rid is not None:
                     w = self.worst[cls]
                     w.append((latency_ms, rid))
@@ -256,7 +291,7 @@ def arrival_offsets(n, qps, arrival="uniform", rng=None):
 
 
 def _one_request(url, cls, slo_ms, deadline_ms, new_tokens, prompt_spec,
-                 timeout, stats, rng_seed, base_seed):
+                 timeout, stats, rng_seed, base_seed, offset=None):
     rng = random.Random(rng_seed)
     ids = [prompt_ids(prompt_spec, rng, base_seed)]
     body = {"ids": ids, "new_tokens": new_tokens, "class": cls}
@@ -272,7 +307,7 @@ def _one_request(url, cls, slo_ms, deadline_ms, new_tokens, prompt_spec,
     rid = resp.get("rid") if isinstance(resp, dict) else None
     if status == 200:
         outcome = "ok" if (slo_ms is None or ms <= slo_ms) else "ok_late"
-        stats.record(cls, outcome, latency_ms=ms, rid=rid)
+        stats.record(cls, outcome, latency_ms=ms, rid=rid, offset=offset)
     elif status == 503 and resp.get("shed"):
         stats.record(cls, "shed", retry_after=retry_after, rid=rid)
     elif status == 503 and resp.get("degraded"):
@@ -287,13 +322,16 @@ def _one_request(url, cls, slo_ms, deadline_ms, new_tokens, prompt_spec,
 def run_load(url, duration_s, qps, mix=None, slo_ms=None,
              deadline_from_slo=True, new_tokens=8, prompt_len=6,
              timeout=120.0, max_inflight=128, seed=0,
-             arrival="uniform"):
+             arrival="uniform", burst=None):
     """Offer `qps` requests/s for `duration_s` with the per-class `mix`;
     return the report dict (see module doc for the outcome taxonomy).
     Importable — the overload acceptance test, the CI smoke, and the
     benchkit serve recipe all call this in-process instead of shelling
     out. `seed` drives EVERYTHING random end-to-end (arrival process,
-    class draw, prompt token sampling) and rides the report."""
+    class draw, prompt token sampling) and rides the report. `burst`
+    (see `parse_burst_spec`) injects a seeded mid-run long-prompt spike
+    whose own outcomes — and the steady-state latencies inside its
+    blast-radius window — report under the `burst` key."""
     mix = dict(DEFAULT_MIX if mix is None else mix)
     unknown = set(mix) - set(REQUEST_CLASSES)
     if unknown:
@@ -303,17 +341,53 @@ def run_load(url, duration_s, qps, mix=None, slo_ms=None,
         raise ValueError("mix weights, qps and duration must be > 0")
     prompt_spec = parse_prompt_spec(prompt_len)
     slo_ms = dict(DEFAULT_SLO_MS if slo_ms is None else slo_ms)
+    burst = parse_burst_spec(burst)
+    burst_at_s = None if burst is None else burst["at"] * duration_s
     classes = sorted(mix)
     weights = [mix[c] / total_w for c in classes]
-    stats = _Stats(classes)
+    stats = _Stats(classes,
+                   during_window=None if burst is None else
+                   (burst_at_s, burst_at_s + burst["window_s"]))
+    # the spike's own accounting stays OUT of the per-class stats: the
+    # steady-state goodput/attainment/latency numbers must measure the
+    # same offered load with and without --burst
+    burst_stats = None if burst is None else _Stats(["interactive"])
+    burst_threads = []
+
+    def _fire_burst():
+        # back-to-back, NOT semaphore-gated: the spike must hit the
+        # server even when the client is at its in-flight cap
+        for j in range(burst["n"]):
+            def bwork(j=j):
+                _one_request(url, "interactive",
+                             slo_ms.get("interactive"),
+                             slo_ms.get("interactive")
+                             if deadline_from_slo else None,
+                             new_tokens,
+                             {"dist": "fixed", "len": burst["len"]},
+                             timeout, burst_stats,
+                             seed * 7907 + j, seed)
+            t = threading.Thread(target=bwork, daemon=True)
+            t.start()
+            burst_threads.append(t)
+
     rng = random.Random(seed)
     inflight = threading.Semaphore(max_inflight)
     threads = []
     n = max(1, int(round(qps * duration_s)))
     offsets = arrival_offsets(n, qps, arrival, rng)
     t0 = time.monotonic()
+    burst_fired = False
     for i in range(n):
         target = t0 + offsets[i]         # open loop: arrivals on the clock
+        if burst is not None and not burst_fired \
+                and target >= t0 + burst_at_s:
+            # the spike launches ON its clock tick, not the next arrival
+            bd = t0 + burst_at_s - time.monotonic()
+            if bd > 0:
+                time.sleep(bd)
+            _fire_burst()
+            burst_fired = True
         delay = target - time.monotonic()
         if delay > 0:
             time.sleep(delay)
@@ -328,14 +402,21 @@ def run_load(url, duration_s, qps, mix=None, slo_ms=None,
             try:
                 _one_request(url, cls, cls_slo, deadline, new_tokens,
                              prompt_spec, timeout, stats,
-                             seed * 100003 + i, seed)
+                             seed * 100003 + i, seed, offset=offsets[i])
             finally:
                 inflight.release()
 
         t = threading.Thread(target=work, daemon=True)
         t.start()
         threads.append(t)
+    if burst is not None and not burst_fired:
+        bd = t0 + burst_at_s - time.monotonic()
+        if bd > 0:
+            time.sleep(bd)
+        _fire_burst()
     for t in threads:
+        t.join(timeout=timeout)
+    for t in burst_threads:
         t.join(timeout=timeout)
     wall = time.monotonic() - t0
     report = {"url": url, "duration_s": round(wall, 3),
@@ -383,6 +464,26 @@ def run_load(url, duration_s, qps, mix=None, slo_ms=None,
     # server-side — the bench-to-bundle cross-reference
     report["deadline_rids"] = stats.deadline_rids
     report["first_error"] = stats.first_error
+    if burst is not None:
+        bc = burst_stats.counts["interactive"]
+        blat = burst_stats.latencies["interactive"]
+        report["burst"] = {
+            "at_s": round(burst_at_s, 3), "n": burst["n"],
+            "prompt_len": burst["len"], "window_s": burst["window_s"],
+            **{k: bc[k] for k in OUTCOMES},
+            # the spike's own end-to-end latencies (long prompt + decode)
+            "latency_ms": {"p50": _percentile(blat, 50),
+                           "p95": _percentile(blat, 95),
+                           "p99": _percentile(blat, 99)},
+            # steady-state served latencies launched inside the blast-
+            # radius window — THE burst-decode number the chunked-
+            # prefill A/B compares
+            "during_ms": {"p50": _percentile(stats.during, 50),
+                          "p95": _percentile(stats.during, 95),
+                          "p99": _percentile(stats.during, 99),
+                          "n": len(stats.during)},
+            "first_error": burst_stats.first_error,
+        }
     return report
 
 
@@ -441,6 +542,13 @@ def main():
                    choices=["uniform", "poisson"],
                    help="arrival process: fixed 1/qps grid or seeded "
                         "exponential gaps (bursty open-loop traffic)")
+    p.add_argument("--burst", default=None, metavar="AT:N:LEN[:WINDOW]",
+                   help="inject a seeded long-prompt spike: at fraction "
+                        "AT of the run, N interactive requests with "
+                        "LEN-token prompts launch back-to-back; the "
+                        "spike's outcomes and the steady-state latency "
+                        "inside the WINDOW-second blast radius (default "
+                        "2.0) ride the JSON line under `burst`")
     p.add_argument("--indent", action="store_true",
                    help="pretty-print instead of the one-line record")
     args = p.parse_args()
@@ -463,13 +571,15 @@ def main():
         deadline_from_slo=not args.no_deadline,
         new_tokens=args.new_tokens, prompt_len=args.prompt_len,
         timeout=args.timeout, max_inflight=args.max_inflight,
-        seed=args.seed, arrival=args.arrival)
+        seed=args.seed, arrival=args.arrival, burst=args.burst)
     if calibrated is not None:
         report["calibrated_capacity_rps"] = round(calibrated, 3)
         report["overload_factor"] = args.overload_factor
     print(json.dumps(report, indent=2 if args.indent else None,
                      sort_keys=True))
-    return 0 if report["totals"]["error"] == 0 else 2
+    errors = report["totals"]["error"] \
+        + report.get("burst", {}).get("error", 0)
+    return 0 if errors == 0 else 2
 
 
 if __name__ == "__main__":

@@ -185,7 +185,9 @@ class _Service:
                  brownout_enabled=True, brownout_marks=None,
                  clamp_new_tokens=16, governor_interval=0.25,
                  postmortem_dir=None, kv_pages=0, kv_page_size=16,
-                 prefill_fleet=None, prefill_supervisor=None):
+                 prefill_fleet=None, prefill_supervisor=None,
+                 chunked_prefill=0, step_join=False,
+                 prefill_budget=None, clamp_chunk_tokens=0):
         from collections import OrderedDict, deque
 
         from pipeedge_tpu.parallel.batcher import (ContinuousBatcher,
@@ -202,13 +204,19 @@ class _Service:
         # stage-time with prefills.
         self.kv_backend = None
         if kv_pages:
-            if spec is not None:
-                raise ValueError("--kv-pages does not compose with "
-                                 "--draft-model (speculative decoding "
-                                 "rides dense draft/verify caches)")
             from pipeedge_tpu.kv import PagedKvBackend
             self.kv_backend = PagedKvBackend(pipe, kv_pages,
                                              kv_page_size)
+            if spec is not None:
+                # page the speculative draft/verify caches onto the
+                # plane: target rounds reserve pages from the SAME pool
+                # decode requests use (one capacity accountant), the
+                # draft model gets its own small pool over its own
+                # pipeline geometry (pipeedge_tpu/parallel/speculative)
+                from pipeedge_tpu.kv.pool import KvPagePool
+                spec.attach_paged(self.kv_backend,
+                                  KvPagePool(spec.draft, kv_pages,
+                                             kv_page_size))
         self.prefill_fleet = prefill_fleet
         self.prefill_supervisor = prefill_supervisor
         self._prefill_unavailable = None
@@ -311,15 +319,31 @@ class _Service:
         # basis of the DERIVED Retry-After when the orchestrator's
         # /degraded post doesn't carry one
         self._heal_s = deque(maxlen=8)
+        # -- iteration-level scheduling knobs (docs/SERVING.md) ---------
+        # chunked_prefill > 0 splits long prompt passes into fixed-token
+        # chunks interleaved with decode steps; step_join wakes the
+        # admission queue at every decode-step boundary so joiners ride
+        # the next tick instead of the next completion. `_on_step` is a
+        # bound closure because the admission controller is constructed
+        # AFTER the executors (it needs their concurrency bound).
+        self.chunked_prefill = int(chunked_prefill)
+        self.step_join = bool(step_join)
         if executor == "stage":
             self.exec = StageWorkerExecutor(pipe, max_active=max_active,
-                                            kv=self.kv_backend)
+                                            kv=self.kv_backend,
+                                            chunk_tokens=self.chunked_prefill,
+                                            step_join=self.step_join,
+                                            on_step=self._on_step)
             self.batcher = None
             self.worker = None
         elif executor == "wave":
             self.exec = None
             self.batcher = ContinuousBatcher(pipe, max_active=max_active,
-                                             kv=self.kv_backend)
+                                             kv=self.kv_backend,
+                                             chunk_tokens=self.chunked_prefill,
+                                             prefill_budget=prefill_budget,
+                                             step_join=self.step_join,
+                                             on_step=self._on_step)
             self.worker = threading.Thread(target=self._loop, daemon=True)
             self.worker.start()
         else:
@@ -353,7 +377,8 @@ class _Service:
         if brownout_enabled:
             self.brownout = BrownoutLadder(
                 brownout_marks if brownout_marks is not None
-                else Watermarks(), clamp_new_tokens=clamp_new_tokens)
+                else Watermarks(), clamp_new_tokens=clamp_new_tokens,
+                clamp_chunk_tokens=clamp_chunk_tokens)
             if self.kv_backend is not None:
                 # the evict_cold_pages rung's lever: reclaim cached-but-
                 # idle prefix pages before any request class is shed
@@ -365,6 +390,17 @@ class _Service:
                                               daemon=True,
                                               name="brownout-governor")
             self._governor.start()
+
+    def _on_step(self):
+        """Executor decode-step hook (--step-join): re-drive the EDF
+        admission queue at every step boundary, so a joiner whose slot
+        or token charge just freed is granted mid-request instead of
+        waiting out the whole completion. Cheap no-op when the queue is
+        empty; tolerant of construction order (the executors exist
+        before the admission controller does)."""
+        adm = getattr(self, "admission", None)
+        if adm is not None:
+            adm.notify_step()
 
     def _loop(self):
         while True:
@@ -443,10 +479,18 @@ class _Service:
                else self.batcher._live_rids)
         for _ in range(3):
             try:
-                return set(src)
+                live = set(src)
+                break
             except RuntimeError:     # set mutated during copy
                 continue
-        return None
+        else:
+            return None
+        if self.spec is not None:
+            # paged speculative rounds reserve pages from the decode
+            # plane's pool under their own owner ids — union them in so
+            # a mid-generate speculative request survives the sweep
+            live |= self.spec.live_rids()
+        return live
 
     def _governor_loop(self):
         """Periodic brownout tick: windowed p95 of the request-latency
@@ -490,6 +534,17 @@ class _Service:
                         self.flight.maybe_dump(
                             "slo", context=self.bundle_context())
                     last_level = level
+                if self.chunked_prefill:
+                    # the clamp_tokens rung's second lever: shrink the
+                    # prefill chunk size while hot so decode steps get
+                    # more step boundaries per second (identity when the
+                    # lever is unarmed — clamp_chunk_tokens == 0)
+                    want = self.brownout.clamp_chunk(self.chunked_prefill)
+                    ex = self.exec if self.exec is not None \
+                        else self.batcher
+                    if ex.chunk_tokens != want:
+                        ex.set_chunk_tokens(want)
+                        self.flight.note("chunk_clamp", chunk_tokens=want)
             if self.kv_backend is not None and ticks % sweep_every == 0:
                 # liveness passed as a CALLABLE: the sweep snapshots
                 # the owner ledger FIRST, liveness second — a request
@@ -499,6 +554,11 @@ class _Service:
                     self._live_request_ids)
                 if leaked:
                     self.flight.note("kv_pages_reclaimed", pages=leaked)
+                if self.spec is not None:
+                    d_leaked = self.spec.sweep_orphans()
+                    if d_leaked:
+                        self.flight.note("draft_pages_reclaimed",
+                                         pages=d_leaked)
 
     # -- failover window ------------------------------------------------
 
@@ -716,6 +776,21 @@ class _Service:
             s["admission"] = self.admission.snapshot()
         if self.brownout is not None:
             s["brownout"] = self.brownout.snapshot()
+        if self.chunked_prefill or self.step_join:
+            # iteration-level scheduling state: the configured chunk
+            # size, the EFFECTIVE one (brownout may have clamped it),
+            # and how many chunk waves have run — the serve_kv bench's
+            # chunked-arm evidence (docs/SERVING.md)
+            ex = self.exec if self.exec is not None else self.batcher
+            s["scheduler"] = {
+                "chunked_prefill": self.chunked_prefill,
+                "chunk_tokens": ex.chunk_tokens,
+                "step_join": self.step_join,
+                "prefill_chunks": int(
+                    self.exec.snapshot()["prefill_chunks"]
+                    if self.exec is not None
+                    else self.batcher.stats["prefill_chunks"]),
+            }
         if self.kv_backend is not None:
             s["kv"] = self.kv_backend.snapshot()
             s["kv"]["disaggregated"] = self.prefill_fleet is not None
@@ -753,13 +828,33 @@ class _Service:
                                       parent="serve.speculative")
         released = self.admission is None
         try:
+            strip = 0
+            if self.kv_backend is not None and prefix_id is not None:
+                # paged mode: the prefix becomes prepended tokens BEFORE
+                # the token charge is computed (the page reservation
+                # must cover the full prompt; the trie makes the shared
+                # part nearly free to re-run)
+                with self.cond:
+                    self._check_dead()
+                    self._check_admittable()
+                    pkw = {"prefix_id": prefix_id}
+                    ids, strip = self._expand_prefix(ids, pkw)
+                prefix_id = None
             if ticket is None and self.admission is not None:
-                ticket, _ = self.admit(request_class, deadline_s, rid=rid)
+                # paged speculative rounds reserve up to gamma extra
+                # verify positions past new_tokens — charge for them
+                gamma = self.spec.gamma if self.spec is not None else 0
+                ticket, _ = self.admit(
+                    request_class, deadline_s, rid=rid,
+                    tokens=self.kv_tokens(ids, int(new_tokens) + gamma))
             completed = False
             try:
                 with telemetry.trace_scope(tctx):
                     out = self._generate_speculative_once(ids, new_tokens,
-                                                          prefix_id)
+                                                          prefix_id,
+                                                          rid=rid)
+                    if strip:
+                        out = out[:, strip:]
                 completed = True
             finally:
                 if not released:
@@ -793,7 +888,8 @@ class _Service:
         self._account_edge_bytes(ids, int(new_tokens))
         return out
 
-    def _generate_speculative_once(self, ids, new_tokens, prefix_id):
+    def _generate_speculative_once(self, ids, new_tokens, prefix_id,
+                                   rid=None):
         import numpy as np
         if self.spec is None:
             raise KeyError("server started without --draft-model; "
@@ -811,8 +907,10 @@ class _Service:
                 self.prefixes.move_to_end(prefix_id)   # LRU touch
                 prefix = self.spec_prefixes[prefix_id]
         with self.spec_lock, telemetry.span("serve", "speculative"):
+            # rid threads through to the paged allocator as the page
+            # owner id, so the governor's orphan sweep can name it
             return np.asarray(self.spec.generate(ids, new_tokens,
-                                                 prefix=prefix))
+                                                 prefix=prefix, rid=rid))
 
     def prevalidate(self, ids, new_tokens, kw):
         """Resolve prefix_id and run the full admission validation WITHOUT
@@ -1665,6 +1763,22 @@ def main():
                         "per-request cache slots (the historical mode)")
     p.add_argument("--kv-page-size", default=16, type=int,
                    help="cache positions per KV page")
+    p.add_argument("--chunked-prefill", default=0, type=int, metavar="N",
+                   help="split prompt passes longer than N tokens into "
+                        "N-token chunks interleaved with decode steps "
+                        "at every executor step boundary (needs "
+                        "--kv-pages; bounds decode-step latency under "
+                        "long-prompt bursts). 0 = run-to-completion "
+                        "prefill (the historical mode)")
+    p.add_argument("--prefill-budget", default=None, type=int,
+                   metavar="TOKENS",
+                   help="prompt tokens the wave executor may start per "
+                        "decode step when chunking (default: the chunk "
+                        "size — one chunk per step)")
+    p.add_argument("--step-join", action="store_true",
+                   help="re-drive the admission queue at every decode-"
+                        "step boundary, so queued requests join mid-"
+                        "generation instead of at the next completion")
     p.add_argument("--disaggregate", default="off",
                    choices=["off", "local", "wire", "process"],
                    help="split serving into a prefill fleet and a decode "
@@ -1730,6 +1844,11 @@ def main():
     p.add_argument("--brownout-dwell-down", default=2.0, type=float)
     p.add_argument("--brownout-clamp-tokens", default=16, type=int,
                    help="new_tokens clamp at brownout level >= 2")
+    p.add_argument("--brownout-clamp-chunk", default=0, type=int,
+                   metavar="TOKENS",
+                   help="chunked-prefill chunk-size clamp at brownout "
+                        "level >= 2 (0 = lever unarmed; only applies "
+                        "with --chunked-prefill)")
     p.add_argument("--governor-interval", default=0.25, type=float,
                    help="brownout governor tick (s)")
     p.add_argument("--trace-spans", default=None, metavar="OUT",
@@ -1752,16 +1871,19 @@ def main():
     # flag pair fails in milliseconds with both flags named, not after
     # minutes of weight loading (and never as a bare mid-construction
     # refusal from _Service)
-    if args.draft_model and args.kv_pages:
-        p.error("--kv-pages does not compose with --draft-model: "
-                "speculative decoding rides dense draft/verify caches, "
-                "which the paged KV plane replaces — drop --draft-model "
-                "to serve paged, or drop --kv-pages to serve "
-                "speculatively (ROADMAP item 2 tracks paging the "
-                "speculative caches)")
     if args.disaggregate != "off" and not args.kv_pages:
         p.error("--disaggregate needs --kv-pages (shipped KV lands in "
                 "the paged pool)")
+    if args.chunked_prefill < 0:
+        p.error("--chunked-prefill must be >= 0")
+    if args.chunked_prefill and not args.kv_pages:
+        p.error("--chunked-prefill needs --kv-pages (chunk waves write "
+                "prompt spans at an offset into the request's page "
+                "table; dense cache slots have no span-at-offset path)")
+    if args.prefill_budget is not None and not args.chunked_prefill:
+        p.error("--prefill-budget only applies with --chunked-prefill")
+    if args.prefill_budget is not None and args.prefill_budget < 1:
+        p.error("--prefill-budget must be >= 1")
 
     from pipeedge_tpu.utils import apply_env_platform
     apply_env_platform()
@@ -1873,7 +1995,11 @@ def main():
                        kv_pages=args.kv_pages,
                        kv_page_size=args.kv_page_size,
                        prefill_fleet=prefill_fleet,
-                       prefill_supervisor=prefill_supervisor)
+                       prefill_supervisor=prefill_supervisor,
+                       chunked_prefill=args.chunked_prefill,
+                       step_join=args.step_join,
+                       prefill_budget=args.prefill_budget,
+                       clamp_chunk_tokens=args.brownout_clamp_chunk)
     if prefill_fleet is not None and hasattr(prefill_fleet,
                                              "flight_note"):
         # ship-plane faults (lease timeouts, zombie drops, worker
