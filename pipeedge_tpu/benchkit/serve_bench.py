@@ -21,6 +21,7 @@ the one-command "explain this p99" follow-up.
 """
 from __future__ import annotations
 
+import json
 import os
 import signal
 import socket
@@ -57,6 +58,13 @@ def _serve_args(p) -> None:
     p.add_argument("--max-active", type=int, default=1,
                    help="execution slots (1 pins capacity so the "
                         "overload factor is deterministic)")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="decode replicas behind a `--role router` "
+                        "front-end (serving/router.py); 1 = the classic "
+                        "single-process server. The 1-vs-2 A/B arms of "
+                        "one overload run are the routed fleet's "
+                        "capacity-scaling record (use --scenario-suffix "
+                        "to keep both arms in one artifact)")
     p.add_argument("--queue-capacity", type=int, default=16)
     p.add_argument("--overload-factor", type=float, default=3.0,
                    help="offered load as a multiple of the calibrated "
@@ -124,6 +132,14 @@ def _setup(args) -> dict:
     cmd += list(getattr(args, "extra_serve_args", ()))
     if args.postmortem_dir:
         cmd += ["--postmortem-dir", args.postmortem_dir]
+    replicas = getattr(args, "replicas", 1)
+    if replicas > 1:
+        # the routed arm: same knobs, but serve.py becomes a router
+        # front-end forwarding them to `replicas` supervised replica
+        # processes (each gets its own --max-active slots, so capacity
+        # scales with the fleet)
+        cmd += ["--role", "router", "--replicas", str(replicas),
+                "--router-poll-interval", "0.3"]
     env = dict(os.environ, PYTHONPATH=REPO)
     env.setdefault("JAX_PLATFORMS", "cpu")
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
@@ -147,6 +163,22 @@ def _setup(args) -> dict:
         else:
             raise RuntimeError("serve.py never became healthy "
                                f"within {args.startup_timeout}s")
+        if replicas > 1:
+            # warm EVERY replica directly: the router's deterministic
+            # least-loaded tie-break would otherwise leave replica 2+
+            # cold and fold its first XLA compile into the measured
+            # overload window
+            with urllib.request.urlopen(f"{state['url']}/healthz",
+                                        timeout=10) as resp:
+                fleet = json.loads(resp.read())["fleet"]
+            payload = json.dumps({"ids": [7] * args.prompt_len,
+                                  "new_tokens": args.new_tokens}).encode()
+            for rep in fleet.values():
+                req = urllib.request.Request(
+                    f"{rep['url']}/generate", data=payload,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=300) as resp:
+                    resp.read()
     except BaseException:
         _teardown(state)     # SIGTERM + reap (kill on a wedged server)
         raise
@@ -247,6 +279,7 @@ def _run(args, state) -> dict:
             "requests": report["requests"],
             "calibrated_capacity_rps": report["calibrated_capacity_rps"],
             "overload_factor": factors[-1],
+            "replicas": getattr(args, "replicas", 1),
             "overload_curve": curve,
             "retry_after": report["retry_after"],
             "deadline_rids": report["deadline_rids"],

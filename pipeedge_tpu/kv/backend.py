@@ -326,6 +326,97 @@ class PagedKvBackend:
         self.trie.insert(ks["tokens"][:full * self.page_size],
                          ks["table"][0][:full].tolist())
 
+    # -- prefix migration (router drain — docs/FAULT_TOLERANCE.md) -------
+
+    def export_prefix(self, tokens, bits: int = 0):
+        """Snapshot the trie's cached pages for this prompt prefix as
+        wire-v2 ship frames (kv/ship.py) — the router's drain path ships
+        these to a survivor replica instead of re-prefilling there.
+        Returns `(frames, tokens_covered, n_pages)` or `None` when the
+        trie holds nothing for the prefix (or is unarmed / the cache is
+        int8 — quantized caches don't ship exactly)."""
+        if self.trie is None:
+            return None
+        toks = [int(t) for t in tokens]
+        pids = self.trie.lookup(toks, max_tokens=len(toks))
+        if not pids:
+            return None
+        try:
+            plen = len(pids) * self.page_size
+            table = np.asarray([pids], np.int32)
+            caches = []
+            with telemetry.span("kv", "export", mb=None):
+                with self._arena_lock:
+                    for i in range(self._n_stages):
+                        view = self.pool.gather(i, table)
+                        if set(view) != {"k", "v"}:
+                            return None       # int8 cache: not shippable
+                        caches.append(view)
+                from . import ship
+                # prefix export carries no sampling decision — the
+                # logits slot is a placeholder the importer ignores
+                frames = ship.encode_kv_ship(
+                    caches, plen, np.zeros((1, 1), np.float32), bits=bits)
+            return frames, plen, len(pids)
+        finally:
+            # lookup took one reference per matched page for us; the
+            # trie's own retention references keep the pages cached
+            self.pool.release(pids)
+
+    def install_prefix(self, tokens, handle) -> int:
+        """Land a peer replica's exported prefix into this pool + trie
+        (the receive side of `export_prefix`): alloc pages, scatter the
+        shipped rows, publish to the trie. Idempotent — a prefix the
+        trie already covers installs zero pages. Returns pages
+        installed."""
+        if self.trie is None:
+            raise ValueError("prefix install needs the prefix trie "
+                             "(share_prefixes)")
+        toks = [int(t) for t in tokens]
+        plen = int(handle["prompt_len"])
+        rows = handle["stage_rows"]
+        if plen % self.page_size or plen > len(toks) or plen <= 0:
+            raise ValueError(
+                f"shipped prefix covers {plen} tokens; expected a "
+                f"positive multiple of page_size {self.page_size} "
+                f"within the {len(toks)}-token prefix")
+        if len(rows) != self._n_stages:
+            raise ValueError(f"shipped prefix has {len(rows)} stages; "
+                             f"this pipeline has {self._n_stages}")
+        toks = toks[:plen]
+        if self.trie.peek(toks, max_tokens=plen) >= plen:
+            return 0        # already cached here: nothing to install
+        n = plen // self.page_size
+        pids = self.pool.alloc(n)
+        try:
+            table = np.asarray([pids], np.int32)
+            writes = [(0, j) for j in range(n)]
+            with telemetry.span("kv", "import"):
+                with self._arena_lock:
+                    for i in range(self._n_stages):
+                        view = self.pool.gather(i, table)
+                        if set(rows[i]) != set(view):
+                            raise ValueError(
+                                f"shipped prefix leaves "
+                                f"{sorted(rows[i])} do not match this "
+                                f"pipeline's cache leaves "
+                                f"{sorted(view)}")
+                        for name, arr in rows[i].items():
+                            arr = jnp.asarray(arr).astype(
+                                view[name].dtype)
+                            view[name] = view[name].at[
+                                :, :, :plen].set(arr)
+                        self.pool.scatter(i, table, view, writes)
+            # insert adds the trie's retention refs for NEW nodes; pages
+            # duplicating an existing node stay ours alone and die with
+            # the release below
+            self.trie.insert(toks, pids)
+        except BaseException:
+            self.pool.release(pids)
+            raise
+        self.pool.release(pids)     # drop the alloc ref; trie refs live on
+        return n
+
     # -- completion / pressure -------------------------------------------
 
     def release(self, req) -> None:

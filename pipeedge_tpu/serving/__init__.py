@@ -21,16 +21,28 @@ tools/serve.py the three mechanisms that bound the damage:
   (`parallel/batcher.py`): each request's absolute deadline rides into
   the decode loop, and expiry fires the existing `cancel` flag at the
   next decode-step boundary so dead work stops consuming TPU time.
+- `router`: the routed decode fleet's front end (`--role router`) — a
+  health-checked replica registry with EWMA-scored hysteresis
+  (healthy→suspect→drained→dead), prefix-affinity routing, bounded
+  retry/failover, tail hedging, graceful drain with KV page migration
+  over the ship codec (`DecodeRouter`, `ReplicaRegistry`,
+  `RouterPolicy` — docs/SERVING.md router topology).
 """
 from .admission import (AdmissionController, AdmissionShed, ClassPolicy,
                         DeadlineExceeded, EDFQueue, REQUEST_CLASSES,
                         ServiceRateEstimator, TokenBucket, default_policies,
                         parse_class_map)
 from .brownout import BrownoutLadder, LEVEL_NAMES, Watermarks
+from .router import (DecodeRouter, NoReplicaAvailable,  # noqa: F401
+                     REPLICA_DEAD, REPLICA_DRAINED, REPLICA_HEALTHY,
+                     REPLICA_SUSPECT, ReplicaRegistry, RouterPolicy)
 
 __all__ = [
     "AdmissionController", "AdmissionShed", "BrownoutLadder",
-    "ClassPolicy", "DeadlineExceeded", "EDFQueue", "LEVEL_NAMES",
-    "REQUEST_CLASSES", "ServiceRateEstimator", "TokenBucket",
-    "Watermarks", "default_policies", "parse_class_map",
+    "ClassPolicy", "DeadlineExceeded", "DecodeRouter", "EDFQueue",
+    "LEVEL_NAMES", "NoReplicaAvailable", "REPLICA_DEAD",
+    "REPLICA_DRAINED", "REPLICA_HEALTHY", "REPLICA_SUSPECT",
+    "REQUEST_CLASSES", "ReplicaRegistry", "RouterPolicy",
+    "ServiceRateEstimator", "TokenBucket", "Watermarks",
+    "default_policies", "parse_class_map",
 ]

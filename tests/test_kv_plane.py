@@ -633,3 +633,70 @@ def test_shipped_install_publishes_prefix(pipe):
         assert kv.trie.stats()["pages_reused_total"] > 0
     finally:
         ex.stop()
+
+
+# ---------------------------------------------------------------------------
+# replica-to-replica prefix migration (the router drain path, ISSUE 17)
+# ---------------------------------------------------------------------------
+
+def test_export_install_prefix_roundtrip_token_identical(pipe):
+    """A warm prefix exported from one backend and installed into a
+    fresh one serves the SAME tokens there — the drain migration's
+    correctness gate (router /kv/export -> ship codec -> /kv/import)."""
+    be_a = _backend(pipe, n_pages=24, page_size=4)
+    be_b = _backend(pipe, n_pages=24, page_size=4)
+    bat = ContinuousBatcher(pipe, kv=be_a)
+    ids = (np.arange(5, 17) % 50)[None, :]     # 12 tokens = 3 full pages
+    bat.submit("warm", ids, new_tokens=4)
+    out = np.asarray(bat.run()["warm"])
+
+    toks = ids[0].tolist()
+    frames, plen, pages = be_a.export_prefix(toks)
+    assert plen == 12 and pages == 3
+    # the export takes no lasting references: A's accounting unchanged
+    assert be_a.pool.free_pages + be_a.trie.stats()["pages_cached"] \
+        == be_a.pool.n_pages
+
+    blob = ship_mod.frames_to_bytes(frames)
+    handle = ship_mod.decode_kv_ship(ship_mod.frames_from_bytes(blob),
+                                     pipe.dtype)
+    assert be_b.install_prefix(toks, handle) == pages
+    assert be_b.install_prefix(toks, handle) == 0      # idempotent
+    assert be_b.pool.free_pages + be_b.trie.stats()["pages_cached"] \
+        == be_b.pool.n_pages
+
+    bat_b = ContinuousBatcher(pipe, kv=be_b)
+    bat_b.submit("rerun", ids, new_tokens=4)
+    out_b = np.asarray(bat_b.run()["rerun"])
+    np.testing.assert_array_equal(out, out_b)
+    # B really served the prompt from the migrated pages (admit's trie
+    # lookup caps at prompt_len - 1, so the last full page recomputes)
+    assert be_b.trie.stats()["pages_reused_total"] >= pages - 1
+
+
+def test_export_unknown_prefix_returns_none(pipe):
+    be = _backend(pipe, n_pages=8, page_size=4)
+    assert be.export_prefix([999, 998, 997, 996]) is None
+
+
+def test_install_prefix_rejects_malformed_without_leaking(pipe):
+    be_a = _backend(pipe, n_pages=24, page_size=4)
+    be_b = _backend(pipe, n_pages=24, page_size=4)
+    bat = ContinuousBatcher(pipe, kv=be_a)
+    ids = (np.arange(30, 42) % 50)[None, :]
+    bat.submit("warm", ids, new_tokens=2)
+    bat.run()
+    toks = ids[0].tolist()
+    frames, plen, pages = be_a.export_prefix(toks)
+    handle = ship_mod.decode_kv_ship(
+        ship_mod.frames_from_bytes(ship_mod.frames_to_bytes(frames)),
+        pipe.dtype)
+    free0 = be_b.pool.free_pages
+    with pytest.raises(ValueError):          # stage-count mismatch
+        be_b.install_prefix(toks, dict(handle,
+                                       stage_rows=handle["stage_rows"][:1]))
+    with pytest.raises(ValueError):          # not page-aligned
+        be_b.install_prefix(toks, dict(handle, prompt_len=plen - 1))
+    with pytest.raises(ValueError):          # covers more than the prefix
+        be_b.install_prefix(toks[:4], handle)
+    assert be_b.pool.free_pages == free0     # nothing leaked

@@ -14,6 +14,32 @@ import pytest
 
 pytestmark = pytest.mark.fleet  # every test here spawns OS processes
 
+
+def _cpu_multiprocess_collectives_available() -> bool:
+    """Whether this jax build can run cross-process collectives on the
+    CPU backend. jax 0.4.x's CPU client has no multiprocess collective
+    implementation (no Gloo/MPI wiring in jaxlib <= 0.4.36): any
+    computation spanning processes — including the jitted psum inside
+    `multihost_utils.broadcast_one_to_all`, which `device_put` onto a
+    process-spanning NamedSharding triggers via assert_equal — dies
+    with `XlaRuntimeError: INVALID_ARGUMENT: Multiprocess computations
+    aren't implemented on the CPU backend.` jax >= 0.5 ships a
+    CpuCollectives/Gloo layer; on such a build this test must run (and
+    the xfail below turns into a hard failure via strict=True +
+    condition)."""
+    import jax
+    major, minor = (int(v) for v in jax.__version__.split(".")[:2])
+    return (major, minor) >= (0, 5)
+
+
+@pytest.mark.xfail(
+    condition=not _cpu_multiprocess_collectives_available(),
+    reason="jax 0.4.x CPU backend cannot run multiprocess collectives "
+           "(XlaRuntimeError 'Multiprocess computations aren't "
+           "implemented on the CPU backend' from the broadcast inside "
+           "device_put-to-global-mesh); needs jax >= 0.5's Gloo CPU "
+           "collectives or a real TPU fleet",
+    strict=True, run=True)
 def test_two_process_spmd_pipeline():
     with socket.create_server(("127.0.0.1", 0)) as s:
         coord = f"127.0.0.1:{s.getsockname()[1]}"

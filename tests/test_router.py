@@ -1,0 +1,673 @@
+"""The decode-fleet router's decision logic (pipeedge_tpu/serving/
+router.py): registry lifecycle + hysteresis, prefix-affinity scoring,
+retry/backoff/hedge decisions, stream failover, and the drain state
+machine — all through injected I/O, no sockets (ISSUE 17's unit
+matrix; the process-level acceptance lives in test_router_fleet.py).
+"""
+import threading
+import time
+
+import pytest
+
+from pipeedge_tpu.serving import router as router_mod
+from pipeedge_tpu.serving.router import (DecodeRouter, REPLICA_DEAD,
+                                         REPLICA_DRAINED, REPLICA_HEALTHY,
+                                         REPLICA_SUSPECT, ReplicaRegistry,
+                                         RouterPolicy)
+
+
+def _policy(**kw):
+    kw.setdefault("backoff_s", 0.001)
+    kw.setdefault("backoff_max_s", 0.002)
+    return RouterPolicy(**kw)
+
+
+def _registry(n=2, **kw):
+    reg = ReplicaRegistry(_policy(**kw))
+    for i in range(n):
+        reg.add(f"r{i}", f"http://test:{9000 + i}")
+    return reg
+
+
+# ---------------------------------------------------------------------------
+# policy validation
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("bad", [
+    dict(alpha=0.0), dict(alpha=1.5),
+    dict(suspect_threshold=0.2, readmit_threshold=0.4),
+    dict(readmit_threshold=0.0),
+    dict(readmit=0), dict(fail_dead=0),
+    dict(route_retries=-1), dict(latency_bad_s=0),
+    dict(poll_interval_s=0), dict(hedge_ms=-1),
+])
+def test_policy_rejects_nonsense(bad):
+    with pytest.raises(ValueError):
+        RouterPolicy(**bad)
+
+
+# ---------------------------------------------------------------------------
+# registry lifecycle + hysteresis
+# ---------------------------------------------------------------------------
+
+def test_healthy_to_suspect_on_degradation():
+    reg = _registry()
+    assert reg.state_of("r0") == REPLICA_HEALTHY
+    # slow-but-answering polls walk the EWMA up past suspect_threshold
+    for _ in range(3):
+        reg.observe("r0", ok=True, latency_s=10.0)   # d = 1.0 (clamped)
+    assert reg.state_of("r0") == REPLICA_SUSPECT
+    assert reg.score_of("r0") > reg.policy.suspect_threshold
+
+
+def test_hysteresis_band_changes_nothing():
+    """A score oscillating BETWEEN readmit and suspect thresholds must
+    not flap the state in either direction."""
+    pol = _policy(alpha=1.0, suspect_threshold=0.6, readmit_threshold=0.2)
+    reg = ReplicaRegistry(pol)
+    reg.add("r0", "u")
+    reg.observe("r0", ok=True, latency_s=0.7)     # score 0.7 -> suspect
+    assert reg.state_of("r0") == REPLICA_SUSPECT
+    for _ in range(10):
+        reg.observe("r0", ok=True, latency_s=0.4)  # in the band
+    assert reg.state_of("r0") == REPLICA_SUSPECT   # no readmit
+    reg2 = ReplicaRegistry(pol)
+    reg2.add("r0", "u")
+    for _ in range(10):
+        reg2.observe("r0", ok=True, latency_s=0.4)  # band from healthy
+    assert reg2.state_of("r0") == REPLICA_HEALTHY   # no demotion
+
+
+def test_readmit_needs_consecutive_clean_polls():
+    reg = _registry(readmit=3)
+    for _ in range(3):
+        reg.observe("r0", ok=False)
+    assert reg.state_of("r0") == REPLICA_DEAD
+    # two clean, one dirty, resets the confirmation streak
+    reg.observe("r0", ok=True, latency_s=0.0)
+    reg.observe("r0", ok=True, latency_s=0.0)
+    reg.observe("r0", ok=False)
+    for _ in range(4):      # drive the score back below readmit
+        reg.observe("r0", ok=True, latency_s=0.0)
+        if reg.score_of("r0") <= reg.policy.readmit_threshold:
+            break
+    assert reg.state_of("r0") == REPLICA_DEAD     # streak was reset
+    reg.observe("r0", ok=True, latency_s=0.0)
+    reg.observe("r0", ok=True, latency_s=0.0)
+    reg.observe("r0", ok=True, latency_s=0.0)
+    assert reg.state_of("r0") == REPLICA_HEALTHY
+
+
+def test_fail_dead_convicts_without_ewma_wait():
+    """`fail_dead` consecutive poll failures kill the replica outright
+    even though EWMA smoothing would take longer to cross any band."""
+    reg = _registry(alpha=0.01, fail_dead=3)   # glacial EWMA
+    reg.observe("r0", ok=False)
+    reg.observe("r0", ok=False)
+    assert reg.state_of("r0") == REPLICA_HEALTHY
+    reg.observe("r0", ok=False)
+    assert reg.state_of("r0") == REPLICA_DEAD
+
+
+def test_mark_failed_is_instant_conviction():
+    reg = _registry()
+    reg.mark_failed("r1")
+    assert reg.state_of("r1") == REPLICA_DEAD
+    assert reg.score_of("r1") == 1.0
+
+
+def test_drain_state_machine():
+    reg = _registry()
+    assert reg.drain("r0") is True
+    assert reg.state_of("r0") == REPLICA_DRAINED
+    # health polls never exit drained — it is administrative
+    for _ in range(10):
+        reg.observe("r0", ok=True, latency_s=0.0)
+    assert reg.state_of("r0") == REPLICA_DRAINED
+    # undrain re-proves through suspect, not straight to healthy
+    reg.undrain("r0")
+    assert reg.state_of("r0") == REPLICA_SUSPECT
+    reg.observe("r0", ok=True, latency_s=0.0)
+    reg.observe("r0", ok=True, latency_s=0.0)
+    assert reg.state_of("r0") == REPLICA_HEALTHY
+    # drain on a dead replica refuses: nothing graceful left
+    reg.mark_failed("r1")
+    assert reg.drain("r1") is False
+
+
+def test_dead_drained_replicas_not_picked():
+    reg = _registry(3)
+    reg.mark_failed("r0")
+    assert reg.drain("r1")
+    for _ in range(20):
+        assert reg.pick() == "r2"
+    reg.mark_failed("r2")
+    assert reg.pick() is None
+
+
+def test_suspect_only_pool_still_routes():
+    """Degraded-but-alive beats shedding: with no healthy replica,
+    suspects take traffic."""
+    reg = _registry(2)
+    for _ in range(3):
+        reg.observe("r0", ok=True, latency_s=10.0)
+        reg.observe("r1", ok=True, latency_s=10.0)
+    assert reg.state_of("r0") == REPLICA_SUSPECT
+    assert reg.pick() in ("r0", "r1")
+
+
+# ---------------------------------------------------------------------------
+# prefix affinity + load
+# ---------------------------------------------------------------------------
+
+def test_affinity_sticks_and_follows_tokens():
+    reg = _registry(2)
+    toks = list(range(40))
+    first = reg.pick(toks)
+    # make the first choice the BUSIER one; affinity must still win
+    reg.note_route(first)
+    reg.note_route(first)
+    for _ in range(5):
+        assert reg.pick(toks) == first
+    # a different prompt goes to the less-loaded replica
+    other = reg.pick(list(range(100, 140)))
+    assert other != first
+
+
+def test_affinity_key_is_leading_tokens_only():
+    reg = _registry(2, affinity_tokens=4)
+    a = reg.pick([1, 2, 3, 4, 5, 6])
+    assert reg.pick([1, 2, 3, 4, 99, 98]) == a     # same leading 4
+    assert reg.affinity_owner([1, 2, 3, 4]) == a
+
+
+def test_affinity_owner_dead_falls_back_and_relearns():
+    reg = _registry(2)
+    toks = list(range(8))
+    owner = reg.pick(toks)
+    reg.mark_failed(owner)
+    survivor = reg.pick(toks)
+    assert survivor != owner
+    assert reg.affinity_owner(toks) == survivor    # relearned
+
+
+def test_reassign_affinity_moves_all_keys():
+    reg = _registry(2)
+    owner = reg.pick([1, 2, 3])
+    reg.pick([4, 5, 6])
+    keys_before = reg.affinity_keys_of(owner)
+    assert keys_before
+    other = [n for n in reg.names() if n != owner][0]
+    moved = reg.reassign_affinity(owner, other)
+    assert moved == len(keys_before)
+    assert reg.affinity_keys_of(owner) == []
+    for k in keys_before:
+        assert reg.affinity_owner(list(k)) == other
+
+
+def test_affinity_lru_bounded():
+    reg = _registry(2, affinity_capacity=4)
+    for i in range(10):
+        reg.pick([i, i + 1, i + 2])
+    total = sum(len(reg.affinity_keys_of(n)) for n in reg.names())
+    assert total <= 4
+
+
+def test_least_loaded_pick_tracks_inflight():
+    reg = _registry(3)
+    reg.note_route("r0")
+    reg.note_route("r0")
+    reg.note_route("r1")
+    assert reg.pick() == "r2"
+    reg.done("r1")
+    reg.note_route("r2")
+    reg.note_route("r2")
+    assert reg.pick() == "r1"
+
+
+# ---------------------------------------------------------------------------
+# dispatch: retry / backoff / shed / hedge (injected post_fn)
+# ---------------------------------------------------------------------------
+
+def _router(n=2, policy=None, post=None, get=None):
+    replicas = {f"r{i}": f"http://test:{9000 + i}" for i in range(n)}
+    return DecodeRouter(replicas, policy=policy or _policy(),
+                        post_fn=post, get_fn=get)
+
+
+def test_dispatch_routes_and_returns_body():
+    calls = []
+
+    def post(url, path, payload, timeout):
+        calls.append((url, path))
+        return 200, {"ids": [[1, 2]], "rid": "q0"}, []
+
+    rt = _router(post=post)
+    status, body, _ = rt.dispatch({"ids": [1, 2], "new_tokens": 2})
+    assert status == 200 and body["ids"] == [[1, 2]]
+    assert calls and calls[0][1] == "/generate"
+
+
+def test_dispatch_fails_over_on_connect_error():
+    attempts = []
+
+    def post(url, path, payload, timeout):
+        attempts.append(url)
+        if len(attempts) == 1:
+            raise OSError("connection refused")
+        return 200, {"ids": [[7]]}, []
+
+    rt = _router(post=post)
+    before = router_mod._M_FAILOVERS.value()
+    status, body, _ = rt.dispatch({"ids": [7], "new_tokens": 1})
+    assert status == 200
+    assert len(attempts) == 2 and attempts[0] != attempts[1]
+    assert router_mod._M_FAILOVERS.value() == before + 1
+    # the failed replica was convicted immediately
+    dead = [n for n in rt.registry.names()
+            if rt.registry.state_of(n) == REPLICA_DEAD]
+    assert len(dead) == 1
+
+
+def test_dispatch_retries_exhausted_503_with_retry_after():
+    def post(url, path, payload, timeout):
+        raise OSError("down")
+
+    rt = _router(policy=_policy(route_retries=1))
+    rt._post = post
+    status, body, headers = rt.dispatch({"ids": [1], "new_tokens": 1})
+    assert status == 503
+    assert "error" in body
+    assert ("Retry-After", "1") in headers      # PL403
+
+
+def test_dispatch_no_replica_is_503():
+    rt = _router(post=lambda *a: (200, {}, []))
+    for n in rt.registry.names():
+        rt.registry.mark_failed(n)
+    status, body, headers = rt.dispatch({"ids": [1], "new_tokens": 1})
+    assert status == 503 and body.get("no_replica")
+    assert any(h == "Retry-After" for h, _ in headers)
+
+
+def test_dispatch_shed_retries_on_other_replica():
+    """A 503 from one replica spends a retry on a different one before
+    surfacing — shed here does not mean shed everywhere."""
+    shed_urls = []
+
+    def post(url, path, payload, timeout):
+        if not shed_urls:
+            shed_urls.append(url)
+            return 503, {"error": "shed"}, [("Retry-After", "2")]
+        return 200, {"ids": [[5]]}, []
+
+    rt = _router(post=post)
+    status, body, _ = rt.dispatch({"ids": [5], "new_tokens": 1})
+    assert status == 200
+    # neither replica was convicted — a shed is an answer, not a fault
+    assert all(rt.registry.state_of(n) != REPLICA_DEAD
+               for n in rt.registry.names())
+
+
+def test_dispatch_unanimous_shed_passes_through_retry_after():
+    def post(url, path, payload, timeout):
+        return 503, {"error": "shed"}, [("Retry-After", "7")]
+
+    rt = _router(post=post)
+    status, body, headers = rt.dispatch({"ids": [5], "new_tokens": 1})
+    assert status == 503
+    assert ("Retry-After", "7") in headers
+
+
+def test_hedge_fires_on_slow_primary():
+    """The primary stalls past hedge_ms; the hedge branch answers and
+    wins. Both answers are identical (deterministic decode), so either
+    winning is correct — the test pins that ONE answer returns fast."""
+    release = threading.Event()
+
+    def post(url, path, payload, timeout):
+        if url.endswith("9000"):     # primary pick is least-loaded = r0
+            release.wait(5.0)
+            return 200, {"ids": [[3]], "who": "slow"}, []
+        return 200, {"ids": [[3]], "who": "fast"}, []
+
+    rt = _router(policy=_policy(hedge_ms=30.0), post=post)
+    before = router_mod._M_HEDGES.value(winner="hedge")
+    t0 = time.monotonic()
+    status, body, _ = rt.dispatch({"ids": [3], "new_tokens": 1,
+                                   "class": "interactive"})
+    took = time.monotonic() - t0
+    release.set()
+    assert status == 200 and body["who"] == "fast"
+    assert took < 2.0
+    assert router_mod._M_HEDGES.value(winner="hedge") == before + 1
+
+
+def test_hedge_skipped_for_batch_class():
+    """Hedging doubles work — it is an interactive-tail tool only."""
+    urls = []
+
+    def post(url, path, payload, timeout):
+        urls.append(url)
+        time.sleep(0.05)             # well past hedge_ms
+        return 200, {"ids": [[3]]}, []
+
+    rt = _router(policy=_policy(hedge_ms=1.0), post=post)
+    status, _, _ = rt.dispatch({"ids": [3], "new_tokens": 1,
+                                "class": "batch"})
+    assert status == 200
+    assert len(urls) == 1
+
+
+# ---------------------------------------------------------------------------
+# prefix registration through the router
+# ---------------------------------------------------------------------------
+
+def test_prefix_registered_lazily_per_replica():
+    registrations = []
+
+    def post(url, path, payload, timeout):
+        if path == "/prefix":
+            registrations.append(url)
+            return 200, {"prefix_id": f"p-{len(registrations)}",
+                         "len": len(payload["ids"])}, []
+        return 200, {"ids": [[9]], "seen_prefix": payload.get(
+            "prefix_id")}, []
+
+    rt = _router(post=post)
+    pid, plen = rt.register_prefix([1, 2, 3, 4])
+    assert plen == 4
+    status, body, _ = rt.dispatch({"prefix_id": pid, "ids": [9],
+                                   "new_tokens": 1})
+    assert status == 200
+    # the replica saw ITS prefix id, not the router-level one
+    assert body["seen_prefix"] == "p-1"
+    assert len(registrations) == 1
+    # second use: already registered there, no second /prefix call
+    rt.dispatch({"prefix_id": pid, "ids": [9], "new_tokens": 1})
+    assert len(registrations) == 1
+
+
+def test_prefix_reregisters_on_failover_target():
+    state = {"fail_next_generate": True}
+    registrations = []
+
+    def post(url, path, payload, timeout):
+        if path == "/prefix":
+            registrations.append(url)
+            return 200, {"prefix_id": f"p@{url}", "len": 2}, []
+        if state.pop("fail_next_generate", False):
+            raise OSError("replica died")
+        return 200, {"ids": [[1]]}, []
+
+    rt = _router(post=post)
+    pid, _ = rt.register_prefix([1, 2])
+    status, _, _ = rt.dispatch({"prefix_id": pid, "ids": [9],
+                                "new_tokens": 1})
+    assert status == 200
+    # registered on the first pick AND again on the failover target
+    assert len(set(registrations)) == 2
+
+
+# ---------------------------------------------------------------------------
+# stream failover (injected _stream_from)
+# ---------------------------------------------------------------------------
+
+class _ScriptedStreamRouter(DecodeRouter):
+    """DecodeRouter whose per-replica stream is a scripted item list:
+    each call pops the next script — exactly how a replica looks to
+    stream() (the real _stream_from yields the same shapes)."""
+
+    def __init__(self, scripts, **kw):
+        replicas = {f"r{i}": f"http://test:{9000 + i}" for i in range(2)}
+        super().__init__(replicas, **kw)
+        self.scripts = list(scripts)
+        self.streamed_to = []
+
+    def _stream_from(self, name, payload):
+        self.streamed_to.append(name)
+        yield from self.scripts.pop(0)
+
+
+def _collect(gen):
+    items = list(gen)
+    status = next(i for i in items if i[0] == "status")
+    lines = [i[1] for i in items if i[0] == "line"]
+    return status, lines
+
+
+def test_stream_passthrough_success():
+    rt = _ScriptedStreamRouter([[
+        ("ok", None),
+        ("line", {"step": 0, "tokens": [4]}),
+        ("line", {"step": 1, "tokens": [5]}),
+        ("line", {"ids": [[4, 5]], "steps": 2}),
+    ]], policy=_policy())
+    (_, code, _), lines = _collect(rt.stream({"ids": [1],
+                                              "new_tokens": 2}))
+    assert code == 200
+    assert [l.get("step") for l in lines[:-1]] == [0, 1]
+    assert lines[-1]["ids"] == [[4, 5]]
+
+
+def test_stream_midstream_death_fails_over_and_suppresses_replay():
+    """Replica dies after step 1; the survivor replays from step 0 and
+    the client must see each step exactly once, then the terminal."""
+    rt = _ScriptedStreamRouter([
+        [("ok", None),
+         ("line", {"step": 0, "tokens": [4]}),
+         ("line", {"step": 1, "tokens": [5]})],    # then truncates
+        [("ok", None),
+         ("line", {"step": 0, "tokens": [4]}),     # replay, suppressed
+         ("line", {"step": 1, "tokens": [5]}),     # replay, suppressed
+         ("line", {"step": 2, "tokens": [6]}),
+         ("line", {"ids": [[4, 5, 6]], "steps": 3})],
+    ], policy=_policy())
+    before = router_mod._M_FAILOVERS.value()
+    (_, code, _), lines = _collect(rt.stream({"ids": [1],
+                                              "new_tokens": 3}))
+    assert code == 200
+    assert [l["step"] for l in lines if "step" in l] == [0, 1, 2]
+    assert lines[-1]["ids"] == [[4, 5, 6]]
+    assert len(rt.streamed_to) == 2
+    assert rt.streamed_to[0] != rt.streamed_to[1]
+    assert router_mod._M_FAILOVERS.value() == before + 1
+    # the dead replica was convicted
+    assert rt.registry.state_of(rt.streamed_to[0]) == REPLICA_DEAD
+
+
+def test_stream_error_line_fails_over_without_conviction():
+    """A terminal {"error"} line means the replica's executor died
+    under the request but the process answered — failover, yes;
+    transport conviction, no (its health polls decide)."""
+    rt = _ScriptedStreamRouter([
+        [("ok", None),
+         ("line", {"step": 0, "tokens": [4]}),
+         ("line", {"error": "executor died", "rid": "q1"})],
+        [("ok", None),
+         ("line", {"step": 0, "tokens": [4]}),
+         ("line", {"ids": [[4]], "steps": 1})],
+    ], policy=_policy())
+    (_, code, _), lines = _collect(rt.stream({"ids": [1],
+                                              "new_tokens": 1}))
+    assert code == 200
+    assert lines[-1]["ids"] == [[4]]
+    assert [l["step"] for l in lines if "step" in l] == [0]
+    assert rt.registry.state_of(rt.streamed_to[0]) != REPLICA_DEAD
+
+
+def test_stream_shed_retries_then_serves():
+    rt = _ScriptedStreamRouter([
+        [("refusal", (503, [("Retry-After", "3")], {"error": "shed"}))],
+        [("ok", None),
+         ("line", {"step": 0, "tokens": [4]}),
+         ("line", {"ids": [[4]], "steps": 1})],
+    ], policy=_policy())
+    (_, code, _), lines = _collect(rt.stream({"ids": [1],
+                                              "new_tokens": 1}))
+    assert code == 200
+    assert lines[-1]["ids"] == [[4]]
+    assert len(rt.streamed_to) == 2
+
+
+def test_stream_retries_exhausted_surfaces_error_line():
+    rt = _ScriptedStreamRouter([
+        [("ok", None), ("line", {"step": 0, "tokens": [4]})],
+        [("ok", None), ("line", {"step": 1, "tokens": [5]})],
+    ], policy=_policy(route_retries=1))
+    (_, code, _), lines = _collect(rt.stream({"ids": [1],
+                                              "new_tokens": 3}))
+    assert code == 200           # headers had already committed
+    assert "error" in lines[-1]
+
+
+# ---------------------------------------------------------------------------
+# drain orchestration (injected post/get)
+# ---------------------------------------------------------------------------
+
+def _drain_fns(active_polls, exported_pages=2):
+    """Fake replica pair: the draining one answers /drain, reports
+    `active_polls` then 0, and exports a prefix blob; the survivor
+    accepts the import."""
+    log = []
+
+    def post(url, path, payload, timeout):
+        log.append((url, path))
+        if path == "/prefix":
+            return 200, {"prefix_id": f"p@{url}",
+                         "len": len(payload["ids"])}, []
+        if path == "/drain":
+            return 200, {"draining": True, "active": 1}, []
+        if path == "/kv/export":
+            return 200, {"blob": "AAAA", "tokens_covered": 8,
+                         "pages": exported_pages}, []
+        if path == "/kv/import":
+            return 200, {"installed_pages": exported_pages}, []
+        return 200, {"ids": [[1]]}, []
+
+    def get(url, path, timeout):
+        n = active_polls.pop(0) if active_polls else 0
+        return 200, {"ok": True, "stats": {"active": n}}
+
+    return post, get, log
+
+
+def test_drain_waits_for_inflight_then_migrates():
+    post, get, log = _drain_fns(active_polls=[2, 1])
+    rt = _router(post=post, get=get)
+    # give the drained replica an affinity key: its pages are warm
+    victim = rt.registry.pick([1, 2, 3, 4])
+    before = router_mod._M_MIGRATED.value()
+    out = rt.drain_replica(victim)
+    assert out["drained"] is True
+    assert out["migrated_prefixes"] == 1
+    assert out["target"] != victim
+    assert router_mod._M_MIGRATED.value() == before + 1
+    paths = [p for _, p in log]
+    assert paths.index("/drain") < paths.index("/kv/export") \
+        < paths.index("/kv/import")
+    # export hit the victim, import hit the survivor
+    exp_url = next(u for u, p in log if p == "/kv/export")
+    imp_url = next(u for u, p in log if p == "/kv/import")
+    assert exp_url == rt.registry.url_of(victim)
+    assert imp_url == rt.registry.url_of(out["target"])
+    # affinity followed the pages
+    assert rt.registry.affinity_owner([1, 2, 3, 4]) == out["target"]
+    assert rt.registry.state_of(victim) == REPLICA_DRAINED
+
+
+def test_drain_routes_nothing_to_drained_replica():
+    post, get, _ = _drain_fns(active_polls=[])
+    rt = _router(post=post, get=get)
+    victim = rt.registry.names()[0]
+    rt.drain_replica(victim)
+    for i in range(10):
+        assert rt.registry.pick([i]) != victim
+
+
+def test_drain_registered_prefixes_migrate_too():
+    post, get, log = _drain_fns(active_polls=[])
+    rt = _router(post=post, get=get)
+    pid, _ = rt.register_prefix([5, 6, 7, 8])
+    # route once so the prefix lands on a replica
+    status, _, _ = rt.dispatch({"prefix_id": pid, "ids": [9],
+                                "new_tokens": 1})
+    assert status == 200
+    victim = next(n for n in rt.registry.names()
+                  if rt._prefixes[pid]["replicas"].get(n))
+    out = rt.drain_replica(victim)
+    assert out["migrated_prefixes"] >= 1
+
+
+def test_drain_dead_replica_refuses():
+    rt = _router(post=lambda *a: (200, {}, []),
+                 get=lambda *a: (200, {"ok": True, "stats": {}}))
+    victim = rt.registry.names()[0]
+    rt.registry.mark_failed(victim)
+    out = rt.drain_replica(victim)
+    assert out["drained"] is False
+
+
+def test_drain_replica_dying_mid_drain_reports_error():
+    def post(url, path, payload, timeout):
+        if path == "/drain":
+            raise OSError("connection reset")
+        return 200, {}, []
+
+    rt = _router(post=post,
+                 get=lambda *a: (200, {"ok": True, "stats": {}}))
+    victim = rt.registry.names()[0]
+    out = rt.drain_replica(victim)
+    assert out["drained"] is False
+    assert rt.registry.state_of(victim) == REPLICA_DEAD
+
+
+def test_failed_export_falls_back_silently():
+    """A prefix whose export fails (no pages / error) is skipped — the
+    survivor re-prefills it on first use instead."""
+    def post(url, path, payload, timeout):
+        if path == "/drain":
+            return 200, {"draining": True}, []
+        if path == "/kv/export":
+            return 200, {"blob": None, "tokens_covered": 0,
+                         "pages": 0}, []
+        return 200, {"ids": [[1]]}, []
+
+    rt = _router(post=post,
+                 get=lambda *a: (200, {"ok": True, "stats": {}}))
+    victim = rt.registry.pick([1, 2, 3])
+    out = rt.drain_replica(victim)
+    assert out["drained"] is True
+    assert out["migrated_prefixes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# healthz fleet block
+# ---------------------------------------------------------------------------
+
+def test_healthz_reports_fleet_and_routability():
+    rt = _router(post=lambda *a: (200, {}, []),
+                 get=lambda *a: (200, {"ok": True, "draining": False,
+                                       "stats": {"active": 3}}))
+    rt._poll_once()
+    code, body = rt.healthz()
+    assert code == 200 and body["ok"] is True
+    assert set(body["fleet"]) == set(rt.registry.names())
+    rec = body["fleet"]["r0"]
+    assert rec["state"] == REPLICA_HEALTHY
+    assert rec["active"] == 3 and rec["draining"] is False
+    for n in rt.registry.names():
+        rt.registry.mark_failed(n)
+    code, body = rt.healthz()
+    assert code == 503 and body["ok"] is False
+
+
+def test_poll_failure_walks_replica_dead():
+    def get(url, path, timeout):
+        raise OSError("refused")
+
+    rt = _router(get=get, policy=_policy(fail_dead=2))
+    rt._poll_once()
+    rt._poll_once()
+    assert all(rt.registry.state_of(n) == REPLICA_DEAD
+               for n in rt.registry.names())

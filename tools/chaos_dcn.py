@@ -45,6 +45,13 @@ Emits one JSON line (plus pass-through logs with --verbose). Examples:
   # JOIN), with recovery_s in the record
   python tools/chaos_dcn.py --target serve-disagg --chaos kill@2 \
       --expect disagg
+
+  # routed decode-replica fleet (--target router-fleet): SIGKILL one
+  # replica of a 2-replica routed fleet mid shared-prefix burst —
+  # gates: zero lost/errored requests (router failover + stream
+  # replay), pipeedge_router_failovers_total >= 1, the respawned
+  # replica readmitted (epoch+1, healthy), zero leaked pages
+  python tools/chaos_dcn.py --target router-fleet --expect router
 """
 import argparse
 import json
@@ -249,14 +256,204 @@ def run_serve_disagg(args):
     return 0 if ok else 1
 
 
+def run_router_fleet(args):
+    """The routed decode-replica chaos experiment: a `--role router`
+    front-end over N supervised replicas under a shared-prefix burst,
+    with one replica SIGKILLed mid-burst. The robustness contract under
+    test (docs/FAULT_TOLERANCE.md replica lifecycle): every request
+    completes (router failover re-routes, streams replay with
+    suppression — zero lost, zero errors), the failover counter moved,
+    the killed replica respawns + is readmitted (epoch+1, healthy), and
+    no replica leaks a page. Emits one JSON line with the fault-window
+    goodput, failover count, and readmission latency."""
+    import json as json_mod
+    import urllib.request
+
+    sys.path.insert(0, REPO)
+    from tools import loadgen
+
+    port = _free_ports(1)[0]
+    url = f"http://127.0.0.1:{port}"
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = [sys.executable, os.path.join(REPO, "tools", "serve.py"),
+           "--role", "router", "--replicas", str(args.replicas),
+           "-m", args.model_name, "-pt", args.partition,
+           "--max-len", "64", "-t", "float32", "--port", str(port),
+           "--kv-pages", str(args.kv_pages),
+           "--kv-page-size", str(args.kv_page_size),
+           "--router-poll-interval", "0.2"]
+    t0 = time.monotonic()
+    proc = subprocess.Popen(cmd, env=env, text=True,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT)
+    reader = _TimedReader(proc)
+
+    def get_json(path, timeout=10.0):
+        with urllib.request.urlopen(f"{url}{path}",
+                                    timeout=timeout) as resp:
+            return json_mod.loads(resp.read())
+
+    def metric(name):
+        with urllib.request.urlopen(f"{url}/metrics",
+                                    timeout=10) as resp:
+            for line in resp.read().decode().splitlines():
+                if line.startswith(name + " "):
+                    return float(line.split()[-1])
+        return 0.0
+
+    record = {"target": "router-fleet", "replicas": args.replicas,
+              "expect": args.expect}
+    try:
+        deadline = time.monotonic() + args.timeout
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError("router died during startup")
+            try:
+                h = get_json("/healthz", timeout=5)
+                if h.get("ok") and all(
+                        r["state"] == "healthy"
+                        for r in h["fleet"].values()):
+                    break
+            except OSError:
+                pass
+            time.sleep(0.5)
+        else:
+            raise RuntimeError("router fleet never became healthy")
+        epochs0 = {n: r["epoch"] for n, r in h["fleet"].items()}
+        # warm EVERY replica directly (the router's affinity map would
+        # otherwise leave one cold and fold its first XLA compile into
+        # the fault window)
+        shared_max = loadgen.spec_max_len(args.shared_spec)
+        for rep in h["fleet"].values():
+            for n in {shared_max, 6}:
+                req = urllib.request.Request(
+                    f"{rep['url']}/generate",
+                    data=json_mod.dumps({"ids": [7] * n,
+                                         "new_tokens": 2}).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=180) as resp:
+                    resp.read()
+        # the kill thread fires mid-burst at whichever replica is
+        # actively serving; a concurrent watcher stamps the respawn's
+        # readmission AS IT HAPPENS
+        killed = {}           # victim -> kill instant
+        recovered_at = [None]
+        watch_stop = threading.Event()
+
+        def kill_one():
+            watch_stop.wait(args.kill_after)
+            if watch_stop.is_set():
+                return
+            try:
+                body = get_json("/healthz", timeout=5)
+            except OSError:
+                return
+            fleet = body["fleet"]
+            victim = next((n for n, rec in fleet.items()
+                           if rec.get("active")), sorted(fleet)[0])
+            pid = body["workers"][victim[1:]]["pid"]
+            os.kill(pid, signal.SIGKILL)
+            killed[victim] = time.monotonic()
+
+        def watch_readmission():
+            while not watch_stop.is_set() and recovered_at[0] is None:
+                if killed:
+                    try:
+                        fleet = get_json("/healthz",
+                                         timeout=5)["fleet"]
+                    except OSError:
+                        watch_stop.wait(0.3)
+                        continue
+                    victim = next(iter(killed))
+                    rec = fleet[victim]
+                    if rec["epoch"] > epochs0[victim] \
+                            and rec["state"] == "healthy":
+                        recovered_at[0] = time.monotonic()
+                        return
+                watch_stop.wait(0.2)
+
+        killer = threading.Thread(target=kill_one, daemon=True,
+                                  name="chaos-kill")
+        watcher = threading.Thread(target=watch_readmission,
+                                   daemon=True, name="readmit-watch")
+        killer.start()
+        watcher.start()
+        report = loadgen.run_load(
+            f"{url}/generate", args.duration, args.qps,
+            mix={"interactive": 1.0}, new_tokens=4,
+            prompt_len=args.shared_spec, seed=7, arrival="poisson")
+        recover_deadline = time.monotonic() + 120
+        while recovered_at[0] is None \
+                and time.monotonic() < recover_deadline:
+            time.sleep(0.3)
+        watch_stop.set()
+        killer.join(timeout=10)
+        watcher.join(timeout=10)
+        fleet = get_json("/healthz")["fleet"]
+        # the page-accounting gate spans every replica: ask each one's
+        # own /healthz for its orphan-sweep running total
+        leaked = 0
+        for rep in fleet.values():
+            try:
+                with urllib.request.urlopen(f"{rep['url']}/healthz",
+                                            timeout=10) as resp:
+                    body = json_mod.loads(resp.read())
+                leaked += ((body.get("serving") or {}).get("kv")
+                           or {}).get("leaked", 0)
+            except OSError:
+                pass      # a dead replica holds no pages to leak
+        victim = next(iter(killed), None)
+        record.update({
+            "requests": report["requests"],
+            "lost": report["client_dropped"],
+            "errors": report["totals"]["error"],
+            "shed": report["totals"]["shed"],
+            "fault_window_goodput_rps": round(sum(
+                c["goodput_rps"] for c in report["classes"].values()), 3),
+            "victim": victim,
+            "failovers": metric("pipeedge_router_failovers_total"),
+            "retries": metric("pipeedge_router_retries_total"),
+            "pages_leaked": leaked,
+            "replica_epochs": {n: r["epoch"] for n, r in fleet.items()},
+            "replica_states": {n: r["state"] for n, r in fleet.items()},
+            "recovery_s": (round(recovered_at[0] - killed[victim], 3)
+                           if recovered_at[0] and victim else None),
+            "readmitted": recovered_at[0] is not None,
+            "total_s": round(time.monotonic() - t0, 3),
+        })
+    finally:
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+        reader.join()
+    print(json.dumps(record))
+    if args.verbose:
+        for t, line in reader.lines:
+            print(f"[router +{t - t0:7.3f}] {line}", file=sys.stderr)
+    # the router gate: nothing lost, nothing errored, the failover path
+    # engaged (>= 1 re-route off the corpse), zero leaked pages, and
+    # the victim respawned + readmitted before the harness deadline
+    ok = (record["errors"] == 0 and record["lost"] == 0
+          and record["failovers"] >= 1 and record["pages_leaked"] == 0
+          and record["readmitted"])
+    return 0 if ok else 1
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--target", default="runtime",
-                   choices=["runtime", "serve-disagg"],
+                   choices=["runtime", "serve-disagg", "router-fleet"],
                    help="runtime: a runtime.py DCN fleet (the original "
                         "experiments); serve-disagg: a --disaggregate "
                         "process serving fleet with --chaos armed on "
-                        "the prefill worker's ship edge")
+                        "the prefill worker's ship edge; router-fleet: "
+                        "a --role router replica fleet with a mid-burst "
+                        "replica SIGKILL")
     p.add_argument("--world", type=int, default=3)
     p.add_argument("--victim", type=int, default=1,
                    help="rank DCN_CHAOS is armed in (must not be the "
@@ -267,7 +464,7 @@ def main():
                         "slow@K[-J]:MS | jitter@K[-J]:MS | corrupt@K")
     p.add_argument("--expect", default="recover",
                    choices=["recover", "abort", "heal", "quarantine",
-                            "disagg"],
+                            "disagg", "router"],
                    help="recover: the run must complete; abort: the fleet "
                         "must stop naming the victim; heal: the run must "
                         "complete AND the victim must rejoin AND the "
@@ -321,13 +518,21 @@ def main():
     p.add_argument("--duration", type=float, default=8.0,
                    help="serve-disagg: burst seconds")
     p.add_argument("--shared-spec", default="shared:16:24:2",
-                   help="serve-disagg: loadgen shared-prefix prompt "
-                        "distribution for the burst")
+                   help="serve-disagg/router-fleet: loadgen "
+                        "shared-prefix prompt distribution for the "
+                        "burst")
+    p.add_argument("--replicas", type=int, default=2,
+                   help="router-fleet: supervised decode replicas")
+    p.add_argument("--kill-after", type=float, default=2.5,
+                   help="router-fleet: seconds into the burst before "
+                        "the SIGKILL lands on the active replica")
     args = p.parse_args()
-    if args.target == "serve-disagg":
+    if args.target in ("serve-disagg", "router-fleet"):
         if args.model_name == "pipeedge/test-tiny-vit":
             # the runtime default is a ViT; serving needs a decoder
             args.model_name = "pipeedge/test-tiny-gpt2"
+        if args.target == "router-fleet":
+            return run_router_fleet(args)
         return run_serve_disagg(args)
     if args.victim == 0:
         p.error("--victim 0 is the data rank (the driver; killing it "

@@ -311,6 +311,10 @@ class _Service:
         # work is refused with 503 + Retry-After and healthz reports the
         # dead rank; unlike `_dead` it is expected to clear
         self.degraded_info: Optional[dict] = None
+        # graceful drain (POST /drain, routed fleets): new admits are
+        # refused 503 + Retry-After while in-flight requests complete;
+        # the router migrates warm KV and detaches when active hits 0
+        self.draining = False
         # replay gate: set on every window close so in-flight requests
         # waiting out a failover wake IMMEDIATELY on recovery instead of
         # polling (the _await_recovery contract)
@@ -468,6 +472,37 @@ class _Service:
         dead = self.dead
         if dead is not None:
             raise RuntimeError(f"serving worker died: {dead!r}")
+
+    # -- replica-to-replica KV migration (docs/FAULT_TOLERANCE.md) ------
+
+    def kv_export(self, ids):
+        """POST /kv/export: this replica's warm KV pages for a prompt
+        prefix, as a base64 wire-v2 ship blob (kv/ship.py) — the router
+        ships them to a survivor during a graceful drain. Returns
+        (blob_b64 | None, tokens_covered, pages)."""
+        if self.kv_backend is None:
+            raise ValueError("KV export needs --kv-pages (dense cache "
+                             "slots have no page plane to export)")
+        from pipeedge_tpu.kv import ship
+        from pipeedge_tpu.serving.router import encode_ship_blob
+        out = self.kv_backend.export_prefix([int(t) for t in ids])
+        if out is None:
+            return None, 0, 0
+        frames, plen, pages = out
+        return encode_ship_blob(frames), plen, pages
+
+    def kv_import(self, ids, blob_b64):
+        """POST /kv/import: install a shipped prefix into this
+        replica's page pool + trie (idempotent — an already-cached
+        prefix installs 0 pages). Returns pages installed."""
+        if self.kv_backend is None:
+            raise ValueError("KV import needs --kv-pages")
+        from pipeedge_tpu.kv import ship
+        from pipeedge_tpu.serving.router import decode_ship_blob
+        tensors = decode_ship_blob(blob_b64)
+        handle = ship.decode_kv_ship(tensors, self.pipe.dtype)
+        return self.kv_backend.install_prefix([int(t) for t in ids],
+                                              handle)
 
     # -- brownout governor ----------------------------------------------
 
@@ -634,6 +669,20 @@ class _Service:
         deg = self.degraded_info
         if deg is not None:
             raise ServiceDegraded(deg["dead_rank"], deg["retry_after"])
+        if self.draining:
+            # drains don't heal: the Retry-After tells the client to go
+            # find another replica (the router already stopped routing
+            # here; this is the race window's backstop)
+            raise RuntimeError("draining: this replica admits no new "
+                               "requests")
+
+    def begin_drain(self):
+        """POST /drain: stop admitting, let in-flight work finish. The
+        ROUTER owns the rest of the lifecycle (migrate warm prefixes,
+        detach, respawn) — this side only has to refuse new admits and
+        report `active` honestly in /healthz."""
+        self.draining = True
+        self.flight.note("drain_begin")
 
     def _await_recovery(self) -> bool:
         """Block until the degraded window closes (True) or its retry
@@ -1373,6 +1422,7 @@ def make_handler(service, model_name):
                             "speculative": service.spec is not None,
                             "executor": service.executor,
                             "degraded": degraded,
+                            "draining": service.draining,
                             "serving": service.serving_stats(),
                             "flight": service.flight_stats(),
                             # per-peer gray-failure scores when a
@@ -1418,6 +1468,21 @@ def make_handler(service, model_name):
                 elif self.path == "/prefix":
                     pid, plen = service.add_prefix(req["ids"])
                     self._send(200, {"prefix_id": pid, "len": plen})
+                elif self.path == "/drain":
+                    # the router's graceful-drain entry (replica side):
+                    # stop admitting, keep finishing; /healthz's
+                    # stats.active reports the remaining in-flight work
+                    service.begin_drain()
+                    self._send(200, {"draining": True,
+                                     "active": service.stats().get(
+                                         "active", 0)})
+                elif self.path == "/kv/export":
+                    blob, plen, pages = service.kv_export(req["ids"])
+                    self._send(200, {"blob": blob, "tokens_covered": plen,
+                                     "pages": pages})
+                elif self.path == "/kv/import":
+                    pages = service.kv_import(req["ids"], req["blob"])
+                    self._send(200, {"installed_pages": pages})
                 elif self.path == "/generate":
                     ids = req["ids"]
                     if ids and not isinstance(ids[0], list):
@@ -1563,26 +1628,25 @@ def _inject_stall(pipe, spec, parser):
           f"{idx}", flush=True)
 
 
-class PrefillWorkerSupervisor:
-    """Spawns and supervises the prefill worker PROCESSES of
-    `--disaggregate process` (tools/prefill_worker.py ranks 1..N of the
-    ship plane's DCN world). A worker that dies — crash, OOM, chaos
-    kill — is respawned with DCN_EPOCH incremented, so its JOIN clears
-    the decode side's death fence and the fleet readmits it
-    (docs/FAULT_TOLERANCE.md disaggregated serving lifecycle). Chaos:
-    PIPEEDGE_PREFILL_CHAOS (a DCN_CHAOS spec) arms deterministic faults
-    in ONE worker's env (PIPEEDGE_PREFILL_CHAOS_RANK, default 1) for
-    the first incarnation only — respawns come up clean, exactly like
-    the restart@K:MS contract."""
+class WorkerSupervisor:
+    """Spawns and supervises a fleet of child worker PROCESSES: respawn
+    on death with crash-loop backoff and an epoch bump per incarnation.
+    Subclasses name the fleet (`LABEL`/`TAG`) and provide the per-rank
+    argv/env/ready-line contract — `PrefillWorkerSupervisor` runs the
+    prefill fleet of `--disaggregate process`, `ReplicaSupervisor` the
+    decode replicas of `--role router`
+    (docs/FAULT_TOLERANCE.md lifecycles)."""
+
+    LABEL = "worker"       # human/log name ("prefill worker rank 1 died")
+    TAG = "worker"         # stdout tee prefix ("[worker r1] ...")
 
     RESPAWN_DELAY_S = 0.5
     RESPAWN_BACKOFF_MAX_S = 30.0
     FAST_DEATH_S = 5.0     # an incarnation dying this fast escalates
 
-    def __init__(self, worker_cmd, ranks, respawn=True):
+    def __init__(self, ranks, respawn=True):
         import subprocess
         self._subprocess = subprocess
-        self._cmd = list(worker_cmd)      # without rank; appended per rank
         self.ranks = tuple(ranks)
         self.respawn = bool(respawn)
         self._procs = {}                  # rank -> Popen
@@ -1597,27 +1661,37 @@ class PrefillWorkerSupervisor:
         self._spawned_at = {r: 0.0 for r in self.ranks}
         self._respawn_after = {r: 0.0 for r in self.ranks}
         self._stop = threading.Event()
-        self._lock = make_lock("serve.prefill_sup")
+        self._lock = make_lock(f"serve.{self.TAG}_sup")
         self._watchers = []
         for r in self.ranks:
             self._spawn(r)
         self._supervisor = threading.Thread(target=self._watch_loop,
                                             daemon=True,
-                                            name="prefill-supervisor")
+                                            name=f"{self.TAG}-supervisor")
         self._supervisor.start()
+
+    # -- the per-fleet contract (subclasses) -----------------------------
+
+    def _argv(self, rank):
+        raise NotImplementedError
+
+    def _env(self, rank):
+        env = dict(os.environ)
+        # every incarnation carries its epoch: a respawned worker's
+        # JOIN/readmission is fenced against its dead predecessor
+        env["DCN_EPOCH"] = str(self._epoch[rank])
+        return env
+
+    def _is_ready(self, rank, line):
+        raise NotImplementedError
+
+    # -- lifecycle -------------------------------------------------------
 
     def _spawn(self, rank):
         import subprocess
-        env = dict(os.environ)
-        env["DCN_EPOCH"] = str(self._epoch[rank])
-        chaos = os.getenv("PIPEEDGE_PREFILL_CHAOS")
-        chaos_rank = int(os.getenv("PIPEEDGE_PREFILL_CHAOS_RANK", "1"))
-        if chaos and rank == chaos_rank and self._epoch[rank] == 0:
-            env["DCN_CHAOS"] = chaos
         proc = subprocess.Popen(
-            [sys.executable] + self._cmd[:1] + [str(rank)] + self._cmd[1:],
-            env=env, text=True, stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT)
+            self._argv(rank), env=self._env(rank), text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
         with self._lock:
             # stop() may have swept _procs while this Popen was in
             # flight (the respawn/shutdown race): a spawn the shutdown
@@ -1628,26 +1702,22 @@ class PrefillWorkerSupervisor:
             self._procs[rank] = proc
             self._spawned_at[rank] = time.monotonic()
         t = threading.Thread(target=self._pump, args=(rank, proc),
-                             daemon=True, name=f"prefill-out-r{rank}")
+                             daemon=True, name=f"{self.TAG}-out-r{rank}")
         t.start()
         # pump threads exit when their worker's stdout closes: prune
         # the dead ones so a long-lived server doesn't accumulate one
         # Thread record per respawn
         self._watchers = [w for w in self._watchers if w.is_alive()]
         self._watchers.append(t)
-        print(f"prefill worker rank {rank} spawned "
+        print(f"{self.LABEL} rank {rank} spawned "
               f"(pid={proc.pid}, epoch={self._epoch[rank]})", flush=True)
 
     def _pump(self, rank, proc):
         # tee worker output through the server's stdout (prefixed): the
         # chaos harness and CI key on the workers' chaos/ready lines
-        ready_line = f"prefill worker rank {rank} ready"
         for line in proc.stdout:
-            print(f"[prefill r{rank}] {line}", end="", flush=True)
-            # exact machine line only: a bare substring ("ready") would
-            # also match e.g. "...already initialized" warnings from
-            # the model build and release wait_ready() mid-build
-            if line.startswith(ready_line):
+            print(f"[{self.TAG} r{rank}] {line}", end="", flush=True)
+            if self._is_ready(rank, line):
                 self._ready[rank].set()
 
     def _watch_loop(self):
@@ -1671,7 +1741,7 @@ class PrefillWorkerSupervisor:
                         self._backoff[rank] = self.RESPAWN_DELAY_S
                     self._respawn_after[rank] = now + self._backoff[rank]
                     dead_pending.add(rank)
-                    print(f"prefill worker rank {rank} died "
+                    print(f"{self.LABEL} rank {rank} died "
                           f"(rc={proc.returncode}; respawn backoff "
                           f"{self._backoff[rank]:g}s)", flush=True)
                     if not self.respawn:
@@ -1692,8 +1762,18 @@ class PrefillWorkerSupervisor:
             if not self._ready[rank].wait(
                     max(0.0, deadline - time.monotonic())):
                 raise RuntimeError(
-                    f"prefill worker rank {rank} never became ready "
+                    f"{self.LABEL} rank {rank} never became ready "
                     f"within {timeout}s")
+
+    def restart(self, rank):
+        """Planned restart (the router's drain endgame): terminate the
+        incarnation; the watch loop observes the death and respawns it
+        with the next epoch — the same path an unplanned death takes,
+        so readmission is identical either way."""
+        with self._lock:
+            proc = self._procs.get(rank)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
 
     def snapshot(self):
         with self._lock:
@@ -1717,13 +1797,278 @@ class PrefillWorkerSupervisor:
                 proc.wait(timeout=5)
 
 
-def _free_ports(n):
+class PrefillWorkerSupervisor(WorkerSupervisor):
+    """The prefill fleet of `--disaggregate process`
+    (tools/prefill_worker.py ranks 1..N of the ship plane's DCN world).
+    A worker that dies — crash, OOM, chaos kill — is respawned with
+    DCN_EPOCH incremented, so its JOIN clears the decode side's death
+    fence and the fleet readmits it (docs/FAULT_TOLERANCE.md
+    disaggregated serving lifecycle). Chaos: PIPEEDGE_PREFILL_CHAOS (a
+    DCN_CHAOS spec) arms deterministic faults in ONE worker's env
+    (PIPEEDGE_PREFILL_CHAOS_RANK, default 1) for the first incarnation
+    only — respawns come up clean, exactly like the restart@K:MS
+    contract."""
+
+    LABEL = "prefill worker"
+    TAG = "prefill"
+
+    def __init__(self, worker_cmd, ranks, respawn=True):
+        self._cmd = list(worker_cmd)      # without rank; appended per rank
+        super().__init__(ranks, respawn=respawn)
+
+    def _argv(self, rank):
+        return [sys.executable] + self._cmd[:1] + [str(rank)] \
+            + self._cmd[1:]
+
+    def _env(self, rank):
+        env = super()._env(rank)
+        chaos = os.getenv("PIPEEDGE_PREFILL_CHAOS")
+        chaos_rank = int(os.getenv("PIPEEDGE_PREFILL_CHAOS_RANK", "1"))
+        if chaos and rank == chaos_rank and self._epoch[rank] == 0:
+            env["DCN_CHAOS"] = chaos
+        return env
+
+    def _is_ready(self, rank, line):
+        # exact machine line only: a bare substring ("ready") would
+        # also match e.g. "...already initialized" warnings from
+        # the model build and release wait_ready() mid-build
+        return line.startswith(f"prefill worker rank {rank} ready")
+
+
+class ReplicaSupervisor(WorkerSupervisor):
+    """The decode replicas behind `--role router`: each rank is a full
+    `serve.py --role replica` process on its own port. A replica that
+    dies respawns with the next epoch after crash-loop backoff; the
+    router's health polls readmit it once it proves itself (the
+    registry's readmit confirmation — docs/FAULT_TOLERANCE.md replica
+    lifecycle). `restart(rank)` is the drain endgame: planned
+    detach rides the same death-observation path."""
+
+    LABEL = "decode replica"
+    TAG = "replica"
+
+    def __init__(self, base_cmd, host, ports, respawn=True):
+        self._base_cmd = list(base_cmd)
+        self._host = host
+        self._ports = list(ports)
+        super().__init__(range(len(ports)), respawn=respawn)
+
+    def _argv(self, rank):
+        return [sys.executable] + self._base_cmd + [
+            "--host", self._host, "--port", str(self._ports[rank])]
+
+    def _is_ready(self, rank, line):
+        # the replica's own "serving ... on HOST:PORT" line; the port
+        # makes it rank-unique
+        return (line.startswith("serving ")
+                and f" on {self._host}:{self._ports[rank]}" in line)
+
+
+def _free_ports(n, host="127.0.0.1"):
     import socket as socket_mod
-    socks = [socket_mod.create_server(("127.0.0.1", 0)) for _ in range(n)]
+    socks = [socket_mod.create_server((host, 0)) for _ in range(n)]
     ports = [s.getsockname()[1] for s in socks]
     for s in socks:
         s.close()
     return ports
+
+
+def make_router_handler(router, model_name):
+    """HTTP surface of `--role router`: the same endpoint shapes a
+    single replica serves (clients need no code change), backed by the
+    DecodeRouter instead of a local pipeline."""
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"      # chunked transfer needs 1.1
+
+        def log_message(self, *a):      # quiet server
+            pass
+
+        def _send(self, code, obj, headers=()):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for name, value in headers:
+                self.send_header(name, value)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _chunk(self, obj):
+            data = json.dumps(obj).encode() + b"\n"
+            self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+            self.wfile.flush()
+
+        def do_GET(self):
+            if self.path == "/metrics":
+                body = prom.REGISTRY.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif self.path == "/healthz":
+                code, body = router.healthz()
+                body["model"] = model_name
+                headers = ((("Retry-After", "1"),) if code == 503
+                           else ())
+                self._send(code, body, headers=headers)
+            else:
+                self._send(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self):
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                if self.path == "/generate":
+                    if req.get("stream"):
+                        self._stream(req)
+                        return
+                    status, body, headers = router.dispatch(req)
+                    self._send(status, body, headers=headers)
+                elif self.path == "/prefix":
+                    pid, plen = router.register_prefix(req["ids"])
+                    self._send(200, {"prefix_id": pid, "len": plen})
+                elif self.path == "/drain":
+                    out = router.drain_replica(
+                        req["replica"],
+                        migrate=bool(req.get("migrate", True)))
+                    self._send(200 if out.get("drained") else 409, out)
+                else:
+                    self._send(404, {"error": f"unknown path {self.path}"})
+            except (KeyError, ValueError, TypeError, IndexError) as exc:
+                self._send(400, {"error": str(exc)})
+            except RuntimeError as exc:
+                self._send(503, {"error": str(exc)},
+                           headers=(("Retry-After", "1"),))
+
+        def _stream(self, req):
+            """Relay a streaming generation: the router's generator
+            owns failover; this method only moves lines to the socket
+            (a mid-stream replica death is invisible here beyond the
+            suppressed replay latency)."""
+            streaming = False
+            it = router.stream(req)
+            for item in it:
+                if item[0] == "status":
+                    _, code, headers = item
+                    if code == 200:
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/x-ndjson")
+                        self.send_header("Transfer-Encoding", "chunked")
+                        self.end_headers()
+                        streaming = True
+                    else:
+                        nxt = next(it, None)
+                        body = (nxt[1] if nxt is not None
+                                and nxt[0] == "line" else {})
+                        self._send(code, body, headers=headers)
+                        return
+                else:
+                    try:
+                        self._chunk(item[1])
+                    except OSError:
+                        # client went away: closing the generator tears
+                        # down the upstream replica connection too
+                        it.close()
+                        return
+            if streaming:
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                    self.wfile.flush()
+                except OSError:
+                    pass
+
+    return Handler
+
+
+def _run_router(args):
+    """`--role router` entry: spawn/adopt the replica fleet, start the
+    health poller, serve the routed HTTP surface. Model-free — the
+    router never imports jax or loads weights."""
+    from pipeedge_tpu.serving.router import DecodeRouter, RouterPolicy
+    policy = RouterPolicy(
+        poll_interval_s=args.router_poll_interval,
+        health_timeout_s=args.router_health_timeout,
+        request_timeout_s=args.route_timeout,
+        route_retries=args.route_retries,
+        hedge_ms=args.hedge_ms,
+        drain_timeout_s=args.drain_timeout)
+    supervisor = None
+    if args.replica_addrs:
+        replicas = {}
+        for i, addr in enumerate(args.replica_addrs.split(",")):
+            addr = addr.strip()
+            replicas[f"r{i}"] = (addr if addr.startswith("http")
+                                 else f"http://{addr}")
+    else:
+        ports = _free_ports(args.replicas, args.host)
+        base_cmd = [
+            os.path.abspath(__file__), "--role", "replica",
+            "-m", args.model_name,
+            "--max-len", str(args.max_len), "-t", args.dtype,
+            "--kv-bits", str(args.kv_bits),
+            "--attend-floor", str(args.attend_floor),
+            "--executor", args.executor,
+            "--max-prefixes", str(args.max_prefixes),
+            "--queue-capacity", str(args.queue_capacity),
+            "--kv-pages", str(args.kv_pages),
+            "--kv-page-size", str(args.kv_page_size),
+            "--chunked-prefill", str(args.chunked_prefill),
+            "--governor-interval", str(args.governor_interval),
+            "--brownout-queue-high", str(args.brownout_queue_high),
+            "--brownout-queue-low", str(args.brownout_queue_low),
+            "--brownout-p95-high", str(args.brownout_p95_high),
+            "--brownout-p95-low", str(args.brownout_p95_low),
+            "--brownout-dwell-up", str(args.brownout_dwell_up),
+            "--brownout-dwell-down", str(args.brownout_dwell_down),
+            "--brownout-clamp-tokens", str(args.brownout_clamp_tokens),
+            "--brownout-clamp-chunk", str(args.brownout_clamp_chunk)]
+        if args.partition:
+            base_cmd += ["-pt", args.partition]
+        if args.max_active is not None:
+            base_cmd += ["--max-active", str(args.max_active)]
+        if args.prefill_budget is not None:
+            base_cmd += ["--prefill-budget", str(args.prefill_budget)]
+        if args.step_join:
+            base_cmd += ["--step-join"]
+        if args.no_admission:
+            base_cmd += ["--no-admission"]
+        if args.no_brownout:
+            base_cmd += ["--no-brownout"]
+        if args.draft_model:
+            base_cmd += ["--draft-model", args.draft_model,
+                         "--gamma", str(args.gamma)]
+        for kvp in (args.class_rate or []):
+            base_cmd += ["--class-rate", kvp]
+        for kvp in (args.class_deadline or []):
+            base_cmd += ["--class-deadline", kvp]
+        if args.inject_stall:
+            base_cmd += ["--inject-stall", args.inject_stall]
+        supervisor = ReplicaSupervisor(
+            base_cmd, args.host, ports,
+            respawn=not args.no_replica_respawn)
+        replicas = {f"r{i}": f"http://{args.host}:{port}"
+                    for i, port in enumerate(ports)}
+    router = DecodeRouter(replicas, policy=policy, supervisor=supervisor)
+    if supervisor is not None:
+        for i, name in enumerate(replicas):
+            router.bind_rank(name, i)
+        supervisor.wait_ready(timeout=600.0)
+    router.start()
+    server = ThreadingHTTPServer(
+        (args.host, args.port),
+        make_router_handler(router, args.model_name))
+    print(f"serving router ({len(replicas)} replicas) on "
+          f"{args.host}:{args.port}", flush=True)
+    try:
+        server.serve_forever()
+    finally:
+        router.stop()
+        if supervisor is not None:
+            supervisor.stop()
 
 
 def main():
@@ -1753,6 +2098,52 @@ def main():
                         "--kv-pages only the token lists are stored — "
                         "the prefix trie owns the KV)")
     p.add_argument("--port", default=8321, type=int)
+    p.add_argument("--host", default="127.0.0.1",
+                   help="bind address for the HTTP server and the "
+                        "ship/lease listeners (default loopback; use "
+                        "a NIC address or 0.0.0.0 for non-loopback "
+                        "replicas)")
+    # -- routed decode fleet (docs/SERVING.md router topology) ----------
+    p.add_argument("--role", default="single",
+                   choices=["single", "router", "replica"],
+                   help="single: one decode process serving directly "
+                        "(the historical mode); router: a model-free "
+                        "front-end that health-checks and routes across "
+                        "N decode replicas (spawned and supervised, or "
+                        "external via --replica-addrs); replica: a "
+                        "decode process behind a router (same serving "
+                        "surface as single, plus drain/migration)")
+    p.add_argument("--replicas", default=2, type=int,
+                   help="decode replica processes the router spawns "
+                        "and supervises (ignored with --replica-addrs)")
+    p.add_argument("--replica-addrs", default=None,
+                   metavar="HOST:PORT,...",
+                   help="route across EXTERNAL replicas at these "
+                        "addresses instead of spawning any (no respawn "
+                        "supervision — lifecycle is the operator's)")
+    p.add_argument("--no-replica-respawn", action="store_true",
+                   help="do not respawn dead decode replicas (default: "
+                        "respawn with crash-loop backoff + epoch bump "
+                        "and readmit after clean health polls)")
+    p.add_argument("--router-poll-interval", default=0.5, type=float,
+                   help="seconds between /healthz polls per replica")
+    p.add_argument("--router-health-timeout", default=2.0, type=float,
+                   help="health-poll timeout; a slow poll scores as "
+                        "degraded, a failed one as a miss")
+    p.add_argument("--route-timeout", default=120.0, type=float,
+                   help="per-attempt request timeout at the router")
+    p.add_argument("--route-retries", default=2, type=int,
+                   help="re-route attempts to a DIFFERENT replica after "
+                        "a connect failure or mid-stream death")
+    p.add_argument("--hedge-ms", default=0.0, type=float,
+                   help="tail hedging for non-streaming interactive "
+                        "requests: if the primary replica has not "
+                        "answered within this many ms, race a second "
+                        "replica and keep the first answer (0 = off)")
+    p.add_argument("--drain-timeout", default=60.0, type=float,
+                   help="seconds POST /drain waits for a replica's "
+                        "in-flight requests before migrating its "
+                        "prefix pages anyway")
     # -- paged KV plane + disaggregation (docs/SERVING.md) --------------
     p.add_argument("--kv-pages", default=0, type=int,
                    help="enable the paged KV plane: N fixed-size pages "
@@ -1884,6 +2275,24 @@ def main():
         p.error("--prefill-budget only applies with --chunked-prefill")
     if args.prefill_budget is not None and args.prefill_budget < 1:
         p.error("--prefill-budget must be >= 1")
+    if args.role == "router":
+        if args.disaggregate != "off":
+            p.error("--role router does not compose with --disaggregate "
+                    "yet (run disaggregation inside each replica is a "
+                    "scoped follow-up; see docs/SERVING.md)")
+        if args.replica_addrs is None and args.replicas < 1:
+            p.error("--replicas must be >= 1 (or pass --replica-addrs)")
+        if args.hedge_ms < 0:
+            p.error("--hedge-ms must be >= 0")
+        if args.route_retries < 0:
+            p.error("--route-retries must be >= 0")
+    elif args.replica_addrs is not None:
+        p.error("--replica-addrs only applies with --role router")
+
+    if args.role == "router":
+        # the router is a model-free proxy: no jax, no weights — it
+        # routes, health-checks, drains, and migrates
+        return _run_router(args)
 
     from pipeedge_tpu.utils import apply_env_platform
     apply_env_platform()
@@ -1925,7 +2334,8 @@ def main():
         from pipeedge_tpu.comm import dcn
         from pipeedge_tpu.kv import RemotePrefillFleet
         world = 1 + args.prefill_ranks
-        addrs = [("127.0.0.1", port) for port in _free_ports(world)]
+        addrs = [(args.host, port)
+                 for port in _free_ports(world, args.host)]
         addr_arg = ",".join(f"{h}:{port}" for h, port in addrs)
         worker_cmd = [
             os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -2005,10 +2415,11 @@ def main():
         # ship-plane faults (lease timeouts, zombie drops, worker
         # deaths/readmissions) land in the flight recorder's event ring
         prefill_fleet.flight_note = service.flight.note
-    server = ThreadingHTTPServer(("127.0.0.1", args.port),
+    server = ThreadingHTTPServer((args.host, args.port),
                                  make_handler(service, args.model_name))
     print(f"serving {args.model_name} ({len(pipe.stages)} stages, "
-          f"{args.executor} executor) on 127.0.0.1:{args.port}", flush=True)
+          f"{args.executor} executor) on {args.host}:{args.port}",
+          flush=True)
     try:
         server.serve_forever()
     finally:
