@@ -45,6 +45,7 @@ docs/FAULT_TOLERANCE.md's replica lifecycle section.
 from __future__ import annotations
 
 import base64
+import inspect
 import json
 import logging
 import queue
@@ -71,6 +72,18 @@ STATE_CODES = {REPLICA_HEALTHY: 0, REPLICA_SUSPECT: 1,
                REPLICA_DRAINED: 2, REPLICA_DEAD: 3}
 
 ROUTE_OUTCOMES = ("ok", "shed", "deadline", "error", "no_replica")
+
+# Hop-propagation headers (docs/OBSERVABILITY.md fleet observatory).
+# The router mints the request id and carries it to the replica on
+# RID_HEADER (per-attempt derived rids: `rid.tN` retries, `rid.hedge`
+# hedge branch, `rid.foN` mid-stream failover replays) plus the hop
+# index on HOP_HEADER; responses echo RID_HEADER (the BASE rid — the
+# root of the derivation tree) and REPLICA_HEADER (which replica
+# actually served) so a client complaint cross-references straight to
+# a postmortem bundle without body parsing.
+RID_HEADER = "X-PipeEdge-Rid"
+HOP_HEADER = "X-PipeEdge-Hop"
+REPLICA_HEADER = "X-PipeEdge-Replica"
 
 # /metrics plane. Per-replica label matrices are pre-declared in
 # `ReplicaRegistry.add`, when the fleet membership is known (PL501);
@@ -430,14 +443,20 @@ class ReplicaRegistry:
 # -- HTTP plumbing (injectable for tests) ---------------------------------
 
 def http_post_json(url: str, path: str, payload: dict,
-                   timeout: float) -> Tuple[int, dict, List[Tuple[str, str]]]:
+                   timeout: float,
+                   headers: Optional[Dict[str, str]] = None) \
+        -> Tuple[int, dict, List[Tuple[str, str]]]:
     """POST one JSON body; returns (status, body, passthrough headers).
     HTTP error statuses are RETURNED (they are answers — a 503 shed
     must flow back to the client with its Retry-After); transport
-    failures raise OSError for the caller's failover logic."""
+    failures raise OSError for the caller's failover logic. `headers`
+    adds per-request headers (the rid/hop propagation pair)."""
+    hdrs = {"Content-Type": "application/json"}
+    if headers:
+        hdrs.update(headers)
     req = urllib.request.Request(
         f"{url}{path}", data=json.dumps(payload).encode(),
-        headers={"Content-Type": "application/json"}, method="POST")
+        headers=hdrs, method="POST")
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             body = json.loads(resp.read() or b"{}")
@@ -458,10 +477,46 @@ def http_get_json(url: str, path: str, timeout: float) -> Tuple[int, dict]:
 
 def _passthrough(headers) -> List[Tuple[str, str]]:
     out = []
-    ra = headers.get("Retry-After") if headers is not None else None
-    if ra is not None:
-        out.append(("Retry-After", ra))
+    if headers is None:
+        return out
+    for h in ("Retry-After", RID_HEADER, REPLICA_HEADER):
+        v = headers.get(h)
+        if v is not None:
+            out.append((h, v))
     return out
+
+
+def _with_identity(headers: Iterable[Tuple[str, str]], rid: Optional[str],
+                   replica: Optional[str]) -> List[Tuple[str, str]]:
+    """Make the router authoritative for the identity echo: drop any
+    replica-echoed rid/replica headers and append the BASE rid plus the
+    replica that actually served (None skips that header)."""
+    out = [(h, v) for h, v in headers
+           if h not in (RID_HEADER, REPLICA_HEADER)]
+    if rid is not None:
+        out.append((RID_HEADER, rid))
+    if replica is not None:
+        out.append((REPLICA_HEADER, replica))
+    return out
+
+
+def _adapt_post_fn(fn: Callable) -> Callable:
+    """Tolerate injected post fns written against the pre-observatory
+    4-arg signature (url, path, payload, timeout): drop the `headers`
+    kwarg when the fn cannot take it."""
+    try:
+        sig = inspect.signature(fn)
+        takes_headers = any(
+            p.name == "headers" or p.kind == p.VAR_KEYWORD
+            for p in sig.parameters.values())
+    except (TypeError, ValueError):      # builtins/C callables: assume new
+        takes_headers = True
+    if takes_headers:
+        return fn
+
+    def adapted(url, path, payload, timeout, headers=None):
+        return fn(url, path, payload, timeout)
+    return adapted
 
 
 class _ReplicaStreamError(RuntimeError):
@@ -484,8 +539,12 @@ class DecodeRouter:
         self.policy = policy or RouterPolicy()
         self.registry = ReplicaRegistry(self.policy)
         self.supervisor = supervisor
-        self._post = post_fn or http_post_json
+        self._post = (_adapt_post_fn(post_fn) if post_fn is not None
+                      else http_post_json)
         self._get = get_fn or http_get_json
+        # rid mint: the router is the root of every request's rid tree
+        self._rid_lock = make_lock("router.rids")
+        self._next_rid = 0
         self._stop = threading.Event()
         self._poller: Optional[threading.Thread] = None
         # replica-name -> supervisor rank (supervised fleets only):
@@ -504,6 +563,27 @@ class DecodeRouter:
 
     def bind_rank(self, name: str, rank: int) -> None:
         self._ranks[name] = int(rank)
+
+    def mint_rid(self) -> str:
+        """Router-minted request ids (`R<n>`): the root of the
+        derivation tree `rid[.tN|.hedge|.foN]*` — distinct from the
+        replica-local `q<n>` mint, which now only fires for direct
+        (unrouted) requests."""
+        with self._rid_lock:
+            n = self._next_rid
+            self._next_rid += 1
+        return f"R{n}"
+
+    @staticmethod
+    def _clean_rid(raw: Optional[str]) -> Optional[str]:
+        """Accept a caller-supplied rid if it is sane (printable,
+        bounded — it lands in headers, logs, and span rings)."""
+        if not raw or not isinstance(raw, str):
+            return None
+        rid = raw.strip()
+        if not rid or len(rid) > 128 or not rid.isprintable():
+            return None
+        return rid
 
     # -- health poll loop -------------------------------------------------
 
@@ -561,6 +641,31 @@ class DecodeRouter:
         if self.supervisor is not None:
             out["workers"] = self.supervisor.snapshot()
         return (200 if routable else 503), out
+
+    def health_snapshot(self) -> Dict[str, dict]:
+        """Copy of the latest raw /healthz body per replica — the fleet
+        collector mines it for nested scrape targets (prefill-worker
+        observability URLs a replica reports under
+        serving.kv.prefill.workers)."""
+        with self._health_lock:
+            return dict(self._health)
+
+    def scrape_targets(self) -> Dict[str, str]:
+        """The fleet collector's CURRENT target set: every registered
+        replica, plus any prefill-worker observability endpoints the
+        replicas report in their health bodies."""
+        targets = {name: rec["url"]
+                   for name, rec in self.registry.snapshot().items()}
+        for name, body in self.health_snapshot().items():
+            workers = ((((body.get("serving") or {}).get("kv") or {})
+                        .get("prefill") or {}).get("workers") or {})
+            if not isinstance(workers, dict):
+                continue
+            for rank, rec in workers.items():
+                url = (rec or {}).get("http_url")
+                if url:
+                    targets[f"{name}.pf{rank}"] = url
+        return targets
 
     # -- the routed request path ------------------------------------------
 
@@ -629,33 +734,46 @@ class DecodeRouter:
         (the failed one is convicted immediately), one shed-retry hop
         on a replica 503 (another replica may have capacity). Terminal
         outcomes land in pipeedge_router_requests_total."""
+        rid = self._clean_rid(payload.get("rid")) or self.mint_rid()
         if self.policy.hedge_ms > 0 \
                 and payload.get("class", "interactive") == "interactive" \
                 and not payload.get("stream"):
-            return self._dispatch_hedged(payload, path)
-        return self._dispatch_plain(payload, path, exclude=())
+            return self._dispatch_hedged(payload, path, rid=rid)
+        return self._dispatch_plain(payload, path, exclude=(), rid=rid)
 
     def _dispatch_plain(self, payload: dict, path: str,
-                        exclude: Iterable[str]) \
+                        exclude: Iterable[str],
+                        rid: Optional[str] = None) \
             -> Tuple[int, dict, List[Tuple[str, str]]]:
         pol = self.policy
+        rid = rid or self._clean_rid(payload.get("rid")) or self.mint_rid()
         tokens = self._prefix_tokens(payload)
         tried = list(exclude)
         backoff = pol.backoff_s
         retries_left = pol.route_retries
+        attempt = 0
         while True:
             name = self.registry.pick(tokens, exclude=tried)
             if name is None:
                 _M_REQUESTS.inc(outcome="no_replica")
                 return 503, {"error": "no routable replica",
-                             "no_replica": True}, [("Retry-After", "1")]
+                             "no_replica": True}, \
+                    _with_identity([("Retry-After", "1")], rid, None)
+            # attempt 0 rides the base rid; every re-dispatch derives a
+            # child (`rid.tN`) so the logical request stays one tree
+            arid = rid if attempt == 0 else f"{rid}.t{attempt}"
             self.registry.note_route(name)
             try:
                 body = self._prepare(name, payload)
-                with telemetry.span("router", f"dispatch:{name}"):
+                if "rid" in body:
+                    body = {k: v for k, v in body.items() if k != "rid"}
+                with telemetry.span("router", f"dispatch:{name}",
+                                    rid=arid):
                     status, out, headers = self._post(
                         self.registry.url_of(name), path, body,
-                        pol.request_timeout_s)
+                        pol.request_timeout_s,
+                        headers={RID_HEADER: arid,
+                                 HOP_HEADER: str(attempt)})
             except OSError as exc:
                 self.registry.mark_failed(name)
                 tried.append(name)
@@ -663,8 +781,9 @@ class DecodeRouter:
                     _M_REQUESTS.inc(outcome="error")
                     return 503, {"error": f"replica {name} unreachable "
                                           f"({exc}); retries exhausted"}, \
-                        [("Retry-After", "1")]
+                        _with_identity([("Retry-After", "1")], rid, None)
                 retries_left -= 1
+                attempt += 1
                 _M_RETRIES.inc(reason="connect")
                 _M_FAILOVERS.inc()
                 time.sleep(backoff)
@@ -678,9 +797,11 @@ class DecodeRouter:
                 # retry on a different replica before surfacing it
                 tried.append(name)
                 retries_left -= 1
+                attempt += 1
                 _M_RETRIES.inc(reason="shed")
                 continue
             _M_REQUESTS.inc(outcome=self._outcome(status, out))
+            headers = _with_identity(headers, rid, name)
             if status == 503 and not any(h == "Retry-After"
                                          for h, _ in headers):
                 headers = list(headers) + [("Retry-After", "1")]
@@ -696,24 +817,31 @@ class DecodeRouter:
             return "deadline"
         return "error"
 
-    def _dispatch_hedged(self, payload: dict, path: str) \
+    def _dispatch_hedged(self, payload: dict, path: str,
+                         rid: Optional[str] = None) \
             -> Tuple[int, dict, List[Tuple[str, str]]]:
         """Tail hedging for the interactive class: if the primary has
         not answered within `hedge_ms`, duplicate the request to a
         second replica and take whichever answers first — decode is
-        deterministic, so either answer is THE answer."""
+        deterministic, so either answer is THE answer. The hedge branch
+        rides the derived rid `rid.hedge` (its own retries nest:
+        `rid.hedge.t1`)."""
+        rid = rid or self._clean_rid(payload.get("rid")) or self.mint_rid()
         tokens = self._prefix_tokens(payload)
         primary = self.registry.pick(tokens)
         if primary is None:
             _M_REQUESTS.inc(outcome="no_replica")
             return 503, {"error": "no routable replica",
-                         "no_replica": True}, [("Retry-After", "1")]
+                         "no_replica": True}, \
+                _with_identity([("Retry-After", "1")], rid, None)
         results: "queue.Queue" = queue.Queue()
 
         def run(branch: str, exclude: Iterable[str]) -> None:
+            brid = rid if branch == "primary" else f"{rid}.hedge"
             try:
                 results.put((branch,
-                             self._dispatch_plain(payload, path, exclude)))
+                             self._dispatch_plain(payload, path, exclude,
+                                                  rid=brid)))
             except BaseException as exc:   # noqa: BLE001 — joined below
                 results.put((branch, exc))
 
@@ -735,7 +863,12 @@ class DecodeRouter:
             _M_HEDGES.inc(winner=branch)
         if isinstance(result, BaseException):
             raise result
-        return result
+        status, out, headers = result
+        # whichever branch won, the client is told the BASE rid — the
+        # resolvable root of the whole hedge tree
+        served = next((v for h, v in headers if h == REPLICA_HEADER),
+                      None)
+        return status, out, _with_identity(headers, rid, served)
 
     def stream(self, payload: dict):
         """Route one STREAMING request; yields ("status", code,
@@ -743,31 +876,55 @@ class DecodeRouter:
         replica death re-dispatches the whole request to a survivor
         and suppresses the first `emitted` step lines — deterministic
         decode makes the continuation token-identical (the re-prefill
-        recovery path; a drained replica's pages migrate instead)."""
+        recovery path; a drained replica's pages migrate instead).
+
+        Rid derivation: the first dispatch rides the base rid, each
+        failover replay derives `rid.foN`, each shed-retry hop
+        `rid.tN`. The 200 status (with X-PipeEdge-Rid/-Replica
+        headers) is held until the first line actually reaches the
+        client, so a pre-first-byte failover names the SURVIVOR in the
+        response headers; once streaming has begun the terminal line
+        carries `replica` instead (headers are already on the wire)."""
         pol = self.policy
+        rid = self._clean_rid(payload.get("rid")) or self.mint_rid()
         tokens = self._prefix_tokens(payload)
         tried: List[str] = []
         emitted = 0
         started = False     # 200 headers already yielded to the client
         retries_left = pol.route_retries
         backoff = pol.backoff_s
+        failovers = 0
+        shed_hops = 0
         while True:
             name = self.registry.pick(tokens, exclude=tried)
             if name is None:
                 _M_REQUESTS.inc(outcome="no_replica")
                 if not started:
-                    yield ("status", 503, [("Retry-After", "1")])
+                    yield ("status", 503,
+                           _with_identity([("Retry-After", "1")], rid,
+                                          None))
                 yield ("line", {"error": "no routable replica",
-                                "no_replica": True})
+                                "no_replica": True, "rid": rid})
                 return
+            if failovers == 0 and shed_hops == 0:
+                arid = rid
+            elif failovers > 0:
+                arid = f"{rid}.fo{failovers}"
+            else:
+                arid = f"{rid}.t{shed_hops}"
             self.registry.note_route(name)
             failure = None
             try:
                 body = self._prepare(name, payload)
+                if "rid" in body:
+                    body = {k: v for k, v in body.items() if k != "rid"}
                 skip = emitted
                 terminal = False
-                with telemetry.span("router", f"stream:{name}"):
-                    for kind, item in self._stream_from(name, body):
+                with telemetry.span("router", f"stream:{name}",
+                                    rid=arid):
+                    for kind, item in self._stream_from(
+                            name, body, rid=arid,
+                            hop=failovers + shed_hops):
                         if kind == "refusal":
                             code, headers, rbody = item
                             if code == 503 and retries_left > 0 \
@@ -778,6 +935,8 @@ class DecodeRouter:
                                 failure = "shed"
                                 break
                             if not started:
+                                headers = _with_identity(headers, rid,
+                                                         name)
                                 if code == 503 and not any(
                                         h == "Retry-After"
                                         for h, _ in headers):
@@ -791,9 +950,9 @@ class DecodeRouter:
                             terminal = True
                             break
                         if kind == "ok":
-                            if not started:
-                                yield ("status", 200, [])
-                                started = True
+                            # hold the 200 until the first line: a
+                            # failover before first byte then names
+                            # the survivor in the response headers
                             continue
                         obj = item
                         if "step" in obj:
@@ -804,12 +963,29 @@ class DecodeRouter:
                                 skip -= 1
                                 continue
                             emitted += 1
+                            if not started:
+                                yield ("status", 200,
+                                       _with_identity([], rid, name))
+                                started = True
                             yield ("line", obj)
                         elif "error" in obj:
                             raise _ReplicaStreamError(
                                 str(obj.get("error")))
                         else:
-                            yield ("line", obj)      # the terminal line
+                            # the terminal line: annotate who actually
+                            # served and the base rid (replayed streams
+                            # already sent headers naming the first
+                            # replica)
+                            obj = dict(obj)
+                            obj["replica"] = name
+                            # the BASE rid, not this leg's derived one:
+                            # the client resolves the whole tree from it
+                            obj["rid"] = rid
+                            if not started:
+                                yield ("status", 200,
+                                       _with_identity([], rid, name))
+                                started = True
+                            yield ("line", obj)
                             _M_REQUESTS.inc(outcome="ok")
                             terminal = True
                             break
@@ -830,27 +1006,39 @@ class DecodeRouter:
             if retries_left <= 0:
                 _M_REQUESTS.inc(outcome="error")
                 if not started:
-                    yield ("status", 503, [("Retry-After", "1")])
+                    yield ("status", 503,
+                           _with_identity([("Retry-After", "1")], rid,
+                                          None))
                 yield ("line", {"error": f"replica {name} failed; "
-                                         "retries exhausted"})
+                                         "retries exhausted",
+                                "rid": rid})
                 return
             retries_left -= 1
             _M_RETRIES.inc(reason=failure)
             if failure == "connect":
+                failovers += 1
                 _M_FAILOVERS.inc()
+            else:
+                shed_hops += 1
             time.sleep(backoff)
             backoff = min(backoff * 2, pol.backoff_max_s)
 
-    def _stream_from(self, name: str, payload: dict):
+    def _stream_from(self, name: str, payload: dict,
+                     rid: Optional[str] = None, hop: int = 0):
         """One replica's streaming response: ("refusal", (code,
         headers, body)) for a pre-stream non-200 (shed/400 — complete
         and terminal), else ("ok", None) then ("line", obj) per
         x-ndjson line. Transport failures raise OSError into
-        stream()'s failover arm."""
+        stream()'s failover arm. `rid`/`hop` propagate on the request
+        headers (the per-attempt derived rid)."""
         url = self.registry.url_of(name)
+        hdrs = {"Content-Type": "application/json"}
+        if rid is not None:
+            hdrs[RID_HEADER] = rid
+            hdrs[HOP_HEADER] = str(hop)
         req = urllib.request.Request(
             f"{url}/generate", data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"}, method="POST")
+            headers=hdrs, method="POST")
         try:
             resp = urllib.request.urlopen(
                 req, timeout=self.policy.request_timeout_s)

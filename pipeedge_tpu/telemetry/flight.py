@@ -56,8 +56,8 @@ DEFAULT_POSTMORTEM_DIR = "postmortems"
 DEFAULT_CAPACITY = 4096
 DEFAULT_COOLDOWN_S = 5.0
 
-TRIGGERS = ("deadline", "shed", "failover", "slo", "gray", "poison",
-            "manual")
+TRIGGERS = ("deadline", "shed", "failover", "slo", "slo_burn", "gray",
+            "poison", "manual")
 
 _POSTMORTEMS = prom.REGISTRY.counter(
     "pipeedge_postmortems_written_total",
@@ -66,19 +66,31 @@ for _t in TRIGGERS:
     _POSTMORTEMS.declare(trigger=_t)
 
 
+def rid_tree_member(span_rid: Optional[str], rid: str) -> bool:
+    """True when `span_rid` belongs to `rid`'s derivation tree: the rid
+    itself or any dot-suffixed descendant (`rid.t2`, `rid.hedge.t1`,
+    `rid.fo1`, `rid.replay` — the router/executor derivation grammar,
+    docs/OBSERVABILITY.md). One logical request resolves as one tree."""
+    if not isinstance(span_rid, str):
+        return False
+    return span_rid == rid or span_rid.startswith(rid + ".")
+
+
 def trace_slice(spans: Sequence[dict], rid: Optional[str]) -> List[dict]:
-    """The bundle's span slice: every span tagged with `rid`, plus the
-    spans sharing a microbatch id with one of them (the wire/ledger hops
+    """The bundle's span slice: every span in `rid`'s derivation tree
+    (retry/hedge/failover-replay children included), plus the spans
+    sharing a microbatch id with one of them (the wire/ledger hops
     recorded before the trace context reached them). `rid=None` keeps
     the whole list (a fleet-wide postmortem wants everything)."""
     if rid is None:
         return list(spans)
-    mine = [s for s in spans if s.get("rid") == rid]
+    mine = [s for s in spans if rid_tree_member(s.get("rid"), rid)]
     mbs = {s.get("mb") for s in mine if s.get("mb") is not None}
     out = list(mine)
     if mbs:
         out += [s for s in spans
-                if s.get("rid") != rid and s.get("mb") in mbs]
+                if not rid_tree_member(s.get("rid"), rid)
+                and s.get("mb") in mbs]
     out.sort(key=lambda s: (int(s.get("t0", 0)), str(s.get("cat", "")),
                             str(s.get("name", ""))))
     return out

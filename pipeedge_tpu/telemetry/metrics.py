@@ -224,11 +224,21 @@ class Histogram(_Instrument):
         return percentile_from_counts(self.buckets, counts, n, q)
 
     def render(self) -> List[str]:
+        # ONE lock acquisition captures both the bucket counts and the
+        # exemplar table: a concurrent observe() between two separate
+        # acquisitions could roll an exemplar over mid-render, making
+        # the rendered counts and `# EXEMPLAR` lines disagree (dropped
+        # or duplicated lines under a racing scrape). Formatting — the
+        # slow part — happens outside the lock on the copies.
+        now = time.monotonic()
         with self._lock:
             items = sorted((k, (list(s[0]), s[1], s[2]))
                            for k, s in self._series.items())
+            exemplars = {k: [(i, list(v))
+                             for i, v in sorted(self._exemplars
+                                                .get(k, {}).items())]
+                         for k, _ in items}
         lines = []
-        now = time.monotonic()
         for key, (counts, total, n) in items:
             cum = 0
             for bound, c in zip(self.buckets, counts):
@@ -243,13 +253,16 @@ class Histogram(_Instrument):
             # Prometheus text format 0.0.4 (every parser skips '#' lines
             # that are not HELP/TYPE), while the p99-spike -> trace-id
             # link is still one grep away (OpenMetrics-shaped payload)
-            for le, ex in self.exemplars(
-                    now=now, **dict(key)).items():
+            for idx, (value, trace_id, t) in exemplars.get(key, ()):
+                if now - t > self.exemplar_window_s:
+                    continue
+                le = ("+Inf" if idx >= len(self.buckets)
+                      else _fmt(self.buckets[idx]))
                 lk = key + (("le", le),)
                 lines.append(
                     f"# EXEMPLAR {self.name}_bucket{_label_str(lk)} "
-                    f'{{trace_id="{_escape(ex["trace_id"])}"}} '
-                    f"{_fmt(ex['value'])}")
+                    f'{{trace_id="{_escape(trace_id)}"}} '
+                    f"{_fmt(value)}")
         return lines
 
     def _key(self, labels: dict):

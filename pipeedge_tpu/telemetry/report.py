@@ -491,6 +491,12 @@ def _segment_key(s: dict) -> Optional[str]:
         if name.startswith("shed:"):
             return "shed_wait"
         return None                     # generate/speculative: envelope
+    if cat == "router":
+        if name.startswith(("dispatch:", "stream:")):
+            # route hop to a named replica — the fleet timeline's
+            # router-side view of each attempt/failover leg
+            return f"route/{name.split(':', 1)[1]}"
+        return None                     # admit/health_poll: envelope
     if cat == "compute":
         return f"stage{stage}/compute" if stage is not None else "compute"
     if cat == "stage":
@@ -511,17 +517,34 @@ def _segment_key(s: dict) -> Optional[str]:
     return None
 
 
+def _rid_tree_member(span_rid, rid: str) -> bool:
+    """`span_rid` is `rid` itself or a dot-suffixed descendant — the
+    derivation grammar `rid[.tN|.hedge|.foN|.replay]*` the router and
+    executors mint (docs/OBSERVABILITY.md fleet observatory)."""
+    if not isinstance(span_rid, str):
+        return False
+    return span_rid == rid or span_rid.startswith(rid + ".")
+
+
 def request_timeline(spans: Sequence[dict], rid: str,
-                     max_events: int = 400) -> dict:
+                     max_events: int = 400, tree: bool = True) -> dict:
     """One request's causal timeline from a merged span list: every span
-    tagged with `rid`, ordered, attributed to named segments (queue wait,
-    per-stage compute/dispatch/readback/emit, per-edge transfer, feed,
-    retire), with the DOMINANT STALL — the segment whose union-busy time
+    in `rid`'s derivation tree (the rid plus its retry/hedge/failover-
+    replay children — `tree=False` pins exact-match), ordered,
+    attributed to named segments (queue wait, route hops, per-stage
+    compute/dispatch/readback/emit, per-edge transfer, feed, retire),
+    with the DOMINANT STALL — the segment whose union-busy time
     explains the largest share of the request's end-to-end window —
     called out. The artifact that answers "why was THIS request slow"
-    (ISSUE 10 acceptance)."""
-    mine = [s for s in spans
-            if s.get("rid") == rid and s.get("t1") is not None]
+    (ISSUE 10 acceptance; ISSUE 18 extends it across the routed
+    fleet)."""
+    if tree:
+        mine = [s for s in spans
+                if _rid_tree_member(s.get("rid"), rid)
+                and s.get("t1") is not None]
+    else:
+        mine = [s for s in spans
+                if s.get("rid") == rid and s.get("t1") is not None]
     if not mine:
         return {"rid": rid, "found": False}
     mine.sort(key=lambda s: (int(s["t0"]), int(s["t1"])))
@@ -562,6 +585,7 @@ def request_timeline(spans: Sequence[dict], rid: str,
         "rid": rid,
         "found": True,
         "spans": len(mine),
+        "rids": sorted({str(s.get("rid")) for s in mine}),
         "ranks": sorted({int(s.get("rank", 0)) for s in mine}),
         "stages": sorted({int(s["stage"]) for s in mine
                           if s.get("stage") is not None}),

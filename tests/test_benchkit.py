@@ -258,6 +258,28 @@ def test_noise_band_uses_record_spread():
     assert diff["metrics"]["throughput"]["verdict"] == "ok"
 
 
+def test_record_noise_bands_tighten_below_default():
+    # a -8% attainment drop sits INSIDE the old one-size-fits-all band;
+    # the committed baseline pins its own tighter band and catches it
+    base = _serve_record(attainment=0.95)
+    new = _serve_record(attainment=0.874)
+    base["noise_bands"] = {"serve.slo_attainment": 0.02}
+    diff = bench_report.compare_records(base, new)
+    assert "serve.slo_attainment.interactive" in diff["regressed"]
+    # without the record band the default (5%) band... still catches
+    # this one; a 4% drop splits them
+    mid = _serve_record(attainment=0.912)
+    assert bench_report.compare_records(_serve_record(attainment=0.95),
+                                        mid)["ok"]
+    diff = bench_report.compare_records(base, mid)
+    assert "serve.slo_attainment.interactive" in diff["regressed"]
+    # a --noise override still widens past the record band (the CI
+    # escape hatch keeps working)
+    diff = bench_report.compare_records(
+        base, new, overrides={"serve.slo_attainment": 0.15})
+    assert diff["ok"], diff["regressed"]
+
+
 def test_bench_report_main_gate_exit_codes(tmp_path, capsys):
     base_path = str(tmp_path / "base.json")
     ok_path = str(tmp_path / "ok.json")

@@ -1,0 +1,336 @@
+"""The fleet observatory's unit matrix (ISSUE 18): the Prometheus text
+parser + fleet collector aggregation, the SLO burn-rate engine's window
+math and edge-triggered breach, exemplar retention under a racing
+scrape, rid-tree resolution (flight.trace_slice + report.
+request_timeline), and the /debug/spans drain payload — all through
+injected fetch/now, no sockets (the process-level acceptance lives in
+test_router_fleet.py).
+"""
+import threading
+
+import pipeedge_tpu.telemetry as telemetry
+from pipeedge_tpu.telemetry import collector as fc
+from pipeedge_tpu.telemetry import flight
+from pipeedge_tpu.telemetry import metrics as prom
+from pipeedge_tpu.telemetry import report
+
+
+# ---------------------------------------------------------------------------
+# prometheus text parsing
+# ---------------------------------------------------------------------------
+
+def _replica_text(ok=5.0, shed=1.0, queue=2.0, exemplars=()):
+    """A synthetic replica /metrics document rendered by the REAL
+    instrument classes — the parser is tested against the actual
+    exposition, not a hand-written imitation of it."""
+    reg = prom.Registry()
+    c = reg.counter(fc.CLASS_FAMILY, "requests by class and outcome")
+    c.inc(ok, **{"class": "interactive", "outcome": "ok"})
+    c.inc(shed, **{"class": "interactive", "outcome": "shed"})
+    reg.gauge(fc.QUEUE_FAMILY, "queue depth").set(queue)
+    h = reg.histogram(fc.LATENCY_FAMILY, "latency", buckets=(0.1, 1.0))
+    for value, rid in exemplars:
+        h.observe(value, exemplar=rid)
+    return reg.render()
+
+
+def test_parse_prom_text_families_and_labels():
+    fams = fc.parse_prom_text(_replica_text(ok=7, shed=3, queue=4))
+    rows = fams[fc.CLASS_FAMILY]
+    by = {(d["class"], d["outcome"]): v for d, v in rows}
+    assert by[("interactive", "ok")] == 7.0
+    assert by[("interactive", "shed")] == 3.0
+    assert fams[fc.QUEUE_FAMILY][0][1] == 4.0
+
+
+def test_parse_prom_text_skips_garbage_and_filters():
+    text = "# HELP x y\nnot a metric line !!\nfoo_total 3\nbar_total 4\n"
+    fams = fc.parse_prom_text(text, families=("foo_total",))
+    assert set(fams) == {"foo_total"}
+    assert fams["foo_total"] == [({}, 3.0)]
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate engine
+# ---------------------------------------------------------------------------
+
+def _engine(**kw):
+    kw.setdefault("objective", 0.99)
+    kw.setdefault("fast_window_s", 30.0)
+    kw.setdefault("slow_window_s", 300.0)
+    kw.setdefault("threshold", 10.0)
+    kw.setdefault("registry", prom.Registry())
+    return fc.BurnRateEngine(**kw)
+
+
+def test_burn_gauge_matrix_predeclared_at_zero():
+    reg = prom.Registry()
+    _engine(registry=reg)
+    text = reg.render()
+    for cls in fc.REQUEST_CLASSES:
+        for window in fc.BURN_WINDOWS:
+            assert (f'pipeedge_slo_burn_rate{{class="{cls}",'
+                    f'window="{window}"}} 0') in text
+
+
+def test_burn_zero_on_clean_traffic():
+    eng = _engine()
+    eng.update({"interactive": (0.0, 0.0)}, now=0.0)
+    burns = eng.update({"interactive": (50.0, 50.0)}, now=10.0)
+    assert burns["interactive"] == {"short": 0.0, "long": 0.0}
+    assert eng.gauge.value(**{"class": "interactive",
+                              "window": "short"}) == 0.0
+
+
+def test_burn_math_all_bad_window():
+    # 100% bad over the window against a 1% budget -> burn rate 100
+    eng = _engine()
+    eng.update({"interactive": (0.0, 0.0)}, now=0.0)
+    burns = eng.update({"interactive": (0.0, 10.0)}, now=10.0)
+    assert abs(burns["interactive"]["short"] - 100.0) < 1e-9
+    assert abs(eng.gauge.value(**{"class": "interactive",
+                                  "window": "short"}) - 100.0) < 1e-3
+
+
+def test_burn_windows_diverge_after_recovery():
+    """A burst of errors ages out of the short window while the long
+    window still remembers it — the classic fast-page/slow-confirm
+    split."""
+    eng = _engine(fast_window_s=30.0, slow_window_s=300.0)
+    eng.update({"interactive": (0.0, 0.0)}, now=0.0)
+    eng.update({"interactive": (0.0, 10.0)}, now=10.0)    # all bad
+    # 100 clean requests later, 60 s on: the short window sees only
+    # clean traffic, the long window still covers the burst
+    burns = eng.update({"interactive": (100.0, 110.0)}, now=70.0)
+    assert burns["interactive"]["short"] == 0.0
+    assert burns["interactive"]["long"] > 0.0
+
+
+def test_burn_breach_fires_once_per_episode():
+    fired = []
+    eng = _engine(on_breach=lambda cls, burn: fired.append((cls, burn)))
+    eng.update({"interactive": (0.0, 0.0)}, now=0.0)
+    eng.update({"interactive": (0.0, 10.0)}, now=5.0)     # breach
+    eng.update({"interactive": (0.0, 20.0)}, now=10.0)    # still breached
+    assert [cls for cls, _ in fired] == ["interactive"]
+    assert fired[0][1] > eng.threshold
+    # recovery re-arms: only clean deltas inside the short window
+    eng.update({"interactive": (500.0, 520.0)}, now=50.0)
+    eng.update({"interactive": (1000.0, 1020.0)}, now=78.0)
+    # second episode fires again
+    eng.update({"interactive": (1000.0, 1100.0)}, now=100.0)
+    assert len(fired) == 2
+
+
+def test_counts_from_counter_matches_families():
+    reg = prom.Registry()
+    c = reg.counter(fc.CLASS_FAMILY, "h")
+    c.inc(4, **{"class": "batch", "outcome": "ok"})
+    c.inc(2, **{"class": "batch", "outcome": "deadline"})
+    via_counter = fc.BurnRateEngine.counts_from_counter(c)
+    via_text = fc.BurnRateEngine.counts_from_families(
+        fc.parse_prom_text(reg.render()))
+    assert via_counter["batch"] == (4.0, 6.0)
+    assert via_counter == via_text
+
+
+# ---------------------------------------------------------------------------
+# fleet collector
+# ---------------------------------------------------------------------------
+
+def _collector(texts, burn=None):
+    """Collector over an injectable text table: {name: text}. Mutate
+    `texts` between scrapes to advance the fake fleet's counters."""
+    urls = {name: f"http://{name}.test" for name in texts}
+
+    def fetch(url, timeout):
+        for name, base in urls.items():
+            if url.startswith(base):
+                doc = texts[name]
+                if isinstance(doc, Exception):
+                    raise doc
+                return doc
+        raise OSError(f"unknown target {url}")
+
+    return fc.FleetCollector(lambda: dict(urls), interval_s=1.0,
+                             history=16, fetch_fn=fetch, burn=burn)
+
+
+def test_collector_aggregates_two_replicas():
+    texts = {"r0": _replica_text(ok=10, shed=0, queue=1),
+             "r1": _replica_text(ok=20, shed=10, queue=3)}
+    col = _collector(texts)
+    assert col.scrape_once(now=0.0) == 2
+    texts["r0"] = _replica_text(ok=20, shed=0, queue=1)
+    texts["r1"] = _replica_text(ok=40, shed=20, queue=3)
+    assert col.scrape_once(now=10.0) == 2
+    snap = col.fleet_snapshot(now=10.0)
+    assert set(snap["replicas"]) == {"r0", "r1"}
+    assert all(rec["ok"] for rec in snap["replicas"].values())
+    cls = snap["classes"]["interactive"]
+    # cumulative: latest good sample summed across replicas
+    assert cls["ok_total"] == 60.0 and cls["requests_total"] == 80.0
+    # windowed: (10 + 20) good over the 10 s window
+    assert cls["goodput_rps"] == 3.0
+    assert cls["shed_rps"] == 1.0
+    assert cls["window_attainment"] == round(30 / 40, 4)
+    assert snap["queue_depth"] == 4.0
+    assert snap["replicas"]["r1"]["goodput_rps"]["interactive"] == 2.0
+
+
+def test_collector_counts_scrape_errors_and_keeps_serving():
+    texts = {"r0": _replica_text(), "r1": OSError("connection refused")}
+    col = _collector(texts)
+    assert col.scrape_once(now=0.0) == 1
+    snap = col.fleet_snapshot(now=1.0)
+    assert snap["scrape_errors"] == 1
+    assert snap["replicas"]["r0"]["ok"] is True
+    assert snap["replicas"]["r1"]["ok"] is False
+    # the dead target's ring still exists (health visibility), and the
+    # live one's numbers still aggregate
+    assert snap["classes"]["interactive"]["requests_total"] == 6.0
+
+
+def test_collector_feeds_burn_engine_with_fleet_counts():
+    texts = {"r0": _replica_text(ok=0, shed=0),
+             "r1": _replica_text(ok=0, shed=0)}
+    fired = []
+    burn = _engine(on_breach=lambda cls, b: fired.append(cls))
+    col = _collector(texts, burn=burn)
+    col.scrape_once(now=0.0)
+    texts["r0"] = _replica_text(ok=0, shed=50)      # overload on r0
+    texts["r1"] = _replica_text(ok=0, shed=50)
+    col.scrape_once(now=10.0)
+    assert fired == ["interactive"]
+    snap = col.fleet_snapshot(now=10.0)
+    assert snap["slo"]["burn_rate"]["interactive"]["short"] > burn.threshold
+
+
+def test_collector_exemplar_union_roundtrips_parse_exemplars():
+    texts = {"r0": _replica_text(exemplars=[(0.05, "q1"), (5.0, "q2")]),
+             "r1": _replica_text(exemplars=[(0.08, "q9")])}
+    col = _collector(texts)
+    col.scrape_once(now=0.0)
+    snap = col.fleet_snapshot(now=0.0)
+    # union keeps the max-value exemplar per bucket across replicas
+    by_le = {row["le"]: row for row in snap["exemplars"]}
+    assert by_le["0.1"]["trace_id"] == "q9"
+    assert by_le["+Inf"]["trace_id"] == "q2"
+    parsed = prom.parse_exemplars(snap["exemplars_text"],
+                                  fc.LATENCY_FAMILY)
+    assert sorted(parsed, key=lambda r: r["le"]) == \
+        sorted(snap["exemplars"], key=lambda r: r["le"])
+
+
+# ---------------------------------------------------------------------------
+# exemplar retention under a racing scrape (satellite: the Histogram
+# render fix — one lock acquisition for counts + exemplars)
+# ---------------------------------------------------------------------------
+
+def test_histogram_exemplar_lines_consistent_under_concurrent_scrape():
+    """Rollover during an in-flight render must never drop or duplicate
+    `# EXEMPLAR` lines: every render sees at most ONE line per (label
+    set, le) bucket and each line parses back. A tiny exemplar window
+    forces constant rollover while a writer hammers observe()."""
+    h = prom.Histogram("race_latency_seconds", "h", buckets=(0.1, 1.0),
+                       exemplar_window_s=0.0005)
+    stop = threading.Event()
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            h.observe(0.05 if i % 3 else 5.0, exemplar=f"q{i}")
+            i += 1
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    try:
+        for _ in range(200):
+            text = "\n".join(h.render())
+            ex_lines = [ln for ln in text.splitlines()
+                        if ln.startswith("# EXEMPLAR")]
+            keys = [ln.split(" {trace_id=", 1)[0] for ln in ex_lines]
+            assert len(keys) == len(set(keys)), keys
+            parsed = prom.parse_exemplars(text, "race_latency_seconds")
+            assert len(parsed) == len(ex_lines)
+            for row in parsed:
+                assert row["le"] in ("0.1", "1", "+Inf")
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
+def test_render_exemplar_lines_roundtrip():
+    rows = [{"le": "0.1", "trace_id": "R3.fo1", "value": 0.07},
+            {"le": "+Inf", "trace_id": "R8", "value": 12.5}]
+    text = "\n".join(fc.render_exemplar_lines("f_seconds", rows))
+    assert prom.parse_exemplars(text, "f_seconds") == rows
+
+
+# ---------------------------------------------------------------------------
+# rid-tree resolution
+# ---------------------------------------------------------------------------
+
+def test_rid_tree_member_grammar():
+    assert flight.rid_tree_member("R4", "R4")
+    assert flight.rid_tree_member("R4.t2", "R4")
+    assert flight.rid_tree_member("R4.hedge.t1", "R4")
+    assert flight.rid_tree_member("R4.fo1", "R4")
+    assert not flight.rid_tree_member("R40", "R4")     # no prefix bleed
+    assert not flight.rid_tree_member("R5", "R4")
+    assert not flight.rid_tree_member(None, "R4")
+
+
+def _span(rid, rank=0, t0=0, t1=1_000_000, cat="router",
+          name="dispatch:r0", mb=None):
+    return {"cat": cat, "name": name, "rank": rank, "stage": None,
+            "mb": mb, "t0": t0, "t1": t1, "rid": rid}
+
+
+def test_trace_slice_includes_derived_rids():
+    spans = [_span("R1"), _span("R1.fo1", rank=1),
+             _span("R10", rank=2), _span("R2")]
+    rids = {s["rid"] for s in flight.trace_slice(spans, "R1")}
+    assert rids == {"R1", "R1.fo1"}
+
+
+def test_request_timeline_resolves_tree_across_ranks():
+    spans = [
+        _span("R7", rank=0, t0=0, t1=4_000_000, name="stream:r0"),
+        _span("R7", rank=1, t0=500_000, t1=2_000_000, cat="serve",
+              name="request"),
+        _span("R7.fo1", rank=0, t0=4_000_000, t1=9_000_000,
+              name="stream:r1"),
+        _span("R7.fo1", rank=2, t0=4_500_000, t1=8_500_000, cat="serve",
+              name="request"),
+        _span("R8", rank=0, t0=0, t1=1_000_000),     # different request
+    ]
+    rec = report.request_timeline(spans, "R7")
+    assert rec["found"] and rec["spans"] == 4
+    assert rec["rids"] == ["R7", "R7.fo1"]
+    assert rec["ranks"] == [0, 1, 2]     # router + both replicas
+    assert "route/r0" in rec["segments"] and "route/r1" in rec["segments"]
+    # exact-match mode pins the base rid only
+    assert report.request_timeline(spans, "R7", tree=False)["spans"] == 2
+
+
+# ---------------------------------------------------------------------------
+# /debug/spans payload
+# ---------------------------------------------------------------------------
+
+def test_debug_spans_payload_drains_ring_once():
+    telemetry.configure(rank=3)
+    try:
+        telemetry.record("router", "dispatch:r0", 10, 20, rid="R1")
+        body = fc.debug_spans_payload(drain=True)
+        assert body["enabled"] and body["rank"] == 3
+        assert [s["rid"] for s in body["spans"]] == ["R1"]
+        assert body["t_recv_ns"] <= body["t_send_ns"]
+        # drained: a second federating fetch sees only newer spans
+        assert fc.debug_spans_payload(drain=True)["spans"] == []
+        # peek mode leaves the ring alone
+        telemetry.record("router", "dispatch:r1", 30, 40, rid="R2")
+        assert len(fc.debug_spans_payload(drain=False)["spans"]) == 1
+        assert len(fc.debug_spans_payload(drain=False)["spans"]) == 1
+    finally:
+        telemetry.disable()

@@ -273,8 +273,7 @@ def test_dispatch_retries_exhausted_503_with_retry_after():
     def post(url, path, payload, timeout):
         raise OSError("down")
 
-    rt = _router(policy=_policy(route_retries=1))
-    rt._post = post
+    rt = _router(policy=_policy(route_retries=1), post=post)
     status, body, headers = rt.dispatch({"ids": [1], "new_tokens": 1})
     assert status == 503
     assert "error" in body
@@ -423,9 +422,11 @@ class _ScriptedStreamRouter(DecodeRouter):
         super().__init__(replicas, **kw)
         self.scripts = list(scripts)
         self.streamed_to = []
+        self.stream_rids = []
 
-    def _stream_from(self, name, payload):
+    def _stream_from(self, name, payload, rid=None, hop=0):
         self.streamed_to.append(name)
+        self.stream_rids.append(rid)
         yield from self.scripts.pop(0)
 
 
@@ -671,3 +672,168 @@ def test_poll_failure_walks_replica_dead():
     rt._poll_once()
     assert all(rt.registry.state_of(n) == REPLICA_DEAD
                for n in rt.registry.names())
+
+
+# ---------------------------------------------------------------------------
+# trace propagation: rid minting, derivation, response identity headers
+# (ISSUE 18 — docs/OBSERVABILITY.md rid-derivation grammar)
+# ---------------------------------------------------------------------------
+
+def _hdr(headers, name):
+    return next((v for h, v in headers if h == name), None)
+
+
+def _capture_post(script):
+    """Headers-aware injected post fn: pops (status, body) per call and
+    logs the (url, rid-header, hop-header) of every attempt. An OSError
+    entry raises instead."""
+    seen = []
+
+    def post(url, path, payload, timeout, headers=None):
+        seen.append((url, (headers or {}).get(router_mod.RID_HEADER),
+                     (headers or {}).get(router_mod.HOP_HEADER),
+                     dict(payload)))
+        step = script.pop(0)
+        if isinstance(step, Exception):
+            raise step
+        return step[0], step[1], []
+
+    return post, seen
+
+
+def test_dispatch_mints_rid_and_echoes_identity_headers():
+    post, seen = _capture_post([(200, {"ids": [[1, 2]]})])
+    rt = _router(post=post)
+    status, _, headers = rt.dispatch({"ids": [1, 2], "new_tokens": 2})
+    assert status == 200
+    rid = _hdr(headers, router_mod.RID_HEADER)
+    assert rid and rid.startswith("R")
+    assert _hdr(headers, router_mod.REPLICA_HEADER) in rt.registry.names()
+    # the replica saw the SAME rid, via header (hop 0), not body
+    assert seen[0][1] == rid and seen[0][2] == "0"
+    assert "rid" not in seen[0][3]
+
+
+def test_dispatch_honors_caller_supplied_rid():
+    post, seen = _capture_post([(200, {"ids": [[1]]})])
+    rt = _router(post=post)
+    _, _, headers = rt.dispatch({"ids": [1], "new_tokens": 1,
+                                 "rid": "client-7"})
+    assert _hdr(headers, router_mod.RID_HEADER) == "client-7"
+    assert seen[0][1] == "client-7"
+
+
+def test_dispatch_rejects_garbage_caller_rid():
+    post, seen = _capture_post([(200, {"ids": [[1]]})])
+    rt = _router(post=post)
+    _, _, headers = rt.dispatch({"ids": [1], "new_tokens": 1,
+                                 "rid": "x" * 300})     # oversized: remint
+    assert _hdr(headers, router_mod.RID_HEADER) != "x" * 300
+
+
+def test_dispatch_connect_retry_derives_dot_t1():
+    post, seen = _capture_post([OSError("refused"), (200, {"ids": [[7]]})])
+    rt = _router(post=post)
+    status, _, headers = rt.dispatch({"ids": [7], "new_tokens": 1})
+    assert status == 200
+    base = seen[0][1]
+    assert seen[1][1] == f"{base}.t1" and seen[1][2] == "1"
+    # the client resolves the whole tree from the BASE rid
+    assert _hdr(headers, router_mod.RID_HEADER) == base
+
+
+def test_dispatch_shed_retry_derives_dot_t1():
+    post, seen = _capture_post([(503, {"error": "shed"}),
+                                (200, {"ids": [[7]]})])
+    rt = _router(post=post)
+    status, _, headers = rt.dispatch({"ids": [7], "new_tokens": 1})
+    assert status == 200
+    assert seen[1][1] == f"{seen[0][1]}.t1"
+    assert _hdr(headers, router_mod.RID_HEADER) == seen[0][1]
+
+
+def test_hedged_dispatch_derives_dot_hedge_and_echoes_base_rid():
+    seen = []
+    release = threading.Event()
+
+    def post(url, path, payload, timeout, headers=None):
+        rid = (headers or {}).get(router_mod.RID_HEADER)
+        seen.append(rid)
+        if not rid.endswith(".hedge"):
+            release.wait(2.0)        # primary stalls past the hedge fuse
+        return 200, {"ids": [[3]]}, []
+
+    rt = _router(post=post, policy=_policy(hedge_ms=5.0))
+    try:
+        status, _, headers = rt.dispatch({"ids": [3], "new_tokens": 1})
+    finally:
+        release.set()
+    assert status == 200
+    base = next(r for r in seen if not r.endswith(".hedge"))
+    assert f"{base}.hedge" in seen
+    # whichever branch won, the response names the resolvable tree ROOT
+    assert _hdr(headers, router_mod.RID_HEADER) == base
+    assert _hdr(headers, router_mod.REPLICA_HEADER) in rt.registry.names()
+
+
+def test_stream_failover_derives_dot_fo1_and_annotates_terminal():
+    rt = _ScriptedStreamRouter([
+        [("ok", None),
+         ("line", {"step": 0, "tokens": [4]})],        # then truncates
+        [("ok", None),
+         ("line", {"step": 0, "tokens": [4]}),         # replay, suppressed
+         ("line", {"step": 1, "tokens": [5]}),
+         ("line", {"ids": [[4, 5]], "steps": 2})],
+    ], policy=_policy())
+    (_, code, headers), lines = _collect(rt.stream({"ids": [1],
+                                                    "new_tokens": 2}))
+    assert code == 200
+    base = rt.stream_rids[0]
+    assert rt.stream_rids == [base, f"{base}.fo1"]
+    # headers committed after the FIRST replica's first byte, so the
+    # terminal line carries who actually finished + the base rid
+    assert _hdr(headers, router_mod.REPLICA_HEADER) == rt.streamed_to[0]
+    assert lines[-1]["replica"] == rt.streamed_to[1]
+    assert lines[-1]["rid"] == base
+
+
+def test_stream_shed_hop_derives_dot_t1_and_names_survivor():
+    rt = _ScriptedStreamRouter([
+        [("refusal", (503, [("Retry-After", "3")], {"error": "shed"}))],
+        [("ok", None),
+         ("line", {"step": 0, "tokens": [4]}),
+         ("line", {"ids": [[4]], "steps": 1})],
+    ], policy=_policy())
+    (_, code, headers), lines = _collect(rt.stream({"ids": [1],
+                                                    "new_tokens": 1}))
+    assert code == 200
+    base = rt.stream_rids[0]
+    assert rt.stream_rids == [base, f"{base}.t1"]
+    # the shed happened BEFORE any byte reached the client: the 200
+    # headers name the survivor that actually streamed
+    assert _hdr(headers, router_mod.RID_HEADER) == base
+    assert _hdr(headers, router_mod.REPLICA_HEADER) == rt.streamed_to[1]
+
+
+def test_stream_honors_caller_rid():
+    rt = _ScriptedStreamRouter([
+        [("ok", None),
+         ("line", {"step": 0, "tokens": [4]}),
+         ("line", {"ids": [[4]], "steps": 1})],
+    ], policy=_policy())
+    (_, code, headers), _ = _collect(rt.stream({"ids": [1],
+                                                "new_tokens": 1,
+                                                "rid": "trace-9"}))
+    assert code == 200
+    assert rt.stream_rids == ["trace-9"]
+    assert _hdr(headers, router_mod.RID_HEADER) == "trace-9"
+
+
+def test_mint_rid_is_unique_and_clean_rid_filters():
+    rt = _router(post=lambda *a, **k: (200, {}, []))
+    assert rt.mint_rid() != rt.mint_rid()
+    assert DecodeRouter._clean_rid("  q7 ") == "q7"
+    assert DecodeRouter._clean_rid(17) is None
+    assert DecodeRouter._clean_rid("") is None
+    assert DecodeRouter._clean_rid("a\nb") is None
+    assert DecodeRouter._clean_rid("x" * 200) is None

@@ -250,6 +250,96 @@ def test_replica_sigkill_midburst_loses_zero_requests(router_fleet,
 
 
 # ---------------------------------------------------------------------------
+# fleet observatory (ISSUE 18 acceptance): /fleet aggregation, burn-rate
+# gauges, and trace_report --fleet resolving one cross-process timeline
+# for a request that survived a mid-stream SIGKILL
+# ---------------------------------------------------------------------------
+
+def test_fleet_observatory_resolves_failover_timeline(router_fleet):
+    """Stream through the router, SIGKILL the serving replica mid-
+    stream, then resolve the request's rid TREE across the fleet's
+    live span rings: `trace_report --fleet <router> --request RID` must
+    render one causal timeline spanning the router's route hops (both
+    replicas named) and the surviving replica's serve spans, dominant
+    stall named. (The SIGKILLed process takes its span ring with it —
+    its hop survives in the ROUTER's spans, which is exactly why the
+    router records one per attempt.) Also: GET /fleet aggregates both
+    replicas and the pre-declared burn-rate gauge matrix renders on
+    the router's /metrics."""
+    port = router_fleet
+    _wait_fleet_healthy(port, deadline_s=120)
+    ids = SHARED + [17, 18, 19, 20]
+
+    # -- the surviving-failover request ---------------------------------
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps({"ids": ids, "new_tokens": 8,
+                         "stream": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    resp = urllib.request.urlopen(req, timeout=180)
+    rid = resp.headers.get("X-PipeEdge-Rid")
+    victim = resp.headers.get("X-PipeEdge-Replica")
+    assert rid and victim, "router must echo identity headers on streams"
+    lines = []
+    it = iter(resp)
+    for raw in it:                     # let a couple of steps flow
+        if raw.strip():
+            lines.append(json.loads(raw))
+        if len(lines) >= 2:
+            break
+    pid = _get(port, "/healthz")["workers"][victim[1:]]["pid"]
+    os.kill(pid, signal.SIGKILL)
+    for raw in it:
+        if raw.strip():
+            lines.append(json.loads(raw))
+    final = lines[-1]
+    assert "error" not in final, final
+    steps = [l["step"] for l in lines if "step" in l]
+    assert steps == list(range(8)), steps      # replay suppressed
+    survivor = final["replica"]
+    assert survivor != victim
+    assert final["rid"] == rid
+
+    # -- one federated causal timeline ----------------------------------
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_report.py"),
+         "--fleet", f"http://127.0.0.1:{port}", "--request", rid],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert out.returncode == 0, out.stdout + out.stderr
+    rec = json.loads(out.stdout)
+    assert rec["found"], rec
+    # the whole derivation tree resolved from the BASE rid
+    assert rid in rec["rids"]
+    assert f"{rid}.fo1" in rec["rids"]
+    # router hops name BOTH replicas; >= 2 processes contributed spans
+    assert f"route/{victim}" in rec["segments"]
+    assert f"route/{survivor}" in rec["segments"]
+    assert len(rec["ranks"]) >= 2
+    contributing = {rec["processes"][str(r)]["target"]
+                    for r in rec["ranks"]}
+    assert "router" in contributing
+    assert survivor in contributing    # a REPLICA process's serve spans
+    assert rec["dominant_stall"] and rec["dominant_stall"]["segment"]
+
+    # -- /fleet aggregates every live replica ---------------------------
+    _wait_fleet_healthy(port, deadline_s=120)
+    time.sleep(2.5)                    # let the collector re-scrape all
+    fleet = _get(port, "/fleet")
+    assert set(fleet["replicas"]) >= {"r0", "r1"}
+    cls = fleet["classes"]["interactive"]
+    assert cls["requests_total"] > 0
+    assert fleet["slo"]["burn_rate"]["interactive"]["short"] is not None
+
+    # -- burn-rate gauge matrix pre-declared on the router (PL501) ------
+    text = _metrics(port)
+    for klass in ("interactive", "batch", "best_effort"):
+        for window in ("short", "long"):
+            assert (f'pipeedge_slo_burn_rate{{class="{klass}",'
+                    f'window="{window}"}}') in text
+
+
+# ---------------------------------------------------------------------------
 # --host (the non-loopback prerequisite, shipped as its own change)
 # ---------------------------------------------------------------------------
 

@@ -16,16 +16,22 @@ Gated metrics (direction-aware):
 - `serve.slo_attainment.<class>`    higher is better
 - `serve.shed.error`                ZERO tolerance (any error regresses)
 
-Noise bands: each metric's band is the LARGEST of (a) the baseline
-record's own relative spread when it carries samples (`throughput.spread`
-— the honest per-session wobble the record measured about itself),
-(b) the per-metric default, (c) any `--noise NAME=FRACTION` override.
-Overrides match by plain string prefix on the metric path (longest
-match wins): `--noise serve.goodput=0.5` covers every
-`serve.goodput_rps.<class>`, `--noise latency_ms=2.0` covers all three
-percentiles, `--noise throughput=0.5` covers only `throughput`. An
-override that matches NO metric in any compared scenario is reported
-to stderr — a typo must not silently leave the default band in force.
+Noise bands: each metric's band starts from the per-metric default,
+which a baseline record may REPLACE per metric via its own
+`noise_bands` map ({metric-path-prefix: band}, longest prefix wins) —
+the committed-baseline author's way to TIGHTEN a band below the
+default for metrics that record has shown to be stable (ROADMAP item:
+calibrated noise bands instead of one-size-fits-all). The effective
+band is then the LARGEST of that, (a) the baseline record's own
+relative spread when it carries samples (`throughput.spread` — the
+honest per-session wobble the record measured about itself), and
+(b) any `--noise NAME=FRACTION` override. Overrides match by plain
+string prefix on the metric path (longest match wins): `--noise
+serve.goodput=0.5` covers every `serve.goodput_rps.<class>`, `--noise
+latency_ms=2.0` covers all three percentiles, `--noise
+throughput=0.5` covers only `throughput`. An override that matches NO
+metric in any compared scenario is reported to stderr — a typo must
+not silently leave the default band in force.
 A change within the band is noise; beyond it against the metric's
 direction is a regression; beyond it in favor is an improvement
 (reported, never gated).
@@ -72,13 +78,15 @@ METRIC_DEFAULTS: Dict[str, Tuple[int, float]] = {
     "mfu.calibrated": (+1, 0.10),
     "quality.top1_agreement_vs_exact": (+1, 0.005),
     "serve.goodput_rps": (+1, 0.20),
-    "serve.slo_attainment": (+1, 0.15),
+    # attainment is machine-independent (a fraction of admitted
+    # requests, not a rate) — 5% is plenty even on shared runners
+    "serve.slo_attainment": (+1, 0.05),
     "serve.shed.error": (-1, 0.0),
     "kv.errors": (-1, 0.0),
     "kv.decode_p99_ms": (-1, 0.50),
     "kv.chunked.burst_decode_p99_ms": (-1, 0.50),
     "kv.chunked.goodput_rps": (+1, 0.20),
-    "kv.chunked.attainment": (+1, 0.15),
+    "kv.chunked.attainment": (+1, 0.05),
 }
 
 
@@ -138,13 +146,23 @@ def metric_direction(path: str) -> int:
 
 def noise_band(path: str, baseline: dict,
                overrides: Dict[str, float]) -> float:
-    """max(record's own measured spread, per-metric default, override)."""
+    """max(record band, record's own measured spread, override), where
+    the record band is the baseline record's per-metric `noise_bands`
+    entry (longest-prefix match) when present — it REPLACES the
+    per-metric default, so a committed baseline can tighten a band
+    below the one-size-fits-all default — else the default."""
     override = _override_band(overrides, path)
     band = 0.10
     for prefix in sorted(METRIC_DEFAULTS, key=len, reverse=True):
         if path == prefix or path.startswith(prefix + "."):
             band = METRIC_DEFAULTS[prefix][1]
             break
+    record_bands = baseline.get("noise_bands")
+    if isinstance(record_bands, dict):
+        record_band = _override_band(
+            {k: float(v) for k, v in record_bands.items()}, path)
+        if record_band is not None:
+            band = record_band
     if path == "throughput":
         thr = baseline.get("throughput") or {}
         spread = thr.get("spread")

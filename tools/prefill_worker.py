@@ -48,6 +48,11 @@ def main():
                         "beat death on either side tears the edge down "
                         "cleanly")
     p.add_argument("--heartbeat-miss", default=5, type=int)
+    p.add_argument("--http-port", default=0, type=int,
+                   help="observability listener port (GET /metrics, "
+                        "/healthz, /debug/spans) — the router's fleet "
+                        "collector and trace_report --fleet scrape it; "
+                        "0 disables")
     args = p.parse_args()
     if not 0 < args.rank < args.world:
         p.error(f"rank must be in [1, {args.world - 1}] (rank 0 is the "
@@ -67,6 +72,14 @@ def main():
     # base_port is the no---dcn-addrs default branch only (dead while
     # the flag is required); every rank must seed the SAME base so a
     # future optional-addrs mode still agrees on peer addresses
+    # span ring on from the start: /debug/spans federates this rank's
+    # prefill spans into trace_report --fleet timelines
+    from pipeedge_tpu import telemetry
+    telemetry.configure(rank=args.rank)
+    http_server = None
+    if args.http_port:
+        http_server = _start_http(args.http_port, args.rank)
+
     addrs = dcn.parse_rank_addrs(args.dcn_addrs, args.world, 29600)
     ctx = dcn.DistDcnContext(args.world, args.rank, addrs)
     ctx.init()
@@ -100,7 +113,55 @@ def main():
     finally:
         print(f"prefill worker rank {args.rank} exiting "
               f"({loop.leases_served} lease(s) served)", flush=True)
+        if http_server is not None:
+            http_server.shutdown()
         ctx.shutdown()
+
+
+def _start_http(port: int, rank: int):
+    """Tiny observability listener (daemon thread): the same three
+    read-only endpoints every other fleet process serves — /metrics
+    (Prometheus text), /healthz, /debug/spans (ring drain with clock-
+    offset stamps). No mutation surface: leases arrive over DCN only."""
+    import json
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from pipeedge_tpu.telemetry import collector as fleet_obs
+    from pipeedge_tpu.telemetry import metrics as prom
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):   # noqa: N802 — stdlib name
+            pass
+
+        def _send(self, code, body, ctype="application/json"):
+            data = (body if isinstance(body, bytes)
+                    else json.dumps(body).encode("utf8")
+                    if not isinstance(body, str) else body.encode("utf8"))
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):   # noqa: N802 — stdlib name
+            path = self.path.split("?", 1)[0]
+            if path == "/metrics":
+                self._send(200, prom.REGISTRY.render(),
+                           ctype="text/plain; version=0.0.4")
+            elif path == "/healthz":
+                self._send(200, {"ok": True, "role": "prefill_worker",
+                                 "rank": rank, "pid": os.getpid()})
+            elif path == "/debug/spans":
+                drain = "drain=0" not in self.path
+                self._send(200, fleet_obs.debug_spans_payload(drain=drain))
+            else:
+                self._send(404, {"error": f"no route {path}"})
+
+    server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True,
+                     name="prefill-http").start()
+    return server
 
 
 if __name__ == "__main__":

@@ -152,6 +152,7 @@ from pipeedge_tpu.serving import (AdmissionController,  # noqa: E402
                                   DeadlineExceeded, REQUEST_CLASSES,
                                   Watermarks, default_policies,
                                   parse_class_map)
+from pipeedge_tpu.telemetry import collector as fleet_obs  # noqa: E402
 from pipeedge_tpu.telemetry import flight  # noqa: E402
 from pipeedge_tpu.telemetry import metrics as prom  # noqa: E402
 from pipeedge_tpu.utils.threads import make_condition, make_lock  # noqa: E402
@@ -159,6 +160,30 @@ from pipeedge_tpu.utils.threads import make_condition, make_lock  # noqa: E402
 # request outcomes the per-class counter tracks (the request-class x
 # outcome matrix — pre-declared at service construction, pipelint PL501)
 REQUEST_OUTCOMES = ("ok", "shed", "deadline", "degraded", "error")
+
+# hop-propagation header (serving/router.py mints the fleet-level rid
+# and carries it here; a replica mints its own `q<n>` only when the
+# header is absent — direct, unrouted requests)
+RID_HEADER = "X-PipeEdge-Rid"
+
+
+def _header_rid(headers) -> Optional[str]:
+    """A sane caller-supplied rid from the request headers, else None
+    (it lands in span rings, logs, and postmortem filenames — bound
+    and sanitize it)."""
+    raw = headers.get(RID_HEADER)
+    if not raw:
+        return None
+    rid = raw.strip()
+    if not rid or len(rid) > 128 or not rid.isprintable():
+        return None
+    return rid
+
+
+def _rid_headers(rid) -> tuple:
+    """Response-header echo of the request id (ops cross-reference a
+    client complaint to a bundle without body parsing)."""
+    return ((RID_HEADER, rid),) if rid else ()
 
 
 class ServiceDegraded(RuntimeError):
@@ -187,7 +212,9 @@ class _Service:
                  postmortem_dir=None, kv_pages=0, kv_page_size=16,
                  prefill_fleet=None, prefill_supervisor=None,
                  chunked_prefill=0, step_join=False,
-                 prefill_budget=None, clamp_chunk_tokens=0):
+                 prefill_budget=None, clamp_chunk_tokens=0,
+                 slo_objective=0.99, slo_burn_fast=30.0,
+                 slo_burn_slow=300.0, slo_burn_threshold=10.0):
         from collections import OrderedDict, deque
 
         from pipeedge_tpu.parallel.batcher import (ContinuousBatcher,
@@ -269,6 +296,14 @@ class _Service:
         # flight recorder (docs/OBSERVABILITY.md): always-on event ring +
         # postmortem bundles on 504 / shed / failover / SLO breach
         self.flight = flight.configure(rank=0, out_dir=postmortem_dir)
+        # local SLO burn-rate engine (ticked by the governor loop): the
+        # per-class outcome counter above feeds the pre-declared
+        # pipeedge_slo_burn_rate{class,window} matrix; a fast-window
+        # breach writes ONE slo_burn postmortem per overload episode
+        self.burn = fleet_obs.BurnRateEngine(
+            objective=slo_objective, fast_window_s=slo_burn_fast,
+            slow_window_s=slo_burn_slow, threshold=slo_burn_threshold,
+            on_breach=self._on_slo_burn)
         self.m_degraded = prom.REGISTRY.counter(
             "pipeedge_serve_degraded_entered_total",
             "failover windows opened via POST /degraded")
@@ -388,12 +423,12 @@ class _Service:
                 # idle prefix pages before any request class is shed
                 self.brownout.evict_hook = self.kv_backend.evict_cold_all
         # the governor also owns the paged-KV orphan sweep (leak audit,
-        # docs/FAULT_TOLERANCE.md): it runs whenever EITHER duty exists
-        if brownout_enabled or self.kv_backend is not None:
-            self._governor = threading.Thread(target=self._governor_loop,
-                                              daemon=True,
-                                              name="brownout-governor")
-            self._governor.start()
+        # docs/FAULT_TOLERANCE.md) and the SLO burn-rate tick; the burn
+        # engine always exists, so the thread always runs
+        self._governor = threading.Thread(target=self._governor_loop,
+                                          daemon=True,
+                                          name="brownout-governor")
+        self._governor.start()
 
     def _on_step(self):
         """Executor decode-step hook (--step-join): re-drive the EDF
@@ -527,6 +562,17 @@ class _Service:
             live |= self.spec.live_rids()
         return live
 
+    def _on_slo_burn(self, cls, burn):
+        """BurnRateEngine breach hook (edge-triggered, governor thread):
+        capture the serving state that burned the budget."""
+        self.flight.note("slo_burn_breach", request_class=cls,
+                         burn=round(burn, 3))
+        ctx = self.bundle_context()
+        ctx["slo_burn"] = {"class": cls, "burn_rate": round(burn, 4),
+                           "objective": self.burn.objective,
+                           "threshold": self.burn.threshold}
+        self.flight.maybe_dump("slo_burn", context=ctx)
+
     def _governor_loop(self):
         """Periodic brownout tick: windowed p95 of the request-latency
         histogram (delta between scrapes of the SAME instrument /metrics
@@ -550,6 +596,8 @@ class _Service:
             prev_counts, prev_n = counts, n
             depth = (self.admission.queue_depth
                      if self.admission is not None else 0)
+            self.burn.update(fleet_obs.BurnRateEngine.counts_from_counter(
+                self.m_class_outcome))
             if self.brownout is not None:
                 self.brownout.set_floor(
                     1 if self.degraded_info is not None else 0)
@@ -1341,6 +1389,7 @@ def make_handler(service, model_name):
             self.send_response(200)
             self.send_header("Content-Type", "application/x-ndjson")
             self.send_header("Transfer-Encoding", "chunked")
+            self.send_header(RID_HEADER, rid)
             self.end_headers()
             steps = 0
             first_ms = None
@@ -1404,6 +1453,12 @@ def make_handler(service, model_name):
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif self.path.split("?", 1)[0] == "/debug/spans":
+                # per-process span-ring drain (trace_report --fleet
+                # federation; ?drain=0 peeks without clearing)
+                drain = "drain=0" not in self.path
+                self._send(200,
+                           fleet_obs.debug_spans_payload(drain=drain))
             elif self.path == "/healthz":
                 dead = service.dead is not None
                 deg = service.degraded_info
@@ -1500,11 +1555,14 @@ def make_handler(service, model_name):
                         deadline_s = float(req["deadline_ms"]) / 1e3
                         if deadline_s <= 0:
                             raise ValueError("deadline_ms must be > 0")
-                    # mint the request id HERE, before any admission
+                    # the rid arrives on X-PipeEdge-Rid when a router
+                    # (or any tracing caller) already minted it — honor
+                    # it so the fleet-wide trace stays one tree; mint
+                    # HERE only when absent, before any admission
                     # decision: every outcome (200/503/504) names the
                     # same rid, so a loadgen worst-N entry or a 504 body
                     # cross-references the trace and postmortem bundles
-                    rid = service.mint_rid()
+                    rid = _header_rid(self.headers) or service.mint_rid()
                     if req.get("speculative"):
                         if req.get("temperature") or req.get("top_k") \
                                 or req.get("eos_token") is not None \
@@ -1530,7 +1588,8 @@ def make_handler(service, model_name):
                                 prefix_id=req.get("prefix_id"),
                                 request_class=request_class,
                                 deadline_s=deadline_s, rid=rid)
-                        self._send(200, {"ids": out.tolist(), "rid": rid})
+                        self._send(200, {"ids": out.tolist(), "rid": rid},
+                                   headers=_rid_headers(rid))
                     else:
                         kw = dict(
                             temperature=float(req.get("temperature", 0.0)),
@@ -1548,7 +1607,8 @@ def make_handler(service, model_name):
                                 request_class=request_class,
                                 deadline_s=deadline_s, rid=rid, **kw)
                             self._send(200, {"ids": out.tolist(),
-                                             "rid": rid})
+                                             "rid": rid},
+                                       headers=_rid_headers(rid))
                 else:
                     self._send(404, {"error": "unknown path"})
             except (KeyError, ValueError, TypeError, IndexError) as exc:
@@ -1561,7 +1621,8 @@ def make_handler(service, model_name):
                                  "class": exc.request_class,
                                  "reason": exc.reason, "rid": rid},
                            headers=(("Retry-After",
-                                     f"{exc.retry_after:g}"),))
+                                     f"{exc.retry_after:g}"),)
+                           + _rid_headers(rid))
             except DeadlineExceeded as exc:
                 # the deadline expired while EXECUTING: the executor
                 # cancelled it at a decode-step boundary (no Retry-After —
@@ -1570,7 +1631,8 @@ def make_handler(service, model_name):
                 # just triggered (flight recorder).
                 self._send(504, {"error": str(exc),
                                  "deadline_exceeded": True,
-                                 "class": exc.request_class, "rid": rid})
+                                 "class": exc.request_class, "rid": rid},
+                           headers=_rid_headers(rid))
             except ServiceDegraded as exc:
                 # a degraded window is transient by contract: tell the
                 # client exactly when to come back instead of hanging it
@@ -1578,7 +1640,8 @@ def make_handler(service, model_name):
                                  "degraded": True,
                                  "dead_rank": exc.dead_rank, "rid": rid},
                            headers=(("Retry-After",
-                                     f"{exc.retry_after:g}"),))
+                                     f"{exc.retry_after:g}"),)
+                           + _rid_headers(rid))
             except RuntimeError as exc:
                 # every 503 carries a Retry-After (docs/SERVING.md audit):
                 # even a dead-worker 503 names the best current estimate
@@ -1812,13 +1875,29 @@ class PrefillWorkerSupervisor(WorkerSupervisor):
     LABEL = "prefill worker"
     TAG = "prefill"
 
-    def __init__(self, worker_cmd, ranks, respawn=True):
+    def __init__(self, worker_cmd, ranks, respawn=True, http_ports=None):
         self._cmd = list(worker_cmd)      # without rank; appended per rank
+        # rank -> observability HTTP port (each worker serves /metrics +
+        # /debug/spans there, so the fleet collector and trace_report
+        # --fleet reach prefill processes too)
+        self._http_ports = dict(http_ports or {})
         super().__init__(ranks, respawn=respawn)
 
     def _argv(self, rank):
-        return [sys.executable] + self._cmd[:1] + [str(rank)] \
+        argv = [sys.executable] + self._cmd[:1] + [str(rank)] \
             + self._cmd[1:]
+        port = self._http_ports.get(rank)
+        if port:
+            argv += ["--http-port", str(port)]
+        return argv
+
+    def snapshot(self):
+        out = super().snapshot()
+        for rank, port in self._http_ports.items():
+            rec = out.get(str(rank))
+            if rec is not None:
+                rec["http_url"] = f"http://127.0.0.1:{port}"
+        return out
 
     def _env(self, rank):
         env = super()._env(rank)
@@ -1873,10 +1952,12 @@ def _free_ports(n, host="127.0.0.1"):
     return ports
 
 
-def make_router_handler(router, model_name):
+def make_router_handler(router, model_name, collector=None):
     """HTTP surface of `--role router`: the same endpoint shapes a
     single replica serves (clients need no code change), backed by the
-    DecodeRouter instead of a local pipeline."""
+    DecodeRouter instead of a local pipeline. `collector` (a
+    FleetCollector) backs GET /fleet — the one aggregated scrape
+    surface across router + replicas + prefill workers."""
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"      # chunked transfer needs 1.1
@@ -1908,6 +1989,20 @@ def make_router_handler(router, model_name):
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif self.path == "/fleet":
+                if collector is None:
+                    self._send(503, {"error": "fleet collector disabled "
+                                              "(--fleet-scrape-interval "
+                                              "0)"},
+                               headers=(("Retry-After", "5"),))
+                else:
+                    self._send(200, collector.fleet_snapshot())
+            elif self.path.split("?", 1)[0] == "/debug/spans":
+                # the router's own span ring (trace_report --fleet
+                # federation; ?drain=0 peeks without clearing)
+                drain = "drain=0" not in self.path
+                self._send(200,
+                           fleet_obs.debug_spans_payload(drain=drain))
             elif self.path == "/healthz":
                 code, body = router.healthz()
                 body["model"] = model_name
@@ -1958,6 +2053,10 @@ def make_router_handler(router, model_name):
                         self.send_header("Content-Type",
                                          "application/x-ndjson")
                         self.send_header("Transfer-Encoding", "chunked")
+                        for name, value in headers:
+                            # the identity echo (X-PipeEdge-Rid /
+                            # -Replica) rides the stream headers too
+                            self.send_header(name, value)
                         self.end_headers()
                         streaming = True
                     else:
@@ -2025,7 +2124,11 @@ def _run_router(args):
             "--brownout-dwell-up", str(args.brownout_dwell_up),
             "--brownout-dwell-down", str(args.brownout_dwell_down),
             "--brownout-clamp-tokens", str(args.brownout_clamp_tokens),
-            "--brownout-clamp-chunk", str(args.brownout_clamp_chunk)]
+            "--brownout-clamp-chunk", str(args.brownout_clamp_chunk),
+            "--slo-objective", str(args.slo_objective),
+            "--slo-burn-fast", str(args.slo_burn_fast),
+            "--slo-burn-slow", str(args.slo_burn_slow),
+            "--slo-burn-threshold", str(args.slo_burn_threshold)]
         if args.partition:
             base_cmd += ["-pt", args.partition]
         if args.max_active is not None:
@@ -2053,19 +2156,53 @@ def _run_router(args):
         replicas = {f"r{i}": f"http://{args.host}:{port}"
                     for i, port in enumerate(ports)}
     router = DecodeRouter(replicas, policy=policy, supervisor=supervisor)
+    # the router is a peer process of the fleet observatory: span ring
+    # for /debug/spans, flight recorder for slo_burn postmortems
+    telemetry.configure(rank=0)
+    router_flight = flight.configure(rank=0,
+                                     out_dir=args.postmortem_dir)
+    collector = None
+    if args.fleet_scrape_interval > 0:
+        def _on_breach(cls, burn):
+            router_flight.note("slo_burn_breach", rid=None,
+                               request_class=cls,
+                               burn=round(burn, 3))
+            router_flight.maybe_dump(
+                "slo_burn",
+                context={"class": cls, "burn_rate": round(burn, 4),
+                         "window": "short",
+                         "objective": args.slo_objective,
+                         "threshold": args.slo_burn_threshold,
+                         "fleet": router.registry.snapshot()})
+        burn = fleet_obs.BurnRateEngine(
+            objective=args.slo_objective,
+            fast_window_s=args.slo_burn_fast,
+            slow_window_s=args.slo_burn_slow,
+            threshold=args.slo_burn_threshold,
+            on_breach=_on_breach)
+        collector = fleet_obs.FleetCollector(
+            router.scrape_targets,
+            interval_s=args.fleet_scrape_interval,
+            history=args.fleet_history,
+            burn=burn)
     if supervisor is not None:
         for i, name in enumerate(replicas):
             router.bind_rank(name, i)
         supervisor.wait_ready(timeout=600.0)
     router.start()
+    if collector is not None:
+        collector.start()
     server = ThreadingHTTPServer(
         (args.host, args.port),
-        make_router_handler(router, args.model_name))
+        make_router_handler(router, args.model_name,
+                            collector=collector))
     print(f"serving router ({len(replicas)} replicas) on "
           f"{args.host}:{args.port}", flush=True)
     try:
         server.serve_forever()
     finally:
+        if collector is not None:
+            collector.stop()
         router.stop()
         if supervisor is not None:
             supervisor.stop()
@@ -2251,6 +2388,29 @@ def main():
                         "(default: env PIPEEDGE_POSTMORTEM_DIR or "
                         "./postmortems); bundles are written on 504s, "
                         "sheds, failover, SLO breach, and POST /debug/dump")
+    p.add_argument("--fleet-scrape-interval", default=1.0, type=float,
+                   metavar="S",
+                   help="(router) period of the fleet collector's "
+                        "/metrics scrape across replicas and prefill "
+                        "workers — feeds GET /fleet and the SLO burn-"
+                        "rate engine (<= 0 disables; /fleet then 503s)")
+    p.add_argument("--fleet-history", default=120, type=int,
+                   help="(router) scrape samples retained per target "
+                        "(the /fleet rate window is bounded by "
+                        "history * scrape interval)")
+    p.add_argument("--slo-objective", default=0.99, type=float,
+                   help="per-class SLO objective (good-request fraction) "
+                        "the burn-rate engine budgets against")
+    p.add_argument("--slo-burn-fast", default=30.0, type=float,
+                   metavar="S",
+                   help="short burn-rate window (s) — breaching "
+                        "threshold here triggers one slo_burn "
+                        "postmortem bundle per episode")
+    p.add_argument("--slo-burn-slow", default=300.0, type=float,
+                   metavar="S", help="long burn-rate window (s)")
+    p.add_argument("--slo-burn-threshold", default=10.0, type=float,
+                   help="short-window burn rate that counts as a breach "
+                        "(10 = burning a 30d budget in ~3d)")
     p.add_argument("--inject-stall", default=None, metavar="STAGE:MS",
                    help="chaos hook (tests/CI only): sleep MS ms inside "
                         "every step of pipeline stage STAGE — the "
@@ -2347,9 +2507,15 @@ def main():
             str(args.prefill_heartbeat_interval)]
         if args.partition:
             worker_cmd += ["-pt", args.partition]
+        # per-worker observability listeners (GET /metrics, /healthz,
+        # /debug/spans): the replica's /healthz exposes each worker's
+        # http_url, and the router's fleet collector scrapes them
+        pf_http = dict(zip(range(1, world),
+                           _free_ports(args.prefill_ranks, args.host)))
         prefill_supervisor = PrefillWorkerSupervisor(
             worker_cmd, ranks=range(1, world),
-            respawn=not args.no_prefill_respawn)
+            respawn=not args.no_prefill_respawn,
+            http_ports=pf_http)
         ship_ctx = dcn.DistDcnContext(world, 0, addrs)
         ship_ctx.init()
         prefill_supervisor.wait_ready()
@@ -2372,8 +2538,10 @@ def main():
             ship_bits=args.kv_ship_bits,
             max_concurrent=args.prefill_concurrency)
 
-    if args.trace_spans:
-        telemetry.configure(rank=0)
+    # spans are always on in serving processes: GET /debug/spans drains
+    # the ring for trace_report --fleet federation without pre-arming.
+    # --trace-spans keeps controlling only the shutdown trace dump.
+    telemetry.configure(rank=0)
     from pipeedge_tpu.analysis import lockdep
     if args.trace_spans or lockdep.enabled():
         # SIGTERM must unwind through the finally below (the default
@@ -2409,7 +2577,11 @@ def main():
                        chunked_prefill=args.chunked_prefill,
                        step_join=args.step_join,
                        prefill_budget=args.prefill_budget,
-                       clamp_chunk_tokens=args.brownout_clamp_chunk)
+                       clamp_chunk_tokens=args.brownout_clamp_chunk,
+                       slo_objective=args.slo_objective,
+                       slo_burn_fast=args.slo_burn_fast,
+                       slo_burn_slow=args.slo_burn_slow,
+                       slo_burn_threshold=args.slo_burn_threshold)
     if prefill_fleet is not None and hasattr(prefill_fleet,
                                              "flight_note"):
         # ship-plane faults (lease timeouts, zombie drops, worker

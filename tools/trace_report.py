@@ -61,9 +61,13 @@ import argparse
 import json
 import os
 import sys
+import time
+import urllib.error
+import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import pipeedge_tpu.telemetry as telemetry  # noqa: E402
 from pipeedge_tpu.telemetry import chrome_trace, feedback, report  # noqa: E402
 
 
@@ -122,11 +126,86 @@ def _load_spans(path: str):
     return chrome_trace.trace_to_spans(doc), None
 
 
+def _fetch_json(url: str, timeout: float) -> dict:
+    """GET url -> parsed JSON; an HTTP error status with a JSON body
+    (the router's 503 /healthz while unroutable) still parses."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return json.loads(resp.read().decode("utf8"))
+    except urllib.error.HTTPError as exc:
+        return json.loads(exc.read().decode("utf8"))
+
+
+def _fleet_targets(fleet_url: str, timeout: float) -> dict:
+    """{name: base_url} of every process in the routed fleet: the router
+    itself, plus whatever GET /fleet (the collector's target set —
+    includes prefill workers) or, failing that, GET /healthz's fleet
+    block reports."""
+    targets = {"router": fleet_url}
+    try:
+        body = _fetch_json(f"{fleet_url}/fleet", timeout)
+        for name, url in (body.get("targets") or {}).items():
+            targets.setdefault(name, url)
+    except (OSError, ValueError):
+        pass
+    if len(targets) == 1:
+        body = _fetch_json(f"{fleet_url}/healthz", timeout)
+        for name, rec in (body.get("fleet") or {}).items():
+            url = (rec or {}).get("url")
+            if url:
+                targets.setdefault(name, url)
+    return targets
+
+
+def _collect_fleet(fleet_url: str, timeout: float = 5.0):
+    """Federate span rings across the routed fleet: GET /debug/spans
+    from every process, estimate each peer's monotonic-clock offset
+    from the fetch's own (t0, t1, t2, t3) quadruple (telemetry.
+    estimate_clock_offset), align onto the caller's timeline, and remap
+    each process's span `rank` to a distinct per-process index (every
+    serving process records rank 0 locally — without the remap two
+    replicas would collapse into one lane). Returns (spans, processes).
+    """
+    fleet_url = fleet_url.rstrip("/")
+    spans = []
+    processes = {}
+    for idx, (name, url) in enumerate(
+            sorted(_fleet_targets(fleet_url, timeout).items())):
+        proc = {"target": name, "url": url}
+        try:
+            t0 = time.monotonic_ns()
+            body = _fetch_json(f"{url.rstrip('/')}/debug/spans", timeout)
+            t3 = time.monotonic_ns()
+            theta = telemetry.estimate_clock_offset(
+                [(t0, int(body["t_recv_ns"]), int(body["t_send_ns"]), t3)])
+            aligned = telemetry.align_spans(body.get("spans") or (), theta)
+            for s in aligned:
+                s["rank"] = idx
+            spans.extend(aligned)
+            proc.update({"ok": True, "pid": body.get("pid"),
+                         "spans": len(aligned),
+                         "dropped": body.get("dropped", 0),
+                         "offset_ns": theta, "rtt_ns": t3 - t0})
+        except (OSError, ValueError, KeyError, TypeError) as exc:
+            proc.update({"ok": False, "error": str(exc)})
+        processes[str(idx)] = proc
+    return spans, processes
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    p.add_argument("trace", help="merged trace JSON from --trace-spans "
-                                 "(Chrome trace-event format), or a "
-                                 "flight-recorder postmortem bundle")
+    p.add_argument("trace", nargs="?", default=None,
+                   help="merged trace JSON from --trace-spans "
+                        "(Chrome trace-event format), or a "
+                        "flight-recorder postmortem bundle "
+                        "(omit with --fleet)")
+    p.add_argument("--fleet", metavar="URL", default=None,
+                   help="federate LIVE span rings instead of reading a "
+                        "trace file: GET /debug/spans from the router at "
+                        "URL and every replica/prefill worker it knows, "
+                        "clock-aligned onto one timeline (each drain "
+                        "consumes the rings — a second run sees only "
+                        "newer spans)")
     p.add_argument("--request", metavar="RID", default=None,
                    help="render ONE request's causal timeline (admit -> "
                         "queue -> per-mb per-stage per-edge -> retire) "
@@ -162,18 +241,30 @@ def main() -> int:
     args = p.parse_args()
     if args.emit_profiles and not (args.partition and args.model):
         p.error("--emit-profiles requires --partition and --model")
+    if (args.trace is None) == (args.fleet is None):
+        p.error("give a trace file OR --fleet URL (exactly one)")
 
-    spans, bundle = _load_spans(args.trace)
+    processes = None
+    bundle = None
+    if args.fleet is not None:
+        spans, processes = _collect_fleet(args.fleet)
+    else:
+        spans, bundle = _load_spans(args.trace)
+    source = args.fleet if args.fleet is not None else args.trace
     if args.request is not None:
         record = report.request_timeline(spans, args.request)
-        record["trace"] = args.trace
+        record["trace"] = source
+        if processes is not None:
+            record["processes"] = processes
         if bundle is not None:
             record["bundle_trigger"] = bundle.get("trigger")
         print(json.dumps(record, indent=2 if args.indent else None,
                          sort_keys=True))
         return 0 if record.get("found") else 3
     record = report.analyze_spans(spans)
-    record["trace"] = args.trace
+    record["trace"] = source
+    if processes is not None:
+        record["processes"] = processes
     print(json.dumps(record, indent=2 if args.indent else None,
                      sort_keys=True))
     if args.emit_profiles:
