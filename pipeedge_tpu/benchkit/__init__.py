@@ -125,8 +125,8 @@ def _ensure_loaded() -> None:
     # re-raise on the next lookup, not leave a silently partial registry
     # (sys.modules caches the modules that DID import, and register()
     # only runs at first import, so a retry never double-registers)
-    from . import (fleet, headline, offline, serve_bench,  # noqa: F401
-                   serve_kv_bench)  # noqa: F401
+    from . import (fleet, headline, int8_compute, offline,  # noqa: F401
+                   serve_bench, serve_kv_bench)  # noqa: F401
     _loaded = True
 
 

@@ -60,6 +60,66 @@ class TransformerConfig:
         return (self.image_size // self.patch_size) ** 2
 
 
+@dataclasses.dataclass(frozen=True)
+class QuantizeCompute:
+    """Int8 compute-path config (ops/int8_matmul.py).
+
+    `enabled` routes every TAGGED dense (ViT's attention projections,
+    attn-out, and the FFN pair — untagged call sites always stay exact)
+    through the block-scaled int8 matmul. `skip_tags` is the per-layer
+    opt-out for numerically fragile layers (e.g. frozenset({"head"}));
+    `clamp_alphas` maps tags to calibrated Banner clip thresholds
+    (utils/calibrate.py sidecar); `tunnel` additionally lets a stage's
+    first matmul consume the 8-bit wire payload directly
+    (parallel/pipeline.py seam, ops/int8_matmul.wire_dense).
+
+    TRACE-TIME config, like fast numerics: programs compiled while a
+    config is active keep it — set it BEFORE building/first-calling a
+    model.
+    """
+    enabled: bool = False
+    block_k: int = 128
+    skip_tags: frozenset = frozenset()
+    clamp_alphas: Optional[dict] = None
+    tunnel: bool = False
+
+
+_QC_OFF = QuantizeCompute()
+_QUANTIZE_COMPUTE = None   # None = unset (consult the env var)
+_QC_OBSERVER = None        # calibration hook: fn(tag, x) per tagged dense
+
+
+def set_quantize_compute(cfg) -> None:
+    """Install the int8 compute-path config.
+
+    `cfg` is a `QuantizeCompute`, True/False (defaults / off), or None to
+    RESET: discard the programmatic choice and defer to the env again
+    (PIPEEDGE_QUANTIZE_COMPUTE=1 enables the defaults,
+    PIPEEDGE_QUANTIZE_SKIP=tag,tag populates the opt-out) — the same
+    setter-wins-but-None-restores contract as `set_fast_numerics`.
+    """
+    global _QUANTIZE_COMPUTE
+    if cfg is None or isinstance(cfg, QuantizeCompute):
+        _QUANTIZE_COMPUTE = cfg
+    else:
+        _QUANTIZE_COMPUTE = QuantizeCompute(enabled=bool(cfg))
+
+
+def quantize_compute() -> QuantizeCompute:
+    """The active int8 compute config (programmatic choice wins; env
+    PIPEEDGE_QUANTIZE_COMPUTE is the fallback; disabled otherwise)."""
+    if _QUANTIZE_COMPUTE is not None:
+        return _QUANTIZE_COMPUTE
+    import os
+    env = os.getenv("PIPEEDGE_QUANTIZE_COMPUTE")
+    if env is not None and env.strip().lower() not in (
+            "", "0", "false", "no", "off"):
+        skip = frozenset(t for t in os.getenv(
+            "PIPEEDGE_QUANTIZE_SKIP", "").split(",") if t)
+        return QuantizeCompute(enabled=True, skip_tags=skip)
+    return _QC_OFF
+
+
 _FAST_NUMERICS = None      # None = unset (consult the env var)
 
 
@@ -114,9 +174,24 @@ def layer_norm(p, x: jax.Array, eps: float) -> jax.Array:
     return (normed * p["scale"] + p["bias"]).astype(x.dtype)
 
 
-def dense(p, x: jax.Array) -> jax.Array:
+def dense(p, x: jax.Array, tag: Optional[str] = None) -> jax.Array:
     """x @ w + b with kernels stored [in, out] (JAX convention; torch state
-    dicts store [out, in] and are transposed at load time)."""
+    dicts store [out, in] and are transposed at load time).
+
+    `tag` names the call site for the int8 compute path: tagged denses
+    route through the block-scaled int8 matmul when a `QuantizeCompute`
+    config is active (and the tag isn't opted out); untagged denses are
+    always exact. The calibration observer hook also keys on tags."""
+    if tag is not None:
+        if _QC_OBSERVER is not None:
+            _QC_OBSERVER(tag, x)
+        qc = quantize_compute()
+        if qc.enabled and tag not in qc.skip_tags:
+            from ..ops import int8_matmul
+            alpha = (qc.clamp_alphas or {}).get(tag)
+            return int8_matmul.int8_dense(
+                x, p["w"], p["b"], block_k=qc.block_k, clamp_alpha=alpha,
+                out_dtype=x.dtype)
     y = jnp.dot(x, p["w"].astype(x.dtype), preferred_element_type=jnp.float32)
     return (y + p["b"]).astype(x.dtype)
 
@@ -169,7 +244,8 @@ def apply_causal_mask(scores: jax.Array) -> jax.Array:
 
 def self_attention(p, x: jax.Array, num_heads: int,
                    mask: Optional[jax.Array] = None,
-                   core_fn=None, causal: bool = False) -> jax.Array:
+                   core_fn=None, causal: bool = False,
+                   tag_prefix: Optional[str] = None) -> jax.Array:
     """Multi-head self-attention context (pre-projection), batched over [B,S,D].
 
     Matches HF `{ViT,Bert}SelfAttention` semantics: returns the concatenated
@@ -181,6 +257,9 @@ def self_attention(p, x: jax.Array, num_heads: int,
     the fused kernel handles it natively (and skips past-frontier K/V
     blocks), so the long-sequence perf path covers decoders too.
 
+    `tag_prefix` tags the q/k/v projections (`<prefix>.q` etc.) for the
+    int8 compute path — see `dense`.
+
     `core_fn(q, k, v) -> ctx` ([B,S,H,D]-shaped) overrides the attention
     core while reusing THIS projection code — how sequence-parallel
     execution swaps in ring attention (parallel/spmd.py). A core_fn is
@@ -189,9 +268,11 @@ def self_attention(p, x: jax.Array, num_heads: int,
     """
     b, s, d = x.shape
     hd = d // num_heads
-    q = dense(p["q"], x).reshape(b, s, num_heads, hd)
-    k = dense(p["k"], x).reshape(b, s, num_heads, hd)
-    v = dense(p["v"], x).reshape(b, s, num_heads, hd)
+    tags = {n: f"{tag_prefix}.{n}" if tag_prefix else None
+            for n in ("q", "k", "v")}
+    q = dense(p["q"], x, tag=tags["q"]).reshape(b, s, num_heads, hd)
+    k = dense(p["k"], x, tag=tags["k"]).reshape(b, s, num_heads, hd)
+    v = dense(p["v"], x, tag=tags["v"]).reshape(b, s, num_heads, hd)
     if core_fn is not None:
         if mask is not None:
             # the override receives no mask; reject the combination rather

@@ -56,6 +56,10 @@ class FamilySpec:
     # sequence-parallel prefill block for position-dependent families:
     # (p, x, bcache, cfg, axis, core, cache_gather) -> (x, bcache)
     sp_prefill_block_step: Any = None
+    # sublayers that LEAD with a dense and accept an 8-bit wire
+    # `QuantizedTensor` as the payload's first tensor (the int8
+    # stage-seam tunnel, parallel/pipeline.py + ops/int8_matmul.py)
+    wire_subs: tuple = ()
 
 
 def _apply_slice(family: FamilySpec, block_params: Dict, data: ShardData,

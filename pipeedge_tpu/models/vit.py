@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import ShardConfig
+from ..ops import quant as quant_ops
 from .layers import TransformerConfig, dense, gelu, layer_norm, patchify, self_attention
 from .shard import FamilySpec, build_shard_params
 
@@ -55,22 +56,40 @@ def sublayer(p: Dict, sub: int, data, cfg: TransformerConfig,
 
     `attention_fn(qkv_params, x, num_heads)` overrides the attention core —
     the hook sequence-parallel execution uses to swap in ring attention
-    over a mesh axis (parallel/spmd.py) without duplicating the block."""
+    over a mesh axis (parallel/spmd.py) without duplicating the block.
+
+    Stage-seam tunnel: subs 1 and 3 lead with a dense, so when a stage
+    boundary lands there the payload's leading tensor may arrive as an
+    8-bit wire `QuantizedTensor` (parallel/pipeline.py leaves it encoded
+    under the QuantizeCompute tunnel) — it feeds the int8 matmul directly
+    via `wire_dense`, no dequant round-trip."""
     if sub == 0:
         normed = layer_norm(p["ln_before"], data, cfg.layer_norm_eps)
-        ctx = (attention_fn or self_attention)(
-            {"q": p["q"], "k": p["k"], "v": p["v"]}, normed,
-            cfg.num_attention_heads)
+        if attention_fn is not None:
+            ctx = attention_fn({"q": p["q"], "k": p["k"], "v": p["v"]},
+                               normed, cfg.num_attention_heads)
+        else:
+            ctx = self_attention({"q": p["q"], "k": p["k"], "v": p["v"]},
+                                 normed, cfg.num_attention_heads,
+                                 tag_prefix="attn")
         return (ctx, data)
     if sub == 1:
         ctx, skip = data
-        return dense(p["attn_out"], ctx) + skip
+        if isinstance(ctx, quant_ops.QuantizedTensor):
+            from ..ops.int8_matmul import wire_dense
+            return wire_dense(p["attn_out"], ctx,
+                              out_dtype=skip.dtype) + skip
+        return dense(p["attn_out"], ctx, tag="attn.out") + skip
     if sub == 2:
         normed = layer_norm(p["ln_after"], data, cfg.layer_norm_eps)
-        return (gelu(dense(p["mlp_up"], normed)), data)
+        return (gelu(dense(p["mlp_up"], normed, tag="mlp.up")), data)
     if sub == 3:
         mlp_h, skip = data
-        return dense(p["mlp_down"], mlp_h) + skip
+        if isinstance(mlp_h, quant_ops.QuantizedTensor):
+            from ..ops.int8_matmul import wire_dense
+            return wire_dense(p["mlp_down"], mlp_h,
+                              out_dtype=skip.dtype) + skip
+        return dense(p["mlp_down"], mlp_h, tag="mlp.down") + skip
     raise ValueError(f"sublayer must be 0..3, got {sub}")
 
 
@@ -82,7 +101,8 @@ def finalize(p: Dict, hidden: jax.Array, cfg: TransformerConfig) -> jax.Array:
     return hidden
 
 
-FAMILY = FamilySpec(name="vit", embed=embed, sublayer=sublayer, finalize=finalize)
+FAMILY = FamilySpec(name="vit", embed=embed, sublayer=sublayer,
+                    finalize=finalize, wire_subs=(1, 3))
 
 
 # --- weight loading -------------------------------------------------------

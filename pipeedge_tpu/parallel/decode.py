@@ -164,6 +164,33 @@ def _int8_kernel_env() -> int:
     return 2 if env == "2" else 1
 
 
+def _resolve_int8_optin(override=None) -> int:
+    """Construction-time resolution of the int8 decode-attend routing
+    (the promotion seam, ISSUE 19): an explicit override — constructor
+    arg `int8_decode_attend` / `--int8-decode-attend` — wins, then the
+    PIPEEDGE_INT8_DECODE_ATTEND env (including an explicit '0' off),
+    then the `QuantizeCompute` compute-path config: enabling int8
+    compute promotes the decode attend under the measured 'auto' width
+    policy (kernel v2 at attend windows <= 256, XLA above). Idempotent
+    on already-resolved ints."""
+    if override is not None:
+        if isinstance(override, str):
+            s = override.strip().lower()
+            if s == "auto":
+                return 3
+            if not s or s in ("0", "false", "no", "off"):
+                return 0
+            return 2 if s == "2" else 1
+        return int(override)
+    import os
+    if os.getenv("PIPEEDGE_INT8_DECODE_ATTEND") is not None:
+        return _int8_kernel_env()
+    from ..models.layers import quantize_compute
+    if quantize_compute().enabled:
+        return 3
+    return 0
+
+
 # the measured crossover: kernel v2 beat XLA at attend widths <= 256 in
 # every chip session (3/3); XLA won at 1024 in every session. 'auto'
 # routes the kernel only below this width.
@@ -412,7 +439,8 @@ def _run_blocks(blocks, x, cache: Cache, pos, cfg: TransformerConfig,
     return jax.lax.scan(body, x, (blocks, cache))
 
 
-def make_stage_fns(family, cfg: TransformerConfig, shard_config: ShardConfig):
+def make_stage_fns(family, cfg: TransformerConfig, shard_config: ShardConfig,
+                   int8_optin=None):
     """(prefill_fn, decode_fn) for one block-aligned pipeline stage.
 
     prefill_fn(params, data, cache)        -> (out, cache)   data: ids|hidden
@@ -420,8 +448,10 @@ def make_stage_fns(family, cfg: TransformerConfig, shard_config: ShardConfig):
 
     First stage embeds token ids (decode positions offset by `pos`); last
     stage applies the final LN + LM head and returns per-token logits.
+    `int8_optin` is the resolved int8 decode-attend routing
+    (`_resolve_int8_optin`; None re-resolves from env/config).
     """
-    run = _make_stage_run(family, cfg, shard_config)
+    run = _make_stage_run(family, cfg, shard_config, int8_optin=int8_optin)
     prefill_fn = jax.jit(partial(run, pos=0, prefill=True))
     # read_len is STATIC: each attend-window bucket compiles its own
     # decode-step program (a handful of power-of-2 variants, the same
@@ -433,7 +463,7 @@ def make_stage_fns(family, cfg: TransformerConfig, shard_config: ShardConfig):
 
 def _make_stage_run(family, cfg: TransformerConfig,
                     shard_config: ShardConfig, block_fn=None,
-                    finalize_fn=None, embed_fn=None):
+                    finalize_fn=None, embed_fn=None, int8_optin=None):
     plan = plan_shard(shard_config)
     if plan.head is not None or plan.tail is not None:
         raise ValueError("decode requires a block-aligned partition "
@@ -447,7 +477,8 @@ def _make_stage_run(family, cfg: TransformerConfig,
         # programs compile cannot leave stale shapes on the old setting
         block_fn = getattr(family, "cached_block_step", None)
         if block_fn is None:
-            block_fn = partial(_block_step, int8_optin=_int8_kernel_env())
+            block_fn = partial(_block_step,
+                               int8_optin=_resolve_int8_optin(int8_optin))
 
     def run(params, data, cache, pos, prefill, read_len=None):
         if shard_config.is_first:
@@ -928,8 +959,8 @@ def build_decode_pipeline(model_name: str,
     so model lookup, per-stage weight loading, and the position-capacity
     clamp cannot drift between tools. `stage_params` supplies already-
     loaded per-stage pytrees (callers that also need them for other
-    drivers); extra kwargs (mesh=/sp_mesh=/ep_mesh=/tp_ep_mesh=/devices=)
-    pass through."""
+    drivers); extra kwargs (mesh=/sp_mesh=/ep_mesh=/tp_ep_mesh=/devices=/
+    int8_decode_attend=) pass through."""
     from ..models import registry
     cfg = registry.get_model_config(model_name)
     total = registry.get_model_layers(model_name)
@@ -965,7 +996,7 @@ class DecodePipeline:
                  cache_bits: int = 0, mesh=None, tp_axis: str = "tp",
                  sp_mesh=None, sp_axis: str = "sp", sp_kind: str = "ring",
                  ep_mesh=None, ep_axis: str = "ep", tp_ep_mesh=None,
-                 attend_floor: int = 64):
+                 attend_floor: int = 64, int8_decode_attend=None):
         total = 4 * cfg.num_hidden_layers
         validate_partition(partition, total)
         validate_capacity(cfg, max_len)
@@ -991,6 +1022,11 @@ class DecodePipeline:
         self.mesh, self.tp_axis = mesh, tp_axis
         self.tp_ep_mesh = tp_ep_mesh
         self.ep_mesh = ep_mesh
+        # int8 decode-attend routing, resolved ONCE here (constructor
+        # arg > env > QuantizeCompute promotion — `_resolve_int8_optin`)
+        # and bound into the stage programs below; later env/config
+        # toggles don't affect this pipeline (round-4 advice)
+        optin = _resolve_int8_optin(int8_decode_attend)
         self.stages = []
         for i, (l, r) in enumerate(partition):
             sc = ShardConfig(l, r, is_first=l == 1, is_last=r == total)
@@ -1026,7 +1062,7 @@ class DecodePipeline:
                     lambda x, s: jax.device_put(x, NamedSharding(m, s)),
                     params, p_specs)
             else:
-                pre, dec = make_stage_fns(family, cfg, sc)
+                pre, dec = make_stage_fns(family, cfg, sc, int8_optin=optin)
                 if sp_mesh is not None:
                     pre = make_sp_prefill_fn(family, cfg, sc, sp_mesh,
                                              axis=sp_axis, sp_kind=sp_kind)
@@ -1039,11 +1075,9 @@ class DecodePipeline:
                                 mesh is not None else devices[i]})
         self.dtype = dtype
         self.cache_bits = cache_bits
-        # construction-time resolution of the int8 decode-kernel opt-in
-        # (the same value _make_stage_run bound into the stage programs),
-        # exposed for introspection — later env toggles don't affect this
-        # pipeline (round-4 advice)
-        self.int8_decode_optin = _int8_kernel_env()
+        # the value bound into the stage programs above, exposed for
+        # introspection
+        self.int8_decode_optin = optin
         self.sp_degree = sp_mesh.shape[sp_axis] if sp_mesh is not None else 1
         # bucketed decode-step attention rides the plain stage programs
         # AND the tp variant (static read_len arg; the tp shard_map

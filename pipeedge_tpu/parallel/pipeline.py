@@ -76,6 +76,22 @@ def _decode_payload(payload):
     return payload
 
 
+def _tunnel_decode_payload(payload):
+    """Tunnel variant of `_decode_payload`: the payload's LEADING tensor
+    stays an 8-bit `QuantizedTensor` — the stage's first sublayer leads
+    with a dense that consumes the wire bytes directly in the int8 matmul
+    (ops/int8_matmul.wire_dense), so the activation crosses the pipeline
+    seam MXU-to-MXU without a dequant round-trip. Trailing tensors (the
+    residual skip) decode normally; non-8-bit payloads fall back."""
+    if isinstance(payload, quant_ops.QuantizedTensor):
+        return payload if payload.bit == 8 else _decode_payload(payload)
+    if isinstance(payload, tuple) and payload and isinstance(
+            payload[0], quant_ops.QuantizedTensor) and payload[0].bit == 8:
+        return (payload[0],) + tuple(
+            _decode_payload(t) for t in payload[1:])
+    return _decode_payload(payload)
+
+
 @dataclasses.dataclass
 class PipelineStage:
     """One pipeline stage: a shard function bound to a device.
@@ -98,6 +114,11 @@ class PipelineStage:
     # head stage, whose input is caller-owned (e.g. replayed across
     # --measure-rounds). build_pipeline sets it for stages > 0.
     donate_payload: bool = False
+    # int8 stage-seam tunnel: leave the input payload's leading 8-bit
+    # wire tensor ENCODED so this stage's first matmul eats it directly
+    # (only set when the stage's first sublayer is wire-consuming —
+    # FamilySpec.wire_subs — and the producing edge runs at 8 bits)
+    tunnel: bool = False
 
     def __post_init__(self):
         self.params = jax.device_put(self.params, self.device)
@@ -107,9 +128,11 @@ class PipelineStage:
         fn = self._compiled.get(bit)
         if fn is None:
             shard_fn, do_clamp = self.shard_fn, self.clamp
+            decode = _tunnel_decode_payload if self.tunnel \
+                else _decode_payload
 
             def step(params, payload):
-                data = _decode_payload(payload)
+                data = decode(payload)
                 out = shard_fn(params, data)
                 return _encode_payload(out, bit, do_clamp)
 
@@ -403,10 +426,17 @@ def build_pipeline(model_name: str, partition: Sequence[Tuple[int, int]],
     (runtime.py:291-355); `quant_bits[i]` quantizes the edge leaving stage i
     (reference `-q`, runtime.py:652-656). Stages are placed round-robin on
     `devices` (default: all local devices).
+
+    Int8 tunnel: when the active `QuantizeCompute` config has `tunnel`
+    set, a stage whose first sublayer leads with a dense
+    (`FamilySpec.wire_subs`) and whose incoming edge runs at 8 bits keeps
+    that payload encoded — its first matmul consumes the wire bytes
+    directly (ops/int8_matmul.wire_dense).
     """
     import jax.numpy as jnp
 
     from ..models import registry
+    from ..models.layers import quantize_compute
 
     if devices is None:
         devices = jax.local_devices()
@@ -414,6 +444,9 @@ def build_pipeline(model_name: str, partition: Sequence[Tuple[int, int]],
         dtype = jnp.float32
     if quant_bits is None:
         quant_bits = [0] * len(partition)
+    wire_subs = getattr(
+        registry.get_model_entry(model_name).family.FAMILY, "wire_subs", ())
+    qc = quantize_compute()
     stages = []
     for i, (layer_start, layer_end) in enumerate(partition):
         fn, params, _ = registry.module_shard_factory(
@@ -423,7 +456,10 @@ def build_pipeline(model_name: str, partition: Sequence[Tuple[int, int]],
         # final stage's output edge is the result path: never quantized
         if i == len(partition) - 1:
             bit = 0
+        in_bit = quant_bits[i - 1] if 0 < i <= len(quant_bits) else 0
+        tunnel = (qc.tunnel and i > 0 and in_bit == 8
+                  and (layer_start - 1) % 4 in wire_subs)
         stages.append(PipelineStage(shard_fn=fn, params=params, device=dev,
                                     quant_bit=bit, name=f"stage{i}",
-                                    donate_payload=i > 0))
+                                    donate_payload=i > 0, tunnel=tunnel))
     return HostPipeline(stages, max_inflight=max_inflight)

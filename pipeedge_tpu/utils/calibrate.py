@@ -1,0 +1,197 @@
+"""Offline per-channel calibration for the int8 compute path.
+
+Sweeps a calibration batch through a shard with an observer installed on
+the tagged denses (models/layers.py `_QC_OBSERVER`), aggregates per-tag
+activation statistics, and derives Banner-optimal clip thresholds from
+`ops/clamp.py`'s clamp lineage: tagged activations are near-Laplace
+(alpha = W(3*4^b) * sqrt(var/2)), except the MLP-down input which is
+post-GeLU (half bell curve, alpha = W(3*4^(b+1)) * sqrt(E[x^2])) — the
+same two distributions the wire codec's clamp already assumes
+(parallel/pipeline.py `_encode_payload`).
+
+The result is a scale sidecar written NEXT to the checkpoint
+(`<ckpt>.int8scales.npz`): per-tag clamp alphas plus per-channel weight
+scales for every dense in the shard. At serve time
+`quantize_compute_from_sidecar` turns the sidecar into a `QuantizeCompute`
+config whose alphas fold into the int8 matmul's pre-quantization clip
+(ops/int8_matmul.int8_dense) as trace-time constants.
+
+Observation runs EAGERLY (no jit) over unrolled block params so the
+observer sees concrete arrays — `tools/calibrate.py` is the entrypoint.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Callable, Dict, Iterable, List, Mapping, Optional
+
+import numpy as np
+
+from ..models import layers
+from ..ops.clamp import clamp_factor_gelu, clamp_factor_laplace
+
+# tags whose observed input is post-GeLU (half bell curve): everything
+# else calibrates with the Laplace factor
+GELU_TAGS = ("mlp.down",)
+
+
+@dataclasses.dataclass
+class TagStats:
+    """Running activation moments for one dense tag across calibration
+    batches (and across blocks — all blocks share a tag, so one alpha
+    serves the whole shard, like the wire clamp)."""
+    amax: float = 0.0
+    sum_sq: float = 0.0
+    sum_: float = 0.0
+    count: int = 0
+
+    def update(self, x) -> None:
+        xf = np.asarray(x, np.float32)
+        self.amax = max(self.amax, float(np.max(np.abs(xf))))
+        self.sum_sq += float(np.sum(np.square(xf, dtype=np.float64)))
+        self.sum_ += float(np.sum(xf, dtype=np.float64))
+        self.count += xf.size
+
+    @property
+    def var(self) -> float:
+        if not self.count:
+            return 0.0
+        mean = self.sum_ / self.count
+        return max(self.sum_sq / self.count - mean * mean, 0.0)
+
+    @property
+    def second_moment(self) -> float:
+        return self.sum_sq / self.count if self.count else 0.0
+
+
+def collect_activation_stats(run_fn: Callable, params,
+                             batches: Iterable) -> Dict[str, TagStats]:
+    """Run `run_fn(params, batch)` eagerly for each calibration batch with
+    the tag observer installed; returns per-tag running stats.
+
+    `run_fn` must be the UNJITTED shard function over unrolled block
+    params (registry.module_shard_factory(..., unroll=True) + its
+    `.__wrapped__`) — under jit or lax.scan the observer would see
+    tracers, not data.
+    """
+    stats: Dict[str, TagStats] = {}
+
+    def observer(tag: str, x) -> None:
+        import jax
+        if isinstance(x, jax.core.Tracer):
+            raise RuntimeError(
+                f"calibration observer saw a tracer for tag {tag!r}: run "
+                "the shard eagerly (unjitted, unrolled blocks)")
+        stats.setdefault(tag, TagStats()).update(x)
+
+    prev = layers._QC_OBSERVER
+    layers._QC_OBSERVER = observer
+    try:
+        for batch in batches:
+            run_fn(params, batch)
+    finally:
+        layers._QC_OBSERVER = prev
+    if not stats:
+        raise RuntimeError("calibration saw no tagged denses — the model "
+                           "family has no int8-routable layers")
+    return stats
+
+
+def compute_alphas(stats: Mapping[str, TagStats],
+                   bit: int = 8) -> Dict[str, float]:
+    """Banner-optimal clip threshold per tag (ops/clamp.py lineage)."""
+    alphas: Dict[str, float] = {}
+    for tag, st in stats.items():
+        if tag in GELU_TAGS:
+            alpha = clamp_factor_gelu(bit) * float(
+                np.sqrt(st.second_moment))
+        else:
+            alpha = clamp_factor_laplace(bit) * float(
+                np.sqrt(0.5 * st.var))
+        # clipping NOTHING is always safe; clipping below the observed
+        # range only ever helps if the distribution assumption holds, so
+        # never clamp tighter than half the observed amax (outlier-robust
+        # floor: a degenerate calibration batch can't zero a layer out)
+        alphas[tag] = max(alpha, 0.5 * st.amax) if st.amax else 1.0
+    return alphas
+
+
+def weight_channel_scales(params, prefix: str = "") -> Dict[str, np.ndarray]:
+    """Per-output-channel int8 scales for every dense `{w, b}` dict in a
+    shard's parameter pytree, keyed by slash-joined path."""
+    from ..ops.int8_matmul import quantize_weight
+
+    out: Dict[str, np.ndarray] = {}
+
+    def walk(node, path):
+        if isinstance(node, Mapping):
+            if "w" in node and getattr(node["w"], "ndim", 0) == 2:
+                out[path] = np.asarray(quantize_weight(node["w"])[1])
+                return
+            for key, sub in node.items():
+                walk(sub, f"{path}/{key}" if path else str(key))
+        elif isinstance(node, (tuple, list)):
+            for i, sub in enumerate(node):
+                walk(sub, f"{path}/{i}" if path else str(i))
+
+    walk(params, prefix)
+    return out
+
+
+def sidecar_path(model_file: str) -> str:
+    """The sidecar lives next to the checkpoint it calibrates."""
+    return model_file + ".int8scales.npz"
+
+
+def write_sidecar(path: str, alphas: Mapping[str, float],
+                  wscales: Mapping[str, np.ndarray],
+                  meta: Optional[dict] = None) -> None:
+    arrays = {f"alpha/{tag}": np.float32(a) for tag, a in alphas.items()}
+    arrays.update({f"wscale/{k}": np.asarray(v, np.float32)
+                   for k, v in wscales.items()})
+    arrays["meta"] = np.frombuffer(
+        json.dumps(meta or {}).encode(), dtype=np.uint8)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+    os.replace(tmp, path)
+
+
+def load_sidecar(path: str) -> dict:
+    """Inverse of `write_sidecar`: {'alphas': {...}, 'weight_scales':
+    {...}, 'meta': {...}}."""
+    with np.load(path) as z:
+        alphas = {k[len("alpha/"):]: float(z[k]) for k in z.files
+                  if k.startswith("alpha/")}
+        wscales = {k[len("wscale/"):]: z[k] for k in z.files
+                   if k.startswith("wscale/")}
+        meta = json.loads(bytes(z["meta"]).decode()) if "meta" in z.files \
+            else {}
+    return {"alphas": alphas, "weight_scales": wscales, "meta": meta}
+
+
+def quantize_compute_from_sidecar(
+        path: str, skip_tags: Iterable[str] = (),
+        block_k: int = 128, tunnel: bool = False) -> layers.QuantizeCompute:
+    """Build the runtime config from a calibration sidecar."""
+    side = load_sidecar(path)
+    return layers.QuantizeCompute(
+        enabled=True, block_k=block_k, skip_tags=frozenset(skip_tags),
+        clamp_alphas=dict(side["alphas"]), tunnel=tunnel)
+
+
+def calibrate_shard(model_name: str, model_file: Optional[str],
+                    layer_start: int, layer_end: int,
+                    batches: List, bit: int = 8):
+    """One-call calibration: build the shard (unrolled, unjitted), sweep
+    the batches, return (alphas, weight_scales, stats)."""
+    from ..models import registry
+
+    fn, params, _ = registry.module_shard_factory(
+        model_name, model_file, layer_start, layer_end, unroll=True)
+    raw_fn = getattr(fn, "__wrapped__", fn)
+    stats = collect_activation_stats(raw_fn, params, batches)
+    alphas = compute_alphas(stats, bit=bit)
+    wscales = weight_channel_scales(params)
+    return alphas, wscales, stats

@@ -17,7 +17,7 @@ from tools import bench_report                         # noqa: E402
 from tools.loadgen import arrival_offsets              # noqa: E402
 
 ALL_RECIPES = {"exact", "quant_collectives", "spmd", "dcn", "decode",
-               "train", "serve", "serve_kv"}
+               "train", "serve", "serve_kv", "int8_compute"}
 
 
 # -- registry resolution -------------------------------------------------
@@ -78,6 +78,16 @@ def _sample_blocks(name):
                 "quality": {"top1_agreement_vs_exact": 1.0,
                             "max_abs_logit_delta": 0.04},
                 "extras": {"bits": 8, "tp": 2}}
+    if name == "int8_compute":
+        return {"throughput": {"value": 1180.0, "unit": "images/sec"},
+                "quality": {"top1_agreement_vs_exact": 0.995,
+                            "max_abs_logit_delta": 0.12},
+                "extras": {"exact_images_per_sec": 940.0,
+                           "fast_images_per_sec": 1010.0,
+                           "int8_images_per_sec": 1180.0,
+                           "chip_window_target_img_s": 1126.0,
+                           "chip_window_met": True,
+                           "block_k": 128}}
     if name == "serve":
         return {"throughput": {"value": 56.3, "unit": "req/s"},
                 "latency_ms": {"p50": 120.0, "p95": 300.0, "p99": 366.0,
@@ -420,3 +430,37 @@ def test_serve_recipe_acceptance(tmp_path):
     assert timeline.returncode == 0, timeline.stderr[-2000:]
     t = json.loads(timeline.stdout)
     assert t["found"] and t["dominant_stall"] is not None
+
+
+# -- the int8-compute recipe acceptance run ------------------------------
+
+@pytest.mark.fleet      # subprocess bench.py run (the CI smoke shape)
+def test_int8_compute_recipe_acceptance(tmp_path):
+    """ISSUE 19 acceptance: `python bench.py --recipe int8_compute` on
+    the CPU fixture emits one valid pipeedge-bench/v1 record carrying
+    exact/fast/int8 interleaved img/s, >= 0.99 int8-vs-exact top-1
+    agreement, and the chip-window target (gated null off-TPU)."""
+    artifact = str(tmp_path / "BENCH_int8.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("PIPEEDGE_FAST_NUMERICS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--recipe", "int8_compute", "--model", "pipeedge/test-tiny-vit",
+         "--ubatches", "4", "--reps", "2", "--append-record", artifact],
+        capture_output=True, text=True, timeout=500, cwd=REPO, env=env)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    record = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert schema.validate_record(record) == []
+    assert record["scenario"] == "int8_compute"
+    # the headline quality gate: int8 compute must agree with exact
+    assert record["quality"]["top1_agreement_vs_exact"] >= 0.99
+    extras = record["extras"]
+    for key in ("exact_images_per_sec", "fast_images_per_sec",
+                "int8_images_per_sec"):
+        assert extras[key] > 0, key
+    assert extras["chip_window_target_img_s"] == 1126.0
+    assert extras["chip_window_met"] is None        # CPU: no chip claim
+    assert extras["clamp"] == "inline-1-batch"
+    assert record["throughput"]["value"] == extras["int8_images_per_sec"]
+    doc = json.load(open(artifact))
+    assert [r["scenario"] for r in doc["records"]] == ["int8_compute"]

@@ -1,0 +1,128 @@
+"""Offline int8 calibration (utils/calibrate.py + tools/calibrate.py):
+stat accumulation, Banner alpha derivation from the clamp lineage, the
+sidecar write/load round-trip, and the one-call shard sweep against the
+tiny ViT fixture."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from pipeedge_tpu.models import layers  # noqa: E402
+from pipeedge_tpu.ops.clamp import (clamp_factor_gelu,  # noqa: E402
+                                    clamp_factor_laplace)
+from pipeedge_tpu.utils import calibrate  # noqa: E402
+
+MODEL = "pipeedge/test-tiny-vit"
+
+
+def test_tag_stats_accumulate_across_batches():
+    st = calibrate.TagStats()
+    a = np.array([1.0, -3.0, 2.0], np.float32)
+    b = np.array([0.5, -0.5], np.float32)
+    st.update(a)
+    st.update(b)
+    both = np.concatenate([a, b])
+    assert st.amax == 3.0
+    assert st.count == 5
+    assert st.second_moment == pytest.approx(np.mean(both**2), rel=1e-6)
+    assert st.var == pytest.approx(np.var(both), rel=1e-6)
+
+
+def test_compute_alphas_distribution_split_and_floor():
+    laplace = calibrate.TagStats()
+    gelu = calibrate.TagStats()
+    rng = np.random.default_rng(0)
+    laplace.update(rng.laplace(size=4096).astype(np.float32))
+    gelu.update(np.abs(rng.normal(size=4096)).astype(np.float32))
+    alphas = calibrate.compute_alphas(
+        {"attn.q": laplace, "mlp.down": gelu}, bit=8)
+    exp_lap = clamp_factor_laplace(8) * np.sqrt(0.5 * laplace.var)
+    exp_gelu = clamp_factor_gelu(8) * np.sqrt(gelu.second_moment)
+    assert alphas["attn.q"] == pytest.approx(
+        max(exp_lap, 0.5 * laplace.amax), rel=1e-6)
+    assert alphas["mlp.down"] == pytest.approx(
+        max(exp_gelu, 0.5 * gelu.amax), rel=1e-6)
+    # outlier-robust floor: a degenerate spike can't produce a clip far
+    # below the observed range
+    spiky = calibrate.TagStats()
+    spiky.update(np.array([0.01] * 1000 + [10.0], np.float32))
+    a = calibrate.compute_alphas({"attn.q": spiky})["attn.q"]
+    assert a == pytest.approx(5.0)                 # 0.5 * amax
+    # nothing observed -> neutral 1.0
+    assert calibrate.compute_alphas({"t": calibrate.TagStats()})["t"] == 1.0
+
+
+def test_collect_stats_requires_eager_and_tags():
+    with pytest.raises(RuntimeError, match="no tagged denses"):
+        calibrate.collect_activation_stats(
+            lambda p, b: b, None, [np.zeros(3, np.float32)])
+
+    def jitted_run(p, b):
+        import jax.numpy as jnp
+        return jax.jit(lambda x: layers.dense(
+            {"w": jnp.eye(4), "b": jnp.zeros(4)}, x, tag="attn.q"))(b)
+
+    with pytest.raises(RuntimeError, match="tracer"):
+        calibrate.collect_activation_stats(
+            jitted_run, None, [np.zeros((2, 4), np.float32)])
+    assert layers._QC_OBSERVER is None             # restored on the way out
+
+
+def test_sidecar_round_trip_and_config(tmp_path):
+    path = str(tmp_path / "m.npz.int8scales.npz")
+    alphas = {"attn.q": 1.25, "mlp.down": 0.5}
+    wscales = {"blocks/0/attn_q": np.array([0.1, 0.2], np.float32)}
+    calibrate.write_sidecar(path, alphas, wscales,
+                            meta={"model": MODEL, "bit": 8})
+    side = calibrate.load_sidecar(path)
+    assert side["alphas"] == pytest.approx(alphas)
+    np.testing.assert_array_equal(side["weight_scales"]["blocks/0/attn_q"],
+                                  wscales["blocks/0/attn_q"])
+    assert side["meta"] == {"model": MODEL, "bit": 8}
+
+    qc = calibrate.quantize_compute_from_sidecar(
+        path, skip_tags=("attn.out",), block_k=64, tunnel=True)
+    assert qc.enabled and qc.tunnel and qc.block_k == 64
+    assert qc.skip_tags == frozenset({"attn.out"})
+    assert qc.clamp_alphas == pytest.approx(alphas)
+    assert calibrate.sidecar_path("/x/m.npz") == "/x/m.npz.int8scales.npz"
+
+
+def test_calibrate_shard_sweeps_fixture():
+    from pipeedge_tpu.models import registry
+    cfg = registry.get_model_config(MODEL)
+    rng = np.random.default_rng(0)
+    batches = [np.asarray(rng.normal(size=(
+        4, cfg.num_channels, cfg.image_size, cfg.image_size)), np.float32)
+        for _ in range(2)]
+    alphas, wscales, stats = calibrate.calibrate_shard(
+        MODEL, None, 1, registry.get_model_layers(MODEL), batches)
+    assert set(alphas) == {"attn.q", "attn.k", "attn.v", "attn.out",
+                           "mlp.up", "mlp.down"}
+    assert all(a > 0 for a in alphas.values())
+    # per-channel scales for every 2-D dense in the shard, incl. head
+    assert wscales and all(v.ndim == 1 for v in wscales.values())
+    assert all(st.count > 0 for st in stats.values())
+
+
+@pytest.mark.fleet      # subprocess CLI run
+def test_calibrate_cli_emits_sidecar_and_json(tmp_path):
+    out = str(tmp_path / "tiny.int8scales.npz")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "calibrate.py"),
+         "-m", MODEL, "--batch", "4", "--batches", "1", "--out", out],
+        capture_output=True, text=True, timeout=300, cwd=REPO, env=env)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["bench"] == "calibrate" and rec["sidecar"] == out
+    assert rec["alphas"] and rec["weight_scale_tensors"] > 0
+    qc = calibrate.quantize_compute_from_sidecar(out)
+    assert qc.enabled and set(qc.clamp_alphas) == set(rec["alphas"])

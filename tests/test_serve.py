@@ -583,6 +583,57 @@ def test_streaming_disconnect_cancels_generation(tight_server):
     assert len(out["ids"][0]) == 5
 
 
+def test_streaming_disconnect_storm_does_not_exhaust_slots(tight_server):
+    """A BURST of streaming clients that all vanish mid-response (N well
+    past max_active=1) must not strand admission slots: every cancelled
+    request retires, `active` returns to 0, and a fresh request admits
+    promptly instead of queueing behind ghosts."""
+    port = tight_server
+
+    def healthz():
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=30) as resp:
+            return json.loads(resp.read())["stats"]
+
+    body = json.dumps({"ids": [[1, 2, 3]], "new_tokens": 40,
+                       "stream": True}).encode()
+    head = (b"POST /generate HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: " + str(len(body)).encode() + b"\r\n\r\n")
+    # open the whole storm first (they queue on the 1-slot executor),
+    # then abort every socket with an RST — sockets still waiting for
+    # admission AND the one mid-stream both disconnect
+    socks = [socket.create_connection(("127.0.0.1", port), timeout=60)
+             for _ in range(4)]
+    try:
+        for sock in socks:
+            sock.sendall(head + body)
+        # make sure at least one stream actually started before the storm
+        # aborts (otherwise the test never exercises mid-flight cancel)
+        buf, deadline = b"", time.monotonic() + 120
+        while b'"step"' not in buf:
+            assert time.monotonic() < deadline, f"no stream: {buf!r}"
+            chunk = socks[0].recv(4096)
+            assert chunk, f"server closed early: {buf!r}"
+            buf += chunk
+    finally:
+        for sock in socks:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                            struct.pack("ii", 1, 0))
+            sock.close()
+    # every ghost must retire and free its slot
+    deadline = time.monotonic() + 120
+    while healthz()["active"] > 0:
+        assert time.monotonic() < deadline, (
+            "disconnect storm stranded admission slots: active="
+            f"{healthz()['active']}")
+        time.sleep(0.1)
+    # the server still serves: a fresh request admits through the single
+    # slot the storm just vacated
+    out = _post(port, "/generate", {"ids": [[1, 2, 3]], "new_tokens": 2})
+    assert len(out["ids"][0]) == 5
+
+
 def test_stage_executor_stop_fails_live_waiters():
     """StageWorkerExecutor.stop() with requests in flight fails their
     waiters instead of hanging them (code-review finding)."""

@@ -2217,6 +2217,14 @@ def main():
                    choices=["float32", "bfloat16"])
     p.add_argument("--kv-bits", default=0, type=int, choices=[0, 8])
     p.add_argument("--attend-floor", default=64, type=int)
+    p.add_argument("--int8-decode-attend", default=None,
+                   choices=["0", "1", "2", "auto"],
+                   help="int8-KV decode attention kernel opt-in for the "
+                        "serving pipeline (needs --kv-bits 8): 0 = XLA "
+                        "dequant route, 1 = v1 kernel, 2 = v2, auto = "
+                        "width-policy v2. Default: PIPEEDGE_INT8_DECODE_"
+                        "ATTEND, else on (auto) when the int8 compute "
+                        "path is enabled (docs/QUANTIZATION.md)")
     p.add_argument("--executor", default="wave", choices=["wave", "stage"],
                    help="wave: one thread ticks the batcher; stage: one "
                         "worker thread pinned per pipeline stage "
@@ -2467,7 +2475,8 @@ def main():
         partition = list(zip(nums[::2], nums[1::2]))
     pipe = build_decode_pipeline(
         args.model_name, partition, max_len=args.max_len, dtype=dtype,
-        cache_bits=args.kv_bits, attend_floor=args.attend_floor)
+        cache_bits=args.kv_bits, attend_floor=args.attend_floor,
+        int8_decode_attend=args.int8_decode_attend)
     if args.inject_stall:
         _inject_stall(pipe, args.inject_stall, p)
     spec = None
