@@ -28,6 +28,9 @@ Recipes (see docs/PERF.md for the catalog + flags):
                        shared-prefix hit rate, page-pool occupancy, and
                        decode p99 with/without a concurrent prefill
                        burst (colocated vs --disaggregate A/B)
+- `autoscale`          advise-vs-auto capacity-controller A/B under one
+                       seeded load ramp: time-to-scale-up, per-class
+                       attainment during the ramp, decision counts
 
 Entry point: `python bench.py --recipe NAME [recipe flags]` (the default
 recipe is `exact`, keeping `python bench.py` the headline record).
@@ -125,8 +128,9 @@ def _ensure_loaded() -> None:
     # re-raise on the next lookup, not leave a silently partial registry
     # (sys.modules caches the modules that DID import, and register()
     # only runs at first import, so a retry never double-registers)
-    from . import (fleet, headline, int8_compute, offline,  # noqa: F401
-                   serve_bench, serve_kv_bench)  # noqa: F401
+    from . import (autoscale_bench, fleet, headline,  # noqa: F401
+                   int8_compute, offline, serve_bench,  # noqa: F401
+                   serve_kv_bench)  # noqa: F401
     _loaded = True
 
 

@@ -27,18 +27,26 @@ tools/serve.py the three mechanisms that bound the damage:
   retry/failover, tail hedging, graceful drain with KV page migration
   over the ship codec (`DecodeRouter`, `ReplicaRegistry`,
   `RouterPolicy` — docs/SERVING.md router topology).
+- `autoscale`: the closed capacity loop over that membership plane
+  (`--autoscale {off,advise,auto}`) — a governor-ticked
+  `CapacityController` with confirm/dwell hysteresis, a flap damper,
+  scale-down ordered behind brownout, and dry-run `held` transitions
+  (docs/FAULT_TOLERANCE.md autoscale lifecycle).
 """
 from .admission import (AdmissionController, AdmissionShed, ClassPolicy,
                         DeadlineExceeded, EDFQueue, REQUEST_CLASSES,
                         ServiceRateEstimator, TokenBucket, default_policies,
                         parse_class_map)
+from .autoscale import (AutoscaleRunner, CapacityController,  # noqa: F401
+                        CapacityPolicy)
 from .brownout import BrownoutLadder, LEVEL_NAMES, Watermarks
 from .router import (DecodeRouter, NoReplicaAvailable,  # noqa: F401
                      REPLICA_DEAD, REPLICA_DRAINED, REPLICA_HEALTHY,
                      REPLICA_SUSPECT, ReplicaRegistry, RouterPolicy)
 
 __all__ = [
-    "AdmissionController", "AdmissionShed", "BrownoutLadder",
+    "AdmissionController", "AdmissionShed", "AutoscaleRunner",
+    "BrownoutLadder", "CapacityController", "CapacityPolicy",
     "ClassPolicy", "DeadlineExceeded", "DecodeRouter", "EDFQueue",
     "LEVEL_NAMES", "NoReplicaAvailable", "REPLICA_DEAD",
     "REPLICA_DRAINED", "REPLICA_HEALTHY", "REPLICA_SUSPECT",

@@ -233,15 +233,45 @@ class ReplicaRegistry:
 
     # -- membership -------------------------------------------------------
 
-    def add(self, name: str, url: str) -> None:
+    def add(self, name: str, url: str,
+            state: str = REPLICA_HEALTHY) -> None:
+        """Register a replica. `state` is the entry state: the
+        autoscaler adds a freshly spawned replica as REPLICA_SUSPECT so
+        it is warm-up gated — `pick()` never prefers it over a healthy
+        replica, and it only earns traffic through the same `readmit`
+        consecutive-clean-poll confirmation a recovered replica does."""
+        if state not in STATE_CODES:
+            raise ValueError(f"unknown replica state {state!r}")
         with self._lock:
             if name in self._replicas:
                 raise ValueError(f"replica {name!r} already registered")
-            self._replicas[name] = _Replica(name, url)
+            r = _Replica(name, url)
+            r.state = state
+            self._replicas[name] = r
             # PL501: this replica's label matrix exists from this instant
-            _M_STATE.set(float(STATE_CODES[REPLICA_HEALTHY]), replica=name)
+            _M_STATE.set(float(STATE_CODES[state]), replica=name)
             _M_SCORE.set(0.0, replica=name)
             _M_INFLIGHT.set(0.0, replica=name)
+            if state != REPLICA_HEALTHY:
+                self.transitions.append((name, "new", state, "added"))
+
+    def remove(self, name: str) -> None:
+        """Deregister a replica (autoscale scale-down, after its drain
+        + migration completed). Its affinity entries must already have
+        been reassigned; any stragglers are dropped so `pick()` never
+        resolves to a ghost."""
+        with self._lock:
+            r = self._replicas.pop(name, None)
+            if r is None:
+                return
+            for key in [k for k, v in self._affinity.items() if v == name]:
+                del self._affinity[key]
+            self.transitions.append((name, r.state, "removed", "scale-in"))
+            # park the gauges at the dead code: the label matrix stays
+            # declared (PL501) but reads as not-serving
+            _M_STATE.set(float(STATE_CODES[REPLICA_DEAD]), replica=name)
+            _M_INFLIGHT.set(0.0, replica=name)
+        logger.info("replica %s: %s -> removed (scale-in)", name, r.state)
 
     def names(self) -> List[str]:
         with self._lock:
@@ -1068,12 +1098,16 @@ class DecodeRouter:
 
     # -- graceful drain + KV migration ------------------------------------
 
-    def drain_replica(self, name: str, migrate: bool = True) -> dict:
+    def drain_replica(self, name: str, migrate: bool = True,
+                      respawn: bool = True) -> dict:
         """Planned maintenance: stop routing to `name`, let its
         in-flight requests finish, ship its warm prefix pages to a
         survivor over the kv/ship.py codec, then detach (supervised
         replicas are restarted — the respawn readmits with epoch+1;
-        external ones stay drained)."""
+        external ones stay drained). `respawn=False` is the autoscale
+        scale-in half: the drained replica is NOT restarted — the
+        caller retires its supervisor rank and removes it from the
+        registry once this returns."""
         pol = self.policy
         if not self.registry.drain(name):
             return {"drained": False, "error": f"replica {name} is dead"}
@@ -1102,10 +1136,36 @@ class DecodeRouter:
             if migrate and target is not None:
                 migrated = self._migrate_prefixes(name, target)
                 self.registry.reassign_affinity(name, target)
-            if self.supervisor is not None and name in self._ranks:
+            if respawn and self.supervisor is not None \
+                    and name in self._ranks:
                 self.supervisor.restart(self._ranks[name])
         return {"drained": True, "migrated_prefixes": migrated,
                 "target": target}
+
+    # -- autoscale membership actuators -----------------------------------
+
+    def add_replica(self, name: str, url: str,
+                    rank: Optional[int] = None) -> None:
+        """Scale-out actuator: register a freshly spawned replica
+        warm-up gated (REPLICA_SUSPECT — it earns traffic through the
+        readmit confirmation, never before its first clean polls)."""
+        self.registry.add(name, url, state=REPLICA_SUSPECT)
+        if rank is not None:
+            self.bind_rank(name, rank)
+
+    def remove_replica(self, name: str) -> dict:
+        """Scale-in actuator: graceful drain + KV-prefix migration,
+        then deregister without respawn. Returns the drain result with
+        `removed` set; a replica that dies mid-drain is still removed
+        (it was leaving anyway — `mark_failed` already convicted it)."""
+        out = self.drain_replica(name, migrate=True, respawn=False)
+        self.registry.remove(name)
+        with self._health_lock:
+            self._health.pop(name, None)
+        rank = self._ranks.pop(name, None)
+        out["removed"] = True
+        out["rank"] = rank
+        return out
 
     def _migrate_prefixes(self, frm: str, to: str) -> int:
         """Ship `frm`'s warm prefixes to `to`: every router-registered

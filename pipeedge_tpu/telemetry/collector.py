@@ -52,6 +52,7 @@ BURN_WINDOWS = ("short", "long")
 CLASS_FAMILY = "pipeedge_requests_by_class_total"
 LATENCY_FAMILY = "pipeedge_serve_request_latency_seconds"
 QUEUE_FAMILY = "pipeedge_admission_queue_depth"
+BROWNOUT_FAMILY = "pipeedge_brownout_level"
 
 _SAMPLE_RE = re.compile(
     r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
@@ -330,7 +331,8 @@ class FleetCollector:
             try:
                 text = self.fetch(f"{url}/metrics", self.timeout_s)
                 sample["families"] = parse_prom_text(
-                    text, families=(CLASS_FAMILY, QUEUE_FAMILY))
+                    text, families=(CLASS_FAMILY, QUEUE_FAMILY,
+                                    BROWNOUT_FAMILY))
                 sample["exemplars"] = prom.parse_exemplars(
                     text, LATENCY_FAMILY)
                 sample["ok"] = True
@@ -386,6 +388,7 @@ class FleetCollector:
         replicas = {}
         exemplar_union: Dict[str, dict] = {}
         queue_depth = 0.0
+        brownout_level = 0
         cls_window: Dict[str, List[float]] = {
             cls: [0.0, 0.0, 0.0] for cls in self.classes}  # dok, dtot, dshed
         for name, samples in rings.items():
@@ -406,6 +409,12 @@ class FleetCollector:
                 classes[cls]["requests_total"] += t
             for labels, value in latest["families"].get(QUEUE_FAMILY, ()):
                 queue_depth += value
+            # the fleet's brownout rung is the MAX across targets: one
+            # replica shedding work is enough to order autoscale
+            # scale-down behind brownout (serving/autoscale.py)
+            for labels, value in latest["families"].get(
+                    BROWNOUT_FAMILY, ()):
+                brownout_level = max(brownout_level, int(value))
             # windowed deltas: latest good sample vs the oldest good one
             oldest = next((s for s in samples if s["ok"]), None)
             window_s = max(1e-9, latest["t"] - oldest["t"]) \
@@ -453,6 +462,7 @@ class FleetCollector:
             "replicas": replicas,
             "classes": classes,
             "queue_depth": queue_depth,
+            "brownout_level": brownout_level,
             "latency_family": LATENCY_FAMILY,
             "exemplars": union_rows,
             "exemplars_text": "\n".join(render_exemplar_lines(

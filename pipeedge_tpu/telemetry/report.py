@@ -24,6 +24,11 @@ span stream this repo's runtime emits:
   per affected rank — the zero-false-quarantines assertion on a clean
   run and the exactly-one-quarantine gate on a straggler run both read
   this section.
+- autoscale breakdown: capacity-controller decision spans
+  (pipeedge_tpu/serving/autoscale.py) — plan / apply / held /
+  flap_damped per direction, with apply durations — the
+  zero-decisions-on-a-steady-fleet assertion and the scale-up-then-
+  scale-down chaos gate both read this section.
 - span_overhead_pct: the recorder's own cost — per-record cost measured
   live on this host times the span count, over the window — the number
   that keeps the observability plane honest about its hot-path tax.
@@ -388,6 +393,42 @@ def analyze_spans(spans: Sequence[dict],
             "by_rank": {k: by_rank[k] for k in sorted(by_rank)},
         }
 
+    # -- autoscale: capacity-controller decisions ----------------------
+    # cat "autoscale" spans from the CapacityController: "plan:{dir}"
+    # (dry-run duration), "apply:{dir}" (actuation duration), instant
+    # "held:{dir}" (un-runnable plan / failed actuator) and
+    # "flap_damped:{dir}" (damper swallowed a reversal). The chaos CI
+    # gates on this section: scale-up AND scale-down observed under the
+    # ramp, ZERO decisions on the steady control run.
+    autoscale = {}
+    al = [s for s in spans if s.get("cat") == "autoscale"]
+    if al:
+        as_kinds: Dict[str, int] = {}
+        as_dirs: Dict[str, Dict[str, int]] = {}
+        apply_ms: List[float] = []
+        for s in al:
+            kind, _, direction = str(s.get("name", "")).partition(":")
+            as_kinds[kind] = as_kinds.get(kind, 0) + 1
+            if direction:
+                d = as_dirs.setdefault(direction, {})
+                d[kind] = d.get(kind, 0) + 1
+            if kind == "apply":
+                apply_ms.append((int(s["t1"]) - int(s["t0"])) / 1e6)
+        autoscale = {
+            "plans": as_kinds.get("plan", 0),
+            "applies": as_kinds.get("apply", 0),
+            "held": as_kinds.get("held", 0),
+            "flap_damped": as_kinds.get("flap_damped", 0),
+            "by_direction": {k: dict(sorted(v.items()))
+                             for k, v in sorted(as_dirs.items())},
+        }
+        if apply_ms:
+            apply_ms.sort()
+            autoscale["apply_ms"] = {
+                "n": len(apply_ms),
+                "p50": round(_percentile(apply_ms, 50), 3),
+                "max": round(apply_ms[-1], 3)}
+
     # -- serving plane: admission waits / sheds / brownout -------------
     # tools/serve.py records cat "serve" spans: "admit:{class}" (duration
     # = EDF-queue wait of an ADMITTED request — shed waits record under
@@ -469,6 +510,7 @@ def analyze_spans(spans: Sequence[dict],
         "failover": failover,
         "rejoin": rejoin,
         "gray": gray,
+        "autoscale": autoscale,
         "rebalance_events": rebalance_events,
         "span_cost_ns": round(span_cost_ns, 1),
         "span_overhead_pct": round(overhead_pct, 4),

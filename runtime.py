@@ -103,6 +103,14 @@ benched_ranks: set = set()
 # readmit a quarantined straggler; only probation readmission
 # (pipeedge_tpu/health/scorer.py) removes entries here.
 quarantined_ranks: set = set()
+# capacity-benched ranks (guarded by dead_lock): alive ranks the
+# capacity controller (--autoscale-ranks, serving/autoscale.py) parked
+# as spares because the pipeline is over-provisioned. Kept SEPARATE
+# from benched_ranks/quarantined_ranks so a rejoin heal or a health
+# readmission can never silently re-seat a capacity decision; only the
+# controller's own scale-up (plan_rejoin onto idle survivors) removes
+# entries here.
+autoscaled_ranks: set = set()
 # a death landed mid-round: the data rank ends the round, re-schedules over
 # the survivors, and replays the unacknowledged microbatches
 failover_event = threading.Event()
@@ -1127,7 +1135,8 @@ def _consider_peer_health(ctx, args, hstate: dict, sched, next_sched,
 
     with dead_lock:
         dead_now = set(dead_ranks)
-        bench_now = set(benched_ranks) | set(quarantined_ranks)
+        bench_now = (set(benched_ranks) | set(quarantined_ranks)
+                     | set(autoscaled_ranks))
     # score every rank carrying a stage this round PLUS every
     # quarantined rank (still beating — its RTT drives readmission)
     for peer in sorted((set(stage_ranks) | set(quarantined_ranks))
@@ -1220,6 +1229,164 @@ def _plan_failover(args, sched, world_size: int, dead_now: set,
     return failover_sched.plan_failover(*sched, world_size, dead_now,
                                         scheduler_fn=scheduler_fn,
                                         benched=benched)
+
+
+def _consider_autoscale(ctx, args, a_state: dict, sched, schedules,
+                        sched_idx: int, world_size: int, rnd: int,
+                        cur_digests=None) -> None:
+    """One capacity decision at a round boundary (data rank only): the
+    pipeline-level half of the closed capacity loop (--autoscale-ranks;
+    the decision engine is serving/autoscale.py's CapacityController —
+    confirm/dwell hysteresis, flap damper, dry-run `held`, identical to
+    the router's replica loop). Capacity unit = pipeline stages.
+
+    Signal: the boundary's shared digest window (the same sweep the
+    rebalancer and health scorer read) decomposed into per-stage
+    service estimates — up pressure when the bottleneck stage's
+    per-microbatch service time crosses `--autoscale-rank-high`
+    (adding a stage lets the re-cut shed layers off the critical
+    path), down pressure below `--autoscale-rank-low` (the pipeline is
+    over-provisioned; merging stages trades idle bubbles for none).
+
+    Actuation through EXISTING machinery only:
+    - scale-up = planned rejoin: `plan_rejoin(sched, None, ...)`
+      expands onto idle survivors — including capacity-benched
+      spares — and is written over the remaining rounds, exactly like
+      `_maybe_heal`'s re-expansion path.
+    - scale-down = planned contraction: the span is re-solved over one
+      FEWER stage and the victim (the rank carrying the fewest layers,
+      never the data rank) is dropped from the placement and joins
+      `autoscaled_ranks`, keeping it benched through later failover
+      re-plans and available to scale-up's re-expansion — the
+      contraction is built here first, so an un-runnable one renders
+      as a visible `held` decision instead of an abort."""
+    from pipeedge_tpu.sched import failover as failover_sched
+    from pipeedge_tpu.sched import rebalance
+    from pipeedge_tpu.serving import autoscale as autoscale_mod
+
+    est = _estimates_from_digests(cur_digests, sched,
+                                  a_state["prev_digests"])
+    with dead_lock:
+        dead_now = set(dead_ranks)
+    # state BEFORE the lazy controller construction: the controller
+    # probes size_fn() at __init__, and every closure below reads
+    # a_state at call time
+    a_state.update(sched=sched, schedules=schedules,
+                   sched_idx=sched_idx, dead=dead_now, last_apply=None)
+
+    if a_state.get("controller") is None:
+        max_size = (min(args.autoscale_max, world_size)
+                    if args.autoscale_max else world_size)
+
+        def _classify(pol, sig):
+            b = sig.get("bottleneck_s")
+            if b is None:
+                return 0     # unmeasurable window: streaks reset
+            if b >= args.autoscale_rank_high:
+                return 1
+            if b <= args.autoscale_rank_low:
+                return -1
+            return 0
+
+        def _plan(direction, cur, target):
+            sched_now = a_state["sched"]
+            dead_now = a_state["dead"]
+            if direction == "up":
+                planned = failover_sched.plan_rejoin(
+                    sched_now, None, world_size, dead_now,
+                    align=4 if args.stage_tp > 1 else 1)
+                if planned is None:
+                    return {"ok": False,
+                            "reason": "no idle survivor to expand onto"}
+                return {"ok": True, "planned": planned}
+            # scale-down = partition CONTRACTION (the inverse of the up
+            # path's re-expansion): merge the span over target stages
+            # and drop the victim from the placement. Benching through
+            # the failover cascade is NOT enough — on a full pipeline
+            # substitute_spares hands the stage back to the benched
+            # rank as the last-resort spare (a visible no-op).
+            stage_layers, _q, stage_ranks = sched_now
+            candidates = [(hi - lo + 1, i)
+                          for i, (lo, hi) in enumerate(stage_layers)
+                          if stage_ranks[i] != args.rank
+                          and stage_ranks[i] not in dead_now]
+            if not candidates:
+                return {"ok": False,
+                        "reason": "no benchable stage (data rank "
+                                  "holds the only one)"}
+            _, idx = min(candidates)
+            victim = stage_ranks[idx]
+            try:
+                contracted, _ = rebalance.solve_partition(
+                    [1.0] * stage_layers[-1][1], target,
+                    align=4 if args.stage_tp > 1 else 1)
+            except ValueError as exc:
+                return {"ok": False,
+                        "reason": f"contraction to {target} stage(s) "
+                                  f"unsolvable: {exc}"}
+            new_ranks = [r for r in stage_ranks if r != victim]
+            if len(new_ranks) != target:
+                return {"ok": False,
+                        "reason": f"placement mismatch: {len(new_ranks)} "
+                                  f"survivors for {target} stage(s)"}
+            return {"ok": True, "victim": victim,
+                    "planned": (list(contracted), [0] * target,
+                                new_ranks)}
+
+        def _apply(plan):
+            scheds = a_state["schedules"]
+            idx_now = a_state["sched_idx"]
+            planned = plan["planned"]
+            for j in range(idx_now + 1, len(scheds)):
+                scheds[j] = (list(planned[0]), list(planned[1]),
+                             list(planned[2]))
+            if "victim" not in plan:                    # scale-up
+                with dead_lock:
+                    for r_new in planned[2]:
+                        autoscaled_ranks.discard(r_new)
+                a_state["last_apply"] = ("up", planned[2])
+            else:                                       # scale-down
+                victim = plan["victim"]
+                with dead_lock:
+                    autoscaled_ranks.add(victim)
+                a_state["last_apply"] = ("down", victim)
+
+        a_state["controller"] = autoscale_mod.CapacityController(
+            autoscale_mod.CapacityPolicy(
+                min_size=args.autoscale_min,
+                max_size=max(max_size, args.autoscale_min),
+                confirm=args.autoscale_confirm,
+                cooldown_s=args.autoscale_cooldown),
+            mode=args.autoscale_ranks,
+            size_fn=lambda: len(a_state["sched"][0]),
+            plan_fn=_plan, apply_fn=_apply,
+            classify_fn=_classify, label="stages")
+
+    stage_layers = sched[0]
+    signals = {"size": len(stage_layers), "brownout_level": 0}
+    if est:
+        svc = [e.service_s for e in est.values()]
+        bott = max(svc)
+        signals["bottleneck_s"] = bott
+        # classic steady-state pipeline bubble ratio: how much of the
+        # fleet's stage-seconds are spent waiting on the bottleneck
+        signals["bubble_frac"] = (1.0 - sum(svc) / (len(svc) * bott)
+                                  if bott > 0 else 0.0)
+    d = a_state["controller"].tick(signals)
+    if d is None:
+        return
+    # machine-parseable decision line (tools/chaos_dcn.py / CI grep)
+    print(f"{d.line()} round={rnd}", flush=True)
+    applied = a_state["last_apply"]
+    if applied is None:
+        return
+    kind, detail = applied
+    if kind == "up":
+        print(f"autoscale_rank direction=up round={rnd} "
+              f"ranks={','.join(str(r) for r in detail)}", flush=True)
+    else:
+        print(f"autoscale_rank direction=down round={rnd} "
+              f"victim={detail}", flush=True)
 
 
 def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
@@ -1441,6 +1608,13 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
                 health_mod.set_scorer(h_scorer)
                 health_state = {"scorer": h_scorer, "prev_digests": {},
                                 "prev_retries": {}}
+            # closed capacity loop, pipeline half (--autoscale-ranks):
+            # the controller is built lazily at the first boundary
+            # (_consider_autoscale), from the same digest windows
+            a_state = None
+            if getattr(args, "autoscale_ranks", "off") != "off" \
+                    and world_size > 1:
+                a_state = {"prev_digests": {}, "controller": None}
             schedules = [tuple(s) for s in schedules]
             try:
                 rnd = 0
@@ -1460,7 +1634,8 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
                         with dead_lock:
                             dead_now = set(dead_ranks)
                             bench_now = (set(benched_ranks)
-                                         | set(quarantined_ranks))
+                                         | set(quarantined_ranks)
+                                         | set(autoscaled_ranks))
                         if dead_now or bench_now:
                             # a LATER schedule round may still name a rank
                             # that died earlier (or rejoined un-healed, or
@@ -1500,7 +1675,8 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
                             # baseline — the digests are cumulative)
                             boundary_digests = None
                             if (rebalancer is not None
-                                    or health_state is not None) \
+                                    or health_state is not None
+                                    or a_state is not None) \
                                     and sched_idx + 1 < len(schedules):
                                 boundary_digests = _collect_fleet_digests(
                                     ctx, args, sched[2])
@@ -1541,6 +1717,17 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
                                 # before the next round's broadcast
                                 _maybe_heal(args, sched, world_size, rnd,
                                             schedules, sched_idx)
+                            if a_state is not None \
+                                    and sched_idx + 1 < len(schedules):
+                                # capacity decision LAST: it reads the
+                                # same digest window, and its scale-up
+                                # rewrite must land after any heal so
+                                # the remaining rounds reflect both
+                                _consider_autoscale(
+                                    ctx, args, a_state, sched,
+                                    schedules, sched_idx, world_size,
+                                    rnd - 1,
+                                    cur_digests=boundary_digests)
                             break
                         if fo_t0 is None:
                             # FIRST detection of this episode (appends are
@@ -1568,7 +1755,8 @@ def run_pipeline_dcn(args, schedules, ubatches, labels) -> None:
                         with dead_lock:
                             dead_now = set(dead_ranks)
                             bench_now = (set(benched_ranks)
-                                         | set(quarantined_ranks))
+                                         | set(quarantined_ranks)
+                                         | set(autoscaled_ranks))
                         if _heal_state["pre_failure"] is None:
                             # the schedule running when the episode's
                             # death hit: what --on-peer-rejoin heal
@@ -2609,6 +2797,38 @@ def main():
     parser.add_argument("--degraded-readmit", type=int, default=2,
                         help="consecutive recovered windows before a "
                              "quarantined rank readmits on probation")
+    parser.add_argument("--autoscale-ranks", default="off",
+                        choices=["off", "advise", "auto"],
+                        help="dcn mode closed-loop capacity control over "
+                             "the pipeline partition (the rank-level "
+                             "half of serving/autoscale.py): scale-up "
+                             "expands onto idle survivors via the "
+                             "plan_rejoin cascade at a round boundary, "
+                             "scale-down benches the least-needed rank "
+                             "through the failover re-plan (dry-run "
+                             "verified — an un-runnable contraction "
+                             "renders as `held`). advise logs decisions "
+                             "without acting; auto acts. Data rank "
+                             "drives; forces span recording on "
+                             "(signals come from the rebalancer's "
+                             "digest windows)")
+    parser.add_argument("--autoscale-min", type=int, default=2,
+                        help="stage-count floor the capacity controller "
+                             "never contracts below")
+    parser.add_argument("--autoscale-max", type=int, default=0,
+                        help="stage-count ceiling (0 = world size)")
+    parser.add_argument("--autoscale-confirm", type=int, default=2,
+                        help="consecutive same-direction measured "
+                             "windows before a capacity decision")
+    parser.add_argument("--autoscale-cooldown", type=float, default=0.0,
+                        help="seconds between capacity decisions "
+                             "(reversals double it — the flap damper)")
+    parser.add_argument("--autoscale-rank-high", type=float, default=0.75,
+                        help="bottleneck stage service seconds per "
+                             "microbatch that count as up pressure")
+    parser.add_argument("--autoscale-rank-low", type=float, default=0.05,
+                        help="bottleneck service seconds below which "
+                             "the pipeline counts as over-provisioned")
     parser.add_argument("--wire-crc", action="store_true",
                         help="frame integrity: checksum every wire-v2 "
                              "frame (CRC32C when the wheel is present, "
@@ -2747,6 +2967,23 @@ def main():
             parser.error("--on-peer-degraded quarantine acts at round "
                          "boundaries: pass --rounds N (or ';'-separated "
                          "schedule rounds)")
+    if args.autoscale_ranks != "off":
+        if args.comm != "dcn":
+            parser.error("--autoscale-ranks applies to the dcn driver "
+                         "(per-process ranks)")
+        if args.rounds == 1 and n_rounds == 1:
+            parser.error("--autoscale-ranks acts at round boundaries: "
+                         "pass --rounds N (or ';'-separated schedule "
+                         "rounds)")
+        if args.autoscale_ranks == "auto" \
+                and args.on_peer_death != "failover":
+            parser.error("--autoscale-ranks auto needs --on-peer-death "
+                         "failover: a planned bench rides the failover "
+                         "re-plan cascade (advise mode only observes)")
+        if args.autoscale_min < 1:
+            parser.error("--autoscale-min must be >= 1")
+        if args.autoscale_confirm < 1:
+            parser.error("--autoscale-confirm must be >= 1")
     if args.wire_crc:
         # one process-wide switch (env), so the transport's resend cache
         # and chaos corrupt@K see the same setting the codec does
@@ -2860,7 +3097,8 @@ def main():
 
     if args.trace_spans or (args.comm == "dcn"
                             and (args.rebalance == "auto"
-                                 or args.on_peer_degraded == "quarantine")):
+                                 or args.on_peer_degraded == "quarantine"
+                                 or args.autoscale_ranks != "off")):
         # every rank records; in dcn mode the data rank merges the fleet
         # (workers serve their rings over _MSG_SPANS), single-controller
         # drivers write their own single-rank timeline below. The

@@ -17,7 +17,8 @@ from tools import bench_report                         # noqa: E402
 from tools.loadgen import arrival_offsets              # noqa: E402
 
 ALL_RECIPES = {"exact", "quant_collectives", "spmd", "dcn", "decode",
-               "train", "serve", "serve_kv", "int8_compute"}
+               "train", "serve", "serve_kv", "int8_compute",
+               "autoscale"}
 
 
 # -- registry resolution -------------------------------------------------
@@ -120,6 +121,20 @@ def _sample_blocks(name):
                        "shed": {"shared": 0, "solo": 0,
                                 "with_prefill": 0},
                        "errors": 0}}
+    if name == "autoscale":
+        return {"throughput": {"value": 0.4, "unit": "req/s"},
+                "latency_ms": {"p50": 900.0, "p95": 1400.0,
+                               "p99": 1500.0, "n": 71},
+                "serve": {"goodput_rps": {"interactive": 0.4,
+                                          "total": 0.4},
+                          "slo_attainment": {"interactive": 0.2},
+                          "shed": {"shed": 0, "error": 0,
+                                   "ok_late": 60},
+                          "ramp": "ramp:1:8:0.4", "seed": 7,
+                          "floor": 1, "ceiling": 2},
+                "extras": {"time_to_scale_up_s": 3.3,
+                           "advise_first_up_s": 2.8,
+                           "decision_count": {"advise": 8, "auto": 2}}}
     if name == "dcn":
         return {"throughput": {"value": 210.0, "unit": "items/sec"},
                 "latency_ms": {"p50": 40.0, "p95": 55.0, "p99": 60.0,
@@ -342,6 +357,44 @@ def test_arrival_offsets_seeded_and_shaped():
     assert 0.05 < sum(gaps) / len(gaps) < 0.8   # mean gap ~ 1/qps
     with pytest.raises(ValueError, match="unknown arrival"):
         arrival_offsets(1, 1.0, "bursty")
+
+
+def test_parse_ramp_spec():
+    from tools.loadgen import parse_ramp_spec
+    assert parse_ramp_spec(None) is None
+    assert parse_ramp_spec("uniform") is None
+    assert parse_ramp_spec("poisson") is None
+    assert parse_ramp_spec("ramp:2:8") == {"lo": 2.0, "hi": 8.0,
+                                           "hold": 1.0 / 3.0}
+    assert parse_ramp_spec("ramp:1:4:0.5") == {"lo": 1.0, "hi": 4.0,
+                                               "hold": 0.5}
+    for bad in ("ramp:", "ramp:2", "ramp:2:8:0.3:9", "ramp:x:y",
+                "ramp:0:8", "ramp:8:2", "ramp:2:8:1.0", "ramp:2:8:-0.1"):
+        with pytest.raises(ValueError, match="ramp"):
+            parse_ramp_spec(bad)
+
+
+def test_ramp_offsets_shape_and_determinism():
+    from tools.loadgen import ramp_rate
+    ramp = {"lo": 2.0, "hi": 10.0, "hold": 1.0 / 3.0}
+    a = arrival_offsets(0, None, "ramp:2:10", duration_s=12.0)
+    b = arrival_offsets(0, None, "ramp:2:10", duration_s=12.0)
+    assert a == b                      # deterministic grid, no RNG
+    assert a[0] == 0.0 and a[-1] < 12.0
+    # arrival count ~ integral of the rate: (lo+hi)/2 on each edge,
+    # hi on the plateau -> 4*(2+10)/2 + 4*10 = 88 arrivals over 12 s
+    assert 80 <= len(a) <= 96
+    # instantaneous spacing tracks the piecewise-linear rate: gaps on
+    # the plateau (~1/hi) are much tighter than at the ramp floor
+    first_gap = a[1] - a[0]
+    mid = min(range(len(a)), key=lambda i: abs(a[i] - 6.0))
+    assert a[mid + 1] - a[mid] < first_gap / 2
+    # rate endpoints and plateau value
+    assert ramp_rate(0.0, 12.0, ramp) == 2.0
+    assert ramp_rate(6.0, 12.0, ramp) == 10.0
+    assert ramp_rate(12.0, 12.0, ramp) == 2.0
+    with pytest.raises(ValueError, match="duration"):
+        arrival_offsets(0, None, "ramp:2:10")
 
 
 def test_parse_burst_spec():

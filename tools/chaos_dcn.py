@@ -444,16 +444,221 @@ def run_router_fleet(args):
     return 0 if ok else 1
 
 
+def run_autoscale(args):
+    """The self-driving-capacity chaos experiment (--target autoscale):
+    a router fleet parked at its 1-replica floor in `--autoscale auto`
+    under a seeded piecewise-linear load ramp (`loadgen --arrival
+    ramp:LO:HI`). Gates (--expect scale): the controller scales up off
+    the floor while the ramp is still offering load (capacity arrives
+    before the surge ends, not after), drains back to the floor once
+    the ramp falls away, loses and errors nothing, and leaks zero KV
+    pages; every spawn is epoch-stamped. `--expect steady` instead
+    offers a flat comfortable load and gates ZERO decisions — the
+    flap-damper/false-positive control arm. Emits one JSON line."""
+    import json as json_mod
+    import urllib.request
+
+    sys.path.insert(0, REPO)
+    from tools import loadgen
+
+    port = _free_ports(1)[0]
+    url = f"http://127.0.0.1:{port}"
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    floor, ceiling = 1, max(2, args.replicas)
+    # --max-active 1 makes one replica's honest capacity a few req/s,
+    # so the ramp's plateau queues at the admission controller — the
+    # queue-depth signal the controller scales on — without needing to
+    # saturate the host
+    cmd = [sys.executable, os.path.join(REPO, "tools", "serve.py"),
+           "--role", "router", "--replicas", str(floor),
+           "-m", args.model_name, "-pt", args.partition,
+           "--max-len", "64", "-t", "float32", "--port", str(port),
+           "--kv-pages", str(args.kv_pages),
+           "--kv-page-size", str(args.kv_page_size),
+           "--max-active", "1",
+           "--router-poll-interval", "0.2",
+           "--fleet-scrape-interval", "0.3",
+           "--autoscale", "auto",
+           "--autoscale-min", str(floor),
+           "--autoscale-max", str(ceiling),
+           "--autoscale-confirm", "2",
+           "--autoscale-cooldown", "2.0",
+           "--autoscale-interval", "0.3",
+           "--autoscale-dwell-down", "1.0",
+           "--autoscale-queue-high", "2.0",
+           "--autoscale-queue-low", "0.5"]
+    t0 = time.monotonic()
+    proc = subprocess.Popen(cmd, env=env, text=True,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT)
+    reader = _TimedReader(proc)
+
+    def get_json(path, timeout=10.0):
+        with urllib.request.urlopen(f"{url}{path}",
+                                    timeout=timeout) as resp:
+            return json_mod.loads(resp.read())
+
+    record = {"target": "autoscale", "expect": args.expect,
+              "floor": floor, "ceiling": ceiling}
+    try:
+        deadline = time.monotonic() + args.timeout
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError("router died during startup")
+            try:
+                h = get_json("/healthz", timeout=5)
+                if h.get("ok") and all(
+                        r["state"] == "healthy"
+                        for r in h["fleet"].values()):
+                    break
+            except OSError:
+                pass
+            time.sleep(0.5)
+        else:
+            raise RuntimeError("router fleet never became healthy")
+        # warm the floor replica with the SAME shape the load will
+        # send: the first request that crosses a KV page boundary pays
+        # a multi-second XLA compile, and an unwarmed compile stall
+        # masquerades as a capacity shortfall (queue depth spikes on a
+        # fleet that is not actually hot)
+        n_new = 4 if args.expect == "steady" else 24
+        for rep in h["fleet"].values():
+            req = urllib.request.Request(
+                f"{rep['url']}/generate",
+                data=json_mod.dumps({"ids": [7] * 6,
+                                     "new_tokens": n_new}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=180) as resp:
+                resp.read()
+        load_t0 = time.monotonic()
+        if args.expect == "steady":
+            # the control arm: flat, comfortable load — the controller
+            # must record ZERO decisions (no flaps on a clean fleet)
+            report = loadgen.run_load(
+                f"{url}/generate", args.duration, 1.0,
+                mix={"interactive": 1.0}, deadline_from_slo=False,
+                new_tokens=n_new, prompt_len="6", seed=7,
+                arrival="uniform")
+        else:
+            # 24 decode tokens per request keeps one --max-active 1
+            # replica's honest capacity around ~3 req/s, so the ramp's
+            # plateau genuinely queues instead of sliding under the
+            # queue-high threshold on a fast warm cache
+            report = loadgen.run_load(
+                f"{url}/generate", args.duration, None,
+                mix={"interactive": 1.0}, deadline_from_slo=False,
+                new_tokens=n_new, prompt_len="6", seed=7,
+                arrival=args.ramp)
+        load_s = time.monotonic() - load_t0
+        # after the ramp: wait for the drain back to the floor (the
+        # down path needs queue-low confirmation + dwell + cooldown)
+        scale_down_s = None
+        settle_deadline = time.monotonic() + (
+            5.0 if args.expect == "steady" else 90.0)
+        while time.monotonic() < settle_deadline:
+            h = get_json("/healthz", timeout=5)
+            a = h.get("autoscale") or {}
+            if args.expect == "steady":
+                time.sleep(0.5)
+                continue
+            if a.get("size") == floor and (
+                    a.get("decisions") or {}).get("applied", 0) >= 2:
+                scale_down_s = time.monotonic() - load_t0 - load_s
+                break
+            time.sleep(0.5)
+        h = get_json("/healthz", timeout=5)
+        asnap = h.get("autoscale") or {}
+        # page accounting across every LIVE replica: the migrate-on-
+        # drain path must strand nothing
+        leaked = 0
+        for rep in h["fleet"].values():
+            try:
+                with urllib.request.urlopen(f"{rep['url']}/healthz",
+                                            timeout=10) as resp:
+                    body = json_mod.loads(resp.read())
+                leaked += ((body.get("serving") or {}).get("kv")
+                           or {}).get("leaked", 0)
+            except OSError:
+                pass       # a drained replica holds no pages to leak
+        spawn = reader.first("autoscale_spawn")
+        drain = reader.first("autoscale_drain")
+        spawns = [line for _, line in reader.lines
+                  if line.startswith("autoscale_spawn")]
+        epochs = [int(part.split("=", 1)[1]) for line in spawns
+                  for part in line.split() if part.startswith("epoch=")]
+        record.update({
+            "requests": report["requests"],
+            "offered_qps": report["offered_qps"],
+            "ramp": report.get("ramp"),
+            "lost": report["client_dropped"],
+            "errors": report["totals"]["error"],
+            "shed": report["totals"]["shed"],
+            "attainment": {c: v["slo_attainment"]
+                           for c, v in report["classes"].items()},
+            "decisions": asnap.get("decisions"),
+            "ticks": asnap.get("ticks"),
+            "final_size": asnap.get("size"),
+            "spawns": len(spawns),
+            "spawn_epochs": epochs,
+            "time_to_scale_up_s": (round(spawn[0] - load_t0, 3)
+                                   if spawn else None),
+            "scale_down_s": (round(scale_down_s, 3)
+                             if scale_down_s is not None else None),
+            "drained": drain is not None,
+            "pages_leaked": leaked,
+            "total_s": round(time.monotonic() - t0, 3),
+        })
+    finally:
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+        reader.join()
+    print(json.dumps(record))
+    if args.verbose:
+        for t, line in reader.lines:
+            print(f"[router +{t - t0:7.3f}] {line}", file=sys.stderr)
+    decisions = record.get("decisions") or {}
+    if args.expect == "steady":
+        # the clean-fleet gate: the governor ticked, decided NOTHING,
+        # and the fleet never left the floor
+        ok = (record["errors"] == 0 and record["lost"] == 0
+              and (record.get("ticks") or 0) > 0
+              and sum(decisions.values()) == 0
+              and record.get("final_size") == floor
+              and record["pages_leaked"] == 0)
+    else:
+        # the ramp gate: scaled up WHILE the ramp was still offering
+        # load, drained back to the floor after it, nothing lost or
+        # errored, nothing leaked
+        up_in_time = (record["time_to_scale_up_s"] is not None
+                      and record["time_to_scale_up_s"] < args.duration)
+        ok = (record["errors"] == 0 and record["lost"] == 0
+              and up_in_time and record["drained"]
+              and record.get("final_size") == floor
+              and decisions.get("applied", 0) >= 2
+              and record["pages_leaked"] == 0)
+    return 0 if ok else 1
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--target", default="runtime",
-                   choices=["runtime", "serve-disagg", "router-fleet"],
+                   choices=["runtime", "serve-disagg", "router-fleet",
+                            "autoscale"],
                    help="runtime: a runtime.py DCN fleet (the original "
                         "experiments); serve-disagg: a --disaggregate "
                         "process serving fleet with --chaos armed on "
                         "the prefill worker's ship edge; router-fleet: "
                         "a --role router replica fleet with a mid-burst "
-                        "replica SIGKILL")
+                        "replica SIGKILL; autoscale: a 1-replica-floor "
+                        "fleet in --autoscale auto under a loadgen ramp "
+                        "(--expect scale) or flat control load "
+                        "(--expect steady)")
     p.add_argument("--world", type=int, default=3)
     p.add_argument("--victim", type=int, default=1,
                    help="rank DCN_CHAOS is armed in (must not be the "
@@ -464,7 +669,7 @@ def main():
                         "slow@K[-J]:MS | jitter@K[-J]:MS | corrupt@K")
     p.add_argument("--expect", default="recover",
                    choices=["recover", "abort", "heal", "quarantine",
-                            "disagg", "router"],
+                            "disagg", "router", "scale", "steady"],
                    help="recover: the run must complete; abort: the fleet "
                         "must stop naming the victim; heal: the run must "
                         "complete AND the victim must rejoin AND the "
@@ -526,11 +731,19 @@ def main():
     p.add_argument("--kill-after", type=float, default=2.5,
                    help="router-fleet: seconds into the burst before "
                         "the SIGKILL lands on the active replica")
+    p.add_argument("--ramp", default="ramp:1:8:0.4",
+                   help="autoscale: the loadgen --arrival ramp spec "
+                        "offered during --expect scale (LO->HI->LO "
+                        "req/s over --duration)")
     args = p.parse_args()
-    if args.target in ("serve-disagg", "router-fleet"):
+    if args.target in ("serve-disagg", "router-fleet", "autoscale"):
         if args.model_name == "pipeedge/test-tiny-vit":
             # the runtime default is a ViT; serving needs a decoder
             args.model_name = "pipeedge/test-tiny-gpt2"
+        if args.target == "autoscale":
+            if args.expect not in ("scale", "steady"):
+                args.expect = "scale"
+            return run_autoscale(args)
         if args.target == "router-fleet":
             return run_router_fleet(args)
         return run_serve_disagg(args)

@@ -268,19 +268,82 @@ def _percentile(vals, q):
     return round(vals[idx], 3)
 
 
-def arrival_offsets(n, qps, arrival="uniform", rng=None):
+def parse_ramp_spec(spec):
+    """`ramp:LO:HI[:HOLD]` -> {"lo", "hi", "hold"}, or None when `spec`
+    is a plain arrival-process name. LO/HI are the offered req/s at the
+    ramp floor and plateau; HOLD is the plateau's share of the run in
+    [0, 1) (default 1/3). The offered rate rises LO->HI over the first
+    (1-HOLD)/2 of the run, holds at HI, then falls symmetrically back
+    to LO — the autoscale test workload (scale up on the rise, hold
+    through the plateau, scale back down on the fall)."""
+    if spec is None or not str(spec).startswith("ramp:"):
+        return None
+    parts = str(spec).split(":")
+    if len(parts) not in (3, 4):
+        raise ValueError(f"bad ramp spec {spec!r} "
+                         "(expected ramp:LO:HI[:HOLD])")
+    try:
+        lo, hi = float(parts[1]), float(parts[2])
+        hold = float(parts[3]) if len(parts) == 4 else 1.0 / 3.0
+    except ValueError:
+        raise ValueError(f"bad ramp spec {spec!r}: LO/HI/HOLD must be "
+                         "numbers") from None
+    if lo <= 0 or hi < lo:
+        raise ValueError(f"bad ramp spec {spec!r}: need 0 < LO <= HI")
+    if not 0.0 <= hold < 1.0:
+        raise ValueError(f"bad ramp spec {spec!r}: HOLD is the plateau "
+                         "fraction of the run, in [0, 1)")
+    return {"lo": lo, "hi": hi, "hold": hold}
+
+
+def ramp_rate(t, duration_s, ramp):
+    """Instantaneous offered rate (req/s) `t` seconds into a
+    `ramp:LO:HI[:HOLD]` run: piecewise-linear rise -> hold -> fall."""
+    edge = duration_s * (1.0 - ramp["hold"]) / 2.0
+    lo, hi = ramp["lo"], ramp["hi"]
+    if t < edge:                      # edge > 0 whenever this is reached
+        return lo + (hi - lo) * (t / edge)
+    if t <= duration_s - edge:
+        return hi
+    if t >= duration_s:
+        return lo
+    return hi - (hi - lo) * ((t - (duration_s - edge)) / edge)
+
+
+def _ramp_offsets(duration_s, ramp):
+    # Step `t += 1/rate(t)` across the run so the instantaneous spacing
+    # tracks the piecewise-linear offered rate. Deterministic — the
+    # ramp analogue of the uniform grid; `seed` still drives the class
+    # draw and prompt sampling, so a run is reproducible end-to-end.
+    offsets, t = [], 0.0
+    while t < duration_s:
+        offsets.append(t)
+        t += 1.0 / ramp_rate(t, duration_s, ramp)
+    return offsets
+
+
+def arrival_offsets(n, qps, arrival="uniform", rng=None, duration_s=None):
     """Seconds-from-start launch time of each of `n` arrivals at mean
     rate `qps`. `uniform` is the fixed 1/qps grid (the historical
     behavior); `poisson` draws seeded exponential gaps — an open-loop
     memoryless arrival process whose bursts stress the admission queue
-    harder than a metronome at the same mean rate. Pure: same (n, qps,
-    arrival, rng seed) -> same offsets, so a serve-recipe run is
-    reproducible end-to-end (the bench-record contract)."""
+    harder than a metronome at the same mean rate. `ramp:LO:HI[:HOLD]`
+    (see `parse_ramp_spec`) ignores `n`/`qps` and shapes the rate over
+    `duration_s` instead — the arrival count falls out of the rate
+    integral. Pure: same (n, qps, arrival, rng seed) -> same offsets,
+    so a serve-recipe run is reproducible end-to-end (the bench-record
+    contract)."""
+    ramp = parse_ramp_spec(arrival)
+    if ramp is not None:
+        if duration_s is None or duration_s <= 0:
+            raise ValueError("ramp arrival needs duration_s > 0")
+        return _ramp_offsets(duration_s, ramp)
     if arrival == "uniform":
         return [i / qps for i in range(n)]
     if arrival != "poisson":
         raise ValueError(f"unknown arrival process {arrival!r} "
-                         "(expected 'uniform' or 'poisson')")
+                         "(expected 'uniform', 'poisson' or "
+                         "'ramp:LO:HI[:HOLD]')")
     if rng is None:
         rng = random.Random(0)
     offsets, t = [], 0.0
@@ -337,7 +400,9 @@ def run_load(url, duration_s, qps, mix=None, slo_ms=None,
     if unknown:
         raise ValueError(f"unknown classes in mix: {sorted(unknown)}")
     total_w = sum(mix.values())
-    if total_w <= 0 or qps <= 0 or duration_s <= 0:
+    ramp = parse_ramp_spec(arrival)
+    if total_w <= 0 or duration_s <= 0 \
+            or (ramp is None and (qps is None or qps <= 0)):
         raise ValueError("mix weights, qps and duration must be > 0")
     prompt_spec = parse_prompt_spec(prompt_len)
     slo_ms = dict(DEFAULT_SLO_MS if slo_ms is None else slo_ms)
@@ -374,8 +439,14 @@ def run_load(url, duration_s, qps, mix=None, slo_ms=None,
     rng = random.Random(seed)
     inflight = threading.Semaphore(max_inflight)
     threads = []
-    n = max(1, int(round(qps * duration_s)))
-    offsets = arrival_offsets(n, qps, arrival, rng)
+    if ramp is not None:
+        offsets = arrival_offsets(0, None, arrival, rng,
+                                  duration_s=duration_s)
+        n = len(offsets)
+        qps = n / duration_s         # mean offered rate, for the report
+    else:
+        n = max(1, int(round(qps * duration_s)))
+        offsets = arrival_offsets(n, qps, arrival, rng)
     t0 = time.monotonic()
     burst_fired = False
     for i in range(n):
@@ -425,6 +496,10 @@ def run_load(url, duration_s, qps, mix=None, slo_ms=None,
               "prompt_len": prompt_spec,
               "client_dropped": stats.client_dropped,
               "classes": {}, "totals": dict.fromkeys(OUTCOMES, 0)}
+    if ramp is not None:
+        # parsed spec echoed alongside the raw `arrival` string: the
+        # autoscale CI job and bench recipe read the shape from here
+        report["ramp"] = dict(ramp)
     all_lat = []
     for c in classes:
         counts = stats.counts[c]
@@ -506,7 +581,7 @@ def main():
     p.add_argument("--port", type=int, default=8321)
     p.add_argument("--duration", type=float, default=8.0,
                    help="seconds of offered load")
-    rate = p.add_mutually_exclusive_group(required=True)
+    rate = p.add_mutually_exclusive_group()
     rate.add_argument("--qps", type=float, default=None,
                       help="explicit aggregate arrival rate")
     rate.add_argument("--overload-factor", type=float, default=None,
@@ -539,9 +614,14 @@ def main():
                    help="drives the arrival process, class draw and "
                         "prompt sampling; recorded in the JSON line")
     p.add_argument("--arrival", default="uniform",
-                   choices=["uniform", "poisson"],
-                   help="arrival process: fixed 1/qps grid or seeded "
-                        "exponential gaps (bursty open-loop traffic)")
+                   metavar="{uniform,poisson,ramp:LO:HI[:HOLD]}",
+                   help="arrival process: fixed 1/qps grid, seeded "
+                        "exponential gaps (bursty open-loop traffic), "
+                        "or a piecewise-linear offered-load ramp "
+                        "LO->HI->LO req/s with a HOLD-fraction plateau "
+                        "(default 1/3) — the autoscale test workload; "
+                        "a ramp sets the rate itself, so --qps/"
+                        "--overload-factor must be omitted")
     p.add_argument("--burst", default=None, metavar="AT:N:LEN[:WINDOW]",
                    help="inject a seeded long-prompt spike: at fraction "
                         "AT of the run, N interactive requests with "
@@ -553,10 +633,22 @@ def main():
                    help="pretty-print instead of the one-line record")
     args = p.parse_args()
 
+    try:
+        ramp = parse_ramp_spec(args.arrival)
+    except ValueError as exc:
+        p.error(str(exc))
+    if ramp is None and args.qps is None and args.overload_factor is None:
+        p.error("one of --qps / --overload-factor is required (unless "
+                "--arrival ramp:LO:HI[:HOLD] sets the offered rate)")
+    if ramp is not None and (args.qps is not None
+                             or args.overload_factor is not None):
+        p.error("--arrival ramp:... sets the offered rate itself; "
+                "drop --qps / --overload-factor")
+
     url = f"http://{args.host}:{args.port}/generate"
     qps = args.qps
     calibrated = None
-    if qps is None:
+    if qps is None and args.overload_factor is not None:
         calibrated = calibrate(url, args.calibrate_s, args.new_tokens,
                                args.prompt_len, args.timeout,
                                seed=args.seed)

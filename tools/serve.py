@@ -1723,6 +1723,10 @@ class WorkerSupervisor:
         self._backoff = {r: self.RESPAWN_DELAY_S for r in self.ranks}
         self._spawned_at = {r: 0.0 for r in self.ranks}
         self._respawn_after = {r: 0.0 for r in self.ranks}
+        # ranks retired by the autoscaler: their epoch records are
+        # RETAINED so a future add_rank continues the sequence (+1) and
+        # the rejoin stays fenced against every dead incarnation
+        self._retired = set()
         self._stop = threading.Event()
         self._lock = make_lock(f"serve.{self.TAG}_sup")
         self._watchers = []
@@ -1838,6 +1842,62 @@ class WorkerSupervisor:
         if proc is not None and proc.poll() is None:
             proc.terminate()
 
+    # -- autoscale membership (docs/FAULT_TOLERANCE.md autoscale) --------
+
+    def _on_add_rank(self, rank):
+        """Subclass hook: provision per-rank resources (a port, an
+        argv slot) BEFORE the new rank's first spawn."""
+
+    def add_rank(self, rank=None):
+        """Autoscale scale-out: bring a new rank into the supervised
+        set, preferring the lowest retired rank id. A resurrected rank
+        continues its epoch sequence (+1), so its join is fenced
+        against every dead incarnation exactly like a respawn; a
+        brand-new rank starts at epoch 0. Returns the rank spawned."""
+        with self._lock:
+            if rank is None:
+                spare = sorted(self._retired)
+                rank = spare[0] if spare else \
+                    (max(self._epoch) + 1 if self._epoch else 0)
+            if rank in self.ranks:
+                raise ValueError(f"rank {rank} is already active")
+            self._retired.discard(rank)
+            if rank in self._epoch:
+                self._epoch[rank] += 1
+            else:
+                self._epoch[rank] = 0
+            self._ready[rank] = threading.Event()
+            self._backoff[rank] = self.RESPAWN_DELAY_S
+            self._spawned_at[rank] = 0.0
+            self._respawn_after[rank] = 0.0
+            self._on_add_rank(rank)
+            self.ranks = tuple(list(self.ranks) + [rank])
+        self._spawn(rank)
+        return rank
+
+    def retire_rank(self, rank):
+        """Autoscale scale-in endgame: take `rank` out of the
+        supervised set WITHOUT respawn and terminate its incarnation.
+        The proc record is popped under the lock BEFORE the terminate,
+        so the watch loop can never observe the death and resurrect
+        it. Epoch records are retained (see add_rank)."""
+        with self._lock:
+            if rank not in self.ranks:
+                return False
+            self.ranks = tuple(r for r in self.ranks if r != rank)
+            proc = self._procs.pop(rank, None)
+            self._ready[rank].clear()
+            self._retired.add(rank)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except self._subprocess.TimeoutExpired:
+                proc.kill()
+        print(f"{self.LABEL} rank {rank} retired "
+              f"(epoch={self._epoch[rank]})", flush=True)
+        return True
+
     def snapshot(self):
         with self._lock:
             return {str(r): {"pid": p.pid, "epoch": self._epoch[r],
@@ -1942,6 +2002,15 @@ class ReplicaSupervisor(WorkerSupervisor):
         return (line.startswith("serving ")
                 and f" on {self._host}:{self._ports[rank]}" in line)
 
+    def _on_add_rank(self, rank):
+        # a resurrected rank reuses its old port (the listener is
+        # gone — nothing holds it); a brand-new rank gets a fresh one
+        while len(self._ports) <= rank:
+            self._ports += _free_ports(1, self._host)
+
+    def url_of(self, rank):
+        return f"http://{self._host}:{self._ports[rank]}"
+
 
 def _free_ports(n, host="127.0.0.1"):
     import socket as socket_mod
@@ -1952,12 +2021,16 @@ def _free_ports(n, host="127.0.0.1"):
     return ports
 
 
-def make_router_handler(router, model_name, collector=None):
+def make_router_handler(router, model_name, collector=None,
+                        autoscaler=None):
     """HTTP surface of `--role router`: the same endpoint shapes a
     single replica serves (clients need no code change), backed by the
     DecodeRouter instead of a local pipeline. `collector` (a
     FleetCollector) backs GET /fleet — the one aggregated scrape
-    surface across router + replicas + prefill workers."""
+    surface across router + replicas + prefill workers. `autoscaler`
+    (an AutoscaleRunner) adds the capacity controller's snapshot to
+    /healthz and /fleet — the block the chaos harness polls for
+    decision counts."""
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"      # chunked transfer needs 1.1
@@ -1996,7 +2069,11 @@ def make_router_handler(router, model_name, collector=None):
                                               "0)"},
                                headers=(("Retry-After", "5"),))
                 else:
-                    self._send(200, collector.fleet_snapshot())
+                    snap = collector.fleet_snapshot()
+                    if autoscaler is not None:
+                        snap["autoscale"] = \
+                            autoscaler.controller.snapshot()
+                    self._send(200, snap)
             elif self.path.split("?", 1)[0] == "/debug/spans":
                 # the router's own span ring (trace_report --fleet
                 # federation; ?drain=0 peeks without clearing)
@@ -2006,6 +2083,9 @@ def make_router_handler(router, model_name, collector=None):
             elif self.path == "/healthz":
                 code, body = router.healthz()
                 body["model"] = model_name
+                if autoscaler is not None:
+                    body["autoscale"] = \
+                        autoscaler.controller.snapshot()
                 headers = ((("Retry-After", "1"),) if code == 503
                            else ())
                 self._send(code, body, headers=headers)
@@ -2185,6 +2265,81 @@ def _run_router(args):
             interval_s=args.fleet_scrape_interval,
             history=args.fleet_history,
             burn=burn)
+    autoscaler = None
+    if args.autoscale != "off":
+        # the closed capacity loop (serving/autoscale.py): signals come
+        # from the fleet collector's aggregated scrape, actuators are
+        # the supervisor (spawn with the next epoch) + the router's
+        # drain-without-respawn path. advise mode runs the identical
+        # loop but only logs — the A/B control arm.
+        from pipeedge_tpu.serving import autoscale as autoscale_mod
+        apol = autoscale_mod.CapacityPolicy(
+            min_size=args.autoscale_min,
+            max_size=args.autoscale_max,
+            confirm=args.autoscale_confirm,
+            cooldown_s=args.autoscale_cooldown,
+            dwell_up_s=args.autoscale_dwell_up,
+            dwell_down_s=args.autoscale_dwell_down,
+            queue_high=args.autoscale_queue_high,
+            queue_low=args.autoscale_queue_low,
+            burn_high=args.autoscale_burn_high,
+            burn_low=args.autoscale_burn_low)
+
+        def _fleet_size():
+            return len(router.registry.names())
+
+        def _plan_capacity(direction, cur, target):
+            # the dry-run: an un-runnable move renders as `held`
+            if supervisor is None:
+                return {"ok": False,
+                        "reason": "static fleet (--replica-addrs)"}
+            if direction == "up":
+                return {"ok": True, "direction": "up", "to": target}
+            snap = router.registry.snapshot()
+            healthy = [n for n, rec in snap.items()
+                       if rec["state"] == "healthy"]
+            if len(healthy) < 2:
+                return {"ok": False,
+                        "reason": "no healthy survivor to absorb "
+                                  "the drain"}
+            # newest healthy replica leaves first (LIFO): the warmest
+            # caches stay with the longest-lived replicas
+            victim = max(healthy,
+                         key=lambda n: int(n[1:]) if n[1:].isdigit()
+                         else -1)
+            return {"ok": True, "direction": "down", "victim": victim,
+                    "to": target}
+
+        def _apply_capacity(plan):
+            if plan["direction"] == "up":
+                rank = supervisor.add_rank()
+                name = f"r{rank}"
+                url = supervisor.url_of(rank)
+                router.add_replica(name, url, rank=rank)
+                print(f"autoscale_spawn replica={name} rank={rank} "
+                      f"epoch={supervisor.snapshot()[str(rank)]['epoch']} "
+                      f"url={url}", flush=True)
+            else:
+                victim = plan["victim"]
+                out = router.remove_replica(victim)
+                rank = out.get("rank")
+                if rank is not None:
+                    supervisor.retire_rank(rank)
+                print(f"autoscale_drain replica={victim} rank={rank} "
+                      f"migrated={out.get('migrated_prefixes', 0)}",
+                      flush=True)
+
+        controller = autoscale_mod.CapacityController(
+            apol, mode=args.autoscale, size_fn=_fleet_size,
+            plan_fn=_plan_capacity, apply_fn=_apply_capacity,
+            label="replicas")
+
+        def _signals():
+            fleet = collector.fleet_snapshot()
+            return autoscale_mod.signals_from_fleet(fleet, _fleet_size())
+
+        autoscaler = autoscale_mod.AutoscaleRunner(
+            controller, _signals, interval_s=args.autoscale_interval)
     if supervisor is not None:
         for i, name in enumerate(replicas):
             router.bind_rank(name, i)
@@ -2192,15 +2347,24 @@ def _run_router(args):
     router.start()
     if collector is not None:
         collector.start()
+    if autoscaler is not None:
+        autoscaler.start()
+        print(f"autoscale mode={args.autoscale} "
+              f"min={args.autoscale_min} max={args.autoscale_max} "
+              f"confirm={args.autoscale_confirm} "
+              f"cooldown={args.autoscale_cooldown:g}", flush=True)
     server = ThreadingHTTPServer(
         (args.host, args.port),
         make_router_handler(router, args.model_name,
-                            collector=collector))
+                            collector=collector,
+                            autoscaler=autoscaler))
     print(f"serving router ({len(replicas)} replicas) on "
           f"{args.host}:{args.port}", flush=True)
     try:
         server.serve_forever()
     finally:
+        if autoscaler is not None:
+            autoscaler.stop()
         if collector is not None:
             collector.stop()
         router.stop()
@@ -2289,6 +2453,49 @@ def main():
                    help="seconds POST /drain waits for a replica's "
                         "in-flight requests before migrating its "
                         "prefix pages anyway")
+    # -- closed-loop capacity (docs/FAULT_TOLERANCE.md autoscale) -------
+    p.add_argument("--autoscale", default="off",
+                   choices=["off", "advise", "auto"],
+                   help="(router) closed-loop capacity control over the "
+                        "supervised replica fleet: scale-out spawns a "
+                        "replica with the next epoch (warm-up gated "
+                        "before it takes traffic), scale-in drains + "
+                        "migrates KV prefixes then retires the process. "
+                        "advise = run the identical decision loop but "
+                        "only log (the A/B control arm); auto = act")
+    p.add_argument("--autoscale-min", default=1, type=int,
+                   help="replica floor the autoscaler never drains below")
+    p.add_argument("--autoscale-max", default=2, type=int,
+                   help="replica ceiling it never spawns above")
+    p.add_argument("--autoscale-confirm", default=3, type=int,
+                   help="consecutive same-direction observation windows "
+                        "before a decision is eligible (one hot scrape "
+                        "moves nothing)")
+    p.add_argument("--autoscale-cooldown", default=10.0, type=float,
+                   metavar="S",
+                   help="seconds between decisions; each direction "
+                        "REVERSAL doubles the effective cooldown "
+                        "(flap damper, capped at 8x)")
+    p.add_argument("--autoscale-interval", default=1.0, type=float,
+                   metavar="S", help="governor tick period")
+    p.add_argument("--autoscale-dwell-up", default=0.0, type=float,
+                   metavar="S",
+                   help="seconds up-pressure must persist before "
+                        "scale-out (on top of --autoscale-confirm)")
+    p.add_argument("--autoscale-dwell-down", default=5.0, type=float,
+                   metavar="S",
+                   help="seconds calm must persist before scale-in")
+    p.add_argument("--autoscale-queue-high", default=4.0, type=float,
+                   help="summed admission queue depth PER REPLICA that "
+                        "counts as up pressure")
+    p.add_argument("--autoscale-queue-low", default=0.5, type=float,
+                   help="per-replica queue depth below which the fleet "
+                        "counts as calm (dead band against queue-high)")
+    p.add_argument("--autoscale-burn-high", default=1.0, type=float,
+                   help="short-window SLO burn rate that counts as up "
+                        "pressure")
+    p.add_argument("--autoscale-burn-low", default=0.25, type=float,
+                   help="burn rate below which the fleet counts as calm")
     # -- paged KV plane + disaggregation (docs/SERVING.md) --------------
     p.add_argument("--kv-pages", default=0, type=int,
                    help="enable the paged KV plane: N fixed-size pages "
@@ -2454,8 +2661,28 @@ def main():
             p.error("--hedge-ms must be >= 0")
         if args.route_retries < 0:
             p.error("--route-retries must be >= 0")
+        if args.autoscale != "off":
+            if args.replica_addrs is not None:
+                p.error("--autoscale needs a SUPERVISED fleet (it "
+                        "spawns and retires replica processes); "
+                        "--replica-addrs fleets are the operator's "
+                        "lifecycle")
+            if args.fleet_scrape_interval <= 0:
+                p.error("--autoscale needs the fleet collector "
+                        "(--fleet-scrape-interval > 0) — its scrape is "
+                        "the controller's signal plane")
+            if not 1 <= args.autoscale_min <= args.autoscale_max:
+                p.error("need 1 <= --autoscale-min <= --autoscale-max")
+            if args.autoscale_confirm < 1:
+                p.error("--autoscale-confirm must be >= 1")
+            if args.autoscale_interval <= 0:
+                p.error("--autoscale-interval must be > 0")
     elif args.replica_addrs is not None:
         p.error("--replica-addrs only applies with --role router")
+    elif args.autoscale != "off":
+        p.error("--autoscale only applies with --role router (runtime "
+                "--rounds fleets get the pipeline-level half via "
+                "runtime.py --autoscale-ranks)")
 
     if args.role == "router":
         # the router is a model-free proxy: no jax, no weights — it

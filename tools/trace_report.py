@@ -26,6 +26,10 @@ chaos_dcn.py idiom — with:
   readmit / recovered / floor-held) per affected rank — the gray-failure
   CI smoke gates on exactly one quarantine under an injected straggler
   and ZERO on a clean run (docs/FAULT_TOLERANCE.md gray failures)
+- `autoscale`: capacity-controller decision spans — plan / apply / held
+  / flap_damped per direction with apply durations; the autoscale chaos
+  CI gates on scale-up AND scale-down under a load ramp and ZERO
+  decisions on a steady fleet (docs/FAULT_TOLERANCE.md autoscale)
 - `failover`: detection -> recovery breakdown when a failover happened
 - `span_overhead_pct`: the recorder's own measured hot-path tax (per-span
   cost measured live on this host x span count / window)
